@@ -1,0 +1,27 @@
+"""fusioninfer-tpu: a TPU-native LLM inference serving framework.
+
+Two cooperating halves:
+
+* **Operator** (`api/`, `operator/`, `workload/`, `scheduling/`, `router/`,
+  `utils/`): a Kubernetes controller with the capabilities of the reference
+  FusionInfer operator (reference: /root/reference, pure Go,
+  ``pkg/controller/inferenceservice_controller.go``) — an ``InferenceService``
+  CRD reconciled into LeaderWorkerSet workloads, Volcano gang-scheduled
+  PodGroups, and Gateway API Inference Extension routing — except every
+  rendered pod spec treats Google Cloud TPU slices as the first-class
+  accelerator (``google.com/tpu`` limits, ``gke-tpu-topology`` selectors,
+  one LWS group == one ICI-connected slice).
+
+* **Engine** (`models/`, `ops/`, `parallel/`, `engine/`): a JAX/XLA/Pallas
+  inference engine the operator can launch as a first-class alternative to
+  external vLLM-TPU / JetStream images — paged KV cache, continuous
+  batching, tensor/sequence parallelism over a ``jax.sharding.Mesh``, ring
+  attention for long context, and an OpenAI-compatible server exposing
+  vLLM-compatible metrics for the endpoint picker.
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "fusioninfer.io"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"

@@ -1,0 +1,4 @@
+from fusioninfer_tpu.utils.hash import compute_spec_hash
+from fusioninfer_tpu.utils.names import truncate_name
+
+__all__ = ["compute_spec_hash", "truncate_name"]

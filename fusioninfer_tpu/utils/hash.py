@@ -1,0 +1,79 @@
+"""Spec-hash change detection.
+
+Every resource the operator renders carries a ``fusioninfer.io/spec-hash``
+label computed from its desired state.  The reconciler updates a child
+object only when the desired hash differs from the label on the live
+object — this is the idempotence/no-op mechanism for the whole operator
+(capability parity with the reference's FNV-32-over-deep-dump scheme,
+``pkg/util/hash.go:31-44``; re-designed here as canonical-JSON + BLAKE2b,
+which is stable across Python processes and independent of dict ordering).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+SPEC_HASH_LABEL = "fusioninfer.io/spec-hash"
+
+# Alphanumeric alphabet with vowels and easily-confused glyphs removed, so
+# hashes are safe in Kubernetes label values and never spell words.
+_SAFE_ALPHABET = "bcdfghjklmnpqrstvwxz2456789"
+
+
+def _canonicalize(obj: Any) -> Any:
+    """Reduce an object to a deterministic JSON-serializable form."""
+    if isinstance(obj, dict):
+        return {str(k): _canonicalize(v) for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))}
+    if isinstance(obj, (list, tuple)):
+        return [_canonicalize(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if isinstance(obj, bytes):
+        return obj.decode("utf-8", errors="surrogateescape")
+    # Dataclass-like / attribute objects: fall back to their dict view.
+    if hasattr(obj, "to_dict"):
+        return _canonicalize(obj.to_dict())
+    if hasattr(obj, "__dict__"):
+        return _canonicalize(vars(obj))
+    return str(obj)
+
+
+def _safe_encode(value: int) -> str:
+    if value == 0:
+        return _SAFE_ALPHABET[0]
+    base = len(_SAFE_ALPHABET)
+    out = []
+    while value:
+        value, rem = divmod(value, base)
+        out.append(_SAFE_ALPHABET[rem])
+    return "".join(reversed(out))
+
+
+def compute_spec_hash(obj: Any) -> str:
+    """Deterministic, label-safe hash of an object's desired state.
+
+    The ``fusioninfer.io/spec-hash`` label itself (and nothing else) is
+    excluded so that stamping the hash onto the object does not change it.
+    """
+    canonical = _canonicalize(obj)
+    if isinstance(canonical, dict):
+        labels = canonical.get("metadata", {}).get("labels")
+        if isinstance(labels, dict):
+            labels.pop(SPEC_HASH_LABEL, None)
+    payload = json.dumps(canonical, sort_keys=True, separators=(",", ":"))
+    digest = hashlib.blake2b(payload.encode("utf-8"), digest_size=5).digest()
+    return _safe_encode(int.from_bytes(digest, "big"))
+
+
+def stamp_spec_hash(resource: dict) -> dict:
+    """Compute the resource's spec hash and set it as a label, in place."""
+    h = compute_spec_hash(resource)
+    resource.setdefault("metadata", {}).setdefault("labels", {})[SPEC_HASH_LABEL] = h
+    return resource
+
+
+def spec_hash_of(resource: dict) -> str | None:
+    """Read the spec-hash label off a live resource, if present."""
+    return (resource.get("metadata") or {}).get("labels", {}).get(SPEC_HASH_LABEL)

@@ -1,0 +1,34 @@
+"""Kubernetes object-name helpers shared by all builders."""
+
+from __future__ import annotations
+
+import hashlib
+
+# RFC 1123 label: max 63 chars for label values; DNS subdomain names may be
+# 253 but controller-generated child names must stay label-safe because they
+# are also used in label selectors.
+MAX_NAME = 63
+
+
+def truncate_name(name: str, max_len: int = MAX_NAME) -> str:
+    """Truncate a generated name, keeping it unique via a short suffix hash.
+
+    Names at or under the limit pass through unchanged so common cases stay
+    human-readable and deterministic.
+    """
+    if len(name) <= max_len:
+        return name
+    digest = hashlib.blake2b(name.encode("utf-8"), digest_size=4).hexdigest()
+    keep = max_len - len(digest) - 1
+    return f"{name[:keep]}-{digest}"
+
+
+def dns_safe(fragment: str) -> str:
+    """Lowercase and replace characters illegal in DNS-1123 names."""
+    out = []
+    for ch in fragment.lower():
+        if ch.isalnum() or ch == "-":
+            out.append(ch)
+        else:
+            out.append("-")
+    return "".join(out).strip("-")

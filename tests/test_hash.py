@@ -1,0 +1,107 @@
+"""Mutation-matrix tests for the spec-hash idempotence fence.
+
+Mirrors the reference's regression posture (``pkg/util/hash_test.go``):
+the hash must change on every meaningful spec mutation, be deterministic,
+never be empty, and stay label-safe.
+"""
+
+import copy
+import string
+
+from fusioninfer_tpu.utils.hash import (
+    SPEC_HASH_LABEL,
+    compute_spec_hash,
+    spec_hash_of,
+    stamp_spec_hash,
+)
+
+
+def sample_lws() -> dict:
+    return {
+        "apiVersion": "leaderworkerset.x-k8s.io/v1",
+        "kind": "LeaderWorkerSet",
+        "metadata": {
+            "name": "svc-worker-0",
+            "namespace": "default",
+            "labels": {"fusioninfer.io/service": "svc"},
+        },
+        "spec": {
+            "replicas": 1,
+            "leaderWorkerTemplate": {
+                "size": 4,
+                "workerTemplate": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "engine",
+                                "image": "vllm-tpu:v1",
+                                "args": ["serve", "Qwen/Qwen3-8B"],
+                                "resources": {"limits": {"google.com/tpu": "4"}},
+                            }
+                        ],
+                        "nodeSelector": {
+                            "cloud.google.com/gke-tpu-topology": "4x4",
+                            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                        },
+                    }
+                },
+            },
+        },
+    }
+
+
+MUTATIONS = {
+    "image": lambda o: o["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]["containers"][0].__setitem__("image", "vllm-tpu:v2"),
+    "args": lambda o: o["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]["containers"][0].__setitem__("args", ["serve", "other"]),
+    "size": lambda o: o["spec"]["leaderWorkerTemplate"].__setitem__("size", 8),
+    "tpu_limit": lambda o: o["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]["containers"][0]["resources"]["limits"].__setitem__("google.com/tpu", "8"),
+    "topology": lambda o: o["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]["nodeSelector"].__setitem__("cloud.google.com/gke-tpu-topology", "2x4"),
+    "replicas": lambda o: o["spec"].__setitem__("replicas", 2),
+    "label": lambda o: o["metadata"]["labels"].__setitem__("fusioninfer.io/service", "other"),
+    "name": lambda o: o["metadata"].__setitem__("name", "svc-worker-1"),
+}
+
+
+def test_hash_changes_on_every_meaningful_mutation():
+    base = compute_spec_hash(sample_lws())
+    for name, mutate in MUTATIONS.items():
+        obj = sample_lws()
+        mutate(obj)
+        assert compute_spec_hash(obj) != base, f"mutation {name!r} did not change hash"
+
+
+def test_hash_deterministic_across_runs_and_key_order():
+    a = compute_spec_hash(sample_lws())
+    b = compute_spec_hash(sample_lws())
+    assert a == b
+    reordered = dict(reversed(list(sample_lws().items())))
+    assert compute_spec_hash(reordered) == a
+
+
+def test_hash_never_empty_and_label_safe():
+    for obj in ({}, {"a": 1}, sample_lws(), {"x": None}, {"y": [1, 2, 3]}):
+        h = compute_spec_hash(obj)
+        assert h
+        assert len(h) <= 63
+        assert all(c in string.ascii_lowercase + string.digits for c in h)
+
+
+def test_stamp_is_fixed_point():
+    obj = sample_lws()
+    before = compute_spec_hash(obj)
+    stamp_spec_hash(obj)
+    assert spec_hash_of(obj) == before
+    # Hashing again after stamping must ignore the stamped label.
+    assert compute_spec_hash(obj) == before
+    stamp_spec_hash(obj)
+    assert spec_hash_of(obj) == before
+
+
+def test_hash_ignores_only_the_hash_label():
+    obj = sample_lws()
+    stamped = copy.deepcopy(obj)
+    stamped["metadata"]["labels"][SPEC_HASH_LABEL] = "zzzz"
+    assert compute_spec_hash(stamped) == compute_spec_hash(obj)
+    other_label = copy.deepcopy(obj)
+    other_label["metadata"]["labels"]["extra"] = "zzzz"
+    assert compute_spec_hash(other_label) != compute_spec_hash(obj)

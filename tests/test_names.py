@@ -1,0 +1,17 @@
+from fusioninfer_tpu.utils.names import dns_safe, truncate_name
+
+
+def test_short_names_pass_through():
+    assert truncate_name("svc-worker-0") == "svc-worker-0"
+
+
+def test_long_names_truncate_to_limit_and_stay_unique():
+    a = truncate_name("x" * 100 + "a")
+    b = truncate_name("x" * 100 + "b")
+    assert len(a) <= 63 and len(b) <= 63
+    assert a != b
+
+
+def test_dns_safe():
+    assert dns_safe("My_Service.Name") == "my-service-name"
+    assert dns_safe("--edge--") == "edge"
