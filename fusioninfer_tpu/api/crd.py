@@ -1,0 +1,160 @@
+"""CustomResourceDefinition manifest for InferenceService.
+
+The reference generates its CRD with controller-gen
+(``config/crd/bases/fusioninfer.io_inferenceservices.yaml``); here the
+schema is produced programmatically from one source of truth so
+``fusioninfer-tpu render crd`` and the fake API server can never drift
+from the Python types.  Pod/HTTPRoute/Gateway passthroughs stay untyped
+(``x-kubernetes-preserve-unknown-fields``) to dodge CRD size limits, the
+same escape hatch the reference chose (RawExtension,
+``inferenceservice_types.go:74-104``).
+"""
+
+from __future__ import annotations
+
+from fusioninfer_tpu import GROUP, VERSION
+from fusioninfer_tpu.api.types import ComponentType, EngineKind, RoutingStrategy
+
+PLURAL = "inferenceservices"
+SINGULAR = "inferenceservice"
+KIND = "InferenceService"
+LIST_KIND = "InferenceServiceList"
+SHORT_NAMES = ["isvc", "fisvc"]
+
+_RAW = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+
+def _role_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["name", "componentType"],
+        "properties": {
+            "name": {"type": "string", "minLength": 1},
+            "componentType": {
+                "type": "string",
+                "enum": [c.value for c in ComponentType],
+            },
+            "replicas": {"type": "integer", "minimum": 0, "default": 1},
+            "engine": {
+                "type": "string",
+                "enum": [e.value for e in EngineKind],
+                "default": EngineKind.VLLM_TPU.value,
+            },
+            "template": _RAW,
+            "tpu": {
+                "type": "object",
+                "required": ["type", "topology"],
+                "properties": {
+                    "type": {"type": "string"},
+                    "topology": {"type": "string", "pattern": r"^\d+x\d+(x\d+)?$"},
+                    "chipsPerHost": {"type": "integer", "minimum": 1},
+                },
+            },
+            "multinode": {
+                "type": "object",
+                "properties": {"nodeCount": {"type": "integer", "minimum": 1}},
+            },
+            "strategy": {
+                "type": "string",
+                "enum": [s.value for s in RoutingStrategy],
+            },
+            "httproute": _RAW,
+            "gateway": _RAW,
+            "endpointPickerConfig": {"type": "string"},
+        },
+    }
+
+
+def _status_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "conditions": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "required": ["type", "status"],
+                    "properties": {
+                        "type": {"type": "string"},
+                        "status": {"type": "string"},
+                        "reason": {"type": "string"},
+                        "message": {"type": "string"},
+                        "observedGeneration": {"type": "integer"},
+                        "lastTransitionTime": {"type": "string"},
+                    },
+                },
+            },
+            "componentStatus": {
+                "type": "object",
+                "additionalProperties": {
+                    "type": "object",
+                    "properties": {
+                        "desiredReplicas": {"type": "integer"},
+                        "readyReplicas": {"type": "integer"},
+                        "nodesPerReplica": {"type": "integer"},
+                        "totalPods": {"type": "integer"},
+                        "readyPods": {"type": "integer"},
+                        "phase": {"type": "string"},
+                        "lastUpdateTime": {"type": "string"},
+                    },
+                },
+            },
+        },
+    }
+
+
+def build_crd() -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": LIST_KIND,
+                "plural": PLURAL,
+                "singular": SINGULAR,
+                "shortNames": SHORT_NAMES,
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "Ready",
+                            "type": "string",
+                            "jsonPath": ".status.conditions[?(@.type=='Active')].status",
+                        },
+                        {"name": "Age", "type": "date", "jsonPath": ".metadata.creationTimestamp"},
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": {
+                                    "type": "object",
+                                    "required": ["roles"],
+                                    "properties": {
+                                        "roles": {
+                                            "type": "array",
+                                            "minItems": 1,
+                                            "items": _role_schema(),
+                                        }
+                                    },
+                                },
+                                "status": _status_schema(),
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }

@@ -1,0 +1,127 @@
+"""TPU slice topology arithmetic.
+
+The reference operator makes users hand-write accelerator limits inside the
+raw pod template and express multi-node shape as a free-form ``nodeCount``
+(``pkg/workload/lws.go:83-85``).  On TPU that is not enough information: a
+slice is defined by ``(generation, topology)``, and GKE forms the
+ICI-connected slice only when the pod spec carries consistent
+``gke-tpu-accelerator`` / ``gke-tpu-topology`` node selectors, a
+``google.com/tpu`` chip limit equal to chips-per-host, and a host count
+equal to the slice's host count.  Getting any of these wrong fails
+silently as a hung XLA init — so the operator owns this arithmetic.
+
+Sources for the tables: public GKE TPU docs (machine types
+ct4p/ct5lp/ct5p/ct6e) — encoded as data, no external calls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# GKE node-selector values per TPU generation.
+GKE_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+ACCELERATOR_TYPES = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+# 2D generations may pack a whole small slice into one host (single-host
+# machine shapes); everything larger is carved into 4-chip hosts.
+_SINGLE_HOST_TOPOLOGIES = {
+    "v5e": {"1x1": 1, "2x2": 4, "2x4": 8},
+    "v6e": {"1x1": 1, "2x2": 4, "2x4": 8},
+}
+_DEFAULT_CHIPS_PER_HOST = 4
+
+
+class TopologyError(ValueError):
+    """Raised for malformed or unknown TPU slice descriptions."""
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """Resolved shape of one TPU slice (== one LWS replica group)."""
+
+    accelerator_type: str  # "v5e", ...
+    topology: str  # "4x4", "2x2x4", ...
+    chips: int
+    hosts: int
+    chips_per_host: int
+
+    @property
+    def gke_accelerator(self) -> str:
+        return ACCELERATOR_TYPES[self.accelerator_type]
+
+    def node_selector(self) -> dict:
+        return {
+            GKE_ACCELERATOR_LABEL: self.gke_accelerator,
+            GKE_TOPOLOGY_LABEL: self.topology,
+        }
+
+    def pod_tpu_limits(self) -> dict:
+        return {TPU_RESOURCE: str(self.chips_per_host)}
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError:
+        raise TopologyError(f"malformed TPU topology {topology!r}; expected e.g. '4x4' or '2x2x4'")
+    if not dims or any(d < 1 for d in dims):
+        raise TopologyError(f"malformed TPU topology {topology!r}; dims must be >= 1")
+    return dims
+
+
+def resolve_slice(
+    accelerator_type: str,
+    topology: str,
+    chips_per_host: int | None = None,
+) -> SliceShape:
+    """Resolve ``(generation, topology)`` into chips / hosts / chips-per-host.
+
+    ``chips_per_host`` overrides the machine-shape default (e.g. a
+    ct5lp-hightpu-8t pool serving a 2x4 slice on one host vs two
+    ct5lp-hightpu-4t hosts).
+    """
+    # normalize e.g. "tpu-v5e" / "TPU v5e" → "v5e"
+    atype = accelerator_type.lower().replace("tpu", "").strip("- ")
+    if atype not in ACCELERATOR_TYPES:
+        raise TopologyError(
+            f"unknown TPU accelerator type {accelerator_type!r}; known: {sorted(ACCELERATOR_TYPES)}"
+        )
+    dims = parse_topology(topology)
+    expected_ndim = 3 if atype in ("v4", "v5p") else 2
+    if len(dims) != expected_ndim:
+        raise TopologyError(
+            f"TPU {atype} topologies are {expected_ndim}-D; got {topology!r}"
+        )
+    chips = 1
+    for d in dims:
+        chips *= d
+
+    if chips_per_host is None:
+        single_host = _SINGLE_HOST_TOPOLOGIES.get(atype, {})
+        canon = "x".join(str(d) for d in sorted(dims))
+        if canon in single_host:
+            chips_per_host = single_host[canon]
+        else:
+            chips_per_host = _DEFAULT_CHIPS_PER_HOST
+    if chips_per_host < 1:
+        raise TopologyError("chipsPerHost must be >= 1")
+    if chips % chips_per_host != 0 and chips > chips_per_host:
+        raise TopologyError(
+            f"slice of {chips} chips not divisible into hosts of {chips_per_host}"
+        )
+    hosts = max(1, chips // chips_per_host)
+    return SliceShape(
+        accelerator_type=atype,
+        topology="x".join(str(d) for d in dims),
+        chips=chips,
+        hosts=hosts,
+        chips_per_host=min(chips_per_host, chips),
+    )

@@ -1,0 +1,328 @@
+"""InferenceService API types.
+
+Capability parity with the reference CRD
+(``api/core/v1alpha1/inferenceservice_types.go:24-183``), re-designed with a
+first-class ``tpu`` block per role instead of free-form accelerator limits
+buried in the raw pod template.  Objects parse from / serialize to plain
+dicts (the shape ``kubectl apply`` would submit) so the operator, the fake
+API server, and the CLI all share one representation.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from fusioninfer_tpu import API_VERSION
+from fusioninfer_tpu.api.topology import SliceShape, resolve_slice
+
+
+class ComponentType(str, enum.Enum):
+    ROUTER = "router"
+    PREFILLER = "prefiller"
+    DECODER = "decoder"
+    WORKER = "worker"
+
+    @property
+    def is_worker_like(self) -> bool:
+        return self in (ComponentType.PREFILLER, ComponentType.DECODER, ComponentType.WORKER)
+
+
+class RoutingStrategy(str, enum.Enum):
+    PREFIX_CACHE = "prefix-cache"
+    KV_CACHE_UTILIZATION = "kv-cache-utilization"
+    QUEUE_SIZE = "queue-size"
+    LORA_AFFINITY = "lora-affinity"
+    PD_DISAGGREGATION = "pd-disaggregation"
+
+
+class EngineKind(str, enum.Enum):
+    """Which inference engine runs inside the role's pods.
+
+    Determines the multi-host bootstrap wrap (reference hardcodes Ray for
+    vLLM-GPU, ``pkg/workload/lws.go:189-242``; on TPU the wrap is a
+    per-engine strategy — SURVEY §7 hard part 2).
+    """
+
+    VLLM_TPU = "vllm-tpu"  # Ray-on-TPU bootstrap
+    JETSTREAM = "jetstream"  # JAX coordinator bootstrap
+    NATIVE = "native"  # in-repo fusioninfer_tpu.engine, JAX coordinator bootstrap
+    CUSTOM = "custom"  # no wrapping; user command used verbatim
+
+
+class ComponentPhase(str, enum.Enum):
+    PENDING = "Pending"
+    DEPLOYING = "Deploying"
+    RUNNING = "Running"
+    FAILED = "Failed"
+
+
+class ValidationError(ValueError):
+    """Raised when an InferenceService spec is structurally invalid."""
+
+
+@dataclass
+class TPUSlice:
+    """Declarative TPU accelerator request for one role.
+
+    One replica of the role occupies one slice of this shape; the workload
+    builder derives host count, node selectors, and chip limits from it.
+    """
+
+    type: str = "v5e"
+    topology: str = "1x1"
+    chips_per_host: Optional[int] = None
+
+    def resolve(self) -> SliceShape:
+        return resolve_slice(self.type, self.topology, self.chips_per_host)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TPUSlice":
+        return cls(
+            type=d.get("type", "v5e"),
+            topology=d.get("topology", "1x1"),
+            chips_per_host=d.get("chipsPerHost"),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"type": self.type, "topology": self.topology}
+        if self.chips_per_host is not None:
+            out["chipsPerHost"] = self.chips_per_host
+        return out
+
+
+@dataclass
+class Multinode:
+    """Legacy free-form host count (reference parity); prefer ``tpu``."""
+
+    node_count: int = 1
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Multinode":
+        return cls(node_count=int(d.get("nodeCount", 1)))
+
+    def to_dict(self) -> dict:
+        return {"nodeCount": self.node_count}
+
+
+@dataclass
+class Role:
+    name: str
+    component_type: ComponentType
+    # worker-like fields
+    replicas: int = 1
+    template: Optional[dict] = None  # raw PodTemplateSpec passthrough
+    tpu: Optional[TPUSlice] = None
+    multinode: Optional[Multinode] = None
+    engine: EngineKind = EngineKind.VLLM_TPU
+    # router fields
+    strategy: Optional[RoutingStrategy] = None
+    httproute: Optional[dict] = None  # raw HTTPRouteSpec passthrough
+    gateway: Optional[dict] = None  # raw Gateway passthrough
+    endpoint_picker_config: Optional[str] = None  # raw EPP config YAML, wins outright
+
+    def nodes_per_replica(self) -> int:
+        """Hosts occupied by one replica of this role."""
+        if self.tpu is not None:
+            return self.tpu.resolve().hosts
+        if self.multinode is not None:
+            return max(1, self.multinode.node_count)
+        return 1
+
+    def slice_shape(self) -> Optional[SliceShape]:
+        return self.tpu.resolve() if self.tpu is not None else None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Role":
+        try:
+            ctype = ComponentType(d.get("componentType", "worker"))
+        except ValueError:
+            raise ValidationError(f"unknown componentType {d.get('componentType')!r}")
+        strategy = None
+        if d.get("strategy"):
+            try:
+                strategy = RoutingStrategy(d["strategy"])
+            except ValueError:
+                raise ValidationError(f"unknown routing strategy {d['strategy']!r}")
+        try:
+            engine = EngineKind(d.get("engine", "vllm-tpu"))
+        except ValueError:
+            raise ValidationError(f"unknown engine {d.get('engine')!r}")
+        return cls(
+            name=d.get("name", ""),
+            component_type=ctype,
+            replicas=int(d.get("replicas", 1)),
+            template=d.get("template"),
+            tpu=TPUSlice.from_dict(d["tpu"]) if d.get("tpu") else None,
+            multinode=Multinode.from_dict(d["multinode"]) if d.get("multinode") else None,
+            engine=engine,
+            strategy=strategy,
+            httproute=d.get("httproute"),
+            gateway=d.get("gateway"),
+            endpoint_picker_config=d.get("endpointPickerConfig"),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {
+            "name": self.name,
+            "componentType": self.component_type.value,
+        }
+        if self.component_type.is_worker_like:
+            out["replicas"] = self.replicas
+            out["engine"] = self.engine.value
+            if self.tpu is not None:
+                out["tpu"] = self.tpu.to_dict()
+            if self.multinode is not None:
+                out["multinode"] = self.multinode.to_dict()
+        if self.template is not None:
+            out["template"] = self.template
+        if self.strategy is not None:
+            out["strategy"] = self.strategy.value
+        if self.httproute is not None:
+            out["httproute"] = self.httproute
+        if self.gateway is not None:
+            out["gateway"] = self.gateway
+        if self.endpoint_picker_config is not None:
+            out["endpointPickerConfig"] = self.endpoint_picker_config
+        return out
+
+
+@dataclass
+class ComponentStatus:
+    """Per-role rollup (reference ``inferenceservice_types.go:140-165``).
+
+    With replicas=2 and a 4-host slice: total_pods=8, a replica counts
+    ready only when all of its hosts are ready.
+    """
+
+    desired_replicas: int = 0
+    ready_replicas: int = 0
+    nodes_per_replica: int = 1
+    total_pods: int = 0
+    ready_pods: int = 0
+    phase: ComponentPhase = ComponentPhase.PENDING
+    last_update_time: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        out = {
+            "desiredReplicas": self.desired_replicas,
+            "readyReplicas": self.ready_replicas,
+            "nodesPerReplica": self.nodes_per_replica,
+            "totalPods": self.total_pods,
+            "readyPods": self.ready_pods,
+            "phase": self.phase.value,
+        }
+        if self.last_update_time:
+            out["lastUpdateTime"] = self.last_update_time
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ComponentStatus":
+        return cls(
+            desired_replicas=d.get("desiredReplicas", 0),
+            ready_replicas=d.get("readyReplicas", 0),
+            nodes_per_replica=d.get("nodesPerReplica", 1),
+            total_pods=d.get("totalPods", 0),
+            ready_pods=d.get("readyPods", 0),
+            phase=ComponentPhase(d.get("phase", "Pending")),
+            last_update_time=d.get("lastUpdateTime"),
+        )
+
+
+@dataclass
+class InferenceServiceSpec:
+    roles: list[Role] = field(default_factory=list)
+
+    def worker_roles(self) -> list[Role]:
+        return [r for r in self.roles if r.component_type.is_worker_like]
+
+    def router_roles(self) -> list[Role]:
+        return [r for r in self.roles if r.component_type == ComponentType.ROUTER]
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InferenceServiceSpec":
+        return cls(roles=[Role.from_dict(r) for r in d.get("roles", [])])
+
+    def to_dict(self) -> dict:
+        return {"roles": [r.to_dict() for r in self.roles]}
+
+
+@dataclass
+class InferenceService:
+    name: str
+    namespace: str = "default"
+    uid: Optional[str] = None
+    generation: int = 1
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+    spec: InferenceServiceSpec = field(default_factory=InferenceServiceSpec)
+    status: dict = field(default_factory=dict)
+
+    KIND = "InferenceService"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InferenceService":
+        meta = d.get("metadata", {})
+        svc = cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            uid=meta.get("uid"),
+            generation=meta.get("generation", 1),
+            labels=dict(meta.get("labels") or {}),
+            annotations=dict(meta.get("annotations") or {}),
+            spec=InferenceServiceSpec.from_dict(d.get("spec", {})),
+            status=dict(d.get("status") or {}),
+        )
+        return svc
+
+    def to_dict(self) -> dict:
+        meta: dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.uid:
+            meta["uid"] = self.uid
+        if self.generation:
+            meta["generation"] = self.generation
+        if self.labels:
+            meta["labels"] = dict(self.labels)
+        if self.annotations:
+            meta["annotations"] = dict(self.annotations)
+        out = {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": meta,
+            "spec": self.spec.to_dict(),
+        }
+        if self.status:
+            out["status"] = self.status
+        return out
+
+    def validate(self) -> None:
+        """Structural validation, the webhook-equivalent of the CRD schema."""
+        if not self.name:
+            raise ValidationError("metadata.name is required")
+        if not self.spec.roles:
+            raise ValidationError("spec.roles must not be empty")
+        seen: set[str] = set()
+        for role in self.spec.roles:
+            if not role.name:
+                raise ValidationError("every role needs a name")
+            if role.name in seen:
+                raise ValidationError(f"duplicate role name {role.name!r}")
+            seen.add(role.name)
+            if role.component_type.is_worker_like:
+                if role.replicas < 0:
+                    raise ValidationError(f"role {role.name!r}: replicas must be >= 0")
+                if role.template is None:
+                    raise ValidationError(f"role {role.name!r}: worker roles require a pod template")
+                if role.tpu is not None:
+                    role.tpu.resolve()  # raises TopologyError on bad shapes
+            else:
+                if role.strategy is None and role.endpoint_picker_config is None:
+                    raise ValidationError(
+                        f"role {role.name!r}: router roles need a strategy or endpointPickerConfig"
+                    )
+        ptypes = {r.component_type for r in self.spec.roles}
+        if (ComponentType.PREFILLER in ptypes) != (ComponentType.DECODER in ptypes):
+            raise ValidationError(
+                "prefiller and decoder roles must be declared together for PD disaggregation"
+            )

@@ -1,0 +1,120 @@
+"""fusioninfer-tpu command-line interface.
+
+Subcommands:
+
+* ``controller run`` — start the operator (the reference's ``cmd/main.go``
+  equivalent: flags, probes on :8081, watch loop).
+* ``render crd`` — print the InferenceService CRD manifest.
+* ``render resources -f svc.yaml`` — dry-run: print every child resource
+  the reconciler would create for a manifest.
+* ``engine serve`` — start the in-repo TPU inference engine (OpenAI API +
+  /metrics); see ``fusioninfer_tpu.engine``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+import yaml
+
+
+def _cmd_controller_run(args: argparse.Namespace) -> int:
+    from fusioninfer_tpu.operator.kubeclient import KubeClient
+    from fusioninfer_tpu.operator.manager import Manager
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    client = KubeClient()
+    mgr = Manager(
+        client,
+        namespace=args.namespace,
+        probe_port=args.probe_port,
+        default_queue=args.volcano_queue or None,
+    )
+    mgr.run_forever()
+    return 0
+
+
+def _cmd_render(args: argparse.Namespace) -> int:
+    from fusioninfer_tpu.api import InferenceService, build_crd
+    from fusioninfer_tpu.operator.render import render_all
+
+    if args.what == "crd":
+        yaml.safe_dump(build_crd(), sys.stdout, sort_keys=False)
+        return 0
+    # resources
+    if not args.file:
+        print("render resources requires -f <manifest.yaml>", file=sys.stderr)
+        return 2
+    with open(args.file) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    rendered = []
+    for doc in docs:
+        if doc.get("kind") != "InferenceService":
+            print(f"skipping non-InferenceService document kind={doc.get('kind')}", file=sys.stderr)
+            continue
+        try:
+            svc = InferenceService.from_dict(doc)
+            svc.validate()
+            rendered += render_all(svc, queue=args.volcano_queue or None)
+        except ValueError as e:
+            name = (doc.get("metadata") or {}).get("name", "?")
+            print(f"error: InferenceService {name!r} invalid: {e}", file=sys.stderr)
+            return 1
+    yaml.safe_dump_all(rendered, sys.stdout, sort_keys=False)
+    return 0
+
+
+def _cmd_engine_serve(args: argparse.Namespace) -> int:
+    from fusioninfer_tpu.engine.server import serve_from_args
+
+    return serve_from_args(args)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="fusioninfer-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    controller = sub.add_parser("controller", help="operator controller-manager")
+    csub = controller.add_subparsers(dest="subcommand", required=True)
+    run = csub.add_parser("run", help="run the controller against the cluster")
+    run.add_argument("--namespace", default="default")
+    run.add_argument("--probe-port", type=int, default=8081)
+    run.add_argument("--volcano-queue", default="")
+    run.add_argument("-v", "--verbose", action="store_true")
+    run.set_defaults(func=_cmd_controller_run)
+
+    render = sub.add_parser("render", help="render manifests without a cluster")
+    render.add_argument("what", choices=["crd", "resources"])
+    render.add_argument("-f", "--file", help="InferenceService manifest")
+    render.add_argument("--volcano-queue", default="")
+    render.set_defaults(func=_cmd_render)
+
+    engine = sub.add_parser("engine", help="in-repo TPU inference engine")
+    esub = engine.add_subparsers(dest="subcommand", required=True)
+    serve = esub.add_parser("serve", help="serve an OpenAI-compatible API")
+    serve.add_argument("model", nargs="?", default="qwen3-tiny", help="model name or preset")
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--port", type=int, default=8000)
+    serve.add_argument("--max-batch-size", type=int, default=8)
+    serve.add_argument("--max-model-len", type=int, default=4096)
+    serve.add_argument("--page-size", type=int, default=128)
+    serve.add_argument("--hbm-utilization", type=float, default=0.85)
+    serve.add_argument("--tensor-parallel-size", type=int, default=1)
+    serve.add_argument("--seed", type=int, default=0)
+    serve.set_defaults(func=_cmd_engine_serve)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+from fusioninfer_tpu.operator.client import (
+    Conflict,
+    K8sClient,
+    NotFound,
+    RESOURCE_REGISTRY,
+    set_owner_reference,
+)
+from fusioninfer_tpu.operator.fake import FakeK8s
+from fusioninfer_tpu.operator.manager import Manager, WorkQueue
+from fusioninfer_tpu.operator.reconciler import InferenceServiceReconciler, ReconcileResult
+
+__all__ = [
+    "Conflict",
+    "K8sClient",
+    "NotFound",
+    "RESOURCE_REGISTRY",
+    "set_owner_reference",
+    "FakeK8s",
+    "Manager",
+    "WorkQueue",
+    "InferenceServiceReconciler",
+    "ReconcileResult",
+]

@@ -1,0 +1,104 @@
+"""Kubernetes client abstraction the reconciler runs against.
+
+The reconciler only ever needs these six verbs; implementations are the
+in-memory :mod:`fusioninfer_tpu.operator.fake` (tests, the envtest
+equivalent) and the stdlib-only REST client in
+:mod:`fusioninfer_tpu.operator.kubeclient` (real clusters).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, Optional
+
+
+class NotFound(Exception):
+    def __init__(self, kind: str, namespace: str, name: str):
+        super().__init__(f"{kind} {namespace}/{name} not found")
+        self.kind, self.namespace, self.name = kind, namespace, name
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency conflict on update."""
+
+
+# kind -> (apiVersion, plural) for every resource the operator touches.
+RESOURCE_REGISTRY: dict[str, tuple[str, str]] = {
+    "InferenceService": ("fusioninfer.io/v1alpha1", "inferenceservices"),
+    "LeaderWorkerSet": ("leaderworkerset.x-k8s.io/v1", "leaderworkersets"),
+    "PodGroup": ("scheduling.volcano.sh/v1beta1", "podgroups"),
+    "ConfigMap": ("v1", "configmaps"),
+    "Service": ("v1", "services"),
+    "ServiceAccount": ("v1", "serviceaccounts"),
+    "Deployment": ("apps/v1", "deployments"),
+    "Role": ("rbac.authorization.k8s.io/v1", "roles"),
+    "RoleBinding": ("rbac.authorization.k8s.io/v1", "rolebindings"),
+    "InferencePool": ("inference.networking.k8s.io/v1", "inferencepools"),
+    "HTTPRoute": ("gateway.networking.k8s.io/v1", "httproutes"),
+    "Pod": ("v1", "pods"),
+    "Event": ("v1", "events"),
+}
+
+
+class K8sClient(abc.ABC):
+    @abc.abstractmethod
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        """Return the live object or raise :class:`NotFound`."""
+
+    @abc.abstractmethod
+    def list(self, kind: str, namespace: str, label_selector: Optional[dict] = None) -> list[dict]:
+        """List objects, optionally filtered by exact-match labels."""
+
+    @abc.abstractmethod
+    def create(self, obj: dict) -> dict: ...
+
+    @abc.abstractmethod
+    def update(self, obj: dict) -> dict: ...
+
+    @abc.abstractmethod
+    def update_status(self, obj: dict) -> dict:
+        """Write only the status subresource."""
+
+    @abc.abstractmethod
+    def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+    # -- helpers shared by implementations --
+
+    def get_or_none(self, kind: str, namespace: str, name: str) -> Optional[dict]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+
+def matches_labels(obj: dict, selector: Optional[dict]) -> bool:
+    if not selector:
+        return True
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def set_owner_reference(child: dict, owner: dict, controller: bool = True) -> None:
+    """Stamp the controller ownerReference used for cascade deletion and
+    child→parent requeue mapping."""
+    meta = owner.get("metadata", {})
+    ref = {
+        "apiVersion": owner.get("apiVersion", ""),
+        "kind": owner.get("kind", ""),
+        "name": meta.get("name", ""),
+        "uid": meta.get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+    refs = child.setdefault("metadata", {}).setdefault("ownerReferences", [])
+    for existing in refs:
+        if existing.get("uid") == ref["uid"] and existing.get("kind") == ref["kind"]:
+            return
+    refs.append(ref)
+
+
+def owner_uids(obj: dict) -> Iterable[str]:
+    for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
+        uid = ref.get("uid")
+        if uid:
+            yield uid

@@ -1,0 +1,78 @@
+"""Status condition management.
+
+Parity with the reference condition manager (``pkg/controller/condition.go:26-85``):
+conditions Initialized / Active / Failed with reasons Creating / Processing /
+Available / Failed, each carrying ``observedGeneration``.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+COND_INITIALIZED = "Initialized"
+COND_ACTIVE = "Active"
+COND_FAILED = "Failed"
+
+REASON_CREATING = "Creating"
+REASON_PROCESSING = "Processing"
+REASON_AVAILABLE = "Available"
+REASON_FAILED = "Failed"
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def set_condition(
+    status: dict,
+    cond_type: str,
+    cond_status: bool,
+    reason: str,
+    message: str,
+    observed_generation: int,
+) -> None:
+    """Upsert a condition; lastTransitionTime moves only on status flips."""
+    conditions = status.setdefault("conditions", [])
+    new = {
+        "type": cond_type,
+        "status": "True" if cond_status else "False",
+        "reason": reason,
+        "message": message,
+        "observedGeneration": observed_generation,
+        "lastTransitionTime": _now(),
+    }
+    for i, existing in enumerate(conditions):
+        if existing.get("type") == cond_type:
+            if existing.get("status") == new["status"]:
+                new["lastTransitionTime"] = existing.get("lastTransitionTime", new["lastTransitionTime"])
+            conditions[i] = new
+            return
+    conditions.append(new)
+
+
+def get_condition(status: dict, cond_type: str) -> dict | None:
+    for c in status.get("conditions") or []:
+        if c.get("type") == cond_type:
+            return c
+    return None
+
+
+def set_initialized(status: dict, generation: int) -> None:
+    set_condition(status, COND_INITIALIZED, True, REASON_CREATING, "InferenceService accepted", generation)
+
+
+def set_active(status: dict, generation: int) -> None:
+    set_condition(status, COND_ACTIVE, True, REASON_AVAILABLE, "all components ready", generation)
+
+
+def set_processing(status: dict, generation: int, message: str = "components deploying") -> None:
+    set_condition(status, COND_ACTIVE, False, REASON_PROCESSING, message, generation)
+
+
+def set_failed(status: dict, generation: int, message: str) -> None:
+    set_condition(status, COND_FAILED, True, REASON_FAILED, message, generation)
+
+
+def clear_failed(status: dict, generation: int) -> None:
+    if get_condition(status, COND_FAILED):
+        set_condition(status, COND_FAILED, False, REASON_AVAILABLE, "", generation)

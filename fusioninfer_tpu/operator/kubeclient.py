@@ -1,0 +1,187 @@
+"""Stdlib-only Kubernetes REST client.
+
+The runtime client for real clusters (the reference leans on
+controller-runtime's client; no ``kubernetes`` Python package is assumed
+here).  Supports in-cluster config (service-account token + CA) and
+kubeconfig-style explicit configuration; implements the six verbs of
+:class:`~fusioninfer_tpu.operator.client.K8sClient` plus a chunked watch
+stream used by the manager.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Iterator, Optional
+
+from fusioninfer_tpu.operator.client import (
+    Conflict,
+    K8sClient,
+    NotFound,
+    RESOURCE_REGISTRY,
+)
+
+logger = logging.getLogger("fusioninfer.kubeclient")
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeConfig:
+    def __init__(self, host: str, token: Optional[str] = None, ca_file: Optional[str] = None,
+                 verify: bool = True):
+        self.host = host.rstrip("/")
+        self.token = token
+        self.ca_file = ca_file
+        self.verify = verify
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConfig":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError("not running in a cluster (KUBERNETES_SERVICE_HOST unset)")
+        with open(os.path.join(SERVICEACCOUNT_DIR, "token")) as f:
+            token = f.read().strip()
+        ca = os.path.join(SERVICEACCOUNT_DIR, "ca.crt")
+        return cls(f"https://{host}:{port}", token=token, ca_file=ca if os.path.exists(ca) else None)
+
+    @classmethod
+    def from_env(cls) -> "KubeConfig":
+        """KUBE_API_SERVER / KUBE_TOKEN / KUBE_CA_FILE, falling back to in-cluster."""
+        host = os.environ.get("KUBE_API_SERVER")
+        if host:
+            return cls(
+                host,
+                token=os.environ.get("KUBE_TOKEN"),
+                ca_file=os.environ.get("KUBE_CA_FILE"),
+                verify=os.environ.get("KUBE_INSECURE", "") != "1",
+            )
+        return cls.in_cluster()
+
+
+def _api_path(api_version: str, namespace: str, plural: str, name: str = "") -> str:
+    prefix = f"/api/{api_version}" if "/" not in api_version else f"/apis/{api_version}"
+    path = f"{prefix}/namespaces/{namespace}/{plural}"
+    if name:
+        path += f"/{name}"
+    return path
+
+
+class KubeClient(K8sClient):
+    def __init__(self, config: Optional[KubeConfig] = None):
+        self.config = config or KubeConfig.from_env()
+        if self.config.ca_file:
+            self._ctx = ssl.create_default_context(cafile=self.config.ca_file)
+        elif not self.config.verify:
+            self._ctx = ssl._create_unverified_context()  # explicit opt-in via KUBE_INSECURE
+        else:
+            self._ctx = ssl.create_default_context()
+
+    # -- plumbing --
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 query: Optional[dict] = None, timeout: float = 30.0):
+        url = self.config.host + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        return urllib.request.urlopen(req, context=self._ctx, timeout=timeout)
+
+    def _json(self, method: str, path: str, body: Optional[dict] = None,
+              query: Optional[dict] = None) -> dict:
+        try:
+            with self._request(method, path, body, query) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            if e.code == 404:
+                raise NotFound("?", "?", path) from None
+            if e.code == 409:
+                raise Conflict(detail) from None
+            raise RuntimeError(f"{method} {path} -> HTTP {e.code}: {detail}") from None
+
+    @staticmethod
+    def _resolve(kind: str) -> tuple[str, str]:
+        try:
+            return RESOURCE_REGISTRY[kind]
+        except KeyError:
+            raise ValueError(f"unknown kind {kind!r}; add it to RESOURCE_REGISTRY") from None
+
+    # -- verbs --
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        api_version, plural = self._resolve(kind)
+        try:
+            return self._json("GET", _api_path(api_version, namespace, plural, name))
+        except NotFound:
+            raise NotFound(kind, namespace, name) from None
+
+    def list(self, kind: str, namespace: str, label_selector: Optional[dict] = None) -> list[dict]:
+        api_version, plural = self._resolve(kind)
+        query = {}
+        if label_selector:
+            query["labelSelector"] = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
+        out = self._json("GET", _api_path(api_version, namespace, plural), query=query or None)
+        items = out.get("items", [])
+        for item in items:  # list items omit apiVersion/kind; restore them
+            item.setdefault("apiVersion", api_version)
+            item.setdefault("kind", kind)
+        return items
+
+    def create(self, obj: dict) -> dict:
+        api_version, plural = self._resolve(obj["kind"])
+        ns = obj["metadata"].get("namespace", "default")
+        return self._json("POST", _api_path(api_version, ns, plural), body=obj)
+
+    def update(self, obj: dict) -> dict:
+        api_version, plural = self._resolve(obj["kind"])
+        meta = obj["metadata"]
+        ns = meta.get("namespace", "default")
+        return self._json("PUT", _api_path(api_version, ns, plural, meta["name"]), body=obj)
+
+    def update_status(self, obj: dict) -> dict:
+        api_version, plural = self._resolve(obj["kind"])
+        meta = obj["metadata"]
+        ns = meta.get("namespace", "default")
+        live = self.get(obj["kind"], ns, meta["name"])
+        live["status"] = obj.get("status") or {}
+        path = _api_path(api_version, ns, plural, meta["name"]) + "/status"
+        return self._json("PUT", path, body=live)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        api_version, plural = self._resolve(kind)
+        try:
+            self._json("DELETE", _api_path(api_version, namespace, plural, name))
+        except NotFound:
+            raise NotFound(kind, namespace, name) from None
+
+    # -- watch --
+
+    def watch(self, kind: str, namespace: str, resource_version: str = "",
+              timeout_seconds: int = 300) -> Iterator[tuple[str, dict]]:
+        """Yield ``(event_type, object)`` from a chunked watch stream."""
+        api_version, plural = self._resolve(kind)
+        query = {"watch": "1", "timeoutSeconds": str(timeout_seconds)}
+        if resource_version:
+            query["resourceVersion"] = resource_version
+        path = _api_path(api_version, namespace, plural)
+        with self._request("GET", path, query=query, timeout=timeout_seconds + 10) as resp:
+            for line in resp:
+                if not line.strip():
+                    continue
+                event = json.loads(line)
+                obj = event.get("object") or {}
+                obj.setdefault("apiVersion", api_version)
+                obj.setdefault("kind", kind)
+                yield event.get("type", ""), obj

@@ -1,0 +1,182 @@
+"""Controller manager: watch loop, workqueue, child→parent requeue mapping.
+
+The runtime equivalent of controller-runtime's manager + ``Owns()`` wiring
+(``cmd/main.go:169-222``, ``inferenceservice_controller.go:689-704``): one
+watch on InferenceService plus watches on every owned kind; child events
+map back to the owning InferenceService via controller ownerReferences; a
+deduplicating workqueue feeds a single reconcile worker (the reference
+also runs one worker per controller — that plus the single end-of-loop
+status write is the concurrency-safety model).  Health and readiness are
+served on :8081 like the reference's probe endpoints.
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import queue
+import threading
+import time
+from typing import Optional
+
+from fusioninfer_tpu.operator.client import K8sClient
+from fusioninfer_tpu.operator.reconciler import InferenceServiceReconciler
+
+logger = logging.getLogger("fusioninfer.manager")
+
+OWNED_KINDS = [
+    "LeaderWorkerSet",
+    "PodGroup",
+    "ConfigMap",
+    "Service",
+    "ServiceAccount",
+    "Deployment",
+    "Role",
+    "RoleBinding",
+    "InferencePool",
+    "HTTPRoute",
+]
+
+REQUEUE_DELAY_S = 5.0
+RESYNC_PERIOD_S = 300.0
+
+
+class WorkQueue:
+    """Deduplicating FIFO of (namespace, name) reconcile requests."""
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self._pending: set[tuple[str, str]] = set()
+        self._lock = threading.Lock()
+
+    def add(self, key: tuple[str, str]) -> None:
+        with self._lock:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+        self._q.put(key)
+
+    def get(self, timeout: float = 1.0) -> Optional[tuple[str, str]]:
+        try:
+            key = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        with self._lock:
+            self._pending.discard(key)
+        return key
+
+
+class Manager:
+    def __init__(self, client: K8sClient, namespace: str = "default",
+                 probe_port: int = 8081, default_queue: str | None = None):
+        self.client = client
+        self.namespace = namespace
+        self.probe_port = probe_port
+        self.reconciler = InferenceServiceReconciler(client, default_queue=default_queue)
+        self.workqueue = WorkQueue()
+        self._stop = threading.Event()
+        self.ready = threading.Event()
+
+    # -- event sources --
+
+    def _enqueue_owner(self, obj: dict) -> None:
+        """Map a child event back to its owning InferenceService."""
+        for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
+            if ref.get("kind") == "InferenceService" and ref.get("controller"):
+                ns = obj["metadata"].get("namespace", self.namespace)
+                self.workqueue.add((ns, ref["name"]))
+
+    def _watch_kind(self, kind: str) -> None:
+        """Level-triggered watch with list-based resync on stream errors."""
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                if kind == "InferenceService":
+                    for svc in self.client.list(kind, self.namespace):
+                        self.workqueue.add((svc["metadata"]["namespace"], svc["metadata"]["name"]))
+                watch = getattr(self.client, "watch", None)
+                if watch is None:
+                    self._stop.wait(RESYNC_PERIOD_S)
+                    continue
+                for _etype, obj in watch(kind, self.namespace, resource_version=rv):
+                    rv = (obj.get("metadata") or {}).get("resourceVersion", rv)
+                    if kind == "InferenceService":
+                        meta = obj["metadata"]
+                        self.workqueue.add((meta.get("namespace", self.namespace), meta["name"]))
+                    else:
+                        self._enqueue_owner(obj)
+            except Exception as e:
+                logger.warning("watch %s failed (%s); resyncing", kind, e)
+                rv = ""
+                self._stop.wait(REQUEUE_DELAY_S)
+
+    # -- worker --
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            key = self.workqueue.get(timeout=1.0)
+            if key is None:
+                continue
+            ns, name = key
+            try:
+                result = self.reconciler.reconcile(ns, name)
+            except Exception:
+                logger.exception("reconcile %s/%s panicked", ns, name)
+                result = None
+            if result is not None and (result.requeue or result.errors):
+                threading.Timer(REQUEUE_DELAY_S, self.workqueue.add, args=(key,)).start()
+
+    # -- probes --
+
+    def _serve_probes(self) -> None:
+        mgr = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path in ("/healthz", "/readyz"):
+                    ok = self.path == "/healthz" or mgr.ready.is_set()
+                    self.send_response(200 if ok else 503)
+                    self.end_headers()
+                    self.wfile.write(b"ok" if ok else b"not ready")
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.ThreadingHTTPServer(("", self.probe_port), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        self._probe_server = server
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        logger.info("starting manager (namespace=%s)", self.namespace)
+        self._serve_probes()
+        threads = [threading.Thread(target=self._worker, daemon=True, name="reconcile-worker")]
+        for kind in ["InferenceService"] + OWNED_KINDS:
+            threads.append(
+                threading.Thread(target=self._watch_kind, args=(kind,), daemon=True, name=f"watch-{kind}")
+            )
+        for t in threads:
+            t.start()
+        self.ready.set()
+        self._threads = threads
+
+    def run_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stop.is_set():
+                time.sleep(1)
+        except KeyboardInterrupt:
+            logger.info("shutting down")
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.ready.clear()
+        server = getattr(self, "_probe_server", None)
+        if server is not None:
+            server.shutdown()

@@ -1,0 +1,62 @@
+"""Pure rendering of every child resource for an InferenceService.
+
+The same builders the reconciler drives, exposed as one function for the
+CLI's dry-run (``fusioninfer-tpu render resources``), tests, and doc
+generation — rendering is the operator's "compile step" and must be
+observable without a cluster.
+"""
+
+from __future__ import annotations
+
+from fusioninfer_tpu.api.types import InferenceService
+from fusioninfer_tpu.router import (
+    build_epp_configmap,
+    build_epp_deployment,
+    build_epp_role,
+    build_epp_rolebinding,
+    build_epp_service,
+    build_epp_serviceaccount,
+    build_httproute,
+    build_inference_pool,
+    generate_pool_name,
+)
+from fusioninfer_tpu.scheduling.podgroup import (
+    build_podgroup,
+    generate_podgroup_name,
+    generate_task_name,
+    needs_gang_scheduling,
+    needs_gang_scheduling_for_role,
+)
+from fusioninfer_tpu.workload.lws import LWSConfig, build_lws
+
+
+def render_all(svc: InferenceService, queue: str | None = None) -> list[dict]:
+    """All child resources, in the order the reconciler creates them."""
+    out: list[dict] = []
+    if needs_gang_scheduling(svc):
+        out.append(build_podgroup(svc, queue=queue))
+    for role in svc.spec.worker_roles():
+        gang = needs_gang_scheduling_for_role(svc, role)
+        for i in range(role.replicas):
+            cfg = LWSConfig(
+                service_name=svc.name,
+                namespace=svc.namespace,
+                replica_index=i,
+                gang=gang,
+                podgroup_name=generate_podgroup_name(svc) if gang else "",
+                task_name=generate_task_name(role, i) if gang else "",
+            )
+            out.append(build_lws(role, cfg))
+    for role in svc.spec.router_roles():
+        pool_name = generate_pool_name(svc, role)
+        out += [
+            build_epp_serviceaccount(svc, role),
+            build_epp_role(svc, role),
+            build_epp_rolebinding(svc, role),
+            build_epp_configmap(svc, role),
+            build_epp_deployment(svc, role, pool_name),
+            build_epp_service(svc, role),
+            build_inference_pool(svc, role),
+            build_httproute(svc, role),
+        ]
+    return out

@@ -1,0 +1,46 @@
+from fusioninfer_tpu.router.epp import (
+    DEFAULT_EPP_IMAGE,
+    EPP_GRPC_PORT,
+    EPP_HEALTH_PORT,
+    EPP_IMAGE_ENV,
+    EPP_METRICS_PORT,
+    build_epp_configmap,
+    build_epp_deployment,
+    build_epp_role,
+    build_epp_rolebinding,
+    build_epp_service,
+    build_epp_serviceaccount,
+    generate_epp_name,
+    get_epp_image,
+)
+from fusioninfer_tpu.router.httproute import build_httproute, generate_httproute_name
+from fusioninfer_tpu.router.inferencepool import (
+    BACKEND_PORT,
+    build_inference_pool,
+    build_pool_selector,
+    generate_pool_name,
+)
+from fusioninfer_tpu.router.strategy import generate_epp_config
+
+__all__ = [
+    "DEFAULT_EPP_IMAGE",
+    "EPP_GRPC_PORT",
+    "EPP_HEALTH_PORT",
+    "EPP_IMAGE_ENV",
+    "EPP_METRICS_PORT",
+    "build_epp_configmap",
+    "build_epp_deployment",
+    "build_epp_role",
+    "build_epp_rolebinding",
+    "build_epp_service",
+    "build_epp_serviceaccount",
+    "generate_epp_name",
+    "get_epp_image",
+    "build_httproute",
+    "generate_httproute_name",
+    "BACKEND_PORT",
+    "build_inference_pool",
+    "build_pool_selector",
+    "generate_pool_name",
+    "generate_epp_config",
+]

@@ -1,0 +1,182 @@
+"""Endpoint Picker (EPP) data-plane rendering.
+
+Renders the six resources that stand up the endpoint picker for a router
+role — ConfigMap, Deployment, Service, ServiceAccount, Role, RoleBinding —
+capability parity with ``pkg/router/epp.go:34-361``.  The EPP is the
+ext-proc gRPC server Envoy consults per request; it scrapes the model
+servers' metrics endpoints (vLLM-TPU / native engine / JetStream) and
+scores candidate slice leaders.
+"""
+
+from __future__ import annotations
+
+import os
+
+from fusioninfer_tpu.api.types import InferenceService, Role
+from fusioninfer_tpu.router.strategy import generate_epp_config
+from fusioninfer_tpu.utils.hash import compute_spec_hash, stamp_spec_hash
+from fusioninfer_tpu.utils.names import truncate_name
+from fusioninfer_tpu.workload.labels import workload_labels
+
+EPP_GRPC_PORT = 9002
+EPP_HEALTH_PORT = 9003
+EPP_METRICS_PORT = 9090
+
+DEFAULT_EPP_IMAGE = "registry.k8s.io/gateway-api-inference-extension/epp:v1.2.1"
+EPP_IMAGE_ENV = "EPP_IMAGE"
+
+_CONFIG_MOUNT = "/config"
+_CONFIG_FILE = "config.yaml"
+
+
+def get_epp_image() -> str:
+    return os.environ.get(EPP_IMAGE_ENV, DEFAULT_EPP_IMAGE)
+
+
+def generate_epp_name(svc: InferenceService, role: Role) -> str:
+    return truncate_name(f"{svc.name}-{role.name}-epp")
+
+
+def _meta(svc: InferenceService, role: Role, suffix: str = "") -> dict:
+    return {
+        "name": truncate_name(generate_epp_name(svc, role) + suffix),
+        "namespace": svc.namespace,
+        "labels": workload_labels(svc.name, role.component_type.value, role.name),
+    }
+
+
+def build_epp_configmap(svc: InferenceService, role: Role) -> dict:
+    cm = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": _meta(svc, role, "-config"),
+        "data": {_CONFIG_FILE: generate_epp_config(svc, role)},
+    }
+    return stamp_spec_hash(cm)
+
+
+def build_epp_deployment(svc: InferenceService, role: Role, pool_name: str) -> dict:
+    name = generate_epp_name(svc, role)
+    labels = workload_labels(svc.name, role.component_type.value, role.name)
+    # The EPP binary reads its config file once at startup; stamping the
+    # config hash into the pod template makes strategy changes roll the pods.
+    config_hash = compute_spec_hash({"config": generate_epp_config(svc, role)})
+    dep = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(svc, role),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {
+                    "labels": {"app": name, **labels},
+                    "annotations": {"fusioninfer.io/config-hash": config_hash},
+                },
+                "spec": {
+                    "serviceAccountName": name,
+                    "containers": [
+                        {
+                            "name": "epp",
+                            "image": get_epp_image(),
+                            "args": [
+                                "--pool-name", pool_name,
+                                "--pool-namespace", svc.namespace,
+                                "--config-file", f"{_CONFIG_MOUNT}/{_CONFIG_FILE}",
+                                "--v", "4",
+                                "--grpc-port", str(EPP_GRPC_PORT),
+                                "--grpc-health-port", str(EPP_HEALTH_PORT),
+                            ],
+                            "ports": [
+                                {"name": "grpc", "containerPort": EPP_GRPC_PORT},
+                                {"name": "grpc-health", "containerPort": EPP_HEALTH_PORT},
+                                {"name": "metrics", "containerPort": EPP_METRICS_PORT},
+                            ],
+                            "livenessProbe": {
+                                "grpc": {"port": EPP_HEALTH_PORT, "service": "inference-extension"},
+                                "initialDelaySeconds": 5,
+                                "periodSeconds": 10,
+                            },
+                            "readinessProbe": {
+                                "grpc": {"port": EPP_HEALTH_PORT, "service": "inference-extension"},
+                                "initialDelaySeconds": 5,
+                                "periodSeconds": 10,
+                            },
+                            "volumeMounts": [
+                                {"name": "config", "mountPath": _CONFIG_MOUNT, "readOnly": True}
+                            ],
+                        }
+                    ],
+                    "volumes": [
+                        {
+                            "name": "config",
+                            "configMap": {"name": _meta(svc, role, "-config")["name"]},
+                        }
+                    ],
+                },
+            },
+        },
+    }
+    return stamp_spec_hash(dep)
+
+
+def build_epp_service(svc: InferenceService, role: Role) -> dict:
+    name = generate_epp_name(svc, role)
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(svc, role),
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {"app": name},
+            "ports": [
+                {"name": "grpc", "port": EPP_GRPC_PORT, "targetPort": EPP_GRPC_PORT, "protocol": "TCP"},
+                {"name": "grpc-health", "port": EPP_HEALTH_PORT, "targetPort": EPP_HEALTH_PORT, "protocol": "TCP"},
+                {"name": "metrics", "port": EPP_METRICS_PORT, "targetPort": EPP_METRICS_PORT, "protocol": "TCP"},
+            ],
+        },
+    }
+    return stamp_spec_hash(service)
+
+
+def build_epp_serviceaccount(svc: InferenceService, role: Role) -> dict:
+    return stamp_spec_hash(
+        {"apiVersion": "v1", "kind": "ServiceAccount", "metadata": _meta(svc, role)}
+    )
+
+
+def build_epp_role(svc: InferenceService, role: Role) -> dict:
+    """Namespaced RBAC for the EPP: watch pods + inference objects, lease
+    for HA, events for visibility."""
+    r = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": _meta(svc, role),
+        "rules": [
+            {"apiGroups": [""], "resources": ["pods"], "verbs": ["get", "list", "watch"]},
+            {
+                "apiGroups": ["inference.networking.k8s.io", "inference.networking.x-k8s.io"],
+                "resources": ["inferencepools", "inferenceobjectives"],
+                "verbs": ["get", "list", "watch"],
+            },
+            {
+                "apiGroups": ["coordination.k8s.io"],
+                "resources": ["leases"],
+                "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"],
+            },
+            {"apiGroups": [""], "resources": ["events"], "verbs": ["create", "patch"]},
+        ],
+    }
+    return stamp_spec_hash(r)
+
+
+def build_epp_rolebinding(svc: InferenceService, role: Role) -> dict:
+    name = generate_epp_name(svc, role)
+    rb = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": _meta(svc, role),
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io", "kind": "Role", "name": name},
+        "subjects": [{"kind": "ServiceAccount", "name": name, "namespace": svc.namespace}],
+    }
+    return stamp_spec_hash(rb)

@@ -1,0 +1,58 @@
+"""HTTPRoute rendering: user-shaped routing, operator-owned backend.
+
+The user's ``httproute`` passthrough keeps parentRefs / hostnames /
+sectionName; the operator force-overwrites ``rules`` with a single
+backendRef to the InferencePool (parity with ``pkg/router/httproute.go:36-92``)
+so traffic can only ever land on the endpoint-picked slice leaders.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from fusioninfer_tpu.api.types import InferenceService, Role
+from fusioninfer_tpu.router.inferencepool import (
+    INFERENCE_POOL_GROUP,
+    INFERENCE_POOL_KIND,
+    generate_pool_name,
+)
+from fusioninfer_tpu.utils.hash import stamp_spec_hash
+from fusioninfer_tpu.utils.names import truncate_name
+from fusioninfer_tpu.workload.labels import workload_labels
+
+HTTPROUTE_API_VERSION = "gateway.networking.k8s.io/v1"
+HTTPROUTE_KIND = "HTTPRoute"
+
+
+def generate_httproute_name(svc: InferenceService, role: Role) -> str:
+    return truncate_name(f"{svc.name}-{role.name}-route")
+
+
+def build_inference_pool_backend_ref(svc: InferenceService, role: Role) -> dict:
+    return {
+        "group": INFERENCE_POOL_GROUP,
+        "kind": INFERENCE_POOL_KIND,
+        "name": generate_pool_name(svc, role),
+        "weight": 1,
+    }
+
+
+def build_httproute(svc: InferenceService, role: Role) -> dict:
+    spec = copy.deepcopy(role.httproute or {})
+    spec["rules"] = [
+        {
+            "matches": [{"path": {"type": "PathPrefix", "value": "/"}}],
+            "backendRefs": [build_inference_pool_backend_ref(svc, role)],
+        }
+    ]
+    route = {
+        "apiVersion": HTTPROUTE_API_VERSION,
+        "kind": HTTPROUTE_KIND,
+        "metadata": {
+            "name": generate_httproute_name(svc, role),
+            "namespace": svc.namespace,
+            "labels": workload_labels(svc.name, role.component_type.value, role.name),
+        },
+        "spec": spec,
+    }
+    return stamp_spec_hash(route)

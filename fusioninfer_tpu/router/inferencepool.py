@@ -1,0 +1,70 @@
+"""InferencePool rendering (Gateway API Inference Extension).
+
+Selects the backend pods the EPP may pick: only **slice leader pods**
+(``leaderworkerset.sigs.k8s.io/worker-index=0``) serve HTTP, so the pool
+selector pins worker-index 0 exactly as the reference does
+(``pkg/router/inferencepool.go:30-104``); non-leader hosts of a slice take
+part in the model via ICI collectives, never via HTTP.
+"""
+
+from __future__ import annotations
+
+from fusioninfer_tpu.api.types import InferenceService, Role
+from fusioninfer_tpu.router.epp import EPP_GRPC_PORT, generate_epp_name
+from fusioninfer_tpu.utils.hash import stamp_spec_hash
+from fusioninfer_tpu.utils.names import truncate_name
+from fusioninfer_tpu.workload.labels import (
+    LABEL_COMPONENT_TYPE,
+    LABEL_SERVICE,
+    LWS_WORKER_INDEX_LABEL,
+    workload_labels,
+)
+
+INFERENCE_POOL_API_VERSION = "inference.networking.k8s.io/v1"
+INFERENCE_POOL_KIND = "InferencePool"
+INFERENCE_POOL_GROUP = "inference.networking.k8s.io"
+
+# The engines' OpenAI-compatible HTTP port.
+BACKEND_PORT = 8000
+
+
+def generate_pool_name(svc: InferenceService, role: Role) -> str:
+    return truncate_name(f"{svc.name}-{role.name}-pool")
+
+
+def build_pool_selector(svc: InferenceService) -> dict:
+    """Label selector for pool membership.
+
+    Scopes to the single worker role's component type when unambiguous;
+    with several worker-like roles (e.g. PD) all of them stay in the pool
+    and the EPP's by-label filters split them per profile.
+    """
+    selector = {
+        LABEL_SERVICE: svc.name,
+        LWS_WORKER_INDEX_LABEL: "0",
+    }
+    workers = svc.spec.worker_roles()
+    if len(workers) == 1:
+        selector[LABEL_COMPONENT_TYPE] = workers[0].component_type.value
+    return selector
+
+
+def build_inference_pool(svc: InferenceService, role: Role) -> dict:
+    pool = {
+        "apiVersion": INFERENCE_POOL_API_VERSION,
+        "kind": INFERENCE_POOL_KIND,
+        "metadata": {
+            "name": generate_pool_name(svc, role),
+            "namespace": svc.namespace,
+            "labels": workload_labels(svc.name, role.component_type.value, role.name),
+        },
+        "spec": {
+            "selector": {"matchLabels": build_pool_selector(svc)},
+            "targetPorts": [{"number": BACKEND_PORT}],
+            "endpointPickerRef": {
+                "name": generate_epp_name(svc, role),
+                "port": {"number": EPP_GRPC_PORT},
+            },
+        },
+    }
+    return stamp_spec_hash(pool)

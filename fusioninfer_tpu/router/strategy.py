@@ -1,0 +1,118 @@
+"""Routing strategy → EndpointPickerConfig generation.
+
+Maps the five declarative strategies to EPP plugin-pipeline YAML
+(capability parity with ``pkg/router/strategy.go:27-165``).  The configs
+are engine-agnostic plugin graphs; the scorers consume metrics the EPP
+scrapes from the model servers — vLLM-TPU and the in-repo native engine
+export vLLM-compatible metric names (``vllm:gpu_cache_usage_perc``,
+``vllm:num_requests_waiting``), JetStream needs the metrics-mapping noted
+per scorer.  A user-supplied ``endpointPickerConfig`` wins outright.
+"""
+
+from __future__ import annotations
+
+import yaml
+
+from fusioninfer_tpu.api.types import InferenceService, Role, RoutingStrategy
+from fusioninfer_tpu.scheduling.podgroup import is_pd_disaggregated
+from fusioninfer_tpu.workload.labels import LABEL_COMPONENT_TYPE
+
+EPP_CONFIG_API_VERSION = "inference.networking.x-k8s.io/v1alpha1"
+EPP_CONFIG_KIND = "EndpointPickerConfig"
+
+# Prefix-cache scorer tuning: 5-token hash blocks, match up to 256 blocks
+# (≈1280 tokens of prefix), LRU of 31250 entries per server — the shape the
+# upstream EPP image ships and the reference exposes (strategy.go:51-77).
+PREFIX_CACHE_PARAMS = {
+    "hashBlockSize": 5,
+    "maxPrefixBlocksToMatch": 256,
+    "lruCapacityPerServer": 31250,
+}
+
+_SCORER_FOR = {
+    RoutingStrategy.PREFIX_CACHE: ("prefix-cache-scorer", PREFIX_CACHE_PARAMS),
+    RoutingStrategy.KV_CACHE_UTILIZATION: ("kv-cache-utilization-scorer", None),
+    RoutingStrategy.QUEUE_SIZE: ("queue-scorer", None),
+    RoutingStrategy.LORA_AFFINITY: ("lora-affinity-scorer", None),
+}
+
+
+def _single_scorer_config(scorer: str, params: dict | None) -> dict:
+    scorer_plugin: dict = {"type": scorer}
+    if params:
+        scorer_plugin["parameters"] = dict(params)
+    return {
+        "apiVersion": EPP_CONFIG_API_VERSION,
+        "kind": EPP_CONFIG_KIND,
+        "plugins": [scorer_plugin, {"type": "max-score-picker"}],
+        "schedulingProfiles": [
+            {
+                "name": "default",
+                "plugins": [
+                    {"pluginRef": scorer, "weight": 100},
+                    {"pluginRef": "max-score-picker"},
+                ],
+            }
+        ],
+    }
+
+
+def _pd_config() -> dict:
+    """Prefill/decode profiles: by-label filters split the candidate pods by
+    component type; the pd-profile-handler runs the prefill profile for the
+    prefill leg and marks it via the prefill header for the engine's
+    disaggregated serving path."""
+    return {
+        "apiVersion": EPP_CONFIG_API_VERSION,
+        "kind": EPP_CONFIG_KIND,
+        "plugins": [
+            {"type": "pd-profile-handler"},
+            {"type": "prefill-header-handler"},
+            {
+                "type": "by-label",
+                "name": "prefill-filter",
+                "parameters": {"label": LABEL_COMPONENT_TYPE, "value": "prefiller"},
+            },
+            {
+                "type": "by-label",
+                "name": "decode-filter",
+                "parameters": {"label": LABEL_COMPONENT_TYPE, "value": "decoder"},
+            },
+            {"type": "prefix-cache-scorer", "parameters": dict(PREFIX_CACHE_PARAMS)},
+            {"type": "max-score-picker"},
+        ],
+        "schedulingProfiles": [
+            {
+                "name": "prefill",
+                "plugins": [
+                    {"pluginRef": "prefill-filter"},
+                    {"pluginRef": "prefix-cache-scorer", "weight": 50},
+                    {"pluginRef": "max-score-picker"},
+                ],
+            },
+            {
+                "name": "decode",
+                "plugins": [
+                    {"pluginRef": "decode-filter"},
+                    {"pluginRef": "prefix-cache-scorer", "weight": 50},
+                    {"pluginRef": "max-score-picker"},
+                ],
+            },
+        ],
+    }
+
+
+def generate_epp_config(svc: InferenceService, role: Role) -> str:
+    """YAML EndpointPickerConfig for a router role."""
+    if role.endpoint_picker_config:
+        return role.endpoint_picker_config
+    strategy = role.strategy or RoutingStrategy.PREFIX_CACHE
+    if strategy == RoutingStrategy.PD_DISAGGREGATION:
+        # Graceful fallback when the service isn't actually disaggregated.
+        if not is_pd_disaggregated(svc):
+            cfg = _single_scorer_config(*_SCORER_FOR[RoutingStrategy.PREFIX_CACHE])
+        else:
+            cfg = _pd_config()
+    else:
+        cfg = _single_scorer_config(*_SCORER_FOR[strategy])
+    return yaml.safe_dump(cfg, sort_keys=False)

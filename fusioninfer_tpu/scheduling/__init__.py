@@ -1,0 +1,21 @@
+from fusioninfer_tpu.scheduling.podgroup import (
+    PODGROUP_KIND,
+    VOLCANO_API_VERSION,
+    build_podgroup,
+    generate_podgroup_name,
+    generate_task_name,
+    is_pd_disaggregated,
+    needs_gang_scheduling,
+    needs_gang_scheduling_for_role,
+)
+
+__all__ = [
+    "PODGROUP_KIND",
+    "VOLCANO_API_VERSION",
+    "build_podgroup",
+    "generate_podgroup_name",
+    "generate_task_name",
+    "is_pd_disaggregated",
+    "needs_gang_scheduling",
+    "needs_gang_scheduling_for_role",
+]

@@ -1,0 +1,52 @@
+"""Minimal Kubernetes resource-quantity arithmetic.
+
+Just enough to sum container limits into a PodGroup's ``minResources``
+(reference sums via apimachinery's Quantity, ``pkg/scheduling/podgroup.go:159-190``).
+Values are held in milli-units internally so cpu "500m" and memory "1Gi"
+both survive round-trips without floats.
+"""
+
+from __future__ import annotations
+
+_BINARY = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4, "Pi": 1024**5}
+_DECIMAL = {"k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15}
+
+
+def parse_quantity_milli(s: str | int | float) -> int:
+    """Parse a k8s quantity into integer milli-units (1 == 1000 milli)."""
+    if isinstance(s, (int, float)):
+        return int(round(float(s) * 1000))
+    s = s.strip()
+    if not s:
+        raise ValueError("empty quantity")
+    for suffix, mult in _BINARY.items():
+        if s.endswith(suffix):
+            return int(round(float(s[: -len(suffix)]) * mult * 1000))
+    if s.endswith("m"):
+        return int(round(float(s[:-1])))
+    for suffix, mult in _DECIMAL.items():
+        if s.endswith(suffix):
+            return int(round(float(s[: -len(suffix)]) * mult * 1000))
+    return int(round(float(s) * 1000))
+
+
+def format_quantity_milli(milli: int) -> str:
+    """Render milli-units back to a canonical quantity string, preferring
+    exact binary suffixes (Gi/Mi/Ki) for byte-sized values."""
+    if milli % 1000 == 0:
+        whole = milli // 1000
+        for suffix in ("Pi", "Ti", "Gi", "Mi", "Ki"):
+            mult = _BINARY[suffix]
+            if whole >= mult and whole % mult == 0:
+                return f"{whole // mult}{suffix}"
+        return str(whole)
+    return f"{milli}m"
+
+
+def add_resource_lists(*resource_lists: dict, multiplier: int = 1) -> dict:
+    """Sum resource dicts (e.g. container limits), scaling by ``multiplier``."""
+    totals: dict[str, int] = {}
+    for rl in resource_lists:
+        for name, value in (rl or {}).items():
+            totals[name] = totals.get(name, 0) + parse_quantity_milli(value) * multiplier
+    return {name: format_quantity_milli(v) for name, v in sorted(totals.items())}

@@ -1,0 +1,34 @@
+from fusioninfer_tpu.workload.labels import (
+    ANNOTATION_POD_GROUP,
+    ANNOTATION_TASK_SPEC,
+    LABEL_COMPONENT_TYPE,
+    LABEL_REPLICA_INDEX,
+    LABEL_ROLE_NAME,
+    LABEL_SERVICE,
+    LWS_WORKER_INDEX_LABEL,
+    workload_labels,
+)
+from fusioninfer_tpu.workload.lws import LWSConfig, build_lws, generate_lws_name, is_multi_host
+from fusioninfer_tpu.workload.bootstrap import (
+    JAX_COORDINATOR_PORT,
+    RAY_PORT,
+    bootstrap_for,
+)
+
+__all__ = [
+    "ANNOTATION_POD_GROUP",
+    "ANNOTATION_TASK_SPEC",
+    "LABEL_COMPONENT_TYPE",
+    "LABEL_REPLICA_INDEX",
+    "LABEL_ROLE_NAME",
+    "LABEL_SERVICE",
+    "LWS_WORKER_INDEX_LABEL",
+    "workload_labels",
+    "LWSConfig",
+    "build_lws",
+    "generate_lws_name",
+    "is_multi_host",
+    "JAX_COORDINATOR_PORT",
+    "RAY_PORT",
+    "bootstrap_for",
+]

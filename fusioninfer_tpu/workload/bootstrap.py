@@ -1,0 +1,157 @@
+"""Per-engine multi-host bootstrap strategies.
+
+The reference hardcodes one bootstrap — a Ray shell wrap for vLLM-GPU
+(``pkg/workload/lws.go:189-242``).  TPU engines diverge (SURVEY §7 hard
+part 2): vLLM-TPU still rides Ray, while JetStream and the in-repo native
+engine use the JAX distributed coordinator.  Each strategy takes the
+user's engine container and rewires it for its position in the slice;
+single-host slices are never wrapped.
+
+All strategies key off the env/labels the LeaderWorkerSet controller
+injects (``LWS_LEADER_ADDRESS``, the worker-index pod label) — the same
+discovery contract the reference relies on — plus the TPU env GKE itself
+provides on multi-host slice node pools (``TPU_WORKER_ID``,
+``TPU_WORKER_HOSTNAMES``), which XLA consumes directly.
+"""
+
+from __future__ import annotations
+
+import copy
+import shlex
+
+from fusioninfer_tpu.api.types import EngineKind
+from fusioninfer_tpu.workload.labels import LWS_LEADER_ADDRESS_ENV, LWS_WORKER_INDEX_LABEL
+
+RAY_PORT = 6379
+JAX_COORDINATOR_PORT = 8476
+
+
+def _container_command(container: dict, default_cmd: list[str]) -> list[str]:
+    """The user's effective command: explicit command+args, or the engine
+    default when the image relies on its entrypoint and only passes args."""
+    cmd = list(container.get("command") or [])
+    args = list(container.get("args") or [])
+    if not cmd:
+        cmd = list(default_cmd)
+        # Avoid doubling subcommands when the image entrypoint supplies the
+        # binary and the user's args repeat part of the default (e.g. default
+        # "vllm serve" + args "serve MODEL" must not become "vllm serve serve").
+        while cmd and args and cmd[-1] == args[0]:
+            cmd.pop()
+    return cmd + args
+
+
+def _shellify(words: list[str]) -> str:
+    return " ".join(shlex.quote(w) for w in words)
+
+
+def _set_shell(container: dict, script: str) -> None:
+    container["command"] = ["/bin/sh", "-c"]
+    container["args"] = [script]
+
+
+def _add_port(container: dict, name: str, port: int) -> None:
+    ports = container.setdefault("ports", [])
+    if not any(p.get("containerPort") == port for p in ports):
+        ports.append({"name": name, "containerPort": port, "protocol": "TCP"})
+
+
+def _add_tcp_readiness(container: dict, port: int) -> None:
+    container.setdefault(
+        "readinessProbe",
+        {"tcpSocket": {"port": port}, "initialDelaySeconds": 5, "periodSeconds": 10},
+    )
+
+
+def _add_env(container: dict, name: str, value: str | None = None, field_path: str | None = None) -> None:
+    env = container.setdefault("env", [])
+    if any(e.get("name") == name for e in env):
+        return
+    if field_path is not None:
+        env.append({"name": name, "valueFrom": {"fieldRef": {"fieldPath": field_path}}})
+    else:
+        env.append({"name": name, "value": value})
+
+
+class BootstrapStrategy:
+    """Rewrites the engine container for leader / worker pods of a slice."""
+
+    def wrap_leader(self, container: dict, size: int) -> dict:
+        return container
+
+    def wrap_worker(self, container: dict, size: int) -> dict:
+        return container
+
+
+class RayBootstrap(BootstrapStrategy):
+    """vLLM-TPU multi-host: leader runs the Ray head then the server with
+    the Ray distributed executor; workers join and block."""
+
+    default_cmd = ["vllm", "serve"]
+    executor_flag = "--distributed-executor-backend"
+
+    def wrap_leader(self, container: dict, size: int) -> dict:
+        container = copy.deepcopy(container)
+        words = _container_command(container, self.default_cmd)
+        if self.executor_flag not in " ".join(words):
+            words = words + [self.executor_flag, "ray"]
+        script = f"ray start --head --port={RAY_PORT} && {_shellify(words)}"
+        _set_shell(container, script)
+        _add_port(container, "ray-head", RAY_PORT)
+        _add_tcp_readiness(container, RAY_PORT)
+        return container
+
+    def wrap_worker(self, container: dict, size: int) -> dict:
+        container = copy.deepcopy(container)
+        script = f'ray start --address="${LWS_LEADER_ADDRESS_ENV}:{RAY_PORT}" --block'
+        _set_shell(container, script)
+        return container
+
+
+class JaxCoordinatorBootstrap(BootstrapStrategy):
+    """JetStream / native engine multi-host: every host runs the same
+    command; rank and coordinator address arrive via env, consumed by
+    ``jax.distributed.initialize``.  No shell wrap — the engine owns its
+    process lifecycle, XLA owns the ICI collectives."""
+
+    def _common(self, container: dict, size: int) -> dict:
+        container = copy.deepcopy(container)
+        # NOTE: deliberately NOT "$(LWS_LEADER_ADDRESS):port" — Kubernetes
+        # env-to-env expansion only works when the referenced var appears
+        # earlier in the env list, and LWS_LEADER_ADDRESS is injected by the
+        # LWS webhook at an unspecified position.  The engine composes
+        # "{LWS_LEADER_ADDRESS}:{FUSIONINFER_COORDINATOR_PORT}" at runtime,
+        # which is order-independent.
+        _add_env(container, "FUSIONINFER_COORDINATOR_PORT", value=str(JAX_COORDINATOR_PORT))
+        _add_env(container, "JAX_NUM_PROCESSES", value=str(size))
+        _add_env(
+            container,
+            "JAX_PROCESS_ID",
+            field_path=f"metadata.labels['{LWS_WORKER_INDEX_LABEL}']",
+        )
+        return container
+
+    def wrap_leader(self, container: dict, size: int) -> dict:
+        container = self._common(container, size)
+        _add_port(container, "jax-coord", JAX_COORDINATOR_PORT)
+        _add_tcp_readiness(container, JAX_COORDINATOR_PORT)
+        return container
+
+    def wrap_worker(self, container: dict, size: int) -> dict:
+        return self._common(container, size)
+
+
+class NoopBootstrap(BootstrapStrategy):
+    """EngineKind.CUSTOM: the user's template is authoritative."""
+
+
+_STRATEGIES: dict[EngineKind, BootstrapStrategy] = {
+    EngineKind.VLLM_TPU: RayBootstrap(),
+    EngineKind.JETSTREAM: JaxCoordinatorBootstrap(),
+    EngineKind.NATIVE: JaxCoordinatorBootstrap(),
+    EngineKind.CUSTOM: NoopBootstrap(),
+}
+
+
+def bootstrap_for(engine: EngineKind) -> BootstrapStrategy:
+    return _STRATEGIES[engine]

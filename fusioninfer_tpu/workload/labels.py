@@ -1,0 +1,35 @@
+"""Shared label / annotation contract stamped on every rendered resource.
+
+Same key namespace as the reference (``pkg/workload/lws.go:34-56``) so that
+EPP ``by-label`` filters, InferencePool selectors, and user dashboards keep
+working after switching operators.
+"""
+
+LABEL_SERVICE = "fusioninfer.io/service"
+LABEL_COMPONENT_TYPE = "fusioninfer.io/component-type"
+LABEL_ROLE_NAME = "fusioninfer.io/role-name"
+LABEL_REPLICA_INDEX = "fusioninfer.io/replica-index"
+
+# Volcano gang-scheduling pod annotations.
+ANNOTATION_POD_GROUP = "scheduling.k8s.io/group-name"
+ANNOTATION_TASK_SPEC = "volcano.sh/task-spec"
+VOLCANO_SCHEDULER = "volcano"
+
+# Injected by the LeaderWorkerSet controller into every pod of a group.
+LWS_LEADER_ADDRESS_ENV = "LWS_LEADER_ADDRESS"
+LWS_GROUP_SIZE_ENV = "LWS_GROUP_SIZE"
+LWS_WORKER_INDEX_LABEL = "leaderworkerset.sigs.k8s.io/worker-index"
+
+LWS_API_VERSION = "leaderworkerset.x-k8s.io/v1"
+LWS_KIND = "LeaderWorkerSet"
+
+
+def workload_labels(service: str, component_type: str, role: str, replica_index: int | None = None) -> dict:
+    labels = {
+        LABEL_SERVICE: service,
+        LABEL_COMPONENT_TYPE: component_type,
+        LABEL_ROLE_NAME: role,
+    }
+    if replica_index is not None:
+        labels[LABEL_REPLICA_INDEX] = str(replica_index)
+    return labels

@@ -1,0 +1,132 @@
+"""LeaderWorkerSet rendering: one LWS per (role, replicaIndex).
+
+Capability parity with the reference builder (``pkg/workload/lws.go:73-165``)
+with the TPU-first redesign of SURVEY §7: a role's ``tpu`` block — not a
+free-form node count — determines the group size (hosts in the slice), the
+GKE node selectors that make GKE form the ICI-connected slice, and the
+per-pod ``google.com/tpu`` chip limit.  Per-replica mode (always
+``replicas: 1`` inside the LWS, one LWS per service replica) is kept so the
+EPP can score each slice independently and scale-down can drop a specific
+slice.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Optional
+
+from fusioninfer_tpu.api.types import EngineKind, Role
+from fusioninfer_tpu.api.topology import SliceShape, TPU_RESOURCE
+from fusioninfer_tpu.utils.hash import stamp_spec_hash
+from fusioninfer_tpu.utils.names import truncate_name
+from fusioninfer_tpu.workload.bootstrap import bootstrap_for
+from fusioninfer_tpu.workload.labels import (
+    ANNOTATION_POD_GROUP,
+    ANNOTATION_TASK_SPEC,
+    LWS_API_VERSION,
+    LWS_KIND,
+    VOLCANO_SCHEDULER,
+    workload_labels,
+)
+
+
+@dataclass
+class LWSConfig:
+    """Everything the builder needs beyond the role itself."""
+
+    service_name: str
+    namespace: str
+    replica_index: int
+    gang: bool = False
+    podgroup_name: str = ""
+    task_name: str = ""
+
+
+def generate_lws_name(service: str, role: str, replica_index: int) -> str:
+    return truncate_name(f"{service}-{role}-{replica_index}")
+
+
+def is_multi_host(role: Role) -> bool:
+    return role.nodes_per_replica() >= 2
+
+
+def _engine_container(pod_spec: dict) -> Optional[dict]:
+    containers = pod_spec.get("containers") or []
+    return containers[0] if containers else None
+
+
+def _render_tpu(pod_spec: dict, shape: SliceShape) -> None:
+    """Stamp slice node selectors + chip limits so GKE forms the slice."""
+    selector = pod_spec.setdefault("nodeSelector", {})
+    selector.update(shape.node_selector())
+    container = _engine_container(pod_spec)
+    if container is None:
+        return
+    limits = container.setdefault("resources", {}).setdefault("limits", {})
+    limits.setdefault(TPU_RESOURCE, str(shape.chips_per_host))
+    # requests must equal limits for extended resources; let k8s default it.
+
+
+def _base_pod_spec(role: Role, cfg: LWSConfig) -> dict:
+    template = copy.deepcopy(role.template or {})
+    pod_spec = copy.deepcopy(template.get("spec") or {})
+    if cfg.gang:
+        pod_spec["schedulerName"] = VOLCANO_SCHEDULER
+    shape = role.slice_shape()
+    if shape is not None:
+        _render_tpu(pod_spec, shape)
+    return pod_spec
+
+
+def _pod_template(role: Role, cfg: LWSConfig, pod_spec: dict) -> dict:
+    template_meta = copy.deepcopy((role.template or {}).get("metadata") or {})
+    labels = template_meta.setdefault("labels", {})
+    labels.update(workload_labels(cfg.service_name, role.component_type.value, role.name, cfg.replica_index))
+    if cfg.gang:
+        annotations = template_meta.setdefault("annotations", {})
+        annotations[ANNOTATION_POD_GROUP] = cfg.podgroup_name
+        annotations[ANNOTATION_TASK_SPEC] = cfg.task_name
+    return {"metadata": template_meta, "spec": pod_spec}
+
+
+def build_lws(role: Role, cfg: LWSConfig) -> dict:
+    """Render the LeaderWorkerSet for one replica of a worker-like role."""
+    size = role.nodes_per_replica()
+    name = generate_lws_name(cfg.service_name, role.name, cfg.replica_index)
+    labels = workload_labels(cfg.service_name, role.component_type.value, role.name, cfg.replica_index)
+
+    leader_worker_template: dict = {"size": size, "restartPolicy": "RecreateGroupOnRestart"}
+
+    if is_multi_host(role) and role.engine != EngineKind.CUSTOM:
+        strategy = bootstrap_for(role.engine)
+        leader_spec = _base_pod_spec(role, cfg)
+        worker_spec = _base_pod_spec(role, cfg)
+        lc = _engine_container(leader_spec)
+        wc = _engine_container(worker_spec)
+        if lc is not None:
+            leader_spec["containers"][0] = strategy.wrap_leader(lc, size)
+        if wc is not None:
+            worker_spec["containers"][0] = strategy.wrap_worker(wc, size)
+        leader_worker_template["leaderTemplate"] = _pod_template(role, cfg, leader_spec)
+        leader_worker_template["workerTemplate"] = _pod_template(role, cfg, worker_spec)
+    else:
+        leader_worker_template["workerTemplate"] = _pod_template(role, cfg, _base_pod_spec(role, cfg))
+
+    lws = {
+        "apiVersion": LWS_API_VERSION,
+        "kind": LWS_KIND,
+        "metadata": {
+            "name": name,
+            "namespace": cfg.namespace,
+            "labels": labels,
+        },
+        "spec": {
+            # Per-replica mode: one LWS == one slice; service replicas are
+            # modelled as N LWS objects, not LWS.spec.replicas=N.
+            "replicas": 1,
+            "startupPolicy": "LeaderCreated",
+            "leaderWorkerTemplate": leader_worker_template,
+        },
+    }
+    return stamp_spec_hash(lws)

@@ -1,0 +1,120 @@
+import pytest
+
+from fusioninfer_tpu.api import (
+    ComponentType,
+    EngineKind,
+    InferenceService,
+    RoutingStrategy,
+    ValidationError,
+    build_crd,
+)
+
+POD_TEMPLATE = {
+    "spec": {
+        "containers": [
+            {"name": "engine", "image": "vllm-tpu:latest", "args": ["serve", "Qwen/Qwen3-8B"]}
+        ]
+    }
+}
+
+
+def sample_manifest() -> dict:
+    return {
+        "apiVersion": "fusioninfer.io/v1alpha1",
+        "kind": "InferenceService",
+        "metadata": {"name": "qwen", "namespace": "ml", "uid": "u-1", "generation": 3},
+        "spec": {
+            "roles": [
+                {
+                    "name": "router",
+                    "componentType": "router",
+                    "strategy": "prefix-cache",
+                    "httproute": {"parentRefs": [{"name": "gw"}]},
+                },
+                {
+                    "name": "worker",
+                    "componentType": "worker",
+                    "replicas": 2,
+                    "engine": "native",
+                    "tpu": {"type": "v5e", "topology": "4x4"},
+                    "template": POD_TEMPLATE,
+                },
+            ]
+        },
+    }
+
+
+def test_roundtrip_parse_serialize():
+    svc = InferenceService.from_dict(sample_manifest())
+    svc.validate()
+    assert svc.name == "qwen" and svc.namespace == "ml" and svc.generation == 3
+    router, worker = svc.spec.roles
+    assert router.component_type == ComponentType.ROUTER
+    assert router.strategy == RoutingStrategy.PREFIX_CACHE
+    assert worker.engine == EngineKind.NATIVE
+    assert worker.nodes_per_replica() == 4  # v5e 4x4 = 4 hosts
+    redone = InferenceService.from_dict(svc.to_dict())
+    assert redone.to_dict() == svc.to_dict()
+
+
+def test_multinode_fallback_nodes_per_replica():
+    m = sample_manifest()
+    m["spec"]["roles"][1].pop("tpu")
+    m["spec"]["roles"][1]["multinode"] = {"nodeCount": 4}
+    svc = InferenceService.from_dict(m)
+    svc.validate()
+    assert svc.spec.roles[1].nodes_per_replica() == 4
+
+
+@pytest.mark.parametrize(
+    "mutate,err",
+    [
+        (lambda m: m["metadata"].pop("name"), "metadata.name"),
+        (lambda m: m["spec"].__setitem__("roles", []), "roles"),
+        (lambda m: m["spec"]["roles"][1].pop("template"), "template"),
+        (lambda m: m["spec"]["roles"][1].__setitem__("name", "router"), "duplicate"),
+        (lambda m: m["spec"]["roles"][0].pop("strategy"), "strategy"),
+        (
+            lambda m: m["spec"]["roles"][1]["tpu"].__setitem__("topology", "4x4x4"),
+            None,  # TopologyError subclass of ValueError
+        ),
+        (
+            lambda m: m["spec"]["roles"][1].__setitem__("componentType", "prefiller"),
+            "prefiller and decoder",
+        ),
+    ],
+)
+def test_validation_rejects(mutate, err):
+    m = sample_manifest()
+    mutate(m)
+    with pytest.raises(ValueError) as exc:
+        svc = InferenceService.from_dict(m)
+        svc.validate()
+    if err:
+        assert err in str(exc.value)
+
+
+def test_unknown_enums_rejected_at_parse():
+    m = sample_manifest()
+    m["spec"]["roles"][0]["strategy"] = "bogus"
+    with pytest.raises(ValidationError):
+        InferenceService.from_dict(m)
+    m = sample_manifest()
+    m["spec"]["roles"][1]["engine"] = "cuda"
+    with pytest.raises(ValidationError):
+        InferenceService.from_dict(m)
+
+
+def test_crd_manifest_shape():
+    crd = build_crd()
+    assert crd["metadata"]["name"] == "inferenceservices.fusioninfer.io"
+    ver = crd["spec"]["versions"][0]
+    assert ver["subresources"] == {"status": {}}
+    role_schema = ver["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]["roles"]["items"]
+    assert set(role_schema["required"]) == {"name", "componentType"}
+    assert "tpu" in role_schema["properties"]
+    # raw passthroughs stay untyped to dodge CRD size limits
+    assert role_schema["properties"]["template"] == {
+        "type": "object",
+        "x-kubernetes-preserve-unknown-fields": True,
+    }

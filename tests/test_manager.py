@@ -1,0 +1,74 @@
+"""Manager loop tests: workqueue dedup, end-to-end watchless resync path
+(fake client has no watch stream -> manager falls back to list+resync),
+child-event owner mapping, and probe endpoints."""
+
+import time
+import urllib.request
+
+from fusioninfer_tpu.operator import FakeK8s, Manager, WorkQueue
+
+
+def test_workqueue_dedups_pending_keys():
+    q = WorkQueue()
+    q.add(("ns", "a"))
+    q.add(("ns", "a"))
+    q.add(("ns", "b"))
+    assert q.get() == ("ns", "a")
+    assert q.get() == ("ns", "b")
+    assert q.get(timeout=0.05) is None
+    # after a key is taken it can be re-added
+    q.add(("ns", "a"))
+    assert q.get() == ("ns", "a")
+
+
+def test_manager_reconciles_from_initial_list(unused_tcp_port=18081):
+    fake = FakeK8s()
+    fake.create(
+        {
+            "apiVersion": "fusioninfer.io/v1alpha1",
+            "kind": "InferenceService",
+            "metadata": {"name": "svc", "namespace": "default"},
+            "spec": {
+                "roles": [
+                    {
+                        "name": "worker",
+                        "componentType": "worker",
+                        "replicas": 1,
+                        "template": {"spec": {"containers": [{"name": "e", "image": "img"}]}},
+                    }
+                ]
+            },
+        }
+    )
+    mgr = Manager(fake, namespace="default", probe_port=unused_tcp_port)
+    mgr.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if fake.get_or_none("LeaderWorkerSet", "default", "svc-worker-0"):
+                break
+            time.sleep(0.05)
+        assert fake.get("LeaderWorkerSet", "default", "svc-worker-0")
+        with urllib.request.urlopen(f"http://127.0.0.1:{unused_tcp_port}/healthz") as r:
+            assert r.status == 200
+        with urllib.request.urlopen(f"http://127.0.0.1:{unused_tcp_port}/readyz") as r:
+            assert r.status == 200
+    finally:
+        mgr.stop()
+
+
+def test_enqueue_owner_maps_child_to_parent():
+    fake = FakeK8s()
+    mgr = Manager(fake, namespace="default", probe_port=0)
+    child = {
+        "kind": "LeaderWorkerSet",
+        "metadata": {
+            "name": "svc-worker-0",
+            "namespace": "default",
+            "ownerReferences": [
+                {"kind": "InferenceService", "name": "svc", "uid": "u1", "controller": True}
+            ],
+        },
+    }
+    mgr._enqueue_owner(child)
+    assert mgr.workqueue.get() == ("default", "svc")

@@ -1,0 +1,288 @@
+"""Integration tests of the reconcile loop against the fake API server —
+the envtest-tier equivalent of the reference suite
+(``pkg/controller/inferenceservice_controller_test.go``): LWS ``{name}-{role}-0``
+appears on create, replica increase creates ``-1``, image change flips the
+spec hash and updates the LWS, metadata-only change leaves the LWS
+untouched (stable resourceVersion), scale-down deletes orphans, router
+roles render all eight resources, status aggregates per component."""
+
+import copy
+
+import pytest
+
+from fusioninfer_tpu.operator.fake import FakeK8s
+from fusioninfer_tpu.operator.reconciler import InferenceServiceReconciler
+
+
+def manifest(replicas=1, topology="2x2", with_router=False, pd=False) -> dict:
+    roles = []
+    if with_router:
+        roles.append(
+            {"name": "router", "componentType": "router", "strategy": "prefix-cache"}
+        )
+    template = {
+        "spec": {
+            "containers": [
+                {"name": "engine", "image": "vllm-tpu:v1", "args": ["serve", "Qwen/Qwen3-8B"]}
+            ]
+        }
+    }
+    if pd:
+        roles += [
+            {
+                "name": "prefiller", "componentType": "prefiller", "replicas": 1,
+                "tpu": {"type": "v5e", "topology": topology}, "template": copy.deepcopy(template),
+            },
+            {
+                "name": "decoder", "componentType": "decoder", "replicas": replicas,
+                "tpu": {"type": "v5e", "topology": topology}, "template": copy.deepcopy(template),
+            },
+        ]
+    else:
+        roles.append(
+            {
+                "name": "worker", "componentType": "worker", "replicas": replicas,
+                "tpu": {"type": "v5e", "topology": topology}, "template": copy.deepcopy(template),
+            }
+        )
+    return {
+        "apiVersion": "fusioninfer.io/v1alpha1",
+        "kind": "InferenceService",
+        "metadata": {"name": "qwen", "namespace": "default", "generation": 1},
+        "spec": {"roles": roles},
+    }
+
+
+@pytest.fixture
+def fake():
+    return FakeK8s()
+
+
+@pytest.fixture
+def reconciler(fake):
+    return InferenceServiceReconciler(fake)
+
+
+def apply_and_reconcile(fake, reconciler, m):
+    existing = fake.get_or_none("InferenceService", "default", m["metadata"]["name"])
+    if existing is None:
+        fake.create(m)
+    else:
+        m = copy.deepcopy(m)
+        m["metadata"]["resourceVersion"] = existing["metadata"]["resourceVersion"]
+        fake.update(m)
+    return reconciler.reconcile("default", m["metadata"]["name"])
+
+
+class TestBasicLifecycle:
+    def test_lws_created_on_apply(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest())
+        lws = fake.get("LeaderWorkerSet", "default", "qwen-worker-0")
+        assert lws["spec"]["leaderWorkerTemplate"]["size"] == 1
+        owner = lws["metadata"]["ownerReferences"][0]
+        assert owner["kind"] == "InferenceService" and owner["name"] == "qwen"
+        # single-host 2x2: no gang, so no PodGroup
+        assert fake.list("PodGroup", "default") == []
+
+    def test_replica_increase_creates_next_lws(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(replicas=1))
+        apply_and_reconcile(fake, reconciler, manifest(replicas=2))
+        assert fake.get("LeaderWorkerSet", "default", "qwen-worker-1")
+
+    def test_scale_down_deletes_orphan(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(replicas=3))
+        apply_and_reconcile(fake, reconciler, manifest(replicas=1))
+        names = [o["metadata"]["name"] for o in fake.list("LeaderWorkerSet", "default")]
+        assert names == ["qwen-worker-0"]
+
+    def test_image_change_updates_lws(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest())
+        rv_before = fake.resource_version("LeaderWorkerSet", "default", "qwen-worker-0")
+        m = manifest()
+        m["spec"]["roles"][0]["template"]["spec"]["containers"][0]["image"] = "vllm-tpu:v2"
+        apply_and_reconcile(fake, reconciler, m)
+        lws = fake.get("LeaderWorkerSet", "default", "qwen-worker-0")
+        assert lws["metadata"]["resourceVersion"] != rv_before
+        image = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]["containers"][0]["image"]
+        assert image == "vllm-tpu:v2"
+
+    def test_metadata_only_change_is_noop_on_lws(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest())
+        rv_before = fake.resource_version("LeaderWorkerSet", "default", "qwen-worker-0")
+        m = manifest()
+        m["metadata"]["annotations"] = {"team": "serving"}
+        apply_and_reconcile(fake, reconciler, m)
+        assert fake.resource_version("LeaderWorkerSet", "default", "qwen-worker-0") == rv_before
+
+    def test_deleting_service_cascades_children(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(with_router=True))
+        fake.delete("InferenceService", "default", "qwen")
+        reconciler.reconcile("default", "qwen")
+        assert fake.list("LeaderWorkerSet", "default") == []
+        assert fake.list("Deployment", "default") == []
+
+
+class TestGangScheduling:
+    def test_multihost_creates_podgroup_and_gang_annotations(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(topology="4x4", replicas=2))
+        pg = fake.get("PodGroup", "default", "qwen")
+        assert pg["spec"]["minMember"] == 8
+        assert pg["spec"]["minTaskMember"] == {"worker-0": 4, "worker-1": 4}
+        assert pg["spec"]["minResources"]["google.com/tpu"] == "32"
+        lws = fake.get("LeaderWorkerSet", "default", "qwen-worker-0")
+        leader = lws["spec"]["leaderWorkerTemplate"]["leaderTemplate"]
+        assert leader["metadata"]["annotations"]["scheduling.k8s.io/group-name"] == "qwen"
+        assert leader["metadata"]["annotations"]["volcano.sh/task-spec"] == "worker-0"
+        assert leader["spec"]["schedulerName"] == "volcano"
+
+    def test_pd_disaggregated_shares_one_podgroup(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(pd=True, replicas=2))
+        pg = fake.get("PodGroup", "default", "qwen")
+        assert pg["spec"]["minTaskMember"] == {"prefiller-0": 1, "decoder-0": 1, "decoder-1": 1}
+        assert fake.get("LeaderWorkerSet", "default", "qwen-prefiller-0")
+        assert fake.get("LeaderWorkerSet", "default", "qwen-decoder-1")
+
+
+class TestRouter:
+    def test_all_eight_router_resources(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(with_router=True))
+        assert fake.get("ServiceAccount", "default", "qwen-router-epp")
+        assert fake.get("Role", "default", "qwen-router-epp")
+        assert fake.get("RoleBinding", "default", "qwen-router-epp")
+        assert fake.get("ConfigMap", "default", "qwen-router-epp-config")
+        assert fake.get("Deployment", "default", "qwen-router-epp")
+        assert fake.get("Service", "default", "qwen-router-epp")
+        pool = fake.get("InferencePool", "default", "qwen-router-pool")
+        route = fake.get("HTTPRoute", "default", "qwen-router-route")
+        sel = pool["spec"]["selector"]["matchLabels"]
+        assert sel["leaderworkerset.sigs.k8s.io/worker-index"] == "0"
+        assert route["spec"]["rules"][0]["backendRefs"][0]["name"] == "qwen-router-pool"
+
+    def test_strategy_change_updates_configmap_and_rolls_epp(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(with_router=True))
+        cm_rv = fake.resource_version("ConfigMap", "default", "qwen-router-epp-config")
+        svc_rv = fake.resource_version("Service", "default", "qwen-router-epp")
+        m = manifest(with_router=True)
+        m["spec"]["roles"][0]["strategy"] = "queue-size"
+        apply_and_reconcile(fake, reconciler, m)
+        assert fake.resource_version("ConfigMap", "default", "qwen-router-epp-config") != cm_rv
+        # EPP reads its config once at startup: the deployment must roll too
+        # (config-hash pod annotation), while untouched resources stay put.
+        assert fake.resource_version("Service", "default", "qwen-router-epp") == svc_rv
+
+
+class TestStatus:
+    def test_status_pending_then_running(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(replicas=2, topology="4x4"))
+        svc = fake.get("InferenceService", "default", "qwen")
+        cs = svc["status"]["componentStatus"]["worker"]
+        assert cs["phase"] == "Pending"
+        assert cs["totalPods"] == 8 and cs["nodesPerReplica"] == 4
+        conds = {c["type"]: c for c in svc["status"]["conditions"]}
+        assert conds["Initialized"]["status"] == "True"
+        assert conds["Active"]["status"] == "False"
+
+        # one slice comes up -> Deploying
+        fake.set_status("LeaderWorkerSet", "default", "qwen-worker-0", {"readyReplicas": 1})
+        reconciler.reconcile("default", "qwen")
+        svc = fake.get("InferenceService", "default", "qwen")
+        cs = svc["status"]["componentStatus"]["worker"]
+        assert cs["phase"] == "Deploying"
+        assert cs["readyReplicas"] == 1 and cs["readyPods"] == 4
+
+        # both slices up -> Running + Active
+        fake.set_status("LeaderWorkerSet", "default", "qwen-worker-1", {"readyReplicas": 1})
+        result = reconciler.reconcile("default", "qwen")
+        svc = fake.get("InferenceService", "default", "qwen")
+        assert svc["status"]["componentStatus"]["worker"]["phase"] == "Running"
+        conds = {c["type"]: c for c in svc["status"]["conditions"]}
+        assert conds["Active"]["status"] == "True"
+        assert not result.requeue
+
+    def test_single_status_write_per_reconcile(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest())
+        writes = [a for a in fake.actions if a[0] == "update_status"]
+        assert len(writes) == 1
+
+    def test_invalid_spec_sets_failed_condition(self, fake, reconciler):
+        m = manifest()
+        del m["spec"]["roles"][0]["template"]
+        result = apply_and_reconcile(fake, reconciler, m)
+        assert result.errors
+        svc = fake.get("InferenceService", "default", "qwen")
+        conds = {c["type"]: c for c in svc["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"
+        assert "template" in conds["Failed"]["message"]
+
+    def test_reconcile_of_missing_service_is_noop(self, fake, reconciler):
+        result = reconciler.reconcile("default", "ghost")
+        assert not result.errors and not result.requeue
+        assert fake.actions == []
+
+
+def test_reconcile_is_idempotent(fake, reconciler):
+    apply_and_reconcile(fake, reconciler, manifest(with_router=True, topology="4x4"))
+    fake.actions.clear()
+    reconciler.reconcile("default", "qwen")
+    assert fake.actions == [], f"steady-state reconcile must cost zero API writes, got {fake.actions}"
+
+
+class TestOrphanSweepAndSteadyState:
+    def test_role_removal_deletes_its_children(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(with_router=True))
+        assert fake.get("Deployment", "default", "qwen-router-epp")
+        m = manifest(with_router=False)  # drop the router role entirely
+        apply_and_reconcile(fake, reconciler, m)
+        assert fake.get_or_none("Deployment", "default", "qwen-router-epp") is None
+        assert fake.get_or_none("InferencePool", "default", "qwen-router-pool") is None
+        assert fake.get_or_none("HTTPRoute", "default", "qwen-router-route") is None
+        assert fake.get("LeaderWorkerSet", "default", "qwen-worker-0")  # survivor intact
+
+    def test_podgroup_removed_when_gang_not_needed(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(topology="4x4"))
+        assert fake.get("PodGroup", "default", "qwen")
+        apply_and_reconcile(fake, reconciler, manifest(topology="2x2"))  # single host now
+        assert fake.get_or_none("PodGroup", "default", "qwen") is None
+
+    def test_unowned_lookalike_not_swept(self, fake, reconciler):
+        fake.create(
+            {
+                "apiVersion": "leaderworkerset.x-k8s.io/v1",
+                "kind": "LeaderWorkerSet",
+                "metadata": {
+                    "name": "qwen-imposter",
+                    "namespace": "default",
+                    "labels": {"fusioninfer.io/service": "qwen"},
+                },
+                "spec": {},
+            }
+        )
+        apply_and_reconcile(fake, reconciler, manifest())
+        assert fake.get("LeaderWorkerSet", "default", "qwen-imposter")
+
+    def test_strategy_change_rolls_epp_deployment(self, fake, reconciler):
+        apply_and_reconcile(fake, reconciler, manifest(with_router=True))
+        dep_rv = fake.resource_version("Deployment", "default", "qwen-router-epp")
+        m = manifest(with_router=True)
+        m["spec"]["roles"][0]["strategy"] = "queue-size"
+        apply_and_reconcile(fake, reconciler, m)
+        dep = fake.get("Deployment", "default", "qwen-router-epp")
+        assert dep["metadata"]["resourceVersion"] != dep_rv
+        assert dep["spec"]["template"]["metadata"]["annotations"]["fusioninfer.io/config-hash"]
+
+    def test_replicas_zero_counts_as_running(self, fake, reconciler):
+        m = manifest(replicas=0)
+        apply_and_reconcile(fake, reconciler, m)
+        svc = fake.get("InferenceService", "default", "qwen")
+        assert svc["status"]["componentStatus"]["worker"]["phase"] == "Running"
+        conds = {c["type"]: c for c in svc["status"]["conditions"]}
+        assert conds["Active"]["status"] == "True"
+
+    def test_unparseable_spec_sets_failed_condition(self, fake, reconciler):
+        m = manifest()
+        m["spec"]["roles"][0]["componentType"] = "gpu-worker"
+        result = apply_and_reconcile(fake, reconciler, m)
+        assert result.errors
+        svc = fake.get("InferenceService", "default", "qwen")
+        conds = {c["type"]: c for c in svc["status"]["conditions"]}
+        assert conds["Failed"]["status"] == "True"

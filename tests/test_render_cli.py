@@ -1,0 +1,72 @@
+"""CLI render path over every shipped sample: all five BASELINE configs
+must validate and render, with zero nvidia.com/gpu anywhere (the
+acceptance bar) and TPU selectors present wherever a tpu block is given."""
+
+import glob
+import io
+import os
+import sys
+
+import pytest
+import yaml
+
+from fusioninfer_tpu.api import InferenceService
+from fusioninfer_tpu.cli import main as cli_main
+from fusioninfer_tpu.operator.render import render_all
+
+SAMPLES = sorted(glob.glob(os.path.join(os.path.dirname(__file__), "..", "config", "samples", "*.yaml")))
+
+
+def test_samples_exist():
+    assert len(SAMPLES) == 5
+
+
+@pytest.mark.parametrize("path", SAMPLES, ids=[os.path.basename(p) for p in SAMPLES])
+def test_sample_renders_clean(path):
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    svc = InferenceService.from_dict(doc)
+    svc.validate()
+    rendered = render_all(svc)
+    assert rendered
+    dump = yaml.safe_dump_all(rendered)
+    assert "nvidia.com/gpu" not in dump  # acceptance bar: TPU only
+    has_tpu = any(r.tpu for r in svc.spec.roles)
+    if has_tpu:
+        assert "google.com/tpu" in dump
+        assert "cloud.google.com/gke-tpu-topology" in dump
+
+
+def test_pd_sample_renders_gang_and_pd_profiles():
+    path = [p for p in SAMPLES if "05-pd" in p][0]
+    with open(path) as f:
+        svc = InferenceService.from_dict(yaml.safe_load(f))
+    svc.validate()
+    rendered = {(r["kind"], r["metadata"]["name"]): r for r in render_all(svc)}
+    pg = rendered[("PodGroup", "llama3-70b-pd")]
+    assert pg["spec"]["minMember"] == 8  # two 4-host slices
+    assert pg["spec"]["minResources"]["google.com/tpu"] == "32"
+    cm = rendered[("ConfigMap", "llama3-70b-pd-router-epp-config")]
+    assert "pd-profile-handler" in cm["data"]["config.yaml"]
+
+
+def test_cli_render_crd(capsys):
+    assert cli_main(["render", "crd"]) == 0
+    out = yaml.safe_load(capsys.readouterr().out)
+    assert out["kind"] == "CustomResourceDefinition"
+
+
+def test_cli_render_resources(capsys):
+    sample = [p for p in SAMPLES if "04-multihost" in p][0]
+    assert cli_main(["render", "resources", "-f", sample]) == 0
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    kinds = sorted({d["kind"] for d in docs})
+    assert kinds == [
+        "ConfigMap", "Deployment", "HTTPRoute", "InferencePool",
+        "LeaderWorkerSet", "PodGroup", "Role", "RoleBinding",
+        "Service", "ServiceAccount",
+    ]
+
+
+def test_cli_render_resources_requires_file(capsys):
+    assert cli_main(["render", "resources"]) == 2

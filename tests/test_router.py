@@ -1,0 +1,204 @@
+"""Router rendering tests mirroring the reference's coverage
+(``pkg/router/*_test.go``): EPP resources, image env override, every
+strategy's generated YAML incl. the PD fallback, InferencePool selector
+logic for one vs several worker roles, HTTPRoute user-spec merge."""
+
+import yaml
+
+from fusioninfer_tpu.api.types import (
+    ComponentType,
+    InferenceService,
+    InferenceServiceSpec,
+    Role,
+    RoutingStrategy,
+    TPUSlice,
+)
+from fusioninfer_tpu.router import (
+    BACKEND_PORT,
+    DEFAULT_EPP_IMAGE,
+    EPP_GRPC_PORT,
+    build_epp_configmap,
+    build_epp_deployment,
+    build_epp_role,
+    build_epp_rolebinding,
+    build_epp_service,
+    build_epp_serviceaccount,
+    build_httproute,
+    build_inference_pool,
+    build_pool_selector,
+    generate_epp_config,
+    generate_epp_name,
+    generate_pool_name,
+    get_epp_image,
+)
+
+TEMPLATE = {"spec": {"containers": [{"name": "engine", "image": "img"}]}}
+
+
+def router_role(strategy=RoutingStrategy.PREFIX_CACHE, **over):
+    defaults = dict(name="router", component_type=ComponentType.ROUTER, strategy=strategy)
+    defaults.update(over)
+    return Role(**defaults)
+
+
+def worker_role(name="worker", ctype=ComponentType.WORKER):
+    return Role(name=name, component_type=ctype, template=TEMPLATE)
+
+
+def svc_of(*roles):
+    return InferenceService(name="svc", namespace="ml", spec=InferenceServiceSpec(roles=list(roles)))
+
+
+class TestStrategies:
+    def test_prefix_cache_yaml(self):
+        svc = svc_of(router_role(), worker_role())
+        cfg = yaml.safe_load(generate_epp_config(svc, svc.spec.roles[0]))
+        assert cfg["kind"] == "EndpointPickerConfig"
+        types = [p["type"] for p in cfg["plugins"]]
+        assert types == ["prefix-cache-scorer", "max-score-picker"]
+        assert cfg["plugins"][0]["parameters"]["hashBlockSize"] == 5
+        assert cfg["plugins"][0]["parameters"]["lruCapacityPerServer"] == 31250
+        prof = cfg["schedulingProfiles"][0]
+        assert prof["plugins"][0] == {"pluginRef": "prefix-cache-scorer", "weight": 100}
+
+    def test_simple_scorer_strategies(self):
+        for strategy, scorer in [
+            (RoutingStrategy.KV_CACHE_UTILIZATION, "kv-cache-utilization-scorer"),
+            (RoutingStrategy.QUEUE_SIZE, "queue-scorer"),
+            (RoutingStrategy.LORA_AFFINITY, "lora-affinity-scorer"),
+        ]:
+            svc = svc_of(router_role(strategy), worker_role())
+            cfg = yaml.safe_load(generate_epp_config(svc, svc.spec.roles[0]))
+            assert cfg["plugins"][0]["type"] == scorer
+            assert cfg["schedulingProfiles"][0]["plugins"][0]["weight"] == 100
+
+    def test_pd_strategy_with_real_pd_service(self):
+        svc = svc_of(
+            router_role(RoutingStrategy.PD_DISAGGREGATION),
+            worker_role("prefill", ComponentType.PREFILLER),
+            worker_role("decode", ComponentType.DECODER),
+        )
+        cfg = yaml.safe_load(generate_epp_config(svc, svc.spec.roles[0]))
+        types = [p["type"] for p in cfg["plugins"]]
+        assert "pd-profile-handler" in types and "prefill-header-handler" in types
+        filters = [p for p in cfg["plugins"] if p["type"] == "by-label"]
+        assert {f["parameters"]["value"] for f in filters} == {"prefiller", "decoder"}
+        assert all(f["parameters"]["label"] == "fusioninfer.io/component-type" for f in filters)
+        profiles = {p["name"]: p for p in cfg["schedulingProfiles"]}
+        assert set(profiles) == {"prefill", "decode"}
+        assert profiles["prefill"]["plugins"][1]["weight"] == 50
+
+    def test_pd_strategy_falls_back_when_not_pd(self):
+        svc = svc_of(router_role(RoutingStrategy.PD_DISAGGREGATION), worker_role())
+        cfg = yaml.safe_load(generate_epp_config(svc, svc.spec.roles[0]))
+        assert cfg["plugins"][0]["type"] == "prefix-cache-scorer"
+        assert len(cfg["schedulingProfiles"]) == 1
+
+    def test_user_config_wins_outright(self):
+        custom = "apiVersion: custom/v1\nkind: Whatever\n"
+        svc = svc_of(router_role(endpoint_picker_config=custom), worker_role())
+        assert generate_epp_config(svc, svc.spec.roles[0]) == custom
+
+
+class TestEPPResources:
+    def test_configmap_contains_config(self):
+        svc = svc_of(router_role(), worker_role())
+        cm = build_epp_configmap(svc, svc.spec.roles[0])
+        assert cm["metadata"]["name"] == "svc-router-epp-config"
+        assert "prefix-cache-scorer" in cm["data"]["config.yaml"]
+
+    def test_deployment_wiring(self):
+        svc = svc_of(router_role(), worker_role())
+        dep = build_epp_deployment(svc, svc.spec.roles[0], pool_name="svc-router-pool")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert c["image"] == DEFAULT_EPP_IMAGE
+        args = " ".join(c["args"])
+        assert "--pool-name svc-router-pool" in args
+        assert "--pool-namespace ml" in args
+        assert "--config-file /config/config.yaml" in args
+        assert {p["containerPort"] for p in c["ports"]} == {9002, 9003, 9090}
+        assert c["readinessProbe"]["grpc"]["port"] == 9003
+        assert dep["spec"]["template"]["spec"]["serviceAccountName"] == "svc-router-epp"
+        vols = dep["spec"]["template"]["spec"]["volumes"]
+        assert vols[0]["configMap"]["name"] == "svc-router-epp-config"
+
+    def test_image_env_override(self, monkeypatch):
+        monkeypatch.setenv("EPP_IMAGE", "my-registry/epp:dev")
+        assert get_epp_image() == "my-registry/epp:dev"
+        svc = svc_of(router_role(), worker_role())
+        dep = build_epp_deployment(svc, svc.spec.roles[0], "p")
+        assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == "my-registry/epp:dev"
+
+    def test_service_ports(self):
+        svc = svc_of(router_role(), worker_role())
+        s = build_epp_service(svc, svc.spec.roles[0])
+        assert s["spec"]["type"] == "ClusterIP"
+        assert {p["port"] for p in s["spec"]["ports"]} == {9002, 9003, 9090}
+        assert s["spec"]["selector"] == {"app": "svc-router-epp"}
+
+    def test_rbac_chain(self):
+        svc = svc_of(router_role(), worker_role())
+        role = svc.spec.roles[0]
+        sa = build_epp_serviceaccount(svc, role)
+        r = build_epp_role(svc, role)
+        rb = build_epp_rolebinding(svc, role)
+        assert sa["metadata"]["name"] == r["metadata"]["name"] == "svc-router-epp"
+        resources = {res for rule in r["rules"] for res in rule["resources"]}
+        assert {"pods", "inferencepools", "inferenceobjectives", "leases", "events"} <= resources
+        assert rb["roleRef"]["name"] == "svc-router-epp"
+        assert rb["subjects"][0] == {"kind": "ServiceAccount", "name": "svc-router-epp", "namespace": "ml"}
+
+
+class TestInferencePool:
+    def test_single_worker_role_selector_scopes_component_type(self):
+        svc = svc_of(router_role(), worker_role())
+        sel = build_pool_selector(svc)
+        assert sel == {
+            "fusioninfer.io/service": "svc",
+            "leaderworkerset.sigs.k8s.io/worker-index": "0",
+            "fusioninfer.io/component-type": "worker",
+        }
+
+    def test_pd_selector_keeps_both_roles(self):
+        svc = svc_of(
+            router_role(),
+            worker_role("p", ComponentType.PREFILLER),
+            worker_role("d", ComponentType.DECODER),
+        )
+        sel = build_pool_selector(svc)
+        assert "fusioninfer.io/component-type" not in sel
+        assert sel["leaderworkerset.sigs.k8s.io/worker-index"] == "0"
+
+    def test_pool_shape(self):
+        svc = svc_of(router_role(), worker_role())
+        pool = build_inference_pool(svc, svc.spec.roles[0])
+        assert pool["metadata"]["name"] == "svc-router-pool"
+        assert pool["spec"]["targetPorts"] == [{"number": BACKEND_PORT}]
+        ref = pool["spec"]["endpointPickerRef"]
+        assert ref == {"name": generate_epp_name(svc, svc.spec.roles[0]), "port": {"number": EPP_GRPC_PORT}}
+
+
+class TestHTTPRoute:
+    def test_user_spec_preserved_rules_overwritten(self):
+        user_spec = {
+            "parentRefs": [{"name": "gw", "sectionName": "https"}],
+            "hostnames": ["llm.example.com"],
+            "rules": [{"backendRefs": [{"name": "hijack", "kind": "Service"}]}],
+        }
+        svc = svc_of(router_role(httproute=user_spec), worker_role())
+        route = build_httproute(svc, svc.spec.roles[0])
+        spec = route["spec"]
+        assert spec["parentRefs"] == [{"name": "gw", "sectionName": "https"}]
+        assert spec["hostnames"] == ["llm.example.com"]
+        assert len(spec["rules"]) == 1
+        backend = spec["rules"][0]["backendRefs"][0]
+        assert backend["kind"] == "InferencePool"
+        assert backend["group"] == "inference.networking.k8s.io"
+        assert backend["name"] == generate_pool_name(svc, svc.spec.roles[0])
+        # user's template object untouched
+        assert user_spec["rules"][0]["backendRefs"][0]["name"] == "hijack"
+
+    def test_empty_user_spec_ok(self):
+        svc = svc_of(router_role(), worker_role())
+        route = build_httproute(svc, svc.spec.roles[0])
+        assert route["spec"]["rules"][0]["backendRefs"][0]["kind"] == "InferencePool"

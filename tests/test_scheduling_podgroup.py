@@ -1,0 +1,141 @@
+"""Gang-scheduling tests mirroring the reference's coverage
+(``pkg/scheduling/podgroup_test.go``): PD detection, gang predicates,
+PodGroup minMember/minTaskMember/minResources for PD, multi-node, PD×multi-
+node, and router-skipping — with TPU-chip resource sums."""
+
+from fusioninfer_tpu.api.types import (
+    ComponentType,
+    InferenceService,
+    InferenceServiceSpec,
+    Multinode,
+    Role,
+    RoutingStrategy,
+    TPUSlice,
+)
+from fusioninfer_tpu.scheduling.podgroup import (
+    build_podgroup,
+    generate_podgroup_name,
+    generate_task_name,
+    is_pd_disaggregated,
+    needs_gang_scheduling,
+    needs_gang_scheduling_for_role,
+)
+
+TEMPLATE = {
+    "spec": {
+        "containers": [
+            {
+                "name": "engine",
+                "image": "img",
+                "resources": {"limits": {"cpu": "500m", "memory": "1Gi"}},
+            }
+        ]
+    }
+}
+
+
+def svc_of(*roles: Role) -> InferenceService:
+    return InferenceService(name="svc", namespace="ml", spec=InferenceServiceSpec(roles=list(roles)))
+
+
+def worker(name="worker", ctype=ComponentType.WORKER, replicas=1, tpu=None, multinode=None):
+    return Role(
+        name=name, component_type=ctype, replicas=replicas,
+        tpu=tpu, multinode=multinode, template=TEMPLATE,
+    )
+
+
+def router():
+    return Role(name="router", component_type=ComponentType.ROUTER, strategy=RoutingStrategy.PREFIX_CACHE)
+
+
+class TestPredicates:
+    def test_pd_detection(self):
+        assert is_pd_disaggregated(
+            svc_of(worker("p", ComponentType.PREFILLER), worker("d", ComponentType.DECODER))
+        )
+        assert not is_pd_disaggregated(svc_of(worker()))
+        assert not is_pd_disaggregated(svc_of(worker("p", ComponentType.PREFILLER)))
+
+    def test_gang_needed_iff_pd_or_multihost(self):
+        assert not needs_gang_scheduling(svc_of(worker()))
+        assert not needs_gang_scheduling(svc_of(worker(tpu=TPUSlice("v5e", "2x2"))))  # 1 host
+        assert needs_gang_scheduling(svc_of(worker(tpu=TPUSlice("v5e", "4x4"))))  # 4 hosts
+        assert needs_gang_scheduling(svc_of(worker(multinode=Multinode(2))))
+        assert needs_gang_scheduling(
+            svc_of(worker("p", ComponentType.PREFILLER), worker("d", ComponentType.DECODER))
+        )
+
+    def test_router_roles_never_gang(self):
+        svc = svc_of(router(), worker(tpu=TPUSlice("v5e", "4x4")))
+        assert needs_gang_scheduling_for_role(svc, svc.spec.roles[1])
+        assert not needs_gang_scheduling_for_role(svc, svc.spec.roles[0])
+
+
+class TestBuildPodGroup:
+    def test_pd_disaggregated(self):
+        # prefiller 1 replica x 1 host, decoder 2 replicas x 1 host -> minMember 3
+        svc = svc_of(
+            worker("prefiller", ComponentType.PREFILLER),
+            worker("decoder", ComponentType.DECODER, replicas=2),
+        )
+        pg = build_podgroup(svc)
+        assert pg["metadata"]["name"] == "svc"
+        assert pg["spec"]["minMember"] == 3
+        assert pg["spec"]["minTaskMember"] == {"prefiller-0": 1, "decoder-0": 1, "decoder-1": 1}
+        assert pg["spec"]["minResources"] == {"cpu": "1500m", "memory": "3Gi"}
+
+    def test_multi_host_tpu_slice(self):
+        svc = svc_of(worker(tpu=TPUSlice("v5e", "4x4")))  # 4 hosts, 4 chips each
+        pg = build_podgroup(svc)
+        assert pg["spec"]["minMember"] == 4
+        assert pg["spec"]["minTaskMember"] == {"worker-0": 4}
+        assert pg["spec"]["minResources"]["google.com/tpu"] == "16"  # whole slice
+        assert pg["spec"]["minResources"]["cpu"] == "2"
+
+    def test_pd_times_multihost(self):
+        svc = svc_of(
+            worker("prefiller", ComponentType.PREFILLER, tpu=TPUSlice("v5e", "4x4")),
+            worker("decoder", ComponentType.DECODER, replicas=2, tpu=TPUSlice("v5e", "4x4")),
+        )
+        pg = build_podgroup(svc)
+        assert pg["spec"]["minMember"] == 12
+        assert pg["spec"]["minTaskMember"] == {"prefiller-0": 4, "decoder-0": 4, "decoder-1": 4}
+        assert pg["spec"]["minResources"]["google.com/tpu"] == "48"
+
+    def test_router_roles_skipped(self):
+        svc = svc_of(router(), worker(tpu=TPUSlice("v5e", "4x4")))
+        pg = build_podgroup(svc)
+        assert "router-0" not in pg["spec"]["minTaskMember"]
+        assert pg["spec"]["minMember"] == 4
+
+    def test_explicit_template_tpu_limit_not_double_counted(self):
+        template = {
+            "spec": {
+                "containers": [
+                    {"name": "engine", "image": "img",
+                     "resources": {"limits": {"google.com/tpu": "4"}}}
+                ]
+            }
+        }
+        role = Role(name="w", component_type=ComponentType.WORKER,
+                    tpu=TPUSlice("v5e", "4x4"), template=template)
+        pg = build_podgroup(svc_of(role))
+        assert pg["spec"]["minResources"]["google.com/tpu"] == "16"
+
+    def test_queue_passthrough_and_names(self):
+        pg = build_podgroup(svc_of(worker(multinode=Multinode(2))), queue="tpu-queue")
+        assert pg["spec"]["queue"] == "tpu-queue"
+        assert generate_podgroup_name(svc_of(worker())) == "svc"
+        assert generate_task_name(worker(), 2) == "worker-2"
+
+
+def test_quantity_roundtrip():
+    from fusioninfer_tpu.utils.quantity import add_resource_lists, format_quantity_milli, parse_quantity_milli
+
+    assert parse_quantity_milli("500m") == 500
+    assert parse_quantity_milli("1Gi") == 1024**3 * 1000
+    assert parse_quantity_milli("4") == 4000
+    assert format_quantity_milli(1500) == "1500m"
+    assert add_resource_lists({"cpu": "250m"}, {"cpu": "1"}) == {"cpu": "1250m"}
+    assert add_resource_lists({"memory": "512Mi"}, multiplier=4) == {"memory": "2Gi"}

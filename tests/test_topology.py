@@ -1,0 +1,71 @@
+"""Topology arithmetic is SURVEY §7 hard-part #1: (type, topology) →
+chips → hosts → LWS size → minTaskMember.  Wrong numbers hang XLA init
+silently, so every known shape is pinned here."""
+
+import pytest
+
+from fusioninfer_tpu.api import SliceShape, TopologyError, resolve_slice
+from fusioninfer_tpu.api.topology import (
+    GKE_ACCELERATOR_LABEL,
+    GKE_TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+)
+
+# (type, topology, chips_per_host_override) -> (chips, hosts, chips_per_host)
+KNOWN_SHAPES = [
+    ("v5e", "1x1", None, 1, 1, 1),
+    ("v5e", "2x2", None, 4, 1, 4),
+    ("v5e", "2x4", None, 8, 1, 8),  # ct5lp-hightpu-8t single host
+    ("v5e", "2x4", 4, 8, 2, 4),  # ct5lp-hightpu-4t two hosts
+    ("v5e", "4x4", None, 16, 4, 4),
+    ("v5e", "4x8", None, 32, 8, 4),
+    ("v5e", "8x8", None, 64, 16, 4),
+    ("v5e", "8x16", None, 128, 32, 4),
+    ("v5e", "16x16", None, 256, 64, 4),
+    ("v6e", "2x2", None, 4, 1, 4),
+    ("v6e", "4x4", None, 16, 4, 4),
+    ("v4", "2x2x1", None, 4, 1, 4),
+    ("v4", "2x2x2", None, 8, 2, 4),
+    ("v4", "2x2x4", None, 16, 4, 4),
+    ("v5p", "2x2x1", None, 4, 1, 4),
+    ("v5p", "2x4x4", None, 32, 8, 4),
+]
+
+
+@pytest.mark.parametrize("atype,topo,override,chips,hosts,cph", KNOWN_SHAPES)
+def test_known_slice_shapes(atype, topo, override, chips, hosts, cph):
+    s = resolve_slice(atype, topo, override)
+    assert (s.chips, s.hosts, s.chips_per_host) == (chips, hosts, cph)
+
+
+def test_normalizes_type_spellings():
+    for spelling in ("v5e", "tpu-v5e", "TPU v5e", "tpu v5e"):
+        assert resolve_slice(spelling, "4x4").accelerator_type == "v5e"
+
+
+def test_gke_rendering():
+    s = resolve_slice("v5e", "4x4")
+    assert s.node_selector() == {
+        GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+        GKE_TOPOLOGY_LABEL: "4x4",
+    }
+    assert s.pod_tpu_limits() == {TPU_RESOURCE: "4"}
+
+
+def test_rejects_bad_shapes():
+    with pytest.raises(TopologyError):
+        resolve_slice("v9z", "4x4")  # unknown generation
+    with pytest.raises(TopologyError):
+        resolve_slice("v5e", "4x4x4")  # v5e is 2-D
+    with pytest.raises(TopologyError):
+        resolve_slice("v4", "4x4")  # v4 is 3-D
+    with pytest.raises(TopologyError):
+        resolve_slice("v5e", "axb")
+    with pytest.raises(TopologyError):
+        resolve_slice("v5e", "0x4")
+    with pytest.raises(TopologyError):
+        resolve_slice("v5e", "4x4", chips_per_host=3)  # 16 % 3 != 0
+
+
+def test_slice_shape_is_value_type():
+    assert resolve_slice("v5e", "4x4") == SliceShape("v5e", "4x4", 16, 4, 4)

@@ -1,0 +1,188 @@
+"""LWS builder tests asserting exact rendered specs, mirroring the
+reference's posture (``pkg/workload/lws_test.go``: size, gang annotations,
+scheduler name, leader/worker wrapping down to the shell string)."""
+
+import pytest
+
+from fusioninfer_tpu.api.types import ComponentType, EngineKind, Role, TPUSlice, Multinode
+from fusioninfer_tpu.utils.hash import SPEC_HASH_LABEL
+from fusioninfer_tpu.workload import (
+    JAX_COORDINATOR_PORT,
+    RAY_PORT,
+    LWSConfig,
+    build_lws,
+    generate_lws_name,
+    is_multi_host,
+)
+
+
+def make_role(**over) -> Role:
+    defaults = dict(
+        name="worker",
+        component_type=ComponentType.WORKER,
+        replicas=1,
+        engine=EngineKind.VLLM_TPU,
+        template={
+            "metadata": {"labels": {"user": "kept"}},
+            "spec": {
+                "containers": [
+                    {"name": "engine", "image": "vllm-tpu:v1", "args": ["serve", "Qwen/Qwen3-8B"]}
+                ]
+            },
+        },
+    )
+    defaults.update(over)
+    return Role(**defaults)
+
+
+CFG = LWSConfig(service_name="svc", namespace="ml", replica_index=0)
+
+
+def engine_container(lws, which="workerTemplate"):
+    return lws["spec"]["leaderWorkerTemplate"][which]["spec"]["containers"][0]
+
+
+class TestSingleHost:
+    def test_basic_shape(self):
+        lws = build_lws(make_role(tpu=TPUSlice(type="v5e", topology="2x2")), CFG)
+        assert lws["metadata"]["name"] == "svc-worker-0"
+        assert lws["metadata"]["namespace"] == "ml"
+        assert lws["spec"]["replicas"] == 1
+        lwt = lws["spec"]["leaderWorkerTemplate"]
+        assert lwt["size"] == 1
+        assert "leaderTemplate" not in lwt  # single host: no wrap, one template
+        # container untouched except TPU limits
+        c = engine_container(lws)
+        assert c["args"] == ["serve", "Qwen/Qwen3-8B"]
+        assert "command" not in c
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+        sel = lwt["workerTemplate"]["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+
+    def test_labels_and_hash(self):
+        lws = build_lws(make_role(tpu=TPUSlice(type="v5e", topology="1x1")), CFG)
+        labels = lws["metadata"]["labels"]
+        assert labels["fusioninfer.io/service"] == "svc"
+        assert labels["fusioninfer.io/component-type"] == "worker"
+        assert labels["fusioninfer.io/role-name"] == "worker"
+        assert labels["fusioninfer.io/replica-index"] == "0"
+        assert labels[SPEC_HASH_LABEL]
+        pod_labels = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["metadata"]["labels"]
+        assert pod_labels["user"] == "kept"  # user template labels preserved
+        assert pod_labels["fusioninfer.io/service"] == "svc"
+
+    def test_no_tpu_block_is_plain_pod(self):
+        lws = build_lws(make_role(), CFG)
+        spec = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]
+        assert "nodeSelector" not in spec
+        assert "resources" not in spec["containers"][0]
+
+
+class TestMultiHostRay:
+    def test_leader_wrap_exact_shell(self):
+        role = make_role(tpu=TPUSlice(type="v5e", topology="4x4"))  # 4 hosts
+        lws = build_lws(role, CFG)
+        lwt = lws["spec"]["leaderWorkerTemplate"]
+        assert lwt["size"] == 4
+        leader = engine_container(lws, "leaderTemplate")
+        assert leader["command"] == ["/bin/sh", "-c"]
+        assert leader["args"] == [
+            "ray start --head --port=6379 && vllm serve Qwen/Qwen3-8B "
+            "--distributed-executor-backend ray"
+        ]
+        assert {"name": "ray-head", "containerPort": RAY_PORT, "protocol": "TCP"} in leader["ports"]
+        assert leader["readinessProbe"]["tcpSocket"]["port"] == RAY_PORT
+        worker = engine_container(lws, "workerTemplate")
+        assert worker["args"] == ['ray start --address="$LWS_LEADER_ADDRESS:6379" --block']
+
+    def test_executor_flag_not_duplicated(self):
+        role = make_role(
+            tpu=TPUSlice(type="v5e", topology="4x4"),
+            template={
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "engine",
+                            "image": "vllm-tpu:v1",
+                            "command": ["vllm", "serve", "m", "--distributed-executor-backend", "ray"],
+                        }
+                    ]
+                }
+            },
+        )
+        leader = engine_container(build_lws(role, CFG), "leaderTemplate")
+        assert leader["args"][0].count("--distributed-executor-backend") == 1
+
+    def test_tpu_rendering_on_both_templates(self):
+        role = make_role(tpu=TPUSlice(type="v5p", topology="2x4x4"))  # 32 chips, 8 hosts
+        lws = build_lws(role, CFG)
+        for which in ("leaderTemplate", "workerTemplate"):
+            spec = lws["spec"]["leaderWorkerTemplate"][which]["spec"]
+            assert spec["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+            assert spec["containers"][0]["resources"]["limits"]["google.com/tpu"] == "4"
+        assert lws["spec"]["leaderWorkerTemplate"]["size"] == 8
+
+
+class TestMultiHostJax:
+    def test_native_engine_env_contract(self):
+        role = make_role(engine=EngineKind.NATIVE, tpu=TPUSlice(type="v5e", topology="4x8"))  # 8 hosts
+        lws = build_lws(role, CFG)
+        leader = engine_container(lws, "leaderTemplate")
+        worker = engine_container(lws, "workerTemplate")
+        # same command everywhere — no shell wrap
+        assert "command" not in leader and leader["args"] == ["serve", "Qwen/Qwen3-8B"]
+        env = {e["name"]: e for e in leader["env"]}
+        # engines compose "{LWS_LEADER_ADDRESS}:{FUSIONINFER_COORDINATOR_PORT}"
+        # at runtime; $(VAR) expansion would be order-dependent in k8s.
+        assert env["FUSIONINFER_COORDINATOR_PORT"]["value"] == str(JAX_COORDINATOR_PORT)
+        assert env["JAX_NUM_PROCESSES"]["value"] == "8"
+        assert (
+            env["JAX_PROCESS_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+            == "metadata.labels['leaderworkerset.sigs.k8s.io/worker-index']"
+        )
+        assert leader["readinessProbe"]["tcpSocket"]["port"] == JAX_COORDINATOR_PORT
+        assert worker["env"] == leader["env"]
+        assert "readinessProbe" not in worker
+
+    def test_custom_engine_never_wrapped(self):
+        role = make_role(engine=EngineKind.CUSTOM, multinode=Multinode(node_count=4))
+        lws = build_lws(role, CFG)
+        lwt = lws["spec"]["leaderWorkerTemplate"]
+        assert lwt["size"] == 4
+        assert "leaderTemplate" not in lwt
+        c = engine_container(lws)
+        assert "env" not in c and "command" not in c
+
+
+class TestGang:
+    def test_gang_annotations_and_scheduler(self):
+        cfg = LWSConfig(
+            service_name="svc", namespace="ml", replica_index=1,
+            gang=True, podgroup_name="svc", task_name="worker-1",
+        )
+        role = make_role(tpu=TPUSlice(type="v5e", topology="4x4"))
+        lws = build_lws(role, cfg)
+        for which in ("leaderTemplate", "workerTemplate"):
+            tpl = lws["spec"]["leaderWorkerTemplate"][which]
+            assert tpl["spec"]["schedulerName"] == "volcano"
+            ann = tpl["metadata"]["annotations"]
+            assert ann["scheduling.k8s.io/group-name"] == "svc"
+            assert ann["volcano.sh/task-spec"] == "worker-1"
+
+
+def test_name_generation_and_multihost_predicate():
+    assert generate_lws_name("svc", "decoder", 3) == "svc-decoder-3"
+    assert len(generate_lws_name("s" * 80, "decoder", 3)) <= 63
+    assert not is_multi_host(make_role())
+    assert not is_multi_host(make_role(tpu=TPUSlice(type="v5e", topology="2x4")))  # 1 host (8t)
+    assert is_multi_host(make_role(tpu=TPUSlice(type="v5e", topology="2x4", chips_per_host=4)))
+
+
+def test_build_is_deterministic_and_input_preserving():
+    role = make_role(tpu=TPUSlice(type="v5e", topology="4x4"))
+    before = {k: v for k, v in role.template.items()}
+    a = build_lws(role, CFG)
+    b = build_lws(role, CFG)
+    assert a == b
+    assert role.template == before  # builder must not mutate the user template
