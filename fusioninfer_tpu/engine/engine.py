@@ -1,0 +1,348 @@
+"""Continuous-batching inference engine.
+
+The execution core the OpenAI server wraps: admits requests into a
+running batch (one paged prefill each), then advances every running
+sequence one token per :meth:`NativeEngine.step` with a single batched
+``decode_step`` — vLLM-style continuous batching expressed the XLA way:
+every compiled signature is static ``(bucket, max_batch)``; membership of
+the batch changes purely through data (page tables, active mask).
+
+Capacity pressure is handled by preempting the youngest running sequence
+(pages released, request re-queued for a fresh prefill) so the oldest
+work always completes.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fusioninfer_tpu.engine.kv_cache import (
+    CacheConfig,
+    PageAllocator,
+    init_kv_cache,
+    kv_cache_bytes,
+)
+from fusioninfer_tpu.engine.model_runner import (
+    decode_step,
+    pick_bucket,
+    prefill,
+    prefill_buckets,
+)
+from fusioninfer_tpu.engine.sampler import SamplingParams, sample
+from fusioninfer_tpu.models.config import ModelConfig
+from fusioninfer_tpu.models.transformer import init_params
+
+logger = logging.getLogger("fusioninfer.engine")
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt_tokens: list[int]
+    params: SamplingParams = field(default_factory=SamplingParams)
+    arrival_time: float = field(default_factory=time.monotonic)
+    # Set on preemption: prompt + tokens generated so far.  On re-admission
+    # the whole prefix is re-prefilled so generation continues exactly where
+    # the client stream left off (no token splicing, RNG-safe).
+    resume_tokens: Optional[list[int]] = None
+
+
+@dataclass
+class StepOutput:
+    request_id: str
+    token: int
+    finished: bool
+    finish_reason: Optional[str] = None
+    is_first_token: bool = False
+
+
+@dataclass
+class _SeqState:
+    request: Request
+    tokens: list[int]  # prompt + generated
+    n_prompt: int
+    slot: int  # batch slot
+    first_token_time: Optional[float] = None
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.tokens) - self.n_prompt
+
+
+class NativeEngine:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        cache_cfg: Optional[CacheConfig] = None,
+        max_batch_size: int = 8,
+        params=None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg.validate()
+        self.cache_cfg = cache_cfg or CacheConfig()
+        self.max_batch_size = max_batch_size
+        if params is None:
+            logger.info("initializing random weights for %s", cfg.name)
+            params = init_params(cfg, jax.random.key(seed))
+        self.params = params
+        self.cache = init_kv_cache(cfg, self.cache_cfg)
+        self.alloc = PageAllocator(self.cache_cfg)
+        self.buckets = prefill_buckets(self.cache_cfg.max_len)
+        self._key = jax.random.key(seed + 1)
+        self._step_counter = itertools.count()
+
+        self.waiting: collections.deque[Request] = collections.deque()
+        self.running: dict[int, _SeqState] = {}  # slot -> state
+        self._free_slots = list(reversed(range(max_batch_size)))
+        self._lock = threading.Lock()
+
+        # counters consumed by /metrics
+        self.prompt_tokens_total = 0
+        self.generation_tokens_total = 0
+        self.preemptions_total = 0
+        self.finished_total = 0
+        self.errors_total = 0
+
+    # -- public API ----------------------------------------------------------
+
+    def add_request(self, request: Request) -> None:
+        if request.params.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        if not request.prompt_tokens:
+            raise ValueError("prompt must not be empty")
+        if len(request.prompt_tokens) + request.params.max_tokens > self.cache_cfg.max_len:
+            raise ValueError(
+                f"prompt+max_tokens exceeds engine max_len {self.cache_cfg.max_len}"
+            )
+        with self._lock:
+            self.waiting.append(request)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def kv_cache_usage(self) -> float:
+        return self.alloc.utilization()
+
+    def step(self) -> list[StepOutput]:
+        """Admit + prefill new work, then one batched decode pass."""
+        outputs: list[StepOutput] = []
+        outputs += self._admit()
+        outputs += self._decode()
+        return [o for o in outputs if o is not None]
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _admit(self) -> list[StepOutput]:
+        """Admit waiting requests FCFS while slots and pages allow.
+
+        Pages are allocated lazily (prompt + first token only); generation
+        growth is handled at decode time, where the youngest sequence is
+        preempted when the cache fills.  Admission never preempts — a newer
+        request must not evict older running work.
+        """
+        outputs = []
+        while self.waiting and self._free_slots:
+            request = self.waiting[0]
+            prefix = request.resume_tokens or request.prompt_tokens
+            if not self.alloc.can_allocate(len(prefix) + 1):
+                break  # wait for running work to finish or be preempted
+            self.waiting.popleft()
+            try:
+                outputs.append(self._prefill_request(request))
+            except Exception as e:
+                # never lose a popped request silently: fail it to the client
+                logger.exception("prefill of %s failed", request.request_id)
+                self.alloc.release(request.request_id)
+                self.errors_total += 1
+                outputs.append(
+                    StepOutput(
+                        request_id=request.request_id,
+                        token=0,
+                        finished=True,
+                        finish_reason=f"error:{e}",
+                    )
+                )
+        return outputs
+
+    def _preempt_youngest(self, exclude_slot: int) -> bool:
+        """Release the youngest running sequence (≠ exclude) back to waiting."""
+        candidates = [s for s in self.running if s != exclude_slot]
+        if not candidates:
+            return False
+        slot = max(candidates, key=lambda s: self.running[s].request.arrival_time)
+        state = self.running.pop(slot)
+        self.alloc.release(state.request.request_id)
+        self._free_slots.append(slot)
+        self.preemptions_total += 1
+        # resume later by re-prefilling the full prefix (prompt + generated):
+        # the client's stream continues seamlessly from the same tokens
+        state.request.resume_tokens = list(state.tokens)
+        self.waiting.appendleft(state.request)
+        logger.info("preempted %s for KV capacity", state.request.request_id)
+        return True
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _prefill_request(self, request: Request) -> Optional[StepOutput]:
+        resumed = request.resume_tokens is not None
+        prefix = request.resume_tokens if resumed else request.prompt_tokens
+        request.resume_tokens = None
+        # lazy: cover the prefix and the first generated token only
+        self.alloc.allocate(request.request_id, len(prefix) + 1)
+        row = jnp.asarray(self.alloc.page_table_row(request.request_id))
+
+        bucket = pick_bucket(self.buckets, len(prefix))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(prefix)] = prefix
+        self.cache, logits = prefill(
+            self.cfg, self.cache_cfg, self.params, self.cache,
+            jnp.asarray(padded), jnp.int32(len(prefix)), row,
+        )
+        token = int(
+            sample(
+                logits,
+                self._next_key(),
+                jnp.asarray([request.params.temperature]),
+                jnp.asarray([request.params.top_k], jnp.int32),
+                jnp.asarray([request.params.top_p]),
+            )[0]
+        )
+        slot = self._free_slots.pop()
+        state = _SeqState(
+            request=request,
+            tokens=list(prefix) + [token],
+            n_prompt=len(request.prompt_tokens),
+            slot=slot,
+            first_token_time=time.monotonic(),
+        )
+        self.running[slot] = state
+        if not resumed:
+            self.prompt_tokens_total += len(prefix)
+        self.generation_tokens_total += 1
+        return self._emit(state, token, first=not resumed)
+
+    # -- decode --------------------------------------------------------------
+
+    def _decode(self) -> list[StepOutput]:
+        failures = self._ensure_decode_capacity()
+        live = {s: st for s, st in self.running.items()
+                if st.n_generated < st.request.params.max_tokens}
+        if not live:
+            return failures
+        B = self.max_batch_size
+        mp = self.cache_cfg.max_pages_per_seq
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        page_tables = np.full((B, mp), self.cache_cfg.trash_page, np.int32)
+        active = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        for slot, st in live.items():
+            tokens[slot] = st.tokens[-1]
+            # the input token was sampled last step but its KV is not yet
+            # written; it lands at index len-1 (cache holds tokens[0..len-2])
+            positions[slot] = len(st.tokens) - 1
+            page_tables[slot] = self.alloc.page_table_row(st.request.request_id)
+            active[slot] = True
+            temps[slot] = st.request.params.temperature
+            top_ks[slot] = st.request.params.top_k
+            top_ps[slot] = st.request.params.top_p
+
+        self.cache, logits = decode_step(
+            self.cfg, self.cache_cfg, self.params, self.cache,
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
+            jnp.asarray(active),
+        )
+        sampled = np.asarray(
+            sample(logits, self._next_key(), jnp.asarray(temps),
+                   jnp.asarray(top_ks), jnp.asarray(top_ps))
+        )
+
+        outputs = list(failures)
+        for slot, st in live.items():
+            token = int(sampled[slot])
+            st.tokens.append(token)
+            self.generation_tokens_total += 1
+            outputs.append(self._emit(st, token))
+        return outputs
+
+    def _ensure_decode_capacity(self) -> list[StepOutput]:
+        """Grow page tables for sequences crossing a page boundary this
+        step; on exhaustion, preempt youngest-first until the oldest
+        sequences can proceed."""
+        failures: list[StepOutput] = []
+        # oldest first so the work closest to completion survives pressure
+        for slot in sorted(self.running, key=lambda s: self.running[s].request.arrival_time):
+            st = self.running.get(slot)
+            if st is None or st.n_generated >= st.request.params.max_tokens:
+                continue
+            while True:
+                try:
+                    # input token occupies index len-1 -> need len tokens covered
+                    self.alloc.extend(st.request.request_id, len(st.tokens) - 1, 1)
+                    break
+                except MemoryError:
+                    if not self._preempt_youngest(exclude_slot=slot):
+                        # nothing to steal: only this sequence runs and the
+                        # cache is truly full — fail it rather than livelock
+                        logger.error("request %s exceeds total KV capacity", st.request.request_id)
+                        self._finish(st, success=False)
+                        failures.append(
+                            StepOutput(
+                                request_id=st.request.request_id,
+                                token=st.tokens[-1],
+                                finished=True,
+                                finish_reason="error:kv_capacity",
+                            )
+                        )
+                        break
+        return failures
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _emit(self, state: _SeqState, token: int, first: bool = False) -> StepOutput:
+        params = state.request.params
+        finish_reason = None
+        if token in params.stop_token_ids:
+            finish_reason = "stop"
+        elif state.n_generated >= params.max_tokens:
+            finish_reason = "length"
+        if finish_reason:
+            self._finish(state)
+        return StepOutput(
+            request_id=state.request.request_id,
+            token=token,
+            finished=finish_reason is not None,
+            finish_reason=finish_reason,
+            is_first_token=first,
+        )
+
+    def _finish(self, state: _SeqState, success: bool = True) -> None:
+        self.running.pop(state.slot, None)
+        self._free_slots.append(state.slot)
+        self.alloc.release(state.request.request_id)
+        if success:
+            self.finished_total += 1
+        else:
+            self.errors_total += 1

@@ -1,0 +1,133 @@
+"""Paged KV cache.
+
+Device-side: two stacked arrays ``[n_layers, n_pages, page_size, n_kv_heads,
+head_dim]`` (k and v).  Pages are the allocation unit; a sequence owns a
+list of pages recorded in a host-side page table.  The last page index is
+reserved as a scratch ("trash") page so padded token positions can write
+somewhere harmless while shapes stay static.
+
+Host-side: a free-list allocator (:class:`PageAllocator`) — allocation is
+a Python-time concern, never traced.  The TPU-facing layout keeps the
+``n_kv_heads`` axis shardable over the mesh ``tp`` axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fusioninfer_tpu.models.config import ModelConfig
+
+
+@dataclass(frozen=True)
+class CacheConfig:
+    n_pages: int = 256  # includes the reserved trash page
+    page_size: int = 128
+    max_pages_per_seq: int = 32
+
+    @property
+    def trash_page(self) -> int:
+        return self.n_pages - 1
+
+    @property
+    def max_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+
+def init_kv_cache(cfg: ModelConfig, cache_cfg: CacheConfig) -> dict:
+    shape = (
+        cfg.n_layers,
+        cache_cfg.n_pages,
+        cache_cfg.page_size,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+    )
+    return {
+        "k": jnp.zeros(shape, cfg.jax_dtype),
+        "v": jnp.zeros(shape, cfg.jax_dtype),
+    }
+
+
+def kv_cache_bytes(cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
+    per = (
+        cfg.n_layers
+        * cache_cfg.n_pages
+        * cache_cfg.page_size
+        * cfg.n_kv_heads
+        * cfg.head_dim
+        * jnp.dtype(cfg.jax_dtype).itemsize
+    )
+    return 2 * per
+
+
+class PageAllocator:
+    """Host-side free list over cache pages (trash page never handed out)."""
+
+    def __init__(self, cache_cfg: CacheConfig):
+        self.cache_cfg = cache_cfg
+        self._free: list[int] = list(range(cache_cfg.n_pages - 1))
+        self._owned: dict[str, list[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.cache_cfg.n_pages - 1) - len(self._free)
+
+    def utilization(self) -> float:
+        total = self.cache_cfg.n_pages - 1
+        return 0.0 if total == 0 else self.used_pages / total
+
+    def pages_needed(self, n_tokens: int) -> int:
+        ps = self.cache_cfg.page_size
+        return max(1, -(-n_tokens // ps))
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        need = self.pages_needed(n_tokens)
+        return need <= len(self._free) and need <= self.cache_cfg.max_pages_per_seq
+
+    def allocate(self, seq_id: str, n_tokens: int) -> list[int]:
+        need = self.pages_needed(n_tokens)
+        if need > len(self._free):
+            raise MemoryError(f"KV cache exhausted: need {need} pages, have {len(self._free)}")
+        if need > self.cache_cfg.max_pages_per_seq:
+            raise MemoryError(
+                f"sequence of {n_tokens} tokens exceeds max_pages_per_seq={self.cache_cfg.max_pages_per_seq}"
+            )
+        pages = [self._free.pop() for _ in range(need)]
+        self._owned.setdefault(seq_id, []).extend(pages)
+        return pages
+
+    def extend(self, seq_id: str, current_tokens: int, new_tokens: int) -> list[int]:
+        """Grow a sequence's page list to cover ``current + new`` tokens."""
+        have = len(self._owned.get(seq_id, []))
+        need_total = self.pages_needed(current_tokens + new_tokens)
+        if need_total > self.cache_cfg.max_pages_per_seq:
+            raise MemoryError("sequence exceeds max_pages_per_seq")
+        extra = need_total - have
+        if extra <= 0:
+            return []
+        if extra > len(self._free):
+            raise MemoryError("KV cache exhausted on extend")
+        pages = [self._free.pop() for _ in range(extra)]
+        self._owned[seq_id].extend(pages)
+        return pages
+
+    def pages_of(self, seq_id: str) -> list[int]:
+        return list(self._owned.get(seq_id, []))
+
+    def release(self, seq_id: str) -> None:
+        pages = self._owned.pop(seq_id, [])
+        self._free.extend(pages)
+
+    def page_table_row(self, seq_id: str) -> np.ndarray:
+        """Fixed-width page table row, trash-padded."""
+        row = np.full(self.cache_cfg.max_pages_per_seq, self.cache_cfg.trash_page, np.int32)
+        pages = self._owned.get(seq_id, [])
+        row[: len(pages)] = pages
+        return row

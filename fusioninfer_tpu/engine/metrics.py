@@ -1,0 +1,93 @@
+"""Prometheus text-format metrics, vLLM-compatible names.
+
+The EPP's scorers (prefix-cache / kv-cache-utilization / queue-size,
+``fusioninfer_tpu.router.strategy``) scrape model servers expecting vLLM
+metric names; the native engine exports the same family so it is a
+drop-in routing target.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Histogram:
+    buckets: tuple[float, ...]
+    counts: list[int] = field(default_factory=list)
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self):
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, value: float) -> None:
+        self.total += value
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str, labels: str) -> list[str]:
+        out = []
+        cumulative = 0
+        for b, c in zip(self.buckets, self.counts):
+            cumulative += c
+            out.append(f'{name}_bucket{{{labels},le="{b}"}} {cumulative}')
+        cumulative += self.counts[-1]
+        out.append(f'{name}_bucket{{{labels},le="+Inf"}} {cumulative}')
+        out.append(f"{name}_sum{{{labels}}} {self.total}")
+        out.append(f"{name}_count{{{labels}}} {self.n}")
+        return out
+
+
+TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+
+
+class EngineMetrics:
+    def __init__(self, model_name: str):
+        self.model_name = model_name
+        self.start_time = time.monotonic()
+        self.ttft = Histogram(TTFT_BUCKETS)
+        self.tpot = Histogram(TPOT_BUCKETS)
+        self.e2e_latency = Histogram((0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+
+    def render(self, engine) -> str:
+        """Text exposition from live engine state + accumulated histograms."""
+        labels = f'model_name="{self.model_name}"'
+        lines = [
+            "# HELP vllm:num_requests_running Number of requests currently running.",
+            "# TYPE vllm:num_requests_running gauge",
+            f"vllm:num_requests_running{{{labels}}} {engine.num_running}",
+            "# HELP vllm:num_requests_waiting Number of requests waiting to be processed.",
+            "# TYPE vllm:num_requests_waiting gauge",
+            f"vllm:num_requests_waiting{{{labels}}} {engine.num_waiting}",
+            "# HELP vllm:gpu_cache_usage_perc KV-cache usage (1 = full).",
+            "# TYPE vllm:gpu_cache_usage_perc gauge",
+            f"vllm:gpu_cache_usage_perc{{{labels}}} {engine.kv_cache_usage():.6f}",
+            "# HELP vllm:kv_cache_usage_perc KV-cache usage (1 = full).",
+            "# TYPE vllm:kv_cache_usage_perc gauge",
+            f"vllm:kv_cache_usage_perc{{{labels}}} {engine.kv_cache_usage():.6f}",
+            "# TYPE vllm:prompt_tokens_total counter",
+            f"vllm:prompt_tokens_total{{{labels}}} {engine.prompt_tokens_total}",
+            "# TYPE vllm:generation_tokens_total counter",
+            f"vllm:generation_tokens_total{{{labels}}} {engine.generation_tokens_total}",
+            "# TYPE vllm:num_preemptions_total counter",
+            f"vllm:num_preemptions_total{{{labels}}} {engine.preemptions_total}",
+            "# TYPE vllm:request_success_total counter",
+            f"vllm:request_success_total{{{labels}}} {engine.finished_total}",
+            "# TYPE vllm:request_failure_total counter",
+            f"vllm:request_failure_total{{{labels}}} {engine.errors_total}",
+            "# TYPE vllm:time_to_first_token_seconds histogram",
+            *self.ttft.render("vllm:time_to_first_token_seconds", labels),
+            "# TYPE vllm:time_per_output_token_seconds histogram",
+            *self.tpot.render("vllm:time_per_output_token_seconds", labels),
+            "# TYPE vllm:e2e_request_latency_seconds histogram",
+            *self.e2e_latency.render("vllm:e2e_request_latency_seconds", labels),
+        ]
+        return "\n".join(lines) + "\n"

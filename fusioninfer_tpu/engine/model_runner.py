@@ -1,0 +1,168 @@
+"""KV-cache-aware prefill / decode execution.
+
+Two jitted entry points with fully static shapes (XLA compiles each
+(bucket, batch) signature once and caches it):
+
+* :func:`prefill` — one sequence, prompt padded to a bucket length; runs
+  the causal forward while scattering fresh K/V into the sequence's cache
+  pages; returns logits at the last real token.
+* :func:`decode_step` — the continuous-batching hot loop: B sequences ×
+  one token; writes each token's K/V into its page slot, gathers each
+  sequence's pages, attends, returns next-token logits for the whole
+  batch.
+
+The gather-based paged attention here is the portable baseline;
+:mod:`fusioninfer_tpu.ops.paged_attention` provides the Pallas TPU kernel
+that reads pages in place.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.models.config import ModelConfig
+from fusioninfer_tpu.models.transformer import (
+    apply_rope,
+    causal_mask,
+    layer_forward,
+    lm_head,
+    moe_ffn,
+    rms_norm,
+    swiglu,
+)
+
+
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+def prefill(
+    cfg: ModelConfig,
+    cache_cfg: CacheConfig,
+    params,
+    cache: dict,
+    tokens: jax.Array,  # [1, S] padded to bucket
+    true_len: jax.Array,  # scalar int32
+    page_row: jax.Array,  # [max_pages_per_seq]
+):
+    """Prefill one sequence; returns (cache, last-token logits [1, V])."""
+    B, S = tokens.shape
+    ps = cache_cfg.page_size
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    mask = causal_mask(S)
+
+    token_idx = jnp.arange(S)
+    # Padded positions (>= true_len) write to the trash page.
+    page_of_token = jnp.where(
+        token_idx < true_len, page_row[token_idx // ps], cache_cfg.trash_page
+    )
+    slot_of_token = token_idx % ps
+
+    def body(x, inputs):
+        layer, k_cache_l, v_cache_l = inputs
+        out, (k, v) = layer_forward(cfg, layer, x, positions, mask)
+        k_cache_l = k_cache_l.at[page_of_token, slot_of_token].set(k[0])
+        v_cache_l = v_cache_l.at[page_of_token, slot_of_token].set(v[0])
+        return out, (k_cache_l, v_cache_l)
+
+    x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]  # [B, D]
+    return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
+
+
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+def decode_step(
+    cfg: ModelConfig,
+    cache_cfg: CacheConfig,
+    params,
+    cache: dict,
+    tokens: jax.Array,  # [B] current input token per sequence
+    positions: jax.Array,  # [B] index the token lands at (== tokens so far)
+    page_tables: jax.Array,  # [B, max_pages_per_seq]
+    active: jax.Array,  # [B] bool
+):
+    """One decode step for the whole running batch → (cache, logits [B, V])."""
+    B = tokens.shape[0]
+    ps = cache_cfg.page_size
+    mp = page_tables.shape[1]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+    pos = positions[:, None]  # [B, 1]
+
+    write_page = jnp.where(
+        active, page_tables[jnp.arange(B), positions // ps], cache_cfg.trash_page
+    )
+    write_slot = positions % ps
+
+    # attention mask over the gathered [mp * ps] context
+    ctx_idx = jnp.arange(mp * ps)[None, :]  # [1, T]
+    attend = ctx_idx <= positions[:, None]  # [B, T] (new token included)
+    attend = attend[:, None, None, :]  # [B, 1, 1, T]
+
+    def body(x, inputs):
+        layer, k_cache_l, v_cache_l = inputs
+        B_, S_, D_ = x.shape
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (h @ layer["wq"]).reshape(B_, 1, H, Hd)
+        k = (h @ layer["wk"]).reshape(B_, 1, KV, Hd)
+        v = (h @ layer["wv"]).reshape(B_, 1, KV, Hd)
+        if cfg.qk_norm:
+            q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
+            k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
+        q = apply_rope(q, pos, cfg.rope_theta)
+        k = apply_rope(k, pos, cfg.rope_theta)
+
+        # write this step's K/V into each sequence's page slot
+        k_cache_l = k_cache_l.at[write_page, write_slot].set(k[:, 0])
+        v_cache_l = v_cache_l.at[write_page, write_slot].set(v[:, 0])
+
+        # gather each sequence's context pages: [B, mp, ps, KV, Hd] -> [B, T, KV, Hd]
+        k_ctx = k_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)
+        v_ctx = v_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)
+
+        group = H // KV
+        qg = q.reshape(B_, 1, KV, group, Hd)
+        scores = jnp.einsum("bskgd,btkd->bkgst", qg, k_ctx).astype(jnp.float32) / jnp.sqrt(Hd)
+        scores = jnp.where(attend[:, :, None, :, :] * jnp.ones_like(scores, bool), scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
+        attn = jnp.einsum("bkgst,btkd->bskgd", probs, v_ctx).reshape(B_, 1, H * Hd)
+        x = x + attn @ layer["wo"]
+
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        if cfg.is_moe:
+            ff = moe_ffn(
+                h.reshape(B_, D_), layer["router"], layer["w_gate"], layer["w_up"],
+                layer["w_down"], cfg.n_experts_active,
+            ).reshape(B_, 1, D_)
+        else:
+            ff = swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"])
+        return x + ff, (k_cache_l, v_cache_l)
+
+    x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = lm_head(cfg, params, x[:, 0])
+    return {"k": k_cache, "v": v_cache}, logits
+
+
+def prefill_buckets(max_len: int, smallest: int = 32) -> list[int]:
+    """Power-of-two padding buckets: each prompt compiles against the
+    smallest bucket that holds it, bounding compile count to log2(max)."""
+    out = []
+    b = smallest
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return out
+
+
+def pick_bucket(buckets: list[int], n: int) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt of {n} tokens exceeds max bucket {buckets[-1]}")

@@ -1,0 +1,61 @@
+"""Token sampling: greedy, temperature, top-k, top-p.
+
+Batched and jittable; each sequence carries its own sampling params so one
+compiled sampler serves a heterogeneous continuous batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0
+    max_tokens: int = 128
+    stop_token_ids: tuple[int, ...] = ()
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+@jax.jit
+def sample(
+    logits: jax.Array,  # [B, V] float32
+    key: jax.Array,
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32, 0 = off
+    top_p: jax.Array,  # [B]
+) -> jax.Array:
+    """Sample one token per row; temperature <= 0 means greedy."""
+    B, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / t
+
+    # top-k: mask logits below the k-th largest (per row)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, V) - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p (nucleus): keep the smallest prefix of sorted probs covering p
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # token allowed if the cumulative mass *before* it is < top_p
+    cutoff_mask = (cumulative - sorted_probs) < top_p[:, None]
+    threshold = jnp.where(
+        cutoff_mask, sorted_logits, jnp.inf
+    ).min(axis=-1, keepdims=True)
+    scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)

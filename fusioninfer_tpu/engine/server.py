@@ -1,0 +1,404 @@
+"""OpenAI-compatible HTTP server for the native engine.
+
+Stdlib-only (ThreadingHTTPServer): ``/v1/completions``,
+``/v1/chat/completions`` (blocking and SSE streaming), ``/v1/models``,
+``/health``, and Prometheus ``/metrics`` with vLLM-compatible names so
+the EPP can score this server exactly like a vLLM-TPU pod.
+
+A single background thread drives :meth:`NativeEngine.step` — the engine
+owns the TPU; HTTP threads only enqueue requests and wait on per-request
+queues.  Multi-host slices initialize ``jax.distributed`` from the
+LWS-injected env contract rendered by the operator's JAX-coordinator
+bootstrap (``fusioninfer_tpu.workload.bootstrap``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.metrics import EngineMetrics
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.engine.tokenizer import load_tokenizer
+from fusioninfer_tpu.models.config import get_preset
+
+logger = logging.getLogger("fusioninfer.server")
+
+
+def maybe_init_distributed() -> None:
+    """Join the slice's JAX coordinator when launched by the operator.
+
+    Composes the coordinator address from ``LWS_LEADER_ADDRESS`` +
+    ``FUSIONINFER_COORDINATOR_PORT`` at runtime (order-independent,
+    unlike k8s $(VAR) env expansion).
+    """
+    leader = os.environ.get("LWS_LEADER_ADDRESS")
+    n_proc = os.environ.get("JAX_NUM_PROCESSES")
+    if not leader or not n_proc or int(n_proc) <= 1:
+        return
+    import jax
+
+    port = os.environ.get("FUSIONINFER_COORDINATOR_PORT", "8476")
+    process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=f"{leader}:{port}",
+        num_processes=int(n_proc),
+        process_id=process_id,
+    )
+    logger.info("joined JAX coordinator %s:%s as process %d/%s", leader, port, process_id, n_proc)
+
+
+class _RequestChannel:
+    """Blocking bridge from engine thread to an HTTP handler thread."""
+
+    def __init__(self):
+        self.q: queue.Queue = queue.Queue()
+
+    def put(self, item) -> None:
+        self.q.put(item)
+
+    def stream(self):
+        while True:
+            item = self.q.get()
+            yield item
+            if item is None or item.finished:
+                return
+
+
+class EngineServer:
+    def __init__(
+        self,
+        model: str = "qwen3-tiny",
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        max_batch_size: int = 8,
+        cache_cfg: CacheConfig | None = None,
+        tokenizer=None,
+        engine: NativeEngine | None = None,
+        seed: int = 0,
+    ):
+        self.model_name = model
+        cfg = get_preset(model)
+        self.engine = engine or NativeEngine(
+            cfg, cache_cfg=cache_cfg, max_batch_size=max_batch_size, seed=seed
+        )
+        self.tokenizer = tokenizer or load_tokenizer()
+        self.metrics = EngineMetrics(model)
+        self.host, self.port = host, port
+        self._channels: dict[str, _RequestChannel] = {}
+        self._req_meta: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._httpd: ThreadingHTTPServer | None = None
+        self._engine_thread: threading.Thread | None = None
+
+    # -- engine loop ---------------------------------------------------------
+
+    def _engine_loop(self) -> None:
+        idle_sleep = 0.002
+        while not self._stop.is_set():
+            if not self.engine.has_work():
+                time.sleep(idle_sleep)
+                continue
+            try:
+                outputs = self.engine.step()
+            except Exception:
+                logger.exception("engine step failed")
+                time.sleep(0.05)
+                continue
+            now = time.monotonic()
+            for out in outputs:
+                with self._lock:
+                    chan = self._channels.get(out.request_id)
+                    meta = self._req_meta.get(out.request_id)
+                if meta is not None:
+                    if out.is_first_token:
+                        self.metrics.ttft.observe(now - meta["arrival"])
+                    else:
+                        self.metrics.tpot.observe(now - meta["last_token_time"])
+                    meta["last_token_time"] = now
+                    if out.finished:
+                        self.metrics.e2e_latency.observe(now - meta["arrival"])
+                if chan is not None:
+                    chan.put(out)
+
+    # -- request handling ----------------------------------------------------
+
+    def submit(self, prompt_tokens: list[int], params: SamplingParams) -> _RequestChannel:
+        request_id = uuid.uuid4().hex[:16]
+        chan = _RequestChannel()
+        with self._lock:
+            self._channels[request_id] = chan
+            self._req_meta[request_id] = {
+                "arrival": time.monotonic(),
+                "last_token_time": time.monotonic(),
+            }
+        try:
+            self.engine.add_request(Request(request_id, prompt_tokens, params))
+        except Exception:
+            # rejected before entering the engine: unregister or the
+            # channel/meta entries leak on every bad request
+            with self._lock:
+                self._channels.pop(request_id, None)
+                self._req_meta.pop(request_id, None)
+            raise
+        return chan
+
+    def _release(self, chan: _RequestChannel) -> None:
+        with self._lock:
+            for rid, c in list(self._channels.items()):
+                if c is chan:
+                    del self._channels[rid]
+                    self._req_meta.pop(rid, None)
+
+    def _sampling_params(self, body: dict) -> SamplingParams:
+        stop_ids = [self.tokenizer.eos_token_id]
+        return SamplingParams(
+            temperature=float(body.get("temperature", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            max_tokens=int(body.get("max_tokens", 128)),
+            stop_token_ids=tuple(stop_ids),
+        )
+
+    def stream_completion(self, body: dict, chat: bool = False):
+        """SSE generator: yields OpenAI-style chunk dicts, then None."""
+        if chat:
+            messages = body.get("messages", [])
+            prompt = "".join(
+                f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
+            ) + "<|assistant|>"
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+        params = self._sampling_params(body)
+        prompt_tokens = self.tokenizer.encode(prompt)
+        chan = self.submit(prompt_tokens, params)
+        completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
+        created = int(time.time())
+        tokens: list[int] = []
+        emitted_text = ""
+        try:
+            for out in chan.stream():
+                if not (out.finished and out.finish_reason == "stop"
+                        and out.token == self.tokenizer.eos_token_id):
+                    tokens.append(out.token)
+                full = self.tokenizer.decode(tokens)
+                delta, emitted_text = full[len(emitted_text):], full
+                finish = (out.finish_reason or "length") if out.finished else None
+                if chat:
+                    choice = {"index": 0, "delta": {"content": delta}, "finish_reason": finish}
+                    obj = "chat.completion.chunk"
+                else:
+                    choice = {"index": 0, "text": delta, "finish_reason": finish}
+                    obj = "text_completion"
+                yield {
+                    "id": completion_id,
+                    "object": obj,
+                    "created": created,
+                    "model": self.model_name,
+                    "choices": [choice],
+                }
+                if out.finished:
+                    break
+        finally:
+            self._release(chan)
+        yield None  # sentinel: emit data: [DONE]
+
+    def handle_completion(self, body: dict) -> dict:
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        params = self._sampling_params(body)
+        prompt_tokens = self.tokenizer.encode(prompt)
+        chan = self.submit(prompt_tokens, params)
+        tokens, finish_reason = [], "length"
+        try:
+            for out in chan.stream():
+                tokens.append(out.token)
+                if out.finished:
+                    finish_reason = out.finish_reason or "length"
+        finally:
+            self._release(chan)
+        if finish_reason == "stop" and tokens and tokens[-1] == self.tokenizer.eos_token_id:
+            tokens = tokens[:-1]
+        text = self.tokenizer.decode(tokens)
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:12]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [
+                {"index": 0, "text": text, "finish_reason": finish_reason, "logprobs": None}
+            ],
+            "usage": {
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": len(tokens),
+                "total_tokens": len(prompt_tokens) + len(tokens),
+            },
+        }
+
+    def handle_chat(self, body: dict) -> dict:
+        messages = body.get("messages", [])
+        prompt = "".join(
+            f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
+        ) + "<|assistant|>"
+        completion = self.handle_completion({**body, "prompt": prompt})
+        text = completion["choices"][0]["text"]
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+            "object": "chat.completion",
+            "created": completion["created"],
+            "model": self.model_name,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": completion["choices"][0]["finish_reason"],
+                }
+            ],
+            "usage": completion["usage"],
+        }
+
+    # -- http ----------------------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send_json(self, obj: dict, code: int = 200) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path in ("/health", "/healthz", "/ping"):
+                    self._send_json({"status": "ok"})
+                elif self.path == "/metrics":
+                    data = server.metrics.render(server.engine).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif self.path == "/v1/models":
+                    self._send_json(
+                        {
+                            "object": "list",
+                            "data": [
+                                {
+                                    "id": server.model_name,
+                                    "object": "model",
+                                    "owned_by": "fusioninfer-tpu",
+                                }
+                            ],
+                        }
+                    )
+                else:
+                    self._send_json({"error": {"message": f"not found: {self.path}"}}, 404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self._send_json({"error": {"message": "invalid JSON body"}}, 400)
+                    return
+                try:
+                    if self.path == "/v1/completions":
+                        if body.get("stream"):
+                            self._send_sse(server.stream_completion(body, chat=False))
+                        else:
+                            self._send_json(server.handle_completion(body))
+                    elif self.path == "/v1/chat/completions":
+                        if body.get("stream"):
+                            self._send_sse(server.stream_completion(body, chat=True))
+                        else:
+                            self._send_json(server.handle_chat(body))
+                    else:
+                        self._send_json({"error": {"message": f"not found: {self.path}"}}, 404)
+                except ValueError as e:
+                    self._send_json({"error": {"message": str(e)}}, 400)
+                except Exception as e:
+                    logger.exception("request failed")
+                    self._send_json({"error": {"message": str(e)}}, 500)
+
+            def _send_sse(self, chunks) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(payload: bytes) -> None:
+                    self.wfile.write(f"{len(payload):X}\r\n".encode() + payload + b"\r\n")
+
+                for chunk in chunks:
+                    if chunk is None:
+                        write_chunk(b"data: [DONE]\n\n")
+                    else:
+                        write_chunk(f"data: {json.dumps(chunk)}\n\n".encode())
+                write_chunk(b"")  # chunked EOF
+
+            def log_message(self, *args):
+                pass
+
+        return Handler
+
+    def start(self) -> None:
+        self._engine_thread = threading.Thread(target=self._engine_loop, daemon=True, name="engine")
+        self._engine_thread.start()
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True, name="http").start()
+        logger.info("serving %s on %s:%d", self.model_name, self.host, self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+
+def serve_from_args(args) -> int:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    maybe_init_distributed()
+    pages_per_seq = max(1, -(-args.max_model_len // args.page_size))  # ceil
+    cache_cfg = CacheConfig(
+        n_pages=pages_per_seq * args.max_batch_size + 1,
+        page_size=args.page_size,
+        max_pages_per_seq=pages_per_seq,
+    )
+    server = EngineServer(
+        model=args.model,
+        host=args.host,
+        port=args.port,
+        max_batch_size=args.max_batch_size,
+        cache_cfg=cache_cfg,
+        seed=args.seed,
+    )
+    server.serve_forever()
+    return 0

@@ -1,0 +1,74 @@
+"""Tokenizers for the native engine.
+
+Default is a dependency-free byte-level tokenizer (any vocab ≥ 259 works,
+no downloads — the engine stays servable in air-gapped clusters and
+tests).  When a HuggingFace model name/path is supplied and the
+``transformers`` package can load it locally, that tokenizer is used
+instead.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("fusioninfer.tokenizer")
+
+
+class ByteTokenizer:
+    """Bytes 0-255 mapped to ids 3-258; BOS=1, EOS=2, PAD=0."""
+
+    PAD_ID = 0
+    BOS_ID = 1
+    EOS_ID = 2
+    OFFSET = 3
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + self.OFFSET
+
+    @property
+    def eos_token_id(self) -> int:
+        return self.EOS_ID
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = [b + self.OFFSET for b in text.encode("utf-8")]
+        return ([self.BOS_ID] if add_bos else []) + ids
+
+    def decode(self, ids: list[int]) -> str:
+        # ids beyond the byte range (models usually have vocab > 259) decode
+        # to nothing rather than erroring — generation stays well-defined
+        # under random or mismatched weights
+        data = bytes(i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Thin adapter over a locally-available transformers tokenizer."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer  # baked into the image
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    @property
+    def eos_token_id(self) -> int:
+        return self._tok.eos_token_id
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(name_or_path: str | None = None):
+    if name_or_path:
+        try:
+            return HFTokenizer(name_or_path)
+        except Exception as e:  # offline / unknown path: fall back, stay servable
+            logger.warning("could not load tokenizer %r (%s); using byte tokenizer", name_or_path, e)
+    return ByteTokenizer()

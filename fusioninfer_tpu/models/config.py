@@ -1,0 +1,143 @@
+"""Model architecture configs for the native TPU engine.
+
+Decoder-only transformer family covering the architectures the BASELINE
+ladder serves (Qwen3-style with QK-norm and tied embeddings at small
+sizes; Llama-3-style GQA at 70B shapes) plus a mixture-of-experts variant
+for expert-parallel coverage.  Shapes are chosen MXU-friendly: head_dim
+and d_ff multiples of 128, bfloat16 weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "qwen3-tiny"
+    vocab_size: int = 4096
+    d_model: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: int = 64
+    d_ff: int = 512
+    rope_theta: float = 1_000_000.0
+    rms_eps: float = 1e-6
+    qk_norm: bool = True  # Qwen3-style per-head RMSNorm on Q and K
+    tie_embeddings: bool = True
+    dtype: str = "bfloat16"
+    max_seq_len: int = 4096
+    # Mixture of experts (0 experts == dense)
+    n_experts: int = 0
+    n_experts_active: int = 2
+    moe_d_ff: int = 0  # per-expert FFN width; defaults to d_ff when 0
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    @property
+    def expert_d_ff(self) -> int:
+        return self.moe_d_ff or self.d_ff
+
+    def validate(self) -> "ModelConfig":
+        assert self.n_heads % self.n_kv_heads == 0, "GQA requires n_heads % n_kv_heads == 0"
+        assert self.d_model % self.n_heads == 0 or self.head_dim, "need explicit head_dim"
+        if self.is_moe:
+            assert self.n_experts_active <= self.n_experts
+        return self
+
+
+_PRESETS: dict[str, ModelConfig] = {}
+
+
+def register_preset(cfg: ModelConfig) -> ModelConfig:
+    _PRESETS[cfg.name] = cfg.validate()
+    return cfg
+
+
+def get_preset(name: str) -> ModelConfig:
+    try:
+        return _PRESETS[name]
+    except KeyError:
+        raise KeyError(f"unknown model preset {name!r}; known: {sorted(_PRESETS)}") from None
+
+
+def list_presets() -> list[str]:
+    return sorted(_PRESETS)
+
+
+# -- presets -----------------------------------------------------------------
+
+# Tiny configs: CI / CPU-mesh tests and the driver's compile checks.
+register_preset(ModelConfig(name="qwen3-tiny"))
+register_preset(
+    ModelConfig(
+        name="moe-tiny",
+        n_experts=4,
+        n_experts_active=2,
+        d_ff=512,
+        moe_d_ff=512,
+    )
+)
+
+# Qwen3-8B-shaped: the BASELINE north-star model (config 2/3).
+register_preset(
+    ModelConfig(
+        name="qwen3-8b",
+        vocab_size=151_936,
+        d_model=4096,
+        n_layers=36,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=12_288,
+        qk_norm=True,
+        tie_embeddings=False,
+        max_seq_len=32_768,
+    )
+)
+
+# A ~1.7B config that fits one v5e chip (16 GiB HBM) comfortably in bf16
+# with KV cache headroom — the single-chip bench model.
+register_preset(
+    ModelConfig(
+        name="qwen3-1.7b",
+        vocab_size=151_936,
+        d_model=2048,
+        n_layers=28,
+        n_heads=16,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=6144,
+        qk_norm=True,
+        tie_embeddings=True,
+        max_seq_len=32_768,
+    )
+)
+
+# Llama-3-70B-shaped: the multi-host TP target (configs 4/5).
+register_preset(
+    ModelConfig(
+        name="llama3-70b",
+        vocab_size=128_256,
+        d_model=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=28_672,
+        qk_norm=False,
+        tie_embeddings=False,
+        rope_theta=500_000.0,
+        max_seq_len=8192,
+    )
+)

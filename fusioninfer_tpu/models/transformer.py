@@ -1,0 +1,237 @@
+"""Functional decoder-only transformer.
+
+Pure-pytree params + pure functions (no module framework): everything is
+trivially jittable, shardable with ``NamedSharding``, and scannable.
+Layer weights are stacked on a leading ``n_layers`` axis and consumed with
+``lax.scan`` — one compiled layer body regardless of depth, the
+XLA-friendly shape for 80-layer models.
+
+Attention variants consumed here live in :mod:`fusioninfer_tpu.ops`;
+the KV-cache-aware serving paths (paged prefill/decode) live in
+:mod:`fusioninfer_tpu.engine.model_runner`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from fusioninfer_tpu.models.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+# -- building blocks ---------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding, NeoX half-rotation layout.
+
+    x: [..., seq, heads, head_dim]; positions: [..., seq]
+    """
+    head_dim = x.shape[-1]
+    freqs = rope_frequencies(head_dim, theta)  # [head_dim/2]
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+    gate = jax.nn.silu(x @ w_gate)
+    return (gate * (x @ w_up)) @ w_down
+
+
+def moe_ffn(x: jax.Array, router_w: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+            w_down: jax.Array, n_active: int) -> jax.Array:
+    """Token-choice top-k mixture of experts, dense-compute formulation.
+
+    Every expert runs on every token and results are combined with the
+    (renormalized) top-k router weights.  Dense MoE keeps shapes static —
+    the XLA-friendly choice at the expert counts we ship; the expert axis
+    is shardable over the mesh's ``ep`` axis for expert parallelism.
+
+    x: [tokens, d_model]; router_w: [d_model, E];
+    w_gate/w_up: [E, d_model, d_ff]; w_down: [E, d_ff, d_model]
+    """
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [T, E]
+    top_vals, _ = lax.top_k(logits, n_active)
+    threshold = top_vals[..., -1:]
+    mask = logits >= threshold
+    weights = jax.nn.softmax(jnp.where(mask, logits, -jnp.inf), axis=-1)  # [T, E]
+    # einsum over experts: dense but static-shaped
+    gate = jax.nn.silu(jnp.einsum("td,edf->tef", x, w_gate))
+    up = jnp.einsum("td,edf->tef", x, w_up)
+    per_expert = jnp.einsum("tef,efd->ted", gate * up, w_down)  # [T, E, D]
+    return jnp.einsum("ted,te->td", per_expert, weights.astype(x.dtype))
+
+
+# -- parameter init ----------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random-init parameters, layer weights stacked on axis 0."""
+    cfg.validate()
+    dtype = cfg.jax_dtype
+    L, D, H, KV, Hd, F = (
+        cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff,
+    )
+    keys = jax.random.split(key, 12)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dtype)
+
+    layers: Params = {
+        "attn_norm": jnp.ones((L, D), dtype),
+        "wq": dense(keys[0], (L, D, H * Hd), D),
+        "wk": dense(keys[1], (L, D, KV * Hd), D),
+        "wv": dense(keys[2], (L, D, KV * Hd), D),
+        "wo": dense(keys[3], (L, H * Hd, D), H * Hd),
+        "mlp_norm": jnp.ones((L, D), dtype),
+    }
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, Hd), dtype)
+        layers["k_norm"] = jnp.ones((L, Hd), dtype)
+    if cfg.is_moe:
+        E, EF = cfg.n_experts, cfg.expert_d_ff
+        layers["router"] = dense(keys[4], (L, D, E), D).astype(jnp.float32)
+        layers["w_gate"] = dense(keys[5], (L, E, D, EF), D)
+        layers["w_up"] = dense(keys[6], (L, E, D, EF), D)
+        layers["w_down"] = dense(keys[7], (L, E, EF, D), EF)
+    else:
+        layers["w_gate"] = dense(keys[5], (L, D, F), D)
+        layers["w_up"] = dense(keys[6], (L, D, F), D)
+        layers["w_down"] = dense(keys[7], (L, F, D), F)
+
+    params: Params = {
+        "embed": dense(keys[8], (cfg.vocab_size, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[9], (D, cfg.vocab_size), D)
+    return params
+
+
+# -- forward -----------------------------------------------------------------
+
+
+def _attention(q, k, v, mask):
+    """Plain batched attention: q [B,S,H,Hd], k/v [B,T,KV,Hd], mask [B,1,S,T]."""
+    B, S, H, Hd = q.shape
+    KV = k.shape[2]
+    group = H // KV
+    q = q.reshape(B, S, KV, group, Hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", q, k).astype(jnp.float32) / jnp.sqrt(Hd)
+    scores = jnp.where(mask[:, :, None, :, :] if mask.ndim == 4 else mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(B, S, H * Hd)
+
+
+def layer_forward(
+    cfg: ModelConfig,
+    layer: Params,
+    x: jax.Array,
+    positions: jax.Array,
+    mask: jax.Array,
+    kv: Optional[tuple[jax.Array, jax.Array]] = None,
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """One transformer block. Returns (output, (k, v)) for cache management.
+
+    x: [B, S, D]; positions: [B, S]; mask broadcastable to [B, 1, S, T].
+    When ``kv`` is given, attends over provided (k, v) history that already
+    includes this block's fresh keys.
+    """
+    B, S, D = x.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = (h @ layer["wq"]).reshape(B, S, H, Hd)
+    k = (h @ layer["wk"]).reshape(B, S, KV, Hd)
+    v = (h @ layer["wv"]).reshape(B, S, KV, Hd)
+    if cfg.qk_norm:
+        q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    if kv is None:
+        attn_k, attn_v = k, v
+    else:
+        attn_k, attn_v = kv
+    attn = _attention(q, attn_k, attn_v, mask)
+    x = x + attn @ layer["wo"]
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    if cfg.is_moe:
+        flat = h.reshape(B * S, D)
+        ff = moe_ffn(
+            flat, layer["router"], layer["w_gate"], layer["w_up"], layer["w_down"],
+            cfg.n_experts_active,
+        ).reshape(B, S, D)
+    else:
+        ff = swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"])
+    return x + ff, (k, v)
+
+
+def causal_mask(S: int, dtype=jnp.bool_) -> jax.Array:
+    return jnp.tril(jnp.ones((S, S), dtype))[None, None, :, :]
+
+
+def lm_head(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
+    """Project hidden states to fp32 logits; tied embeddings fall back to
+    the transposed embedding table."""
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return (x @ head).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=0)
+def forward(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    """Full-sequence causal forward → logits [B, S, V].
+
+    The training / compile-check path: no KV cache, scan over stacked
+    layer weights.
+    """
+    B, S = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    mask = causal_mask(S)
+
+    def body(x, layer):
+        out, _ = layer_forward(cfg, layer, x, positions, mask)
+        return out, None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return lm_head(cfg, params, x)
+
+
+def loss_fn(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    """Next-token cross-entropy over the sequence (training step target)."""
+    logits = forward(cfg, params, tokens)  # [B, S, V]
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return jnp.mean(nll)
