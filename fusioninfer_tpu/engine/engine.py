@@ -87,15 +87,38 @@ class NativeEngine:
         max_batch_size: int = 8,
         params=None,
         seed: int = 0,
+        mesh=None,
     ):
+        """``mesh``: optional ``jax.sharding.Mesh`` (axes from
+        ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
+        ``tp`` and the KV cache shards its head axis; the jitted
+        prefill/decode steps then run tensor-parallel with XLA inserting
+        the ICI collectives — no other engine code changes."""
         self.cfg = cfg.validate()
-        self.cache_cfg = cache_cfg or CacheConfig()
+        self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
-        if params is None:
-            logger.info("initializing random weights for %s", cfg.name)
-            params = init_params(cfg, jax.random.key(seed))
+        self.mesh = mesh
+        if mesh is not None:
+            from fusioninfer_tpu.parallel import sharding as psharding
+
+            tp = mesh.shape.get("tp", 1)
+            if tp > 1 and cfg.n_kv_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide n_kv_heads={cfg.n_kv_heads} to shard the KV cache"
+                )
+            if params is None:
+                logger.info("initializing sharded weights for %s over %s", cfg.name, mesh)
+                params = psharding.sharded_init(cfg, mesh, jax.random.key(seed))
+            else:
+                params = psharding.shard_params(cfg, mesh, params)
+            kv_sharding = jax.sharding.NamedSharding(mesh, psharding.kv_cache_spec())
+            self.cache = jax.device_put(init_kv_cache(cfg, self.cache_cfg), kv_sharding)
+        else:
+            if params is None:
+                logger.info("initializing random weights for %s", cfg.name)
+                params = init_params(cfg, jax.random.key(seed))
+            self.cache = init_kv_cache(cfg, self.cache_cfg)
         self.params = params
-        self.cache = init_kv_cache(cfg, self.cache_cfg)
         self.alloc = PageAllocator(self.cache_cfg)
         self.buckets = prefill_buckets(self.cache_cfg.max_len)
         self._key = jax.random.key(seed + 1)
@@ -104,6 +127,7 @@ class NativeEngine:
         self.waiting: collections.deque[Request] = collections.deque()
         self.running: dict[int, _SeqState] = {}  # slot -> state
         self._free_slots = list(reversed(range(max_batch_size)))
+        self._cancelled: set[str] = set()
         self._lock = threading.Lock()
 
         # counters consumed by /metrics
@@ -141,12 +165,32 @@ class NativeEngine:
     def kv_cache_usage(self) -> float:
         return self.alloc.utilization()
 
+    def cancel(self, request_id: str) -> None:
+        """Abandon a request (client gone). Thread-safe; takes effect at
+        the next step so only the engine thread mutates scheduling state."""
+        with self._lock:
+            self._cancelled.add(request_id)
+
     def step(self) -> list[StepOutput]:
         """Admit + prefill new work, then one batched decode pass."""
+        self._process_cancellations()
         outputs: list[StepOutput] = []
         outputs += self._admit()
         outputs += self._decode()
         return [o for o in outputs if o is not None]
+
+    def _process_cancellations(self) -> None:
+        with self._lock:
+            cancelled, self._cancelled = self._cancelled, set()
+        if not cancelled:
+            return
+        self.waiting = collections.deque(
+            r for r in self.waiting if r.request_id not in cancelled
+        )
+        for state in [s for s in self.running.values()
+                      if s.request.request_id in cancelled]:
+            self._finish(state)
+            logger.info("cancelled %s", state.request.request_id)
 
     # -- scheduling ----------------------------------------------------------
 

@@ -36,6 +36,19 @@ class CacheConfig:
     def max_len(self) -> int:
         return self.max_pages_per_seq * self.page_size
 
+    def validate(self) -> "CacheConfig":
+        if self.page_size < 1 or self.n_pages < 2 or self.max_pages_per_seq < 1:
+            raise ValueError(f"invalid cache config {self}")
+        usable = self.n_pages - 1  # trash page reserved
+        if self.max_pages_per_seq > usable:
+            # otherwise a request the engine admits (fits max_len) could need
+            # more pages than exist and spin in the scheduler forever
+            raise ValueError(
+                f"max_pages_per_seq={self.max_pages_per_seq} exceeds usable pages "
+                f"{usable} (n_pages={self.n_pages} minus the trash page)"
+            )
+        return self
+
 
 def init_kv_cache(cfg: ModelConfig, cache_cfg: CacheConfig) -> dict:
     shape = (
@@ -51,16 +64,69 @@ def init_kv_cache(cfg: ModelConfig, cache_cfg: CacheConfig) -> dict:
     }
 
 
-def kv_cache_bytes(cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
-    per = (
-        cfg.n_layers
-        * cache_cfg.n_pages
-        * cache_cfg.page_size
+def page_bytes(cfg: ModelConfig, page_size: int) -> int:
+    """Device bytes one KV page costs (k + v, all layers)."""
+    return (
+        2
+        * cfg.n_layers
+        * page_size
         * cfg.n_kv_heads
         * cfg.head_dim
         * jnp.dtype(cfg.jax_dtype).itemsize
     )
-    return 2 * per
+
+
+def model_param_bytes(cfg: ModelConfig) -> int:
+    """Weight footprint (bytes) computed from shapes — no allocation."""
+    from fusioninfer_tpu.models.transformer import init_params
+
+    shapes = jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
+    return sum(int(np.prod(s.shape)) * s.dtype.itemsize for s in jax.tree.leaves(shapes))
+
+
+def auto_cache_config(
+    cfg: ModelConfig,
+    page_size: int,
+    max_model_len: int,
+    max_batch_size: int,
+    hbm_utilization: float = 0.85,
+    tp: int = 1,
+    hbm_bytes: int | None = None,
+) -> CacheConfig:
+    """Size the page pool from device memory, vLLM's ``gpu_memory_utilization``
+    equivalent: pages fill ``hbm_utilization`` of HBM left after weights.
+
+    Falls back to request-shaped sizing (every batch slot can hold a
+    ``max_model_len`` sequence) when HBM stats are unavailable (CPU tests)
+    or when they allow fewer pages than that minimum. With tensor
+    parallelism both weights and KV heads are sharded, so per-device cost
+    divides by ``tp`` on both sides of the subtraction.
+    """
+    pages_per_seq = max(1, -(-max_model_len // page_size))
+    min_pages = pages_per_seq * max_batch_size + 1
+    n_pages = min_pages
+    if hbm_bytes is None:
+        stats = jax.devices()[0].memory_stats() or {}
+        hbm_bytes = stats.get("bytes_limit")
+    if hbm_bytes:
+        budget = int(hbm_bytes * hbm_utilization) - model_param_bytes(cfg) // tp
+        fit = budget // max(1, page_bytes(cfg, page_size) // tp)
+        if fit < min_pages:
+            raise ValueError(
+                f"model {cfg.name} with max_model_len={max_model_len} × "
+                f"max_batch_size={max_batch_size} needs {min_pages} KV pages "
+                f"but only {max(0, int(fit))} fit in "
+                f"{hbm_utilization:.0%} of {hbm_bytes / 2**30:.1f} GiB HBM "
+                f"after weights; lower max_batch_size/max_model_len or raise tp"
+            )
+        n_pages = int(fit)
+    return CacheConfig(
+        n_pages=n_pages, page_size=page_size, max_pages_per_seq=pages_per_seq
+    ).validate()
+
+
+def kv_cache_bytes(cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
+    return cache_cfg.n_pages * page_bytes(cfg, cache_cfg.page_size)
 
 
 class PageAllocator:

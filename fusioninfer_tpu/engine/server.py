@@ -86,10 +86,14 @@ class EngineServer:
         seed: int = 0,
     ):
         self.model_name = model
-        cfg = get_preset(model)
-        self.engine = engine or NativeEngine(
-            cfg, cache_cfg=cache_cfg, max_batch_size=max_batch_size, seed=seed
-        )
+        if engine is None:
+            # resolve the preset lazily so injected engines may carry any
+            # model name (fine-tunes, tests)
+            engine = NativeEngine(
+                get_preset(model), cache_cfg=cache_cfg, max_batch_size=max_batch_size,
+                seed=seed,
+            )
+        self.engine = engine
         self.tokenizer = tokenizer or load_tokenizer()
         self.metrics = EngineMetrics(model)
         self.host, self.port = host, port
@@ -159,6 +163,16 @@ class EngineServer:
                     del self._channels[rid]
                     self._req_meta.pop(rid, None)
 
+    def abort(self, chan: _RequestChannel) -> None:
+        """Idempotent teardown for a client that went away: unregister the
+        channel AND cancel the engine-side work so dead clients don't burn
+        decode steps."""
+        with self._lock:
+            rids = [rid for rid, c in self._channels.items() if c is chan]
+        for rid in rids:
+            self.engine.cancel(rid)
+        self._release(chan)
+
     def _sampling_params(self, body: dict) -> SamplingParams:
         stop_ids = [self.tokenizer.eos_token_id]
         return SamplingParams(
@@ -170,7 +184,14 @@ class EngineServer:
         )
 
     def stream_completion(self, body: dict, chat: bool = False):
-        """SSE generator: yields OpenAI-style chunk dicts, then None."""
+        """SSE source: returns ``(channel, generator)`` of OpenAI-style
+        chunk dicts (None-terminated). Validation and request admission
+        happen HERE, eagerly — before the HTTP layer commits to a 200/SSE
+        response — so a rejected request still gets a clean JSON 400. The
+        caller must ``abort(channel)`` when done (idempotent): if the
+        socket dies before the generator's first ``next()``, the
+        generator's own ``finally`` never runs and the request would
+        otherwise leak and keep decoding for a dead client."""
         if chat:
             messages = body.get("messages", [])
             prompt = "".join(
@@ -182,7 +203,10 @@ class EngineServer:
                 prompt = prompt[0] if prompt else ""
         params = self._sampling_params(body)
         prompt_tokens = self.tokenizer.encode(prompt)
-        chan = self.submit(prompt_tokens, params)
+        chan = self.submit(prompt_tokens, params)  # raises ValueError on rejection
+        return chan, self._stream_chunks(chan, chat)
+
+    def _stream_chunks(self, chan: _RequestChannel, chat: bool):
         completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         created = int(time.time())
         tokens: list[int] = []
@@ -321,12 +345,12 @@ class EngineServer:
                 try:
                     if self.path == "/v1/completions":
                         if body.get("stream"):
-                            self._send_sse(server.stream_completion(body, chat=False))
+                            self._stream(body, chat=False)
                         else:
                             self._send_json(server.handle_completion(body))
                     elif self.path == "/v1/chat/completions":
                         if body.get("stream"):
-                            self._send_sse(server.stream_completion(body, chat=True))
+                            self._stream(body, chat=True)
                         else:
                             self._send_json(server.handle_chat(body))
                     else:
@@ -336,6 +360,13 @@ class EngineServer:
                 except Exception as e:
                     logger.exception("request failed")
                     self._send_json({"error": {"message": str(e)}}, 500)
+
+            def _stream(self, body: dict, chat: bool) -> None:
+                chan, chunks = server.stream_completion(body, chat=chat)
+                try:
+                    self._send_sse(chunks)
+                finally:
+                    server.abort(chan)
 
             def _send_sse(self, chunks) -> None:
                 self.send_response(200)
@@ -386,19 +417,39 @@ class EngineServer:
 def serve_from_args(args) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
     maybe_init_distributed()
-    pages_per_seq = max(1, -(-args.max_model_len // args.page_size))  # ceil
-    cache_cfg = CacheConfig(
-        n_pages=pages_per_seq * args.max_batch_size + 1,
+    import jax
+
+    from fusioninfer_tpu.engine.kv_cache import auto_cache_config
+    from fusioninfer_tpu.parallel import build_mesh, infer_mesh_config
+
+    cfg = get_preset(args.model)
+    tp = args.tensor_parallel_size
+    mesh = None
+    if tp > 1:
+        devices = jax.devices()
+        if tp > len(devices):
+            raise SystemExit(
+                f"--tensor-parallel-size {tp} but only {len(devices)} devices visible"
+            )
+        mesh = build_mesh(infer_mesh_config(tp, tp=tp), devices[:tp])
+    cache_cfg = auto_cache_config(
+        cfg,
         page_size=args.page_size,
-        max_pages_per_seq=pages_per_seq,
+        max_model_len=args.max_model_len,
+        max_batch_size=args.max_batch_size,
+        hbm_utilization=args.hbm_utilization,
+        tp=tp,
+    )
+    logger.info("cache: %d pages of %d tokens", cache_cfg.n_pages, cache_cfg.page_size)
+    engine = NativeEngine(
+        cfg, cache_cfg=cache_cfg, max_batch_size=args.max_batch_size, seed=args.seed,
+        mesh=mesh,
     )
     server = EngineServer(
         model=args.model,
         host=args.host,
         port=args.port,
-        max_batch_size=args.max_batch_size,
-        cache_cfg=cache_cfg,
-        seed=args.seed,
+        engine=engine,
     )
     server.serve_forever()
     return 0

@@ -1,0 +1,40 @@
+"""TPU parallelism: device meshes, sharding rules, ring attention.
+
+The reference operator delegates all intra-model parallelism to vLLM
+(Ray bootstrap + NCCL, SURVEY §2.2); in the TPU-native stack it is a
+first-class subsystem built on ``jax.sharding`` — mesh axes (dp, sp, ep,
+tp), NamedSharding rules over the weight pytree, XLA-inserted ICI
+collectives, and explicit ``ppermute`` ring attention for long context.
+"""
+
+from fusioninfer_tpu.parallel.mesh import (
+    AXES,
+    MeshConfig,
+    build_mesh,
+    infer_mesh_config,
+    single_device_mesh,
+)
+from fusioninfer_tpu.parallel.ring import make_ring_attention, ring_attention_local
+from fusioninfer_tpu.parallel.sharding import (
+    param_shardings,
+    param_specs,
+    shard_params,
+    sharded_init,
+)
+from fusioninfer_tpu.parallel.step import make_forward, make_train_step
+
+__all__ = [
+    "AXES",
+    "MeshConfig",
+    "build_mesh",
+    "infer_mesh_config",
+    "single_device_mesh",
+    "make_ring_attention",
+    "ring_attention_local",
+    "param_shardings",
+    "param_specs",
+    "shard_params",
+    "sharded_init",
+    "make_forward",
+    "make_train_step",
+]

@@ -1,0 +1,116 @@
+"""Sharding rules: model pytree → ``NamedSharding`` per leaf.
+
+This is the TPU replacement for the reference's delegated tensor
+parallelism (vLLM `--tensor-parallel-size` passthrough, SURVEY §2.2): we
+annotate shardings on the weight pytree and let XLA's SPMD partitioner
+insert the ICI collectives — the scaling-book recipe, not hand-written
+NCCL.
+
+Megatron-style layout over the ``tp`` axis:
+
+* qkv projections  ``[L, D, H·Hd]``  → column-parallel (heads split)
+* attn output      ``[L, H·Hd, D]``  → row-parallel (psum after)
+* FFN gate/up      ``[L, D, F]``     → column-parallel
+* FFN down         ``[L, F, D]``     → row-parallel
+* embedding        ``[V, D]``        → vocab-parallel rows
+* lm head          ``[D, V]``        → vocab-parallel columns
+* norms            replicated
+* MoE expert weights additionally shard the expert axis over ``ep``.
+
+Activations: batch over ``dp``, sequence over ``sp``; the hidden axis
+stays unsharded so layernorms need no collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fusioninfer_tpu.models.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpec pytree congruent with ``transformer.init_params``."""
+    layers: Params = {
+        "attn_norm": P(),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(),
+    }
+    if cfg.qk_norm:
+        layers["q_norm"] = P()
+        layers["k_norm"] = P()
+    if cfg.is_moe:
+        layers["router"] = P()
+        layers["w_gate"] = P(None, "ep", None, "tp")
+        layers["w_up"] = P(None, "ep", None, "tp")
+        layers["w_down"] = P(None, "ep", "tp", None)
+    else:
+        layers["w_gate"] = P(None, None, "tp")
+        layers["w_up"] = P(None, None, "tp")
+        layers["w_down"] = P(None, "tp", None)
+
+    specs: Params = {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm": P(),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Params:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def token_spec() -> P:
+    """[B, S] token ids: batch over dp, sequence over sp."""
+    return P("dp", "sp")
+
+
+def activation_spec() -> P:
+    """[B, S, D] hidden states."""
+    return P("dp", "sp", None)
+
+
+def logit_spec() -> P:
+    """[B, S, V] logits: vocab over tp (vocab-parallel lm head)."""
+    return P("dp", "sp", "tp")
+
+
+def kv_cache_spec() -> P:
+    """[L, pages, page_size, KV, Hd] paged KV cache: KV heads over tp.
+
+    With tp ≤ n_kv_heads each tensor-parallel shard owns whole KV heads —
+    the attention kernel then needs no cross-device communication during
+    decode. (tp > n_kv_heads would replicate KV heads; guard in caller.)
+    """
+    return P(None, None, None, "tp", None)
+
+
+def shard_params(cfg: ModelConfig, mesh: Mesh, params: Params) -> Params:
+    """Place an existing (host/replicated) param pytree onto the mesh."""
+    return jax.device_put(params, param_shardings(cfg, mesh))
+
+
+def sharded_init(cfg: ModelConfig, mesh: Mesh, key: jax.Array) -> Params:
+    """Initialize parameters directly into their sharded layout — no
+    host-side full copy, so 70B-scale weights never exist unsharded."""
+    from fusioninfer_tpu.models.transformer import init_params
+
+    init = jax.jit(
+        lambda k: init_params(cfg, k),
+        out_shardings=param_shardings(cfg, mesh),
+    )
+    return init(key)

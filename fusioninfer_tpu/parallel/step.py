@@ -1,0 +1,71 @@
+"""Mesh-sharded forward and train steps.
+
+These are thin jit wrappers: all parallelism is expressed through the
+in/out shardings from :mod:`fusioninfer_tpu.parallel.sharding`; XLA's
+SPMD partitioner inserts the all-reduces/all-gathers over ICI. No
+hand-scheduled collectives on this path — ring attention (which does use
+explicit ``ppermute``) lives in :mod:`fusioninfer_tpu.parallel.ring`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding
+
+from fusioninfer_tpu.models.config import ModelConfig
+from fusioninfer_tpu.models.transformer import forward, loss_fn
+from fusioninfer_tpu.parallel import sharding
+
+Params = dict[str, Any]
+
+
+def make_forward(cfg: ModelConfig, mesh: Mesh) -> Callable[[Params, jax.Array], jax.Array]:
+    """Sharded full-sequence forward: tokens [B, S] → logits [B, S, V]."""
+    return jax.jit(
+        lambda params, tokens: forward(cfg, params, tokens),
+        in_shardings=(
+            sharding.param_shardings(cfg, mesh),
+            NamedSharding(mesh, sharding.token_spec()),
+        ),
+        out_shardings=NamedSharding(mesh, sharding.logit_spec()),
+    )
+
+
+def default_optimizer(learning_rate: float = 1e-4) -> optax.GradientTransformation:
+    return optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(learning_rate))
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+):
+    """Build (init_state, train_step) over the mesh.
+
+    The optimizer state inherits each parameter's sharding, so Adam
+    moments are tensor-parallel too. Gradients reduce over ``dp``/``sp``
+    automatically (XLA inserts the psum where logical shardings demand).
+    ``train_step(params, opt_state, tokens) -> (params, opt_state, loss)``
+    donates the old state buffers.
+    """
+    opt = optimizer if optimizer is not None else default_optimizer()
+    p_shard = sharding.param_shardings(cfg, mesh)
+
+    def init_state(params: Params):
+        return jax.jit(opt.init)(params)
+
+    def step(params: Params, opt_state, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(cfg, p, tokens))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    train_step = jax.jit(
+        step,
+        in_shardings=(p_shard, None, NamedSharding(mesh, sharding.token_spec())),
+        donate_argnums=(0, 1),
+    )
+    return init_state, train_step

@@ -1,18 +1,26 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
-Multi-chip TPU hardware is not available in CI; sharding/collective code is
-validated on 8 virtual CPU devices exactly the way the driver's
-``dryrun_multichip`` does.  These env vars must be set before the first
-``import jax`` anywhere in the test process.
+Multi-chip TPU hardware is not available in CI; sharding/collective code
+is validated on 8 virtual CPU devices exactly the way the driver's
+``dryrun_multichip`` does.
+
+The ambient image installs a ``sitecustomize`` that imports jax and
+registers a single-chip TPU backend before any test code runs, so
+``JAX_PLATFORMS`` in the environment is already latched into jax.config
+by the time this file executes. Backend *initialization* is still lazy,
+though, so overriding via ``jax.config.update`` here (before any test
+touches a device) reliably lands everything on the virtual CPU mesh.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may point at a real TPU
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

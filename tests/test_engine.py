@@ -103,6 +103,21 @@ class TestEngine:
         with pytest.raises(ValueError):
             engine.add_request(Request("huge", list(range(60)), SamplingParams(max_tokens=10)))
 
+    def test_cancel_waiting_and_running(self):
+        engine = make_engine(max_batch_size=1)
+        engine.add_request(Request("run", [1, 2, 3], SamplingParams(temperature=0.0, max_tokens=50)))
+        engine.add_request(Request("wait", [4, 5], SamplingParams(temperature=0.0, max_tokens=50)))
+        engine.step()  # admits "run", leaves "wait" queued
+        assert engine.num_running == 1 and engine.num_waiting == 1
+        engine.cancel("run")
+        engine.cancel("wait")
+        engine.step()
+        assert engine.num_running == 0 and engine.num_waiting == 0
+        assert engine.kv_cache_usage() == 0.0
+        # cancelling an unknown/finished id is a no-op
+        engine.cancel("ghost")
+        engine.step()
+
 
 @pytest.fixture(scope="module")
 def server():
@@ -235,3 +250,76 @@ class TestServer:
             assert False, "expected 400"
         except urllib.error.HTTPError as e:
             assert e.code == 400
+
+    def test_oversized_streaming_request_is_clean_400(self, server):
+        # regression: validation must run before SSE headers are committed,
+        # else the 400 arrives as garbage inside a 200 chunked body
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json.dumps(
+                {"prompt": "x" * 2000, "max_tokens": 400, "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+
+
+class TestCacheValidation:
+    def test_unsatisfiable_cache_config_fails_fast(self):
+        bad = CacheConfig(n_pages=4, page_size=8, max_pages_per_seq=8)
+        with pytest.raises(ValueError):
+            NativeEngine(CFG, cache_cfg=bad)
+
+    def test_auto_cache_config_fallback_and_hbm(self):
+        from fusioninfer_tpu.engine.kv_cache import auto_cache_config, page_bytes
+
+        # no HBM stats (CPU): request-shaped minimum
+        cc = auto_cache_config(CFG, page_size=8, max_model_len=64, max_batch_size=4)
+        assert cc.max_pages_per_seq == 8 and cc.n_pages == 8 * 4 + 1
+        # explicit HBM budget: pages fill the budget
+        big = auto_cache_config(
+            CFG, page_size=8, max_model_len=64, max_batch_size=4,
+            hbm_bytes=1 << 30, hbm_utilization=0.5,
+        )
+        assert big.n_pages > cc.n_pages
+        assert big.n_pages * page_bytes(CFG, 8) < (1 << 30)
+        # over-subscribed HBM must fail fast, not fall back and OOM later
+        with pytest.raises(ValueError, match="KV pages"):
+            auto_cache_config(
+                CFG, page_size=8, max_model_len=4096, max_batch_size=64,
+                hbm_bytes=1 << 20, hbm_utilization=0.9,
+            )
+
+
+class TestTensorParallelEngine:
+    def test_tp_engine_matches_single_device_greedy(self):
+        import dataclasses
+
+        from fusioninfer_tpu.parallel import MeshConfig, build_mesh
+
+        # fp32 so the equivalence is exact-argmax-robust (see test_model_runner)
+        cfg = dataclasses.replace(CFG, dtype="float32")
+        prompt = [2, 4, 6, 8, 10]
+        sp = SamplingParams(temperature=0.0, max_tokens=6)
+
+        ref_engine = NativeEngine(cfg, cache_cfg=CACHE, max_batch_size=2, seed=0)
+        ref_engine.add_request(Request("r", list(prompt), sp))
+        ref, _ = run_to_completion(ref_engine)
+
+        mesh = build_mesh(MeshConfig(tp=2), __import__("jax").devices()[:2])
+        tp_engine = NativeEngine(cfg, cache_cfg=CACHE, max_batch_size=2, seed=0, mesh=mesh)
+        tp_engine.add_request(Request("r", list(prompt), sp))
+        out, _ = run_to_completion(tp_engine)
+        assert out["r"] == ref["r"]
+
+    def test_tp_must_divide_kv_heads(self):
+        from fusioninfer_tpu.parallel import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(tp=8))
+        with pytest.raises(ValueError):
+            NativeEngine(CFG, cache_cfg=CACHE, mesh=mesh)  # 2 kv heads, tp=8
