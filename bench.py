@@ -1,0 +1,105 @@
+"""Benchmark: steady-state decode throughput of the native TPU engine.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}``
+
+Measures the continuous-batching hot loop — batched ``decode_step`` over a
+paged KV cache — the dominant cost of serving (BASELINE.md north-star:
+output tokens/sec/chip).  On TPU it runs a Qwen3-1.7B-shaped model (fits
+one v5e chip in bf16 with KV headroom); on CPU it falls back to the tiny
+config so CI smoke runs finish in seconds.
+
+The reference publishes no numbers (BASELINE.md: ``published: {}``), so
+``vs_baseline`` is reported against our own first recorded TPU run once
+one exists; until then 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+
+if os.environ.get("BENCH_PLATFORM"):  # e.g. BENCH_PLATFORM=cpu for local smoke
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+import jax.numpy as jnp
+import numpy as np
+
+from fusioninfer_tpu.engine.kv_cache import CacheConfig, PageAllocator, init_kv_cache
+from fusioninfer_tpu.engine.model_runner import decode_step
+from fusioninfer_tpu.models.config import get_preset
+from fusioninfer_tpu.models.transformer import init_params
+
+
+def run(model: str, batch: int, cache_cfg: CacheConfig, prefix_len: int,
+        warmup: int, steps: int) -> float:
+    cfg = get_preset(model)
+    cache_cfg.validate()
+    params = jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
+    cache = init_kv_cache(cfg, cache_cfg)
+
+    alloc = PageAllocator(cache_cfg)
+    tables = np.stack([
+        alloc.page_table_row(str(i))
+        for i in range(batch)
+        if alloc.allocate(str(i), prefix_len + warmup + steps + 1) is not None
+    ])
+    page_tables = jnp.asarray(tables)
+    active = jnp.ones((batch,), bool)
+    rng = np.random.default_rng(0)
+
+    def one_step(cache, pos):
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, batch, dtype=np.int32))
+        positions = jnp.full((batch,), pos, jnp.int32)
+        return decode_step(cfg, cache_cfg, params, cache, tokens, positions,
+                           page_tables, active)
+
+    pos = prefix_len
+    for _ in range(warmup):
+        cache, logits = one_step(cache, pos)
+        pos += 1
+    jax.block_until_ready(logits)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        cache, logits = one_step(cache, pos)
+        pos += 1
+    jax.block_until_ready(logits)
+    elapsed = time.perf_counter() - t0
+    return batch * steps / elapsed
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # Qwen3-1.7B shapes, 32-way continuous batch, 1 KiB-token contexts:
+        # ~3.4 GiB weights + ~7.3 GiB KV pages on a 16 GiB v5e chip.
+        tok_s = run(
+            model="qwen3-1.7b",
+            batch=32,
+            cache_cfg=CacheConfig(n_pages=32 * 8 + 1, page_size=128, max_pages_per_seq=8),
+            prefix_len=128,
+            warmup=5,
+            steps=64,
+        )
+    else:
+        tok_s = run(
+            model="qwen3-tiny",
+            batch=8,
+            cache_cfg=CacheConfig(n_pages=33, page_size=64, max_pages_per_seq=4),
+            prefix_len=32,
+            warmup=2,
+            steps=16,
+        )
+    print(json.dumps({
+        "metric": "decode_throughput_qwen3_1.7b" if on_tpu else "decode_throughput_tiny_cpu",
+        "value": round(tok_s, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

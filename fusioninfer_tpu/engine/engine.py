@@ -136,6 +136,7 @@ class NativeEngine:
         self.preemptions_total = 0
         self.finished_total = 0
         self.errors_total = 0
+        self.cancelled_total = 0
 
     # -- public API ----------------------------------------------------------
 
@@ -182,14 +183,15 @@ class NativeEngine:
     def _process_cancellations(self) -> None:
         with self._lock:
             cancelled, self._cancelled = self._cancelled, set()
-        if not cancelled:
-            return
-        self.waiting = collections.deque(
-            r for r in self.waiting if r.request_id not in cancelled
-        )
+            if not cancelled:
+                return
+            # rebuild under the lock: add_request appends from HTTP threads
+            self.waiting = collections.deque(
+                r for r in self.waiting if r.request_id not in cancelled
+            )
         for state in [s for s in self.running.values()
                       if s.request.request_id in cancelled]:
-            self._finish(state)
+            self._finish(state, outcome="cancelled")
             logger.info("cancelled %s", state.request.request_id)
 
     # -- scheduling ----------------------------------------------------------
@@ -351,7 +353,7 @@ class NativeEngine:
                         # nothing to steal: only this sequence runs and the
                         # cache is truly full — fail it rather than livelock
                         logger.error("request %s exceeds total KV capacity", st.request.request_id)
-                        self._finish(st, success=False)
+                        self._finish(st, outcome="error")
                         failures.append(
                             StepOutput(
                                 request_id=st.request.request_id,
@@ -382,11 +384,13 @@ class NativeEngine:
             is_first_token=first,
         )
 
-    def _finish(self, state: _SeqState, success: bool = True) -> None:
+    def _finish(self, state: _SeqState, outcome: str = "finished") -> None:
         self.running.pop(state.slot, None)
         self._free_slots.append(state.slot)
         self.alloc.release(state.request.request_id)
-        if success:
+        if outcome == "finished":
             self.finished_total += 1
+        elif outcome == "cancelled":
+            self.cancelled_total += 1
         else:
             self.errors_total += 1

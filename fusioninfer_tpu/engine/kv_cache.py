@@ -94,17 +94,19 @@ def auto_cache_config(
     hbm_bytes: int | None = None,
 ) -> CacheConfig:
     """Size the page pool from device memory, vLLM's ``gpu_memory_utilization``
-    equivalent: pages fill ``hbm_utilization`` of HBM left after weights.
+    equivalent, then cap at peak addressable demand.
 
-    Falls back to request-shaped sizing (every batch slot can hold a
-    ``max_model_len`` sequence) when HBM stats are unavailable (CPU tests)
-    or when they allow fewer pages than that minimum. With tensor
-    parallelism both weights and KV heads are sharded, so per-device cost
-    divides by ``tp`` on both sides of the subtraction.
+    Peak demand is ``max_batch_size × pages_per_seq + 1`` — pages beyond
+    that can never be allocated (slots and per-seq pages are both capped),
+    so the HBM math acts as a feasibility check: if the request-shaped
+    pool does not fit the budget, fail fast at startup rather than OOM
+    mid-serving.  Falls back to request-shaped sizing when HBM stats are
+    unavailable (CPU tests).  With tensor parallelism both weights and KV
+    heads are sharded, so per-device cost divides by ``tp`` on both sides
+    of the subtraction.
     """
     pages_per_seq = max(1, -(-max_model_len // page_size))
     min_pages = pages_per_seq * max_batch_size + 1
-    n_pages = min_pages
     if hbm_bytes is None:
         stats = jax.devices()[0].memory_stats() or {}
         hbm_bytes = stats.get("bytes_limit")
@@ -119,9 +121,8 @@ def auto_cache_config(
                 f"{hbm_utilization:.0%} of {hbm_bytes / 2**30:.1f} GiB HBM "
                 f"after weights; lower max_batch_size/max_model_len or raise tp"
             )
-        n_pages = int(fit)
     return CacheConfig(
-        n_pages=n_pages, page_size=page_size, max_pages_per_seq=pages_per_seq
+        n_pages=min_pages, page_size=page_size, max_pages_per_seq=pages_per_seq
     ).validate()
 
 

@@ -83,6 +83,8 @@ class EngineMetrics:
             f"vllm:request_success_total{{{labels}}} {engine.finished_total}",
             "# TYPE vllm:request_failure_total counter",
             f"vllm:request_failure_total{{{labels}}} {engine.errors_total}",
+            "# TYPE vllm:request_cancelled_total counter",
+            f"vllm:request_cancelled_total{{{labels}}} {engine.cancelled_total}",
             "# TYPE vllm:time_to_first_token_seconds histogram",
             *self.ttft.render("vllm:time_to_first_token_seconds", labels),
             "# TYPE vllm:time_per_output_token_seconds histogram",

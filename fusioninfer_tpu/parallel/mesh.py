@@ -22,7 +22,6 @@ the fastest ICI ring of a physical slice.
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Optional, Sequence
 
 import jax

@@ -86,8 +86,15 @@ def ring_attention_local(
     l0 = jnp.zeros((B, KV, G, S), jnp.float32)
     o0 = jnp.zeros((B, KV, G, S, Hd), jnp.float32)
 
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # Chunk 0 is the local K/V — attend before any communication, then
+    # rotate at the top of each remaining step: n chunks, n-1 exchanges.
+    acc0 = _merge((m0, l0, o0), _chunk_attend(q, k, v, q_pos, q_pos, causal))
+
     def body(i, carry):
         acc, kv_blk = carry
+        kv_blk = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), kv_blk)
         k_blk, v_blk = kv_blk
         # Block i arrived from device (me - i); its chunk owns positions
         # [(me - i) % n * S, ...).
@@ -95,12 +102,9 @@ def ring_attention_local(
         k_pos = src * S + jnp.arange(S)
         new = _chunk_attend(q, k_blk, v_blk, q_pos, k_pos, causal)
         acc = _merge(acc, new)
-        # rotate: receive the next chunk from the previous rank
-        perm = [(j, (j + 1) % n) for j in range(n)]
-        kv_blk = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
         return acc, kv_blk
 
-    (m, l, o), _ = lax.fori_loop(0, n, body, ((m0, l0, o0), (k, v)))
+    (m, l, o), _ = lax.fori_loop(1, n, body, (acc0, (k, v)))
     l = jnp.maximum(l, 1e-20)
     out = o / l[..., None]  # [B, KV, G, S, Hd]
     out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, H * Hd)
