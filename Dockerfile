@@ -1,0 +1,24 @@
+# Controller-manager / native-engine image.
+# The reference builds a distroless Go binary; this build is a slim Python
+# runtime carrying the operator (pure stdlib + pyyaml) and, optionally,
+# the JAX TPU engine (installed only when ENGINE=tpu to keep the
+# controller image small).
+
+FROM python:3.12-slim AS base
+WORKDIR /app
+COPY pyproject.toml ./
+COPY fusioninfer_tpu ./fusioninfer_tpu
+RUN pip install --no-cache-dir pyyaml && pip install --no-cache-dir -e . --no-deps
+
+# Controller image (default target): no JAX needed to reconcile.
+FROM base AS controller
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "fusioninfer_tpu.cli"]
+CMD ["controller", "run"]
+
+# Engine image: JAX with TPU support for the native serving path.
+FROM base AS engine
+RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "fusioninfer_tpu.cli"]
+CMD ["engine", "serve"]

@@ -1,0 +1,79 @@
+# fusioninfer-tpu — build/test/deploy targets (capability parity with the
+# reference's Makefile: manifests/test/lint/build/deploy + drift checks).
+
+PYTHON ?= python
+IMG ?= fusioninfer-tpu:latest
+
+.PHONY: all
+all: test
+
+##@ Development
+
+.PHONY: manifests
+manifests: ## Regenerate config/ from the Python sources.
+	$(PYTHON) -m fusioninfer_tpu.cli render config --out config
+
+.PHONY: manifests-check
+manifests-check: manifests ## Fail if config/ drifts from the generators.
+	@git diff --exit-code -- config/ || \
+		(echo "config/ drifted — run 'make manifests' and commit" && exit 1)
+
+.PHONY: test
+test: ## Unit + integration tests (virtual 8-device CPU mesh).
+	$(PYTHON) -m pytest tests/ -q
+
+.PHONY: test-fast
+test-fast: ## Tests, stop at first failure.
+	$(PYTHON) -m pytest tests/ -x -q
+
+.PHONY: lint
+lint: ## Byte-compile all sources (no external linters in the image).
+	$(PYTHON) -m compileall -q fusioninfer_tpu tests bench.py __graft_entry__.py
+
+.PHONY: bench
+bench: ## One-line JSON decode-throughput benchmark (real chip if present).
+	$(PYTHON) bench.py
+
+.PHONY: dryrun
+dryrun: ## Multichip sharding dry-run on 8 virtual CPU devices.
+	$(PYTHON) __graft_entry__.py 8
+
+##@ Render
+
+.PHONY: render-samples
+render-samples: ## Dry-run render every sample InferenceService.
+	@for f in config/samples/*.yaml; do \
+		echo "--- $$f"; \
+		$(PYTHON) -m fusioninfer_tpu.cli render resources -f $$f > /dev/null || exit 1; \
+	done; echo "all samples render"
+
+##@ Build
+
+.PHONY: docker-build
+docker-build: ## Build the controller/engine image.
+	docker build -t $(IMG) .
+
+.PHONY: build-installer
+build-installer: manifests ## Single-file install manifest.
+	mkdir -p dist
+	$(PYTHON) -c "import yaml,sys; from fusioninfer_tpu.operator.manifests import config_tree; \
+docs=[v for k,v in config_tree().items() if k.endswith('.yaml') and 'kustomization' not in k]; \
+yaml.safe_dump_all(docs, open('dist/install.yaml','w'), sort_keys=False)"
+
+##@ Deployment
+
+.PHONY: install
+install: manifests ## Install CRDs into the current cluster.
+	kubectl apply -f config/crd/bases/
+
+.PHONY: deploy
+deploy: ## Deploy controller via kustomize.
+	kubectl apply -k config/default
+
+.PHONY: undeploy
+undeploy:
+	kubectl delete -k config/default --ignore-not-found=true
+
+.PHONY: help
+help:
+	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ { printf "  %-18s %s\n", $$1, $$2 }' $(MAKEFILE_LIST)

@@ -41,11 +41,10 @@ def run(model: str, batch: int, cache_cfg: CacheConfig, prefix_len: int,
     cache = init_kv_cache(cfg, cache_cfg)
 
     alloc = PageAllocator(cache_cfg)
-    tables = np.stack([
-        alloc.page_table_row(str(i))
-        for i in range(batch)
-        if alloc.allocate(str(i), prefix_len + warmup + steps + 1) is not None
-    ])
+    tables = np.zeros((batch, cache_cfg.max_pages_per_seq), np.int32)
+    for i in range(batch):
+        alloc.allocate(str(i), prefix_len + warmup + steps + 1)
+        tables[i] = alloc.page_table_row(str(i))
     page_tables = jnp.asarray(tables)
     active = jnp.ones((batch,), bool)
     rng = np.random.default_rng(0)

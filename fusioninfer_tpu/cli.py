@@ -33,6 +33,7 @@ def _cmd_controller_run(args: argparse.Namespace) -> int:
         client,
         namespace=args.namespace,
         probe_port=args.probe_port,
+        metrics_port=args.metrics_port,
         default_queue=args.volcano_queue or None,
     )
     mgr.run_forever()
@@ -45,6 +46,12 @@ def _cmd_render(args: argparse.Namespace) -> int:
 
     if args.what == "crd":
         yaml.safe_dump(build_crd(), sys.stdout, sort_keys=False)
+        return 0
+    if args.what == "config":
+        from fusioninfer_tpu.operator.manifests import write_config_tree
+
+        for path in write_config_tree(args.out):
+            print(path)
         return 0
     # resources
     if not args.file:
@@ -84,13 +91,15 @@ def build_parser() -> argparse.ArgumentParser:
     run = csub.add_parser("run", help="run the controller against the cluster")
     run.add_argument("--namespace", default="default")
     run.add_argument("--probe-port", type=int, default=8081)
+    run.add_argument("--metrics-port", type=int, default=8443)
     run.add_argument("--volcano-queue", default="")
     run.add_argument("-v", "--verbose", action="store_true")
     run.set_defaults(func=_cmd_controller_run)
 
     render = sub.add_parser("render", help="render manifests without a cluster")
-    render.add_argument("what", choices=["crd", "resources"])
+    render.add_argument("what", choices=["crd", "resources", "config"])
     render.add_argument("-f", "--file", help="InferenceService manifest")
+    render.add_argument("--out", default="config", help="output dir for 'config'")
     render.add_argument("--volcano-queue", default="")
     render.set_defaults(func=_cmd_render)
 

@@ -101,6 +101,7 @@ class NativeEngine:
         if mesh is not None:
             from fusioninfer_tpu.parallel import sharding as psharding
 
+            self.cfg = cfg = psharding.spmd_cfg(self.cfg, mesh)
             tp = mesh.shape.get("tp", 1)
             if tp > 1 and cfg.n_kv_heads % tp:
                 raise ValueError(
@@ -186,9 +187,11 @@ class NativeEngine:
             if not cancelled:
                 return
             # rebuild under the lock: add_request appends from HTTP threads
-            self.waiting = collections.deque(
+            kept = collections.deque(
                 r for r in self.waiting if r.request_id not in cancelled
             )
+            self.cancelled_total += len(self.waiting) - len(kept)
+            self.waiting = kept
         for state in [s for s in self.running.values()
                       if s.request.request_id in cancelled]:
             self._finish(state, outcome="cancelled")

@@ -86,10 +86,13 @@ def decode_step(
     active: jax.Array,  # [B] bool
 ):
     """One decode step for the whole running batch → (cache, logits [B, V])."""
+    from fusioninfer_tpu.ops import dispatch, paged_decode_attention
+
     B = tokens.shape[0]
     ps = cache_cfg.page_size
     mp = page_tables.shape[1]
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
 
     x = params["embed"][tokens][:, None, :]  # [B, 1, D]
     pos = positions[:, None]  # [B, 1]
@@ -98,8 +101,10 @@ def decode_step(
         active, page_tables[jnp.arange(B), positions // ps], cache_cfg.trash_page
     )
     write_slot = positions % ps
+    # context length per sequence incl. the token written this step
+    lengths = jnp.where(active, positions + 1, 0)
 
-    # attention mask over the gathered [mp * ps] context
+    # attention mask over the gathered [mp * ps] context (reference path)
     ctx_idx = jnp.arange(mp * ps)[None, :]  # [1, T]
     attend = ctx_idx <= positions[:, None]  # [B, T] (new token included)
     attend = attend[:, None, None, :]  # [B, 1, 1, T]
@@ -121,16 +126,23 @@ def decode_step(
         k_cache_l = k_cache_l.at[write_page, write_slot].set(k[:, 0])
         v_cache_l = v_cache_l.at[write_page, write_slot].set(v[:, 0])
 
-        # gather each sequence's context pages: [B, mp, ps, KV, Hd] -> [B, T, KV, Hd]
-        k_ctx = k_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)
-        v_ctx = v_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)
+        if use_kernel:
+            # Pallas kernel streams only the live pages HBM→VMEM
+            attn = paged_decode_attention(
+                q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
+                interpret=dispatch.kernel_interpret(),
+            )[:, None, :]  # [B, 1, H*Hd]
+        else:
+            # portable path: gather pages [B, mp, ps, KV, Hd] -> [B, T, KV, Hd]
+            k_ctx = k_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)
+            v_ctx = v_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)
 
-        group = H // KV
-        qg = q.reshape(B_, 1, KV, group, Hd)
-        scores = jnp.einsum("bskgd,btkd->bkgst", qg, k_ctx).astype(jnp.float32) / jnp.sqrt(Hd)
-        scores = jnp.where(attend[:, :, None, :, :] * jnp.ones_like(scores, bool), scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
-        attn = jnp.einsum("bkgst,btkd->bskgd", probs, v_ctx).reshape(B_, 1, H * Hd)
+            group = H // KV
+            qg = q.reshape(B_, 1, KV, group, Hd)
+            scores = jnp.einsum("bskgd,btkd->bkgst", qg, k_ctx).astype(jnp.float32) / jnp.sqrt(Hd)
+            scores = jnp.where(attend[:, :, None, :, :] * jnp.ones_like(scores, bool), scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
+            attn = jnp.einsum("bkgst,btkd->bskgd", probs, v_ctx).reshape(B_, 1, H * Hd)
         x = x + attn @ layer["wo"]
 
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)

@@ -31,6 +31,10 @@ class ModelConfig:
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
     max_seq_len: int = 4096
+    # Attention implementation: "auto" (Pallas kernels on TPU, jnp
+    # reference elsewhere), "flash", or "reference".  Sharded multi-device
+    # paths pin "reference" — see fusioninfer_tpu.ops.dispatch.
+    attn_impl: str = "auto"
     # Mixture of experts (0 experts == dense)
     n_experts: int = 0
     n_experts_active: int = 2

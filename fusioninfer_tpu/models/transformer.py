@@ -175,10 +175,18 @@ def layer_forward(
     k = apply_rope(k, positions, cfg.rope_theta)
 
     if kv is None:
-        attn_k, attn_v = k, v
+        from fusioninfer_tpu.ops import dispatch, flash_attention
+
+        if dispatch.resolve_attn(cfg.attn_impl) == "flash" and dispatch.flash_seq_ok(S):
+            # fresh K/V over the full (causal) sequence: Pallas flash path
+            attn = flash_attention(
+                q, k, v, causal=True, interpret=dispatch.kernel_interpret()
+            )
+        else:
+            attn = _attention(q, k, v, mask)
     else:
         attn_k, attn_v = kv
-    attn = _attention(q, attn_k, attn_v, mask)
+        attn = _attention(q, attn_k, attn_v, mask)
     x = x + attn @ layer["wo"]
 
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)

@@ -66,14 +66,57 @@ class WorkQueue:
         return key
 
 
+class ControllerMetrics:
+    """Reconcile counters/latency in Prometheus text format — the
+    controller-runtime metrics the reference's ServiceMonitor scrapes
+    (``controller_runtime_reconcile_total``; e2e asserts it,
+    ``test/e2e/e2e_test.go:143-261``).  Served plain on the metrics port;
+    TLS/authn is the deployment's job (NetworkPolicy + ServiceMonitor)."""
+
+    def __init__(self):
+        self.reconcile_total = 0
+        self.reconcile_errors_total = 0
+        self.requeue_total = 0
+        self._duration_sum = 0.0
+        self._duration_count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float, errors: int, requeued: bool) -> None:
+        with self._lock:
+            self.reconcile_total += 1
+            self.reconcile_errors_total += errors
+            self.requeue_total += 1 if requeued else 0
+            self._duration_sum += seconds
+            self._duration_count += 1
+
+    def render(self) -> str:
+        c = 'controller="inferenceservice"'
+        with self._lock:
+            lines = [
+                "# TYPE controller_runtime_reconcile_total counter",
+                f'controller_runtime_reconcile_total{{{c}}} {self.reconcile_total}',
+                "# TYPE controller_runtime_reconcile_errors_total counter",
+                f'controller_runtime_reconcile_errors_total{{{c}}} {self.reconcile_errors_total}',
+                "# TYPE controller_runtime_reconcile_requeue_total counter",
+                f'controller_runtime_reconcile_requeue_total{{{c}}} {self.requeue_total}',
+                "# TYPE controller_runtime_reconcile_time_seconds summary",
+                f'controller_runtime_reconcile_time_seconds_sum{{{c}}} {self._duration_sum}',
+                f'controller_runtime_reconcile_time_seconds_count{{{c}}} {self._duration_count}',
+            ]
+        return "\n".join(lines) + "\n"
+
+
 class Manager:
     def __init__(self, client: K8sClient, namespace: str = "default",
-                 probe_port: int = 8081, default_queue: str | None = None):
+                 probe_port: int = 8081, metrics_port: int = 8443,
+                 default_queue: str | None = None):
         self.client = client
         self.namespace = namespace
         self.probe_port = probe_port
+        self.metrics_port = metrics_port
         self.reconciler = InferenceServiceReconciler(client, default_queue=default_queue)
         self.workqueue = WorkQueue()
+        self.metrics = ControllerMetrics()
         self._stop = threading.Event()
         self.ready = threading.Event()
 
@@ -118,15 +161,22 @@ class Manager:
             if key is None:
                 continue
             ns, name = key
+            t0 = time.monotonic()
             try:
                 result = self.reconciler.reconcile(ns, name)
             except Exception:
                 logger.exception("reconcile %s/%s panicked", ns, name)
                 result = None
-            if result is not None and (result.requeue or result.errors):
+            requeued = result is not None and (result.requeue or bool(result.errors))
+            self.metrics.observe(
+                time.monotonic() - t0,
+                errors=len(result.errors) if result is not None else 1,
+                requeued=requeued,
+            )
+            if requeued:
                 threading.Timer(REQUEUE_DELAY_S, self.workqueue.add, args=(key,)).start()
 
-    # -- probes --
+    # -- probes + metrics --
 
     def _serve_probes(self) -> None:
         mgr = self
@@ -149,11 +199,34 @@ class Manager:
         threading.Thread(target=server.serve_forever, daemon=True).start()
         self._probe_server = server
 
+    def _serve_metrics(self) -> None:
+        mgr = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = mgr.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.ThreadingHTTPServer(("", self.metrics_port), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        self._metrics_server = server
+
     # -- lifecycle --
 
     def start(self) -> None:
         logger.info("starting manager (namespace=%s)", self.namespace)
         self._serve_probes()
+        self._serve_metrics()
         threads = [threading.Thread(target=self._worker, daemon=True, name="reconcile-worker")]
         for kind in ["InferenceService"] + OWNED_KINDS:
             threads.append(
@@ -177,6 +250,7 @@ class Manager:
     def stop(self) -> None:
         self._stop.set()
         self.ready.clear()
-        server = getattr(self, "_probe_server", None)
-        if server is not None:
-            server.shutdown()
+        for attr in ("_probe_server", "_metrics_server"):
+            server = getattr(self, attr, None)
+            if server is not None:
+                server.shutdown()

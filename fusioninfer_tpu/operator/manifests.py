@@ -1,0 +1,351 @@
+"""Deploy-manifest generation: the kustomize config tree.
+
+The reference ships a hand-tended kustomize tree (``config/``: crd bases,
+rbac incl. admin/editor/viewer and metrics roles, manager Deployment with
+restricted pod security, prometheus ServiceMonitor, metrics
+NetworkPolicy — SURVEY §2 row 16) kept in sync by ``make manifests`` +
+a CI drift check.  Here the whole tree is generated from this module —
+``fusioninfer-tpu render config --out config/`` — so the YAML can never
+drift from the Python sources; CI re-renders and fails on diff, same
+contract as the reference's ``git status --porcelain`` check
+(``.github/workflows/ci.yml:55-67``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import yaml
+
+from fusioninfer_tpu import GROUP
+from fusioninfer_tpu.api.crd import PLURAL, build_crd
+
+NAMESPACE = "fusioninfer-system"
+MANAGER_IMAGE = "fusioninfer-tpu:latest"
+PREFIX = "fusioninfer-"
+
+_RESTRICTED = {
+    "runAsNonRoot": True,
+    "allowPrivilegeEscalation": False,
+    "capabilities": {"drop": ["ALL"]},
+    "seccompProfile": {"type": "RuntimeDefault"},
+}
+
+
+def manager_role() -> dict:
+    """ClusterRole for the controller: everything the reconciler touches
+    (parity with the reference's generated ``config/rbac/role.yaml``)."""
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "manager-role"},
+        "rules": [
+            {
+                "apiGroups": [GROUP],
+                "resources": [PLURAL],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": [GROUP],
+                "resources": [f"{PLURAL}/status"],
+                "verbs": ["get", "patch", "update"],
+            },
+            {
+                "apiGroups": [GROUP],
+                "resources": [f"{PLURAL}/finalizers"],
+                "verbs": ["update"],
+            },
+            {
+                "apiGroups": ["leaderworkerset.x-k8s.io"],
+                "resources": ["leaderworkersets"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": ["scheduling.volcano.sh"],
+                "resources": ["podgroups"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": [""],
+                "resources": ["configmaps", "services", "serviceaccounts", "events"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": ["apps"],
+                "resources": ["deployments"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": ["rbac.authorization.k8s.io"],
+                "resources": ["roles", "rolebindings"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": ["inference.networking.k8s.io"],
+                "resources": ["inferencepools"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": ["gateway.networking.k8s.io"],
+                "resources": ["httproutes"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": ["coordination.k8s.io"],
+                "resources": ["leases"],
+                "verbs": ["create", "get", "list", "update", "watch"],
+            },
+        ],
+    }
+
+
+def _aggregate_role(suffix: str, verbs: list[str]) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {
+            "name": f"inferenceservice-{suffix}-role",
+            "labels": {
+                f"rbac.authorization.k8s.io/aggregate-to-{suffix}": "true",
+            },
+        },
+        "rules": [
+            {"apiGroups": [GROUP], "resources": [PLURAL], "verbs": verbs},
+            {"apiGroups": [GROUP], "resources": [f"{PLURAL}/status"], "verbs": ["get"]},
+        ],
+    }
+
+
+def metrics_reader_role() -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "metrics-reader"},
+        "rules": [{"nonResourceURLs": ["/metrics"], "verbs": ["get"]}],
+    }
+
+
+def manager_deployment() -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": "controller-manager",
+            "namespace": "system",
+            "labels": {"control-plane": "controller-manager"},
+        },
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"control-plane": "controller-manager"}},
+            "template": {
+                "metadata": {"labels": {"control-plane": "controller-manager"}},
+                "spec": {
+                    "serviceAccountName": "controller-manager",
+                    "securityContext": {"runAsNonRoot": True},
+                    "terminationGracePeriodSeconds": 10,
+                    "containers": [
+                        {
+                            "name": "manager",
+                            "image": MANAGER_IMAGE,
+                            "command": [
+                                "python", "-m", "fusioninfer_tpu.cli",
+                                "controller", "run",
+                            ],
+                            "securityContext": _RESTRICTED,
+                            "ports": [
+                                {"containerPort": 8443, "name": "metrics"},
+                                {"containerPort": 8081, "name": "probes"},
+                            ],
+                            "livenessProbe": {
+                                "httpGet": {"path": "/healthz", "port": 8081},
+                                "initialDelaySeconds": 15,
+                                "periodSeconds": 20,
+                            },
+                            "readinessProbe": {
+                                "httpGet": {"path": "/readyz", "port": 8081},
+                                "initialDelaySeconds": 5,
+                                "periodSeconds": 10,
+                            },
+                            "resources": {
+                                "limits": {"cpu": "500m", "memory": "256Mi"},
+                                "requests": {"cpu": "10m", "memory": "128Mi"},
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def service_monitor() -> dict:
+    return {
+        "apiVersion": "monitoring.coreos.com/v1",
+        "kind": "ServiceMonitor",
+        "metadata": {
+            "name": "controller-manager-metrics-monitor",
+            "namespace": "system",
+            "labels": {"control-plane": "controller-manager"},
+        },
+        "spec": {
+            "endpoints": [{"port": "metrics", "path": "/metrics"}],
+            "selector": {"matchLabels": {"control-plane": "controller-manager"}},
+        },
+    }
+
+
+def metrics_network_policy() -> dict:
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": {"name": "allow-metrics-traffic", "namespace": "system"},
+        "spec": {
+            "podSelector": {"matchLabels": {"control-plane": "controller-manager"}},
+            "policyTypes": ["Ingress"],
+            "ingress": [
+                {
+                    "from": [
+                        {
+                            "namespaceSelector": {
+                                "matchLabels": {"metrics": "enabled"}
+                            }
+                        }
+                    ],
+                    "ports": [{"port": 8443, "protocol": "TCP"}],
+                }
+            ],
+        },
+    }
+
+
+def _metrics_service() -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": "controller-manager-metrics-service",
+            "namespace": "system",
+            "labels": {"control-plane": "controller-manager"},
+        },
+        "spec": {
+            "selector": {"control-plane": "controller-manager"},
+            "ports": [{"name": "metrics", "port": 8443, "targetPort": "metrics"}],
+        },
+    }
+
+
+def config_tree() -> dict[str, Any]:
+    """path → manifest-dict | list-of-dicts | raw-str for the whole tree."""
+    kust = lambda resources, **extra: {"resources": resources, **extra}  # noqa: E731
+    return {
+        "crd/bases/fusioninfer.io_inferenceservices.yaml": build_crd(),
+        "crd/kustomization.yaml": kust(["bases/fusioninfer.io_inferenceservices.yaml"]),
+        "rbac/role.yaml": manager_role(),
+        "rbac/service_account.yaml": {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "controller-manager", "namespace": "system"},
+        },
+        "rbac/role_binding.yaml": {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "manager-rolebinding"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "manager-role",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "controller-manager",
+                    "namespace": "system",
+                }
+            ],
+        },
+        "rbac/leader_election_role.yaml": {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "Role",
+            "metadata": {"name": "leader-election-role", "namespace": "system"},
+            "rules": [
+                {
+                    "apiGroups": ["coordination.k8s.io"],
+                    "resources": ["leases"],
+                    "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"],
+                },
+                {"apiGroups": [""], "resources": ["events"], "verbs": ["create", "patch"]},
+            ],
+        },
+        "rbac/leader_election_role_binding.yaml": {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": "leader-election-rolebinding", "namespace": "system"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "Role",
+                "name": "leader-election-role",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "controller-manager",
+                    "namespace": "system",
+                }
+            ],
+        },
+        "rbac/metrics_reader_role.yaml": metrics_reader_role(),
+        "rbac/inferenceservice_admin_role.yaml": _aggregate_role(
+            "admin", ["create", "delete", "get", "list", "patch", "update", "watch"]
+        ),
+        "rbac/inferenceservice_editor_role.yaml": _aggregate_role(
+            "edit", ["create", "delete", "get", "list", "patch", "update", "watch"]
+        ),
+        "rbac/inferenceservice_viewer_role.yaml": _aggregate_role(
+            "view", ["get", "list", "watch"]
+        ),
+        "rbac/kustomization.yaml": kust([
+            "service_account.yaml",
+            "role.yaml",
+            "role_binding.yaml",
+            "leader_election_role.yaml",
+            "leader_election_role_binding.yaml",
+            "metrics_reader_role.yaml",
+            "inferenceservice_admin_role.yaml",
+            "inferenceservice_editor_role.yaml",
+            "inferenceservice_viewer_role.yaml",
+        ]),
+        "manager/manager.yaml": manager_deployment(),
+        "manager/metrics_service.yaml": _metrics_service(),
+        "manager/kustomization.yaml": kust(["manager.yaml", "metrics_service.yaml"]),
+        "prometheus/monitor.yaml": service_monitor(),
+        "prometheus/kustomization.yaml": kust(["monitor.yaml"]),
+        "network-policy/allow-metrics-traffic.yaml": metrics_network_policy(),
+        "network-policy/kustomization.yaml": kust(["allow-metrics-traffic.yaml"]),
+        "default/kustomization.yaml": {
+            "namespace": NAMESPACE,
+            "namePrefix": PREFIX,
+            "resources": ["../crd", "../rbac", "../manager"],
+            "labels": [
+                {
+                    "pairs": {"app.kubernetes.io/name": "fusioninfer-tpu"},
+                    "includeSelectors": False,
+                }
+            ],
+        },
+    }
+
+
+def write_config_tree(root: str) -> list[str]:
+    """Render the tree under ``root`` (creating dirs); returns paths written."""
+    written = []
+    for rel, content in config_tree().items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            if isinstance(content, str):
+                f.write(content)
+            else:
+                yaml.safe_dump(content, f, sort_keys=False)
+        written.append(path)
+    return sorted(written)

@@ -1,0 +1,20 @@
+"""Pallas TPU kernels for the hot attention ops, with jnp oracles.
+
+* :mod:`flash_attention` — blockwise prefill/training attention.
+* :mod:`paged_attention` — paged decode attention over the KV cache.
+* :mod:`dispatch` — trace-time kernel/reference selection.
+"""
+
+from fusioninfer_tpu.ops.dispatch import (  # noqa: F401
+    flash_seq_ok,
+    kernel_interpret,
+    resolve_attn,
+)
+from fusioninfer_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    reference_attention,
+)
+from fusioninfer_tpu.ops.paged_attention import (  # noqa: F401
+    paged_decode_attention,
+    reference_paged_attention,
+)

@@ -1,0 +1,41 @@
+"""Attention implementation dispatch.
+
+Selection order: an explicit ``ModelConfig.attn_impl`` (``flash`` /
+``reference``) always wins — sharded multi-device paths pin
+``"reference"`` because Pallas calls are not shard_map-wrapped yet, and
+the env var must not defeat that pin.  When the config says ``auto``,
+the ``FUSIONINFER_ATTN`` env var may choose; otherwise ``auto`` resolves
+to the Pallas kernels on TPU and the jnp reference elsewhere.
+Resolution happens at trace time — a process serves with one
+implementation.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def resolve_attn(cfg_impl: str = "auto") -> str:
+    impl = cfg_impl
+    if impl == "auto":
+        impl = os.environ.get("FUSIONINFER_ATTN", "") or "auto"
+    if impl == "auto":
+        return "flash" if jax.default_backend() == "tpu" else "reference"
+    if impl not in ("flash", "reference"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    return impl
+
+
+def kernel_interpret() -> bool:
+    """Pallas kernels interpret-execute off-TPU (CPU tests of the kernel path)."""
+    return jax.default_backend() != "tpu"
+
+
+def flash_seq_ok(seq_len: int) -> bool:
+    """Flash tiles need the sequence to divide into full blocks; the
+    engine's power-of-two prefill buckets always satisfy this."""
+    return seq_len % 128 == 0 or (
+        seq_len >= 16 and (seq_len & (seq_len - 1)) == 0
+    )

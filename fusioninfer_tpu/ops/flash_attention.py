@@ -1,0 +1,180 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The prefill-path attention: blockwise online-softmax attention computed
+tile-by-tile in VMEM so the [S, S] score matrix never materializes in
+HBM.  This replaces the O(S²)-memory `_attention` einsum in
+:mod:`fusioninfer_tpu.models.transformer` on the TPU hot path (the
+reference delegates all kernel work to vLLM's CUDA kernels —
+``/root/reference/docs/fusioninfer/docs/design/core-design.md:29``; here the
+kernel layer is in-repo and TPU-native).
+
+Design notes:
+
+* Grid ``(B, H, n_q, n_k)`` — the k axis innermost; output / softmax
+  stats live in VMEM scratch across the k sweep (the classic Pallas TPU
+  flash pattern), so each q tile is written to HBM exactly once.
+* GQA folded into the k/v BlockSpec index maps (``h → h // group``):
+  no materialized head-broadcast of K/V, the kernel reads each KV head
+  once per q-head group.
+* Causal masking by global position; fully-masked tiles short-circuit
+  (``pl.when``) so wave-front cost is ~half of the full rectangle.
+* Accumulation in fp32 regardless of input dtype; bf16 in/out.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # mask value; softmax stats are fp32
+_STATS_LANES = 128  # lane width for the m/l scratch tiles
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, causal: bool, sm_scale: float, block_q: int, block_k: int, n_k: int,
+):
+    i = pl.program_id(2)  # q tile
+    j = pl.program_id(3)  # k tile
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Tiles strictly above the causal diagonal contribute nothing.
+    needed = True if not causal else j * block_k <= i * block_q + block_q - 1
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0, 0]  # [block_q, Hd]
+        k = k_ref[0, 0]  # [block_k, Hd]
+        v = v_ref[0, 0]  # [block_k, Hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [block_q, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [block_q, block_k] fp32
+        alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    last_j = n_k - 1 if not causal else jnp.minimum(
+        (i * block_q + block_q - 1) // block_k, n_k - 1
+    )
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-20)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,  # [B, S, H, Hd]
+    k: jax.Array,  # [B, S, KV, Hd]
+    v: jax.Array,  # [B, S, KV, Hd]
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blockwise exact attention → [B, S, H·Hd] (model layer layout).
+
+    ``S`` must divide by the (possibly clamped) block sizes — the engine's
+    power-of-two prefill buckets guarantee that.  ``interpret=True`` runs
+    the same kernel in the Pallas interpreter (CPU tests).
+    """
+    B, S, H, Hd = q.shape
+    KV = k.shape[2]
+    group = H // KV
+    sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"seq len {S} not divisible by blocks ({block_q},{block_k})")
+    n_q, n_k = S // block_q, S // block_k
+
+    # [B, S, H, Hd] → [B, H, S, Hd]: tile the sequence, one head per program.
+    qT = jnp.swapaxes(q, 1, 2)
+    kT = jnp.swapaxes(k, 1, 2)
+    vT = jnp.swapaxes(v, 1, 2)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, n_k=n_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, Hd), lambda b, h, i, j: (b, h, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, Hd), lambda b, h, i, j, g=group: (b, h // g, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, Hd), lambda b, h, i, j, g=group: (b, h // g, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, Hd), lambda b, h, i, j: (b, h, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, Hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # l
+            pltpu.VMEM((block_q, Hd), jnp.float32),  # acc
+        ],
+        interpret=interpret,
+    )(qT, kT, vT)
+    return jnp.swapaxes(out, 1, 2).reshape(B, S, H * Hd)
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """jnp oracle with identical GQA semantics, for tests and CPU fallback."""
+    B, S, H, Hd = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    qg = q.reshape(B, S, KV, G, Hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(Hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, H * Hd).astype(q.dtype)

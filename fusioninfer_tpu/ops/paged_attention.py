@@ -1,0 +1,192 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+The decode-path attention for the continuous-batching engine: each
+sequence's KV context lives in non-contiguous cache pages
+(:mod:`fusioninfer_tpu.engine.kv_cache`); this kernel streams exactly the
+live pages HBM→VMEM per (sequence, kv-head) program with double-buffered
+DMA and an online softmax — no materialized ``cache[page_tables]``
+gather (which copies the whole context through HBM every step, the
+portable-baseline cost in :mod:`fusioninfer_tpu.engine.model_runner`).
+
+Equivalent capability in the reference is vLLM's CUDA PagedAttention,
+which FusionInfer only orchestrates (SURVEY §0); here it is an in-repo
+TPU kernel.
+
+Layout: pages ``[n_pages, page_size, KV, Hd]``; grid ``(B, KV)``; the
+``G = H // KV`` query heads of a group attend together so each KV page
+is read once per group.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_kernel(
+    # scalar prefetch
+    page_tables_ref,  # [B, mp] int32 (SMEM)
+    lengths_ref,  # [B] int32 — context length incl. the current token
+    # inputs
+    q_ref,  # [1, 1, G, Hd] VMEM block
+    k_pages_ref,  # [n_pages, ps, KV, Hd] in HBM/ANY
+    v_pages_ref,  # [n_pages, ps, KV, Hd] in HBM/ANY
+    # output
+    o_ref,  # [1, 1, G, Hd] VMEM block
+    # scratch
+    k_buf,  # [2, ps, Hd] VMEM
+    v_buf,  # [2, ps, Hd] VMEM
+    sem,  # DMA semaphores [2, 2]
+    *,
+    max_pages: int,
+    page_size: int,
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    length = lengths_ref[b]
+    n_used = pl.cdiv(length, page_size)  # live pages for this sequence
+
+    def dma(slot, p):
+        page = page_tables_ref[b, p]
+        return (
+            pltpu.make_async_copy(
+                k_pages_ref.at[page, :, g, :], k_buf.at[slot], sem.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                v_pages_ref.at[page, :, g, :], v_buf.at[slot], sem.at[slot, 1]
+            ),
+        )
+
+    @pl.when(n_used > 0)
+    def _start_first():
+        for c in dma(0, 0):
+            c.start()
+
+    G, Hd = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [G, Hd]
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = p % 2
+
+        @pl.when(p + 1 < n_used)
+        def _prefetch_next():
+            for c in dma((p + 1) % 2, p + 1):
+                c.start()
+
+        for c in dma(slot, p):
+            c.wait()
+        k = k_buf[slot]  # [ps, Hd]
+        v = v_buf[slot]
+
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, ps]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (G, page_size), 1
+        )
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        pexp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(pexp, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((G, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((G, 1), jnp.float32)
+    a0 = jnp.zeros((G, Hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, a0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret")
+)
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, Hd] — one query token per sequence
+    k_pages: jax.Array,  # [n_pages, page_size, KV, Hd]
+    v_pages: jax.Array,  # [n_pages, page_size, KV, Hd]
+    page_tables: jax.Array,  # [B, max_pages] int32
+    lengths: jax.Array,  # [B] int32, context length incl. current token
+    *,
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Batched one-token attention over paged KV → [B, H·Hd].
+
+    Inactive batch slots should pass ``lengths = 0`` (output is zeros).
+    """
+    B, H, Hd = q.shape
+    _, page_size, KV, _ = k_pages.shape
+    G = H // KV
+    max_pages = page_tables.shape[1]
+    sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
+
+    qg = q.reshape(B, KV, G, Hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, Hd), k_pages.dtype),
+            pltpu.VMEM((2, page_size, Hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel,
+        max_pages=max_pages, page_size=page_size, sm_scale=sm_scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, Hd), q.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg,
+      k_pages, v_pages)
+    return out.reshape(B, H * Hd)
+
+
+def reference_paged_attention(q, k_pages, v_pages, page_tables, lengths):
+    """Gather-based jnp oracle (same math as the engine's portable path)."""
+    B, H, Hd = q.shape
+    _, ps, KV, _ = k_pages.shape
+    G = H // KV
+    mp = page_tables.shape[1]
+    k_ctx = k_pages[page_tables].reshape(B, mp * ps, KV, Hd)
+    v_ctx = v_pages[page_tables].reshape(B, mp * ps, KV, Hd)
+    qg = q.reshape(B, KV, G, Hd)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
+                   k_ctx.astype(jnp.float32)) / jnp.sqrt(Hd)
+    pos = jnp.arange(mp * ps)[None, :]
+    s = jnp.where((pos < lengths[:, None])[:, None, None, :], s, NEG_INF)
+    # inactive slots (length 0) are fully masked: zero their output
+    probs = jax.nn.softmax(s, axis=-1) * (lengths > 0)[:, None, None, None]
+    out = jnp.einsum("bkgt,btkd->bkgd", probs, v_ctx.astype(jnp.float32))
+    return out.reshape(B, H * Hd).astype(q.dtype)

@@ -66,6 +66,18 @@ def param_specs(cfg: ModelConfig) -> Params:
     return specs
 
 
+def spmd_cfg(cfg: ModelConfig, mesh: Mesh) -> ModelConfig:
+    """Pin the jnp attention on multi-device meshes: Pallas calls are not
+    shard_map-wrapped yet, so SPMD paths must stay pure-XLA.  The single
+    source of this invariant — both the sharded train/forward steps and
+    the tp>1 engine call it."""
+    import dataclasses
+
+    if mesh.size > 1 and cfg.attn_impl != "reference":
+        return dataclasses.replace(cfg, attn_impl="reference")
+    return cfg
+
+
 def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Params:
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),

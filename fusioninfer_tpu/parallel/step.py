@@ -24,6 +24,7 @@ Params = dict[str, Any]
 
 def make_forward(cfg: ModelConfig, mesh: Mesh) -> Callable[[Params, jax.Array], jax.Array]:
     """Sharded full-sequence forward: tokens [B, S] → logits [B, S, V]."""
+    cfg = sharding.spmd_cfg(cfg, mesh)
     return jax.jit(
         lambda params, tokens: forward(cfg, params, tokens),
         in_shardings=(
@@ -51,6 +52,7 @@ def make_train_step(
     ``train_step(params, opt_state, tokens) -> (params, opt_state, loss)``
     donates the old state buffers.
     """
+    cfg = sharding.spmd_cfg(cfg, mesh)
     opt = optimizer if optimizer is not None else default_optimizer()
     p_shard = sharding.param_shardings(cfg, mesh)
 

@@ -1,0 +1,61 @@
+"""Pallas flash attention vs the jnp oracle (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fusioninfer_tpu.ops.flash_attention import flash_attention, reference_attention
+
+
+def _qkv(B, S, H, KV, Hd, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, S, H, Hd), dtype)
+    k = jax.random.normal(ks[1], (B, S, KV, Hd), dtype)
+    v = jax.random.normal(ks[2], (B, S, KV, Hd), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("H,KV", [(4, 4), (4, 2), (8, 2)])
+def test_matches_reference(causal, H, KV):
+    q, k, v = _qkv(2, 256, H, KV, 64)
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_multiple_q_and_k_tiles_with_uneven_blocks():
+    # block_q != block_k exercises the causal last_j arithmetic
+    q, k, v = _qkv(1, 256, 4, 2, 64, seed=3)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=64,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_small_sequence_clamps_blocks():
+    q, k, v = _qkv(2, 64, 4, 2, 64, seed=1)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(1, 128, 4, 2, 64, dtype=jnp.bfloat16, seed=2)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_indivisible_seq_raises():
+    q, k, v = _qkv(1, 96, 4, 2, 64)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)

@@ -40,7 +40,10 @@ def test_manager_reconciles_from_initial_list(unused_tcp_port=18081):
             },
         }
     )
-    mgr = Manager(fake, namespace="default", probe_port=unused_tcp_port)
+    mgr = Manager(
+        fake, namespace="default", probe_port=unused_tcp_port,
+        metrics_port=unused_tcp_port + 1,
+    )
     mgr.start()
     try:
         deadline = time.time() + 5
@@ -53,6 +56,18 @@ def test_manager_reconciles_from_initial_list(unused_tcp_port=18081):
             assert r.status == 200
         with urllib.request.urlopen(f"http://127.0.0.1:{unused_tcp_port}/readyz") as r:
             assert r.status == 200
+        # the reconcile above must be visible on the metrics endpoint
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{unused_tcp_port + 1}/metrics"
+            ) as r:
+                body = r.read().decode()
+            if 'controller_runtime_reconcile_total{controller="inferenceservice"} 0' not in body:
+                break
+            time.sleep(0.05)
+        assert "controller_runtime_reconcile_total" in body
+        assert 'controller_runtime_reconcile_total{controller="inferenceservice"} 0' not in body
     finally:
         mgr.stop()
 

@@ -1,0 +1,78 @@
+"""Deploy-tree generation: structure, RBAC coverage, and drift fence."""
+
+import os
+
+import yaml
+
+from fusioninfer_tpu import GROUP
+from fusioninfer_tpu.operator.manager import OWNED_KINDS
+from fusioninfer_tpu.operator.manifests import (
+    config_tree,
+    manager_deployment,
+    manager_role,
+    write_config_tree,
+)
+
+# kind → (apiGroup, plural) the manager role must cover
+_KIND_RULES = {
+    "LeaderWorkerSet": ("leaderworkerset.x-k8s.io", "leaderworkersets"),
+    "PodGroup": ("scheduling.volcano.sh", "podgroups"),
+    "ConfigMap": ("", "configmaps"),
+    "Service": ("", "services"),
+    "ServiceAccount": ("", "serviceaccounts"),
+    "Deployment": ("apps", "deployments"),
+    "Role": ("rbac.authorization.k8s.io", "roles"),
+    "RoleBinding": ("rbac.authorization.k8s.io", "rolebindings"),
+    "InferencePool": ("inference.networking.k8s.io", "inferencepools"),
+    "HTTPRoute": ("gateway.networking.k8s.io", "httproutes"),
+}
+
+
+def test_manager_role_covers_every_owned_kind():
+    rules = manager_role()["rules"]
+
+    def covered(group, plural):
+        return any(
+            group in r["apiGroups"] and plural in r["resources"] and "create" in r["verbs"]
+            for r in rules
+        )
+
+    for kind in OWNED_KINDS:
+        group, plural = _KIND_RULES[kind]
+        assert covered(group, plural), f"manager role misses {kind}"
+    assert any(
+        GROUP in r["apiGroups"] and "inferenceservices/status" in r["resources"]
+        for r in rules
+    )
+
+
+def test_manager_deployment_probes_and_security():
+    dep = manager_deployment()
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["livenessProbe"]["httpGet"]["port"] == 8081
+    assert c["readinessProbe"]["httpGet"]["port"] == 8081
+    assert c["securityContext"]["allowPrivilegeEscalation"] is False
+    assert c["securityContext"]["capabilities"]["drop"] == ["ALL"]
+    ports = {p["name"]: p["containerPort"] for p in c["ports"]}
+    assert ports == {"metrics": 8443, "probes": 8081}
+
+
+def test_tree_roundtrips_and_kustomizations_reference_real_files():
+    tree = config_tree()
+    for rel, content in tree.items():
+        if rel.endswith("kustomization.yaml") and "default" not in rel:
+            base = os.path.dirname(rel)
+            for res in content["resources"]:
+                assert os.path.join(base, res) in tree, f"{rel} references missing {res}"
+
+
+def test_write_config_tree_matches_committed_config(tmp_path):
+    """The committed config/ must equal a fresh render (CI drift fence)."""
+    written = write_config_tree(str(tmp_path))
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for path in written:
+        rel = os.path.relpath(path, tmp_path)
+        committed = os.path.join(repo_root, "config", rel)
+        assert os.path.exists(committed), f"config/{rel} not committed — run make manifests"
+        with open(path) as a, open(committed) as b:
+            assert yaml.safe_load(a) == yaml.safe_load(b), f"config/{rel} drifted"
