@@ -1,8 +1,9 @@
-# Controller-manager / native-engine image.
+# Controller-manager / native-engine images.
 # The reference builds a distroless Go binary; this build is a slim Python
-# runtime carrying the operator (pure stdlib + pyyaml) and, optionally,
-# the JAX TPU engine (installed only when ENGINE=tpu to keep the
-# controller image small).
+# runtime. Two targets:
+#   controller (default) — operator only: stdlib + pyyaml, no JAX.
+#   engine — JAX TPU serving + weight loading (safetensors, orbax,
+#            huggingface_hub); also the image ModelLoader Jobs run.
 
 FROM python:3.12-slim AS base
 WORKDIR /app
@@ -16,9 +17,12 @@ USER 65532:65532
 ENTRYPOINT ["python", "-m", "fusioninfer_tpu.cli"]
 CMD ["controller", "run"]
 
-# Engine image: JAX with TPU support for the native serving path.
+# Engine image: TPU serving + loader entrypoints (ModelLoader Jobs use this).
 FROM base AS engine
-RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+RUN pip install --no-cache-dir \
+        numpy safetensors orbax-checkpoint optax huggingface_hub && \
+    pip install --no-cache-dir "jax[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
 USER 65532:65532
 ENTRYPOINT ["python", "-m", "fusioninfer_tpu.cli"]
 CMD ["engine", "serve"]

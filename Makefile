@@ -50,15 +50,16 @@ render-samples: ## Dry-run render every sample InferenceService.
 ##@ Build
 
 .PHONY: docker-build
-docker-build: ## Build the controller/engine image.
-	docker build -t $(IMG) .
+docker-build: ## Build the controller image.
+	docker build --target controller -t $(IMG) .
+
+.PHONY: docker-build-engine
+docker-build-engine: ## Build the engine image (JAX TPU + loader deps).
+	docker build --target engine -t fusioninfer-tpu-engine:latest .
 
 .PHONY: build-installer
-build-installer: manifests ## Single-file install manifest.
-	mkdir -p dist
-	$(PYTHON) -c "import yaml,sys; from fusioninfer_tpu.operator.manifests import config_tree; \
-docs=[v for k,v in config_tree().items() if k.endswith('.yaml') and 'kustomization' not in k]; \
-yaml.safe_dump_all(docs, open('dist/install.yaml','w'), sort_keys=False)"
+build-installer: manifests ## Single-file install manifest (kustomize transforms applied).
+	$(PYTHON) -m fusioninfer_tpu.cli render installer --out dist/install.yaml
 
 ##@ Deployment
 

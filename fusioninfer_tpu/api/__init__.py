@@ -21,6 +21,7 @@ from fusioninfer_tpu.api.types import (
     ValidationError,
 )
 from fusioninfer_tpu.api.crd import build_crd
+from fusioninfer_tpu.api.modelloader import ModelLoader, ModelLoaderSpec, build_loader_crd
 
 __all__ = [
     "ACCELERATOR_TYPES",
@@ -42,4 +43,7 @@ __all__ = [
     "TPUSlice",
     "ValidationError",
     "build_crd",
+    "ModelLoader",
+    "ModelLoaderSpec",
+    "build_loader_crd",
 ]

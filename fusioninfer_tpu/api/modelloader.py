@@ -1,0 +1,163 @@
+"""ModelLoader API: declarative model-weight download/convert jobs.
+
+The reference scaffolded this subsystem and left it empty (CRD with a
+single ``Foo`` field, ``api/core/v1alpha1/modelloader_types.go:27-36``;
+no-op reconciler ``pkg/controller/modelloader_controller.go:49-55``).
+Here it is implemented: a ModelLoader declares a HuggingFace source and a
+PVC destination; the controller runs a Job (the engine image's
+``loader fetch`` entrypoint) that downloads the weights — optionally
+converting to the native orbax format TPU serving restores fastest —
+and surfaces the Job's phase in status.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from fusioninfer_tpu import API_VERSION, GROUP
+from fusioninfer_tpu.api.types import ValidationError
+
+LOADER_KIND = "ModelLoader"
+LOADER_PLURAL = "modelloaders"
+# Download Jobs need the loader deps (huggingface_hub, safetensors, orbax),
+# which live in the engine image — not the JAX-free controller image.
+DEFAULT_LOADER_IMAGE = "fusioninfer-tpu-engine:latest"
+
+
+@dataclass
+class HFSource:
+    repo: str = ""
+    revision: str = "main"
+
+
+@dataclass
+class Destination:
+    pvc: str = ""
+    path: str = "/models"
+
+
+@dataclass
+class ModelLoaderSpec:
+    source: HFSource = field(default_factory=HFSource)
+    destination: Destination = field(default_factory=Destination)
+    convert: bool = False
+    image: str = DEFAULT_LOADER_IMAGE
+
+
+@dataclass
+class ModelLoader:
+    name: str = ""
+    namespace: str = "default"
+    uid: Optional[str] = None
+    generation: int = 1
+    spec: ModelLoaderSpec = field(default_factory=ModelLoaderSpec)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModelLoader":
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        src = spec.get("source") or {}
+        hf = src.get("hf") or {}
+        dst = spec.get("destination") or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            uid=meta.get("uid"),
+            generation=meta.get("generation", 1),
+            spec=ModelLoaderSpec(
+                source=HFSource(
+                    repo=hf.get("repo", ""), revision=hf.get("revision", "main")
+                ),
+                destination=Destination(
+                    pvc=dst.get("pvc", ""), path=dst.get("path", "/models")
+                ),
+                convert=bool(spec.get("convert", False)),
+                image=spec.get("image", DEFAULT_LOADER_IMAGE),
+            ),
+        )
+
+    def validate(self) -> "ModelLoader":
+        if not self.name:
+            raise ValidationError("metadata.name required")
+        if not self.spec.source.repo:
+            raise ValidationError("spec.source.hf.repo required")
+        if not self.spec.destination.pvc:
+            raise ValidationError("spec.destination.pvc required")
+        if not self.spec.destination.path.startswith("/"):
+            raise ValidationError("spec.destination.path must be absolute")
+        return self
+
+
+def build_loader_crd() -> dict:
+    """CRD manifest (the reference generated its stub with controller-gen)."""
+    raw: dict[str, Any] = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{LOADER_PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": LOADER_KIND,
+                "listKind": f"{LOADER_KIND}List",
+                "plural": LOADER_PLURAL,
+                "singular": "modelloader",
+                "shortNames": ["ml"],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": API_VERSION.split("/")[-1],
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {"name": "Repo", "type": "string", "jsonPath": ".spec.source.hf.repo"},
+                        {"name": "Phase", "type": "string", "jsonPath": ".status.phase"},
+                        {"name": "Age", "type": "date", "jsonPath": ".metadata.creationTimestamp"},
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": {
+                                    "type": "object",
+                                    "required": ["source", "destination"],
+                                    "properties": {
+                                        "source": {
+                                            "type": "object",
+                                            "properties": {
+                                                "hf": {
+                                                    "type": "object",
+                                                    "required": ["repo"],
+                                                    "properties": {
+                                                        "repo": {"type": "string"},
+                                                        "revision": {"type": "string"},
+                                                    },
+                                                }
+                                            },
+                                        },
+                                        "destination": {
+                                            "type": "object",
+                                            "required": ["pvc"],
+                                            "properties": {
+                                                "pvc": {"type": "string"},
+                                                "path": {"type": "string"},
+                                            },
+                                        },
+                                        "convert": {"type": "boolean"},
+                                        "image": {"type": "string"},
+                                    },
+                                },
+                                "status": raw,
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }

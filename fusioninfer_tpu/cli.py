@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 
 import yaml
@@ -53,6 +54,12 @@ def _cmd_render(args: argparse.Namespace) -> int:
         for path in write_config_tree(args.out):
             print(path)
         return 0
+    if args.what == "installer":
+        from fusioninfer_tpu.operator.manifests import write_installer
+
+        write_installer(args.out if args.out != "config" else "dist/install.yaml")
+        print(args.out if args.out != "config" else "dist/install.yaml")
+        return 0
     # resources
     if not args.file:
         print("render resources requires -f <manifest.yaml>", file=sys.stderr)
@@ -82,6 +89,39 @@ def _cmd_engine_serve(args: argparse.Namespace) -> int:
     return serve_from_args(args)
 
 
+def _cmd_loader_convert(args: argparse.Namespace) -> int:
+    from fusioninfer_tpu.models.loader import load_hf_checkpoint, save_checkpoint
+
+    cfg, params = load_hf_checkpoint(args.hf, dtype=args.dtype or None)
+    save_checkpoint(args.out, cfg, params)
+    print(f"converted {args.hf} -> {args.out} ({cfg.name}, {cfg.n_layers} layers)")
+    return 0
+
+
+def _cmd_loader_fetch(args: argparse.Namespace) -> int:
+    """Download weights from the HF hub (the ModelLoader Job's entrypoint)."""
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError:
+        print("huggingface_hub not installed in this image", file=sys.stderr)
+        return 2
+    path = snapshot_download(
+        args.repo, revision=args.revision, local_dir=args.dest,
+        allow_patterns=["*.safetensors", "*.json", "tokenizer*"],
+    )
+    print(f"downloaded {args.repo}@{args.revision} -> {path}")
+    if args.convert:
+        from fusioninfer_tpu.models.loader import load_hf_checkpoint, save_checkpoint
+
+        # keep the converted checkpoint INSIDE dest — in a ModelLoader Job
+        # dest is the PVC mountpoint, and anything outside it is lost
+        native = os.path.join(args.dest, "native")
+        cfg, params = load_hf_checkpoint(path)
+        save_checkpoint(native, cfg, params)
+        print(f"converted -> {native}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fusioninfer-tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -97,7 +137,7 @@ def build_parser() -> argparse.ArgumentParser:
     run.set_defaults(func=_cmd_controller_run)
 
     render = sub.add_parser("render", help="render manifests without a cluster")
-    render.add_argument("what", choices=["crd", "resources", "config"])
+    render.add_argument("what", choices=["crd", "resources", "config", "installer"])
     render.add_argument("-f", "--file", help="InferenceService manifest")
     render.add_argument("--out", default="config", help="output dir for 'config'")
     render.add_argument("--volcano-queue", default="")
@@ -115,12 +155,38 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--hbm-utilization", type=float, default=0.85)
     serve.add_argument("--tensor-parallel-size", type=int, default=1)
     serve.add_argument("--seed", type=int, default=0)
+    serve.add_argument(
+        "--prefill-upstream", default="",
+        help="PD decode role: pull prefills (KV over DCN) from this prefiller URL",
+    )
+    serve.add_argument("--load-hf", default="", help="HF checkpoint dir (safetensors)")
+    serve.add_argument("--load-checkpoint", default="", help="native orbax checkpoint dir")
     serve.set_defaults(func=_cmd_engine_serve)
+
+    loader = sub.add_parser("loader", help="model weight loading / conversion")
+    lsub = loader.add_subparsers(dest="subcommand", required=True)
+    convert = lsub.add_parser("convert", help="HF safetensors → native orbax checkpoint")
+    convert.add_argument("--hf", required=True, help="HF checkpoint directory")
+    convert.add_argument("--out", required=True, help="output checkpoint directory")
+    convert.add_argument("--dtype", default="", help="target dtype (default: model config)")
+    convert.set_defaults(func=_cmd_loader_convert)
+    fetch = lsub.add_parser("fetch", help="download a model repo then convert")
+    fetch.add_argument("--repo", required=True, help="HF hub repo id")
+    fetch.add_argument("--dest", required=True, help="destination directory")
+    fetch.add_argument("--revision", default="main")
+    fetch.add_argument("--convert", action="store_true", help="also write native checkpoint")
+    fetch.set_defaults(func=_cmd_loader_fetch)
 
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
+    if os.environ.get("FUSIONINFER_PLATFORM"):
+        # Force a jax platform (e.g. cpu) before any backend initializes —
+        # needed because ambient site hooks may pre-register an accelerator.
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["FUSIONINFER_PLATFORM"])
     args = build_parser().parse_args(argv)
     return args.func(args)
 

@@ -15,8 +15,10 @@ work always completes.
 from __future__ import annotations
 
 import collections
+import concurrent.futures
 import itertools
 import logging
+import queue as queue_mod
 import threading
 import time
 from dataclasses import dataclass, field
@@ -126,6 +128,15 @@ class NativeEngine:
         self._step_counter = itertools.count()
 
         self.waiting: collections.deque[Request] = collections.deque()
+        # PD decode side: requests whose KV arrived from a prefill worker
+        self.waiting_prefilled: collections.deque[tuple[Request, "KVSlab"]] = (
+            collections.deque()
+        )
+        # PD prefill side: slab requests served inside step() so only the
+        # engine thread ever touches the cache
+        self._slab_q: "queue_mod.Queue[tuple[Request, concurrent.futures.Future]]" = (
+            queue_mod.Queue()
+        )
         self.running: dict[int, _SeqState] = {}  # slot -> state
         self._free_slots = list(reversed(range(max_batch_size)))
         self._cancelled: set[str] = set()
@@ -155,14 +166,140 @@ class NativeEngine:
 
     @property
     def num_waiting(self) -> int:
-        return len(self.waiting)
+        return len(self.waiting) + len(self.waiting_prefilled)
 
     @property
     def num_running(self) -> int:
         return len(self.running)
 
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(
+            self.waiting or self.waiting_prefilled or self.running
+            or not self._slab_q.empty()
+        )
+
+    # -- PD disaggregation ---------------------------------------------------
+
+    def request_prefill_slab(self, request: Request) -> concurrent.futures.Future:
+        """Prefill-worker side: queue a prefill whose KV leaves as a slab.
+        Served inside :meth:`step` (engine thread owns the cache); resolves
+        to a :class:`fusioninfer_tpu.engine.kv_transfer.KVSlab`."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._slab_q.put((request, fut))
+        return fut
+
+    def add_prefilled_request(self, request: Request, slab) -> None:
+        """Decode-worker side: admit a request whose prefill (KV + first
+        token) was computed remotely; generation continues from there."""
+        if slab.page_size != self.cache_cfg.page_size:
+            raise ValueError(
+                f"slab page_size {slab.page_size} != engine page_size "
+                f"{self.cache_cfg.page_size}"
+            )
+        if len(slab.prompt_tokens) + request.params.max_tokens > self.cache_cfg.max_len:
+            raise ValueError("prompt+max_tokens exceeds engine max_len")
+        with self._lock:
+            self.waiting_prefilled.append((request, slab))
+
+    def _serve_slab_requests(self) -> None:
+        from fusioninfer_tpu.engine.kv_transfer import extract_slab
+
+        while True:
+            try:
+                request, fut = self._slab_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            prefix = request.prompt_tokens
+            need = self.alloc.pages_needed(len(prefix))
+            if (need > self.cache_cfg.max_pages_per_seq
+                    or need > self.cache_cfg.n_pages - 1):
+                # permanently infeasible: fail now, don't spin
+                self.errors_total += 1
+                fut.set_exception(ValueError(
+                    f"prompt of {len(prefix)} tokens exceeds prefill cache capacity"
+                ))
+                continue
+            if need > self.alloc.free_pages:
+                # transient pressure (pages held by running work): retry on
+                # the next step instead of failing the decoder's client.
+                # (The future stays pending, so the retry can still run it.)
+                self._slab_q.put((request, fut))
+                return
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                rid = request.request_id
+                self.alloc.allocate(rid, len(prefix))
+                try:
+                    row = jnp.asarray(self.alloc.page_table_row(rid))
+                    bucket = pick_bucket(self.buckets, len(prefix))
+                    padded = np.zeros((1, bucket), np.int32)
+                    padded[0, : len(prefix)] = prefix
+                    self.cache, logits = prefill(
+                        self.cfg, self.cache_cfg, self.params, self.cache,
+                        jnp.asarray(padded), jnp.int32(len(prefix)), row,
+                    )
+                    token = int(
+                        sample(
+                            logits,
+                            self._next_key(),
+                            jnp.asarray([request.params.temperature]),
+                            jnp.asarray([request.params.top_k], jnp.int32),
+                            jnp.asarray([request.params.top_p]),
+                        )[0]
+                    )
+                    slab = extract_slab(
+                        self.cache, self.alloc.pages_of(rid), prefix, token,
+                        self.cache_cfg.page_size,
+                    )
+                finally:
+                    self.alloc.release(rid)
+                self.prompt_tokens_total += len(prefix)
+                fut.set_result(slab)
+            except Exception as e:
+                self.errors_total += 1
+                fut.set_exception(e)
+
+    def _admit_prefilled(self) -> list[StepOutput]:
+        from fusioninfer_tpu.engine.kv_transfer import inject_slab
+
+        outputs = []
+        while self.waiting_prefilled and self._free_slots:
+            with self._lock:
+                request, slab = self.waiting_prefilled[0]
+                prefix = slab.prompt_tokens
+                if not self.alloc.can_allocate(len(prefix) + 1):
+                    break
+                self.waiting_prefilled.popleft()
+            try:
+                self.alloc.allocate(request.request_id, len(prefix) + 1)
+                self.cache = inject_slab(
+                    self.cache, slab, self.alloc.pages_of(request.request_id)
+                )
+                slot = self._free_slots.pop()
+                state = _SeqState(
+                    request=request,
+                    tokens=list(prefix) + [slab.first_token],
+                    n_prompt=len(request.prompt_tokens),
+                    slot=slot,
+                    first_token_time=time.monotonic(),
+                )
+                self.running[slot] = state
+                self.generation_tokens_total += 1
+                outputs.append(self._emit(state, slab.first_token, first=True))
+            except Exception as e:
+                logger.exception("prefilled admission of %s failed", request.request_id)
+                self.alloc.release(request.request_id)
+                self.errors_total += 1
+                outputs.append(
+                    StepOutput(
+                        request_id=request.request_id,
+                        token=0,
+                        finished=True,
+                        finish_reason=f"error:{e}",
+                    )
+                )
+        return outputs
 
     def kv_cache_usage(self) -> float:
         return self.alloc.utilization()
@@ -176,7 +313,9 @@ class NativeEngine:
     def step(self) -> list[StepOutput]:
         """Admit + prefill new work, then one batched decode pass."""
         self._process_cancellations()
+        self._serve_slab_requests()
         outputs: list[StepOutput] = []
+        outputs += self._admit_prefilled()
         outputs += self._admit()
         outputs += self._decode()
         return [o for o in outputs if o is not None]
@@ -192,6 +331,12 @@ class NativeEngine:
             )
             self.cancelled_total += len(self.waiting) - len(kept)
             self.waiting = kept
+            kept_p = collections.deque(
+                (r, s) for r, s in self.waiting_prefilled
+                if r.request_id not in cancelled
+            )
+            self.cancelled_total += len(self.waiting_prefilled) - len(kept_p)
+            self.waiting_prefilled = kept_p
         for state in [s for s in self.running.values()
                       if s.request.request_id in cancelled]:
             self._finish(state, outcome="cancelled")

@@ -1,0 +1,202 @@
+"""KV-cache transfer for prefill/decode disaggregation.
+
+The reference realizes PD disaggregation purely by orchestration: distinct
+prefiller/decoder roles, EPP ``pd-profile-handler`` routing, and vLLM
+connector flags (``PyNcclConnector`` / ``NixlConnector``) passed through
+user templates (``docs/.../core-design.md:85-107``, ``router.md:131-143``).
+Here the transfer itself is in-repo and TPU-shaped: a prefill worker
+extracts a sequence's KV pages into a contiguous **slab**, a connector
+moves the slab prefiller→decoder (over DCN between slices; in-process for
+tests), and the decode engine injects it into its own paged cache and
+continues generation exactly where prefill left off.
+
+Slab layout ``[L, n_pages, page_size, KV, Hd]`` (k and v) — page-granular
+so extract/inject are single gather/scatter ops on device, and the wire
+format stays independent of either side's page-pool size.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import queue
+import struct
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class KVSlab:
+    """One sequence's KV context plus what decode needs to resume."""
+
+    k: jnp.ndarray  # [L, n_pages, ps, KV, Hd]
+    v: jnp.ndarray
+    prompt_tokens: list[int]
+    first_token: int
+    page_size: int
+
+    @property
+    def n_tokens(self) -> int:
+        return len(self.prompt_tokens)
+
+
+def extract_slab(cache: dict, pages: list[int], prompt_tokens: list[int],
+                 first_token: int, page_size: int) -> KVSlab:
+    """Gather a sequence's pages out of a paged cache (device-side gather,
+    then the caller decides when/where the slab crosses host/DCN)."""
+    idx = jnp.asarray(pages, jnp.int32)
+    return KVSlab(
+        k=cache["k"][:, idx],
+        v=cache["v"][:, idx],
+        prompt_tokens=list(prompt_tokens),
+        first_token=first_token,
+        page_size=page_size,
+    )
+
+
+def inject_slab(cache: dict, slab: KVSlab, pages: list[int]) -> dict:
+    """Scatter a slab into this engine's cache at ``pages`` (the decode
+    side's own allocation; may be longer than the slab — extra pages are
+    growth room for generation)."""
+    n = slab.k.shape[1]
+    if len(pages) < n:
+        raise ValueError(f"need {n} pages to inject, got {len(pages)}")
+    idx = jnp.asarray(pages[:n], jnp.int32)
+    return {
+        "k": cache["k"].at[:, idx].set(slab.k.astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, idx].set(slab.v.astype(cache["v"].dtype)),
+    }
+
+
+# -- wire format -------------------------------------------------------------
+
+_MAGIC = b"FIKV1\n"
+
+
+def _arr_bytes(a: jnp.ndarray) -> tuple[dict, bytes]:
+    np_a = np.asarray(a)
+    dtype = str(a.dtype)
+    if dtype == "bfloat16":  # raw-transport bf16 as uint16
+        np_a = np_a.view(np.uint16)
+    return {"shape": list(a.shape), "dtype": dtype}, np_a.tobytes()
+
+
+def _arr_from(meta: dict, raw: bytes) -> jnp.ndarray:
+    dtype = meta["dtype"]
+    shape = tuple(meta["shape"])
+    if dtype == "bfloat16":
+        np_a = np.frombuffer(raw, np.uint16).reshape(shape)
+        return jnp.asarray(np_a.view(jnp.bfloat16))  # bf16 is a numpy dtype via ml_dtypes
+    return jnp.asarray(np.frombuffer(raw, np.dtype(dtype)).reshape(shape))
+
+
+def slab_to_bytes(slab: KVSlab) -> bytes:
+    """Self-describing binary frame: magic, JSON header, k bytes, v bytes."""
+    k_meta, k_raw = _arr_bytes(slab.k)
+    v_meta, v_raw = _arr_bytes(slab.v)
+    header = json.dumps({
+        "k": k_meta,
+        "v": v_meta,
+        "prompt_tokens": slab.prompt_tokens,
+        "first_token": slab.first_token,
+        "page_size": slab.page_size,
+        "k_len": len(k_raw),
+        "v_len": len(v_raw),
+    }).encode()
+    out = io.BytesIO()
+    out.write(_MAGIC)
+    out.write(struct.pack(">I", len(header)))
+    out.write(header)
+    out.write(k_raw)
+    out.write(v_raw)
+    return out.getvalue()
+
+
+def slab_from_bytes(data: bytes) -> KVSlab:
+    if data[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("not a KV slab frame")
+    off = len(_MAGIC)
+    (hlen,) = struct.unpack(">I", data[off : off + 4])
+    off += 4
+    header = json.loads(data[off : off + hlen])
+    off += hlen
+    k_raw = data[off : off + header["k_len"]]
+    off += header["k_len"]
+    v_raw = data[off : off + header["v_len"]]
+    return KVSlab(
+        k=_arr_from(header["k"], k_raw),
+        v=_arr_from(header["v"], v_raw),
+        prompt_tokens=list(header["prompt_tokens"]),
+        first_token=header["first_token"],
+        page_size=header["page_size"],
+    )
+
+
+# -- connectors --------------------------------------------------------------
+
+
+class KVConnector(Protocol):
+    """Moves slabs prefiller→decoder.  Implementations: in-process queue
+    (tests / co-located roles) and HTTP pull over DCN (cross-slice)."""
+
+    def put(self, request_id: str, slab: KVSlab) -> None: ...
+
+    def get(self, request_id: str, timeout: float = 30.0) -> KVSlab: ...
+
+
+@dataclass
+class InProcessConnector:
+    """Same-process handoff (also the fake for unit tests)."""
+
+    _slabs: dict[str, "queue.Queue[KVSlab]"] = field(default_factory=dict)
+
+    def _q(self, request_id: str) -> "queue.Queue[KVSlab]":
+        return self._slabs.setdefault(request_id, queue.Queue(maxsize=1))
+
+    def put(self, request_id: str, slab: KVSlab) -> None:
+        self._q(request_id).put(slab)
+
+    def get(self, request_id: str, timeout: float = 30.0) -> KVSlab:
+        slab = self._q(request_id).get(timeout=timeout)
+        self._slabs.pop(request_id, None)
+        return slab
+
+
+@dataclass
+class HTTPPullConnector:
+    """Decode side pulls from the prefiller's ``/v1/prefill`` endpoint.
+
+    ``put`` is a no-op — the prefiller computes on demand inside the pull
+    (NIXL-style pull model: the decoder initiates, so KV never waits in
+    prefiller memory).  ``prefill_url`` points at the prefiller service
+    the operator renders for the prefiller role; the transfer rides DCN.
+    """
+
+    prefill_url: str
+    sampling: Optional[dict] = None
+
+    def put(self, request_id: str, slab: KVSlab) -> None:  # pragma: no cover
+        raise NotImplementedError("pull connector: decoder initiates")
+
+    def request_prefill(self, request_id: str, prompt_tokens: list[int],
+                        sampling: Optional[dict] = None,
+                        timeout: float = 120.0) -> KVSlab:
+        body = json.dumps({
+            "request_id": request_id,
+            "prompt_tokens": prompt_tokens,
+            "sampling": sampling or self.sampling or {},
+        }).encode()
+        req = urllib.request.Request(
+            self.prefill_url.rstrip("/") + "/v1/prefill",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return slab_from_bytes(resp.read())
+
+    def get(self, request_id: str, timeout: float = 30.0) -> KVSlab:
+        raise NotImplementedError("use request_prefill (needs the prompt)")

@@ -84,8 +84,15 @@ class EngineServer:
         tokenizer=None,
         engine: NativeEngine | None = None,
         seed: int = 0,
+        prefill_upstream: str | None = None,
     ):
+        """``prefill_upstream``: PD-disaggregated decode mode — completions
+        pull their prefill (KV slab + first token) from the prefiller
+        service at this URL instead of prefilling locally; the transfer
+        rides DCN between slices.  Every server also exposes
+        ``/v1/prefill`` so any instance can act as the prefiller role."""
         self.model_name = model
+        self.prefill_upstream = prefill_upstream
         if engine is None:
             # resolve the preset lazily so injected engines may carry any
             # model name (fine-tunes, tests)
@@ -146,7 +153,22 @@ class EngineServer:
                 "last_token_time": time.monotonic(),
             }
         try:
-            self.engine.add_request(Request(request_id, prompt_tokens, params))
+            request = Request(request_id, prompt_tokens, params)
+            if self.prefill_upstream:
+                # PD decode role: pull KV from the prefiller over DCN
+                from fusioninfer_tpu.engine.kv_transfer import HTTPPullConnector
+
+                slab = HTTPPullConnector(self.prefill_upstream).request_prefill(
+                    request_id, prompt_tokens,
+                    sampling={
+                        "temperature": params.temperature,
+                        "top_k": params.top_k,
+                        "top_p": params.top_p,
+                    },
+                )
+                self.engine.add_prefilled_request(request, slab)
+            else:
+                self.engine.add_request(request)
         except Exception:
             # rejected before entering the engine: unregister or the
             # channel/meta entries leak on every bad request
@@ -155,6 +177,25 @@ class EngineServer:
                 self._req_meta.pop(request_id, None)
             raise
         return chan
+
+    def handle_prefill(self, body: dict) -> bytes:
+        """Prefiller role: run one prefill, return the KV slab frame."""
+        from fusioninfer_tpu.engine.kv_transfer import slab_to_bytes
+
+        prompt_tokens = [int(t) for t in body.get("prompt_tokens", [])]
+        if not prompt_tokens:
+            raise ValueError("prompt_tokens required")
+        sampling = body.get("sampling") or {}
+        params = SamplingParams(
+            temperature=float(sampling.get("temperature", 1.0)),
+            top_k=int(sampling.get("top_k", 0)),
+            top_p=float(sampling.get("top_p", 1.0)),
+            max_tokens=1,
+        )
+        rid = body.get("request_id") or uuid.uuid4().hex[:16]
+        fut = self.engine.request_prefill_slab(Request(rid, prompt_tokens, params))
+        slab = fut.result(timeout=120.0)
+        return slab_to_bytes(slab)
 
     def _release(self, chan: _RequestChannel) -> None:
         with self._lock:
@@ -353,6 +394,13 @@ class EngineServer:
                             self._stream(body, chat=True)
                         else:
                             self._send_json(server.handle_chat(body))
+                    elif self.path == "/v1/prefill":
+                        frame = server.handle_prefill(body)
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/octet-stream")
+                        self.send_header("Content-Length", str(len(frame)))
+                        self.end_headers()
+                        self.wfile.write(frame)
                     else:
                         self._send_json({"error": {"message": f"not found: {self.path}"}}, 404)
                 except ValueError as e:
@@ -422,7 +470,24 @@ def serve_from_args(args) -> int:
     from fusioninfer_tpu.engine.kv_cache import auto_cache_config
     from fusioninfer_tpu.parallel import build_mesh, infer_mesh_config
 
-    cfg = get_preset(args.model)
+    load_hf = getattr(args, "load_hf", "") or ""
+    load_ckpt = getattr(args, "load_checkpoint", "") or ""
+    params = None
+    if load_hf and load_ckpt:
+        raise SystemExit("--load-hf and --load-checkpoint are mutually exclusive")
+    if load_hf:
+        from fusioninfer_tpu.models.loader import load_hf_checkpoint
+
+        cfg, params = load_hf_checkpoint(load_hf)
+        model_name = args.model if args.model != "qwen3-tiny" else cfg.name
+    elif load_ckpt:
+        from fusioninfer_tpu.models.loader import restore_checkpoint
+
+        cfg, params = restore_checkpoint(load_ckpt)
+        model_name = args.model if args.model != "qwen3-tiny" else cfg.name
+    else:
+        cfg = get_preset(args.model)
+        model_name = args.model
     tp = args.tensor_parallel_size
     mesh = None
     if tp > 1:
@@ -443,13 +508,14 @@ def serve_from_args(args) -> int:
     logger.info("cache: %d pages of %d tokens", cache_cfg.n_pages, cache_cfg.page_size)
     engine = NativeEngine(
         cfg, cache_cfg=cache_cfg, max_batch_size=args.max_batch_size, seed=args.seed,
-        mesh=mesh,
+        mesh=mesh, params=params,
     )
     server = EngineServer(
-        model=args.model,
+        model=model_name,
         host=args.host,
         port=args.port,
         engine=engine,
+        prefill_upstream=getattr(args, "prefill_upstream", None) or None,
     )
     server.serve_forever()
     return 0

@@ -20,10 +20,13 @@ import time
 from typing import Optional
 
 from fusioninfer_tpu.operator.client import K8sClient
+from fusioninfer_tpu.operator.modelloader import ModelLoaderReconciler
 from fusioninfer_tpu.operator.reconciler import InferenceServiceReconciler
 
 logger = logging.getLogger("fusioninfer.manager")
 
+# Kinds the InferenceService controller owns (the reference's Owns() set,
+# inferenceservice_controller.go:689-704) …
 OWNED_KINDS = [
     "LeaderWorkerSet",
     "PodGroup",
@@ -36,6 +39,9 @@ OWNED_KINDS = [
     "InferencePool",
     "HTTPRoute",
 ]
+# … plus the kinds with their own reconcilers and what they own.
+ROOT_KINDS = ["InferenceService", "ModelLoader"]
+LOADER_OWNED_KINDS = ["Job"]
 
 REQUEUE_DELAY_S = 5.0
 RESYNC_PERIOD_S = 300.0
@@ -74,35 +80,40 @@ class ControllerMetrics:
     TLS/authn is the deployment's job (NetworkPolicy + ServiceMonitor)."""
 
     def __init__(self):
-        self.reconcile_total = 0
-        self.reconcile_errors_total = 0
-        self.requeue_total = 0
-        self._duration_sum = 0.0
-        self._duration_count = 0
+        # controller label → counters; one series set per reconciler
+        self._by: dict[str, dict[str, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, seconds: float, errors: int, requeued: bool) -> None:
+    def observe(self, controller: str, seconds: float, errors: int, requeued: bool) -> None:
         with self._lock:
-            self.reconcile_total += 1
-            self.reconcile_errors_total += errors
-            self.requeue_total += 1 if requeued else 0
-            self._duration_sum += seconds
-            self._duration_count += 1
+            c = self._by.setdefault(
+                controller,
+                {"total": 0, "errors": 0, "requeue": 0, "dur_sum": 0.0, "dur_count": 0},
+            )
+            c["total"] += 1
+            c["errors"] += errors
+            c["requeue"] += 1 if requeued else 0
+            c["dur_sum"] += seconds
+            c["dur_count"] += 1
 
     def render(self) -> str:
-        c = 'controller="inferenceservice"'
+        lines = [
+            "# TYPE controller_runtime_reconcile_total counter",
+            "# TYPE controller_runtime_reconcile_errors_total counter",
+            "# TYPE controller_runtime_reconcile_requeue_total counter",
+            "# TYPE controller_runtime_reconcile_time_seconds summary",
+        ]
         with self._lock:
-            lines = [
-                "# TYPE controller_runtime_reconcile_total counter",
-                f'controller_runtime_reconcile_total{{{c}}} {self.reconcile_total}',
-                "# TYPE controller_runtime_reconcile_errors_total counter",
-                f'controller_runtime_reconcile_errors_total{{{c}}} {self.reconcile_errors_total}',
-                "# TYPE controller_runtime_reconcile_requeue_total counter",
-                f'controller_runtime_reconcile_requeue_total{{{c}}} {self.requeue_total}',
-                "# TYPE controller_runtime_reconcile_time_seconds summary",
-                f'controller_runtime_reconcile_time_seconds_sum{{{c}}} {self._duration_sum}',
-                f'controller_runtime_reconcile_time_seconds_count{{{c}}} {self._duration_count}',
-            ]
+            for controller in sorted(self._by):
+                c = self._by[controller]
+                lab = f'controller="{controller}"'
+                lines += [
+                    f'controller_runtime_reconcile_total{{{lab}}} {c["total"]}',
+                    f'controller_runtime_reconcile_errors_total{{{lab}}} {c["errors"]}',
+                    f'controller_runtime_reconcile_requeue_total{{{lab}}} {c["requeue"]}',
+                    f'controller_runtime_reconcile_time_seconds_sum{{{lab}}} {c["dur_sum"]}',
+                    f'controller_runtime_reconcile_time_seconds_count{{{lab}}} {c["dur_count"]}',
+                ]
         return "\n".join(lines) + "\n"
 
 
@@ -115,7 +126,8 @@ class Manager:
         self.probe_port = probe_port
         self.metrics_port = metrics_port
         self.reconciler = InferenceServiceReconciler(client, default_queue=default_queue)
-        self.workqueue = WorkQueue()
+        self.loader_reconciler = ModelLoaderReconciler(client)
+        self.workqueue = WorkQueue()  # keys: (kind, namespace, name)
         self.metrics = ControllerMetrics()
         self._stop = threading.Event()
         self.ready = threading.Event()
@@ -123,29 +135,32 @@ class Manager:
     # -- event sources --
 
     def _enqueue_owner(self, obj: dict) -> None:
-        """Map a child event back to its owning InferenceService."""
+        """Map a child event back to its owning root object."""
         for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
-            if ref.get("kind") == "InferenceService" and ref.get("controller"):
+            if ref.get("kind") in ROOT_KINDS and ref.get("controller"):
                 ns = obj["metadata"].get("namespace", self.namespace)
-                self.workqueue.add((ns, ref["name"]))
+                self.workqueue.add((ref["kind"], ns, ref["name"]))
 
     def _watch_kind(self, kind: str) -> None:
         """Level-triggered watch with list-based resync on stream errors."""
         rv = ""
         while not self._stop.is_set():
             try:
-                if kind == "InferenceService":
+                if kind in ROOT_KINDS:
                     for svc in self.client.list(kind, self.namespace):
-                        self.workqueue.add((svc["metadata"]["namespace"], svc["metadata"]["name"]))
+                        meta = svc["metadata"]
+                        self.workqueue.add((kind, meta["namespace"], meta["name"]))
                 watch = getattr(self.client, "watch", None)
                 if watch is None:
                     self._stop.wait(RESYNC_PERIOD_S)
                     continue
                 for _etype, obj in watch(kind, self.namespace, resource_version=rv):
                     rv = (obj.get("metadata") or {}).get("resourceVersion", rv)
-                    if kind == "InferenceService":
+                    if kind in ROOT_KINDS:
                         meta = obj["metadata"]
-                        self.workqueue.add((meta.get("namespace", self.namespace), meta["name"]))
+                        self.workqueue.add(
+                            (kind, meta.get("namespace", self.namespace), meta["name"])
+                        )
                     else:
                         self._enqueue_owner(obj)
             except Exception as e:
@@ -160,15 +175,19 @@ class Manager:
             key = self.workqueue.get(timeout=1.0)
             if key is None:
                 continue
-            ns, name = key
+            kind, ns, name = key
+            rec = (
+                self.loader_reconciler if kind == "ModelLoader" else self.reconciler
+            )
             t0 = time.monotonic()
             try:
-                result = self.reconciler.reconcile(ns, name)
+                result = rec.reconcile(ns, name)
             except Exception:
-                logger.exception("reconcile %s/%s panicked", ns, name)
+                logger.exception("reconcile %s %s/%s panicked", kind, ns, name)
                 result = None
             requeued = result is not None and (result.requeue or bool(result.errors))
             self.metrics.observe(
+                kind.lower(),
                 time.monotonic() - t0,
                 errors=len(result.errors) if result is not None else 1,
                 requeued=requeued,
@@ -228,7 +247,7 @@ class Manager:
         self._serve_probes()
         self._serve_metrics()
         threads = [threading.Thread(target=self._worker, daemon=True, name="reconcile-worker")]
-        for kind in ["InferenceService"] + OWNED_KINDS:
+        for kind in ROOT_KINDS + OWNED_KINDS + LOADER_OWNED_KINDS:
             threads.append(
                 threading.Thread(target=self._watch_kind, args=(kind,), daemon=True, name=f"watch-{kind}")
             )

@@ -20,6 +20,7 @@ import yaml
 
 from fusioninfer_tpu import GROUP
 from fusioninfer_tpu.api.crd import PLURAL, build_crd
+from fusioninfer_tpu.api.modelloader import LOADER_PLURAL, build_loader_crd
 
 NAMESPACE = "fusioninfer-system"
 MANAGER_IMAGE = "fusioninfer-tpu:latest"
@@ -55,6 +56,16 @@ def manager_role() -> dict:
                 "apiGroups": [GROUP],
                 "resources": [f"{PLURAL}/finalizers"],
                 "verbs": ["update"],
+            },
+            {
+                "apiGroups": [GROUP],
+                "resources": [LOADER_PLURAL, f"{LOADER_PLURAL}/status"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
+            },
+            {
+                "apiGroups": ["batch"],
+                "resources": ["jobs"],
+                "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
             },
             {
                 "apiGroups": ["leaderworkerset.x-k8s.io"],
@@ -240,7 +251,11 @@ def config_tree() -> dict[str, Any]:
     kust = lambda resources, **extra: {"resources": resources, **extra}  # noqa: E731
     return {
         "crd/bases/fusioninfer.io_inferenceservices.yaml": build_crd(),
-        "crd/kustomization.yaml": kust(["bases/fusioninfer.io_inferenceservices.yaml"]),
+        "crd/bases/fusioninfer.io_modelloaders.yaml": build_loader_crd(),
+        "crd/kustomization.yaml": kust([
+            "bases/fusioninfer.io_inferenceservices.yaml",
+            "bases/fusioninfer.io_modelloaders.yaml",
+        ]),
         "rbac/role.yaml": manager_role(),
         "rbac/service_account.yaml": {
             "apiVersion": "v1",
@@ -315,9 +330,19 @@ def config_tree() -> dict[str, Any]:
             "inferenceservice_editor_role.yaml",
             "inferenceservice_viewer_role.yaml",
         ]),
+        "manager/namespace.yaml": {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {
+                "name": "system",
+                "labels": {"control-plane": "controller-manager"},
+            },
+        },
         "manager/manager.yaml": manager_deployment(),
         "manager/metrics_service.yaml": _metrics_service(),
-        "manager/kustomization.yaml": kust(["manager.yaml", "metrics_service.yaml"]),
+        "manager/kustomization.yaml": kust(
+            ["namespace.yaml", "manager.yaml", "metrics_service.yaml"]
+        ),
         "prometheus/monitor.yaml": service_monitor(),
         "prometheus/kustomization.yaml": kust(["monitor.yaml"]),
         "network-policy/allow-metrics-traffic.yaml": metrics_network_policy(),
@@ -334,6 +359,52 @@ def config_tree() -> dict[str, Any]:
             ],
         },
     }
+
+
+_CLUSTER_SCOPED = {
+    "CustomResourceDefinition", "Namespace", "ClusterRole", "ClusterRoleBinding",
+}
+
+
+def render_installer() -> list[dict]:
+    """Single-file install manifest: the config tree with the kustomize
+    ``default`` overlay's transforms applied (namespace + name prefix) —
+    what ``kubectl apply -k config/default`` would submit, flattened."""
+    docs: list[dict] = []
+    for rel, content in config_tree().items():
+        if "kustomization" in rel or rel.startswith(("prometheus/", "network-policy/")):
+            continue
+        doc = yaml.safe_load(yaml.safe_dump(content))  # deep copy
+        kind = doc.get("kind")
+        name = doc["metadata"]["name"]
+        if kind == "CustomResourceDefinition":
+            docs.append(doc)  # CRD names are structural: never prefixed
+            continue
+        doc["metadata"]["name"] = (
+            NAMESPACE if kind == "Namespace" else PREFIX + name
+        )
+        if kind not in _CLUSTER_SCOPED:
+            doc["metadata"]["namespace"] = NAMESPACE
+        for subject in doc.get("subjects") or []:
+            if subject.get("kind") == "ServiceAccount":
+                subject["name"] = PREFIX + subject["name"]
+                subject["namespace"] = NAMESPACE
+        if "roleRef" in doc:
+            doc["roleRef"]["name"] = PREFIX + doc["roleRef"]["name"]
+        if kind == "Deployment":
+            tmpl = doc["spec"]["template"]["spec"]
+            if tmpl.get("serviceAccountName"):
+                tmpl["serviceAccountName"] = PREFIX + tmpl["serviceAccountName"]
+        labels = doc["metadata"].setdefault("labels", {})
+        labels["app.kubernetes.io/name"] = "fusioninfer-tpu"
+        docs.append(doc)
+    return docs
+
+
+def write_installer(path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump_all(render_installer(), f, sort_keys=False)
 
 
 def write_config_tree(root: str) -> list[str]:

@@ -1,0 +1,138 @@
+"""ModelLoader reconciler: render a download Job, mirror its phase.
+
+Functional replacement for the reference's no-op scaffold
+(``pkg/controller/modelloader_controller.go:49-55``).  One ModelLoader →
+one batch/v1 Job mounting the destination PVC and running the in-image
+``loader fetch`` entrypoint; status.phase follows the Job
+(Pending/Running/Succeeded/Failed).  Jobs are immutable after creation,
+so spec changes delete-and-recreate (hash-gated like every other child).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from fusioninfer_tpu import API_VERSION
+from fusioninfer_tpu.api.modelloader import ModelLoader
+from fusioninfer_tpu.operator.client import K8sClient, NotFound, set_owner_reference
+from fusioninfer_tpu.operator.reconciler import ReconcileResult
+from fusioninfer_tpu.utils.hash import spec_hash_of, stamp_spec_hash
+
+logger = logging.getLogger("fusioninfer.modelloader")
+
+LABEL_LOADER = "fusioninfer.io/model-loader"
+
+
+def generate_job_name(loader: ModelLoader) -> str:
+    return f"{loader.name}-download"
+
+
+def build_loader_job(loader: ModelLoader) -> dict:
+    spec = loader.spec
+    cmd = [
+        "python", "-m", "fusioninfer_tpu.cli", "loader", "fetch",
+        "--repo", spec.source.repo,
+        "--revision", spec.source.revision,
+        "--dest", spec.destination.path,
+    ]
+    if spec.convert:
+        cmd.append("--convert")
+    job = {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": generate_job_name(loader),
+            "namespace": loader.namespace,
+            "labels": {LABEL_LOADER: loader.name},
+        },
+        "spec": {
+            "backoffLimit": 3,
+            "template": {
+                "metadata": {"labels": {LABEL_LOADER: loader.name}},
+                "spec": {
+                    "restartPolicy": "Never",
+                    "containers": [
+                        {
+                            "name": "download",
+                            "image": spec.image,
+                            "command": cmd,
+                            "volumeMounts": [
+                                {"name": "models", "mountPath": spec.destination.path}
+                            ],
+                        }
+                    ],
+                    "volumes": [
+                        {
+                            "name": "models",
+                            "persistentVolumeClaim": {"claimName": spec.destination.pvc},
+                        }
+                    ],
+                },
+            },
+        },
+    }
+    return stamp_spec_hash(job)
+
+
+def job_phase(job: dict | None) -> str:
+    if job is None:
+        return "Pending"
+    status = job.get("status") or {}
+    if status.get("succeeded"):
+        return "Succeeded"
+    if status.get("failed", 0) >= (job.get("spec") or {}).get("backoffLimit", 3) + 1:
+        return "Failed"
+    if status.get("active"):
+        return "Running"
+    return "Pending"
+
+
+class ModelLoaderReconciler:
+    def __init__(self, client: K8sClient):
+        self.client = client
+
+    def reconcile(self, namespace: str, name: str) -> ReconcileResult:
+        raw = self.client.get_or_none("ModelLoader", namespace, name)
+        if raw is None:
+            return ReconcileResult()
+        prev_status = dict(raw.get("status") or {})
+        try:
+            loader = ModelLoader.from_dict(raw).validate()
+        except ValueError as e:
+            status = {"phase": "Failed", "message": str(e)}
+            if status != prev_status:
+                self._write_status(raw, status)
+            return ReconcileResult(errors=[str(e)])
+
+        desired = build_loader_job(loader)
+        set_owner_reference(desired, raw)
+        existing = self.client.get_or_none("Job", namespace, desired["metadata"]["name"])
+        if existing is None:
+            self.client.create(desired)
+            logger.info("created Job %s/%s", namespace, desired["metadata"]["name"])
+            existing = desired
+        elif spec_hash_of(existing) != spec_hash_of(desired):
+            # Jobs are immutable: recreate on spec change
+            self.client.delete("Job", namespace, desired["metadata"]["name"])
+            self.client.create(desired)
+            logger.info("recreated Job %s/%s", namespace, desired["metadata"]["name"])
+            existing = desired
+
+        phase = job_phase(self.client.get_or_none("Job", namespace, desired["metadata"]["name"]))
+        status = {"phase": phase, "job": desired["metadata"]["name"]}
+        if status != prev_status:
+            self._write_status(raw, status)
+        return ReconcileResult(requeue=phase in ("Pending", "Running"))
+
+    def _write_status(self, raw: dict, status: dict) -> None:
+        self.client.update_status(
+            {
+                "apiVersion": raw.get("apiVersion", API_VERSION),
+                "kind": raw.get("kind", "ModelLoader"),
+                "metadata": {
+                    "name": raw["metadata"]["name"],
+                    "namespace": raw["metadata"].get("namespace", "default"),
+                },
+                "status": status,
+            }
+        )

@@ -86,4 +86,4 @@ def test_enqueue_owner_maps_child_to_parent():
         },
     }
     mgr._enqueue_owner(child)
-    assert mgr.workqueue.get() == ("default", "svc")
+    assert mgr.workqueue.get() == ("InferenceService", "default", "svc")

@@ -66,6 +66,31 @@ def test_tree_roundtrips_and_kustomizations_reference_real_files():
                 assert os.path.join(base, res) in tree, f"{rel} references missing {res}"
 
 
+def test_installer_transforms_applied():
+    from fusioninfer_tpu.operator.manifests import NAMESPACE, render_installer
+
+    docs = render_installer()
+    by_kind = {}
+    for d in docs:
+        by_kind.setdefault(d["kind"], []).append(d)
+    # namespace object exists with the real name
+    assert [n["metadata"]["name"] for n in by_kind["Namespace"]] == [NAMESPACE]
+    # CRD names are never prefixed
+    for crd in by_kind["CustomResourceDefinition"]:
+        assert crd["metadata"]["name"].endswith(".fusioninfer.io")
+        assert not crd["metadata"]["name"].startswith("fusioninfer-")
+    # deployment lands in the namespace with prefixed name + SA
+    dep = by_kind["Deployment"][0]
+    assert dep["metadata"]["namespace"] == NAMESPACE
+    assert dep["metadata"]["name"].startswith("fusioninfer-")
+    assert dep["spec"]["template"]["spec"]["serviceAccountName"].startswith("fusioninfer-")
+    # bindings point at prefixed roles and namespaced subjects
+    for b in by_kind["ClusterRoleBinding"] + by_kind.get("RoleBinding", []):
+        assert b["roleRef"]["name"].startswith("fusioninfer-")
+        for s in b["subjects"]:
+            assert s["namespace"] == NAMESPACE and s["name"].startswith("fusioninfer-")
+
+
 def test_write_config_tree_matches_committed_config(tmp_path):
     """The committed config/ must equal a fresh render (CI drift fence)."""
     written = write_config_tree(str(tmp_path))

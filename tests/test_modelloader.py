@@ -1,0 +1,105 @@
+"""ModelLoader: spec parsing, Job rendering, reconcile lifecycle through
+the fake API server (create → Running → Succeeded; spec change recreates
+the immutable Job; invalid spec fails fast)."""
+
+import pytest
+
+from fusioninfer_tpu.api.modelloader import ModelLoader, build_loader_crd
+from fusioninfer_tpu.api.types import ValidationError
+from fusioninfer_tpu.operator.fake import FakeK8s
+from fusioninfer_tpu.operator.modelloader import (
+    ModelLoaderReconciler,
+    build_loader_job,
+    job_phase,
+)
+
+
+def _manifest(repo="org/model", pvc="models", convert=False):
+    return {
+        "apiVersion": "fusioninfer.io/v1alpha1",
+        "kind": "ModelLoader",
+        "metadata": {"name": "ml", "namespace": "default"},
+        "spec": {
+            "source": {"hf": {"repo": repo, "revision": "main"}},
+            "destination": {"pvc": pvc, "path": "/models/m"},
+            "convert": convert,
+        },
+    }
+
+
+def test_parse_and_validate():
+    ml = ModelLoader.from_dict(_manifest()).validate()
+    assert ml.spec.source.repo == "org/model"
+    assert ml.spec.destination.pvc == "models"
+    with pytest.raises(ValidationError, match="repo"):
+        ModelLoader.from_dict(_manifest(repo="")).validate()
+    with pytest.raises(ValidationError, match="pvc"):
+        ModelLoader.from_dict(_manifest(pvc="")).validate()
+
+
+def test_job_render_command_and_volumes():
+    ml = ModelLoader.from_dict(_manifest(convert=True)).validate()
+    job = build_loader_job(ml)
+    c = job["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][:5] == ["python", "-m", "fusioninfer_tpu.cli", "loader", "fetch"]
+    assert "--convert" in c["command"]
+    assert "--repo" in c["command"] and "org/model" in c["command"]
+    vol = job["spec"]["template"]["spec"]["volumes"][0]
+    assert vol["persistentVolumeClaim"]["claimName"] == "models"
+    assert c["volumeMounts"][0]["mountPath"] == "/models/m"
+    assert "fusioninfer.io/spec-hash" in job["metadata"]["labels"]
+
+
+def test_reconcile_lifecycle():
+    fake = FakeK8s()
+    fake.create(_manifest())
+    rec = ModelLoaderReconciler(fake)
+
+    result = rec.reconcile("default", "ml")
+    assert result.requeue  # job pending
+    job = fake.get("Job", "default", "ml-download")
+    assert job["metadata"]["ownerReferences"][0]["kind"] == "ModelLoader"
+    assert fake.get("ModelLoader", "default", "ml")["status"]["phase"] == "Pending"
+
+    fake.set_status("Job", "default", "ml-download", {"active": 1})
+    assert rec.reconcile("default", "ml").requeue
+    assert fake.get("ModelLoader", "default", "ml")["status"]["phase"] == "Running"
+
+    fake.set_status("Job", "default", "ml-download", {"succeeded": 1})
+    assert not rec.reconcile("default", "ml").requeue
+    assert fake.get("ModelLoader", "default", "ml")["status"]["phase"] == "Succeeded"
+
+
+def test_spec_change_recreates_job():
+    fake = FakeK8s()
+    fake.create(_manifest())
+    rec = ModelLoaderReconciler(fake)
+    rec.reconcile("default", "ml")
+    uid1 = fake.get("Job", "default", "ml-download")["metadata"]["uid"]
+
+    changed = _manifest(repo="org/other")
+    cur = fake.get("ModelLoader", "default", "ml")
+    changed["metadata"]["resourceVersion"] = cur["metadata"]["resourceVersion"]
+    fake.update(changed)
+    rec.reconcile("default", "ml")
+    job = fake.get("Job", "default", "ml-download")
+    assert job["metadata"]["uid"] != uid1
+    assert "org/other" in job["spec"]["template"]["spec"]["containers"][0]["command"]
+
+
+def test_invalid_spec_sets_failed_status():
+    fake = FakeK8s()
+    fake.create(_manifest(pvc=""))
+    rec = ModelLoaderReconciler(fake)
+    result = rec.reconcile("default", "ml")
+    assert result.errors
+    assert fake.get("ModelLoader", "default", "ml")["status"]["phase"] == "Failed"
+
+
+def test_loader_crd_shape():
+    crd = build_loader_crd()
+    assert crd["metadata"]["name"] == "modelloaders.fusioninfer.io"
+    ver = crd["spec"]["versions"][0]
+    assert ver["subresources"] == {"status": {}}
+    spec_schema = ver["schema"]["openAPIV3Schema"]["properties"]["spec"]
+    assert "source" in spec_schema["required"]

@@ -18,13 +18,20 @@ SAMPLES = sorted(glob.glob(os.path.join(os.path.dirname(__file__), "..", "config
 
 
 def test_samples_exist():
-    assert len(SAMPLES) == 5
+    assert len(SAMPLES) == 6
 
 
 @pytest.mark.parametrize("path", SAMPLES, ids=[os.path.basename(p) for p in SAMPLES])
 def test_sample_renders_clean(path):
     with open(path) as f:
         doc = yaml.safe_load(f)
+    if doc["kind"] == "ModelLoader":
+        from fusioninfer_tpu.api.modelloader import ModelLoader
+        from fusioninfer_tpu.operator.modelloader import build_loader_job
+
+        job = build_loader_job(ModelLoader.from_dict(doc).validate())
+        assert "nvidia.com/gpu" not in yaml.safe_dump(job)
+        return
     svc = InferenceService.from_dict(doc)
     svc.validate()
     rendered = render_all(svc)
