@@ -1,0 +1,140 @@
+"""Typed client library for third-party integrators.
+
+The reference ships a generated clientset (``client-go/``: typed CRUD,
+watch, apply-configurations, and a fake for consumer tests — produced by
+``hack/update-codegen.sh``).  The equivalent here is hand-rolled but
+serves the same contract: typed get/list/create/update/delete/watch for
+the ``fusioninfer.io`` kinds over any :class:`K8sClient` transport — the
+real REST client in-cluster, or the in-memory fake in consumer tests
+(``FusionInferClient(FakeK8s())``).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Iterator, Optional
+
+from fusioninfer_tpu import API_VERSION
+from fusioninfer_tpu.api.modelloader import ModelLoader
+from fusioninfer_tpu.api.types import InferenceService
+from fusioninfer_tpu.operator.client import K8sClient
+from fusioninfer_tpu.operator.kubeclient import KubeClient
+
+
+class _TypedApi:
+    kind: str = ""
+
+    def __init__(self, transport: K8sClient):
+        self._t = transport
+
+    # subclasses provide parse/serialize
+    @staticmethod
+    def _parse(raw: dict):
+        raise NotImplementedError
+
+    @staticmethod
+    def _serialize(obj) -> dict:
+        raise NotImplementedError
+
+    def get(self, name: str, namespace: str = "default"):
+        return self._parse(self._t.get(self.kind, namespace, name))
+
+    def get_raw(self, name: str, namespace: str = "default") -> dict:
+        """The raw dict — status and metadata included."""
+        return self._t.get(self.kind, namespace, name)
+
+    def list(self, namespace: str = "default",
+             label_selector: Optional[dict] = None) -> list:
+        return [
+            self._parse(o) for o in self._t.list(self.kind, namespace, label_selector)
+        ]
+
+    def create(self, obj) -> dict:
+        return self._t.create(self._serialize(obj))
+
+    def apply(self, manifest: dict) -> dict:
+        """Create-or-update from a raw manifest (kubectl-apply shape).
+        The caller's dict is never mutated — a resourceVersion injected
+        into it would go stale on reuse (re-apply after delete, second
+        cluster) and turn clean applies into conflicts."""
+        manifest = copy.deepcopy(manifest)
+        meta = manifest.get("metadata") or {}
+        existing = self._t.get_or_none(
+            self.kind, meta.get("namespace", "default"), meta.get("name", "")
+        )
+        if existing is None:
+            return self._t.create(manifest)
+        manifest.setdefault("metadata", {})["resourceVersion"] = (
+            existing["metadata"].get("resourceVersion")
+        )
+        return self._t.update(manifest)
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        self._t.delete(self.kind, namespace, name)
+
+    def status(self, name: str, namespace: str = "default") -> dict:
+        return self._t.get(self.kind, namespace, name).get("status") or {}
+
+    def watch(self, namespace: str = "default") -> Iterator[tuple[str, dict]]:
+        watch = getattr(self._t, "watch", None)
+        if watch is None:
+            raise NotImplementedError("transport does not support watch")
+        return watch(self.kind, namespace)
+
+
+class InferenceServiceApi(_TypedApi):
+    kind = "InferenceService"
+
+    @staticmethod
+    def _parse(raw: dict) -> InferenceService:
+        return InferenceService.from_dict(raw)
+
+    @staticmethod
+    def _serialize(obj) -> dict:
+        if isinstance(obj, dict):
+            return obj
+        if isinstance(obj, InferenceService):
+            return obj.to_dict()
+        raise TypeError(f"cannot serialize {type(obj)}")
+
+
+class ModelLoaderApi(_TypedApi):
+    kind = "ModelLoader"
+
+    @staticmethod
+    def _parse(raw: dict) -> ModelLoader:
+        return ModelLoader.from_dict(raw)
+
+    @staticmethod
+    def _serialize(obj) -> dict:
+        if isinstance(obj, dict):
+            return obj
+        if isinstance(obj, ModelLoader):
+            return {
+                "apiVersion": API_VERSION,
+                "kind": "ModelLoader",
+                "metadata": {"name": obj.name, "namespace": obj.namespace},
+                "spec": {
+                    "source": {
+                        "hf": {
+                            "repo": obj.spec.source.repo,
+                            "revision": obj.spec.source.revision,
+                        }
+                    },
+                    "destination": dataclasses.asdict(obj.spec.destination),
+                    "convert": obj.spec.convert,
+                    "image": obj.spec.image,
+                },
+            }
+        raise TypeError(f"cannot serialize {type(obj)}")
+
+
+class FusionInferClient:
+    """Entry point: ``FusionInferClient()`` in-cluster, or pass any
+    transport (e.g. ``FakeK8s()`` in tests)."""
+
+    def __init__(self, transport: Optional[K8sClient] = None):
+        self.transport = transport if transport is not None else KubeClient()
+        self.inference_services = InferenceServiceApi(self.transport)
+        self.model_loaders = ModelLoaderApi(self.transport)

@@ -100,10 +100,22 @@ class NativeEngine:
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
         self.mesh = mesh
+        self._kernel_mesh = None
         if mesh is not None:
+            from fusioninfer_tpu.ops import dispatch
+            from fusioninfer_tpu.ops.sharded import tp_compatible
             from fusioninfer_tpu.parallel import sharding as psharding
 
-            self.cfg = cfg = psharding.spmd_cfg(self.cfg, mesh)
+            if (
+                mesh.size > 1
+                and tp_compatible(mesh, cfg.n_heads, cfg.n_kv_heads)
+                and dispatch.resolve_attn(cfg.attn_impl) == "flash"
+            ):
+                # tp-only mesh: Pallas kernels run per tensor-parallel
+                # shard via shard_map (ops/sharded.py)
+                self._kernel_mesh = mesh
+            else:
+                self.cfg = cfg = psharding.spmd_cfg(self.cfg, mesh)
             tp = mesh.shape.get("tp", 1)
             if tp > 1 and cfg.n_kv_heads % tp:
                 raise ValueError(
@@ -238,6 +250,7 @@ class NativeEngine:
                     self.cache, logits = prefill(
                         self.cfg, self.cache_cfg, self.params, self.cache,
                         jnp.asarray(padded), jnp.int32(len(prefix)), row,
+                        mesh=self._kernel_mesh,
                     )
                     token = int(
                         sample(
@@ -411,6 +424,7 @@ class NativeEngine:
         self.cache, logits = prefill(
             self.cfg, self.cache_cfg, self.params, self.cache,
             jnp.asarray(padded), jnp.int32(len(prefix)), row,
+            mesh=self._kernel_mesh,
         )
         token = int(
             sample(
@@ -466,7 +480,7 @@ class NativeEngine:
         self.cache, logits = decode_step(
             self.cfg, self.cache_cfg, self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
-            jnp.asarray(active),
+            jnp.asarray(active), mesh=self._kernel_mesh,
         )
         sampled = np.asarray(
             sample(logits, self._next_key(), jnp.asarray(temps),

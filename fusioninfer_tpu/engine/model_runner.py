@@ -37,7 +37,7 @@ from fusioninfer_tpu.models.transformer import (
 )
 
 
-@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
 def prefill(
     cfg: ModelConfig,
     cache_cfg: CacheConfig,
@@ -46,6 +46,7 @@ def prefill(
     tokens: jax.Array,  # [1, S] padded to bucket
     true_len: jax.Array,  # scalar int32
     page_row: jax.Array,  # [max_pages_per_seq]
+    mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
 ):
     """Prefill one sequence; returns (cache, last-token logits [1, V])."""
     B, S = tokens.shape
@@ -63,7 +64,7 @@ def prefill(
 
     def body(x, inputs):
         layer, k_cache_l, v_cache_l = inputs
-        out, (k, v) = layer_forward(cfg, layer, x, positions, mask)
+        out, (k, v) = layer_forward(cfg, layer, x, positions, mask, mesh=mesh)
         k_cache_l = k_cache_l.at[page_of_token, slot_of_token].set(k[0])
         v_cache_l = v_cache_l.at[page_of_token, slot_of_token].set(v[0])
         return out, (k_cache_l, v_cache_l)
@@ -74,7 +75,7 @@ def prefill(
     return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
 
 
-@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
 def decode_step(
     cfg: ModelConfig,
     cache_cfg: CacheConfig,
@@ -84,6 +85,7 @@ def decode_step(
     positions: jax.Array,  # [B] index the token lands at (== tokens so far)
     page_tables: jax.Array,  # [B, max_pages_per_seq]
     active: jax.Array,  # [B] bool
+    mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
 ):
     """One decode step for the whole running batch → (cache, logits [B, V])."""
     from fusioninfer_tpu.ops import dispatch, paged_decode_attention
@@ -128,10 +130,18 @@ def decode_step(
 
         if use_kernel:
             # Pallas kernel streams only the live pages HBM→VMEM
-            attn = paged_decode_attention(
-                q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
-                interpret=dispatch.kernel_interpret(),
-            )[:, None, :]  # [B, 1, H*Hd]
+            if mesh is not None:
+                from fusioninfer_tpu.ops.sharded import paged_decode_attention_tp
+
+                attn = paged_decode_attention_tp(
+                    mesh, q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
+                    interpret=dispatch.kernel_interpret(),
+                )[:, None, :]
+            else:
+                attn = paged_decode_attention(
+                    q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
+                    interpret=dispatch.kernel_interpret(),
+                )[:, None, :]  # [B, 1, H*Hd]
         else:
             # portable path: gather pages [B, mp, ps, KV, Hd] -> [B, T, KV, Hd]
             k_ctx = k_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)

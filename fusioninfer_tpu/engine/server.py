@@ -110,6 +110,13 @@ class EngineServer:
         self._stop = threading.Event()
         self._httpd: ThreadingHTTPServer | None = None
         self._engine_thread: threading.Thread | None = None
+        self._profiling = False
+        self.enable_profiling = (
+            os.environ.get("FUSIONINFER_ENABLE_PROFILING", "") == "1"
+        )
+        self.profile_dir = os.environ.get(
+            "FUSIONINFER_PROFILE_DIR", "/tmp/fusioninfer-profile"
+        )
 
     # -- engine loop ---------------------------------------------------------
 
@@ -177,6 +184,40 @@ class EngineServer:
                 self._req_meta.pop(request_id, None)
             raise
         return chan
+
+    def handle_profile(self, body: dict) -> dict:
+        """On-demand device profiling (aux subsystem the reference lacks —
+        its only observability is controller-runtime metrics, SURVEY §5):
+        capture a jax.profiler trace for ``seconds`` while serving
+        continues, written where TensorBoard/XProf can read it.
+
+        Opt-in only (``FUSIONINFER_ENABLE_PROFILING=1`` or
+        ``--enable-profiling``) and the output directory is pinned
+        server-side (``FUSIONINFER_PROFILE_DIR``) — profiling has real
+        hot-path overhead and an open port must not choose write paths."""
+        import jax
+
+        if not self.enable_profiling:
+            raise ValueError(
+                "profiling disabled; start the server with "
+                "FUSIONINFER_ENABLE_PROFILING=1 or --enable-profiling"
+            )
+        seconds = float(body.get("seconds", 3.0))
+        out_dir = self.profile_dir
+        if not 0 < seconds <= 60:
+            raise ValueError("seconds must be in (0, 60]")
+        with self._lock:
+            if self._profiling:
+                raise ValueError("a profile capture is already running")
+            self._profiling = True
+        try:
+            jax.profiler.start_trace(out_dir)
+            time.sleep(seconds)
+            jax.profiler.stop_trace()
+        finally:
+            with self._lock:
+                self._profiling = False
+        return {"status": "ok", "dir": out_dir, "seconds": seconds}
 
     def handle_prefill(self, body: dict) -> bytes:
         """Prefiller role: run one prefill, return the KV slab frame."""
@@ -394,6 +435,8 @@ class EngineServer:
                             self._stream(body, chat=True)
                         else:
                             self._send_json(server.handle_chat(body))
+                    elif self.path == "/debug/profile":
+                        self._send_json(server.handle_profile(body))
                     elif self.path == "/v1/prefill":
                         frame = server.handle_prefill(body)
                         self.send_response(200)
@@ -517,5 +560,7 @@ def serve_from_args(args) -> int:
         engine=engine,
         prefill_upstream=getattr(args, "prefill_upstream", None) or None,
     )
+    if getattr(args, "enable_profiling", False):
+        server.enable_profiling = True
     server.serve_forever()
     return 0

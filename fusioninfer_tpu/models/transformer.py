@@ -154,12 +154,14 @@ def layer_forward(
     positions: jax.Array,
     mask: jax.Array,
     kv: Optional[tuple[jax.Array, jax.Array]] = None,
+    mesh=None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """One transformer block. Returns (output, (k, v)) for cache management.
 
     x: [B, S, D]; positions: [B, S]; mask broadcastable to [B, 1, S, T].
     When ``kv`` is given, attends over provided (k, v) history that already
-    includes this block's fresh keys.
+    includes this block's fresh keys.  ``mesh``: a tp-only serving mesh —
+    runs the flash kernel per tensor-parallel shard via shard_map.
     """
     B, S, D = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -179,9 +181,17 @@ def layer_forward(
 
         if dispatch.resolve_attn(cfg.attn_impl) == "flash" and dispatch.flash_seq_ok(S):
             # fresh K/V over the full (causal) sequence: Pallas flash path
-            attn = flash_attention(
-                q, k, v, causal=True, interpret=dispatch.kernel_interpret()
-            )
+            if mesh is not None:
+                from fusioninfer_tpu.ops.sharded import flash_attention_tp
+
+                attn = flash_attention_tp(
+                    mesh, q, k, v, causal=True,
+                    interpret=dispatch.kernel_interpret(),
+                )
+            else:
+                attn = flash_attention(
+                    q, k, v, causal=True, interpret=dispatch.kernel_interpret()
+                )
         else:
             attn = _attention(q, k, v, mask)
     else:

@@ -25,6 +25,8 @@ class Conflict(Exception):
 # kind -> (apiVersion, plural) for every resource the operator touches.
 RESOURCE_REGISTRY: dict[str, tuple[str, str]] = {
     "InferenceService": ("fusioninfer.io/v1alpha1", "inferenceservices"),
+    "ModelLoader": ("fusioninfer.io/v1alpha1", "modelloaders"),
+    "Job": ("batch/v1", "jobs"),
     "LeaderWorkerSet": ("leaderworkerset.x-k8s.io/v1", "leaderworkersets"),
     "PodGroup": ("scheduling.volcano.sh/v1beta1", "podgroups"),
     "ConfigMap": ("v1", "configmaps"),

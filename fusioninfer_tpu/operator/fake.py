@@ -12,8 +12,9 @@ from __future__ import annotations
 
 import copy
 import itertools
+import queue
 import threading
-from typing import Optional
+from typing import Iterator, Optional
 
 from fusioninfer_tpu.operator.client import (
     Conflict,
@@ -32,6 +33,46 @@ class FakeK8s(K8sClient):
         self._rv = itertools.count(1)
         self._uid = itertools.count(1)
         self.actions: list[tuple[str, str, str]] = []  # (verb, kind, name)
+        self._watchers: list["queue.Queue[tuple[str, dict]]"] = []
+        self._watches_closed = False
+
+    # -- watch stream (apiserver watch equivalent) --
+
+    def _publish(self, etype: str, obj: dict) -> None:
+        for q in list(self._watchers):
+            q.put((etype, copy.deepcopy(obj)))
+
+    def watch(self, kind: str, namespace: str,
+              resource_version: str = "") -> Iterator[tuple[str, dict]]:
+        """Blocking event stream of (ADDED|MODIFIED|DELETED, object) for
+        ``kind`` — what the manager's watch threads consume.  Terminates
+        when :meth:`close_watches` is called (manager shutdown)."""
+        q: "queue.Queue[tuple[str, dict]]" = queue.Queue()
+        with self._lock:
+            if self._watches_closed:
+                return  # shut down: a late (re)connecting watcher must not block
+            self._watchers.append(q)
+        try:
+            while True:
+                etype, obj = q.get()
+                if etype == "__CLOSE__":
+                    return
+                if obj.get("kind") != kind:
+                    continue
+                if (obj.get("metadata") or {}).get("namespace", "default") != namespace:
+                    continue
+                yield etype, obj
+        finally:
+            with self._lock:
+                if q in self._watchers:
+                    self._watchers.remove(q)
+
+    def close_watches(self) -> None:
+        with self._lock:
+            self._watches_closed = True
+            watchers = list(self._watchers)
+        for q in watchers:
+            q.put(("__CLOSE__", {}))
 
     # -- keying --
 
@@ -76,6 +117,7 @@ class FakeK8s(K8sClient):
             meta["resourceVersion"] = str(next(self._rv))
             self._objects[key] = stored
             self.actions.append(("create", kind, name))
+            self._publish("ADDED", stored)
             return copy.deepcopy(stored)
 
     def update(self, obj: dict) -> dict:
@@ -97,6 +139,7 @@ class FakeK8s(K8sClient):
                 stored["status"] = copy.deepcopy(existing["status"])
             self._objects[key] = stored
             self.actions.append(("update", kind, name))
+            self._publish("MODIFIED", stored)
             return copy.deepcopy(stored)
 
     def update_status(self, obj: dict) -> dict:
@@ -109,6 +152,7 @@ class FakeK8s(K8sClient):
             existing["status"] = copy.deepcopy(obj.get("status") or {})
             existing["metadata"]["resourceVersion"] = str(next(self._rv))
             self.actions.append(("update_status", kind, name))
+            self._publish("MODIFIED", existing)
             return copy.deepcopy(existing)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -118,6 +162,7 @@ class FakeK8s(K8sClient):
             if obj is None:
                 raise NotFound(kind, namespace, name)
             self.actions.append(("delete", kind, name))
+            self._publish("DELETED", obj)
             self._cascade(obj["metadata"].get("uid"))
 
     # -- test conveniences --
@@ -133,6 +178,7 @@ class FakeK8s(K8sClient):
             child = self._objects.pop(key, None)
             if child is not None:
                 self.actions.append(("delete", kind, name))
+                self._publish("DELETED", child)
                 self._cascade(child["metadata"].get("uid"))
 
     def set_status(self, kind: str, namespace: str, name: str, status: dict) -> None:
@@ -143,6 +189,7 @@ class FakeK8s(K8sClient):
                 raise NotFound(kind, namespace, name)
             obj["status"] = copy.deepcopy(status)
             obj["metadata"]["resourceVersion"] = str(next(self._rv))
+            self._publish("MODIFIED", obj)
 
     def resource_version(self, kind: str, namespace: str, name: str) -> str:
         return self.get(kind, namespace, name)["metadata"]["resourceVersion"]

@@ -269,6 +269,9 @@ class Manager:
     def stop(self) -> None:
         self._stop.set()
         self.ready.clear()
+        close = getattr(self.client, "close_watches", None)
+        if close is not None:
+            close()
         for attr in ("_probe_server", "_metrics_server"):
             server = getattr(self, attr, None)
             if server is not None:

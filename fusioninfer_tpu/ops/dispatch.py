@@ -1,13 +1,17 @@
 """Attention implementation dispatch.
 
 Selection order: an explicit ``ModelConfig.attn_impl`` (``flash`` /
-``reference``) always wins — sharded multi-device paths pin
-``"reference"`` because Pallas calls are not shard_map-wrapped yet, and
-the env var must not defeat that pin.  When the config says ``auto``,
-the ``FUSIONINFER_ATTN`` env var may choose; otherwise ``auto`` resolves
-to the Pallas kernels on TPU and the jnp reference elsewhere.
-Resolution happens at trace time — a process serves with one
-implementation.
+``reference``) always wins, and the env var must not defeat a pin.  When
+the config says ``auto``, the ``FUSIONINFER_ATTN`` env var may choose;
+otherwise ``auto`` resolves to the Pallas kernels on TPU and the jnp
+reference elsewhere.  Resolution happens at trace time — a process
+serves with one implementation.
+
+Multi-device: tp-only serving meshes run the kernels per tensor-parallel
+shard via the shard_map wrappers in :mod:`fusioninfer_tpu.ops.sharded`
+(see ``tp_compatible``); every other sharded path (training, sp/ep
+meshes) pins ``"reference"`` through ``parallel.sharding.spmd_cfg`` and
+relies on XLA SPMD.
 """
 
 from __future__ import annotations

@@ -1,0 +1,87 @@
+"""shard_map wrappers: the Pallas attention kernels under tensor parallelism.
+
+Megatron-style TP shards attention by head: each device owns ``H/tp``
+query heads and ``KV/tp`` KV heads.  With ``tp | KV`` (the engine already
+requires it for the KV cache) every GQA group lives wholly on one shard,
+so attention needs **zero** cross-device communication — each shard runs
+the single-device kernel on its local heads and the row-parallel output
+projection's psum (inserted by XLA from the shardings) is the only
+collective.  These wrappers express exactly that: kernel inside
+``shard_map``, head axes split over ``tp``, everything else replicated.
+
+The serving mesh must be tp-only (dp=sp=ep=1) — the engine falls back to
+the jnp reference path otherwise.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fusioninfer_tpu.ops.flash_attention import flash_attention
+from fusioninfer_tpu.ops.paged_attention import paged_decode_attention
+
+
+def tp_compatible(mesh: Mesh, n_heads: int, n_kv_heads: int) -> bool:
+    """True when the kernels can run per-shard without communication."""
+    if "tp" not in mesh.axis_names:
+        return False
+    tp = mesh.shape["tp"]
+    others = [mesh.shape[a] for a in mesh.axis_names if a != "tp"]
+    return (
+        tp > 1
+        and all(s == 1 for s in others)
+        and n_kv_heads % tp == 0
+        and n_heads % tp == 0
+    )
+
+
+def flash_attention_tp(
+    mesh: Mesh,
+    q: jax.Array,  # [B, S, H, Hd] — H sharded over tp
+    k: jax.Array,  # [B, S, KV, Hd] — KV sharded over tp
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-shard flash attention → [B, S, H·Hd] sharded on the feature axis."""
+    head_spec = P(None, None, "tp", None)
+    fn = shard_map(
+        partial(flash_attention, causal=causal, interpret=interpret),
+        mesh=mesh,
+        in_specs=(head_spec, head_spec, head_spec),
+        out_specs=P(None, None, "tp"),
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def paged_decode_attention_tp(
+    mesh: Mesh,
+    q: jax.Array,  # [B, H, Hd] — H sharded over tp
+    k_pages: jax.Array,  # [n_pages, ps, KV, Hd] — KV sharded over tp
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [B, mp] replicated
+    lengths: jax.Array,  # [B] replicated
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-shard paged decode attention → [B, H·Hd] sharded on features."""
+    fn = shard_map(
+        partial(paged_decode_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),
+            P(None, None, "tp", None),
+            P(None, None, "tp", None),
+            P(None, None),
+            P(None),
+        ),
+        out_specs=P(None, "tp"),
+        check_vma=False,
+    )
+    return fn(q, k_pages, v_pages, page_tables, lengths)

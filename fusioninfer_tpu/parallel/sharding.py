@@ -67,10 +67,11 @@ def param_specs(cfg: ModelConfig) -> Params:
 
 
 def spmd_cfg(cfg: ModelConfig, mesh: Mesh) -> ModelConfig:
-    """Pin the jnp attention on multi-device meshes: Pallas calls are not
-    shard_map-wrapped yet, so SPMD paths must stay pure-XLA.  The single
-    source of this invariant — both the sharded train/forward steps and
-    the tp>1 engine call it."""
+    """Pin the jnp attention for auto-SPMD multi-device paths (training,
+    sp/ep meshes): un-shard_mapped Pallas calls cannot run under the SPMD
+    partitioner.  The one exception is a tp-only serving mesh, where the
+    engine runs the kernels per shard via ``ops.sharded`` instead of
+    calling this (``ops.sharded.tp_compatible`` is the gate)."""
     import dataclasses
 
     if mesh.size > 1 and cfg.attn_impl != "reference":

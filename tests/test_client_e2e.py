@@ -1,0 +1,127 @@
+"""Typed client library + the e2e the reference admits it lacks
+(``test/e2e/e2e_test.go:265-272``): apply a real PD-disaggregated
+InferenceService through the running manager, watch the full child tree
+appear, simulate the external controllers reporting readiness, and
+assert the service goes Active with correct slice math."""
+
+import os
+import time
+
+import yaml
+
+from fusioninfer_tpu.api.types import InferenceService
+from fusioninfer_tpu.client import FusionInferClient
+from fusioninfer_tpu.operator.fake import FakeK8s
+from fusioninfer_tpu.operator.manager import Manager
+
+SAMPLES = os.path.join(os.path.dirname(__file__), "..", "config", "samples")
+
+
+def _load(name):
+    with open(os.path.join(SAMPLES, name)) as f:
+        return yaml.safe_load(f)
+
+
+def test_typed_client_crud_roundtrip():
+    fake = FakeK8s()
+    client = FusionInferClient(fake)
+    manifest = _load("02-monolithic-v5e.yaml")
+    client.inference_services.apply(manifest)
+
+    svc = client.inference_services.get(manifest["metadata"]["name"])
+    assert isinstance(svc, InferenceService)
+    assert svc.spec.roles[0].tpu is not None
+
+    listed = client.inference_services.list()
+    assert [s.name for s in listed] == [svc.name]
+
+    # apply again with a change = update path
+    manifest["spec"]["roles"][0]["replicas"] = 3
+    client.inference_services.apply(manifest)
+    assert client.inference_services.get(svc.name).spec.roles[0].replicas == 3
+
+    client.inference_services.delete(svc.name)
+    assert client.inference_services.list() == []
+
+
+def test_typed_client_model_loader():
+    fake = FakeK8s()
+    client = FusionInferClient(fake)
+    client.model_loaders.apply(_load("06-modelloader.yaml"))
+    ml = client.model_loaders.get("qwen3-8b-weights")
+    assert ml.spec.source.repo == "Qwen/Qwen3-8B"
+    assert ml.spec.convert is True
+
+
+def _wait(predicate, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_e2e_pd_service_reaches_active(unused_port_base=18200):
+    fake = FakeK8s()
+    client = FusionInferClient(fake)
+    mgr = Manager(
+        fake, namespace="default",
+        probe_port=unused_port_base, metrics_port=unused_port_base + 1,
+    )
+    mgr.start()
+    try:
+        manifest = _load("05-pd-disaggregated.yaml")
+        manifest["metadata"]["namespace"] = "default"
+        client.inference_services.apply(manifest)
+        name = manifest["metadata"]["name"]
+        svc = InferenceService.from_dict(manifest)
+        svc.validate()
+        worker_roles = [r for r in svc.spec.roles if r.component_type.is_worker_like]
+        assert len(worker_roles) == 2  # prefiller + decoder
+
+        # whole child tree appears: per-replica LWS, shared PodGroup, router set
+        def tree_up():
+            lws = fake.list("LeaderWorkerSet", "default")
+            pgs = fake.list("PodGroup", "default")
+            pools = fake.list("InferencePool", "default")
+            return (
+                len(lws) == sum(r.replicas for r in worker_roles)
+                and len(pgs) == 1
+                and len(pools) == 1
+            )
+
+        assert _wait(tree_up), f"children: {[a for a in fake.actions if a[0]=='create']}"
+
+        # not Active yet: nothing is ready
+        status = client.inference_services.status(name)
+        conds = {c["type"]: c["status"] for c in status.get("conditions", [])}
+        assert conds.get("Active") != "True"
+
+        # external controllers report readiness
+        for lws in fake.list("LeaderWorkerSet", "default"):
+            fake.set_status(
+                "LeaderWorkerSet", "default", lws["metadata"]["name"],
+                {"readyReplicas": 1},
+            )
+        for dep in fake.list("Deployment", "default"):
+            fake.set_status(
+                "Deployment", "default", dep["metadata"]["name"], {"readyReplicas": 1}
+            )
+
+        def active():
+            st = client.inference_services.status(name)
+            cs = {c["type"]: c["status"] for c in st.get("conditions", [])}
+            return cs.get("Active") == "True"
+
+        assert _wait(active), client.inference_services.status(name)
+
+        # slice math: each PD role reports nodes-per-replica from its tpu block
+        st = client.inference_services.status(name)
+        for role in worker_roles:
+            entry = st["componentStatus"][role.name]
+            assert entry["readyReplicas"] == role.replicas
+            assert entry["nodesPerReplica"] == role.nodes_per_replica()
+            assert entry["phase"] == "Running"
+    finally:
+        mgr.stop()

@@ -324,3 +324,38 @@ class TestTensorParallelEngine:
         mesh = build_mesh(MeshConfig(tp=8))
         with pytest.raises(ValueError):
             NativeEngine(CFG, cache_cfg=CACHE, mesh=mesh)  # 2 kv heads, tp=8
+
+
+class TestProfileEndpoint:
+    def test_profile_capture_writes_trace_and_is_opt_in(self, tmp_path):
+        import glob
+        import json
+        import urllib.error
+        import urllib.request
+
+        from fusioninfer_tpu.engine.server import EngineServer
+
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=make_engine())
+        srv.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/debug/profile",
+                data=json.dumps({"seconds": 0.2}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            # disabled by default: 400, nothing written
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=30)
+            assert e.value.code == 400
+
+            srv.enable_profiling = True
+            srv.profile_dir = str(tmp_path)
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.load(r)
+            assert out["status"] == "ok" and out["dir"] == str(tmp_path)
+            assert glob.glob(str(tmp_path) + "/**/*.pb", recursive=True) or \
+                glob.glob(str(tmp_path) + "/**/*.trace*", recursive=True), \
+                "no trace artifacts written"
+        finally:
+            srv.stop()
