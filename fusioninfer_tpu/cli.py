@@ -159,6 +159,8 @@ def build_parser() -> argparse.ArgumentParser:
         "--prefill-upstream", default="",
         help="PD decode role: pull prefills (KV over DCN) from this prefiller URL",
     )
+    serve.add_argument("--no-prefix-caching", action="store_true",
+                       help="disable automatic prefix caching (KV page reuse)")
     serve.add_argument("--enable-profiling", action="store_true",
                        help="expose /debug/profile (writes to FUSIONINFER_PROFILE_DIR)")
     serve.add_argument("--load-hf", default="", help="HF checkpoint dir (safetensors)")

@@ -39,8 +39,15 @@ from fusioninfer_tpu.engine.model_runner import (
     pick_bucket,
     prefill,
     prefill_buckets,
+    prefill_suffix,
 )
-from fusioninfer_tpu.engine.sampler import SamplingParams, sample
+from fusioninfer_tpu.engine.prefix_cache import PrefixCachingAllocator
+from fusioninfer_tpu.engine.sampler import (
+    SamplingParams,
+    apply_penalties,
+    make_row_keys,
+    sample,
+)
 from fusioninfer_tpu.models.config import ModelConfig
 from fusioninfer_tpu.models.transformer import init_params
 
@@ -74,6 +81,7 @@ class _SeqState:
     tokens: list[int]  # prompt + generated
     n_prompt: int
     slot: int  # batch slot
+    seed: int = 0  # per-request sampling stream
     first_token_time: Optional[float] = None
 
     @property
@@ -90,12 +98,17 @@ class NativeEngine:
         params=None,
         seed: int = 0,
         mesh=None,
+        enable_prefix_caching: bool = True,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
         ``tp`` and the KV cache shards its head axis; the jitted
         prefill/decode steps then run tensor-parallel with XLA inserting
-        the ICI collectives — no other engine code changes."""
+        the ICI collectives — no other engine code changes.
+
+        ``enable_prefix_caching``: content-address full prompt pages and
+        reuse the longest cached prefix across requests (the engine-side
+        realization of the router's prefix-cache strategy)."""
         self.cfg = cfg.validate()
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
@@ -134,10 +147,21 @@ class NativeEngine:
                 params = init_params(cfg, jax.random.key(seed))
             self.cache = init_kv_cache(cfg, self.cache_cfg)
         self.params = params
-        self.alloc = PageAllocator(self.cache_cfg)
+        self.prefix_caching = enable_prefix_caching
+        self.alloc = (
+            PrefixCachingAllocator(self.cache_cfg)
+            if enable_prefix_caching
+            else PageAllocator(self.cache_cfg)
+        )
         self.buckets = prefill_buckets(self.cache_cfg.max_len)
         self._key = jax.random.key(seed + 1)
         self._step_counter = itertools.count()
+        self._seed_counter = itertools.count(1)
+        self._base_seed = seed
+        # per-slot sampling state (device-resident; V-wide rows)
+        V = self.cfg.vocab_size
+        self._token_counts = jnp.zeros((max_batch_size, V), jnp.int32)
+        self._suppress = jnp.zeros((max_batch_size, V), jnp.bool_)
 
         self.waiting: collections.deque[Request] = collections.deque()
         # PD decode side: requests whose KV arrived from a prefill worker
@@ -252,14 +276,8 @@ class NativeEngine:
                         jnp.asarray(padded), jnp.int32(len(prefix)), row,
                         mesh=self._kernel_mesh,
                     )
-                    token = int(
-                        sample(
-                            logits,
-                            self._next_key(),
-                            jnp.asarray([request.params.temperature]),
-                            jnp.asarray([request.params.top_k], jnp.int32),
-                            jnp.asarray([request.params.top_p]),
-                        )[0]
+                    token = self._sample_first_token(
+                        logits, request, prefix, self._request_seed(request)
                     )
                     slab = extract_slab(
                         self.cache, self.alloc.pages_of(rid), prefix, token,
@@ -295,8 +313,10 @@ class NativeEngine:
                     tokens=list(prefix) + [slab.first_token],
                     n_prompt=len(request.prompt_tokens),
                     slot=slot,
+                    seed=self._request_seed(request),
                     first_token_time=time.monotonic(),
                 )
+                self._register_slot(slot, state.tokens, request.params)
                 self.running[slot] = state
                 self.generation_tokens_total += 1
                 outputs.append(self._emit(state, slab.first_token, first=True))
@@ -316,6 +336,11 @@ class NativeEngine:
 
     def kv_cache_usage(self) -> float:
         return self.alloc.utilization()
+
+    def prefix_cache_hit_rate(self) -> float:
+        if not self.prefix_caching:
+            return 0.0
+        return self.alloc.prefix_hit_rate()
 
     def cancel(self, request_id: str) -> None:
         """Abandon a request (client gone). Thread-safe; takes effect at
@@ -369,7 +394,8 @@ class NativeEngine:
         while self.waiting and self._free_slots:
             request = self.waiting[0]
             prefix = request.resume_tokens or request.prompt_tokens
-            if not self.alloc.can_allocate(len(prefix) + 1):
+            # reuse-aware: a mostly-cached prompt needs few fresh pages
+            if not self.alloc.can_admit(prefix, 1):
                 break  # wait for running work to finish or be preempted
             self.waiting.popleft()
             try:
@@ -410,39 +436,103 @@ class NativeEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _request_seed(self, request: Request) -> int:
+        if request.params.seed is not None:
+            return int(request.params.seed)
+        # unseeded: stable per engine seed + admission order
+        return (self._base_seed * 1_000_003 + next(self._seed_counter)) & 0x7FFFFFFF
+
+    def _prompt_counts(self, prefix: list[int]) -> jax.Array:
+        V = self.cfg.vocab_size
+        return jnp.zeros((V,), jnp.int32).at[jnp.asarray(prefix, jnp.int32)].add(1)
+
+    def _stop_suppress_row(self, params: SamplingParams) -> jax.Array:
+        V = self.cfg.vocab_size
+        row = jnp.zeros((V,), jnp.bool_)
+        if params.min_tokens > 0 and params.stop_token_ids:
+            row = row.at[jnp.asarray(params.stop_token_ids, jnp.int32)].set(True)
+        return row
+
+    def _sample_first_token(self, logits: jax.Array, request: Request,
+                            prefix: list[int], seed: int) -> int:
+        """Sample a prefill's first token with full per-request sampling
+        semantics (penalties over the prompt, stop suppression under
+        min_tokens, the request's own PRNG stream at position 0)."""
+        p = request.params
+        counts = self._prompt_counts(prefix)[None]
+        logits = apply_penalties(
+            logits, counts,
+            jnp.asarray([p.presence_penalty]),
+            jnp.asarray([p.frequency_penalty]),
+            jnp.asarray([p.repetition_penalty]),
+        )
+        if p.min_tokens > 0 and p.stop_token_ids:
+            logits = jnp.where(self._stop_suppress_row(p)[None], -jnp.inf, logits)
+        keys = make_row_keys(
+            jnp.asarray([seed], jnp.uint32), jnp.asarray([0], jnp.int32)
+        )
+        return int(
+            sample(
+                logits, keys,
+                jnp.asarray([p.temperature]),
+                jnp.asarray([p.top_k], jnp.int32),
+                jnp.asarray([p.top_p]),
+            )[0]
+        )
+
+    def _register_slot(self, slot: int, tokens: list[int], params: SamplingParams) -> None:
+        """Reset the slot's device sampling state (counts incl. the first
+        generated token; stop-suppress mask for min_tokens)."""
+        self._token_counts = self._token_counts.at[slot].set(self._prompt_counts(tokens))
+        self._suppress = self._suppress.at[slot].set(self._stop_suppress_row(params))
+
     def _prefill_request(self, request: Request) -> Optional[StepOutput]:
         resumed = request.resume_tokens is not None
         prefix = request.resume_tokens if resumed else request.prompt_tokens
         request.resume_tokens = None
+        rid = request.request_id
+        reused_tokens = 0
+        if self.prefix_caching:
+            reused_tokens = self.alloc.match_prefix(rid, prefix)
         # lazy: cover the prefix and the first generated token only
-        self.alloc.allocate(request.request_id, len(prefix) + 1)
-        row = jnp.asarray(self.alloc.page_table_row(request.request_id))
+        self.alloc.allocate(rid, len(prefix) + 1)
+        row = jnp.asarray(self.alloc.page_table_row(rid))
 
-        bucket = pick_bucket(self.buckets, len(prefix))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(prefix)] = prefix
-        self.cache, logits = prefill(
-            self.cfg, self.cache_cfg, self.params, self.cache,
-            jnp.asarray(padded), jnp.int32(len(prefix)), row,
-            mesh=self._kernel_mesh,
-        )
-        token = int(
-            sample(
-                logits,
-                self._next_key(),
-                jnp.asarray([request.params.temperature]),
-                jnp.asarray([request.params.top_k], jnp.int32),
-                jnp.asarray([request.params.top_p]),
-            )[0]
-        )
+        if reused_tokens:
+            # cached prefix pages carry positions [0, reused): prefill
+            # only the suffix against them
+            suffix = prefix[reused_tokens:]
+            bucket = pick_bucket(self.buckets, len(suffix))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(suffix)] = suffix
+            self.cache, logits = prefill_suffix(
+                self.cfg, self.cache_cfg, self.params, self.cache,
+                jnp.asarray(padded), jnp.int32(reused_tokens),
+                jnp.int32(len(suffix)), row,
+            )
+        else:
+            bucket = pick_bucket(self.buckets, len(prefix))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(prefix)] = prefix
+            self.cache, logits = prefill(
+                self.cfg, self.cache_cfg, self.params, self.cache,
+                jnp.asarray(padded), jnp.int32(len(prefix)), row,
+                mesh=self._kernel_mesh,
+            )
+        if self.prefix_caching:
+            self.alloc.register_blocks(rid, prefix)
+        seq_seed = self._request_seed(request)
+        token = self._sample_first_token(logits, request, prefix, seq_seed)
         slot = self._free_slots.pop()
         state = _SeqState(
             request=request,
             tokens=list(prefix) + [token],
             n_prompt=len(request.prompt_tokens),
             slot=slot,
+            seed=seq_seed,
             first_token_time=time.monotonic(),
         )
+        self._register_slot(slot, state.tokens, request.params)
         self.running[slot] = state
         if not resumed:
             self.prompt_tokens_total += len(prefix)
@@ -466,6 +556,12 @@ class NativeEngine:
         temps = np.zeros((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
         top_ps = np.ones((B,), np.float32)
+        presence = np.zeros((B,), np.float32)
+        frequency = np.zeros((B,), np.float32)
+        repetition = np.ones((B,), np.float32)
+        min_toks = np.zeros((B,), np.int32)
+        gen_counts = np.zeros((B,), np.int32)
+        seeds = np.zeros((B,), np.uint32)
         for slot, st in live.items():
             tokens[slot] = st.tokens[-1]
             # the input token was sampled last step but its KV is not yet
@@ -473,19 +569,37 @@ class NativeEngine:
             positions[slot] = len(st.tokens) - 1
             page_tables[slot] = self.alloc.page_table_row(st.request.request_id)
             active[slot] = True
-            temps[slot] = st.request.params.temperature
-            top_ks[slot] = st.request.params.top_k
-            top_ps[slot] = st.request.params.top_p
+            p = st.request.params
+            temps[slot] = p.temperature
+            top_ks[slot] = p.top_k
+            top_ps[slot] = p.top_p
+            presence[slot] = p.presence_penalty
+            frequency[slot] = p.frequency_penalty
+            repetition[slot] = p.repetition_penalty
+            min_toks[slot] = p.min_tokens
+            gen_counts[slot] = st.n_generated
+            seeds[slot] = st.seed
 
         self.cache, logits = decode_step(
             self.cfg, self.cache_cfg, self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
             jnp.asarray(active), mesh=self._kernel_mesh,
         )
-        sampled = np.asarray(
-            sample(logits, self._next_key(), jnp.asarray(temps),
-                   jnp.asarray(top_ks), jnp.asarray(top_ps))
+        logits = apply_penalties(
+            logits, self._token_counts,
+            jnp.asarray(presence), jnp.asarray(frequency), jnp.asarray(repetition),
         )
+        # min_tokens: stop ids stay unsampleable until enough generated
+        still_early = jnp.asarray(gen_counts < min_toks)[:, None]
+        logits = jnp.where(still_early & self._suppress, -jnp.inf, logits)
+        keys = make_row_keys(jnp.asarray(seeds), jnp.asarray(gen_counts))
+        sampled_dev = sample(logits, keys, jnp.asarray(temps),
+                             jnp.asarray(top_ks), jnp.asarray(top_ps))
+        live_slots = jnp.asarray(sorted(live), jnp.int32)
+        self._token_counts = self._token_counts.at[
+            live_slots, sampled_dev[live_slots]
+        ].add(1)
+        sampled = np.asarray(sampled_dev)
 
         outputs = list(failures)
         for slot, st in live.items():

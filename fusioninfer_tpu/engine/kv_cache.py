@@ -158,6 +158,11 @@ class PageAllocator:
         need = self.pages_needed(n_tokens)
         return need <= len(self._free) and need <= self.cache_cfg.max_pages_per_seq
 
+    def can_admit(self, prompt_tokens: list, extra_tokens: int = 1) -> bool:
+        """Admission check for a new request (prefix-caching subclasses
+        account for reusable cached pages)."""
+        return self.can_allocate(len(prompt_tokens) + extra_tokens)
+
     def allocate(self, seq_id: str, n_tokens: int) -> list[int]:
         need = self.pages_needed(n_tokens)
         if need > len(self._free):

@@ -85,6 +85,9 @@ class EngineMetrics:
             f"vllm:request_failure_total{{{labels}}} {engine.errors_total}",
             "# TYPE vllm:request_cancelled_total counter",
             f"vllm:request_cancelled_total{{{labels}}} {engine.cancelled_total}",
+            "# HELP vllm:gpu_prefix_cache_hit_rate fraction of prompt tokens served from cached prefix pages.",
+            "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
+            f"vllm:gpu_prefix_cache_hit_rate{{{labels}}} {engine.prefix_cache_hit_rate():.6f}",
             "# TYPE vllm:time_to_first_token_seconds histogram",
             *self.ttft.render("vllm:time_to_first_token_seconds", labels),
             "# TYPE vllm:time_per_output_token_seconds histogram",

@@ -27,13 +27,12 @@ from jax import lax
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
 from fusioninfer_tpu.models.config import ModelConfig
 from fusioninfer_tpu.models.transformer import (
-    apply_rope,
     causal_mask,
     layer_forward,
     lm_head,
-    moe_ffn,
+    mlp_block,
+    qkv_proj,
     rms_norm,
-    swiglu,
 )
 
 
@@ -72,6 +71,73 @@ def prefill(
     x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]  # [B, D]
+    return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
+
+
+@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+def prefill_suffix(
+    cfg: ModelConfig,
+    cache_cfg: CacheConfig,
+    params,
+    cache: dict,
+    tokens: jax.Array,  # [1, C] suffix padded to bucket
+    start: jax.Array,  # scalar int32: global position of tokens[0]
+    true_len: jax.Array,  # scalar int32: real suffix length
+    page_row: jax.Array,  # [max_pages_per_seq] — prefix pages already filled
+):
+    """Prefill a prompt SUFFIX against cached prefix pages (the automatic
+    prefix-caching path): token i sits at global position ``start + i``,
+    writes its K/V into the sequence's pages, and attends over the
+    gathered page context (shared prefix pages are read, never written).
+    Returns (cache, logits at the last real suffix token [1, V]).
+
+    Attention here is the gathered-context jnp path: under a sharded
+    engine XLA's SPMD partitioner handles the tensor-parallel split from
+    the input shardings (no explicit mesh needed); a paged flash kernel
+    for this path is future work.
+    """
+    B, C = tokens.shape
+    ps = cache_cfg.page_size
+    mp = page_row.shape[0]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dtype_ctx = cache["k"].dtype
+
+    x = params["embed"][tokens]  # [1, C, D]
+    offs = jnp.arange(C)
+    positions = (start + offs)[None, :]  # [1, C]
+
+    write_page = jnp.where(
+        offs < true_len, page_row[(start + offs) // ps], cache_cfg.trash_page
+    )
+    write_slot = (start + offs) % ps
+
+    # context mask over the gathered [mp * ps] positions
+    ctx_idx = jnp.arange(mp * ps)[None, :]  # [1, T]
+    attend = ctx_idx <= positions[0][:, None]  # [C, T]
+
+    def body(x, inputs):
+        layer, k_cache_l, v_cache_l = inputs
+        q, k, v = qkv_proj(cfg, layer, x, positions)
+
+        k_cache_l = k_cache_l.at[write_page, write_slot].set(k[0])
+        v_cache_l = v_cache_l.at[write_page, write_slot].set(v[0])
+
+        k_ctx = k_cache_l[page_row].reshape(1, mp * ps, KV, Hd)
+        v_ctx = v_cache_l[page_row].reshape(1, mp * ps, KV, Hd)
+
+        group = H // KV
+        qg = q.reshape(B, C, KV, group, Hd)
+        scores = jnp.einsum("bskgd,btkd->bkgst", qg, k_ctx).astype(jnp.float32)
+        scores = scores / jnp.sqrt(Hd)
+        scores = jnp.where(attend[None, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dtype_ctx)
+        attn = jnp.einsum("bkgst,btkd->bskgd", probs, v_ctx).reshape(B, C, H * Hd)
+        x = x + attn @ layer["wo"]
+        return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
+
+    x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]
     return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
 
 
@@ -114,15 +180,7 @@ def decode_step(
     def body(x, inputs):
         layer, k_cache_l, v_cache_l = inputs
         B_, S_, D_ = x.shape
-        h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-        q = (h @ layer["wq"]).reshape(B_, 1, H, Hd)
-        k = (h @ layer["wk"]).reshape(B_, 1, KV, Hd)
-        v = (h @ layer["wv"]).reshape(B_, 1, KV, Hd)
-        if cfg.qk_norm:
-            q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
-            k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
-        q = apply_rope(q, pos, cfg.rope_theta)
-        k = apply_rope(k, pos, cfg.rope_theta)
+        q, k, v = qkv_proj(cfg, layer, x, pos)
 
         # write this step's K/V into each sequence's page slot
         k_cache_l = k_cache_l.at[write_page, write_slot].set(k[:, 0])
@@ -154,16 +212,7 @@ def decode_step(
             probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
             attn = jnp.einsum("bkgst,btkd->bskgd", probs, v_ctx).reshape(B_, 1, H * Hd)
         x = x + attn @ layer["wo"]
-
-        h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
-        if cfg.is_moe:
-            ff = moe_ffn(
-                h.reshape(B_, D_), layer["router"], layer["w_gate"], layer["w_up"],
-                layer["w_down"], cfg.n_experts_active,
-            ).reshape(B_, 1, D_)
-        else:
-            ff = swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"])
-        return x + ff, (k_cache_l, v_cache_l)
+        return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
 
     x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)

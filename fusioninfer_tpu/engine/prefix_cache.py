@@ -1,0 +1,204 @@
+"""Automatic prefix caching: content-addressed KV page sharing.
+
+The router's default strategy scores prefix-cache overlap
+(``router/strategy.py`` renders the EPP ``prefix-cache-scorer``); this
+module makes that real on the engine side, vLLM-APC-style but
+page-granular and host-side only (the device cache is just pages — which
+page holds which content is entirely host metadata):
+
+* Full prompt pages are content-addressed by a **hash chain**
+  (``H(parent_hash, block_tokens)``) so a block's identity includes its
+  whole prefix.
+* A new request reuses the longest chain of cached pages (capped at
+  ``len(prompt) - 1`` tokens — the last token must be recomputed for its
+  logits), increments their refcounts, and prefills only the suffix.
+* Released pages with a registered hash become **evictable** (LRU) but
+  stay addressable until the pool actually needs them — so back-to-back
+  requests with shared system prompts skip most prefill compute.
+
+Shared pages are never written: the suffix prefill starts past them, and
+generated tokens land on private pages by construction (positions beyond
+the reused prefix).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+from fusioninfer_tpu.engine.kv_cache import CacheConfig, PageAllocator
+
+
+def block_hashes(tokens: list[int], page_size: int) -> list[bytes]:
+    """Hash chain over the FULL pages of ``tokens``."""
+    out = []
+    parent = b"root"
+    for i in range(len(tokens) // page_size):
+        block = tokens[i * page_size : (i + 1) * page_size]
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent)
+        h.update(np.asarray(block, np.int64).tobytes())
+        parent = h.digest()
+        out.append(parent)
+    return out
+
+
+class PrefixCachingAllocator(PageAllocator):
+    """Page allocator with content-addressed sharing.
+
+    Page states: *free* (no content), *owned* (referenced by ≥1 sequence;
+    hashed pages may be shared by several), *evictable* (hashed content,
+    zero references — reusable as-is via its hash, reclaimable under
+    pressure, LRU order).
+    """
+
+    def __init__(self, cache_cfg: CacheConfig):
+        super().__init__(cache_cfg)
+        self._hash_to_page: dict[bytes, int] = {}
+        self._page_hash: dict[int, bytes] = {}
+        self._refs: dict[int, int] = {}  # page -> #sequences referencing
+        self._evictable: "collections.OrderedDict[int, None]" = collections.OrderedDict()
+        # per sequence: pages acquired via sharing (no write permission)
+        self._shared_of: dict[str, list[int]] = {}
+        self.hit_tokens_total = 0
+        self.query_tokens_total = 0
+
+    # -- capacity ------------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:  # evictable pages are reclaimable
+        return len(self._free) + len(self._evictable)
+
+    def utilization(self) -> float:
+        total = self.cache_cfg.n_pages - 1
+        used = total - self.free_pages
+        return 0.0 if total == 0 else used / total
+
+    def _take_free_page(self) -> int:
+        if self._free:
+            return self._free.pop()
+        # reclaim the least-recently-used evictable page
+        page, _ = self._evictable.popitem(last=False)
+        h = self._page_hash.pop(page)
+        del self._hash_to_page[h]
+        return page
+
+    # -- prefix matching -----------------------------------------------------
+
+    def match_prefix(self, seq_id: str, prompt_tokens: list[int]) -> int:
+        """Acquire the longest cached page chain for this prompt; returns
+        the number of prefix TOKENS covered (multiple of page_size, capped
+        at ``len(prompt) - 1`` so the last token is always recomputed)."""
+        ps = self.cache_cfg.page_size
+        self.query_tokens_total += len(prompt_tokens)
+        usable_blocks = max(0, (len(prompt_tokens) - 1) // ps)
+        shared: list[int] = []
+        for h in block_hashes(prompt_tokens, ps)[:usable_blocks]:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            shared.append(page)
+        for page in shared:
+            self._refs[page] = self._refs.get(page, 0) + 1
+            self._evictable.pop(page, None)
+        if shared:
+            self._shared_of[seq_id] = list(shared)
+            self._owned.setdefault(seq_id, []).extend(shared)
+        self.hit_tokens_total += len(shared) * ps
+        return len(shared) * ps
+
+    # -- allocation ----------------------------------------------------------
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        need = self.pages_needed(n_tokens)
+        return need <= self.free_pages and need <= self.cache_cfg.max_pages_per_seq
+
+    def _peek_match(self, prompt_tokens: list[int]) -> tuple[int, int]:
+        """(matched pages, matched pages currently evictable) — a dry run
+        of :meth:`match_prefix` that acquires nothing."""
+        ps = self.cache_cfg.page_size
+        usable_blocks = max(0, (len(prompt_tokens) - 1) // ps)
+        matched = evictable = 0
+        for h in block_hashes(prompt_tokens, ps)[:usable_blocks]:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            matched += 1
+            evictable += 1 if page in self._evictable else 0
+        return matched, evictable
+
+    def can_admit(self, prompt_tokens: list, extra_tokens: int = 1) -> bool:
+        """Reuse-aware admission: a request whose prompt is mostly cached
+        needs only the uncovered pages.  Matched-but-evictable pages count
+        as free AND as matched, so subtract them from both sides."""
+        need_total = self.pages_needed(len(prompt_tokens) + extra_tokens)
+        if need_total > self.cache_cfg.max_pages_per_seq:
+            return False
+        matched, evictable = self._peek_match(list(prompt_tokens))
+        return need_total - matched <= self.free_pages - evictable
+
+    def allocate(self, seq_id: str, n_tokens: int) -> list[int]:
+        """Grow ``seq_id``'s table to cover ``n_tokens`` total (shared
+        prefix pages count toward the total)."""
+        have = len(self._owned.get(seq_id, []))
+        need_total = self.pages_needed(n_tokens)
+        extra = need_total - have
+        if need_total > self.cache_cfg.max_pages_per_seq:
+            raise MemoryError(
+                f"sequence of {n_tokens} tokens exceeds max_pages_per_seq="
+                f"{self.cache_cfg.max_pages_per_seq}"
+            )
+        if extra > self.free_pages:
+            raise MemoryError(
+                f"KV cache exhausted: need {extra} pages, have {self.free_pages}"
+            )
+        pages = [self._take_free_page() for _ in range(max(0, extra))]
+        self._owned.setdefault(seq_id, []).extend(pages)
+        return pages
+
+    def extend(self, seq_id: str, current_tokens: int, new_tokens: int) -> list[int]:
+        return self.allocate(seq_id, current_tokens + new_tokens)
+
+    # -- publishing ----------------------------------------------------------
+
+    def register_blocks(self, seq_id: str, prompt_tokens: list[int]) -> None:
+        """Content-address this sequence's full private prompt pages so
+        later requests can share them (called once after prefill)."""
+        ps = self.cache_cfg.page_size
+        pages = self._owned.get(seq_id, [])
+        for i, h in enumerate(block_hashes(prompt_tokens, ps)):
+            if i >= len(pages):
+                break
+            page = pages[i]
+            existing = self._page_hash.get(page)
+            if existing is not None:
+                continue  # already published (shared prefix)
+            if h in self._hash_to_page:
+                continue  # another sequence's page already owns this content
+            self._page_hash[page] = h
+            self._hash_to_page[h] = page
+            self._refs[page] = self._refs.get(page, 0) + 1
+
+    # -- release -------------------------------------------------------------
+
+    def release(self, seq_id: str) -> None:
+        pages = self._owned.pop(seq_id, [])
+        self._shared_of.pop(seq_id, None)
+        for page in pages:
+            if page in self._refs:
+                self._refs[page] -= 1
+                if self._refs[page] <= 0:
+                    del self._refs[page]
+                    # retain content: evictable until the pool needs it
+                    self._evictable[page] = None
+                    self._evictable.move_to_end(page)
+            else:
+                self._free.append(page)
+
+    def prefix_hit_rate(self) -> float:
+        if self.query_tokens_total == 0:
+            return 0.0
+        return self.hit_tokens_total / self.query_tokens_total

@@ -1,12 +1,17 @@
-"""Token sampling: greedy, temperature, top-k, top-p.
+"""Token sampling: greedy, temperature, top-k, top-p, penalties, seeds.
 
 Batched and jittable; each sequence carries its own sampling params so one
-compiled sampler serves a heterogeneous continuous batch.
+compiled sampler serves a heterogeneous continuous batch.  Per-request
+seeds give reproducible sampling **independent of batch composition**:
+each row draws from its own PRNG stream (``fold_in(seed, n_generated)``),
+so the same request produces the same tokens whether it runs solo or
+packed with strangers.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -18,17 +23,48 @@ class SamplingParams:
     top_k: int = 0  # 0 = disabled
     top_p: float = 1.0
     max_tokens: int = 128
+    min_tokens: int = 0  # stop tokens suppressed until this many generated
     stop_token_ids: tuple[int, ...] = ()
+    presence_penalty: float = 0.0  # subtract once per seen token id
+    frequency_penalty: float = 0.0  # subtract per occurrence
+    repetition_penalty: float = 1.0  # HF-style multiplicative, 1 = off
+    seed: Optional[int] = None  # per-request reproducibility
 
     @property
     def greedy(self) -> bool:
         return self.temperature <= 0.0
 
+    @property
+    def needs_token_counts(self) -> bool:
+        return (
+            self.presence_penalty != 0.0
+            or self.frequency_penalty != 0.0
+            or self.repetition_penalty != 1.0
+        )
+
+
+@jax.jit
+def apply_penalties(
+    logits: jax.Array,  # [B, V] float32
+    token_counts: jax.Array,  # [B, V] int32 — prompt + generated occurrences
+    presence: jax.Array,  # [B]
+    frequency: jax.Array,  # [B]
+    repetition: jax.Array,  # [B], 1.0 = off
+) -> jax.Array:
+    seen = token_counts > 0
+    rep = repetition[:, None]
+    logits = jnp.where(
+        seen, jnp.where(logits > 0, logits / rep, logits * rep), logits
+    )
+    logits = logits - presence[:, None] * seen
+    logits = logits - frequency[:, None] * token_counts
+    return logits
+
 
 @jax.jit
 def sample(
-    logits: jax.Array,  # [B, V] float32
-    key: jax.Array,
+    logits: jax.Array,  # [B, V] float32 (penalties already applied)
+    keys: jax.Array,  # [B] PRNG keys — one independent stream per row
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32, 0 = off
     top_p: jax.Array,  # [B]
@@ -57,5 +93,20 @@ def sample(
     ).min(axis=-1, keepdims=True)
     scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
+@jax.jit
+def make_row_keys(seeds: jax.Array, counters: jax.Array) -> jax.Array:
+    """[B] independent keys: stream ``seed``, position ``counter``."""
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.fold_in(jax.random.key(s), c), 0)
+    )(seeds, counters)
+
+
+@jax.jit
+def count_prompt_tokens(tokens: jax.Array, vocab_size_arr: jax.Array) -> jax.Array:
+    """[S] prompt token ids → [V] occurrence counts (V from arr shape)."""
+    V = vocab_size_arr.shape[0]
+    return jnp.zeros((V,), jnp.int32).at[tokens].add(1)

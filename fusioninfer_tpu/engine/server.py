@@ -257,12 +257,18 @@ class EngineServer:
 
     def _sampling_params(self, body: dict) -> SamplingParams:
         stop_ids = [self.tokenizer.eos_token_id]
+        seed = body.get("seed")
         return SamplingParams(
             temperature=float(body.get("temperature", 1.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
             max_tokens=int(body.get("max_tokens", 128)),
+            min_tokens=int(body.get("min_tokens", 0)),
             stop_token_ids=tuple(stop_ids),
+            presence_penalty=float(body.get("presence_penalty", 0.0)),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+            repetition_penalty=float(body.get("repetition_penalty", 1.0)),
+            seed=int(seed) if seed is not None else None,
         )
 
     def stream_completion(self, body: dict, chat: bool = False):
@@ -552,6 +558,7 @@ def serve_from_args(args) -> int:
     engine = NativeEngine(
         cfg, cache_cfg=cache_cfg, max_batch_size=args.max_batch_size, seed=args.seed,
         mesh=mesh, params=params,
+        enable_prefix_caching=not getattr(args, "no_prefix_caching", False),
     )
     server = EngineServer(
         model=model_name,

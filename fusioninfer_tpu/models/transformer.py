@@ -147,6 +147,43 @@ def _attention(q, k, v, mask):
     return out.reshape(B, S, H * Hd)
 
 
+def qkv_proj(
+    cfg: ModelConfig, layer: Params, x: jax.Array, positions: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Pre-norm + QKV projection + (optional) QK-norm + RoPE — shared by
+    every execution path (full forward, paged prefill/suffix, decode) so
+    model features can never drift between them.
+
+    x: [B, S, D] → q [B, S, H, Hd], k/v [B, S, KV, Hd].
+    """
+    B, S, _ = x.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = (h @ layer["wq"]).reshape(B, S, H, Hd)
+    k = (h @ layer["wk"]).reshape(B, S, KV, Hd)
+    v = (h @ layer["wv"]).reshape(B, S, KV, Hd)
+    if cfg.qk_norm:
+        q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def mlp_block(cfg: ModelConfig, layer: Params, x: jax.Array) -> jax.Array:
+    """Pre-norm + FFN (dense SwiGLU or MoE), shared by every path.
+
+    x: [B, S, D] → [B, S, D] (residual NOT added)."""
+    B, S, D = x.shape
+    h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    if cfg.is_moe:
+        return moe_ffn(
+            h.reshape(B * S, D), layer["router"], layer["w_gate"], layer["w_up"],
+            layer["w_down"], cfg.n_experts_active,
+        ).reshape(B, S, D)
+    return swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"])
+
+
 def layer_forward(
     cfg: ModelConfig,
     layer: Params,
@@ -164,17 +201,8 @@ def layer_forward(
     runs the flash kernel per tensor-parallel shard via shard_map.
     """
     B, S, D = x.shape
-    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = (h @ layer["wq"]).reshape(B, S, H, Hd)
-    k = (h @ layer["wk"]).reshape(B, S, KV, Hd)
-    v = (h @ layer["wv"]).reshape(B, S, KV, Hd)
-    if cfg.qk_norm:
-        q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
-        k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q, k, v = qkv_proj(cfg, layer, x, positions)
 
     if kv is None:
         from fusioninfer_tpu.ops import dispatch, flash_attention
@@ -198,17 +226,7 @@ def layer_forward(
         attn_k, attn_v = kv
         attn = _attention(q, attn_k, attn_v, mask)
     x = x + attn @ layer["wo"]
-
-    h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
-    if cfg.is_moe:
-        flat = h.reshape(B * S, D)
-        ff = moe_ffn(
-            flat, layer["router"], layer["w_gate"], layer["w_up"], layer["w_down"],
-            cfg.n_experts_active,
-        ).reshape(B, S, D)
-    else:
-        ff = swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"])
-    return x + ff, (k, v)
+    return x + mlp_block(cfg, layer, x), (k, v)
 
 
 def causal_mask(S: int, dtype=jnp.bool_) -> jax.Array:

@@ -71,9 +71,11 @@ class TestEngine:
         assert all(len(v) == 4 for v in outputs.values())
 
     def test_preemption_under_kv_pressure(self):
-        # tiny cache: 15 usable pages of 8 tokens; two big requests can't fit
+        # tiny cache: 15 usable pages of 8 tokens; two big requests can't fit.
+        # Prefix caching off: the identical prompts would otherwise share
+        # pages and defeat the pressure this test creates.
         tight = CacheConfig(n_pages=16, page_size=8, max_pages_per_seq=8)
-        engine = make_engine(cache_cfg=tight)
+        engine = make_engine(cache_cfg=tight, enable_prefix_caching=False)
         engine.add_request(Request("big1", list(range(1, 30)), SamplingParams(temperature=0.0, max_tokens=30)))
         engine.add_request(Request("big2", list(range(1, 30)), SamplingParams(temperature=0.0, max_tokens=30)))
         outputs, finished = run_to_completion(engine, max_steps=400)
@@ -359,3 +361,48 @@ class TestProfileEndpoint:
                 "no trace artifacts written"
         finally:
             srv.stop()
+
+
+class TestSamplingSemantics:
+    def test_seeded_request_reproducible_across_batch_compositions(self):
+        prompt = [3, 5, 7, 9]
+        sp = SamplingParams(temperature=1.2, max_tokens=8, seed=1234)
+        solo = make_engine()
+        solo.add_request(Request("s", list(prompt), sp))
+        solo_out, _ = run_to_completion(solo)
+
+        crowded = make_engine()
+        crowded.add_request(Request("noise1", [8, 6, 4], SamplingParams(temperature=1.0, max_tokens=12)))
+        crowded.add_request(Request("s", list(prompt), sp))
+        crowded.add_request(Request("noise2", [2, 2, 2], SamplingParams(temperature=1.0, max_tokens=5)))
+        crowded_out, _ = run_to_completion(crowded)
+        assert crowded_out["s"] == solo_out["s"], \
+            "seeded sampling must not depend on batch composition"
+
+    def test_min_tokens_suppresses_stop(self):
+        engine = make_engine()
+        # find what greedy stops on first
+        engine.add_request(Request("probe", [7, 7], SamplingParams(temperature=0.0, max_tokens=2)))
+        probe, _ = run_to_completion(engine)
+        stop_tok = probe["probe"][0]
+
+        engine2 = make_engine()
+        engine2.add_request(Request("m", [7, 7], SamplingParams(
+            temperature=0.0, max_tokens=6, min_tokens=4, stop_token_ids=(stop_tok,))))
+        out, finished = run_to_completion(engine2)
+        # the stop token cannot appear among the first 4 generated tokens
+        assert stop_tok not in out["m"][:4]
+        assert len(out["m"]) >= 4
+
+    def test_repetition_penalty_changes_greedy_stream(self):
+        engine = make_engine()
+        engine.add_request(Request("plain", [1, 2, 3], SamplingParams(temperature=0.0, max_tokens=10)))
+        plain, _ = run_to_completion(engine)
+
+        engine2 = make_engine()
+        engine2.add_request(Request("pen", [1, 2, 3], SamplingParams(
+            temperature=0.0, max_tokens=10, repetition_penalty=2.0,
+            frequency_penalty=1.5)))
+        pen, _ = run_to_completion(engine2)
+        # random-weight models loop hard; penalties must break the loop
+        assert pen["pen"] != plain["plain"]

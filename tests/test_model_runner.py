@@ -157,16 +157,53 @@ def test_sampler_modes():
     from fusioninfer_tpu.engine.sampler import sample
 
     logits = jnp.asarray([[0.0, 5.0, 1.0, -2.0]] * 3)
-    key = jax.random.key(0)
+    keys = jax.random.split(jax.random.key(0), 3)
     # greedy
-    toks = sample(logits, key, jnp.asarray([0.0, 0.0, 0.0]),
+    toks = sample(logits, keys, jnp.asarray([0.0, 0.0, 0.0]),
                   jnp.zeros(3, jnp.int32), jnp.ones(3))
     assert list(np.asarray(toks)) == [1, 1, 1]
     # top_k=1 is greedy regardless of temperature
-    toks = sample(logits, key, jnp.asarray([5.0, 5.0, 5.0]),
+    toks = sample(logits, keys, jnp.asarray([5.0, 5.0, 5.0]),
                   jnp.ones(3, jnp.int32), jnp.ones(3))
     assert list(np.asarray(toks)) == [1, 1, 1]
     # tiny top_p keeps only the argmax
-    toks = sample(logits, key, jnp.asarray([2.0, 2.0, 2.0]),
+    toks = sample(logits, keys, jnp.asarray([2.0, 2.0, 2.0]),
                   jnp.zeros(3, jnp.int32), jnp.asarray([0.01, 0.01, 0.01]))
     assert list(np.asarray(toks)) == [1, 1, 1]
+
+
+def test_sampler_penalties_and_seed_streams():
+    from fusioninfer_tpu.engine.sampler import apply_penalties, make_row_keys, sample
+
+    logits = jnp.asarray([[0.0, 5.0, 1.0, -2.0]] * 2)
+    counts = jnp.asarray([[0, 3, 0, 0], [0, 0, 0, 0]], jnp.int32)
+    # heavy frequency penalty on token 1 flips row 0's argmax to token 2
+    out = apply_penalties(
+        logits, counts,
+        presence=jnp.asarray([1.0, 0.0]),
+        frequency=jnp.asarray([2.0, 0.0]),
+        repetition=jnp.asarray([1.5, 1.0]),
+    )
+    toks = sample(out, jax.random.split(jax.random.key(0), 2),
+                  jnp.zeros(2), jnp.zeros(2, jnp.int32), jnp.ones(2))
+    assert list(np.asarray(toks)) == [2, 1]  # penalized row moved, clean row didn't
+
+    # same (seed, position) => same key => same draw; different position differs
+    k1 = make_row_keys(jnp.asarray([7, 7], jnp.uint32), jnp.asarray([0, 0], jnp.int32))
+    k2 = make_row_keys(jnp.asarray([7, 7], jnp.uint32), jnp.asarray([0, 1], jnp.int32))
+    t1 = sample(logits, k1, jnp.asarray([10.0, 10.0]), jnp.zeros(2, jnp.int32), jnp.ones(2))
+    t2 = sample(logits, k2, jnp.asarray([10.0, 10.0]), jnp.zeros(2, jnp.int32), jnp.ones(2))
+    assert int(t1[0]) == int(t1[1])  # identical streams agree
+    # across many draws the two stream positions must diverge somewhere
+    diverged = any(
+        int(sample(logits, make_row_keys(jnp.asarray([s, s], jnp.uint32),
+                                          jnp.asarray([0, 1], jnp.int32)),
+                   jnp.asarray([10.0, 10.0]), jnp.zeros(2, jnp.int32),
+                   jnp.ones(2))[0])
+        != int(sample(logits, make_row_keys(jnp.asarray([s, s], jnp.uint32),
+                                             jnp.asarray([0, 1], jnp.int32)),
+                      jnp.asarray([10.0, 10.0]), jnp.zeros(2, jnp.int32),
+                      jnp.ones(2))[1])
+        for s in range(8)
+    )
+    assert diverged
