@@ -1,40 +1,98 @@
-"""Benchmark: steady-state decode throughput of the native TPU engine.
+"""Benchmark: decode throughput + HTTP-level TTFT of the native TPU engine.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}``
+``{"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N,
+"backend": ..., "http": {...}}``
 
-Measures the continuous-batching hot loop — batched ``decode_step`` over a
-paged KV cache — the dominant cost of serving (BASELINE.md north-star:
-output tokens/sec/chip).  On TPU it runs a Qwen3-1.7B-shaped model (fits
-one v5e chip in bf16 with KV headroom); on CPU it falls back to the tiny
-config so CI smoke runs finish in seconds.
+Two phases, both on the BASELINE.md north star:
 
-The reference publishes no numbers (BASELINE.md: ``published: {}``), so
-``vs_baseline`` is reported against our own first recorded TPU run once
-one exists; until then 1.0.
+1. **Decode core** — batched ``decode_step`` over a paged KV cache, the
+   continuous-batching hot loop (output tokens/sec/chip).
+2. **HTTP load** — ShareGPT-style mixed-length streaming requests against
+   the full OpenAI-compatible server (p50 TTFT + tok/s/chip through the
+   real serving stack), via :mod:`fusioninfer_tpu.benchmark.loadgen`.
+
+Hardened against flaky TPU init (round-1 failure mode: the tunneled
+backend hung or raised UNAVAILABLE and the bench emitted a traceback
+instead of JSON): the TPU backend is probed in a SUBPROCESS with a
+timeout and retried with backoff, so a hung PJRT init can never hang the
+bench itself; on persistent failure the bench still emits its JSON line
+(backend: cpu fallback, with the probe error recorded).  The reference
+publishes no numbers (BASELINE.md: ``published: {}``), so
+``vs_baseline`` is 1.0 until our own first TPU number is recorded.
+
+Env knobs: ``BENCH_PLATFORM=cpu`` (skip probe, run CPU smoke),
+``BENCH_SKIP_HTTP=1`` (decode core only), ``BENCH_TPU_PROBE_TIMEOUTS``
+(comma list of per-attempt seconds, default ``180,300``).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import jax
-
-if os.environ.get("BENCH_PLATFORM"):  # e.g. BENCH_PLATFORM=cpu for local smoke
-    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
-import jax.numpy as jnp
-import numpy as np
-
-from fusioninfer_tpu.engine.kv_cache import CacheConfig, PageAllocator, init_kv_cache
-from fusioninfer_tpu.engine.model_runner import decode_step
-from fusioninfer_tpu.models.config import get_preset
-from fusioninfer_tpu.models.transformer import init_params
+_PROBE_SNIPPET = (
+    "import jax; d = jax.devices(); "
+    "print('PROBE_OK', jax.default_backend(), len(d), flush=True)"
+)
 
 
-def run(model: str, batch: int, cache_cfg: CacheConfig, prefix_len: int,
-        warmup: int, steps: int) -> float:
+def probe_tpu() -> tuple[bool, str]:
+    """Try TPU init in a killable subprocess; returns (ok, detail)."""
+    raw = os.environ.get("BENCH_TPU_PROBE_TIMEOUTS", "")
+    try:
+        timeouts = [float(t) for t in raw.split(",") if t.strip()]
+    except ValueError:
+        timeouts = []
+    if not timeouts:
+        timeouts = [180.0, 300.0]
+    detail = ""
+    for i, budget in enumerate(timeouts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True, text=True, timeout=budget,
+            )
+        except subprocess.TimeoutExpired:
+            detail = f"attempt {i + 1}: TPU init hung >{budget:.0f}s (killed)"
+            print(detail, file=sys.stderr, flush=True)
+            continue
+        out = (proc.stdout or "").strip().splitlines()
+        if proc.returncode == 0 and any(line.startswith("PROBE_OK") for line in out):
+            return True, out[-1]
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        detail = f"attempt {i + 1}: rc={proc.returncode} {' | '.join(tail)}"
+        print(detail, file=sys.stderr, flush=True)
+        if i + 1 < len(timeouts):
+            time.sleep(10 * (i + 1))
+    return False, detail
+
+
+def pick_backend() -> tuple[str, str]:
+    """Decide the platform BEFORE jax initializes a backend in-process.
+    Returns (platform-to-force, probe detail); '' = leave default."""
+    forced = os.environ.get("BENCH_PLATFORM")
+    if forced:
+        return forced, f"forced by BENCH_PLATFORM={forced}"
+    ok, detail = probe_tpu()
+    if ok:
+        return "", detail
+    return "cpu", f"TPU unavailable, CPU fallback ({detail})"
+
+
+def run_decode(jax, model: str, batch: int, cache_cfg, prefix_len: int,
+               warmup: int, steps: int) -> float:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fusioninfer_tpu.engine.kv_cache import PageAllocator, init_kv_cache
+    from fusioninfer_tpu.engine.model_runner import decode_step
+    from fusioninfer_tpu.models.config import get_preset
+    from fusioninfer_tpu.models.transformer import init_params
+
     cfg = get_preset(model)
     cache_cfg.validate()
     params = jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
@@ -70,34 +128,87 @@ def run(model: str, batch: int, cache_cfg: CacheConfig, prefix_len: int,
     return batch * steps / elapsed
 
 
+def run_http(model: str, max_batch_size: int, cache_cfg, n_requests: int,
+             concurrency: int, max_prompt: int, max_output: int) -> dict:
+    from fusioninfer_tpu.benchmark.loadgen import run_http_load
+    from fusioninfer_tpu.engine.server import EngineServer
+
+    srv = EngineServer(
+        model=model, host="127.0.0.1", port=0,
+        max_batch_size=max_batch_size, cache_cfg=cache_cfg,
+    )
+    srv.start()
+    try:
+        result = run_http_load(
+            f"http://127.0.0.1:{srv.port}",
+            n_requests=n_requests, concurrency=concurrency, seed=0,
+            max_prompt=max_prompt, max_output=max_output,
+        )
+        return result.summary(n_chips=1)
+    finally:
+        srv.stop()
+
+
 def main() -> None:
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        # Qwen3-1.7B shapes, 32-way continuous batch, 1 KiB-token contexts:
-        # ~3.4 GiB weights + ~7.3 GiB KV pages on a 16 GiB v5e chip.
-        tok_s = run(
-            model="qwen3-1.7b",
-            batch=32,
-            cache_cfg=CacheConfig(n_pages=32 * 8 + 1, page_size=128, max_pages_per_seq=8),
-            prefix_len=128,
-            warmup=5,
-            steps=64,
-        )
-    else:
-        tok_s = run(
-            model="qwen3-tiny",
-            batch=8,
-            cache_cfg=CacheConfig(n_pages=33, page_size=64, max_pages_per_seq=4),
-            prefix_len=32,
-            warmup=2,
-            steps=16,
-        )
-    print(json.dumps({
-        "metric": "decode_throughput_qwen3_1.7b" if on_tpu else "decode_throughput_tiny_cpu",
-        "value": round(tok_s, 2),
+    record: dict = {
+        "metric": "decode_throughput",
+        "value": 0.0,
         "unit": "tokens/sec/chip",
         "vs_baseline": 1.0,
-    }))
+        "backend": "unknown",
+    }
+    try:
+        platform, detail = pick_backend()
+        if platform:
+            os.environ["JAX_PLATFORMS"] = platform
+        record["probe"] = detail
+
+        import jax
+
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        from fusioninfer_tpu.engine.kv_cache import CacheConfig
+
+        backend = jax.default_backend()
+        record["backend"] = backend
+        on_tpu = backend == "tpu"
+        if on_tpu:
+            # Qwen3-1.7B shapes, 32-way continuous batch, 1 KiB-token
+            # contexts: ~3.4 GiB weights + KV pages on a 16 GiB v5e chip.
+            model, batch = "qwen3-1.7b", 32
+            cache_cfg = CacheConfig(n_pages=32 * 8 + 1, page_size=128,
+                                    max_pages_per_seq=8)
+            tok_s = run_decode(jax, model, batch, cache_cfg,
+                               prefix_len=128, warmup=5, steps=64)
+            record["metric"] = "decode_throughput_qwen3_1.7b"
+        else:
+            model, batch = "qwen3-tiny", 8
+            cache_cfg = CacheConfig(n_pages=33, page_size=64, max_pages_per_seq=4)
+            tok_s = run_decode(jax, model, batch, cache_cfg,
+                               prefix_len=32, warmup=2, steps=16)
+            record["metric"] = "decode_throughput_tiny_cpu"
+        record["value"] = round(tok_s, 2)
+
+        if os.environ.get("BENCH_SKIP_HTTP", "") != "1":
+            if on_tpu:
+                http_cache = CacheConfig(n_pages=16 * 10 + 1, page_size=128,
+                                         max_pages_per_seq=10)
+                record["http"] = run_http(
+                    model, max_batch_size=16, cache_cfg=http_cache,
+                    n_requests=48, concurrency=12,
+                    max_prompt=1024, max_output=128,
+                )
+            else:
+                http_cache = CacheConfig(n_pages=8 * 4 + 1, page_size=64,
+                                         max_pages_per_seq=4)
+                record["http"] = run_http(
+                    model, max_batch_size=8, cache_cfg=http_cache,
+                    n_requests=12, concurrency=4,
+                    max_prompt=128, max_output=32,
+                )
+    except Exception as e:  # never a traceback instead of the JSON line
+        record["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,4 @@
+"""Benchmark harnesses: HTTP-level load generation (TTFT / throughput)
+and the decode-throughput core used by ``bench.py``."""
+
+from fusioninfer_tpu.benchmark.loadgen import LoadResult, run_http_load  # noqa: F401

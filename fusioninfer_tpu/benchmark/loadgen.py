@@ -1,0 +1,156 @@
+"""HTTP-level load generator for the north-star metric.
+
+BASELINE.md names the target: **p50 TTFT + output tokens/sec/chip under
+ShareGPT-style load** (mixed prompt/output lengths, streaming clients).
+The reference publishes no numbers and delegates serving to vLLM
+(``/root/reference/docs/.../core-design.md:29``); this harness measures
+our in-repo engine through the same interface a gateway would use — the
+OpenAI-compatible HTTP surface with SSE streaming — so TTFT includes
+tokenization, queueing, scheduling, prefill, and the HTTP hop, not just
+the kernel.
+
+ShareGPT's empirical length mix is approximated with a fixed log-normal
+draw (median prompt ≈ 80 tokens, heavy right tail; outputs similar),
+deterministic under ``seed`` so runs are comparable.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class LoadResult:
+    n_requests: int
+    n_ok: int
+    duration_s: float
+    ttft_s: list[float] = field(default_factory=list)
+    output_tokens: int = 0
+    prompt_tokens: int = 0
+
+    def percentile_ttft(self, p: float) -> float:
+        if not self.ttft_s:
+            return float("nan")
+        return float(np.percentile(np.asarray(self.ttft_s), p))
+
+    @property
+    def output_tok_per_s(self) -> float:
+        return self.output_tokens / self.duration_s if self.duration_s else 0.0
+
+    def summary(self, n_chips: int = 1) -> dict:
+        return {
+            "requests": self.n_requests,
+            "ok": self.n_ok,
+            "duration_s": round(self.duration_s, 3),
+            "ttft_p50_ms": round(self.percentile_ttft(50) * 1e3, 1),
+            "ttft_p90_ms": round(self.percentile_ttft(90) * 1e3, 1),
+            "ttft_p99_ms": round(self.percentile_ttft(99) * 1e3, 1),
+            "output_tokens": self.output_tokens,
+            "output_tok_per_s_per_chip": round(self.output_tok_per_s / n_chips, 2),
+        }
+
+
+def sharegpt_lengths(
+    n: int, seed: int, median_prompt: int = 80, median_output: int = 64,
+    max_prompt: int = 1024, max_output: int = 256,
+) -> list[tuple[int, int]]:
+    """Deterministic (prompt_len, output_len) pairs with a ShareGPT-like
+    log-normal shape: most requests short, a heavy tail of long ones."""
+    rng = np.random.default_rng(seed)
+    prompts = np.clip(
+        rng.lognormal(np.log(median_prompt), 0.9, n).astype(int), 4, max_prompt
+    )
+    outputs = np.clip(
+        rng.lognormal(np.log(median_output), 0.7, n).astype(int), 4, max_output
+    )
+    return list(zip(prompts.tolist(), outputs.tolist()))
+
+
+def _one_request(
+    base_url: str, prompt_len: int, output_len: int, result: LoadResult,
+    lock: threading.Lock, timeout: float, seed: int,
+) -> None:
+    # byte-tokenizer-friendly synthetic prompt of the requested token length
+    prompt = "a" * prompt_len
+    body = json.dumps({
+        "prompt": prompt,
+        "max_tokens": output_len,
+        "temperature": 0.8,
+        "seed": seed,
+        "stream": True,
+    }).encode()
+    req = urllib.request.Request(
+        f"{base_url}/v1/completions", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    ttft = None
+    n_chunks = 0
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                n_chunks += 1
+    except Exception:
+        return
+    with lock:
+        result.n_ok += 1
+        if ttft is not None:
+            result.ttft_s.append(ttft)
+        result.output_tokens += n_chunks
+        result.prompt_tokens += prompt_len
+
+
+def run_http_load(
+    base_url: str,
+    n_requests: int = 64,
+    concurrency: int = 16,
+    seed: int = 0,
+    timeout: float = 120.0,
+    median_prompt: int = 80,
+    median_output: int = 64,
+    max_prompt: int = 1024,
+    max_output: int = 256,
+) -> LoadResult:
+    """Closed-loop load: ``concurrency`` worker threads drain a shared
+    queue of ShareGPT-style requests against a running server."""
+    pairs = sharegpt_lengths(
+        n_requests, seed, median_prompt=median_prompt,
+        median_output=median_output, max_prompt=max_prompt,
+        max_output=max_output,
+    )
+    result = LoadResult(n_requests=n_requests, n_ok=0, duration_s=0.0)
+    lock = threading.Lock()
+    it = iter(enumerate(pairs))
+    it_lock = threading.Lock()
+
+    def worker():
+        while True:
+            with it_lock:
+                nxt = next(it, None)
+            if nxt is None:
+                return
+            i, (p_len, o_len) = nxt
+            _one_request(base_url, p_len, o_len, result, lock, timeout, seed + i)
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    result.duration_s = time.perf_counter() - t0
+    return result

@@ -36,9 +36,13 @@ def _cmd_controller_run(args: argparse.Namespace) -> int:
         probe_port=args.probe_port,
         metrics_port=args.metrics_port,
         default_queue=args.volcano_queue or None,
+        leader_elect=args.leader_elect,
+        leader_identity=os.environ.get("POD_NAME") or None,
     )
     mgr.run_forever()
-    return 0
+    # mirror controller-runtime: lost leadership is a fatal exit so the
+    # pod restarts as a standby
+    return 1 if mgr.leadership_lost else 0
 
 
 def _cmd_render(args: argparse.Namespace) -> int:
@@ -133,6 +137,8 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--probe-port", type=int, default=8081)
     run.add_argument("--metrics-port", type=int, default=8443)
     run.add_argument("--volcano-queue", default="")
+    run.add_argument("--leader-elect", action="store_true",
+                     help="lease-based active/standby HA (coordination.k8s.io)")
     run.add_argument("-v", "--verbose", action="store_true")
     run.set_defaults(func=_cmd_controller_run)
 

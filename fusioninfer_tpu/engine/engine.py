@@ -158,9 +158,12 @@ class NativeEngine:
         self._step_counter = itertools.count()
         self._seed_counter = itertools.count(1)
         self._base_seed = seed
-        # per-slot sampling state (device-resident; V-wide rows)
+        # per-slot sampling state (device-resident; V-wide rows):
+        # combined prompt+output counts feed the repetition penalty,
+        # output-only counts feed presence/frequency (OpenAI semantics)
         V = self.cfg.vocab_size
         self._token_counts = jnp.zeros((max_batch_size, V), jnp.int32)
+        self._output_counts = jnp.zeros((max_batch_size, V), jnp.int32)
         self._suppress = jnp.zeros((max_batch_size, V), jnp.bool_)
 
         self.waiting: collections.deque[Request] = collections.deque()
@@ -316,7 +319,7 @@ class NativeEngine:
                     seed=self._request_seed(request),
                     first_token_time=time.monotonic(),
                 )
-                self._register_slot(slot, state.tokens, request.params)
+                self._register_slot(slot, state.tokens, state.n_prompt, request.params)
                 self.running[slot] = state
                 self.generation_tokens_total += 1
                 outputs.append(self._emit(state, slab.first_token, first=True))
@@ -454,22 +457,33 @@ class NativeEngine:
         return row
 
     def _sample_first_token(self, logits: jax.Array, request: Request,
-                            prefix: list[int], seed: int) -> int:
+                            prefix: list[int], seed: int,
+                            n_prompt: Optional[int] = None) -> int:
         """Sample a prefill's first token with full per-request sampling
-        semantics (penalties over the prompt, stop suppression under
-        min_tokens, the request's own PRNG stream at position 0)."""
+        semantics (repetition penalty over the whole prefix,
+        presence/frequency over previously *generated* tokens only, stop
+        suppression under min_tokens, the request's own PRNG stream).
+
+        ``n_prompt``: prompt length within ``prefix`` (differs on resume,
+        where the prefix also carries already-generated tokens — those
+        count as output for penalties, and set the PRNG counter so a
+        seeded request replays the same stream it would have continued)."""
         p = request.params
+        if n_prompt is None:
+            n_prompt = len(prefix)
         counts = self._prompt_counts(prefix)[None]
+        out_counts = self._prompt_counts(prefix[n_prompt:])[None]
         logits = apply_penalties(
-            logits, counts,
+            logits, counts, out_counts,
             jnp.asarray([p.presence_penalty]),
             jnp.asarray([p.frequency_penalty]),
             jnp.asarray([p.repetition_penalty]),
         )
-        if p.min_tokens > 0 and p.stop_token_ids:
+        gen_index = len(prefix) - n_prompt
+        if gen_index < p.min_tokens and p.stop_token_ids:
             logits = jnp.where(self._stop_suppress_row(p)[None], -jnp.inf, logits)
         keys = make_row_keys(
-            jnp.asarray([seed], jnp.uint32), jnp.asarray([0], jnp.int32)
+            jnp.asarray([seed], jnp.uint32), jnp.asarray([gen_index], jnp.int32)
         )
         return int(
             sample(
@@ -480,10 +494,15 @@ class NativeEngine:
             )[0]
         )
 
-    def _register_slot(self, slot: int, tokens: list[int], params: SamplingParams) -> None:
-        """Reset the slot's device sampling state (counts incl. the first
-        generated token; stop-suppress mask for min_tokens)."""
+    def _register_slot(self, slot: int, tokens: list[int], n_prompt: int,
+                       params: SamplingParams) -> None:
+        """Reset the slot's device sampling state: combined counts (incl.
+        the first generated token) for repetition, output-only counts for
+        presence/frequency, stop-suppress mask for min_tokens."""
         self._token_counts = self._token_counts.at[slot].set(self._prompt_counts(tokens))
+        self._output_counts = self._output_counts.at[slot].set(
+            self._prompt_counts(tokens[n_prompt:])
+        )
         self._suppress = self._suppress.at[slot].set(self._stop_suppress_row(params))
 
     def _prefill_request(self, request: Request) -> Optional[StepOutput]:
@@ -522,17 +541,19 @@ class NativeEngine:
         if self.prefix_caching:
             self.alloc.register_blocks(rid, prefix)
         seq_seed = self._request_seed(request)
-        token = self._sample_first_token(logits, request, prefix, seq_seed)
+        n_prompt = len(request.prompt_tokens)
+        token = self._sample_first_token(logits, request, prefix, seq_seed,
+                                         n_prompt=n_prompt)
         slot = self._free_slots.pop()
         state = _SeqState(
             request=request,
             tokens=list(prefix) + [token],
-            n_prompt=len(request.prompt_tokens),
+            n_prompt=n_prompt,
             slot=slot,
             seed=seq_seed,
             first_token_time=time.monotonic(),
         )
-        self._register_slot(slot, state.tokens, request.params)
+        self._register_slot(slot, state.tokens, n_prompt, request.params)
         self.running[slot] = state
         if not resumed:
             self.prompt_tokens_total += len(prefix)
@@ -586,7 +607,7 @@ class NativeEngine:
             jnp.asarray(active), mesh=self._kernel_mesh,
         )
         logits = apply_penalties(
-            logits, self._token_counts,
+            logits, self._token_counts, self._output_counts,
             jnp.asarray(presence), jnp.asarray(frequency), jnp.asarray(repetition),
         )
         # min_tokens: stop ids stay unsampleable until enough generated
@@ -597,6 +618,9 @@ class NativeEngine:
                              jnp.asarray(top_ks), jnp.asarray(top_ps))
         live_slots = jnp.asarray(sorted(live), jnp.int32)
         self._token_counts = self._token_counts.at[
+            live_slots, sampled_dev[live_slots]
+        ].add(1)
+        self._output_counts = self._output_counts.at[
             live_slots, sampled_dev[live_slots]
         ].add(1)
         sampled = np.asarray(sampled_dev)

@@ -92,24 +92,34 @@ def auto_cache_config(
     hbm_utilization: float = 0.85,
     tp: int = 1,
     hbm_bytes: int | None = None,
+    prefix_caching: bool = True,
 ) -> CacheConfig:
     """Size the page pool from device memory, vLLM's ``gpu_memory_utilization``
-    equivalent, then cap at peak addressable demand.
+    equivalent.
 
-    Peak demand is ``max_batch_size × pages_per_seq + 1`` — pages beyond
-    that can never be allocated (slots and per-seq pages are both capped),
-    so the HBM math acts as a feasibility check: if the request-shaped
-    pool does not fit the budget, fail fast at startup rather than OOM
-    mid-serving.  Falls back to request-shaped sizing when HBM stats are
-    unavailable (CPU tests).  With tensor parallelism both weights and KV
-    heads are sharded, so per-device cost divides by ``tp`` on both sides
-    of the subtraction.
+    Peak *demand* is ``max_batch_size × pages_per_seq + 1`` — the HBM math
+    acts as a feasibility check first: if that request-shaped pool does
+    not fit the budget, fail fast at startup rather than OOM mid-serving.
+
+    With ``prefix_caching`` (the engine default) released pages are
+    retained as evictable cache, so pages beyond peak demand directly
+    raise the prefix hit rate — the pool then grows into remaining HBM
+    headroom, capped at 4× demand (beyond that, hit-rate returns are
+    negligible while host-side page-table bookkeeping isn't free).
+    Without prefix caching the pool stays demand-sized: extra pages could
+    never be allocated.
+
+    Falls back to request-shaped sizing when HBM stats are unavailable
+    (CPU tests).  With tensor parallelism both weights and KV heads are
+    sharded, so per-device cost divides by ``tp`` on both sides of the
+    subtraction.
     """
     pages_per_seq = max(1, -(-max_model_len // page_size))
     min_pages = pages_per_seq * max_batch_size + 1
     if hbm_bytes is None:
         stats = jax.devices()[0].memory_stats() or {}
         hbm_bytes = stats.get("bytes_limit")
+    n_pages = min_pages
     if hbm_bytes:
         budget = int(hbm_bytes * hbm_utilization) - model_param_bytes(cfg) // tp
         fit = budget // max(1, page_bytes(cfg, page_size) // tp)
@@ -121,8 +131,10 @@ def auto_cache_config(
                 f"{hbm_utilization:.0%} of {hbm_bytes / 2**30:.1f} GiB HBM "
                 f"after weights; lower max_batch_size/max_model_len or raise tp"
             )
+        if prefix_caching:
+            n_pages = min(int(fit), 4 * min_pages)
     return CacheConfig(
-        n_pages=min_pages, page_size=page_size, max_pages_per_seq=pages_per_seq
+        n_pages=n_pages, page_size=page_size, max_pages_per_seq=pages_per_seq
     ).validate()
 
 

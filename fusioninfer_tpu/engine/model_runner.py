@@ -27,7 +27,6 @@ from jax import lax
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
 from fusioninfer_tpu.models.config import ModelConfig
 from fusioninfer_tpu.models.transformer import (
-    causal_mask,
     layer_forward,
     lm_head,
     mlp_block,
@@ -52,7 +51,6 @@ def prefill(
     ps = cache_cfg.page_size
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    mask = causal_mask(S)
 
     token_idx = jnp.arange(S)
     # Padded positions (>= true_len) write to the trash page.
@@ -63,7 +61,7 @@ def prefill(
 
     def body(x, inputs):
         layer, k_cache_l, v_cache_l = inputs
-        out, (k, v) = layer_forward(cfg, layer, x, positions, mask, mesh=mesh)
+        out, (k, v) = layer_forward(cfg, layer, x, positions, mesh=mesh)
         k_cache_l = k_cache_l.at[page_of_token, slot_of_token].set(k[0])
         v_cache_l = v_cache_l.at[page_of_token, slot_of_token].set(v[0])
         return out, (k_cache_l, v_cache_l)

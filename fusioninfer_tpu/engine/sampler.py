@@ -47,17 +47,21 @@ class SamplingParams:
 def apply_penalties(
     logits: jax.Array,  # [B, V] float32
     token_counts: jax.Array,  # [B, V] int32 — prompt + generated occurrences
+    output_counts: jax.Array,  # [B, V] int32 — generated occurrences only
     presence: jax.Array,  # [B]
     frequency: jax.Array,  # [B]
     repetition: jax.Array,  # [B], 1.0 = off
 ) -> jax.Array:
+    """OpenAI/vLLM semantics: presence/frequency penalize tokens the model
+    *generated* (never mere prompt occurrences); only the HF-style
+    repetition penalty spans prompt + output."""
     seen = token_counts > 0
     rep = repetition[:, None]
     logits = jnp.where(
         seen, jnp.where(logits > 0, logits / rep, logits * rep), logits
     )
-    logits = logits - presence[:, None] * seen
-    logits = logits - frequency[:, None] * token_counts
+    logits = logits - presence[:, None] * (output_counts > 0)
+    logits = logits - frequency[:, None] * output_counts
     return logits
 
 

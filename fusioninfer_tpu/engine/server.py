@@ -165,12 +165,21 @@ class EngineServer:
                 # PD decode role: pull KV from the prefiller over DCN
                 from fusioninfer_tpu.engine.kv_transfer import HTTPPullConnector
 
+                # forward the FULL sampling state: the prefiller samples the
+                # first token, so seed/penalties/min_tokens must match what
+                # an aggregated deployment would have used
                 slab = HTTPPullConnector(self.prefill_upstream).request_prefill(
                     request_id, prompt_tokens,
                     sampling={
                         "temperature": params.temperature,
                         "top_k": params.top_k,
                         "top_p": params.top_p,
+                        "min_tokens": params.min_tokens,
+                        "stop_token_ids": list(params.stop_token_ids),
+                        "presence_penalty": params.presence_penalty,
+                        "frequency_penalty": params.frequency_penalty,
+                        "repetition_penalty": params.repetition_penalty,
+                        "seed": params.seed,
                     },
                 )
                 self.engine.add_prefilled_request(request, slab)
@@ -227,11 +236,20 @@ class EngineServer:
         if not prompt_tokens:
             raise ValueError("prompt_tokens required")
         sampling = body.get("sampling") or {}
+        seed = sampling.get("seed")
         params = SamplingParams(
             temperature=float(sampling.get("temperature", 1.0)),
             top_k=int(sampling.get("top_k", 0)),
             top_p=float(sampling.get("top_p", 1.0)),
             max_tokens=1,
+            min_tokens=int(sampling.get("min_tokens", 0)),
+            stop_token_ids=tuple(
+                int(t) for t in sampling.get("stop_token_ids", ())
+            ),
+            presence_penalty=float(sampling.get("presence_penalty", 0.0)),
+            frequency_penalty=float(sampling.get("frequency_penalty", 0.0)),
+            repetition_penalty=float(sampling.get("repetition_penalty", 1.0)),
+            seed=int(seed) if seed is not None else None,
         )
         rid = body.get("request_id") or uuid.uuid4().hex[:16]
         fut = self.engine.request_prefill_slab(Request(rid, prompt_tokens, params))
@@ -553,6 +571,7 @@ def serve_from_args(args) -> int:
         max_batch_size=args.max_batch_size,
         hbm_utilization=args.hbm_utilization,
         tp=tp,
+        prefix_caching=not getattr(args, "no_prefix_caching", False),
     )
     logger.info("cache: %d pages of %d tokens", cache_cfg.n_pages, cache_cfg.page_size)
     engine = NativeEngine(

@@ -189,22 +189,31 @@ def layer_forward(
     layer: Params,
     x: jax.Array,
     positions: jax.Array,
-    mask: jax.Array,
+    mask: Optional[jax.Array] = None,
     kv: Optional[tuple[jax.Array, jax.Array]] = None,
     mesh=None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """One transformer block. Returns (output, (k, v)) for cache management.
 
     x: [B, S, D]; positions: [B, S]; mask broadcastable to [B, 1, S, T].
-    When ``kv`` is given, attends over provided (k, v) history that already
-    includes this block's fresh keys.  ``mesh``: a tp-only serving mesh —
-    runs the flash kernel per tensor-parallel shard via shard_map.
+    ``kv=None`` means fresh causal self-attention — the mask is derived
+    internally (``mask`` must be None; the flash-kernel path is causal by
+    construction and cannot honor an arbitrary caller mask).  When ``kv``
+    is given, attends over provided (k, v) history that already includes
+    this block's fresh keys, under the required ``mask``.  ``mesh``: a
+    tp-only serving mesh — runs the flash kernel per tensor-parallel
+    shard via shard_map.
     """
     B, S, D = x.shape
 
     q, k, v = qkv_proj(cfg, layer, x, positions)
 
     if kv is None:
+        if mask is not None:
+            raise ValueError(
+                "layer_forward(kv=None) is causal self-attention; it derives "
+                "its own mask — pass kv=(k, v) history to use a custom mask"
+            )
         from fusioninfer_tpu.ops import dispatch, flash_attention
 
         if dispatch.resolve_attn(cfg.attn_impl) == "flash" and dispatch.flash_seq_ok(S):
@@ -221,8 +230,10 @@ def layer_forward(
                     q, k, v, causal=True, interpret=dispatch.kernel_interpret()
                 )
         else:
-            attn = _attention(q, k, v, mask)
+            attn = _attention(q, k, v, causal_mask(S))
     else:
+        if mask is None:
+            raise ValueError("layer_forward with kv history requires a mask")
         attn_k, attn_v = kv
         attn = _attention(q, attn_k, attn_v, mask)
     x = x + attn @ layer["wo"]
@@ -252,10 +263,9 @@ def forward(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
     B, S = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    mask = causal_mask(S)
 
     def body(x, layer):
-        out, _ = layer_forward(cfg, layer, x, positions, mask)
+        out, _ = layer_forward(cfg, layer, x, positions)
         return out, None
 
     x, _ = lax.scan(body, x, params["layers"])

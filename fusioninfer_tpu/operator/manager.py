@@ -120,7 +120,15 @@ class ControllerMetrics:
 class Manager:
     def __init__(self, client: K8sClient, namespace: str = "default",
                  probe_port: int = 8081, metrics_port: int = 8443,
-                 default_queue: str | None = None):
+                 default_queue: str | None = None,
+                 leader_elect: bool = False,
+                 leader_identity: str | None = None,
+                 leader_election_config=None):
+        """``leader_elect``: active/standby HA via a coordination.k8s.io
+        Lease (the reference's ``--leader-elect``, cmd/main.go:80-82):
+        controllers start only on acquiring the lease; losing it stops
+        the manager (``leadership_lost``) so a supervisor can restart it
+        as a standby, mirroring controller-runtime's exit-on-loss."""
         self.client = client
         self.namespace = namespace
         self.probe_port = probe_port
@@ -131,6 +139,23 @@ class Manager:
         self.metrics = ControllerMetrics()
         self._stop = threading.Event()
         self.ready = threading.Event()
+        self.leadership_lost = False
+        self._controllers_started = False
+        self.elector = None
+        if leader_elect:
+            from fusioninfer_tpu.operator.leaderelection import (
+                LeaderElectionConfig,
+                LeaderElector,
+            )
+
+            self.elector = LeaderElector(
+                client,
+                namespace=namespace,
+                identity=leader_identity,
+                config=leader_election_config or LeaderElectionConfig(),
+                on_started_leading=self._start_controllers,
+                on_stopped_leading=self._on_leadership_lost,
+            )
 
     # -- event sources --
 
@@ -242,10 +267,12 @@ class Manager:
 
     # -- lifecycle --
 
-    def start(self) -> None:
-        logger.info("starting manager (namespace=%s)", self.namespace)
-        self._serve_probes()
-        self._serve_metrics()
+    def _start_controllers(self) -> None:
+        """Launch the watch threads + reconcile worker (leader-only when
+        leader election is on)."""
+        if self._controllers_started or self._stop.is_set():
+            return
+        self._controllers_started = True
         threads = [threading.Thread(target=self._worker, daemon=True, name="reconcile-worker")]
         for kind in ROOT_KINDS + OWNED_KINDS + LOADER_OWNED_KINDS:
             threads.append(
@@ -253,8 +280,32 @@ class Manager:
             )
         for t in threads:
             t.start()
-        self.ready.set()
         self._threads = threads
+
+    def _on_leadership_lost(self) -> None:
+        """controller-runtime exits the process on lost leadership — two
+        reconcilers must never run concurrently.  The library equivalent:
+        stop everything and flag it; the CLI exits non-zero."""
+        if self._stop.is_set():
+            return  # normal shutdown released the lease; not a loss
+        logger.error("leadership lost; stopping manager")
+        self.leadership_lost = True
+        self.stop()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector is None or self.elector.is_leader.is_set()
+
+    def start(self) -> None:
+        logger.info("starting manager (namespace=%s)", self.namespace)
+        self._serve_probes()
+        self._serve_metrics()
+        if self.elector is not None:
+            # probes/metrics serve immediately; controllers wait for the lease
+            self.elector.start()
+        else:
+            self._start_controllers()
+        self.ready.set()
 
     def run_forever(self) -> None:
         self.start()
@@ -269,6 +320,8 @@ class Manager:
     def stop(self) -> None:
         self._stop.set()
         self.ready.clear()
+        if self.elector is not None:
+            self.elector.stop()
         close = getattr(self.client, "close_watches", None)
         if close is not None:
             close()

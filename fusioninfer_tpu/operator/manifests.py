@@ -161,7 +161,16 @@ def manager_deployment() -> dict:
                             "image": MANAGER_IMAGE,
                             "command": [
                                 "python", "-m", "fusioninfer_tpu.cli",
-                                "controller", "run",
+                                "controller", "run", "--leader-elect",
+                            ],
+                            "env": [
+                                {
+                                    # leader-election identity = pod name
+                                    "name": "POD_NAME",
+                                    "valueFrom": {
+                                        "fieldRef": {"fieldPath": "metadata.name"}
+                                    },
+                                }
                             ],
                             "securityContext": _RESTRICTED,
                             "ports": [

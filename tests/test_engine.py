@@ -100,6 +100,50 @@ class TestEngine:
         assert finished2["stopper"] == "stop"
         assert outputs2["stopper"] == [first]
 
+    def test_presence_frequency_ignore_prompt_tokens(self):
+        # OpenAI semantics: presence/frequency apply to GENERATED tokens
+        # only.  After prefill of a prompt stuffed with one token id, the
+        # device-side output-count row must count just the single
+        # generated token — prompt occurrences live only in the combined
+        # (repetition-penalty) counts.
+        import numpy as np
+
+        engine = make_engine()
+        engine.add_request(
+            Request("r", [5] * 16, SamplingParams(
+                temperature=0.0, max_tokens=4,
+                presence_penalty=1.5, frequency_penalty=0.7,
+            ))
+        )
+        engine.step()  # prefill (first token) + one decode step
+        state = next(iter(engine.running.values()))
+        out_row = np.asarray(engine._output_counts[state.slot])
+        comb_row = np.asarray(engine._token_counts[state.slot])
+        generated = state.tokens[state.n_prompt:]
+        assert comb_row[5] >= 16  # prompt counted for repetition
+        assert out_row.sum() == len(generated)  # generated tokens only
+        assert out_row[5] == generated.count(5)  # prompt 5s excluded
+
+    def test_seeded_resume_continues_prng_stream(self):
+        # a seeded request must produce identical tokens whether or not it
+        # was preempted mid-generation (resume re-prefills the prefix and
+        # must continue the PRNG stream at generation index n, not 0)
+        prompt = list(range(1, 30))
+        params = SamplingParams(temperature=1.0, max_tokens=30, seed=1234)
+        engine = make_engine()
+        engine.add_request(Request("solo", prompt, params))
+        solo, _ = run_to_completion(engine, max_steps=400)
+
+        tight = CacheConfig(n_pages=16, page_size=8, max_pages_per_seq=8)
+        engine2 = make_engine(cache_cfg=tight, enable_prefix_caching=False)
+        engine2.add_request(Request("a", prompt, params))
+        engine2.add_request(Request("b", prompt, params))
+        outputs, finished = run_to_completion(engine2, max_steps=600)
+        assert set(finished) == {"a", "b"}
+        assert engine2.preemptions_total >= 1
+        assert outputs["a"] == solo["solo"]
+        assert outputs["b"] == solo["solo"]
+
     def test_rejects_oversized_request(self):
         engine = make_engine()
         with pytest.raises(ValueError):
@@ -283,13 +327,20 @@ class TestCacheValidation:
         # no HBM stats (CPU): request-shaped minimum
         cc = auto_cache_config(CFG, page_size=8, max_model_len=64, max_batch_size=4)
         assert cc.max_pages_per_seq == 8 and cc.n_pages == 8 * 4 + 1
-        # ample HBM budget: still request-shaped (pages beyond peak
-        # addressable demand would be dead memory), and within budget
+        # ample HBM budget + prefix caching off: request-shaped (pages
+        # beyond peak addressable demand would be dead memory)
+        flat = auto_cache_config(
+            CFG, page_size=8, max_model_len=64, max_batch_size=4,
+            hbm_bytes=1 << 30, hbm_utilization=0.5, prefix_caching=False,
+        )
+        assert flat.n_pages == cc.n_pages
+        # prefix caching on (default): grow into headroom — extra pages
+        # become evictable prefix cache — capped at 4× peak demand
         big = auto_cache_config(
             CFG, page_size=8, max_model_len=64, max_batch_size=4,
             hbm_bytes=1 << 30, hbm_utilization=0.5,
         )
-        assert big.n_pages == cc.n_pages
+        assert big.n_pages == 4 * cc.n_pages
         assert big.n_pages * page_bytes(CFG, 8) < (1 << 30)
         # over-subscribed HBM must fail fast, not fall back and OOM later
         with pytest.raises(ValueError, match="KV pages"):

@@ -1,0 +1,170 @@
+"""Leader election: exactly one of two managers reconciles; standby takes
+over on graceful release and on lease expiry (crash).  Mirrors the HA
+behavior the reference gets from controller-runtime ``--leader-elect``
+(``/root/reference/cmd/main.go:80-82,174-187``)."""
+
+import time
+
+import pytest
+
+from fusioninfer_tpu.operator.fake import FakeK8s
+from fusioninfer_tpu.operator.leaderelection import (
+    LeaderElectionConfig,
+    LeaderElector,
+)
+from fusioninfer_tpu.operator.manager import Manager
+
+FAST = LeaderElectionConfig(
+    lease_duration=0.6, renew_deadline=0.4, retry_period=0.1
+)
+
+
+def wait_for(pred, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def sample_service(name="svc"):
+    return {
+        "apiVersion": "fusioninfer.io/v1alpha1",
+        "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": "default", "generation": 1},
+        "spec": {
+            "roles": [{
+                "name": "worker", "componentType": "worker", "replicas": 1,
+                "template": {"spec": {"containers": [
+                    {"name": "engine", "image": "vllm-tpu:v1"}
+                ]}},
+            }]
+        },
+    }
+
+
+class TestLeaderElector:
+    def test_single_elector_acquires_and_renews(self):
+        client = FakeK8s()
+        el = LeaderElector(client, "default", identity="a", config=FAST)
+        el.start()
+        try:
+            assert wait_for(el.is_leader.is_set)
+            lease = client.get("Lease", "default", el.name)
+            assert lease["spec"]["holderIdentity"] == "a"
+            first_renew = lease["spec"]["renewTime"]
+            assert wait_for(
+                lambda: client.get("Lease", "default", el.name)["spec"]["renewTime"]
+                != first_renew
+            ), "holder must keep renewing"
+        finally:
+            el.stop()
+        # graceful stop releases the lease for instant takeover
+        assert client.get("Lease", "default", el.name)["spec"]["holderIdentity"] == ""
+
+    def test_standby_waits_then_takes_over_on_expiry(self):
+        client = FakeK8s()
+        # a dead holder: lease present, renewTime far in the past
+        from fusioninfer_tpu.operator.leaderelection import _rfc3339
+
+        client.create({
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": "4e1a9c03.fusioninfer.io", "namespace": "default"},
+            "spec": {
+                "holderIdentity": "dead-manager",
+                "leaseDurationSeconds": 1,
+                "renewTime": _rfc3339(time.time() - 60),
+                "leaseTransitions": 3,
+            },
+        })
+        el = LeaderElector(client, "default", identity="b", config=FAST)
+        el.start()
+        try:
+            assert wait_for(el.is_leader.is_set)
+            spec = client.get("Lease", "default", el.name)["spec"]
+            assert spec["holderIdentity"] == "b"
+            assert spec["leaseTransitions"] == 4
+        finally:
+            el.stop()
+
+    def test_live_holder_blocks_takeover(self):
+        client = FakeK8s()
+        a = LeaderElector(client, "default", identity="a", config=FAST)
+        b = LeaderElector(client, "default", identity="b", config=FAST)
+        a.start()
+        assert wait_for(a.is_leader.is_set)
+        b.start()
+        try:
+            time.sleep(FAST.lease_duration * 2)
+            assert a.is_leader.is_set()
+            assert not b.is_leader.is_set(), "standby must not steal a live lease"
+        finally:
+            a.stop()
+            b.stop()
+
+
+class TestManagerLeaderElection:
+    def test_exactly_one_manager_reconciles_and_failover(self):
+        client = FakeK8s()
+        m1 = Manager(client, probe_port=0, metrics_port=0, leader_elect=True,
+                     leader_identity="m1", leader_election_config=FAST)
+        m2 = Manager(client, probe_port=0, metrics_port=0, leader_elect=True,
+                     leader_identity="m2", leader_election_config=FAST)
+        m1.start()
+        assert wait_for(lambda: m1.is_leader)
+        m2.start()
+        try:
+            # standby: controllers not started, no reconciles
+            svc = sample_service("one")
+            client.create(svc)
+            assert wait_for(
+                lambda: client.get_or_none("LeaderWorkerSet", "default", "one-worker-0")
+                is not None
+            ), "leader must reconcile"
+            assert m1._controllers_started and not m2._controllers_started
+            leaders = [m for m in (m1, m2) if m.is_leader]
+            assert leaders == [m1]
+
+            # graceful failover: m1 stops, m2 takes over and reconciles new work
+            m1.stop()
+            assert wait_for(lambda: m2.is_leader, timeout=10.0)
+            assert m2._controllers_started
+            client.create(sample_service("two"))
+            assert wait_for(
+                lambda: client.get_or_none("LeaderWorkerSet", "default", "two-worker-0")
+                is not None,
+                timeout=10.0,
+            ), "new leader must reconcile"
+            assert not m2.leadership_lost
+        finally:
+            m1.stop()
+            m2.stop()
+
+    def test_leadership_loss_stops_manager(self):
+        client = FakeK8s()
+        m = Manager(client, probe_port=0, metrics_port=0, leader_elect=True,
+                    leader_identity="m", leader_election_config=FAST)
+        m.start()
+        assert wait_for(lambda: m.is_leader)
+        # usurp the lease behind the manager's back (e.g. apiserver clock
+        # skew / partition healed with another holder)
+        lease = client.get("Lease", "default", m.elector.name)
+        lease["spec"]["holderIdentity"] = "usurper"
+        from fusioninfer_tpu.operator.leaderelection import _rfc3339
+
+        lease["spec"]["renewTime"] = _rfc3339(time.time() + 60)
+        client.update(lease)
+        assert wait_for(lambda: m.leadership_lost, timeout=10.0)
+        assert m._stop.is_set(), "lost leadership must stop the manager"
+
+
+@pytest.mark.parametrize("bad", [
+    dict(lease_duration=1.0, renew_deadline=1.0, retry_period=0.1),
+    dict(lease_duration=1.0, renew_deadline=0.5, retry_period=0.5),
+    dict(lease_duration=0.0, renew_deadline=-1.0, retry_period=-2.0),
+])
+def test_config_validation(bad):
+    with pytest.raises(ValueError):
+        LeaderElectionConfig(**bad).validate()

@@ -179,7 +179,7 @@ def test_sampler_penalties_and_seed_streams():
     counts = jnp.asarray([[0, 3, 0, 0], [0, 0, 0, 0]], jnp.int32)
     # heavy frequency penalty on token 1 flips row 0's argmax to token 2
     out = apply_penalties(
-        logits, counts,
+        logits, counts, counts,
         presence=jnp.asarray([1.0, 0.0]),
         frequency=jnp.asarray([2.0, 0.0]),
         repetition=jnp.asarray([1.5, 1.0]),
@@ -187,6 +187,17 @@ def test_sampler_penalties_and_seed_streams():
     toks = sample(out, jax.random.split(jax.random.key(0), 2),
                   jnp.zeros(2), jnp.zeros(2, jnp.int32), jnp.ones(2))
     assert list(np.asarray(toks)) == [2, 1]  # penalized row moved, clean row didn't
+
+    # OpenAI semantics: tokens seen only in the PROMPT (combined counts,
+    # zero output counts) take the repetition penalty but NOT
+    # presence/frequency — the argmax must survive prompt occurrences
+    out = apply_penalties(
+        logits[:1], counts[:1], jnp.zeros_like(counts[:1]),
+        presence=jnp.asarray([1.0]),
+        frequency=jnp.asarray([2.0]),
+        repetition=jnp.asarray([1.0]),
+    )
+    assert int(jnp.argmax(out[0])) == 1
 
     # same (seed, position) => same key => same draw; different position differs
     k1 = make_row_keys(jnp.asarray([7, 7], jnp.uint32), jnp.asarray([0, 0], jnp.int32))
