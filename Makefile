@@ -26,9 +26,14 @@ test: ## Unit + integration tests (virtual 8-device CPU mesh).
 test-fast: ## Tests, stop at first failure.
 	$(PYTHON) -m pytest tests/ -x -q
 
+.PHONY: test-tpu
+test-tpu: ## Hardware kernel tests on a real TPU (interpret=False, bench shapes).
+	FUSIONINFER_TEST_TPU=1 $(PYTHON) -m pytest tests/test_kernels_tpu.py -x -q
+
 .PHONY: lint
-lint: ## Byte-compile all sources (no external linters in the image).
-	$(PYTHON) -m compileall -q fusioninfer_tpu tests bench.py __graft_entry__.py
+lint: ## Gating lint: in-repo AST linter + byte-compile (CI adds ruff).
+	$(PYTHON) tools/lint.py
+	$(PYTHON) -m compileall -q fusioninfer_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: bench
 bench: ## One-line JSON decode-throughput benchmark (real chip if present).

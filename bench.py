@@ -2,15 +2,25 @@
 
 Prints ONE JSON line:
 ``{"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N,
-"backend": ..., "http": {...}}``
+"backend": ..., "mfu": ..., "decode": {...}, "http": {...}}``
+and (round-3 hardening) also writes the same record to ``BENCH_OUT.json``
+next to this file, so the number survives log-stream truncation.
 
 Two phases, both on the BASELINE.md north star:
 
 1. **Decode core** — batched ``decode_step`` over a paged KV cache, the
-   continuous-batching hot loop (output tokens/sec/chip).
+   continuous-batching hot loop (output tokens/sec/chip).  On TPU this is
+   measured on BOTH attention paths — the Pallas paged kernel and the
+   portable gather path — reporting each plus the speedup; if the kernel
+   path raises, the gather number still lands (round-2 failure mode:
+   Mosaic rejected the kernel and the bench reported 0 instead of a
+   portable-path datum).  ``mfu`` = measured FLOP/s over the chip
+   generation's peak (``fusioninfer_tpu.benchmark.mfu``).
 2. **HTTP load** — ShareGPT-style mixed-length streaming requests against
    the full OpenAI-compatible server (p50 TTFT + tok/s/chip through the
-   real serving stack), via :mod:`fusioninfer_tpu.benchmark.loadgen`.
+   real serving stack), via :mod:`fusioninfer_tpu.benchmark.loadgen`,
+   with per-request unique prompts and the observed prefix-cache hit rate
+   in the record.
 
 Hardened against flaky TPU init (round-1 failure mode: the tunneled
 backend hung or raised UNAVAILABLE and the bench emitted a traceback
@@ -28,8 +38,10 @@ Env knobs: ``BENCH_PLATFORM=cpu`` (skip probe, run CPU smoke),
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
+import pathlib
 import subprocess
 import sys
 import time
@@ -83,17 +95,16 @@ def pick_backend() -> tuple[str, str]:
     return "cpu", f"TPU unavailable, CPU fallback ({detail})"
 
 
-def run_decode(jax, model: str, batch: int, cache_cfg, prefix_len: int,
+def run_decode(jax, cfg, batch: int, cache_cfg, prefix_len: int,
                warmup: int, steps: int) -> float:
     import jax.numpy as jnp
     import numpy as np
 
     from fusioninfer_tpu.engine.kv_cache import PageAllocator, init_kv_cache
     from fusioninfer_tpu.engine.model_runner import decode_step
-    from fusioninfer_tpu.models.config import get_preset
+
     from fusioninfer_tpu.models.transformer import init_params
 
-    cfg = get_preset(model)
     cache_cfg.validate()
     params = jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
     cache = init_kv_cache(cfg, cache_cfg)
@@ -128,14 +139,15 @@ def run_decode(jax, model: str, batch: int, cache_cfg, prefix_len: int,
     return batch * steps / elapsed
 
 
-def run_http(model: str, max_batch_size: int, cache_cfg, n_requests: int,
+def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
              concurrency: int, max_prompt: int, max_output: int) -> dict:
     from fusioninfer_tpu.benchmark.loadgen import run_http_load
+    from fusioninfer_tpu.engine.engine import NativeEngine
     from fusioninfer_tpu.engine.server import EngineServer
 
+    engine = NativeEngine(cfg, cache_cfg=cache_cfg, max_batch_size=max_batch_size)
     srv = EngineServer(
-        model=model, host="127.0.0.1", port=0,
-        max_batch_size=max_batch_size, cache_cfg=cache_cfg,
+        model=cfg.name, host="127.0.0.1", port=0, engine=engine,
     )
     srv.start()
     try:
@@ -167,7 +179,9 @@ def main() -> None:
 
         if platform:
             jax.config.update("jax_platforms", platform)
+        from fusioninfer_tpu.benchmark.mfu import decode_mfu
         from fusioninfer_tpu.engine.kv_cache import CacheConfig
+        from fusioninfer_tpu.models.config import get_preset
 
         backend = jax.default_backend()
         record["backend"] = backend
@@ -175,26 +189,64 @@ def main() -> None:
         if on_tpu:
             # Qwen3-1.7B shapes, 32-way continuous batch, 1 KiB-token
             # contexts: ~3.4 GiB weights + KV pages on a 16 GiB v5e chip.
-            model, batch = "qwen3-1.7b", 32
+            base_cfg, batch = get_preset("qwen3-1.7b"), 32
             cache_cfg = CacheConfig(n_pages=32 * 8 + 1, page_size=128,
                                     max_pages_per_seq=8)
-            tok_s = run_decode(jax, model, batch, cache_cfg,
-                               prefix_len=128, warmup=5, steps=64)
+            prefix_len, warmup, steps = 128, 5, 64
             record["metric"] = "decode_throughput_qwen3_1.7b"
         else:
-            model, batch = "qwen3-tiny", 8
+            base_cfg, batch = get_preset("qwen3-tiny"), 8
             cache_cfg = CacheConfig(n_pages=33, page_size=64, max_pages_per_seq=4)
-            tok_s = run_decode(jax, model, batch, cache_cfg,
-                               prefix_len=32, warmup=2, steps=16)
+            prefix_len, warmup, steps = 32, 2, 16
             record["metric"] = "decode_throughput_tiny_cpu"
+
+        decode: dict = {}
+        tok_s = 0.0
+        impl_used = None
+        if on_tpu:
+            # kernel path first; a kernel failure must still leave a number
+            try:
+                t = run_decode(jax, dataclasses.replace(base_cfg, attn_impl="flash"),
+                               batch, cache_cfg, prefix_len, warmup, steps)
+                decode["kernel_tok_s"] = round(t, 2)
+                tok_s, impl_used = t, "flash"
+            except Exception as e:
+                decode["kernel_error"] = f"{type(e).__name__}: {str(e)[:400]}"
+            try:
+                t = run_decode(jax, dataclasses.replace(base_cfg, attn_impl="reference"),
+                               batch, cache_cfg, prefix_len, warmup, steps)
+                decode["gather_tok_s"] = round(t, 2)
+                if impl_used is None:
+                    tok_s, impl_used = t, "reference"
+            except Exception as e:
+                decode["gather_error"] = f"{type(e).__name__}: {str(e)[:400]}"
+            if "kernel_tok_s" in decode and "gather_tok_s" in decode and decode["gather_tok_s"]:
+                decode["kernel_speedup"] = round(
+                    decode["kernel_tok_s"] / decode["gather_tok_s"], 3
+                )
+        else:
+            from fusioninfer_tpu.ops import dispatch
+
+            tok_s = run_decode(jax, base_cfg, batch, cache_cfg,
+                               prefix_len, warmup, steps)
+            impl_used = dispatch.resolve_attn(base_cfg.attn_impl)
+        decode["attn_impl_used"] = impl_used
+        record["decode"] = decode
         record["value"] = round(tok_s, 2)
 
-        if os.environ.get("BENCH_SKIP_HTTP", "") != "1":
+        avg_ctx = prefix_len + warmup + steps // 2
+        mfu = decode_mfu(base_cfg, tok_s, avg_ctx, jax.devices()[0].device_kind)
+        if mfu is not None:
+            record["mfu"] = round(mfu, 4)
+
+        if os.environ.get("BENCH_SKIP_HTTP", "") != "1" and impl_used is not None:
+            # serve with whichever attention impl the decode phase proved out
+            http_cfg = dataclasses.replace(base_cfg, attn_impl=impl_used)
             if on_tpu:
                 http_cache = CacheConfig(n_pages=16 * 10 + 1, page_size=128,
                                          max_pages_per_seq=10)
                 record["http"] = run_http(
-                    model, max_batch_size=16, cache_cfg=http_cache,
+                    http_cfg, max_batch_size=16, cache_cfg=http_cache,
                     n_requests=48, concurrency=12,
                     max_prompt=1024, max_output=128,
                 )
@@ -202,13 +254,21 @@ def main() -> None:
                 http_cache = CacheConfig(n_pages=8 * 4 + 1, page_size=64,
                                          max_pages_per_seq=4)
                 record["http"] = run_http(
-                    model, max_batch_size=8, cache_cfg=http_cache,
+                    http_cfg, max_batch_size=8, cache_cfg=http_cache,
                     n_requests=12, concurrency=4,
                     max_prompt=128, max_output=32,
                 )
     except Exception as e:  # never a traceback instead of the JSON line
         record["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(record))
+    line = json.dumps(record)
+    # sidecar copy: the driver captures a bounded log tail, which truncated
+    # the round-2 record — the file is the canonical evidence
+    try:
+        sidecar = pathlib.Path(__file__).resolve().parent / "BENCH_OUT.json"
+        sidecar.write_text(line + "\n")
+    except OSError as e:
+        print(f"sidecar write failed: {e}", file=sys.stderr, flush=True)
+    print(line)
 
 
 if __name__ == "__main__":

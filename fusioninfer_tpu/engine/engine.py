@@ -22,7 +22,7 @@ import queue as queue_mod
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +32,6 @@ from fusioninfer_tpu.engine.kv_cache import (
     CacheConfig,
     PageAllocator,
     init_kv_cache,
-    kv_cache_bytes,
 )
 from fusioninfer_tpu.engine.model_runner import (
     decode_step,
@@ -528,6 +527,7 @@ class NativeEngine:
                 self.cfg, self.cache_cfg, self.params, self.cache,
                 jnp.asarray(padded), jnp.int32(reused_tokens),
                 jnp.int32(len(suffix)), row,
+                mesh=self._kernel_mesh,
             )
         else:
             bucket = pick_bucket(self.buckets, len(prefix))

@@ -1,14 +1,20 @@
 """Paged KV cache.
 
-Device-side: two stacked arrays ``[n_layers, n_pages, page_size, n_kv_heads,
-head_dim]`` (k and v).  Pages are the allocation unit; a sequence owns a
-list of pages recorded in a host-side page table.  The last page index is
-reserved as a scratch ("trash") page so padded token positions can write
-somewhere harmless while shapes stay static.
+Device-side: two stacked arrays ``[n_layers, n_kv_heads, n_pages,
+page_size, head_dim]`` (k and v).  Pages are the allocation unit; a
+sequence owns a list of pages recorded in a host-side page table.  The
+last page index is reserved as a scratch ("trash") page so padded token
+positions can write somewhere harmless while shapes stay static.
+
+The layout is **head-major** (kv-head axis ahead of the page axis): the
+paged-attention kernel DMAs one ``[page_size, head_dim]`` tile per
+(sequence, kv-head) program, and with head-major storage that slice only
+indexes leading dims — Mosaic requires the tiled trailing two dims stay
+whole (see :mod:`fusioninfer_tpu.ops.paged_attention`).  The kv-head
+axis is also the ``tp`` shard axis.
 
 Host-side: a free-list allocator (:class:`PageAllocator`) — allocation is
-a Python-time concern, never traced.  The TPU-facing layout keeps the
-``n_kv_heads`` axis shardable over the mesh ``tp`` axis.
+a Python-time concern, never traced.
 """
 
 from __future__ import annotations
@@ -53,9 +59,9 @@ class CacheConfig:
 def init_kv_cache(cfg: ModelConfig, cache_cfg: CacheConfig) -> dict:
     shape = (
         cfg.n_layers,
+        cfg.n_kv_heads,
         cache_cfg.n_pages,
         cache_cfg.page_size,
-        cfg.n_kv_heads,
         cfg.head_dim,
     )
     return {

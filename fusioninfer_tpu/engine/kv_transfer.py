@@ -10,9 +10,11 @@ moves the slab prefiller→decoder (over DCN between slices; in-process for
 tests), and the decode engine injects it into its own paged cache and
 continues generation exactly where prefill left off.
 
-Slab layout ``[L, n_pages, page_size, KV, Hd]`` (k and v) — page-granular
+Slab layout ``[L, KV, n_pages, page_size, Hd]`` (k and v) — page-granular
 so extract/inject are single gather/scatter ops on device, and the wire
-format stays independent of either side's page-pool size.
+format stays independent of either side's page-pool size.  Matches the
+engine's head-major cache layout (:mod:`fusioninfer_tpu.engine.kv_cache`)
+so no transpose sits on the transfer path.
 """
 
 from __future__ import annotations
@@ -33,7 +35,7 @@ import numpy as np
 class KVSlab:
     """One sequence's KV context plus what decode needs to resume."""
 
-    k: jnp.ndarray  # [L, n_pages, ps, KV, Hd]
+    k: jnp.ndarray  # [L, KV, n_pages, ps, Hd]
     v: jnp.ndarray
     prompt_tokens: list[int]
     first_token: int
@@ -50,8 +52,8 @@ def extract_slab(cache: dict, pages: list[int], prompt_tokens: list[int],
     then the caller decides when/where the slab crosses host/DCN)."""
     idx = jnp.asarray(pages, jnp.int32)
     return KVSlab(
-        k=cache["k"][:, idx],
-        v=cache["v"][:, idx],
+        k=cache["k"][:, :, idx],
+        v=cache["v"][:, :, idx],
         prompt_tokens=list(prompt_tokens),
         first_token=first_token,
         page_size=page_size,
@@ -62,13 +64,13 @@ def inject_slab(cache: dict, slab: KVSlab, pages: list[int]) -> dict:
     """Scatter a slab into this engine's cache at ``pages`` (the decode
     side's own allocation; may be longer than the slab — extra pages are
     growth room for generation)."""
-    n = slab.k.shape[1]
+    n = slab.k.shape[2]
     if len(pages) < n:
         raise ValueError(f"need {n} pages to inject, got {len(pages)}")
     idx = jnp.asarray(pages[:n], jnp.int32)
     return {
-        "k": cache["k"].at[:, idx].set(slab.k.astype(cache["k"].dtype)),
-        "v": cache["v"].at[:, idx].set(slab.v.astype(cache["v"].dtype)),
+        "k": cache["k"].at[:, :, idx].set(slab.k.astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, :, idx].set(slab.v.astype(cache["v"].dtype)),
     }
 
 

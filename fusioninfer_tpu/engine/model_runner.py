@@ -62,8 +62,13 @@ def prefill(
     def body(x, inputs):
         layer, k_cache_l, v_cache_l = inputs
         out, (k, v) = layer_forward(cfg, layer, x, positions, mesh=mesh)
-        k_cache_l = k_cache_l.at[page_of_token, slot_of_token].set(k[0])
-        v_cache_l = v_cache_l.at[page_of_token, slot_of_token].set(v[0])
+        # head-major per-layer cache [KV, n_pages, ps, Hd]; k[0] is [S, KV, Hd]
+        k_cache_l = k_cache_l.at[:, page_of_token, slot_of_token].set(
+            jnp.swapaxes(k[0], 0, 1)
+        )
+        v_cache_l = v_cache_l.at[:, page_of_token, slot_of_token].set(
+            jnp.swapaxes(v[0], 0, 1)
+        )
         return out, (k_cache_l, v_cache_l)
 
     x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
@@ -72,7 +77,7 @@ def prefill(
     return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
 
 
-@partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
 def prefill_suffix(
     cfg: ModelConfig,
     cache_cfg: CacheConfig,
@@ -82,23 +87,31 @@ def prefill_suffix(
     start: jax.Array,  # scalar int32: global position of tokens[0]
     true_len: jax.Array,  # scalar int32: real suffix length
     page_row: jax.Array,  # [max_pages_per_seq] — prefix pages already filled
+    mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
 ):
     """Prefill a prompt SUFFIX against cached prefix pages (the automatic
     prefix-caching path): token i sits at global position ``start + i``,
-    writes its K/V into the sequence's pages, and attends over the
-    gathered page context (shared prefix pages are read, never written).
-    Returns (cache, logits at the last real suffix token [1, V]).
+    writes its K/V into the sequence's pages, and attends over the page
+    context (shared prefix pages are read, never written).  Returns
+    (cache, logits at the last real suffix token [1, V]).
 
-    Attention here is the gathered-context jnp path: under a sharded
-    engine XLA's SPMD partitioner handles the tensor-parallel split from
-    the input shardings (no explicit mesh needed); a paged flash kernel
-    for this path is future work.
+    Attention dispatch mirrors ``decode_step``: on the kernel path the
+    Pallas suffix kernel streams pages in place
+    (:func:`fusioninfer_tpu.ops.paged_attention.paged_prefill_attention`),
+    per tensor-parallel shard when a tp-only ``mesh`` is given; the
+    portable branch gathers the page context and relies on XLA SPMD.
+    This is the data path behind the router's flagship prefix-cache
+    strategy (reference ``pkg/router/strategy.go:51-77`` routes for cache
+    hits; the hit's compute happens here).
     """
+    from fusioninfer_tpu.ops import dispatch, paged_prefill_attention
+
     B, C = tokens.shape
     ps = cache_cfg.page_size
     mp = page_row.shape[0]
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dtype_ctx = cache["k"].dtype
+    use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
 
     x = params["embed"][tokens]  # [1, C, D]
     offs = jnp.arange(C)
@@ -109,7 +122,7 @@ def prefill_suffix(
     )
     write_slot = (start + offs) % ps
 
-    # context mask over the gathered [mp * ps] positions
+    # context mask over the gathered [mp * ps] positions (portable branch)
     ctx_idx = jnp.arange(mp * ps)[None, :]  # [1, T]
     attend = ctx_idx <= positions[0][:, None]  # [C, T]
 
@@ -117,19 +130,41 @@ def prefill_suffix(
         layer, k_cache_l, v_cache_l = inputs
         q, k, v = qkv_proj(cfg, layer, x, positions)
 
-        k_cache_l = k_cache_l.at[write_page, write_slot].set(k[0])
-        v_cache_l = v_cache_l.at[write_page, write_slot].set(v[0])
+        # head-major per-layer cache [KV, n_pages, ps, Hd]; k[0] is [C, KV, Hd]
+        k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
+            jnp.swapaxes(k[0], 0, 1)
+        )
+        v_cache_l = v_cache_l.at[:, write_page, write_slot].set(
+            jnp.swapaxes(v[0], 0, 1)
+        )
 
-        k_ctx = k_cache_l[page_row].reshape(1, mp * ps, KV, Hd)
-        v_ctx = v_cache_l[page_row].reshape(1, mp * ps, KV, Hd)
+        if use_kernel:
+            if mesh is not None:
+                from fusioninfer_tpu.ops.sharded import paged_prefill_attention_tp
 
-        group = H // KV
-        qg = q.reshape(B, C, KV, group, Hd)
-        scores = jnp.einsum("bskgd,btkd->bkgst", qg, k_ctx).astype(jnp.float32)
-        scores = scores / jnp.sqrt(Hd)
-        scores = jnp.where(attend[None, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dtype_ctx)
-        attn = jnp.einsum("bkgst,btkd->bskgd", probs, v_ctx).reshape(B, C, H * Hd)
+                attn = paged_prefill_attention_tp(
+                    mesh, q[0], k_cache_l, v_cache_l, page_row, start, true_len,
+                    interpret=dispatch.kernel_interpret(),
+                )[None]  # [1, C, H*Hd]
+            else:
+                attn = paged_prefill_attention(
+                    q[0], k_cache_l, v_cache_l, page_row, start, true_len,
+                    interpret=dispatch.kernel_interpret(),
+                )[None]
+        else:
+            k_ctx = k_cache_l[:, page_row].reshape(KV, mp * ps, Hd)
+            v_ctx = v_cache_l[:, page_row].reshape(KV, mp * ps, Hd)
+
+            group = H // KV
+            qg = q.reshape(B, C, KV, group, Hd)
+            scores = jnp.einsum("bskgd,ktd->bkgst", qg, k_ctx).astype(jnp.float32)
+            scores = scores / jnp.sqrt(Hd)
+            scores = jnp.where(attend[None, None, None, :, :], scores, -1e30)
+            attn = jnp.einsum(
+                "bkgst,ktd->bskgd",
+                jax.nn.softmax(scores, axis=-1).astype(dtype_ctx),
+                v_ctx,
+            ).reshape(B, C, H * Hd)
         x = x + attn @ layer["wo"]
         return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
 
@@ -181,8 +216,13 @@ def decode_step(
         q, k, v = qkv_proj(cfg, layer, x, pos)
 
         # write this step's K/V into each sequence's page slot
-        k_cache_l = k_cache_l.at[write_page, write_slot].set(k[:, 0])
-        v_cache_l = v_cache_l.at[write_page, write_slot].set(v[:, 0])
+        # (head-major cache [KV, n_pages, ps, Hd]; k[:, 0] is [B, KV, Hd])
+        k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
+            jnp.swapaxes(k[:, 0], 0, 1)
+        )
+        v_cache_l = v_cache_l.at[:, write_page, write_slot].set(
+            jnp.swapaxes(v[:, 0], 0, 1)
+        )
 
         if use_kernel:
             # Pallas kernel streams only the live pages HBM→VMEM
@@ -199,16 +239,16 @@ def decode_step(
                     interpret=dispatch.kernel_interpret(),
                 )[:, None, :]  # [B, 1, H*Hd]
         else:
-            # portable path: gather pages [B, mp, ps, KV, Hd] -> [B, T, KV, Hd]
-            k_ctx = k_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)
-            v_ctx = v_cache_l[page_tables].reshape(B_, mp * ps, KV, Hd)
+            # portable path: gather pages [KV, B, mp, ps, Hd] -> [KV, B, T, Hd]
+            k_ctx = k_cache_l[:, page_tables].reshape(KV, B_, mp * ps, Hd)
+            v_ctx = v_cache_l[:, page_tables].reshape(KV, B_, mp * ps, Hd)
 
             group = H // KV
             qg = q.reshape(B_, 1, KV, group, Hd)
-            scores = jnp.einsum("bskgd,btkd->bkgst", qg, k_ctx).astype(jnp.float32) / jnp.sqrt(Hd)
+            scores = jnp.einsum("bskgd,kbtd->bkgst", qg, k_ctx).astype(jnp.float32) / jnp.sqrt(Hd)
             scores = jnp.where(attend[:, :, None, :, :] * jnp.ones_like(scores, bool), scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
-            attn = jnp.einsum("bkgst,btkd->bskgd", probs, v_ctx).reshape(B_, 1, H * Hd)
+            attn = jnp.einsum("bkgst,kbtd->bskgd", probs, v_ctx).reshape(B_, 1, H * Hd)
         x = x + attn @ layer["wo"]
         return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
 

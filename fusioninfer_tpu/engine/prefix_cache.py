@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import collections
 import hashlib
-from typing import Optional
 
 import numpy as np
 

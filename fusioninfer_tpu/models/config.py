@@ -10,7 +10,6 @@ and d_ff multiples of 128, bfloat16 weights.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax.numpy as jnp
 

@@ -14,7 +14,7 @@ import logging
 
 from fusioninfer_tpu import API_VERSION
 from fusioninfer_tpu.api.modelloader import ModelLoader
-from fusioninfer_tpu.operator.client import K8sClient, NotFound, set_owner_reference
+from fusioninfer_tpu.operator.client import K8sClient, set_owner_reference
 from fusioninfer_tpu.operator.reconciler import ReconcileResult
 from fusioninfer_tpu.utils.hash import spec_hash_of, stamp_spec_hash
 

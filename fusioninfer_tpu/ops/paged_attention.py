@@ -12,9 +12,14 @@ Equivalent capability in the reference is vLLM's CUDA PagedAttention,
 which FusionInfer only orchestrates (SURVEY §0); here it is an in-repo
 TPU kernel.
 
-Layout: pages ``[n_pages, page_size, KV, Hd]``; grid ``(B, KV)``; the
-``G = H // KV`` query heads of a group attend together so each KV page
-is read once per group.
+Layout: pages are **head-major** ``[KV, n_pages, page_size, Hd]``; grid
+``(B, KV)``; the ``G = H // KV`` query heads of a group attend together
+so each KV page is read once per group.  Head-major matters for Mosaic:
+the per-(sequence, kv-head) DMA ``k_pages.at[g, page]`` slices only
+*leading* dims, so every copy is a whole ``[page_size, Hd]`` tile of the
+(8,128)-tiled memref.  The previous ``[n_pages, ps, KV, Hd]`` layout
+sliced the tiled second-to-minor dim to width 1 per head, which Mosaic
+rejects ("Slice shape along dimension 2 must be aligned to tiling (8)").
 """
 
 from __future__ import annotations
@@ -35,8 +40,8 @@ def _paged_kernel(
     lengths_ref,  # [B] int32 — context length incl. the current token
     # inputs
     q_ref,  # [1, 1, G, Hd] VMEM block
-    k_pages_ref,  # [n_pages, ps, KV, Hd] in HBM/ANY
-    v_pages_ref,  # [n_pages, ps, KV, Hd] in HBM/ANY
+    k_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
+    v_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
     # output
     o_ref,  # [1, 1, G, Hd] VMEM block
     # scratch
@@ -55,12 +60,14 @@ def _paged_kernel(
 
     def dma(slot, p):
         page = page_tables_ref[b, p]
+        # Head-major pages: slicing (g, page) squeezes two leading dims
+        # and copies one whole [ps, Hd] tile — Mosaic-clean.
         return (
             pltpu.make_async_copy(
-                k_pages_ref.at[page, :, g, :], k_buf.at[slot], sem.at[slot, 0]
+                k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
             ),
             pltpu.make_async_copy(
-                v_pages_ref.at[page, :, g, :], v_buf.at[slot], sem.at[slot, 1]
+                v_pages_ref.at[g, page], v_buf.at[slot], sem.at[slot, 1]
             ),
         )
 
@@ -118,8 +125,8 @@ def _paged_kernel(
 )
 def paged_decode_attention(
     q: jax.Array,  # [B, H, Hd] — one query token per sequence
-    k_pages: jax.Array,  # [n_pages, page_size, KV, Hd]
-    v_pages: jax.Array,  # [n_pages, page_size, KV, Hd]
+    k_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
+    v_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
     page_tables: jax.Array,  # [B, max_pages] int32
     lengths: jax.Array,  # [B] int32, context length incl. current token
     *,
@@ -131,7 +138,7 @@ def paged_decode_attention(
     Inactive batch slots should pass ``lengths = 0`` (output is zeros).
     """
     B, H, Hd = q.shape
-    _, page_size, KV, _ = k_pages.shape
+    KV, _, page_size, _ = k_pages.shape
     G = H // KV
     max_pages = page_tables.shape[1]
     sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
@@ -173,20 +180,212 @@ def paged_decode_attention(
     return out.reshape(B, H * Hd)
 
 
+def _suffix_kernel(
+    # scalar prefetch
+    page_row_ref,  # [mp] int32 (SMEM) — ONE sequence's page table
+    meta_ref,  # [2] int32: (start, true_len)
+    # inputs
+    q_ref,  # [block_q, 1, G, Hd] VMEM block
+    k_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
+    v_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
+    # output
+    o_ref,  # [block_q, 1, G, Hd] VMEM block
+    # scratch
+    k_buf,  # [2, ps, Hd]
+    v_buf,
+    sem,  # [2, 2]
+    *,
+    block_q: int,
+    page_size: int,
+    sm_scale: float,
+):
+    g = pl.program_id(0)
+    i = pl.program_id(1)  # q tile
+    start = meta_ref[0]
+    true_len = meta_ref[1]
+
+    # real queries in this tile and the pages their causal window covers
+    n_q_real = jnp.clip(true_len - i * block_q, 0, block_q)
+    max_pos = start + i * block_q + n_q_real - 1  # last real query's position
+    n_used = jnp.where(n_q_real > 0, pl.cdiv(max_pos + 1, page_size), 0)
+
+    def dma(slot, p):
+        page = page_row_ref[p]
+        return (
+            pltpu.make_async_copy(
+                k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                v_pages_ref.at[g, page], v_buf.at[slot], sem.at[slot, 1]
+            ),
+        )
+
+    @pl.when(n_used > 0)
+    def _start_first():
+        for c in dma(0, 0):
+            c.start()
+
+    G, Hd = q_ref.shape[2], q_ref.shape[3]
+    R = block_q * G  # flattened (query, group-head) rows
+    q = q_ref[:, 0].astype(jnp.float32).reshape(R, Hd) * sm_scale
+    # global position of each flattened row's query token
+    row_pos = start + i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (R, page_size), 0
+    ) // G
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = p % 2
+
+        @pl.when(p + 1 < n_used)
+        def _prefetch_next():
+            for c in dma((p + 1) % 2, p + 1):
+                c.start()
+
+        for c in dma(slot, p):
+            c.wait()
+        k = k_buf[slot]  # [ps, Hd]
+        v = v_buf[slot]
+
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, ps]
+        ctx_pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (R, page_size), 1
+        )
+        s = jnp.where(ctx_pos <= row_pos, s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        pexp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(pexp, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((R, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((R, 1), jnp.float32)
+    a0 = jnp.zeros((R, Hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, a0))
+    out = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    o_ref[:, 0] = out.reshape(block_q, G, Hd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "block_q", "interpret")
+)
+def paged_prefill_attention(
+    q: jax.Array,  # [C, H, Hd] — suffix queries, padded to bucket C
+    k_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
+    v_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
+    page_row: jax.Array,  # [max_pages] int32 — ONE sequence's pages
+    start: jax.Array,  # scalar int32: global position of q[0]
+    true_len: jax.Array,  # scalar int32: real (unpadded) suffix length
+    *,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Suffix-prefill attention over paged KV → [C, H·Hd].
+
+    The prefix-cache *hit* path: query token ``i`` sits at global
+    position ``start + i`` and attends causally over the sequence's
+    pages (prefix pages written by earlier requests + this suffix's own
+    pages, already scattered by the caller).  Same double-buffered
+    page-streaming structure as the decode kernel, extended to a query
+    tile per program; the causal wavefront bounds each tile's page loop
+    (``n_used = cdiv(tile's last real position + 1, ps)``), so early
+    tiles never touch late pages.  Rows at/past ``true_len`` are padding;
+    their output is unspecified and must be discarded by the caller.
+    """
+    C, H, Hd = q.shape
+    KV, _, page_size, _ = k_pages.shape
+    G = H // KV
+    sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
+    block_q = min(block_q, C)
+    if C % block_q:
+        raise ValueError(f"suffix bucket {C} not divisible by block_q {block_q}")
+    n_qt = C // block_q
+
+    qg = q.reshape(C, KV, G, Hd)
+    meta = jnp.stack([jnp.int32(start), jnp.int32(true_len)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(KV, n_qt),
+        in_specs=[
+            pl.BlockSpec(
+                (block_q, 1, G, Hd), lambda g, i, *_: (i, g, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_q, 1, G, Hd), lambda g, i, *_: (i, g, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, Hd), k_pages.dtype),
+            pltpu.VMEM((2, page_size, Hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _suffix_kernel,
+        block_q=block_q, page_size=page_size, sm_scale=sm_scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((C, KV, G, Hd), q.dtype),
+        interpret=interpret,
+    )(page_row.astype(jnp.int32), meta, qg, k_pages, v_pages)
+    return out.reshape(C, H * Hd)
+
+
+def reference_paged_prefill_attention(q, k_pages, v_pages, page_row, start,
+                                      true_len):
+    """Gathered-context jnp oracle for the suffix path (same math as
+    ``prefill_suffix``'s portable branch).  Padding rows are zeroed for
+    deterministic comparison."""
+    C, H, Hd = q.shape
+    KV, _, ps, _ = k_pages.shape
+    G = H // KV
+    mp = page_row.shape[0]
+    k_ctx = k_pages[:, page_row].reshape(KV, mp * ps, Hd)
+    v_ctx = v_pages[:, page_row].reshape(KV, mp * ps, Hd)
+    qg = q.reshape(C, KV, G, Hd)
+    s = jnp.einsum("ckgd,ktd->kgct", qg.astype(jnp.float32),
+                   k_ctx.astype(jnp.float32)) / jnp.sqrt(Hd)
+    pos_q = start + jnp.arange(C)
+    ctx = jnp.arange(mp * ps)
+    s = jnp.where((ctx[None, :] <= pos_q[:, None])[None, None], s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("kgct,ktd->ckgd", probs, v_ctx.astype(jnp.float32))
+    out = out * (jnp.arange(C) < true_len)[:, None, None, None]
+    return out.reshape(C, H * Hd).astype(q.dtype)
+
+
 def reference_paged_attention(q, k_pages, v_pages, page_tables, lengths):
     """Gather-based jnp oracle (same math as the engine's portable path)."""
     B, H, Hd = q.shape
-    _, ps, KV, _ = k_pages.shape
+    KV, _, ps, _ = k_pages.shape
     G = H // KV
     mp = page_tables.shape[1]
-    k_ctx = k_pages[page_tables].reshape(B, mp * ps, KV, Hd)
-    v_ctx = v_pages[page_tables].reshape(B, mp * ps, KV, Hd)
+    # head-major pages: gather on axis 1 → [KV, B, mp·ps, Hd]
+    k_ctx = k_pages[:, page_tables].reshape(KV, B, mp * ps, Hd)
+    v_ctx = v_pages[:, page_tables].reshape(KV, B, mp * ps, Hd)
     qg = q.reshape(B, KV, G, Hd)
-    s = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
+    s = jnp.einsum("bkgd,kbtd->bkgt", qg.astype(jnp.float32),
                    k_ctx.astype(jnp.float32)) / jnp.sqrt(Hd)
     pos = jnp.arange(mp * ps)[None, :]
     s = jnp.where((pos < lengths[:, None])[:, None, None, :], s, NEG_INF)
     # inactive slots (length 0) are fully masked: zero their output
     probs = jax.nn.softmax(s, axis=-1) * (lengths > 0)[:, None, None, None]
-    out = jnp.einsum("bkgt,btkd->bkgd", probs, v_ctx.astype(jnp.float32))
+    out = jnp.einsum("bkgt,kbtd->bkgd", probs, v_ctx.astype(jnp.float32))
     return out.reshape(B, H * Hd).astype(q.dtype)

@@ -22,7 +22,10 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fusioninfer_tpu.ops.flash_attention import flash_attention
-from fusioninfer_tpu.ops.paged_attention import paged_decode_attention
+from fusioninfer_tpu.ops.paged_attention import (
+    paged_decode_attention,
+    paged_prefill_attention,
+)
 
 
 def tp_compatible(mesh: Mesh, n_heads: int, n_kv_heads: int) -> bool:
@@ -63,7 +66,7 @@ def flash_attention_tp(
 def paged_decode_attention_tp(
     mesh: Mesh,
     q: jax.Array,  # [B, H, Hd] — H sharded over tp
-    k_pages: jax.Array,  # [n_pages, ps, KV, Hd] — KV sharded over tp
+    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] — KV (leading) sharded over tp
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, mp] replicated
     lengths: jax.Array,  # [B] replicated
@@ -76,8 +79,8 @@ def paged_decode_attention_tp(
         mesh=mesh,
         in_specs=(
             P(None, "tp", None),
-            P(None, None, "tp", None),
-            P(None, None, "tp", None),
+            P("tp", None, None, None),
+            P("tp", None, None, None),
             P(None, None),
             P(None),
         ),
@@ -85,3 +88,32 @@ def paged_decode_attention_tp(
         check_vma=False,
     )
     return fn(q, k_pages, v_pages, page_tables, lengths)
+
+
+def paged_prefill_attention_tp(
+    mesh: Mesh,
+    q: jax.Array,  # [C, H, Hd] — H sharded over tp
+    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] — KV (leading) sharded over tp
+    v_pages: jax.Array,
+    page_row: jax.Array,  # [mp] replicated
+    start: jax.Array,  # scalar replicated
+    true_len: jax.Array,  # scalar replicated
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-shard suffix-prefill attention → [C, H·Hd] sharded on features."""
+    fn = shard_map(
+        partial(paged_prefill_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),
+            P("tp", None, None, None),
+            P("tp", None, None, None),
+            P(None),
+            P(),
+            P(),
+        ),
+        out_specs=P(None, "tp"),
+        check_vma=False,
+    )
+    return fn(q, k_pages, v_pages, page_row, start, true_len)

@@ -103,13 +103,15 @@ def logit_spec() -> P:
 
 
 def kv_cache_spec() -> P:
-    """[L, pages, page_size, KV, Hd] paged KV cache: KV heads over tp.
+    """[L, KV, pages, page_size, Hd] paged KV cache: KV heads over tp.
 
+    Head-major layout (KV ahead of pages) so the paged-attention kernel's
+    per-head page DMA slices only leading dims (Mosaic tiling constraint).
     With tp ≤ n_kv_heads each tensor-parallel shard owns whole KV heads —
     the attention kernel then needs no cross-device communication during
     decode. (tp > n_kv_heads would replicate KV heads; guard in caller.)
     """
-    return P(None, None, None, "tp", None)
+    return P(None, "tp", None, None, None)
 
 
 def shard_params(cfg: ModelConfig, mesh: Mesh, params: Params) -> Params:

@@ -10,17 +10,26 @@ registers a single-chip TPU backend before any test code runs, so
 by the time this file executes. Backend *initialization* is still lazy,
 though, so overriding via ``jax.config.update`` here (before any test
 touches a device) reliably lands everything on the virtual CPU mesh.
+
+``FUSIONINFER_TEST_TPU=1`` (the ``make test-tpu`` tier) leaves the real
+TPU backend in place instead — that tier runs the hardware kernel tests
+(``tests/test_kernels_tpu.py``) with ``interpret=False`` at bench
+shapes, the regression fence round 2 lacked when Mosaic rejected the
+paged kernel's layout only at driver-bench time.
 """
 
 import os
 import sys
 
+_ON_TPU_TIER = os.environ.get("FUSIONINFER_TEST_TPU", "") == "1"
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not _ON_TPU_TIER and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU_TIER:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,121 @@
+"""Two-process proof of the operator's multi-host bootstrap contract.
+
+SURVEY §7 hard-part 1 warns a wrong (topology env ↔ jax.distributed)
+contract "fails silently as a hung XLA init"; through round 2 the
+contract had never run as more than one real process.  This test renders
+the engine container exactly the way the operator does
+(:class:`fusioninfer_tpu.workload.bootstrap.JaxCoordinatorBootstrap`),
+resolves the fieldRef env the way kubelet would, then launches TWO real
+OS processes that drive ``maybe_init_distributed``
+(``engine/server.py``) to a successful ``jax.distributed.initialize``
+handshake on CPU — with a hard timeout so contract drift fails in
+seconds, not as a hang.  VERDICT r2 ask #7.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+from fusioninfer_tpu.api.types import EngineKind
+from fusioninfer_tpu.workload.bootstrap import bootstrap_for
+from fusioninfer_tpu.workload.labels import LWS_WORKER_INDEX_LABEL
+
+_CHILD = textwrap.dedent(
+    """
+    from fusioninfer_tpu.engine.server import maybe_init_distributed
+    maybe_init_distributed()
+    import jax
+    assert jax.process_count() == 2, jax.process_count()
+    # every process must see the other's devices through the coordinator
+    assert jax.device_count() == 2 * jax.local_device_count(), (
+        jax.device_count(), jax.local_device_count())
+    print("BOOTSTRAP_OK", jax.process_index(), flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _resolve_env(container: dict, worker_index: int) -> dict[str, str]:
+    """Materialize the rendered env list the way kubelet would (fieldRef
+    → the pod's LWS worker-index label)."""
+    out = {}
+    for e in container.get("env", []):
+        if "valueFrom" in e:
+            field_path = e["valueFrom"]["fieldRef"]["fieldPath"]
+            assert field_path == f"metadata.labels['{LWS_WORKER_INDEX_LABEL}']", field_path
+            out[e["name"]] = str(worker_index)
+        else:
+            out[e["name"]] = e["value"]
+    return out
+
+
+def test_two_process_jax_coordinator_handshake():
+    strat = bootstrap_for(EngineKind.NATIVE)
+    leader = strat.wrap_leader({"name": "engine"}, size=2)
+    worker = strat.wrap_worker({"name": "engine"}, size=2)
+
+    port = str(_free_port())  # avoid CI collisions on the default 8476
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for idx, container in enumerate([leader, worker]):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # one CPU device per process
+        env.update(_resolve_env(container, worker_index=idx))
+        env.update({
+            # what the LWS controller injects at runtime
+            "LWS_LEADER_ADDRESS": "127.0.0.1",
+            "FUSIONINFER_COORDINATOR_PORT": port,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": repo_root,
+        })
+        assert env["JAX_NUM_PROCESSES"] == "2"
+        assert env["JAX_PROCESS_ID"] == str(idx)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+
+    results = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            results.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    for rank, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"process {rank} failed rc={rc}\n{err[-2000:]}"
+        assert f"BOOTSTRAP_OK {rank}" in out, (rank, out, err[-500:])
+
+
+def test_single_process_is_noop():
+    """Without the operator's env the server must not touch
+    jax.distributed (single-host slices are never wrapped)."""
+    env = dict(os.environ)
+    for k in ("LWS_LEADER_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(
+            """
+            from fusioninfer_tpu.engine.server import maybe_init_distributed
+            maybe_init_distributed()
+            import jax
+            assert jax.process_count() == 1
+            print("NOOP_OK", flush=True)
+            """
+        )],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "NOOP_OK" in proc.stdout

@@ -12,7 +12,6 @@ from fusioninfer_tpu.engine.engine import NativeEngine, Request
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
 from fusioninfer_tpu.engine.sampler import SamplingParams
 from fusioninfer_tpu.engine.server import EngineServer
-from fusioninfer_tpu.engine.tokenizer import ByteTokenizer
 from fusioninfer_tpu.models.config import get_preset
 
 CFG = get_preset("qwen3-tiny")
@@ -370,6 +369,37 @@ class TestTensorParallelEngine:
         tp_engine.add_request(Request("r", list(prompt), sp))
         out, _ = run_to_completion(tp_engine)
         assert out["r"] == ref["r"]
+
+    def test_tp_prefix_cache_hit_matches_single_device_greedy(self):
+        """Prefix-caching ON × tp=2, kernel path pinned: the second request
+        is a near-total prefix-cache hit, so its compute flows through the
+        sharded suffix kernel (``paged_prefill_attention_tp``).  Tokens
+        must match the single-device engine exactly (VERDICT r2 ask #5)."""
+        import dataclasses
+
+        from fusioninfer_tpu.parallel import MeshConfig, build_mesh
+
+        cfg = dataclasses.replace(CFG, dtype="float32", attn_impl="flash")
+        base = [7, 3, 5, 11, 2, 9, 4, 6, 1, 8, 13, 12]  # > 1 page of 8
+        follow = base + [10, 14]
+        sp = SamplingParams(temperature=0.0, max_tokens=5)
+
+        def serve(mesh):
+            engine = NativeEngine(cfg, cache_cfg=CACHE, max_batch_size=2,
+                                  seed=0, mesh=mesh)
+            engine.add_request(Request("warm", list(base), sp))
+            run_to_completion(engine)
+            assert engine.prefix_cache_hit_rate() == 0.0
+            engine.add_request(Request("hit", list(follow), sp))
+            out, _ = run_to_completion(engine)
+            # the second request must actually have hit the cache —
+            # otherwise this test silently stops covering the suffix path
+            assert engine.prefix_cache_hit_rate() > 0.0
+            return out["hit"]
+
+        ref = serve(None)
+        mesh = build_mesh(MeshConfig(tp=2), __import__("jax").devices()[:2])
+        assert ref == serve(mesh)
 
     def test_tp_must_divide_kv_heads(self):
         from fusioninfer_tpu.parallel import MeshConfig, build_mesh

@@ -5,7 +5,6 @@ reference — the fence that the kernels are drop-in on the serving path."""
 import dataclasses
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from fusioninfer_tpu.engine.engine import NativeEngine, Request

@@ -1,0 +1,195 @@
+"""Hardware kernel tests: Pallas kernels with ``interpret=False`` on a real TPU.
+
+Run via ``make test-tpu`` (sets ``FUSIONINFER_TEST_TPU=1`` so the root
+conftest leaves the real backend in place); skipped everywhere else.
+These exist because round 2 shipped a paged-attention layout Mosaic
+rejects — and every in-repo kernel test passed, because all of them ran
+``interpret=True``.  The shapes here are exactly the driver bench's
+qwen3-1.7b decode config (bf16, KV=8, Hd=128, page_size=128, a
+[KV, 257, 128, 128] page pool) plus non-multiple-of-8 lengths, so a
+kernel that cannot compile on hardware fails HERE, not in the driver.
+
+VERDICT r2 ask #2.
+"""
+
+import os
+
+import pytest
+
+requires_tpu = pytest.mark.skipif(
+    os.environ.get("FUSIONINFER_TEST_TPU", "") != "1",
+    reason="hardware tier: run via make test-tpu on a TPU host",
+)
+
+pytestmark = requires_tpu
+
+if os.environ.get("FUSIONINFER_TEST_TPU", "") == "1":
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.default_backend() != "tpu":  # pragma: no cover
+        pytestmark = pytest.mark.skip(reason="FUSIONINFER_TEST_TPU=1 but no TPU backend")
+
+
+def _paged_setup(B, H, KV, Hd, ps, n_pages, mp, lengths, dtype, seed=0):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, H, Hd), dtype)
+    k_pages = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), dtype)
+    v_pages = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), dtype)
+    rng = np.random.default_rng(seed)
+    tables = np.full((B, mp), n_pages - 1, np.int32)
+    perm = iter(rng.permutation(n_pages - 1))
+    for b, ln in enumerate(lengths):
+        for i in range(-(-int(ln) // ps) if ln else 0):
+            tables[b, i] = next(perm)
+    return q, k_pages, v_pages, jnp.asarray(tables), jnp.asarray(np.asarray(lengths, np.int32))
+
+
+class TestPagedAttentionHW:
+    def test_bench_shapes_bf16(self):
+        """The exact round-2 failure config: [257, ...] bf16 page pool,
+        KV=8, Hd=128, ps=128 — must COMPILE (interpret=False) and match
+        the gather oracle."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_decode_attention,
+            reference_paged_attention,
+        )
+
+        B, H, KV, Hd, ps, n_pages, mp = 8, 16, 8, 128, 128, 257, 8
+        lengths = [129, 1000, 7, 1, 0, 128, 255, 513]  # non-multiples of 8 included
+        q, kp, vp, tables, ln = _paged_setup(
+            B, H, KV, Hd, ps, n_pages, mp, lengths, jnp.bfloat16
+        )
+        out = paged_decode_attention(q, kp, vp, tables, ln, interpret=False)
+        out.block_until_ready()
+        ref = reference_paged_attention(q, kp, vp, tables, ln)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=5e-2, rtol=5e-2,
+        )
+
+    def test_inactive_rows_zero(self):
+        from fusioninfer_tpu.ops.paged_attention import paged_decode_attention
+
+        q, kp, vp, tables, ln = _paged_setup(
+            4, 16, 8, 128, 128, 33, 4, [0, 200, 0, 64], jnp.bfloat16
+        )
+        out = paged_decode_attention(q, kp, vp, tables, ln, interpret=False)
+        out = np.asarray(out, np.float32)
+        assert np.allclose(out[0], 0.0) and np.allclose(out[2], 0.0)
+        assert not np.allclose(out[1], 0.0)
+
+
+class TestPagedPrefillAttentionHW:
+    def test_suffix_bench_shapes_bf16(self):
+        """Prefix-cache-hit path at bench shapes: suffix queries mid-stream
+        over a bf16 page pool, interpret=False.  Must compile under Mosaic
+        and match the gather oracle (the decode kernel's round-2 failure
+        mode applies equally here)."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_prefill_attention,
+            reference_paged_prefill_attention,
+        )
+
+        C, H, KV, Hd, ps, n_pages, mp = 256, 16, 8, 128, 128, 65, 16
+        ks = jax.random.split(jax.random.key(3), 3)
+        q = jax.random.normal(ks[0], (C, H, Hd), jnp.bfloat16)
+        kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        row = jnp.asarray(np.random.default_rng(3).permutation(n_pages - 1)[:mp])
+        start, true_len = jnp.int32(901), jnp.int32(189)  # non-multiples of 8
+        out = paged_prefill_attention(q, kp, vp, row, start, true_len,
+                                      interpret=False)
+        out.block_until_ready()
+        ref = reference_paged_prefill_attention(q, kp, vp, row, start, true_len)
+        got = np.asarray(out, np.float32).copy()
+        got[189:] = 0.0  # pad rows are unspecified; oracle zeroes them
+        np.testing.assert_allclose(
+            got, np.asarray(ref, np.float32), atol=5e-2, rtol=5e-2,
+        )
+
+
+class TestFlashAttentionHW:
+    def test_bench_shapes_bf16_causal(self):
+        from fusioninfer_tpu.ops.flash_attention import (
+            flash_attention,
+            reference_attention,
+        )
+
+        B, S, H, KV, Hd = 1, 1024, 16, 8, 128
+        ks = jax.random.split(jax.random.key(1), 3)
+        q = jax.random.normal(ks[0], (B, S, H, Hd), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, S, KV, Hd), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, S, KV, Hd), jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True, interpret=False)
+        out.block_until_ready()
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=5e-2, rtol=5e-2,
+        )
+
+    def test_small_pow2_bucket(self):
+        """Smallest prefill bucket (32) — block sizes clamp below 128."""
+        from fusioninfer_tpu.ops.flash_attention import (
+            flash_attention,
+            reference_attention,
+        )
+
+        B, S, H, KV, Hd = 2, 32, 4, 2, 128
+        ks = jax.random.split(jax.random.key(2), 3)
+        q = jax.random.normal(ks[0], (B, S, H, Hd), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, S, KV, Hd), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, S, KV, Hd), jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True, interpret=False)
+        out.block_until_ready()
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=5e-2, rtol=5e-2,
+        )
+
+
+class TestDecodeStepHW:
+    def test_decode_step_kernel_path_compiles(self):
+        """End-to-end decode_step with attn_impl=flash at small-model
+        shapes but REAL page/head dims — the integration the bench runs."""
+        import dataclasses
+
+        from fusioninfer_tpu.engine.kv_cache import (
+            CacheConfig,
+            PageAllocator,
+            init_kv_cache,
+        )
+        from fusioninfer_tpu.engine.model_runner import decode_step
+        from fusioninfer_tpu.models.config import get_preset
+        from fusioninfer_tpu.models.transformer import init_params
+
+        cfg = dataclasses.replace(
+            get_preset("qwen3-tiny"),
+            n_heads=16, n_kv_heads=8, head_dim=128, attn_impl="flash",
+        )
+        cache_cfg = CacheConfig(n_pages=17, page_size=128, max_pages_per_seq=4)
+        params = jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
+        cache = init_kv_cache(cfg, cache_cfg)
+        B = 4
+        alloc = PageAllocator(cache_cfg)
+        tables = np.zeros((B, cache_cfg.max_pages_per_seq), np.int32)
+        for i in range(B):
+            alloc.allocate(str(i), 200)
+            tables[i] = alloc.page_table_row(str(i))
+        cache, logits = decode_step(
+            cfg, cache_cfg, params, cache,
+            jnp.arange(B, dtype=jnp.int32),
+            jnp.full((B,), 150, jnp.int32),
+            jnp.asarray(tables),
+            jnp.ones((B,), bool),
+        )
+        logits.block_until_ready()
+        assert logits.shape == (B, cfg.vocab_size)
+        assert bool(jnp.isfinite(logits.astype(jnp.float32)).all())

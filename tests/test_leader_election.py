@@ -14,8 +14,11 @@ from fusioninfer_tpu.operator.leaderelection import (
 )
 from fusioninfer_tpu.operator.manager import Manager
 
+# Short enough that expiry/failover paths run in seconds, wide enough that
+# a CI machine under parallel-suite load cannot make the holder miss its
+# renew deadline spuriously (0.4s proved flaky at ~2× suite parallelism).
 FAST = LeaderElectionConfig(
-    lease_duration=0.6, renew_deadline=0.4, retry_period=0.1
+    lease_duration=2.0, renew_deadline=1.5, retry_period=0.2
 )
 
 

@@ -10,7 +10,6 @@ from fusioninfer_tpu.operator.fake import FakeK8s
 from fusioninfer_tpu.operator.modelloader import (
     ModelLoaderReconciler,
     build_loader_job,
-    job_phase,
 )
 
 

@@ -1,4 +1,4 @@
-"""Pallas paged decode attention vs the gather-based oracle (interpret mode)."""
+"""Pallas paged decode + suffix-prefill attention vs gather oracles (interpret mode)."""
 
 import jax
 import jax.numpy as jnp
@@ -6,15 +6,17 @@ import numpy as np
 
 from fusioninfer_tpu.ops.paged_attention import (
     paged_decode_attention,
+    paged_prefill_attention,
     reference_paged_attention,
+    reference_paged_prefill_attention,
 )
 
 
 def _setup(B=3, H=4, KV=2, Hd=64, n_pages=9, ps=16, mp=4, seed=0, dtype=jnp.float32):
     ks = jax.random.split(jax.random.key(seed), 3)
     q = jax.random.normal(ks[0], (B, H, Hd), dtype)
-    k_pages = jax.random.normal(ks[1], (n_pages, ps, KV, Hd), dtype)
-    v_pages = jax.random.normal(ks[2], (n_pages, ps, KV, Hd), dtype)
+    k_pages = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), dtype)
+    v_pages = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), dtype)
     # distinct page rows per sequence; trash page = n_pages - 1
     rng = np.random.default_rng(seed)
     tables = np.full((B, mp), n_pages - 1, np.int32)
@@ -57,4 +59,69 @@ def test_bf16_pages():
     ref = reference_paged_attention(q, kp, vp, tables, lengths)
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=4e-2, rtol=4e-2
+    )
+
+
+def _suffix_setup(C=32, H=4, KV=2, Hd=64, n_pages=9, ps=16, mp=8, seed=0,
+                  dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (C, H, Hd), dtype)
+    k_pages = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), dtype)
+    v_pages = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), dtype)
+    rng = np.random.default_rng(seed)
+    row = np.full(mp, n_pages - 1, np.int32)
+    perm = rng.permutation(n_pages - 1)
+    row[: len(perm)] = perm[:mp]
+    return q, k_pages, v_pages, jnp.asarray(row)
+
+
+def _mask_pad(out, true_len):
+    """Kernel output past true_len is unspecified; zero it like the oracle."""
+    out = np.asarray(out, np.float32).copy()
+    out[true_len:] = 0.0
+    return out
+
+
+def test_suffix_matches_oracle_midstream():
+    """Queries starting mid-sequence (the prefix-cache hit shape)."""
+    q, kp, vp, row = _suffix_setup()
+    start, true_len = jnp.int32(19), jnp.int32(21)  # non-multiples of page size
+    out = paged_prefill_attention(q, kp, vp, row, start, true_len, interpret=True)
+    ref = reference_paged_prefill_attention(q, kp, vp, row, start, true_len)
+    np.testing.assert_allclose(
+        _mask_pad(out, 21), np.asarray(ref, np.float32), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_suffix_from_zero_equals_full_prefill():
+    """start=0 degenerates to ordinary causal prefill over own pages."""
+    q, kp, vp, row = _suffix_setup(seed=3)
+    out = paged_prefill_attention(q, kp, vp, row, jnp.int32(0), jnp.int32(32),
+                                  interpret=True)
+    ref = reference_paged_prefill_attention(q, kp, vp, row, jnp.int32(0),
+                                            jnp.int32(32))
+    np.testing.assert_allclose(
+        _mask_pad(out, 32), np.asarray(ref, np.float32), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_suffix_multi_qtile():
+    """C > block_q exercises the q-tile grid axis + causal page bounds."""
+    q, kp, vp, row = _suffix_setup(C=64, n_pages=17, ps=16, mp=12, seed=5)
+    start, true_len = jnp.int32(50), jnp.int32(40)
+    out = paged_prefill_attention(q, kp, vp, row, start, true_len,
+                                  block_q=32, interpret=True)
+    ref = reference_paged_prefill_attention(q, kp, vp, row, start, true_len)
+    np.testing.assert_allclose(
+        _mask_pad(out, 40), np.asarray(ref, np.float32), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_suffix_gqa_bf16():
+    q, kp, vp, row = _suffix_setup(H=8, KV=2, dtype=jnp.bfloat16, seed=9)
+    start, true_len = jnp.int32(7), jnp.int32(30)
+    out = paged_prefill_attention(q, kp, vp, row, start, true_len, interpret=True)
+    ref = reference_paged_prefill_attention(q, kp, vp, row, start, true_len)
+    np.testing.assert_allclose(
+        _mask_pad(out, 30), np.asarray(ref, np.float32), atol=4e-2, rtol=4e-2
     )

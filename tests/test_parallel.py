@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from fusioninfer_tpu.models.config import ModelConfig, get_preset
+from fusioninfer_tpu.models.config import get_preset
 from fusioninfer_tpu.models.transformer import forward, init_params
 from fusioninfer_tpu.parallel import (
     MeshConfig,
@@ -18,7 +18,6 @@ from fusioninfer_tpu.parallel import (
     infer_mesh_config,
     make_forward,
     make_train_step,
-    param_shardings,
     param_specs,
     shard_params,
     sharded_init,

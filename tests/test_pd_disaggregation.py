@@ -13,7 +13,6 @@ import pytest
 from fusioninfer_tpu.engine.engine import NativeEngine, Request
 from fusioninfer_tpu.engine.kv_cache import CacheConfig, init_kv_cache
 from fusioninfer_tpu.engine.kv_transfer import (
-    KVSlab,
     extract_slab,
     inject_slab,
     slab_from_bytes,

@@ -4,7 +4,6 @@ identical to cold generation, while actually skipping prefill compute."""
 
 import dataclasses
 
-import pytest
 
 from fusioninfer_tpu.engine.engine import NativeEngine, Request
 from fusioninfer_tpu.engine.kv_cache import CacheConfig

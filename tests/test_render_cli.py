@@ -3,9 +3,7 @@ must validate and render, with zero nvidia.com/gpu anywhere (the
 acceptance bar) and TPU selectors present wherever a tpu block is given."""
 
 import glob
-import io
 import os
-import sys
 
 import pytest
 import yaml

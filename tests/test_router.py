@@ -11,7 +11,6 @@ from fusioninfer_tpu.api.types import (
     InferenceServiceSpec,
     Role,
     RoutingStrategy,
-    TPUSlice,
 )
 from fusioninfer_tpu.router import (
     BACKEND_PORT,

@@ -2,7 +2,6 @@
 reference's posture (``pkg/workload/lws_test.go``: size, gang annotations,
 scheduler name, leader/worker wrapping down to the shell string)."""
 
-import pytest
 
 from fusioninfer_tpu.api.types import ComponentType, EngineKind, Role, TPUSlice, Multinode
 from fusioninfer_tpu.utils.hash import SPEC_HASH_LABEL
