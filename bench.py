@@ -106,7 +106,16 @@ def run_decode(jax, cfg, batch: int, cache_cfg, prefix_len: int,
     from fusioninfer_tpu.models.transformer import init_params
 
     cache_cfg.validate()
-    params = jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
+    if cfg.quantization == "int8":
+        # init on the host CPU and ship int8 only — an 8B bf16 tree would
+        # OOM the chip before quantization could shrink it
+        from fusioninfer_tpu.models.quantization import quantize_params
+
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = quantize_params(cfg, init_params(cfg, jax.random.key(0)))
+        params = jax.device_put(params, jax.devices()[0])
+    else:
+        params = jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
     cache = init_kv_cache(cfg, cache_cfg)
 
     alloc = PageAllocator(cache_cfg)
@@ -189,11 +198,27 @@ def main() -> None:
         if on_tpu:
             # Qwen3-1.7B shapes, 32-way continuous batch, 1 KiB-token
             # contexts: ~3.4 GiB weights + KV pages on a 16 GiB v5e chip.
+            # BENCH_MODEL=qwen3-8b+int8 measures the BASELINE config-2 rung
+            # (int8 weight-only, see models/quantization.py).
             base_cfg, batch = get_preset("qwen3-1.7b"), 32
+            model_env = os.environ.get("BENCH_MODEL", "")
+            if model_env:
+                name, _, suffix = model_env.partition("+")
+                base_cfg = get_preset(name)
+                if suffix == "int8":
+                    base_cfg = dataclasses.replace(base_cfg, quantization="int8")
             cache_cfg = CacheConfig(n_pages=32 * 8 + 1, page_size=128,
                                     max_pages_per_seq=8)
             prefix_len, warmup, steps = 128, 5, 64
-            record["metric"] = "decode_throughput_qwen3_1.7b"
+            # keep the longitudinal default key stable ("qwen3_1.7b" since
+            # r2); sanitize only explicit BENCH_MODEL overrides
+            if model_env:
+                safe = "".join(c if c.isalnum() else "_" for c in base_cfg.name)
+                record["metric"] = f"decode_throughput_{safe}" + (
+                    "_int8" if base_cfg.quantization == "int8" else ""
+                )
+            else:
+                record["metric"] = "decode_throughput_qwen3_1.7b"
         else:
             base_cfg, batch = get_preset("qwen3-tiny"), 8
             cache_cfg = CacheConfig(n_pages=33, page_size=64, max_pages_per_seq=4)

@@ -38,6 +38,7 @@ def _cmd_controller_run(args: argparse.Namespace) -> int:
         default_queue=args.volcano_queue or None,
         leader_elect=args.leader_elect,
         leader_identity=os.environ.get("POD_NAME") or None,
+        metrics_auth=args.metrics_auth,
     )
     mgr.run_forever()
     # mirror controller-runtime: lost leadership is a fatal exit so the
@@ -139,6 +140,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--volcano-queue", default="")
     run.add_argument("--leader-elect", action="store_true",
                      help="lease-based active/standby HA (coordination.k8s.io)")
+    run.add_argument("--metrics-auth", choices=("none", "token"), default="token",
+                     help="metrics endpoint authn: bearer token via TokenReview "
+                          "(or FUSIONINFER_METRICS_TOKEN static token); "
+                          "secure by default like the reference manager")
     run.add_argument("-v", "--verbose", action="store_true")
     run.set_defaults(func=_cmd_controller_run)
 
@@ -160,6 +165,9 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--page-size", type=int, default=128)
     serve.add_argument("--hbm-utilization", type=float, default=0.85)
     serve.add_argument("--tensor-parallel-size", type=int, default=1)
+    serve.add_argument("--quantization", choices=("none", "int8"), default="none",
+                       help="weight-only int8: the 8B-on-one-chip fit "
+                            "(single-device; tp shards bf16)")
     serve.add_argument("--seed", type=int, default=0)
     serve.add_argument(
         "--prefill-upstream", default="",

@@ -113,6 +113,14 @@ class NativeEngine:
         self.max_batch_size = max_batch_size
         self.mesh = mesh
         self._kernel_mesh = None
+        if cfg.quantization != "none" and mesh is not None:
+            # the sharding rules map named bf16 leaves; they don't know the
+            # quantized {_q8, _scale} structure yet — int8 is the 1-chip
+            # fit story (BASELINE config 2), TP shards bf16
+            raise ValueError(
+                f"quantization={cfg.quantization!r} is single-device serving; "
+                "use tp over bf16 weights for multi-chip"
+            )
         if mesh is not None:
             from fusioninfer_tpu.ops import dispatch
             from fusioninfer_tpu.ops.sharded import tp_compatible
@@ -141,9 +149,24 @@ class NativeEngine:
             kv_sharding = jax.sharding.NamedSharding(mesh, psharding.kv_cache_spec())
             self.cache = jax.device_put(init_kv_cache(cfg, self.cache_cfg), kv_sharding)
         else:
-            if params is None:
+            if cfg.quantization == "int8" and params is None:
+                # init + quantize on host CPU, ship int8 only: an 8B bf16
+                # tree on the chip would OOM before quantization shrank it
+                from fusioninfer_tpu.models.quantization import quantize_params
+
+                logger.info("initializing %s int8 weights host-side", cfg.name)
+                with jax.default_device(jax.devices("cpu")[0]):
+                    params = quantize_params(cfg, init_params(cfg, jax.random.key(seed)))
+                params = jax.device_put(params, jax.devices()[0])
+            elif params is None:
                 logger.info("initializing random weights for %s", cfg.name)
                 params = init_params(cfg, jax.random.key(seed))
+            elif cfg.quantization == "int8":
+                # provided params (loader output is already int8 — no-op);
+                # bf16 input quantizes in place on its current device
+                from fusioninfer_tpu.models.quantization import quantize_params
+
+                params = quantize_params(cfg, params)
             self.cache = init_kv_cache(cfg, self.cache_cfg)
         self.params = params
         self.prefix_caching = enable_prefix_caching

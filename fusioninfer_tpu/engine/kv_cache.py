@@ -83,7 +83,13 @@ def page_bytes(cfg: ModelConfig, page_size: int) -> int:
 
 
 def model_param_bytes(cfg: ModelConfig) -> int:
-    """Weight footprint (bytes) computed from shapes — no allocation."""
+    """Weight footprint (bytes) computed from shapes — no allocation.
+    Quantization-aware: int8 configs budget the quantized tree, which is
+    what actually occupies HBM when the engine serves them."""
+    if cfg.quantization == "int8":
+        from fusioninfer_tpu.models.quantization import quantized_param_bytes
+
+        return quantized_param_bytes(cfg)
     from fusioninfer_tpu.models.transformer import init_params
 
     shapes = jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))

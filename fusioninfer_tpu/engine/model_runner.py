@@ -26,6 +26,7 @@ from jax import lax
 
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
 from fusioninfer_tpu.models.config import ModelConfig
+from fusioninfer_tpu.models.quantization import embed_lookup
 from fusioninfer_tpu.models.transformer import (
     layer_forward,
     lm_head,
@@ -49,7 +50,7 @@ def prefill(
     """Prefill one sequence; returns (cache, last-token logits [1, V])."""
     B, S = tokens.shape
     ps = cache_cfg.page_size
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
 
     token_idx = jnp.arange(S)
@@ -113,7 +114,7 @@ def prefill_suffix(
     dtype_ctx = cache["k"].dtype
     use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
 
-    x = params["embed"][tokens]  # [1, C, D]
+    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)  # [1, C, D]
     offs = jnp.arange(C)
     positions = (start + offs)[None, :]  # [1, C]
 
@@ -128,6 +129,9 @@ def prefill_suffix(
 
     def body(x, inputs):
         layer, k_cache_l, v_cache_l = inputs
+        from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
+
+        layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
         q, k, v = qkv_proj(cfg, layer, x, positions)
 
         # head-major per-layer cache [KV, n_pages, ps, Hd]; k[0] is [C, KV, Hd]
@@ -195,7 +199,7 @@ def decode_step(
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
 
-    x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)[:, None, :]  # [B, 1, D]
     pos = positions[:, None]  # [B, 1]
 
     write_page = jnp.where(
@@ -212,6 +216,9 @@ def decode_step(
 
     def body(x, inputs):
         layer, k_cache_l, v_cache_l = inputs
+        from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
+
+        layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
         B_, S_, D_ = x.shape
         q, k, v = qkv_proj(cfg, layer, x, pos)
 

@@ -539,15 +539,31 @@ def serve_from_args(args) -> int:
 
     load_hf = getattr(args, "load_hf", "") or ""
     load_ckpt = getattr(args, "load_checkpoint", "") or ""
+    quant = getattr(args, "quantization", "none") or "none"
     params = None
     if load_hf and load_ckpt:
         raise SystemExit("--load-hf and --load-checkpoint are mutually exclusive")
     if load_hf:
-        from fusioninfer_tpu.models.loader import load_hf_checkpoint
+        from fusioninfer_tpu.models.loader import config_from_hf, load_hf_checkpoint
 
-        cfg, params = load_hf_checkpoint(load_hf)
+        # quantization must be on the cfg BEFORE loading so the loader
+        # quantizes host-side per tensor (device never holds bf16 8B)
+        hf_cfg = config_from_hf(load_hf)
+        if quant != "none":
+            import dataclasses
+
+            hf_cfg = dataclasses.replace(hf_cfg, quantization=quant)
+        cfg, params = load_hf_checkpoint(load_hf, cfg=hf_cfg)
         model_name = args.model if args.model != "qwen3-tiny" else cfg.name
     elif load_ckpt:
+        if quant != "none":
+            # orbax restore materializes the full bf16 tree on device before
+            # any quantization could shrink it — OOM for the 8B chip-fit
+            # case this flag serves; the safetensors path quantizes host-side
+            raise SystemExit(
+                "--load-checkpoint cannot be combined with --quantization; "
+                "use --load-hf (host-side per-tensor quantization) instead"
+            )
         from fusioninfer_tpu.models.loader import restore_checkpoint
 
         cfg, params = restore_checkpoint(load_ckpt)
@@ -555,6 +571,10 @@ def serve_from_args(args) -> int:
     else:
         cfg = get_preset(args.model)
         model_name = args.model
+    if quant != "none" and cfg.quantization == "none":
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, quantization=quant)
     tp = args.tensor_parallel_size
     mesh = None
     if tp > 1:

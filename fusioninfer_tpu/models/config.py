@@ -34,6 +34,10 @@ class ModelConfig:
     # reference elsewhere), "flash", or "reference".  Sharded multi-device
     # paths pin "reference" — see fusioninfer_tpu.ops.dispatch.
     attn_impl: str = "auto"
+    # Weight quantization: "none" (bf16) or "int8" (weight-only symmetric
+    # per-channel — the single-chip fit story for 8B models; see
+    # fusioninfer_tpu.models.quantization).
+    quantization: str = "none"
     # Mixture of experts (0 experts == dense)
     n_experts: int = 0
     n_experts_active: int = 2
@@ -54,6 +58,7 @@ class ModelConfig:
     def validate(self) -> "ModelConfig":
         assert self.n_heads % self.n_kv_heads == 0, "GQA requires n_heads % n_kv_heads == 0"
         assert self.d_model % self.n_heads == 0 or self.head_dim, "need explicit head_dim"
+        assert self.quantization in ("none", "int8"), f"unknown quantization {self.quantization!r}"
         if self.is_moe:
             assert self.n_experts_active <= self.n_experts
         return self

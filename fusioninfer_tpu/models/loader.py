@@ -126,7 +126,27 @@ def load_hf_checkpoint(
         ours, transpose = _LAYER_MAP[suffix]
         per_layer.setdefault(ours, {})[int(idx_s)] = tensor.T if transpose else tensor
 
+    quantize = cfg.quantization == "int8"
+    if quantize and shardings is not None:
+        raise ValueError(
+            "int8 quantization is single-device serving; load bf16 for "
+            "sharded (tp) meshes"
+        )
+
     def put(leaf_path: tuple, arr: np.ndarray):
+        if quantize:
+            from fusioninfer_tpu.models.quantization import (
+                quantize_int8_host,
+                quantize_rows_host,
+                quantize_target,
+            )
+
+            kind = quantize_target(leaf_path)
+            if kind is not None:
+                # quantize on HOST so the device only ever holds int8 —
+                # a bf16 8B tree plus its int8 copy would OOM one chip
+                q = (quantize_rows_host if kind == "rows" else quantize_int8_host)(arr)
+                return {k: jnp.asarray(v) for k, v in q.items()}
         a = jnp.asarray(arr, target)
         if shardings is not None:
             s = shardings

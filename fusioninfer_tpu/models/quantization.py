@@ -1,0 +1,164 @@
+"""Weight-only int8 quantization — the single-chip fit story for 8B models.
+
+BASELINE config 2 serves Qwen3-8B on one v5e chip: ~8.2B params at bf16
+is ≈16.4 GB, over the chip's 16 GiB HBM before a single KV page exists.
+Symmetric per-output-channel int8 weights halve that to ≈8.2 GB, leaving
+multi-GiB of KV headroom (the reference delegates this problem to vLLM's
+quantization support; here it is in-repo).
+
+Representation: a quantized tensor is a pytree dict
+``{"_q8": int8[..., in, out], "_scale": f32[..., 1, out]}`` — scales are
+per *output* channel over the contraction axis, so dequantization is a
+single broadcast multiply that XLA fuses into the consuming matmul's
+operand load (weights stream from HBM as int8; the bf16 copy never
+round-trips).  Norm weights, router logits, and biases stay in their
+original dtypes (negligible bytes, precision-sensitive).
+
+Consumption is dequant-at-use inside the model's building blocks
+(:func:`maybe_dequantize_tree` at the top of ``qkv_proj`` / ``mlp_block``
+/ ``lm_head`` / the embed lookup): under ``jit`` the unused dequants in
+any given block are dead-code-eliminated, so no site pays for weights it
+does not touch.
+
+Scope: single-device serving (the 1-chip fit problem).  Tensor-parallel
+meshes shard bf16 weights; the engine rejects int8 × mesh until the
+sharding rules learn the quantized leaf structure.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+_Q = "_q8"
+_S = "_scale"
+
+# layer-stacked weights to quantize (everything matmul-shaped); norms,
+# router (fp32, tiny) and biases stay high-precision
+_LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and _Q in leaf and _S in leaf
+
+
+def quantize_int8(w: jax.Array) -> dict:
+    """Symmetric per-output-channel int8 over the contraction axis.
+
+    ``w`` is ``[..., in, out]``; scale reduces the ``in`` axis →
+    ``[..., 1, out]``.  (For row-major tables like embeddings, transpose
+    semantics are handled by the caller via :func:`quantize_rows`.)
+    """
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {_Q: q, _S: scale}
+
+
+def quantize_rows(w: jax.Array) -> dict:
+    """Per-row int8 for lookup tables (``[V, D]`` embeddings): scale
+    ``[V, 1]`` so a token gather reads one row + one scalar."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {_Q: q, _S: scale}
+
+
+def dequantize(leaf: dict, dtype=jnp.bfloat16) -> jax.Array:
+    return (leaf[_Q].astype(jnp.float32) * leaf[_S]).astype(dtype)
+
+
+def maybe_dequantize_tree(tree: Params, dtype=jnp.bfloat16) -> Params:
+    """Shallow map replacing quantized leaves by bf16 arrays; plain
+    arrays pass through untouched.  Call at block entry — XLA DCEs the
+    dequants that block does not consume."""
+    return {
+        k: dequantize(v, dtype) if is_quantized(v) else v
+        for k, v in tree.items()
+    }
+
+
+def embed_lookup(embed, tokens: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    """Token embedding gather for plain or row-quantized tables."""
+    if is_quantized(embed):
+        rows = embed[_Q][tokens].astype(jnp.float32) * embed[_S][tokens]
+        return rows.astype(dtype)
+    return embed[tokens]
+
+
+def quantize_params(cfg, params: Params) -> Params:
+    """Quantize a full parameter tree (idempotent).
+
+    Layer matmul weights per-output-channel; embedding (and its tied or
+    untied LM head use) per-row.  Returns a new tree; norms stay put.
+    """
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _LAYER_WEIGHTS:
+        if name in layers and not is_quantized(layers[name]):
+            layers[name] = quantize_int8(layers[name])
+    out["layers"] = layers
+    if not is_quantized(params["embed"]):
+        out["embed"] = quantize_rows(params["embed"])
+    if "lm_head" in params and not is_quantized(params["lm_head"]):
+        out["lm_head"] = quantize_int8(params["lm_head"])
+    return out
+
+
+def quantize_int8_host(w) -> dict:
+    """Numpy twin of :func:`quantize_int8` for checkpoint loading: an 8B
+    model must never exist as bf16 on the device (16.4 GiB bf16 + the
+    int8 copy would OOM a 16 GiB chip), so the loader quantizes each
+    stacked tensor on the host and ships only int8 + scales."""
+    import numpy as np
+
+    w32 = np.asarray(w, np.float32)
+    amax = np.abs(w32).max(axis=-2, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+    return {_Q: q, _S: scale}
+
+
+def quantize_rows_host(w) -> dict:
+    import numpy as np
+
+    w32 = np.asarray(w, np.float32)
+    amax = np.abs(w32).max(axis=-1, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+    return {_Q: q, _S: scale}
+
+
+def quantize_target(leaf_path: tuple) -> str | None:
+    """Which host quantizer applies to a named parameter leaf: "channel"
+    (matmul weights / lm_head), "rows" (embedding table), or None."""
+    if leaf_path == ("embed",):
+        return "rows"
+    if leaf_path == ("lm_head",):
+        return "channel"
+    if len(leaf_path) == 2 and leaf_path[0] == "layers" and leaf_path[1] in _LAYER_WEIGHTS:
+        return "channel"
+    return None
+
+
+def quantized_param_bytes(cfg) -> int:
+    """Weight footprint (bytes) of the int8-quantized tree — the number
+    ``auto_cache_config`` subtracts from HBM before sizing KV pages."""
+    from fusioninfer_tpu.models.transformer import init_params
+
+    def build():
+        return quantize_params(cfg, init_params(cfg, jax.random.key(0)))
+
+    shapes = jax.eval_shape(build)
+    import numpy as np
+
+    return sum(
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(shapes)
+    )

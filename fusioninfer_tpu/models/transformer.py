@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from fusioninfer_tpu.models.config import ModelConfig
+from fusioninfer_tpu.models.quantization import embed_lookup, maybe_dequantize_tree
 
 Params = dict[str, Any]
 
@@ -158,6 +159,8 @@ def qkv_proj(
     """
     B, S, _ = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    # invariant: callers (layer_forward / the model_runner scan bodies)
+    # maybe_dequantize_tree the layer once at block entry
     h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
     q = (h @ layer["wq"]).reshape(B, S, H, Hd)
     k = (h @ layer["wk"]).reshape(B, S, KV, Hd)
@@ -173,7 +176,8 @@ def qkv_proj(
 def mlp_block(cfg: ModelConfig, layer: Params, x: jax.Array) -> jax.Array:
     """Pre-norm + FFN (dense SwiGLU or MoE), shared by every path.
 
-    x: [B, S, D] → [B, S, D] (residual NOT added)."""
+    x: [B, S, D] → [B, S, D] (residual NOT added).  Callers dequantize
+    the layer tree once at block entry (see qkv_proj invariant)."""
     B, S, D = x.shape
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
     if cfg.is_moe:
@@ -206,6 +210,7 @@ def layer_forward(
     """
     B, S, D = x.shape
 
+    layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
     q, k, v = qkv_proj(cfg, layer, x, positions)
 
     if kv is None:
@@ -247,9 +252,14 @@ def causal_mask(S: int, dtype=jnp.bool_) -> jax.Array:
 def lm_head(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     """Project hidden states to fp32 logits; tied embeddings fall back to
     the transposed embedding table."""
+    from fusioninfer_tpu.models.quantization import dequantize, is_quantized
+
     head = params.get("lm_head")
     if head is None:
-        head = params["embed"].T
+        embed = params["embed"]
+        head = (dequantize(embed, cfg.jax_dtype) if is_quantized(embed) else embed).T
+    elif is_quantized(head):
+        head = dequantize(head, cfg.jax_dtype)
     return (x @ head).astype(jnp.float32)
 
 
@@ -261,7 +271,7 @@ def forward(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
     layer weights.
     """
     B, S = tokens.shape
-    x = params["embed"][tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
 
     def body(x, layer):

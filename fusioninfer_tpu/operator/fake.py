@@ -34,7 +34,22 @@ class FakeK8s(K8sClient):
         self._uid = itertools.count(1)
         self.actions: list[tuple[str, str, str]] = []  # (verb, kind, name)
         self._watchers: list["queue.Queue[tuple[str, dict]]"] = []
-        self._watches_closed = False
+        # tokens accepted by the fake TokenReview authenticator, and the
+        # subset additionally authorized (SubjectAccessReview) to scrape
+        # /metrics; register in both for a successful scrape
+        self.valid_tokens: set[str] = set()
+        self.metrics_reader_tokens: set[str] = set()
+
+    def token_review(self, token: str) -> bool:
+        """Fake authentication.k8s.io/v1 TokenReview: authenticated iff the
+        test registered the token in ``valid_tokens``."""
+        self.actions.append(("tokenreview", "TokenReview", "-"))
+        return token in self.valid_tokens
+
+    def metrics_access_review(self, token: str) -> bool:
+        """Fake authn+authz: authenticated AND bound to metrics-reader."""
+        self.actions.append(("accessreview", "SubjectAccessReview", "-"))
+        return token in self.valid_tokens and token in self.metrics_reader_tokens
 
     # -- watch stream (apiserver watch equivalent) --
 
@@ -45,12 +60,14 @@ class FakeK8s(K8sClient):
     def watch(self, kind: str, namespace: str,
               resource_version: str = "") -> Iterator[tuple[str, dict]]:
         """Blocking event stream of (ADDED|MODIFIED|DELETED, object) for
-        ``kind`` — what the manager's watch threads consume.  Terminates
-        when :meth:`close_watches` is called (manager shutdown)."""
+        ``kind`` — what the manager's watch threads consume.  The current
+        stream terminates when :meth:`close_watches` is called (manager
+        shutdown); like a real apiserver, later watches connect fine —
+        one manager stopping must not poison a SHARED fake for the other
+        manager in leader-election tests (that latch starved the new
+        leader into a list-resync busy spin)."""
         q: "queue.Queue[tuple[str, dict]]" = queue.Queue()
         with self._lock:
-            if self._watches_closed:
-                return  # shut down: a late (re)connecting watcher must not block
             self._watchers.append(q)
         try:
             while True:
@@ -68,8 +85,10 @@ class FakeK8s(K8sClient):
                     self._watchers.remove(q)
 
     def close_watches(self) -> None:
+        """End every OPEN stream (each consumer's watch generator returns,
+        its manager loop then re-checks its own stop flag).  Not a latch:
+        new watches connect normally afterwards."""
         with self._lock:
-            self._watches_closed = True
             watchers = list(self._watchers)
         for q in watchers:
             q.put(("__CLOSE__", {}))

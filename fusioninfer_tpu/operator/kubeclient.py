@@ -166,6 +166,49 @@ class KubeClient(K8sClient):
         except NotFound:
             raise NotFound(kind, namespace, name) from None
 
+    def token_review(self, token: str) -> bool:
+        """Authenticate a bearer token via the cluster's TokenReview API —
+        the authn half of the reference's metrics FilterProvider
+        (``cmd/main.go:138-150``).  Cluster-scoped resource, so no
+        namespace in the path."""
+        return bool(self._token_review_status(token).get("authenticated"))
+
+    def _token_review_status(self, token: str) -> dict:
+        body = {
+            "apiVersion": "authentication.k8s.io/v1",
+            "kind": "TokenReview",
+            "spec": {"token": token},
+        }
+        resp = self._json(
+            "POST", "/apis/authentication.k8s.io/v1/tokenreviews", body=body
+        )
+        return resp.get("status") or {}
+
+    def metrics_access_review(self, token: str) -> bool:
+        """Full authn + authz for a metrics scrape: TokenReview, then a
+        SubjectAccessReview that the authenticated user may ``get`` the
+        ``/metrics`` nonResourceURL — the check the metrics-reader
+        ClusterRole grants.  Mirrors the reference's FilterProvider,
+        which authorizes as well as authenticates (a bare TokenReview
+        would let ANY pod's service-account token scrape)."""
+        status = self._token_review_status(token)
+        if not status.get("authenticated"):
+            return False
+        user = (status.get("user") or {})
+        body = {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user.get("username", ""),
+                "groups": user.get("groups") or [],
+                "nonResourceAttributes": {"path": "/metrics", "verb": "get"},
+            },
+        }
+        resp = self._json(
+            "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews", body=body
+        )
+        return bool((resp.get("status") or {}).get("allowed"))
+
     # -- watch --
 
     def watch(self, kind: str, namespace: str, resource_version: str = "",

@@ -12,8 +12,10 @@ served on :8081 like the reference's probe endpoints.
 
 from __future__ import annotations
 
+import hmac
 import http.server
 import logging
+import os
 import queue
 import threading
 import time
@@ -45,6 +47,8 @@ LOADER_OWNED_KINDS = ["Job"]
 
 REQUEUE_DELAY_S = 5.0
 RESYNC_PERIOD_S = 300.0
+TOKEN_CACHE_TTL_S = 60.0  # TokenReview verdicts cached per scrape token
+TOKEN_CACHE_MAX = 1024  # hard cap; oldest-expiry entries evicted beyond it
 
 
 class WorkQueue:
@@ -123,16 +127,32 @@ class Manager:
                  default_queue: str | None = None,
                  leader_elect: bool = False,
                  leader_identity: str | None = None,
-                 leader_election_config=None):
+                 leader_election_config=None,
+                 metrics_auth: str = "none"):
         """``leader_elect``: active/standby HA via a coordination.k8s.io
         Lease (the reference's ``--leader-elect``, cmd/main.go:80-82):
         controllers start only on acquiring the lease; losing it stops
         the manager (``leadership_lost``) so a supervisor can restart it
-        as a standby, mirroring controller-runtime's exit-on-loss."""
+        as a standby, mirroring controller-runtime's exit-on-loss.
+
+        ``metrics_auth``: ``"token"`` requires a bearer token on the
+        metrics endpoint, validated through the cluster's TokenReview API
+        (the reference serves metrics behind controller-runtime's
+        authn/authz FilterProvider, ``cmd/main.go:138-150``); the
+        ``FUSIONINFER_METRICS_TOKEN`` env var provides a static-token
+        mode for clusterless setups.  ``"none"`` serves plain (library /
+        test default)."""
+        if metrics_auth not in ("none", "token"):
+            raise ValueError(f"metrics_auth must be 'none' or 'token', got {metrics_auth!r}")
         self.client = client
         self.namespace = namespace
         self.probe_port = probe_port
         self.metrics_port = metrics_port
+        self.metrics_auth = metrics_auth
+        # TokenReview verdict cache: token -> (authenticated, expiry);
+        # guarded — ThreadingHTTPServer handlers race on it
+        self._token_cache: dict[str, tuple[bool, float]] = {}
+        self._token_cache_lock = threading.Lock()
         self.reconciler = InferenceServiceReconciler(client, default_queue=default_queue)
         self.loader_reconciler = ModelLoaderReconciler(client)
         self.workqueue = WorkQueue()  # keys: (kind, namespace, name)
@@ -243,12 +263,58 @@ class Manager:
         threading.Thread(target=server.serve_forever, daemon=True).start()
         self._probe_server = server
 
+    def _authorize_metrics(self, auth_header: str | None) -> bool:
+        """Bearer-token check for the metrics endpoint (fail closed)."""
+        if self.metrics_auth == "none":
+            return True
+        if not auth_header or not auth_header.startswith("Bearer "):
+            return False
+        token = auth_header[len("Bearer "):].strip()
+        if not token:
+            return False
+        static = os.environ.get("FUSIONINFER_METRICS_TOKEN")
+        if static:
+            return hmac.compare_digest(token, static)
+        now = time.monotonic()
+        with self._token_cache_lock:
+            cached = self._token_cache.get(token)
+        if cached and cached[1] > now:
+            return cached[0]
+        # authn + authz, like the reference's FilterProvider: prefer the
+        # client's combined TokenReview→SubjectAccessReview check; a client
+        # with only TokenReview authenticates but cannot authorize, so it
+        # is accepted only as a degraded fallback
+        review = getattr(self.client, "metrics_access_review", None)
+        if review is None:
+            review = getattr(self.client, "token_review", None)
+        if review is None:
+            return False  # no authenticator available: deny, never serve open
+        try:
+            ok = bool(review(token))
+        except Exception as e:
+            logger.warning("token review failed (%s); denying metrics scrape", e)
+            return False
+        with self._token_cache_lock:
+            if len(self._token_cache) >= TOKEN_CACHE_MAX:
+                # bound memory under a unique-token flood: entries within
+                # TTL are all unexpired, so evict oldest-expiry half
+                keep = sorted(self._token_cache.items(), key=lambda kv: kv[1][1])
+                self._token_cache = dict(keep[TOKEN_CACHE_MAX // 2:])
+            self._token_cache[token] = (ok, now + TOKEN_CACHE_TTL_S)
+        return ok
+
     def _serve_metrics(self) -> None:
         mgr = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
                 if self.path == "/metrics":
+                    if not mgr._authorize_metrics(self.headers.get("Authorization")):
+                        self.send_response(401)
+                        self.send_header("WWW-Authenticate", "Bearer")
+                        self.end_headers()
+                        self.wfile.write(b"unauthorized")
+                        return
                     body = mgr.metrics.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")

@@ -43,6 +43,20 @@ def manager_role() -> dict:
         "metadata": {"name": "manager-role"},
         "rules": [
             {
+                # authenticate metrics scrapers (reference: metrics authn
+                # FilterProvider needs tokenreviews create, cmd/main.go:138-150)
+                "apiGroups": ["authentication.k8s.io"],
+                "resources": ["tokenreviews"],
+                "verbs": ["create"],
+            },
+            {
+                # authorize them: SubjectAccessReview against the
+                # metrics-reader grant (the authz half of the FilterProvider)
+                "apiGroups": ["authorization.k8s.io"],
+                "resources": ["subjectaccessreviews"],
+                "verbs": ["create"],
+            },
+            {
                 "apiGroups": [GROUP],
                 "resources": [PLURAL],
                 "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"],
@@ -162,6 +176,7 @@ def manager_deployment() -> dict:
                             "command": [
                                 "python", "-m", "fusioninfer_tpu.cli",
                                 "controller", "run", "--leader-elect",
+                                "--metrics-auth=token",
                             ],
                             "env": [
                                 {

@@ -14,6 +14,7 @@ from __future__ import annotations
 import yaml
 
 from fusioninfer_tpu.api.types import InferenceService, Role, RoutingStrategy
+from fusioninfer_tpu.router.epp_schema import validate_epp_config
 from fusioninfer_tpu.scheduling.podgroup import is_pd_disaggregated
 from fusioninfer_tpu.workload.labels import LABEL_COMPONENT_TYPE
 
@@ -115,4 +116,8 @@ def generate_epp_config(svc: InferenceService, role: Role) -> str:
             cfg = _pd_config()
     else:
         cfg = _single_scorer_config(*_SCORER_FOR[strategy])
-    return yaml.safe_dump(cfg, sort_keys=False)
+    out = yaml.safe_dump(cfg, sort_keys=False)
+    # a key the EPP image would silently ignore must fail at render time,
+    # not no-op in production (see epp_schema for the schema provenance)
+    validate_epp_config(out)
+    return out

@@ -100,3 +100,32 @@ def test_orbax_restore_sharded(tmp_path, params):
     np.testing.assert_array_equal(
         np.asarray(wq, np.float32), np.asarray(params["layers"]["wq"], np.float32)
     )
+
+
+def test_hf_load_quantized_host_side(tmp_path, params):
+    """int8 loading quantizes each tensor on the HOST and ships int8 —
+    the 8B one-chip path must never materialize bf16 weights on device."""
+    from fusioninfer_tpu.models.quantization import is_quantized
+
+    save_hf_checkpoint(str(tmp_path), CFG, params)
+    qcfg = dataclasses.replace(CFG, quantization="int8")
+    cfg2, qparams = load_hf_checkpoint(str(tmp_path), cfg=qcfg)
+    assert is_quantized(qparams["embed"])
+    assert is_quantized(qparams["layers"]["wq"])
+    assert is_quantized(qparams["lm_head"])
+    assert qparams["layers"]["wq"]["_q8"].dtype == jnp.int8
+    # norms stay high-precision
+    assert not is_quantized(qparams["layers"]["attn_norm"])
+    # forward still tracks the bf16 reference at the argmax level
+    tokens = jnp.asarray([[1, 2, 3, 4, 5]])
+    ref = np.asarray(forward(CFG, params, tokens))
+    got = np.asarray(forward(dataclasses.replace(cfg2, attn_impl="reference"),
+                             qparams, tokens))
+    assert (ref.argmax(-1) == got.argmax(-1)).mean() >= 0.8
+
+
+def test_hf_load_quantized_rejects_shardings(tmp_path, params):
+    save_hf_checkpoint(str(tmp_path), CFG, params)
+    qcfg = dataclasses.replace(CFG, quantization="int8")
+    with pytest.raises(ValueError, match="single-device"):
+        load_hf_checkpoint(str(tmp_path), cfg=qcfg, shardings={"anything": None})

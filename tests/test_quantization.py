@@ -1,0 +1,134 @@
+"""Weight-only int8 quantization: numerics, the Qwen3-8B one-chip fit
+story (VERDICT r2 ask #9 / BASELINE config 2), and engine integration."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import (
+    CacheConfig,
+    auto_cache_config,
+    model_param_bytes,
+)
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.models.config import get_preset
+from fusioninfer_tpu.models.quantization import (
+    dequantize,
+    embed_lookup,
+    is_quantized,
+    quantize_int8,
+    quantize_params,
+    quantize_rows,
+)
+from fusioninfer_tpu.models.transformer import forward, init_params
+
+V5E_HBM = 16 * 2**30  # one v5e chip
+
+
+class TestNumerics:
+    def test_roundtrip_error_small(self):
+        w = jax.random.normal(jax.random.key(0), (64, 128), jnp.float32)
+        deq = dequantize(quantize_int8(w), jnp.float32)
+        # symmetric per-channel int8: worst-case step is amax/127
+        err = np.abs(np.asarray(deq) - np.asarray(w))
+        bound = np.abs(np.asarray(w)).max(axis=0, keepdims=True) / 127
+        assert (err <= bound + 1e-6).all()
+
+    def test_row_quant_gather(self):
+        emb = jax.random.normal(jax.random.key(1), (32, 16), jnp.float32)
+        q = quantize_rows(emb)
+        toks = jnp.asarray([[3, 7, 31]])
+        got = embed_lookup(q, toks, jnp.float32)
+        want = embed_lookup(emb, toks, jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0.05)
+
+    def test_quantize_params_idempotent_and_typed(self):
+        cfg = get_preset("qwen3-tiny")
+        params = init_params(cfg, jax.random.key(0))
+        q = quantize_params(cfg, params)
+        assert is_quantized(q["layers"]["wq"]) and is_quantized(q["embed"])
+        assert q["layers"]["wq"]["_q8"].dtype == jnp.int8
+        # norms untouched
+        assert q["layers"]["attn_norm"] is params["layers"]["attn_norm"]
+        # idempotent
+        q2 = quantize_params(cfg, q)
+        assert q2["layers"]["wq"] is q["layers"]["wq"]
+
+    def test_forward_close_to_bf16(self):
+        cfg = dataclasses.replace(get_preset("qwen3-tiny"), dtype="float32")
+        params = init_params(cfg, jax.random.key(2))
+        toks = jnp.asarray([[5, 9, 2, 14, 3]])
+        ref = forward(cfg, params, toks)
+        got = forward(cfg, quantize_params(cfg, params), toks)
+        ref, got = np.asarray(ref), np.asarray(got)
+        # int8 weight error compounds through layers; argmax agreement is
+        # the serving-relevant bar
+        agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+        assert agree >= 0.8, agree
+
+
+class TestQwen8BFit:
+    """The BASELINE config-2 decision, as arithmetic the suite enforces:
+    bf16 Qwen3-8B does NOT fit one 16 GiB v5e chip; int8 does, with KV
+    headroom for real serving shapes."""
+
+    def test_bf16_8b_does_not_fit_one_chip(self):
+        cfg = get_preset("qwen3-8b")
+        assert model_param_bytes(cfg) > V5E_HBM * 0.85
+        with pytest.raises(ValueError, match="fit|pages"):
+            auto_cache_config(
+                cfg, page_size=128, max_model_len=2048, max_batch_size=8,
+                hbm_bytes=V5E_HBM,
+            )
+
+    def test_int8_8b_fits_with_kv_headroom(self):
+        cfg = dataclasses.replace(get_preset("qwen3-8b"), quantization="int8")
+        pbytes = model_param_bytes(cfg)
+        assert pbytes < 9 * 2**30, f"int8 8B should be ~8.3 GiB, got {pbytes/2**30:.1f}"
+        cache = auto_cache_config(
+            cfg, page_size=128, max_model_len=2048, max_batch_size=8,
+            hbm_bytes=V5E_HBM,
+        )
+        # demand: 16 pages/seq × 8 seqs + trash page
+        assert cache.n_pages >= 16 * 8 + 1
+        assert cache.max_pages_per_seq == 16
+
+    def test_llama70b_requires_tp_even_int8(self):
+        """70B stays a multi-chip model (BASELINE configs 4/5): int8 halves
+        it to ~35 GiB, still far over one chip — the tested sharding
+        prerequisite for the v5e-16 rung."""
+        cfg = dataclasses.replace(get_preset("llama3-70b"), quantization="int8")
+        assert model_param_bytes(cfg) > 2 * V5E_HBM
+
+
+class TestEngineInt8:
+    CFG = dataclasses.replace(get_preset("qwen3-tiny"), quantization="int8")
+    CACHE = CacheConfig(n_pages=33, page_size=8, max_pages_per_seq=8)
+
+    def test_greedy_generation_runs_and_is_deterministic(self):
+        def run():
+            engine = NativeEngine(self.CFG, cache_cfg=self.CACHE, max_batch_size=2, seed=0)
+            engine.add_request(Request("r", [3, 1, 4, 1, 5], SamplingParams(
+                temperature=0.0, max_tokens=6)))
+            out = {}
+            for _ in range(50):
+                if not engine.has_work():
+                    break
+                for o in engine.step():
+                    out.setdefault(o.request_id, []).append(o.token)
+            return out["r"]
+
+        first = run()
+        assert len(first) == 6
+        assert first == run()
+
+    def test_int8_rejects_mesh(self):
+        from fusioninfer_tpu.parallel import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        with pytest.raises(ValueError, match="single-device"):
+            NativeEngine(self.CFG, cache_cfg=self.CACHE, mesh=mesh)

@@ -201,3 +201,71 @@ class TestHTTPRoute:
         svc = svc_of(router_role(), worker_role())
         route = build_httproute(svc, svc.spec.roles[0])
         assert route["spec"]["rules"][0]["backendRefs"][0]["kind"] == "InferencePool"
+
+
+class TestEPPSchemaPin:
+    """Every generated config must validate against the vendored EPP
+    v1.2 plugin parameter schema (epp_schema.py documents the
+    blockSize-vs-hashBlockSize resolution; the reference's own non-PD
+    path ships a key upstream ignores, strategy.go:57)."""
+
+    def test_all_strategies_validate(self):
+        from fusioninfer_tpu.router.epp_schema import validate_epp_config
+
+        for strategy in RoutingStrategy:
+            if strategy == RoutingStrategy.PD_DISAGGREGATION:
+                svc = svc_of(
+                    router_role(strategy),
+                    worker_role("p", ComponentType.PREFILLER),
+                    worker_role("d", ComponentType.DECODER),
+                )
+            else:
+                svc = svc_of(router_role(strategy), worker_role())
+            cfg = validate_epp_config(generate_epp_config(svc, svc.spec.roles[0]))
+            assert cfg["kind"] == "EndpointPickerConfig"
+
+    def test_prefix_cache_emits_hash_block_size(self):
+        svc = svc_of(router_role(RoutingStrategy.PREFIX_CACHE), worker_role())
+        out = generate_epp_config(svc, svc.spec.roles[0])
+        assert "hashBlockSize" in out
+        assert "blockSize: " not in out.replace("hashBlockSize", "")
+
+    def test_bad_key_fails_at_render_time(self):
+        import pytest as _pytest
+
+        from fusioninfer_tpu.router.epp_schema import (
+            EPPSchemaError,
+            validate_epp_config,
+        )
+
+        bad = """
+apiVersion: inference.networking.x-k8s.io/v1alpha1
+kind: EndpointPickerConfig
+plugins:
+- type: prefix-cache-scorer
+  parameters:
+    blockSize: 5
+"""
+        with _pytest.raises(EPPSchemaError, match="hashBlockSize"):
+            validate_epp_config(bad)
+
+    def test_undeclared_profile_ref_fails(self):
+        import pytest as _pytest
+
+        from fusioninfer_tpu.router.epp_schema import (
+            EPPSchemaError,
+            validate_epp_config,
+        )
+
+        bad = """
+apiVersion: inference.networking.x-k8s.io/v1alpha1
+kind: EndpointPickerConfig
+plugins:
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: prefix-cache-scorer
+"""
+        with _pytest.raises(EPPSchemaError, match="undeclared"):
+            validate_epp_config(bad)
