@@ -210,15 +210,16 @@ def main() -> None:
             cache_cfg = CacheConfig(n_pages=32 * 8 + 1, page_size=128,
                                     max_pages_per_seq=8)
             prefix_len, warmup, steps = 128, 5, 64
-            # keep the longitudinal default key stable ("qwen3_1.7b" since
-            # r2); sanitize only explicit BENCH_MODEL overrides
-            if model_env:
+            # longitudinal keys: the default config keeps its r2 literal
+            # even when BENCH_MODEL names it explicitly (same measurement
+            # must never fork series); other configs get sanitized names
+            if base_cfg.name == "qwen3-1.7b" and base_cfg.quantization == "none":
+                record["metric"] = "decode_throughput_qwen3_1.7b"
+            else:
                 safe = "".join(c if c.isalnum() else "_" for c in base_cfg.name)
                 record["metric"] = f"decode_throughput_{safe}" + (
                     "_int8" if base_cfg.quantization == "int8" else ""
                 )
-            else:
-                record["metric"] = "decode_throughput_qwen3_1.7b"
         else:
             base_cfg, batch = get_preset("qwen3-tiny"), 8
             cache_cfg = CacheConfig(n_pages=33, page_size=64, max_pages_per_seq=4)

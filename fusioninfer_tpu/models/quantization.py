@@ -46,6 +46,17 @@ def is_quantized(leaf: Any) -> bool:
     return isinstance(leaf, dict) and _Q in leaf and _S in leaf
 
 
+def _quantize(w, axis: int, xp) -> dict:
+    """The one symmetric-int8 algorithm, parameterized by reduction axis
+    and array library (``jnp`` for traced/device trees, ``np`` for the
+    loader's host path — same math, so the twins cannot drift)."""
+    w32 = xp.asarray(w).astype(xp.float32) if xp is not jnp else w.astype(jnp.float32)
+    amax = xp.max(xp.abs(w32), axis=axis, keepdims=True)
+    scale = xp.where(amax > 0, amax / 127.0, xp.float32(1.0))
+    q = xp.clip(xp.round(w32 / scale), -127, 127).astype(xp.int8)
+    return {_Q: q, _S: scale}
+
+
 def quantize_int8(w: jax.Array) -> dict:
     """Symmetric per-output-channel int8 over the contraction axis.
 
@@ -53,21 +64,13 @@ def quantize_int8(w: jax.Array) -> dict:
     ``[..., 1, out]``.  (For row-major tables like embeddings, transpose
     semantics are handled by the caller via :func:`quantize_rows`.)
     """
-    w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return {_Q: q, _S: scale}
+    return _quantize(w, -2, jnp)
 
 
 def quantize_rows(w: jax.Array) -> dict:
     """Per-row int8 for lookup tables (``[V, D]`` embeddings): scale
     ``[V, 1]`` so a token gather reads one row + one scalar."""
-    w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=-1, keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return {_Q: q, _S: scale}
+    return _quantize(w, -1, jnp)
 
 
 def dequantize(leaf: dict, dtype=jnp.bfloat16) -> jax.Array:
@@ -118,21 +121,13 @@ def quantize_int8_host(w) -> dict:
     stacked tensor on the host and ships only int8 + scales."""
     import numpy as np
 
-    w32 = np.asarray(w, np.float32)
-    amax = np.abs(w32).max(axis=-2, keepdims=True)
-    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
-    q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
-    return {_Q: q, _S: scale}
+    return _quantize(w, -2, np)
 
 
 def quantize_rows_host(w) -> dict:
     import numpy as np
 
-    w32 = np.asarray(w, np.float32)
-    amax = np.abs(w32).max(axis=-1, keepdims=True)
-    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
-    q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
-    return {_Q: q, _S: scale}
+    return _quantize(w, -1, np)
 
 
 def quantize_target(leaf_path: tuple) -> str | None:

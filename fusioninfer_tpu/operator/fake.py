@@ -14,6 +14,7 @@ import copy
 import itertools
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 from fusioninfer_tpu.operator.client import (
@@ -57,21 +58,32 @@ class FakeK8s(K8sClient):
         for q in list(self._watchers):
             q.put((etype, copy.deepcopy(obj)))
 
-    def watch(self, kind: str, namespace: str,
-              resource_version: str = "") -> Iterator[tuple[str, dict]]:
+    def watch(self, kind: str, namespace: str, resource_version: str = "",
+              timeout_seconds: float = 30.0) -> Iterator[tuple[str, dict]]:
         """Blocking event stream of (ADDED|MODIFIED|DELETED, object) for
-        ``kind`` — what the manager's watch threads consume.  The current
-        stream terminates when :meth:`close_watches` is called (manager
-        shutdown); like a real apiserver, later watches connect fine —
-        one manager stopping must not poison a SHARED fake for the other
-        manager in leader-election tests (that latch starved the new
-        leader into a list-resync busy spin)."""
+        ``kind`` — what the manager's watch threads consume.  Real
+        apiserver semantics on both ends of its lifetime:
+
+        * :meth:`close_watches` ends *current* streams only; later
+          watches connect fine — one manager stopping must not poison a
+          SHARED fake for the other manager in leader-election tests
+          (a permanent closed-latch starved the new leader into a
+          list-resync busy spin), and
+        * every stream ends by itself after ``timeout_seconds`` (the
+          server-side watch timeout), so a watcher that connected in the
+          close/stop race window expires instead of blocking forever —
+          its manager loop then re-checks its own stop flag and exits.
+        """
         q: "queue.Queue[tuple[str, dict]]" = queue.Queue()
         with self._lock:
             self._watchers.append(q)
+        deadline = time.monotonic() + timeout_seconds
         try:
             while True:
-                etype, obj = q.get()
+                try:
+                    etype, obj = q.get(timeout=max(0.0, deadline - time.monotonic()))
+                except queue.Empty:
+                    return  # server-side watch timeout; clients re-watch
                 if etype == "__CLOSE__":
                     return
                 if obj.get("kind") != kind:

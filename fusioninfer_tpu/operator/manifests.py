@@ -224,7 +224,15 @@ def service_monitor() -> dict:
             "labels": {"control-plane": "controller-manager"},
         },
         "spec": {
-            "endpoints": [{"port": "metrics", "path": "/metrics"}],
+            "endpoints": [{
+                "port": "metrics",
+                "path": "/metrics",
+                # the manager ships with --metrics-auth=token: the scraper
+                # must present its SA token (and be bound to metrics-reader
+                # — see rbac/metrics_reader_role_binding.yaml)
+                "bearerTokenFile":
+                    "/var/run/secrets/kubernetes.io/serviceaccount/token",
+            }],
             "selector": {"matchLabels": {"control-plane": "controller-manager"}},
         },
     }
@@ -270,6 +278,79 @@ def _metrics_service() -> dict:
     }
 
 
+def external_crd(group: str, version: str, kind: str, plural: str,
+                 singular: str, short_names: list[str] | None = None,
+                 served_versions: list[str] | None = None) -> dict:
+    """Minimal structural CRD for an EXTERNAL kind the operator creates
+    (LWS, PodGroup, InferencePool, HTTPRoute, Gateway).
+
+    The reference vendors the upstream projects' full generated schemas
+    (``config/crd/external/``) so envtest can accept the objects the
+    controller renders; these serve the same purpose for the in-repo
+    integration tier and any cluster lacking the upstream installs, but
+    are deliberately permissive — ``x-kubernetes-preserve-unknown-fields``
+    on spec/status — because the upstream controllers own validation.
+    """
+    versions = []
+    for i, v in enumerate(served_versions or [version]):
+        versions.append({
+            "name": v,
+            "served": True,
+            "storage": i == 0,
+            "schema": {
+                "openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {"type": "object",
+                                 "x-kubernetes-preserve-unknown-fields": True},
+                        "status": {"type": "object",
+                                   "x-kubernetes-preserve-unknown-fields": True},
+                    },
+                }
+            },
+            "subresources": {"status": {}},
+        })
+    meta: dict = {"name": f"{plural}.{group}"}
+    names: dict = {"kind": kind, "plural": plural, "singular": singular,
+                   "listKind": f"{kind}List"}
+    if short_names:
+        names["shortNames"] = short_names
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": meta,
+        "spec": {
+            "group": group,
+            "names": names,
+            "scope": "Namespaced",
+            "versions": versions,
+        },
+    }
+
+
+EXTERNAL_CRDS: dict[str, dict] = {
+    "lws.yaml": external_crd(
+        "leaderworkerset.x-k8s.io", "v1", "LeaderWorkerSet",
+        "leaderworkersets", "leaderworkerset", short_names=["lws"],
+    ),
+    "podgroup.yaml": external_crd(
+        "scheduling.volcano.sh", "v1beta1", "PodGroup", "podgroups", "podgroup",
+        short_names=["pg"],
+    ),
+    "inferencepool.yaml": external_crd(
+        "inference.networking.k8s.io", "v1", "InferencePool",
+        "inferencepools", "inferencepool",
+    ),
+    "httproute.yaml": external_crd(
+        "gateway.networking.k8s.io", "v1", "HTTPRoute", "httproutes",
+        "httproute",
+    ),
+    "gateway.yaml": external_crd(
+        "gateway.networking.k8s.io", "v1", "Gateway", "gateways", "gateway",
+    ),
+}
+
+
 def config_tree() -> dict[str, Any]:
     """path → manifest-dict | list-of-dicts | raw-str for the whole tree."""
     kust = lambda resources, **extra: {"resources": resources, **extra}  # noqa: E731
@@ -280,6 +361,9 @@ def config_tree() -> dict[str, Any]:
             "bases/fusioninfer.io_inferenceservices.yaml",
             "bases/fusioninfer.io_modelloaders.yaml",
         ]),
+        # external kinds the operator creates, for integration tiers /
+        # clusters without the upstream installs (reference: crd/external/)
+        **{f"crd/external/{name}": crd for name, crd in EXTERNAL_CRDS.items()},
         "rbac/role.yaml": manager_role(),
         "rbac/service_account.yaml": {
             "apiVersion": "v1",
@@ -334,6 +418,24 @@ def config_tree() -> dict[str, Any]:
             ],
         },
         "rbac/metrics_reader_role.yaml": metrics_reader_role(),
+        # bind the monitoring stack's scraper SA to metrics-reader so its
+        # SubjectAccessReview passes (kube-prometheus default SA; adjust
+        # the subject for other stacks)
+        "rbac/metrics_reader_role_binding.yaml": {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "metrics-reader-binding"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "metrics-reader",
+            },
+            "subjects": [{
+                "kind": "ServiceAccount",
+                "name": "prometheus-k8s",
+                "namespace": "monitoring",
+            }],
+        },
         "rbac/inferenceservice_admin_role.yaml": _aggregate_role(
             "admin", ["create", "delete", "get", "list", "patch", "update", "watch"]
         ),
@@ -350,6 +452,7 @@ def config_tree() -> dict[str, Any]:
             "leader_election_role.yaml",
             "leader_election_role_binding.yaml",
             "metrics_reader_role.yaml",
+            "metrics_reader_role_binding.yaml",
             "inferenceservice_admin_role.yaml",
             "inferenceservice_editor_role.yaml",
             "inferenceservice_viewer_role.yaml",
@@ -397,6 +500,10 @@ def render_installer() -> list[dict]:
     docs: list[dict] = []
     for rel, content in config_tree().items():
         if "kustomization" in rel or rel.startswith(("prometheus/", "network-policy/")):
+            continue
+        if rel.startswith("crd/external/"):
+            # integration-tier schemas; the upstream projects own and
+            # install these CRDs in real clusters
             continue
         doc = yaml.safe_load(yaml.safe_dump(content))  # deep copy
         kind = doc.get("kind")
