@@ -138,3 +138,14 @@ class FusionInferClient:
         self.transport = transport if transport is not None else KubeClient()
         self.inference_services = InferenceServiceApi(self.transport)
         self.model_loaders = ModelLoaderApi(self.transport)
+
+    def informers(self, namespace: str = "default",
+                  resync_period: float = 300.0):
+        """A :class:`~fusioninfer_tpu.informers.SharedInformerFactory`
+        over this client's transport (the reference's generated
+        ``client-go/informers`` + ``listers`` surface)."""
+        from fusioninfer_tpu.informers import SharedInformerFactory
+
+        return SharedInformerFactory(
+            self.transport, namespace=namespace, resync_period=resync_period
+        )

@@ -135,7 +135,7 @@ class _Handler(BaseHTTPRequestHandler):
             except NotFound as e:
                 return self._send_error(404, "NotFound", str(e))
         if query.get("watch") == "1":
-            return self._watch(kind, ns)
+            return self._watch(kind, ns, query.get("resourceVersion", ""))
         selector = None
         if "labelSelector" in query:
             selector = dict(
@@ -148,7 +148,7 @@ class _Handler(BaseHTTPRequestHandler):
             "items": items,
         })
 
-    def _watch(self, kind: str, ns: str) -> None:
+    def _watch(self, kind: str, ns: str, resource_version: str = "") -> None:
         """Chunked JSON-lines event stream (what a real apiserver sends
         with Transfer-Encoding: chunked)."""
         self.send_response(200)
@@ -161,7 +161,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
         try:
-            for etype, obj in self._api.fake.watch(kind, ns):
+            for etype, obj in self._api.fake.watch(
+                    kind, ns, resource_version=resource_version):
                 line = json.dumps({"type": etype, "object": obj}).encode() + b"\n"
                 write_chunk(line)
         except (BrokenPipeError, ConnectionResetError):

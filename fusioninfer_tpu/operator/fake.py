@@ -77,8 +77,25 @@ class FakeK8s(K8sClient):
         q: "queue.Queue[tuple[str, dict]]" = queue.Queue()
         with self._lock:
             self._watchers.append(q)
+            # resourceVersion continuation (the apiserver contract that
+            # closes the list→watch race): replay existing objects newer
+            # than the caller's rv as synthetic ADDED events.  Snapshot
+            # under the lock AFTER registering, so nothing can fall in
+            # the gap; consumers dedupe by (key, resourceVersion).
+            try:
+                since = int(resource_version) if resource_version else 0
+            except ValueError:
+                since = 0
+            replay = [
+                copy.deepcopy(obj)
+                for (k, ns, _), obj in self._objects.items()
+                if k == kind and ns == namespace
+                and int((obj.get("metadata") or {}).get("resourceVersion", 0)) > since
+            ]
         deadline = time.monotonic() + timeout_seconds
         try:
+            for obj in replay:
+                yield "ADDED", obj
             while True:
                 try:
                     etype, obj = q.get(timeout=max(0.0, deadline - time.monotonic()))

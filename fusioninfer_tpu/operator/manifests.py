@@ -279,10 +279,12 @@ def _metrics_service() -> dict:
 
 
 def external_crd(group: str, version: str, kind: str, plural: str,
-                 singular: str, short_names: list[str] | None = None,
-                 served_versions: list[str] | None = None) -> dict:
+                 singular: str, short_names: list[str] | None = None) -> dict:
     """Minimal structural CRD for an EXTERNAL kind the operator creates
-    (LWS, PodGroup, InferencePool, HTTPRoute, Gateway).
+    (LWS, PodGroup, InferencePool, HTTPRoute) or references (Gateway —
+    created by the user, named by HTTPRoute parentRefs; vendored so a
+    bare apiserver can hold the full object graph, same as the
+    reference's set).
 
     The reference vendors the upstream projects' full generated schemas
     (``config/crd/external/``) so envtest can accept the objects the
@@ -291,25 +293,23 @@ def external_crd(group: str, version: str, kind: str, plural: str,
     are deliberately permissive — ``x-kubernetes-preserve-unknown-fields``
     on spec/status — because the upstream controllers own validation.
     """
-    versions = []
-    for i, v in enumerate(served_versions or [version]):
-        versions.append({
-            "name": v,
-            "served": True,
-            "storage": i == 0,
-            "schema": {
-                "openAPIV3Schema": {
-                    "type": "object",
-                    "properties": {
-                        "spec": {"type": "object",
-                                 "x-kubernetes-preserve-unknown-fields": True},
-                        "status": {"type": "object",
-                                   "x-kubernetes-preserve-unknown-fields": True},
-                    },
-                }
-            },
-            "subresources": {"status": {}},
-        })
+    versions = [{
+        "name": version,
+        "served": True,
+        "storage": True,
+        "schema": {
+            "openAPIV3Schema": {
+                "type": "object",
+                "properties": {
+                    "spec": {"type": "object",
+                             "x-kubernetes-preserve-unknown-fields": True},
+                    "status": {"type": "object",
+                               "x-kubernetes-preserve-unknown-fields": True},
+                },
+            }
+        },
+        "subresources": {"status": {}},
+    }]
     meta: dict = {"name": f"{plural}.{group}"}
     names: dict = {"kind": kind, "plural": plural, "singular": singular,
                    "listKind": f"{kind}List"}

@@ -185,7 +185,7 @@ class TestManagerOverHTTP:
         finally:
             mgr.stop()
 
-    def test_metrics_scrape_via_wire_reviews(self, api, client, port=18301):
+    def test_metrics_scrape_via_wire_reviews(self, api, client):
         """The manager's metrics authn/authz round-trips through the HTTP
         TokenReview + SubjectAccessReview endpoints."""
         import urllib.error
@@ -193,12 +193,14 @@ class TestManagerOverHTTP:
 
         api.fake.valid_tokens.add("promtoken")
         api.fake.metrics_reader_tokens.add("promtoken")
-        mgr = Manager(client, namespace="default", probe_port=port,
-                      metrics_port=port + 1, metrics_auth="token")
+        mgr = Manager(client, namespace="default", probe_port=0,
+                      metrics_port=0, metrics_auth="token")
         mgr.start()
         try:
+            port = mgr._metrics_server.server_address[1]
+
             def scrape(tok):
-                req = urllib.request.Request(f"http://127.0.0.1:{port + 1}/metrics")
+                req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
                 if tok:
                     req.add_header("Authorization", f"Bearer {tok}")
                 try:
@@ -215,9 +217,11 @@ class TestManagerOverHTTP:
 
 class TestExternalCRDs:
     """The rendered external CRD schemas (reference: config/crd/external/)
-    cover every external kind the reconciler creates."""
+    cover every external kind the reconciler creates, plus Gateway (user-
+    created, referenced by HTTPRoute parentRefs — same set the reference
+    vendors)."""
 
-    def test_external_crds_cover_created_kinds(self):
+    def test_external_crds_cover_created_and_referenced_kinds(self):
         from fusioninfer_tpu.operator.manifests import EXTERNAL_CRDS
 
         kinds = {crd["spec"]["names"]["kind"] for crd in EXTERNAL_CRDS.values()}

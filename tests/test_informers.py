@@ -1,0 +1,180 @@
+"""Shared informer / lister ecosystem (reference: generated client-go
+informers+listers, hack/update-codegen.sh) over both transports: the
+in-memory fake and the HTTP apiserver (real chunked watch)."""
+
+import threading
+import time
+
+from fusioninfer_tpu.api.types import InferenceService
+from fusioninfer_tpu.informers import SharedInformerFactory, Store
+from fusioninfer_tpu.operator.fake import FakeK8s
+
+
+def wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def svc_dict(name, image="img", labels=None):
+    return {
+        "apiVersion": "fusioninfer.io/v1alpha1",
+        "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": "default",
+                     "labels": labels or {}},
+        "spec": {"roles": [{
+            "name": "worker", "componentType": "worker", "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "engine", "image": image}]}},
+        }]},
+    }
+
+
+class TestStore:
+    def test_put_get_remove_list(self):
+        store = Store()
+        assert store.put(svc_dict("a", labels={"x": "1"})) is None
+        prev = store.put(svc_dict("a", labels={"x": "2"}))
+        assert prev["metadata"]["labels"] == {"x": "1"}
+        assert store.get("default", "a")["metadata"]["labels"] == {"x": "2"}
+        assert store.list(label_selector={"x": "2"})
+        assert not store.list(label_selector={"x": "1"})
+        assert store.remove(svc_dict("a")) is not None
+        assert store.get("default", "a") is None
+
+    def test_reads_are_copies(self):
+        store = Store()
+        store.put(svc_dict("a"))
+        got = store.get("default", "a")
+        got["metadata"]["name"] = "mutated"
+        assert store.get("default", "a")["metadata"]["name"] == "a"
+
+
+class TestSharedInformer:
+    def test_sync_handlers_and_lister(self):
+        fake = FakeK8s()
+        fake.create(svc_dict("pre-existing"))
+
+        factory = SharedInformerFactory(fake)
+        inf = factory.inference_services()
+        events = []
+        lock = threading.Lock()
+
+        def record(kind):
+            def h(*args):
+                with lock:
+                    events.append((kind, args[-1]["metadata"]["name"]))
+            return h
+
+        inf.add_event_handler(on_add=record("add"), on_update=record("update"),
+                              on_delete=record("delete"))
+        factory.start()
+        assert factory.wait_for_cache_sync(10)
+        assert wait_for(lambda: ("add", "pre-existing") in events)
+
+        fake.create(svc_dict("later"))
+        assert wait_for(lambda: ("add", "later") in events)
+
+        live = fake.get("InferenceService", "default", "later")
+        live["spec"]["roles"][0]["template"]["spec"]["containers"][0]["image"] = "v2"
+        fake.update(live)
+        assert wait_for(lambda: ("update", "later") in events)
+
+        fake.delete("InferenceService", "default", "later")
+        assert wait_for(lambda: ("delete", "later") in events)
+
+        # lister is typed and cache-only: no new transport reads
+        n_actions = len(fake.actions)
+        got = inf.lister.get("pre-existing")
+        assert isinstance(got, InferenceService)
+        assert [s.name for s in inf.lister.list()] == ["pre-existing"]
+        assert len(fake.actions) == n_actions
+        factory.stop()
+
+    def test_update_fires_only_on_resource_version_change(self):
+        fake = FakeK8s()
+        fake.create(svc_dict("a"))
+        factory = SharedInformerFactory(fake)
+        inf = factory.inference_services()
+        updates = []
+        inf.add_event_handler(
+            on_update=lambda old, new: updates.append(new["metadata"]["resourceVersion"])
+        )
+        factory.start()
+        assert factory.wait_for_cache_sync(10)
+        time.sleep(0.3)
+        assert updates == []  # no spurious updates from watch echo
+        factory.stop()
+
+    def test_resync_refires_updates(self):
+        fake = FakeK8s()
+        fake.create(svc_dict("a"))
+        factory = SharedInformerFactory(fake, resync_period=0.3)
+        inf = factory.inference_services()
+        updates = []
+        inf.add_event_handler(on_update=lambda old, new: updates.append(1))
+
+        # force the poll path (no watch): resync relists periodically
+        class NoWatch(FakeK8s):
+            watch = None
+
+        poll = NoWatch()
+        poll.create(svc_dict("a"))
+        inf2 = SharedInformerFactory(poll, resync_period=0.2).for_kind(
+            "InferenceService")
+        re_updates = []
+        inf2.add_event_handler(on_update=lambda old, new: re_updates.append(1))
+        inf2.start()
+        assert inf2.wait_for_cache_sync(10)
+        assert wait_for(lambda: len(re_updates) >= 1, timeout=5)
+        inf2.stop()
+        factory.stop()
+
+    def test_factory_shares_informers(self):
+        fake = FakeK8s()
+        factory = SharedInformerFactory(fake)
+        assert factory.inference_services() is factory.inference_services()
+        assert factory.for_kind("ConfigMap") is factory.for_kind("ConfigMap")
+
+    def test_broken_handler_does_not_kill_stream(self):
+        fake = FakeK8s()
+        factory = SharedInformerFactory(fake)
+        inf = factory.inference_services()
+        seen = []
+
+        def boom(*a):
+            raise RuntimeError("handler bug")
+
+        inf.add_event_handler(on_add=boom)
+        inf.add_event_handler(on_add=lambda o: seen.append(o["metadata"]["name"]))
+        factory.start()
+        factory.wait_for_cache_sync(10)
+        fake.create(svc_dict("x"))
+        assert wait_for(lambda: "x" in seen)
+        factory.stop()
+
+
+class TestInformerOverHTTP:
+    def test_informer_via_rest_client_chunked_watch(self):
+        from fusioninfer_tpu.operator.apiserver import HTTPApiServer
+        from fusioninfer_tpu.operator.kubeclient import KubeClient, KubeConfig
+
+        api = HTTPApiServer(token="t").start()
+        try:
+            client = KubeClient(KubeConfig(api.url, token="t"))
+            factory = SharedInformerFactory(client)
+            inf = factory.inference_services()
+            adds = []
+            inf.add_event_handler(
+                on_add=lambda o: adds.append(o["metadata"]["name"]))
+            factory.start()
+            assert factory.wait_for_cache_sync(10)
+            api.fake.create(svc_dict("over-http"))
+            assert wait_for(lambda: "over-http" in adds)
+            assert inf.lister.get("over-http") is not None
+            factory.stop()
+        finally:
+            api.stop()

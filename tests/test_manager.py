@@ -97,10 +97,11 @@ class TestMetricsAuth:
     the metrics-reader grant) must both pass; static token for
     clusterless setups."""
 
-    def _mgr(self, fake, port, **kw):
-        m = Manager(fake, namespace="default", probe_port=port,
-                    metrics_port=port + 1, metrics_auth="token", **kw)
+    def _mgr(self, fake, **kw):
+        m = Manager(fake, namespace="default", probe_port=0,
+                    metrics_port=0, metrics_auth="token", **kw)
         m.start()
+        m.metrics_url_port = m._metrics_server.server_address[1]
         return m
 
     def _get(self, port, token=None):
@@ -113,33 +114,33 @@ class TestMetricsAuth:
         except urllib.error.HTTPError as e:
             return e.code, ""
 
-    def test_authn_authz_path(self, port=18201):
+    def test_authn_authz_path(self):
         fake = FakeK8s()
         fake.valid_tokens.add("good-token")
         fake.metrics_reader_tokens.add("good-token")
         # authenticated but NOT bound to metrics-reader: any pod's SA token
         fake.valid_tokens.add("some-pod-token")
-        mgr = self._mgr(fake, port)
+        mgr = self._mgr(fake)
         try:
-            assert self._get(port + 1)[0] == 401  # no token
-            assert self._get(port + 1, "wrong")[0] == 401
+            assert self._get(mgr.metrics_url_port)[0] == 401  # no token
+            assert self._get(mgr.metrics_url_port, "wrong")[0] == 401
             # authn alone is not enough — the reference FilterProvider
             # also authorizes; a random pod SA must not scrape
-            assert self._get(port + 1, "some-pod-token")[0] == 401
-            status, body = self._get(port + 1, "good-token")
+            assert self._get(mgr.metrics_url_port, "some-pod-token")[0] == 401
+            status, body = self._get(mgr.metrics_url_port, "good-token")
             assert status == 200 and "controller_runtime_reconcile" in body
             # verdicts are cached: a second scrape must not re-review
             n_reviews = sum(1 for a in fake.actions if a[0] == "accessreview")
-            assert self._get(port + 1, "good-token")[0] == 200
+            assert self._get(mgr.metrics_url_port, "good-token")[0] == 200
             assert sum(1 for a in fake.actions if a[0] == "accessreview") == n_reviews
         finally:
             mgr.stop()
 
-    def test_token_cache_bounded_under_unique_token_flood(self, port=18231):
+    def test_token_cache_bounded_under_unique_token_flood(self):
         from fusioninfer_tpu.operator.manager import TOKEN_CACHE_MAX
 
         fake = FakeK8s()
-        mgr = self._mgr(fake, port)
+        mgr = self._mgr(fake)
         try:
             for i in range(TOKEN_CACHE_MAX + 50):
                 assert not mgr._authorize_metrics(f"Bearer bogus-{i}")
@@ -147,28 +148,28 @@ class TestMetricsAuth:
         finally:
             mgr.stop()
 
-    def test_static_token_path(self, port=18211, monkeypatch=None):
+    def test_static_token_path(self):
         import os
         fake = FakeK8s()
         os.environ["FUSIONINFER_METRICS_TOKEN"] = "static-secret"
         try:
-            mgr = self._mgr(fake, port)
+            mgr = self._mgr(fake)
             try:
-                assert self._get(port + 1)[0] == 401
-                assert self._get(port + 1, "nope")[0] == 401
-                assert self._get(port + 1, "static-secret")[0] == 200
+                assert self._get(mgr.metrics_url_port)[0] == 401
+                assert self._get(mgr.metrics_url_port, "nope")[0] == 401
+                assert self._get(mgr.metrics_url_port, "static-secret")[0] == 200
             finally:
                 mgr.stop()
         finally:
             del os.environ["FUSIONINFER_METRICS_TOKEN"]
 
-    def test_fails_closed_without_authenticator(self, port=18221):
+    def test_fails_closed_without_authenticator(self):
         class NoReview(FakeK8s):
             token_review = None  # client without any review support
             metrics_access_review = None
 
-        mgr = self._mgr(NoReview(), port)
+        mgr = self._mgr(NoReview())
         try:
-            assert self._get(port + 1, "anything")[0] == 401
+            assert self._get(mgr.metrics_url_port, "anything")[0] == 401
         finally:
             mgr.stop()
