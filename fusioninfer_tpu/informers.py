@@ -70,15 +70,15 @@ class Store:
 
     def list(self, namespace: Optional[str] = None,
              label_selector: Optional[dict] = None) -> list[dict]:
+        from fusioninfer_tpu.operator.client import matches_labels
+
         with self._lock:
             out = []
             for (ns, _), obj in self._objs.items():
                 if namespace is not None and ns != namespace:
                     continue
-                if label_selector:
-                    labels = (obj.get("metadata") or {}).get("labels") or {}
-                    if any(labels.get(k) != v for k, v in label_selector.items()):
-                        continue
+                if label_selector and not matches_labels(obj, label_selector):
+                    continue
                 out.append(copy.deepcopy(obj))
             return out
 
@@ -90,12 +90,14 @@ class Store:
 class Lister:
     """Cache-only reads (client-go lister contract: never hits the API)."""
 
-    def __init__(self, store: Store, parse: Callable[[dict], object] = None):
+    def __init__(self, store: Store, parse: Callable[[dict], object] = None,
+                 namespace: str = "default"):
         self._store = store
         self._parse = parse
+        self._namespace = namespace  # the owning informer's namespace
 
-    def get(self, name: str, namespace: str = "default"):
-        obj = self._store.get(namespace, name)
+    def get(self, name: str, namespace: Optional[str] = None):
+        obj = self._store.get(namespace or self._namespace, name)
         if obj is None:
             return None
         return self._parse(obj) if self._parse else obj
@@ -117,8 +119,13 @@ class SharedInformer:
         self.namespace = namespace
         self.resync_period = resync_period
         self.store = Store()
-        self.lister = Lister(self.store, parse)
+        self.lister = Lister(self.store, parse, namespace=namespace)
         self._handlers: list[dict[str, Optional[Handler]]] = []
+        # serializes handler registration (snapshot + append + replay)
+        # with store-mutation+delivery, the client-go guarantee that a
+        # late handler sees each object exactly once; reentrant so a
+        # handler may itself register handlers
+        self._handler_lock = threading.RLock()
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -128,9 +135,23 @@ class SharedInformer:
     def add_event_handler(self, on_add: Optional[Handler] = None,
                           on_update: Optional[Handler] = None,
                           on_delete: Optional[Handler] = None) -> None:
-        self._handlers.append(
-            {"add": on_add, "update": on_update, "delete": on_delete}
-        )
+        # client-go contract: a handler registered after sync gets the
+        # current cache replayed as adds (a late consumer of a SHARED
+        # informer must not start blind).  Registration holds the same
+        # lock as _dispatch, so a concurrent event can neither be missed
+        # (arrives after append → dispatched) nor doubled (in the
+        # snapshot AND dispatched mid-registration).
+        with self._handler_lock:
+            replay = self.store.list() if (
+                on_add is not None and self._synced.is_set()) else []
+            self._handlers.append(
+                {"add": on_add, "update": on_update, "delete": on_delete}
+            )
+            for obj in replay:
+                try:
+                    on_add(obj)
+                except Exception:
+                    logger.exception("add replay handler for %s failed", self.kind)
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
@@ -176,35 +197,37 @@ class SharedInformer:
         """
         fresh = self._t.list(self.kind, self.namespace)
         seen = set()
-        for obj in fresh:
-            meta = obj.get("metadata") or {}
-            seen.add((meta.get("namespace", "default"), meta.get("name", "")))
-            self._track_rv(obj)
+        with self._handler_lock:  # reconcile + delivery atomic vs registration
+            for obj in fresh:
+                meta = obj.get("metadata") or {}
+                seen.add((meta.get("namespace", "default"), meta.get("name", "")))
+                self._track_rv(obj)
+                prev = self.store.put(obj)
+                if prev is None:
+                    self._dispatch("add", obj)
+                elif (prev["metadata"].get("resourceVersion")
+                      != meta.get("resourceVersion")):
+                    self._dispatch("update", prev, obj)
+                elif fire == "resync":
+                    self._dispatch("update", prev, obj)
+            for stale in [o for o in self.store.list()
+                          if self.store._key(o) not in seen]:
+                self.store.remove(stale)
+                self._dispatch("delete", stale)
+
+    def _handle_event(self, etype: str, obj: dict) -> None:
+        self._track_rv(obj)
+        with self._handler_lock:  # store change + delivery are atomic
+            if etype == "DELETED":
+                prev = self.store.remove(obj)
+                self._dispatch("delete", prev or obj)
+                return
             prev = self.store.put(obj)
             if prev is None:
                 self._dispatch("add", obj)
             elif (prev["metadata"].get("resourceVersion")
-                  != meta.get("resourceVersion")):
+                  != (obj.get("metadata") or {}).get("resourceVersion")):
                 self._dispatch("update", prev, obj)
-            elif fire == "resync":
-                self._dispatch("update", prev, obj)
-        for stale in [o for o in self.store.list()
-                      if self.store._key(o) not in seen]:
-            self.store.remove(stale)
-            self._dispatch("delete", stale)
-
-    def _handle_event(self, etype: str, obj: dict) -> None:
-        self._track_rv(obj)
-        if etype == "DELETED":
-            prev = self.store.remove(obj)
-            self._dispatch("delete", prev or obj)
-            return
-        prev = self.store.put(obj)
-        if prev is None:
-            self._dispatch("add", obj)
-        elif (prev["metadata"].get("resourceVersion")
-              != (obj.get("metadata") or {}).get("resourceVersion")):
-            self._dispatch("update", prev, obj)
 
     def _run(self) -> None:
         self._last_rv = ""
@@ -223,14 +246,24 @@ class SharedInformer:
                     self._stop.wait(self.resync_period)
                     continue
                 # resourceVersion continuation closes the list→watch race
-                # (an apiserver replays history after our last revision)
-                for etype, obj in watch(self.kind, self.namespace,
-                                        resource_version=self._last_rv):
+                # (an apiserver replays history after our last revision);
+                # the stream is bounded to the resync period so a healthy
+                # long-lived watch cannot starve the resync clock
+                try:
+                    stream = watch(self.kind, self.namespace,
+                                   resource_version=self._last_rv,
+                                   timeout_seconds=self.resync_period)
+                except TypeError:  # transport without a timeout knob
+                    stream = watch(self.kind, self.namespace,
+                                   resource_version=self._last_rv)
+                for etype, obj in stream:
                     if self._stop.is_set():
                         return
                     self._handle_event(etype, obj)
-                # stream ended (server-side timeout): loop relists, which
-                # both reconciles missed deletes and drives the resync clock
+                    if time.monotonic() >= next_resync:
+                        break
+                # stream ended (server-side timeout / resync due): loop
+                # relists, reconciling missed deletes + firing the resync
             except Exception as e:
                 logger.warning("informer %s list/watch failed (%s); retrying",
                                self.kind, e)

@@ -132,6 +132,27 @@ register_preset(
     )
 )
 
+# Qwen3-30B-A3B-shaped: MoE at production scale — 128 experts, 8 active
+# (~3B active params), the expert-parallel (ep) showcase config.
+register_preset(
+    ModelConfig(
+        name="qwen3-30b-a3b",
+        vocab_size=151_936,
+        d_model=2048,
+        n_layers=48,
+        n_heads=32,
+        n_kv_heads=4,
+        head_dim=128,
+        d_ff=6144,
+        qk_norm=True,
+        tie_embeddings=False,
+        max_seq_len=32_768,
+        n_experts=128,
+        n_experts_active=8,
+        moe_d_ff=768,
+    )
+)
+
 # Llama-3-70B-shaped: the multi-host TP target (configs 4/5).
 register_preset(
     ModelConfig(

@@ -61,14 +61,22 @@ def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) 
     return (gate * (x @ w_up)) @ w_down
 
 
+# dense MoE computes every expert on every token: exact, but its FLOPs
+# scale with E — past this expert count the capacity-dispatch path wins
+DENSE_MOE_MAX_EXPERTS = 16
+
+
 def moe_ffn(x: jax.Array, router_w: jax.Array, w_gate: jax.Array, w_up: jax.Array,
             w_down: jax.Array, n_active: int) -> jax.Array:
     """Token-choice top-k mixture of experts, dense-compute formulation.
 
     Every expert runs on every token and results are combined with the
-    (renormalized) top-k router weights.  Dense MoE keeps shapes static —
-    the XLA-friendly choice at the expert counts we ship; the expert axis
-    is shardable over the mesh's ``ep`` axis for expert parallelism.
+    (renormalized) top-k router weights.  Exact and static-shaped — the
+    right choice at small expert counts (≤ ``DENSE_MOE_MAX_EXPERTS``,
+    e.g. the tiny test presets); large-E models like qwen3-30b-a3b
+    route through :func:`moe_ffn_sparse`, whose FLOPs track the ACTIVE
+    experts.  The expert axis is shardable over the mesh's ``ep`` axis
+    either way.
 
     x: [tokens, d_model]; router_w: [d_model, E];
     w_gate/w_up: [E, d_model, d_ff]; w_down: [E, d_ff, d_model]
@@ -83,6 +91,63 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w_gate: jax.Array, w_up: jax.Arra
     up = jnp.einsum("td,edf->tef", x, w_up)
     per_expert = jnp.einsum("tef,efd->ted", gate * up, w_down)  # [T, E, D]
     return jnp.einsum("ted,te->td", per_expert, weights.astype(x.dtype))
+
+
+def moe_capacity(n_tokens: int, n_active: int, n_experts: int,
+                 capacity_factor: float = 2.0) -> int:
+    """Static per-expert token capacity (Switch/GShard): expected load
+    ``T·k/E`` times a slack factor, floored at 4 so tiny decode batches
+    never drop."""
+    import math
+
+    return max(4, int(math.ceil(n_tokens * n_active / n_experts * capacity_factor)))
+
+
+def moe_ffn_sparse(x: jax.Array, router_w: jax.Array, w_gate: jax.Array,
+                   w_up: jax.Array, w_down: jax.Array, n_active: int,
+                   capacity_factor: float = 2.0) -> jax.Array:
+    """Capacity-based sparse MoE (the Switch/GShard dispatch, XLA-style).
+
+    FLOPs scale with the ACTIVE experts, not E: each token's top-k
+    assignments scatter into a static ``[E, C, D]`` dispatch buffer
+    (``C`` = :func:`moe_capacity`), every expert runs one batched matmul
+    over its buffer, and results gather back weighted by the renormalized
+    router scores.  All shapes are static — capacity overflow *drops*
+    that (token, expert) assignment, the standard trade the slack factor
+    makes rare.  The leading expert axis of both the buffer and the
+    weights shards over ``ep``.
+
+    x: [tokens, d_model] → [tokens, d_model]
+    """
+    T, D = x.shape
+    E = router_w.shape[-1]
+    k = n_active
+    C = moe_capacity(T, k, E, capacity_factor)
+
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [T, E]
+    top_vals, top_idx = lax.top_k(logits, k)  # [T, k]
+    weights = jax.nn.softmax(top_vals, axis=-1)  # renormalized over chosen
+
+    flat_e = top_idx.reshape(-1)  # [T*k] expert id per assignment
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)  # [T*k, E]
+    # slot of each assignment within its expert's buffer: how many prior
+    # assignments chose the same expert
+    prior = jnp.cumsum(onehot, axis=0) - onehot
+    slot = jnp.take_along_axis(prior, flat_e[:, None], axis=1)[:, 0]  # [T*k]
+    keep = slot < C
+    slot = jnp.where(keep, slot, 0)  # clamped; masked contributions add zero
+
+    x_rep = jnp.repeat(x, k, axis=0)  # [T*k, D]
+    contrib = x_rep * keep[:, None].astype(x.dtype)
+    dispatch = jnp.zeros((E, C, D), x.dtype).at[flat_e, slot].add(contrib)
+
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", dispatch, w_gate))
+    up = jnp.einsum("ecd,edf->ecf", dispatch, w_up)
+    out_e = jnp.einsum("ecf,efd->ecd", gate * up, w_down)  # [E, C, D]
+
+    gathered = out_e[flat_e, slot]  # [T*k, D]
+    w_flat = (weights.reshape(-1) * keep).astype(x.dtype)
+    return (gathered * w_flat[:, None]).reshape(T, k, D).sum(axis=1)
 
 
 # -- parameter init ----------------------------------------------------------
@@ -181,7 +246,8 @@ def mlp_block(cfg: ModelConfig, layer: Params, x: jax.Array) -> jax.Array:
     B, S, D = x.shape
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
     if cfg.is_moe:
-        return moe_ffn(
+        ffn = moe_ffn if cfg.n_experts <= DENSE_MOE_MAX_EXPERTS else moe_ffn_sparse
+        return ffn(
             h.reshape(B * S, D), layer["router"], layer["w_gate"], layer["w_up"],
             layer["w_down"], cfg.n_experts_active,
         ).reshape(B, S, D)

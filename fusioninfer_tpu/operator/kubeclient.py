@@ -215,6 +215,9 @@ class KubeClient(K8sClient):
               timeout_seconds: int = 300) -> Iterator[tuple[str, dict]]:
         """Yield ``(event_type, object)`` from a chunked watch stream."""
         api_version, plural = self._resolve(kind)
+        # the apiserver rejects non-integer timeoutSeconds (callers pass
+        # float periods); coerce here so every caller is safe
+        timeout_seconds = max(1, int(timeout_seconds))
         query = {"watch": "1", "timeoutSeconds": str(timeout_seconds)}
         if resource_version:
             query["resourceVersion"] = resource_version

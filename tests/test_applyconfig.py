@@ -1,0 +1,157 @@
+"""Apply-configurations (reference: generated client-go
+applyconfigurations): partial-manifest merges that preserve fields owned
+by other managers, over the fake and the HTTP apiserver transports."""
+
+from fusioninfer_tpu.applyconfig import (
+    ApplyConfig,
+    InferenceServiceApply,
+    extract,
+)
+from fusioninfer_tpu.operator.fake import FakeK8s
+
+
+def worker_role(name="worker", image="img", replicas=1):
+    return {
+        "name": name, "componentType": "worker", "replicas": replicas,
+        "template": {"spec": {"containers": [
+            {"name": "engine", "image": image}]}},
+    }
+
+
+class TestApply:
+    def test_apply_creates_when_absent(self):
+        fake = FakeK8s()
+        out = (InferenceServiceApply("svc")
+               .with_labels({"team": "ml"})
+               .with_spec(roles=[worker_role()])
+               .apply(fake, field_manager="ci"))
+        assert out["metadata"]["labels"] == {"team": "ml"}
+        assert extract(fake.get("InferenceService", "default", "svc"), "ci")
+
+    def test_partial_apply_preserves_other_managers_fields(self):
+        fake = FakeK8s()
+        (InferenceServiceApply("svc")
+         .with_labels({"team": "ml"})
+         .with_spec(roles=[worker_role(replicas=2)])
+         .apply(fake, field_manager="owner"))
+
+        # a second manager declares ONLY an annotation
+        (InferenceServiceApply("svc")
+         .with_annotations({"audit": "yes"})
+         .apply(fake, field_manager="auditor"))
+
+        live = fake.get("InferenceService", "default", "svc")
+        assert live["metadata"]["labels"] == {"team": "ml"}  # untouched
+        assert live["metadata"]["annotations"] == {"audit": "yes"}
+        assert live["spec"]["roles"][0]["replicas"] == 2  # untouched
+        managers = {f["manager"] for f in live["metadata"]["managedFields"]}
+        assert managers == {"owner", "auditor"}
+
+    def test_role_list_merges_by_name(self):
+        fake = FakeK8s()
+        (InferenceServiceApply("svc")
+         .with_role(worker_role("worker", image="v1"))
+         .with_role(worker_role("prefiller", image="v1"))
+         .apply(fake))
+
+        # update only the worker role's image; prefiller must survive
+        (InferenceServiceApply("svc")
+         .with_role({"name": "worker",
+                     "template": {"spec": {"containers": [
+                         {"name": "engine", "image": "v2"}]}}})
+         .apply(fake))
+
+        roles = {r["name"]: r for r in
+                 fake.get("InferenceService", "default", "svc")["spec"]["roles"]}
+        assert set(roles) == {"worker", "prefiller"}
+        assert roles["worker"]["template"]["spec"]["containers"][0]["image"] == "v2"
+        assert roles["worker"]["replicas"] == 1  # undeclared field preserved
+        assert roles["prefiller"]["template"]["spec"]["containers"][0]["image"] == "v1"
+
+    def test_none_deletes_field(self):
+        fake = FakeK8s()
+        ApplyConfig("v1", "ConfigMap", "c").with_spec().apply(fake)
+        fake.update({**fake.get("ConfigMap", "default", "c"),
+                     "data": {"a": "1", "b": "2"}})
+        cfg = ApplyConfig("v1", "ConfigMap", "c")
+        cfg._doc["data"] = {"b": None}
+        cfg.apply(fake)
+        assert fake.get("ConfigMap", "default", "c")["data"] == {"a": "1"}
+
+    def test_reapply_same_manager_single_managed_fields_entry(self):
+        fake = FakeK8s()
+        for _ in range(3):
+            InferenceServiceApply("svc").with_spec(
+                roles=[worker_role()]).apply(fake, field_manager="ci")
+        entries = fake.get("InferenceService", "default", "svc")["metadata"]["managedFields"]
+        assert [e["manager"] for e in entries] == ["ci"]
+
+    def test_apply_over_http_transport(self):
+        from fusioninfer_tpu.operator.apiserver import HTTPApiServer
+        from fusioninfer_tpu.operator.kubeclient import KubeClient, KubeConfig
+
+        api = HTTPApiServer(token="t").start()
+        try:
+            client = KubeClient(KubeConfig(api.url, token="t"))
+            InferenceServiceApply("svc").with_spec(
+                roles=[worker_role()]).apply(client, field_manager="remote")
+            InferenceServiceApply("svc").with_labels(
+                {"x": "1"}).apply(client, field_manager="remote")
+            live = api.fake.get("InferenceService", "default", "svc")
+            assert live["metadata"]["labels"] == {"x": "1"}
+            assert live["spec"]["roles"]
+        finally:
+            api.stop()
+
+
+class TestApplyConcurrency:
+    def test_conflict_retries_and_merges(self):
+        """A concurrent writer between read and update must not surface
+        as Conflict — SSA semantics retry the merge."""
+        fake = FakeK8s()
+        InferenceServiceApply("svc").with_spec(
+            roles=[worker_role()]).apply(fake, field_manager="owner")
+
+        class RacingFake(FakeK8s):
+            """First update attempt loses a race injected at get time."""
+
+            def __init__(self, inner):
+                self.__dict__ = inner.__dict__
+                self._raced = False
+
+            def get_or_none(self, kind, ns, name):
+                live = super().get_or_none(kind, ns, name)
+                if live is not None and not self._raced:
+                    self._raced = True
+                    bump = super().get(kind, ns, name)
+                    bump["metadata"]["labels"] = {"racer": "wrote"}
+                    super().update(bump)  # bumps resourceVersion
+                return live
+
+        racing = RacingFake(fake)
+        (InferenceServiceApply("svc")
+         .with_annotations({"late": "apply"})
+         .apply(racing, field_manager="late"))
+        live = fake.get("InferenceService", "default", "svc")
+        assert live["metadata"]["annotations"] == {"late": "apply"}
+        assert live["metadata"]["labels"] == {"racer": "wrote"}  # race survives
+
+
+class TestListerNamespace:
+    def test_lister_defaults_to_informer_namespace(self):
+        from fusioninfer_tpu.informers import SharedInformerFactory
+
+        fake = FakeK8s()
+        svc = {
+            "apiVersion": "fusioninfer.io/v1alpha1", "kind": "InferenceService",
+            "metadata": {"name": "svc", "namespace": "prod"},
+            "spec": {"roles": [worker_role()]},
+        }
+        fake.create(svc)
+        factory = SharedInformerFactory(fake, namespace="prod")
+        inf = factory.inference_services()
+        factory.start()
+        assert factory.wait_for_cache_sync(10)
+        assert inf.lister.get("svc") is not None  # informer's own namespace
+        assert inf.lister.get("svc", namespace="default") is None
+        factory.stop()

@@ -109,28 +109,48 @@ class TestSharedInformer:
         assert updates == []  # no spurious updates from watch echo
         factory.stop()
 
-    def test_resync_refires_updates(self):
+    def test_resync_refires_updates_watch_path(self):
+        """A LIVE watch stream must not starve the resync clock: with
+        resync_period well under the stream timeout, unchanged objects
+        still get update re-fires at the resync cadence."""
         fake = FakeK8s()
         fake.create(svc_dict("a"))
         factory = SharedInformerFactory(fake, resync_period=0.3)
         inf = factory.inference_services()
         updates = []
         inf.add_event_handler(on_update=lambda old, new: updates.append(1))
+        factory.start()
+        assert factory.wait_for_cache_sync(10)
+        assert wait_for(lambda: len(updates) >= 2, timeout=5)
+        factory.stop()
 
-        # force the poll path (no watch): resync relists periodically
+    def test_resync_refires_updates_poll_path(self):
         class NoWatch(FakeK8s):
             watch = None
 
         poll = NoWatch()
         poll.create(svc_dict("a"))
-        inf2 = SharedInformerFactory(poll, resync_period=0.2).for_kind(
+        inf = SharedInformerFactory(poll, resync_period=0.2).for_kind(
             "InferenceService")
         re_updates = []
-        inf2.add_event_handler(on_update=lambda old, new: re_updates.append(1))
-        inf2.start()
-        assert inf2.wait_for_cache_sync(10)
+        inf.add_event_handler(on_update=lambda old, new: re_updates.append(1))
+        inf.start()
+        assert inf.wait_for_cache_sync(10)
         assert wait_for(lambda: len(re_updates) >= 1, timeout=5)
-        inf2.stop()
+        inf.stop()
+
+    def test_late_handler_gets_cache_replayed(self):
+        """client-go contract: handlers added after sync see the current
+        cache as synthetic adds."""
+        fake = FakeK8s()
+        fake.create(svc_dict("early"))
+        factory = SharedInformerFactory(fake)
+        inf = factory.inference_services()
+        factory.start()
+        assert factory.wait_for_cache_sync(10)
+        late = []
+        inf.add_event_handler(on_add=lambda o: late.append(o["metadata"]["name"]))
+        assert "early" in late
         factory.stop()
 
     def test_factory_shares_informers(self):

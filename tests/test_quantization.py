@@ -132,3 +132,19 @@ class TestEngineInt8:
         mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
         with pytest.raises(ValueError, match="single-device"):
             NativeEngine(self.CFG, cache_cfg=self.CACHE, mesh=mesh)
+
+
+class TestMoEScalePreset:
+    """qwen3-30b-a3b (128-expert MoE, 8 active): the expert-parallel
+    rung's sizing arithmetic."""
+
+    def test_preset_validates_and_sizes(self):
+        cfg = get_preset("qwen3-30b-a3b")
+        assert cfg.is_moe and cfg.n_experts == 128 and cfg.n_experts_active == 8
+        total = model_param_bytes(cfg)
+        # ~30B params bf16 ≈ 60 GB: multi-chip even before KV
+        assert total > 3 * V5E_HBM
+
+    def test_int8_still_needs_sharding(self):
+        cfg = dataclasses.replace(get_preset("qwen3-30b-a3b"), quantization="int8")
+        assert model_param_bytes(cfg) > V5E_HBM  # ~30 GB int8: ep/tp territory
