@@ -49,6 +49,8 @@ def _merge_lists(field: str, base: list, patch: list) -> list:
             out[index[k]] = _merge(field, out[index[k]], elem)
         else:
             out.append(copy.deepcopy(elem))
+            if k is not None:  # later patch elements with this key merge in
+                index[k] = len(out) - 1
     return out
 
 
@@ -145,9 +147,11 @@ class InferenceServiceApply(ApplyConfig):
         super().__init__(API_VERSION, "InferenceService", name, namespace)
 
     def with_role(self, role: dict) -> "InferenceServiceApply":
-        """Declare (ownership of) one role; merges by role name."""
+        """Declare (ownership of) one role; merges by role name — also
+        against roles already declared on this builder, so the document
+        never carries duplicate merge keys (which real SSA rejects)."""
         spec = self._doc.setdefault("spec", {})
-        spec.setdefault("roles", []).append(role)
+        spec["roles"] = _merge_lists("roles", spec.get("roles") or [], [role])
         return self
 
 

@@ -292,13 +292,14 @@ class NativeEngine:
                 rid = request.request_id
                 self.alloc.allocate(rid, len(prefix))
                 try:
-                    row = jnp.asarray(self.alloc.page_table_row(rid))
+                    row = jnp.asarray(self.alloc.page_table_row(rid))[None]
                     bucket = pick_bucket(self.buckets, len(prefix))
                     padded = np.zeros((1, bucket), np.int32)
                     padded[0, : len(prefix)] = prefix
                     self.cache, logits = prefill(
                         self.cfg, self.cache_cfg, self.params, self.cache,
-                        jnp.asarray(padded), jnp.int32(len(prefix)), row,
+                        jnp.asarray(padded),
+                        jnp.asarray([len(prefix)], jnp.int32), row,
                         mesh=self._kernel_mesh,
                     )
                     token = self._sample_first_token(
@@ -414,31 +415,87 @@ class NativeEngine:
         growth is handled at decode time, where the youngest sequence is
         preempted when the cache fills.  Admission never preempts — a newer
         request must not evict older running work.
+
+        Fresh prompts that land in the SAME padding bucket prefill as one
+        batched forward (power-of-two group sizes bound the compile count
+        to bucket×group signatures); prefix-cache hits take the per-
+        sequence suffix path.  Rounds preserve the serial path's
+        intra-burst reuse: only the first occurrence of a prompt prefills
+        fresh in a round — duplicates defer one round and arrive as cache
+        hits against the pages the first registered.
         """
-        outputs = []
-        while self.waiting and self._free_slots:
+        outputs: list[StepOutput] = []
+        pending: list[tuple[Request, list[int], bool]] = []
+        while self.waiting and len(self._free_slots) > len(pending):
             request = self.waiting[0]
             prefix = request.resume_tokens or request.prompt_tokens
             # reuse-aware: a mostly-cached prompt needs few fresh pages
             if not self.alloc.can_admit(prefix, 1):
                 break  # wait for running work to finish or be preempted
             self.waiting.popleft()
-            try:
-                outputs.append(self._prefill_request(request))
-            except Exception as e:
-                # never lose a popped request silently: fail it to the client
-                logger.exception("prefill of %s failed", request.request_id)
-                self.alloc.release(request.request_id)
-                self.errors_total += 1
-                outputs.append(
-                    StepOutput(
-                        request_id=request.request_id,
-                        token=0,
-                        finished=True,
-                        finish_reason=f"error:{e}",
+            resumed = request.resume_tokens is not None
+            request.resume_tokens = None
+            pending.append((request, prefix, resumed))
+
+        while pending:
+            fresh: list[tuple[Request, list[int], bool]] = []
+            deferred: list[tuple[Request, list[int], bool]] = []
+            seen_prompts: set = set()
+            for request, prefix, resumed in pending:
+                key = hash(tuple(prefix))
+                if self.prefix_caching and key in seen_prompts:
+                    # a same-prompt request earlier in this round is about
+                    # to register these pages: defer → next round hits
+                    deferred.append((request, prefix, resumed))
+                    continue
+                rid = request.request_id
+                try:
+                    reused = (
+                        self.alloc.match_prefix(rid, prefix)
+                        if self.prefix_caching else 0
                     )
-                )
+                    self.alloc.allocate(rid, len(prefix) + 1)
+                except Exception as e:
+                    # match_prefix may have pinned shared pages: release
+                    self.alloc.release(rid)
+                    outputs.append(self._fail_admission(request, e))
+                    continue
+                if reused:
+                    try:
+                        outputs.append(self._prefill_suffix_one(
+                            request, prefix, resumed, reused))
+                    except Exception as e:
+                        logger.exception("prefill of %s failed", rid)
+                        self.alloc.release(rid)
+                        outputs.append(self._fail_admission(request, e))
+                else:
+                    seen_prompts.add(key)
+                    fresh.append((request, prefix, resumed))
+
+            by_bucket: dict[int, list[tuple[Request, list[int], bool]]] = {}
+            for item in fresh:
+                by_bucket.setdefault(
+                    pick_bucket(self.buckets, len(item[1])), []).append(item)
+            for bucket in sorted(by_bucket):
+                items = by_bucket[bucket]
+                while items:
+                    # largest power of two ≤ remaining: compile cache stays
+                    # bounded at (buckets × log2(max_batch)) signatures
+                    n = 1 << (len(items).bit_length() - 1)
+                    group, items = items[:n], items[n:]
+                    outputs.extend(self._prefill_fresh_group(bucket, group))
+            pending = deferred
         return outputs
+
+    def _fail_admission(self, request: Request, e: Exception) -> StepOutput:
+        """Never lose a popped request silently: fail it to the client."""
+        self.errors_total += 1
+        return StepOutput(
+            request_id=request.request_id,
+            token=0,
+            finished=True,
+            finish_reason=f"error:{e}",
+        )
 
     def _preempt_youngest(self, exclude_slot: int) -> bool:
         """Release the youngest running sequence (≠ exclude) back to waiting."""
@@ -527,40 +584,74 @@ class NativeEngine:
         )
         self._suppress = self._suppress.at[slot].set(self._stop_suppress_row(params))
 
-    def _prefill_request(self, request: Request) -> Optional[StepOutput]:
-        resumed = request.resume_tokens is not None
-        prefix = request.resume_tokens if resumed else request.prompt_tokens
-        request.resume_tokens = None
+    def _prefill_suffix_one(self, request: Request, prefix: list[int],
+                            resumed: bool, reused_tokens: int) -> StepOutput:
+        """Prefix-cache hit: prefill only the suffix against the cached
+        pages (positions [0, reused) already live there)."""
         rid = request.request_id
-        reused_tokens = 0
-        if self.prefix_caching:
-            reused_tokens = self.alloc.match_prefix(rid, prefix)
-        # lazy: cover the prefix and the first generated token only
-        self.alloc.allocate(rid, len(prefix) + 1)
         row = jnp.asarray(self.alloc.page_table_row(rid))
+        suffix = prefix[reused_tokens:]
+        bucket = pick_bucket(self.buckets, len(suffix))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(suffix)] = suffix
+        self.cache, logits = prefill_suffix(
+            self.cfg, self.cache_cfg, self.params, self.cache,
+            jnp.asarray(padded), jnp.int32(reused_tokens),
+            jnp.int32(len(suffix)), row,
+            mesh=self._kernel_mesh,
+        )
+        return self._activate(request, prefix, resumed, logits)
 
-        if reused_tokens:
-            # cached prefix pages carry positions [0, reused): prefill
-            # only the suffix against them
-            suffix = prefix[reused_tokens:]
-            bucket = pick_bucket(self.buckets, len(suffix))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, : len(suffix)] = suffix
-            self.cache, logits = prefill_suffix(
-                self.cfg, self.cache_cfg, self.params, self.cache,
-                jnp.asarray(padded), jnp.int32(reused_tokens),
-                jnp.int32(len(suffix)), row,
-                mesh=self._kernel_mesh,
-            )
-        else:
-            bucket = pick_bucket(self.buckets, len(prefix))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, : len(prefix)] = prefix
+    def _prefill_fresh_group(
+        self, bucket: int, items: list[tuple[Request, list[int], bool]]
+    ) -> list[StepOutput]:
+        """One batched forward for same-bucket fresh prompts.
+
+        Never raises: a forward failure fails (and releases) the whole
+        group; an activation failure fails only its own request — by then
+        earlier items are live in ``self.running`` and must not be
+        touched (releasing their pages would hand them to later requests
+        mid-decode: cross-sequence KV corruption)."""
+        B = len(items)
+        mp = self.cache_cfg.max_pages_per_seq
+        padded = np.zeros((B, bucket), np.int32)
+        rows = np.zeros((B, mp), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for i, (request, prefix, _) in enumerate(items):
+            padded[i, : len(prefix)] = prefix
+            rows[i] = self.alloc.page_table_row(request.request_id)
+            lens[i] = len(prefix)
+        try:
             self.cache, logits = prefill(
                 self.cfg, self.cache_cfg, self.params, self.cache,
-                jnp.asarray(padded), jnp.int32(len(prefix)), row,
+                jnp.asarray(padded), jnp.asarray(lens), jnp.asarray(rows),
                 mesh=self._kernel_mesh,
             )
+        except Exception as e:
+            logger.exception("batched prefill of %d requests failed", B)
+            outputs = []
+            for request, _, _ in items:
+                self.alloc.release(request.request_id)
+                outputs.append(self._fail_admission(request, e))
+            return outputs
+        outputs = []
+        for i, (request, prefix, resumed) in enumerate(items):
+            try:
+                outputs.append(
+                    self._activate(request, prefix, resumed, logits[i : i + 1])
+                )
+            except Exception as e:
+                logger.exception("activation of %s failed", request.request_id)
+                self.alloc.release(request.request_id)
+                outputs.append(self._fail_admission(request, e))
+        return outputs
+
+    def _activate(self, request: Request, prefix: list[int], resumed: bool,
+                  logits: jax.Array) -> StepOutput:
+        """Shared post-prefill tail: sample the first token with the
+        request's full sampling semantics, claim a batch slot, register
+        device-side sampling state, emit."""
+        rid = request.request_id
         if self.prefix_caching:
             self.alloc.register_blocks(rid, prefix)
         seq_seed = self._request_seed(request)

@@ -42,39 +42,50 @@ def prefill(
     cache_cfg: CacheConfig,
     params,
     cache: dict,
-    tokens: jax.Array,  # [1, S] padded to bucket
-    true_len: jax.Array,  # scalar int32
-    page_row: jax.Array,  # [max_pages_per_seq]
+    tokens: jax.Array,  # [B, S] — B sequences padded to one bucket
+    true_lens: jax.Array,  # [B] int32
+    page_rows: jax.Array,  # [B, max_pages_per_seq]
     mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
 ):
-    """Prefill one sequence; returns (cache, last-token logits [1, V])."""
+    """Prefill B sequences in one forward; returns (cache, last-token
+    logits [B, V]).
+
+    Batching prompts raises MXU utilization and turns an N-request burst
+    into ⌈N/group⌉ compiled calls instead of N (the engine groups
+    admissible same-bucket requests — vLLM batches prefills the same
+    way).  Causality is per row: flash attention's batch dim isolates
+    sequences, and each row's padded positions write to the trash page.
+    """
     B, S = tokens.shape
     ps = cache_cfg.page_size
     x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
 
-    token_idx = jnp.arange(S)
+    token_idx = jnp.arange(S)[None, :]  # [1, S]
     # Padded positions (>= true_len) write to the trash page.
     page_of_token = jnp.where(
-        token_idx < true_len, page_row[token_idx // ps], cache_cfg.trash_page
-    )
-    slot_of_token = token_idx % ps
+        token_idx < true_lens[:, None],
+        jnp.take_along_axis(page_rows, token_idx // ps, axis=1),
+        cache_cfg.trash_page,
+    )  # [B, S]
+    slot_of_token = jnp.broadcast_to(token_idx % ps, (B, S))
 
     def body(x, inputs):
         layer, k_cache_l, v_cache_l = inputs
         out, (k, v) = layer_forward(cfg, layer, x, positions, mesh=mesh)
-        # head-major per-layer cache [KV, n_pages, ps, Hd]; k[0] is [S, KV, Hd]
+        # head-major per-layer cache [KV, n_pages, ps, Hd]; k is
+        # [B, S, KV, Hd] → scatter [KV, B, S, Hd] at [B, S] page/slot maps
         k_cache_l = k_cache_l.at[:, page_of_token, slot_of_token].set(
-            jnp.swapaxes(k[0], 0, 1)
+            jnp.moveaxis(k, 2, 0)
         )
         v_cache_l = v_cache_l.at[:, page_of_token, slot_of_token].set(
-            jnp.swapaxes(v[0], 0, 1)
+            jnp.moveaxis(v, 2, 0)
         )
         return out, (k_cache_l, v_cache_l)
 
     x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    last = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]  # [B, D]
+    last = x[jnp.arange(B), jnp.maximum(true_lens - 1, 0)]  # [B, D]
     return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
 
 

@@ -104,6 +104,24 @@ class TestApply:
             api.stop()
 
 
+class TestMergeKeyIndex:
+    def test_two_patch_elements_same_key_merge_not_duplicate(self):
+        """Two with_role() declarations for the same role name must merge
+        into ONE role, even when the live object lacks it (SSA rejects
+        duplicate merge keys; we merge them)."""
+        fake = FakeK8s()
+        (InferenceServiceApply("svc")
+         .with_role({"name": "worker", "componentType": "worker",
+                     "template": {"spec": {"containers": [
+                         {"name": "engine", "image": "v1"}]}}})
+         .with_role({"name": "worker", "replicas": 3})
+         .apply(fake))
+        roles = fake.get("InferenceService", "default", "svc")["spec"]["roles"]
+        assert len(roles) == 1
+        assert roles[0]["replicas"] == 3
+        assert roles[0]["template"]["spec"]["containers"][0]["image"] == "v1"
+
+
 class TestApplyConcurrency:
     def test_conflict_retries_and_merges(self):
         """A concurrent writer between read and update must not surface

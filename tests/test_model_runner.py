@@ -59,7 +59,8 @@ def paged_generate(params, prompt: np.ndarray, n_steps: int, batch_size: int = 2
     padded = np.zeros((1, bucket), np.int32)
     padded[0, : len(prompt)] = prompt
     cache, logits = prefill(
-        CFG, CACHE_CFG, params, cache, jnp.asarray(padded), jnp.int32(len(prompt)), row
+        CFG, CACHE_CFG, params, cache, jnp.asarray(padded),
+        jnp.asarray([len(prompt)], jnp.int32), row[None],
     )
     out = [int(jnp.argmax(logits[0]))]
 
@@ -93,8 +94,9 @@ def test_prefill_logits_match_forward_last_token(params):
     padded = np.zeros((1, 16), np.int32)
     padded[0, : len(prompt)] = prompt
     _, logits = prefill(
-        CFG, CACHE_CFG, params, cache, jnp.asarray(padded), jnp.int32(len(prompt)),
-        jnp.asarray(alloc.page_table_row("s")),
+        CFG, CACHE_CFG, params, cache, jnp.asarray(padded),
+        jnp.asarray([len(prompt)], jnp.int32),
+        jnp.asarray(alloc.page_table_row("s"))[None],
     )
     ref = forward(CFG, params, jnp.asarray([prompt]))[0, -1]
     np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(ref), rtol=2e-2, atol=2e-2)
@@ -117,7 +119,8 @@ def test_two_concurrent_sequences_do_not_interfere(params):
         padded = np.zeros((1, 16), np.int32)
         padded[0, : len(prompt)] = prompt
         cache, logits = prefill(
-            CFG, CACHE_CFG, params, cache, jnp.asarray(padded), jnp.int32(len(prompt)), rows[sid]
+            CFG, CACHE_CFG, params, cache, jnp.asarray(padded),
+            jnp.asarray([len(prompt)], jnp.int32), rows[sid][None],
         )
         outs[sid].append(int(jnp.argmax(logits[0])))
 
