@@ -439,14 +439,15 @@ class NativeEngine:
 
         while pending:
             fresh: list[tuple[Request, list[int], bool]] = []
-            deferred: list[tuple[Request, list[int], bool]] = []
+            deferred_idx: list[int] = []
             seen_prompts: set = set()
-            for request, prefix, resumed in pending:
+            stopped_at: Optional[int] = None
+            for idx, (request, prefix, resumed) in enumerate(pending):
                 key = hash(tuple(prefix))
                 if self.prefix_caching and key in seen_prompts:
                     # a same-prompt request earlier in this round is about
                     # to register these pages: defer → next round hits
-                    deferred.append((request, prefix, resumed))
+                    deferred_idx.append(idx)
                     continue
                 rid = request.request_id
                 try:
@@ -455,6 +456,15 @@ class NativeEngine:
                         if self.prefix_caching else 0
                     )
                     self.alloc.allocate(rid, len(prefix) + 1)
+                except MemoryError:
+                    # capacity raced ahead of the pop-time can_admit check
+                    # (earlier burst members consumed the pages): this is
+                    # back-pressure, not an error — requeue at the FRONT in
+                    # FCFS order and stop admitting, exactly like the
+                    # serial path's pre-pop break
+                    self.alloc.release(rid)
+                    stopped_at = idx
+                    break
                 except Exception as e:
                     # match_prefix may have pinned shared pages: release
                     self.alloc.release(rid)
@@ -472,6 +482,13 @@ class NativeEngine:
                     seen_prompts.add(key)
                     fresh.append((request, prefix, resumed))
 
+            if stopped_at is not None:
+                # everything unprocessed goes back in original FCFS order
+                keep = sorted(set(deferred_idx)
+                              | set(range(stopped_at, len(pending))))
+                self._requeue_front([pending[i] for i in keep])
+                deferred_idx = []
+
             by_bucket: dict[int, list[tuple[Request, list[int], bool]]] = {}
             for item in fresh:
                 by_bucket.setdefault(
@@ -484,8 +501,17 @@ class NativeEngine:
                     n = 1 << (len(items).bit_length() - 1)
                     group, items = items[:n], items[n:]
                     outputs.extend(self._prefill_fresh_group(bucket, group))
-            pending = deferred
+            pending = [pending[i] for i in deferred_idx]
         return outputs
+
+    def _requeue_front(self, items: list[tuple[Request, list[int], bool]]) -> None:
+        """Return un-admitted burst members to the queue head (FCFS),
+        restoring resume state for preempted requests."""
+        with self._lock:
+            for request, prefix, resumed in reversed(items):
+                if resumed:
+                    request.resume_tokens = list(prefix)
+                self.waiting.appendleft(request)
 
     def _fail_admission(self, request: Request, e: Exception) -> StepOutput:
         """Never lose a popped request silently: fail it to the client."""

@@ -395,3 +395,4 @@ class Manager:
             server = getattr(self, attr, None)
             if server is not None:
                 server.shutdown()
+                server.server_close()  # release the socket for this process

@@ -1,0 +1,178 @@
+"""LWS/pod simulator: "runs" rendered workloads as in-process engines.
+
+The reference's e2e never applies a real InferenceService (its own TODO,
+``test/e2e/e2e_test.go:265-272``) because doing so needs the external
+controllers plus real model servers.  This simulator closes that gap
+without hardware or clusters — the "tpu-echo engine" testing posture
+SURVEY §7 calls for, except the engine is the real in-repo serving
+runtime on a tiny model:
+
+* watches ``LeaderWorkerSet`` objects (what the LWS controller consumes),
+* boots one real :class:`~fusioninfer_tpu.engine.server.EngineServer`
+  per LWS group as its "leader pod", wiring PD decoders to the
+  prefiller service by component-type label,
+* creates the leader ``Pod`` object with the exact labels the rendered
+  InferencePool selector matches (incl. ``worker-index=0``) plus a
+  ``podsim.fusioninfer.io/port`` annotation standing in for podIP:8000,
+* mirrors readiness into LWS status so the operator's aggregation sees
+  a Running component.
+
+With :class:`~fusioninfer_tpu.router.picker.EndpointPicker` on top, the
+full production path — CRD → reconcile → workloads → endpoint scoring →
+completion — runs inside one process (``tests/test_e2e_serving.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, Optional
+
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.models.config import ModelConfig, get_preset
+from fusioninfer_tpu.operator.client import K8sClient
+from fusioninfer_tpu.workload.labels import (
+    LABEL_COMPONENT_TYPE,
+    LABEL_SERVICE,
+    LWS_WORKER_INDEX_LABEL,
+)
+
+logger = logging.getLogger("fusioninfer.podsim")
+
+PORT_ANNOTATION = "podsim.fusioninfer.io/port"
+
+_TINY_CACHE = CacheConfig(n_pages=65, page_size=8, max_pages_per_seq=8)
+
+
+def _default_engine_factory(prefill_upstream: Optional[str]):
+    """A real EngineServer on the tiny preset (random weights)."""
+    from fusioninfer_tpu.engine.engine import NativeEngine
+    from fusioninfer_tpu.engine.server import EngineServer
+
+    cfg: ModelConfig = dataclasses.replace(
+        get_preset("qwen3-tiny"), attn_impl="reference"
+    )
+    engine = NativeEngine(cfg, cache_cfg=_TINY_CACHE, max_batch_size=4)
+    return EngineServer(
+        model="qwen3-tiny", host="127.0.0.1", port=0, engine=engine,
+        prefill_upstream=prefill_upstream,
+    )
+
+
+class LWSSimulator:
+    """The external LWS-controller + kubelet stand-in for e2e tests."""
+
+    def __init__(self, client: K8sClient, namespace: str = "default",
+                 engine_factory: Callable[[Optional[str]], object] = None,
+                 poll_interval: float = 0.1):
+        self.client = client
+        self.namespace = namespace
+        self.engine_factory = engine_factory or _default_engine_factory
+        self.poll_interval = poll_interval
+        self.servers: dict[str, object] = {}  # lws name -> EngineServer
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+
+    def start(self) -> "LWSSimulator":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="lws-simulator")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        for server in self.servers.values():
+            try:
+                server.stop()
+            except Exception:
+                logger.exception("podsim engine stop failed")
+        self.servers.clear()
+
+    def url_of(self, lws_name: str) -> str:
+        server = self.servers[lws_name]
+        return f"http://127.0.0.1:{server.port}"
+
+    # -- internals --
+
+    def _pod_labels(self, lws: dict) -> dict:
+        tmpl = (lws.get("spec") or {}).get("leaderWorkerTemplate") or {}
+        pod_template = tmpl.get("leaderTemplate") or tmpl.get("workerTemplate") or {}
+        labels = dict(((pod_template.get("metadata") or {}).get("labels")) or {})
+        labels[LWS_WORKER_INDEX_LABEL] = "0"  # the LWS controller's stamp
+        return labels
+
+    def _prefiller_url(self, labels: dict) -> Optional[str]:
+        """PD decoders pull prefills from the prefiller role's engine —
+        resolved by the same component-type label the EPP filters on."""
+        if labels.get(LABEL_COMPONENT_TYPE) != "decoder":
+            return None
+        service = labels.get(LABEL_SERVICE, "")
+        for name, server in self.servers.items():
+            pod = self.client.get_or_none("Pod", self.namespace, f"{name}-0")
+            if pod is None:
+                continue
+            plabels = (pod.get("metadata") or {}).get("labels") or {}
+            if (plabels.get(LABEL_SERVICE) == service
+                    and plabels.get(LABEL_COMPONENT_TYPE) == "prefiller"):
+                return f"http://127.0.0.1:{server.port}"
+        return None
+
+    def _simulate(self, lws: dict) -> None:
+        name = lws["metadata"]["name"]
+        labels = self._pod_labels(lws)
+        server = self.engine_factory(self._prefiller_url(labels))
+        server.start()
+        self.servers[name] = server
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"{name}-0",
+                "namespace": self.namespace,
+                "labels": labels,
+                "annotations": {PORT_ANNOTATION: str(server.port)},
+                "ownerReferences": [{
+                    "apiVersion": lws.get("apiVersion", ""),
+                    "kind": "LeaderWorkerSet",
+                    "name": name,
+                    "uid": lws["metadata"].get("uid", ""),
+                    "controller": True,
+                }],
+            },
+            "status": {"phase": "Running", "podIP": "127.0.0.1"},
+        }
+        self.client.create(pod)
+        live = self.client.get("LeaderWorkerSet", self.namespace, name)
+        live["status"] = {"replicas": 1, "readyReplicas": 1}
+        self.client.update_status(live)
+        logger.info("podsim: %s serving on :%s", name, server.port)
+
+    def _reap(self, live_names: set) -> None:
+        for name in [n for n in self.servers if n not in live_names]:
+            try:
+                self.servers.pop(name).stop()
+                self.client.delete("Pod", self.namespace, f"{name}-0")
+            except Exception:
+                logger.exception("podsim reap of %s failed", name)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                lws_list = self.client.list("LeaderWorkerSet", self.namespace)
+                # prefillers first so decoders can resolve their upstream
+                lws_list.sort(
+                    key=lambda l: self._pod_labels(l).get(
+                        LABEL_COMPONENT_TYPE) != "prefiller"
+                )
+                for lws in lws_list:
+                    if lws["metadata"]["name"] not in self.servers:
+                        self._simulate(lws)
+                self._reap({l["metadata"]["name"] for l in lws_list})
+            except Exception:
+                logger.exception("podsim loop error")
+            self._stop.wait(self.poll_interval)

@@ -1,0 +1,192 @@
+"""In-process endpoint picker: executes a generated EndpointPickerConfig.
+
+Production uses the upstream EPP image (an Envoy ext-proc server,
+reference ``pkg/router/epp.go``); this module implements the same
+scoring semantics as an importable library so the full routing path —
+strategy YAML → filters → scorers → picker → chosen engine — is
+executable and testable in-process against real ``/metrics`` scrapes
+(``tests/test_e2e_serving.py``), and usable as a lightweight sidecar
+where running the EPP image is impractical.
+
+Implemented plugins (the set our strategy generator emits, validated by
+:mod:`fusioninfer_tpu.router.epp_schema`):
+
+* ``prefix-cache-scorer`` — upstream's block-hash affinity: the prompt
+  is chunked into ``hashBlockSize``-token blocks, chained hashes looked
+  up in a bounded per-picker LRU of block→endpoint; score = fraction of
+  leading blocks last served by that endpoint.  Picks record their
+  blocks, so repeat prefixes stick to the engine whose KV cache holds
+  them.
+* ``kv-cache-utilization-scorer`` — 1 − ``vllm:gpu_cache_usage_perc``.
+* ``queue-scorer`` — 1 / (1 + ``vllm:num_requests_waiting``).
+* ``lora-affinity-scorer`` — prefix-affinity over the adapter name.
+* ``by-label`` filters and scheduling profiles (the PD ``prefill`` /
+  ``decode`` split on ``fusioninfer.io/component-type``).
+* ``max-score-picker`` — weighted-sum argmax.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from fusioninfer_tpu.router.epp_schema import validate_epp_config
+
+
+@dataclass
+class Endpoint:
+    name: str
+    url: str
+    labels: dict
+
+
+def scrape_metrics(url: str, timeout: float = 5.0) -> dict[str, float]:
+    """Prometheus text → {metric_name_without_labels: value}."""
+    out: dict[str, float] = {}
+    try:
+        with urllib.request.urlopen(f"{url}/metrics", timeout=timeout) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line or line.startswith("#"):
+                    continue
+                head, _, value = line.rpartition(" ")
+                name = head.split("{", 1)[0]
+                try:
+                    out[name] = float(value)
+                except ValueError:
+                    continue
+    except Exception:
+        return {}
+    return out
+
+
+class _PrefixAffinity:
+    """Upstream prefix plugin semantics: chained block hashes → the
+    endpoint that last served them, in a bounded LRU."""
+
+    def __init__(self, block_size: int, max_blocks: int, lru_capacity: int):
+        self.block_size = max(1, block_size)
+        self.max_blocks = max(1, max_blocks)
+        self._lru: "collections.OrderedDict[str, str]" = collections.OrderedDict()
+        self._capacity = max(16, lru_capacity)
+
+    def _block_hashes(self, prompt: str) -> list[str]:
+        hashes, chain = [], b""
+        for i in range(0, min(len(prompt), self.block_size * self.max_blocks),
+                       self.block_size):
+            block = prompt[i : i + self.block_size].encode()
+            chain = hashlib.blake2b(chain + block, digest_size=16).digest()
+            hashes.append(chain.hex())
+        return hashes
+
+    def score(self, prompt: str, endpoint: Endpoint) -> float:
+        hashes = self._block_hashes(prompt)
+        if not hashes:
+            return 0.0
+        matched = 0
+        for h in hashes:  # leading consecutive blocks held by this endpoint
+            if self._lru.get(h) != endpoint.name:
+                break
+            matched += 1
+        return matched / len(hashes)
+
+    def record(self, prompt: str, endpoint: Endpoint) -> None:
+        for h in self._block_hashes(prompt):
+            self._lru.pop(h, None)
+            self._lru[h] = endpoint.name
+        while len(self._lru) > self._capacity:
+            self._lru.popitem(last=False)
+
+
+class EndpointPicker:
+    """Score-and-pick over live endpoints, per scheduling profile."""
+
+    def __init__(self, config_yaml: str,
+                 endpoints: Callable[[], list[Endpoint]],
+                 metrics: Callable[[Endpoint], dict] = None):
+        self.config = validate_epp_config(config_yaml)
+        self._endpoints = endpoints
+        self._metrics = metrics or (lambda ep: scrape_metrics(ep.url))
+        self._plugins = {
+            (p.get("name") or p["type"]): p for p in self.config.get("plugins", [])
+        }
+        self._profiles = {
+            prof["name"]: prof for prof in self.config.get("schedulingProfiles", [])
+        }
+        self._affinity: dict[str, _PrefixAffinity] = {}
+        for key, plugin in self._plugins.items():
+            if plugin["type"] in ("prefix-cache-scorer", "lora-affinity-scorer"):
+                params = plugin.get("parameters") or {}
+                self._affinity[key] = _PrefixAffinity(
+                    params.get("hashBlockSize", 64),
+                    params.get("maxPrefixBlocksToMatch", 256),
+                    params.get("lruCapacityPerServer", 31250),
+                )
+
+    # -- scoring --
+
+    def _score(self, key: str, plugin: dict, prompt: str,
+               ep: Endpoint, metrics: dict) -> float:
+        """Missing metrics score WORST, not best: an endpoint whose
+        scrape failed (crashed engine, stale Pod) must never outrank a
+        healthy loaded one — defaulting utilization/queue to zero would
+        hand a dead endpoint the maximum score."""
+        ptype = plugin["type"]
+        if ptype in ("prefix-cache-scorer", "lora-affinity-scorer"):
+            return self._affinity[key].score(prompt, ep)
+        if ptype == "kv-cache-utilization-scorer":
+            if "vllm:gpu_cache_usage_perc" not in metrics:
+                return 0.0  # unknown → assume full
+            return 1.0 - metrics["vllm:gpu_cache_usage_perc"]
+        if ptype == "queue-scorer":
+            if "vllm:num_requests_waiting" not in metrics:
+                return 0.0  # unknown → assume unbounded queue
+            return 1.0 / (1.0 + metrics["vllm:num_requests_waiting"])
+        return 0.0
+
+    def pick(self, prompt: str, profile: str = "default") -> Optional[Endpoint]:
+        """Run one scheduling profile: filters narrow the candidates,
+        scorers weight them, max-score-picker takes the argmax; the
+        chosen endpoint's prefix blocks are recorded for affinity."""
+        prof = self._profiles.get(profile) or next(iter(self._profiles.values()))
+        candidates = list(self._endpoints())
+        scorers: list[tuple[str, dict, float]] = []
+        for ref in prof.get("plugins", []):
+            plugin = self._plugins.get(ref["pluginRef"])
+            if plugin is None:
+                continue
+            if plugin["type"] == "by-label":
+                params = plugin.get("parameters") or {}
+                candidates = [
+                    ep for ep in candidates
+                    if ep.labels.get(params.get("label")) == params.get("value")
+                ]
+            elif plugin["type"].endswith("-scorer"):
+                scorers.append(
+                    (ref["pluginRef"], plugin, float(ref.get("weight", 1)))
+                )
+        if not candidates:
+            return None
+        best, best_score = None, float("-inf")
+        for ep in candidates:
+            metrics = self._metrics(ep) if any(
+                p["type"] in ("kv-cache-utilization-scorer", "queue-scorer")
+                for _, p, _ in scorers
+            ) else {}
+            total = sum(
+                w * self._score(key, plugin, prompt, ep, metrics)
+                for key, plugin, w in scorers
+            )
+            if total > best_score:
+                best, best_score = ep, total
+        for key, plugin, _ in scorers:
+            if key in self._affinity:
+                self._affinity[key].record(prompt, best)
+        return best
+
+    def pick_pd(self, prompt: str) -> tuple[Optional[Endpoint], Optional[Endpoint]]:
+        """PD profiles: the prefill leg's endpoint and the decode leg's."""
+        return self.pick(prompt, "prefill"), self.pick(prompt, "decode")

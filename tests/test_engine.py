@@ -537,3 +537,21 @@ class TestBatchedPrefill:
         # the dedup must actually have happened: requests q and r served
         # their page-aligned prefix from the cache, not fresh prefills
         assert burst.prefix_cache_hit_rate() > 0.0
+
+    def test_burst_over_capacity_requeues_instead_of_failing(self):
+        """Pop-time can_admit can pass for a whole burst whose later
+        members then lose the page race: those must WAIT (requeue, FCFS),
+        not receive terminal errors — the serial path's semantics."""
+        # 15 usable pages of 8 tokens; each request needs 4 pages (prompt
+        # 25 + 1 token); three fit only 3x4=12 <= 15, a 4th must wait
+        tight = CacheConfig(n_pages=16, page_size=8, max_pages_per_seq=8)
+        engine = make_engine(cache_cfg=tight, max_batch_size=4,
+                             enable_prefix_caching=False)
+        sp = SamplingParams(temperature=0.0, max_tokens=2)
+        for i in range(4):
+            engine.add_request(Request(f"r{i}", [i + 1] * 25, sp))
+        outputs, finished = run_to_completion(engine, max_steps=300)
+        assert set(finished) == {"r0", "r1", "r2", "r3"}
+        assert all(not (fr or "").startswith("error")
+                   for fr in finished.values()), finished
+        assert engine.errors_total == 0
