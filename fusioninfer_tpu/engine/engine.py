@@ -72,6 +72,8 @@ class StepOutput:
     finished: bool
     finish_reason: Optional[str] = None
     is_first_token: bool = False
+    logprob: Optional[float] = None  # set when the request asked for logprobs
+    top_logprobs: Optional[dict[int, float]] = None  # token id -> logprob
 
 
 @dataclass
@@ -684,6 +686,15 @@ class NativeEngine:
         n_prompt = len(request.prompt_tokens)
         token = self._sample_first_token(logits, request, prefix, seq_seed,
                                          n_prompt=n_prompt)
+        lp = tops = None
+        n_lp = request.params.logprobs
+        if n_lp is not None:
+            raw = jax.nn.log_softmax(logits[0].astype(jnp.float32))
+            lp = float(raw[token])
+            if n_lp:
+                vals, ids = jax.lax.top_k(raw, n_lp)
+                tops = {int(t): float(v) for t, v in
+                        zip(np.asarray(ids), np.asarray(vals))}
         slot = self._free_slots.pop()
         state = _SeqState(
             request=request,
@@ -698,7 +709,8 @@ class NativeEngine:
         if not resumed:
             self.prompt_tokens_total += len(prefix)
         self.generation_tokens_total += 1
-        return self._emit(state, token, first=not resumed)
+        return self._emit(state, token, first=not resumed,
+                          logprob=lp, top_logprobs=tops)
 
     # -- decode --------------------------------------------------------------
 
@@ -746,6 +758,15 @@ class NativeEngine:
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
             jnp.asarray(active), mesh=self._kernel_mesh,
         )
+        # raw-distribution logprobs, computed only when someone asked
+        lp_n = max((st.request.params.logprobs or 0 for st in live.values()),
+                   default=0)
+        raw_logp = top_lp = None
+        if lp_n or any(st.request.params.logprobs is not None
+                       for st in live.values()):
+            raw_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            if lp_n:
+                top_lp = jax.lax.top_k(raw_logp, lp_n)
         logits = apply_penalties(
             logits, self._token_counts, self._output_counts,
             jnp.asarray(presence), jnp.asarray(frequency), jnp.asarray(repetition),
@@ -764,13 +785,24 @@ class NativeEngine:
             live_slots, sampled_dev[live_slots]
         ].add(1)
         sampled = np.asarray(sampled_dev)
+        if raw_logp is not None:
+            chosen_lp = np.asarray(raw_logp[jnp.arange(B), sampled_dev])
+            top_vals = np.asarray(top_lp[0]) if top_lp is not None else None
+            top_ids = np.asarray(top_lp[1]) if top_lp is not None else None
 
         outputs = list(failures)
         for slot, st in live.items():
             token = int(sampled[slot])
             st.tokens.append(token)
             self.generation_tokens_total += 1
-            outputs.append(self._emit(st, token))
+            lp = tops = None
+            n = st.request.params.logprobs
+            if raw_logp is not None and n is not None:
+                lp = float(chosen_lp[slot])
+                if n and top_ids is not None:
+                    tops = {int(t): float(v) for t, v in
+                            zip(top_ids[slot][:n], top_vals[slot][:n])}
+            outputs.append(self._emit(st, token, logprob=lp, top_logprobs=tops))
         return outputs
 
     def _ensure_decode_capacity(self) -> list[StepOutput]:
@@ -807,7 +839,8 @@ class NativeEngine:
 
     # -- bookkeeping ---------------------------------------------------------
 
-    def _emit(self, state: _SeqState, token: int, first: bool = False) -> StepOutput:
+    def _emit(self, state: _SeqState, token: int, first: bool = False,
+              logprob=None, top_logprobs=None) -> StepOutput:
         params = state.request.params
         finish_reason = None
         if token in params.stop_token_ids:
@@ -822,6 +855,8 @@ class NativeEngine:
             finished=finish_reason is not None,
             finish_reason=finish_reason,
             is_first_token=first,
+            logprob=logprob,
+            top_logprobs=top_logprobs,
         )
 
     def _finish(self, state: _SeqState, outcome: str = "finished") -> None:

@@ -25,10 +25,16 @@ class SamplingParams:
     max_tokens: int = 128
     min_tokens: int = 0  # stop tokens suppressed until this many generated
     stop_token_ids: tuple[int, ...] = ()
+    # decoded-text stop sequences (OpenAI `stop`): matched by the SERVER,
+    # which cancels engine-side work on a hit — the engine is text-blind
+    stop_strings: tuple[str, ...] = ()
     presence_penalty: float = 0.0  # subtract once per seen token id
     frequency_penalty: float = 0.0  # subtract per occurrence
     repetition_penalty: float = 1.0  # HF-style multiplicative, 1 = off
     seed: Optional[int] = None  # per-request reproducibility
+    # OpenAI `logprobs`: return the sampled token's log-probability and
+    # the top-N alternatives per step (raw model distribution)
+    logprobs: Optional[int] = None
 
     @property
     def greedy(self) -> bool:

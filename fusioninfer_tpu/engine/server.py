@@ -73,6 +73,29 @@ class _RequestChannel:
                 return
 
 
+def _find_stop(text: str, stops) -> int | None:
+    """Earliest index where any stop sequence begins, or None."""
+    best = None
+    for stop in stops:
+        i = text.find(stop)
+        if i != -1 and (best is None or i < best):
+            best = i
+    return best
+
+
+def _held_back(text: str, stops) -> int:
+    """Length of the longest text suffix that could still grow into a
+    stop sequence — streamed deltas must hold it back so a stop split
+    across tokens is never emitted."""
+    held = 0
+    for stop in stops:
+        for k in range(min(len(stop) - 1, len(text)), 0, -1):
+            if text.endswith(stop[:k]):
+                held = max(held, k)
+                break
+    return held
+
+
 class EngineServer:
     def __init__(
         self,
@@ -267,15 +290,22 @@ class EngineServer:
         """Idempotent teardown for a client that went away: unregister the
         channel AND cancel the engine-side work so dead clients don't burn
         decode steps."""
-        with self._lock:
-            rids = [rid for rid, c in self._channels.items() if c is chan]
-        for rid in rids:
-            self.engine.cancel(rid)
+        self._cancel_chan(chan)
         self._release(chan)
 
     def _sampling_params(self, body: dict) -> SamplingParams:
         stop_ids = [self.tokenizer.eos_token_id]
         seed = body.get("seed")
+        stop = body.get("stop") or ()
+        if isinstance(stop, str):
+            stop = (stop,)
+        elif not isinstance(stop, (list, tuple)):
+            raise ValueError("stop must be a string or a list of strings")
+        if any(not isinstance(x, str) or not x for x in stop):
+            raise ValueError("stop sequences must be non-empty strings")
+        logprobs = body.get("logprobs")
+        if logprobs is not None:
+            logprobs = max(0, min(int(logprobs), 5))  # OpenAI caps at 5
         return SamplingParams(
             temperature=float(body.get("temperature", 1.0)),
             top_k=int(body.get("top_k", 0)),
@@ -283,11 +313,19 @@ class EngineServer:
             max_tokens=int(body.get("max_tokens", 128)),
             min_tokens=int(body.get("min_tokens", 0)),
             stop_token_ids=tuple(stop_ids),
+            stop_strings=tuple(str(x) for x in stop),
             presence_penalty=float(body.get("presence_penalty", 0.0)),
             frequency_penalty=float(body.get("frequency_penalty", 0.0)),
             repetition_penalty=float(body.get("repetition_penalty", 1.0)),
             seed=int(seed) if seed is not None else None,
+            logprobs=logprobs,
         )
+
+    def _cancel_chan(self, chan: "_RequestChannel") -> None:
+        with self._lock:
+            rids = [rid for rid, c in self._channels.items() if c is chan]
+        for rid in rids:
+            self.engine.cancel(rid)
 
     def stream_completion(self, body: dict, chat: bool = False):
         """SSE source: returns ``(channel, generator)`` of OpenAI-style
@@ -310,26 +348,43 @@ class EngineServer:
         params = self._sampling_params(body)
         prompt_tokens = self.tokenizer.encode(prompt)
         chan = self.submit(prompt_tokens, params)  # raises ValueError on rejection
-        return chan, self._stream_chunks(chan, chat)
+        return chan, self._stream_chunks(chan, chat, params.stop_strings)
 
-    def _stream_chunks(self, chan: _RequestChannel, chat: bool):
+    def _stream_chunks(self, chan: _RequestChannel, chat: bool,
+                       stops: tuple = ()):
         completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         created = int(time.time())
         tokens: list[int] = []
-        emitted_text = ""
+        emitted = 0  # chars already sent
         try:
             for out in chan.stream():
                 if not (out.finished and out.finish_reason == "stop"
                         and out.token == self.tokenizer.eos_token_id):
                     tokens.append(out.token)
                 full = self.tokenizer.decode(tokens)
-                delta, emitted_text = full[len(emitted_text):], full
                 finish = (out.finish_reason or "length") if out.finished else None
+                if stops:
+                    hit = _find_stop(full, stops)
+                    if hit is not None:
+                        # OpenAI semantics: the stop sequence is excluded
+                        full, finish = full[:hit], "stop"
+                        self._cancel_chan(chan)
+                    elif not out.finished:
+                        full = full[: len(full) - _held_back(full, stops)]
+                delta, emitted = full[emitted:], len(full)
+                lp = None
+                if out.logprob is not None:
+                    tok_piece = (self.tokenizer.decode([out.token])
+                                 or f"<token_{out.token}>")
+                    lp = {"tokens": [tok_piece],
+                          "token_logprobs": [out.logprob],
+                          "top_logprobs": [out.top_logprobs or {}]}
                 if chat:
                     choice = {"index": 0, "delta": {"content": delta}, "finish_reason": finish}
                     obj = "chat.completion.chunk"
                 else:
-                    choice = {"index": 0, "text": delta, "finish_reason": finish}
+                    choice = {"index": 0, "text": delta, "finish_reason": finish,
+                              "logprobs": lp}
                     obj = "text_completion"
                 yield {
                     "id": completion_id,
@@ -338,7 +393,7 @@ class EngineServer:
                     "model": self.model_name,
                     "choices": [choice],
                 }
-                if out.finished:
+                if finish is not None:
                     break
         finally:
             self._release(chan)
@@ -352,23 +407,65 @@ class EngineServer:
         prompt_tokens = self.tokenizer.encode(prompt)
         chan = self.submit(prompt_tokens, params)
         tokens, finish_reason = [], "length"
+        # logprob/top arrays stay index-aligned with `tokens` at all times
+        # (None where unavailable, e.g. a PD-prefilled first token — the
+        # OpenAI convention), so trims below apply to all three in lockstep
+        token_lps: list = []
+        top_lps: list = []
+        stop_cut = None
+        max_stop = max((len(x) for x in params.stop_strings), default=0)
         try:
             for out in chan.stream():
                 tokens.append(out.token)
+                token_lps.append(out.logprob)
+                top_lps.append(out.top_logprobs or {})
+                if params.stop_strings:
+                    # full decode is O(len) for the byte tokenizer; the
+                    # SEARCH is bounded to a tail window so it stays linear
+                    full = self.tokenizer.decode(tokens)
+                    window = max_stop + 64  # slack for multi-char token pieces
+                    hit = _find_stop(full[-window:], params.stop_strings)
+                    if hit is not None:
+                        stop_cut = len(full) - min(window, len(full)) + hit
+                        finish_reason = "stop"
+                        self._cancel_chan(chan)
+                        break
                 if out.finished:
                     finish_reason = out.finish_reason or "length"
         finally:
             self._release(chan)
         if finish_reason == "stop" and tokens and tokens[-1] == self.tokenizer.eos_token_id:
-            tokens = tokens[:-1]
+            tokens, token_lps, top_lps = tokens[:-1], token_lps[:-1], top_lps[:-1]
         text = self.tokenizer.decode(tokens)
+        if stop_cut is not None:
+            text = text[:stop_cut]  # stop sequence excluded (OpenAI)
+            # drop trailing tokens whose text lies entirely past the cut
+            while tokens and len(self.tokenizer.decode(tokens[:-1])) >= stop_cut:
+                tokens, token_lps, top_lps = tokens[:-1], token_lps[:-1], top_lps[:-1]
+        logprobs_obj = None
+        if params.logprobs is not None and tokens:
+            def piece(t: int) -> str:
+                # ids with no text form get a unique placeholder so the
+                # top_logprobs dict never collapses distinct alternatives
+                return self.tokenizer.decode([t]) or f"<token_{t}>"
+
+            logprobs_obj = {
+                "tokens": [piece(t) for t in tokens],
+                "token_logprobs": token_lps,
+                "top_logprobs": [
+                    {piece(t): lp for t, lp in tops.items()} if tops else None
+                    for tops in top_lps
+                ],
+                "text_offset": [],
+            }
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:12]}",
             "object": "text_completion",
             "created": int(time.time()),
             "model": self.model_name,
             "choices": [
-                {"index": 0, "text": text, "finish_reason": finish_reason, "logprobs": None}
+                {"index": 0, "text": text, "finish_reason": finish_reason,
+                 "logprobs": logprobs_obj}
             ],
             "usage": {
                 "prompt_tokens": len(prompt_tokens),

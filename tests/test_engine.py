@@ -555,3 +555,144 @@ class TestBatchedPrefill:
         assert all(not (fr or "").startswith("error")
                    for fr in finished.values()), finished
         assert engine.errors_total == 0
+
+
+class TestStopStringsAndLogprobs:
+    """OpenAI `stop` sequences and `logprobs` on the completions API."""
+
+    class _LetterTokenizer:
+        """Every id decodes to a letter, so random-weight generations
+        always produce deterministic, searchable text."""
+
+        PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
+        eos_token_id = 10_000  # never sampled from the tiny vocab
+
+        @property
+        def vocab_size(self):
+            return 4096
+
+        def encode(self, text, add_bos=True):
+            return [1] + [3 + (ord(c) % 200) for c in text]
+
+        def decode(self, ids):
+            return "".join(chr(ord("a") + (i % 26)) for i in ids)
+
+    def _serve(self):
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=make_engine(),
+                           tokenizer=self._LetterTokenizer())
+        srv.start()
+        return srv
+
+    def _post(self, srv, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    def test_stop_string_truncates_and_cancels(self):
+        srv = self._serve()
+        try:
+            # discover some greedy output text, then stop on a piece of it
+            base = self._post(srv, {"prompt": "abc", "max_tokens": 10,
+                                    "temperature": 0.0})["choices"][0]
+            text = base["text"]
+            assert len(text) == 10  # every token decodes to one letter
+            stop = text[1:3]
+            out = self._post(srv, {"prompt": "abc", "max_tokens": 10,
+                                   "temperature": 0.0,
+                                   "stop": stop})["choices"][0]
+            assert out["finish_reason"] == "stop"
+            assert stop not in out["text"]  # excluded, text truncated before it
+            assert text.startswith(out["text"])
+        finally:
+            srv.stop()
+
+    def test_logprobs_shape_and_consistency(self):
+        srv = self._serve()
+        try:
+            out = self._post(srv, {"prompt": "xyz", "max_tokens": 5,
+                                   "temperature": 0.0,
+                                   "logprobs": 3})["choices"][0]
+            lp = out["logprobs"]
+            assert lp is not None
+            assert len(lp["token_logprobs"]) == 5
+            assert all(isinstance(v, float) and v <= 0.0
+                       for v in lp["token_logprobs"])
+            assert all(len(t) <= 3 for t in lp["top_logprobs"])
+            # greedy: the chosen token's logprob must equal the max of its
+            # top-logprobs row
+            for chosen, tops in zip(lp["token_logprobs"], lp["top_logprobs"]):
+                if tops:
+                    assert abs(chosen - max(tops.values())) < 1e-4
+        finally:
+            srv.stop()
+
+    def test_logprobs_absent_when_not_requested(self):
+        srv = self._serve()
+        try:
+            out = self._post(srv, {"prompt": "q", "max_tokens": 3,
+                                   "temperature": 0.0})["choices"][0]
+            assert out["logprobs"] is None
+        finally:
+            srv.stop()
+
+    def test_stream_holds_back_partial_stop(self):
+        """A stop sequence split across streamed tokens must never reach
+        the client: deltas hold back any suffix that could grow into one."""
+        from fusioninfer_tpu.engine.server import _find_stop, _held_back
+
+        assert _find_stop("hello world", ("wor",)) == 6
+        assert _find_stop("hello", ("xyz",)) is None
+        assert _find_stop("a stop b stop", ("stop", "b ")) == 2
+        # "wo" could become "wor": hold 2 chars back
+        assert _held_back("hello wo", ("wor",)) == 2
+        assert _held_back("hello", ("xyz",)) == 0
+        assert _held_back("ab", ("abc", "bcd")) == 2
+
+    def test_streaming_stop_string_end_to_end(self):
+        srv = self._serve()
+        try:
+            base = self._post(srv, {"prompt": "abc", "max_tokens": 10,
+                                    "temperature": 0.0})["choices"][0]["text"]
+            stop = base[2:4]
+            body = json.dumps({"prompt": "abc", "max_tokens": 10,
+                               "temperature": 0.0, "stop": stop,
+                               "stream": True}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            text, finish = "", None
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                for raw in resp:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:") or line.endswith("[DONE]"):
+                        continue
+                    chunk = json.loads(line[5:])["choices"][0]
+                    text += chunk["text"]
+                    finish = chunk["finish_reason"] or finish
+            assert finish == "stop"
+            assert stop not in text
+            assert base.startswith(text)
+        finally:
+            srv.stop()
+
+    def test_invalid_stop_rejected_as_400(self):
+        srv = self._serve()
+        try:
+            for bad in (5, [""], [1]):
+                body = json.dumps({"prompt": "a", "max_tokens": 2,
+                                   "stop": bad}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    urllib.request.urlopen(req, timeout=30)
+                    assert False, f"stop={bad!r} accepted"
+                except urllib.error.HTTPError as e:
+                    assert e.code == 400, (bad, e.code)
+        finally:
+            srv.stop()
