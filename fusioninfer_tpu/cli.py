@@ -177,6 +177,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="disable automatic prefix caching (KV page reuse)")
     serve.add_argument("--enable-profiling", action="store_true",
                        help="expose /debug/profile (writes to FUSIONINFER_PROFILE_DIR)")
+    serve.add_argument("--lora", action="append", default=[],
+                       metavar="NAME=PATH",
+                       help="load a LoRA adapter (.npz, models.lora format); "
+                            "repeatable; requests select it via model=NAME")
     serve.add_argument("--load-hf", default="", help="HF checkpoint dir (safetensors)")
     serve.add_argument("--load-checkpoint", default="", help="native orbax checkpoint dir")
     serve.set_defaults(func=_cmd_engine_serve)

@@ -59,6 +59,10 @@ class Request:
     prompt_tokens: list[int]
     params: SamplingParams = field(default_factory=SamplingParams)
     arrival_time: float = field(default_factory=time.monotonic)
+    # LoRA adapter name ("" = base model); must be loaded in the engine's
+    # AdapterSet.  Prefix caching is namespaced per adapter — KV computed
+    # under different adapters never cross-hits.
+    lora: str = ""
     # Set on preemption: prompt + tokens generated so far.  On re-admission
     # the whole prefix is re-prefilled so generation continues exactly where
     # the client stream left off (no token splicing, RNG-safe).
@@ -100,6 +104,7 @@ class NativeEngine:
         seed: int = 0,
         mesh=None,
         enable_prefix_caching: bool = True,
+        lora_adapters: Optional[dict] = None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -109,11 +114,21 @@ class NativeEngine:
 
         ``enable_prefix_caching``: content-address full prompt pages and
         reuse the longest cached prefix across requests (the engine-side
-        realization of the router's prefix-cache strategy)."""
+        realization of the router's prefix-cache strategy).
+
+        ``lora_adapters``: name → adapter pytree (``models.lora``); loads
+        them into a batched AdapterSet so any mix of base and adapter
+        requests serves in one batch (the engine side of the router's
+        lora-affinity strategy)."""
         self.cfg = cfg.validate()
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
         self.mesh = mesh
+        self.lora_set = None
+        if lora_adapters:
+            from fusioninfer_tpu.models.lora import AdapterSet
+
+            self.lora_set = AdapterSet(self.cfg, lora_adapters)
         self._kernel_mesh = None
         if cfg.quantization != "none" and mesh is not None:
             # the sharding rules map named bf16 leaves; they don't know the
@@ -252,6 +267,14 @@ class NativeEngine:
         return fut
 
     def add_prefilled_request(self, request: Request, slab) -> None:
+        if request.lora:
+            # the prefill wire carries no adapter identity yet: decoding
+            # with adapter deltas over base-model KV would be silently
+            # wrong tokens — reject loudly instead
+            raise ValueError(
+                "LoRA adapters are not yet supported on the "
+                "PD-disaggregated prefill wire"
+            )
         """Decode-worker side: admit a request whose prefill (KV + first
         token) was computed remotely; generation continues from there."""
         if slab.page_size != self.cache_cfg.page_size:
@@ -432,7 +455,8 @@ class NativeEngine:
             request = self.waiting[0]
             prefix = request.resume_tokens or request.prompt_tokens
             # reuse-aware: a mostly-cached prompt needs few fresh pages
-            if not self.alloc.can_admit(prefix, 1):
+            if not self.alloc.can_admit(prefix, 1,
+                                        namespace=self._lora_ns(request)):
                 break  # wait for running work to finish or be preempted
             self.waiting.popleft()
             resumed = request.resume_tokens is not None
@@ -445,7 +469,7 @@ class NativeEngine:
             seen_prompts: set = set()
             stopped_at: Optional[int] = None
             for idx, (request, prefix, resumed) in enumerate(pending):
-                key = hash(tuple(prefix))
+                key = hash((request.lora, tuple(prefix)))
                 if self.prefix_caching and key in seen_prompts:
                     # a same-prompt request earlier in this round is about
                     # to register these pages: defer → next round hits
@@ -454,9 +478,11 @@ class NativeEngine:
                 rid = request.request_id
                 try:
                     reused = (
-                        self.alloc.match_prefix(rid, prefix)
+                        self.alloc.match_prefix(rid, prefix,
+                                                namespace=self._lora_ns(request))
                         if self.prefix_caching else 0
                     )
+                    self._adapter_id(request)  # validate before any compute
                     self.alloc.allocate(rid, len(prefix) + 1)
                 except MemoryError:
                     # capacity raced ahead of the pop-time can_admit check
@@ -514,6 +540,19 @@ class NativeEngine:
                 if resumed:
                     request.resume_tokens = list(prefix)
                 self.waiting.appendleft(request)
+
+    def _lora_ns(self, request: Request) -> bytes:
+        return f"lora:{request.lora}".encode() if request.lora else b""
+
+    def _adapter_id(self, request: Request) -> int:
+        if not request.lora:
+            return 0
+        if self.lora_set is None:
+            raise ValueError(
+                f"request names LoRA adapter {request.lora!r} but the engine "
+                "has no adapters loaded"
+            )
+        return self.lora_set.id_of(request.lora)
 
     def _fail_admission(self, request: Request, e: Exception) -> StepOutput:
         """Never lose a popped request silently: fail it to the client."""
@@ -622,11 +661,15 @@ class NativeEngine:
         bucket = pick_bucket(self.buckets, len(suffix))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : len(suffix)] = suffix
+        lora, ids = None, None
+        if self.lora_set is not None:
+            lora = self.lora_set.stacked
+            ids = jnp.asarray([self._adapter_id(request)], jnp.int32)
         self.cache, logits = prefill_suffix(
             self.cfg, self.cache_cfg, self.params, self.cache,
             jnp.asarray(padded), jnp.int32(reused_tokens),
             jnp.int32(len(suffix)), row,
-            mesh=self._kernel_mesh,
+            mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
         )
         return self._activate(request, prefix, resumed, logits)
 
@@ -645,15 +688,20 @@ class NativeEngine:
         padded = np.zeros((B, bucket), np.int32)
         rows = np.zeros((B, mp), np.int32)
         lens = np.zeros((B,), np.int32)
+        ids = np.zeros((B,), np.int32)
         for i, (request, prefix, _) in enumerate(items):
             padded[i, : len(prefix)] = prefix
             rows[i] = self.alloc.page_table_row(request.request_id)
             lens[i] = len(prefix)
+            ids[i] = self._adapter_id(request)
+        lora = self.lora_set.stacked if self.lora_set is not None else None
         try:
             self.cache, logits = prefill(
                 self.cfg, self.cache_cfg, self.params, self.cache,
                 jnp.asarray(padded), jnp.asarray(lens), jnp.asarray(rows),
                 mesh=self._kernel_mesh,
+                lora=lora,
+                adapter_ids=jnp.asarray(ids) if lora is not None else None,
             )
         except Exception as e:
             logger.exception("batched prefill of %d requests failed", B)
@@ -681,7 +729,8 @@ class NativeEngine:
         device-side sampling state, emit."""
         rid = request.request_id
         if self.prefix_caching:
-            self.alloc.register_blocks(rid, prefix)
+            self.alloc.register_blocks(rid, prefix,
+                                       namespace=self._lora_ns(request))
         seq_seed = self._request_seed(request)
         n_prompt = len(request.prompt_tokens)
         token = self._sample_first_token(logits, request, prefix, seq_seed,
@@ -735,6 +784,7 @@ class NativeEngine:
         min_toks = np.zeros((B,), np.int32)
         gen_counts = np.zeros((B,), np.int32)
         seeds = np.zeros((B,), np.uint32)
+        adapter_ids = np.zeros((B,), np.int32)
         for slot, st in live.items():
             tokens[slot] = st.tokens[-1]
             # the input token was sampled last step but its KV is not yet
@@ -752,11 +802,15 @@ class NativeEngine:
             min_toks[slot] = p.min_tokens
             gen_counts[slot] = st.n_generated
             seeds[slot] = st.seed
+            adapter_ids[slot] = self._adapter_id(st.request)
 
+        lora = self.lora_set.stacked if self.lora_set is not None else None
         self.cache, logits = decode_step(
             self.cfg, self.cache_cfg, self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
             jnp.asarray(active), mesh=self._kernel_mesh,
+            lora=lora,
+            adapter_ids=jnp.asarray(adapter_ids) if lora is not None else None,
         )
         # raw-distribution logprobs, computed only when someone asked
         lp_n = max((st.request.params.logprobs or 0 for st in live.values()),

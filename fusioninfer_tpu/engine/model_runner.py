@@ -46,6 +46,8 @@ def prefill(
     true_lens: jax.Array,  # [B] int32
     page_rows: jax.Array,  # [B, max_pages_per_seq]
     mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
+    lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
+    adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
 ):
     """Prefill B sequences in one forward; returns (cache, last-token
     logits [B, V]).
@@ -71,8 +73,13 @@ def prefill(
     slot_of_token = jnp.broadcast_to(token_idx % ps, (B, S))
 
     def body(x, inputs):
-        layer, k_cache_l, v_cache_l = inputs
-        out, (k, v) = layer_forward(cfg, layer, x, positions, mesh=mesh)
+        if lora is None:
+            layer, k_cache_l, v_cache_l = inputs
+            layer_lora = None
+        else:
+            layer, layer_lora, k_cache_l, v_cache_l = inputs
+        out, (k, v) = layer_forward(cfg, layer, x, positions, mesh=mesh,
+                                    lora=layer_lora, adapter_ids=adapter_ids)
         # head-major per-layer cache [KV, n_pages, ps, Hd]; k is
         # [B, S, KV, Hd] → scatter [KV, B, S, Hd] at [B, S] page/slot maps
         k_cache_l = k_cache_l.at[:, page_of_token, slot_of_token].set(
@@ -83,7 +90,10 @@ def prefill(
         )
         return out, (k_cache_l, v_cache_l)
 
-    x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    xs = ((params["layers"], cache["k"], cache["v"]) if lora is None
+          else (params["layers"], lora, cache["k"], cache["v"]))
+    x, scanned = lax.scan(body, x, xs)
+    k_cache, v_cache = scanned
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[jnp.arange(B), jnp.maximum(true_lens - 1, 0)]  # [B, D]
     return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
@@ -100,6 +110,9 @@ def prefill_suffix(
     true_len: jax.Array,  # scalar int32: real suffix length
     page_row: jax.Array,  # [max_pages_per_seq] — prefix pages already filled
     mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
+    lora=None,  # stacked AdapterSet tree; the cached prefix pages were
+    adapter_ids: jax.Array = None,  # written under THIS adapter (the
+    # engine namespaces the prefix cache per adapter)
 ):
     """Prefill a prompt SUFFIX against cached prefix pages (the automatic
     prefix-caching path): token i sits at global position ``start + i``,
@@ -139,11 +152,15 @@ def prefill_suffix(
     attend = ctx_idx <= positions[0][:, None]  # [C, T]
 
     def body(x, inputs):
-        layer, k_cache_l, v_cache_l = inputs
+        if lora is None:
+            layer, k_cache_l, v_cache_l = inputs
+            layer_lora = None
+        else:
+            layer, layer_lora, k_cache_l, v_cache_l = inputs
         from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
 
         layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
-        q, k, v = qkv_proj(cfg, layer, x, positions)
+        q, k, v = qkv_proj(cfg, layer, x, positions, layer_lora, adapter_ids)
 
         # head-major per-layer cache [KV, n_pages, ps, Hd]; k[0] is [C, KV, Hd]
         k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
@@ -180,10 +197,17 @@ def prefill_suffix(
                 jax.nn.softmax(scores, axis=-1).astype(dtype_ctx),
                 v_ctx,
             ).reshape(B, C, H * Hd)
-        x = x + attn @ layer["wo"]
+        out_proj = attn @ layer["wo"]
+        if layer_lora is not None:
+            from fusioninfer_tpu.models.lora import lora_delta
+
+            out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
+        x = x + out_proj
         return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
 
-    x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    xs = ((params["layers"], cache["k"], cache["v"]) if lora is None
+          else (params["layers"], lora, cache["k"], cache["v"]))
+    x, (k_cache, v_cache) = lax.scan(body, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]
     return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
@@ -200,6 +224,8 @@ def decode_step(
     page_tables: jax.Array,  # [B, max_pages_per_seq]
     active: jax.Array,  # [B] bool
     mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
+    lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
+    adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
 ):
     """One decode step for the whole running batch → (cache, logits [B, V])."""
     from fusioninfer_tpu.ops import dispatch, paged_decode_attention
@@ -226,12 +252,16 @@ def decode_step(
     attend = attend[:, None, None, :]  # [B, 1, 1, T]
 
     def body(x, inputs):
-        layer, k_cache_l, v_cache_l = inputs
+        if lora is None:
+            layer, k_cache_l, v_cache_l = inputs
+            layer_lora = None
+        else:
+            layer, layer_lora, k_cache_l, v_cache_l = inputs
         from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
 
         layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
         B_, S_, D_ = x.shape
-        q, k, v = qkv_proj(cfg, layer, x, pos)
+        q, k, v = qkv_proj(cfg, layer, x, pos, layer_lora, adapter_ids)
 
         # write this step's K/V into each sequence's page slot
         # (head-major cache [KV, n_pages, ps, Hd]; k[:, 0] is [B, KV, Hd])
@@ -267,10 +297,17 @@ def decode_step(
             scores = jnp.where(attend[:, :, None, :, :] * jnp.ones_like(scores, bool), scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
             attn = jnp.einsum("bkgst,kbtd->bskgd", probs, v_ctx).reshape(B_, 1, H * Hd)
-        x = x + attn @ layer["wo"]
+        out_proj = attn @ layer["wo"]
+        if layer_lora is not None:
+            from fusioninfer_tpu.models.lora import lora_delta
+
+            out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
+        x = x + out_proj
         return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
 
-    x, (k_cache, v_cache) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    xs = ((params["layers"], cache["k"], cache["v"]) if lora is None
+          else (params["layers"], lora, cache["k"], cache["v"]))
+    x, (k_cache, v_cache) = lax.scan(body, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = lm_head(cfg, params, x[:, 0])
     return {"k": k_cache, "v": v_cache}, logits

@@ -31,10 +31,16 @@ import numpy as np
 from fusioninfer_tpu.engine.kv_cache import CacheConfig, PageAllocator
 
 
-def block_hashes(tokens: list[int], page_size: int) -> list[bytes]:
-    """Hash chain over the FULL pages of ``tokens``."""
+def block_hashes(tokens: list[int], page_size: int,
+                 namespace: bytes = b"") -> list[bytes]:
+    """Hash chain over the FULL pages of ``tokens``.
+
+    ``namespace`` partitions the content address space: KV computed
+    under different LoRA adapters is different content for the same
+    tokens, so the engine passes the adapter name — base-model and
+    per-adapter prefixes never cross-hit."""
     out = []
-    parent = b"root"
+    parent = b"root" + namespace
     for i in range(len(tokens) // page_size):
         block = tokens[i * page_size : (i + 1) * page_size]
         h = hashlib.blake2b(digest_size=16)
@@ -87,7 +93,8 @@ class PrefixCachingAllocator(PageAllocator):
 
     # -- prefix matching -----------------------------------------------------
 
-    def match_prefix(self, seq_id: str, prompt_tokens: list[int]) -> int:
+    def match_prefix(self, seq_id: str, prompt_tokens: list[int],
+                     namespace: bytes = b"") -> int:
         """Acquire the longest cached page chain for this prompt; returns
         the number of prefix TOKENS covered (multiple of page_size, capped
         at ``len(prompt) - 1`` so the last token is always recomputed)."""
@@ -95,7 +102,7 @@ class PrefixCachingAllocator(PageAllocator):
         self.query_tokens_total += len(prompt_tokens)
         usable_blocks = max(0, (len(prompt_tokens) - 1) // ps)
         shared: list[int] = []
-        for h in block_hashes(prompt_tokens, ps)[:usable_blocks]:
+        for h in block_hashes(prompt_tokens, ps, namespace)[:usable_blocks]:
             page = self._hash_to_page.get(h)
             if page is None:
                 break
@@ -115,13 +122,14 @@ class PrefixCachingAllocator(PageAllocator):
         need = self.pages_needed(n_tokens)
         return need <= self.free_pages and need <= self.cache_cfg.max_pages_per_seq
 
-    def _peek_match(self, prompt_tokens: list[int]) -> tuple[int, int]:
+    def _peek_match(self, prompt_tokens: list[int],
+                    namespace: bytes = b"") -> tuple[int, int]:
         """(matched pages, matched pages currently evictable) — a dry run
         of :meth:`match_prefix` that acquires nothing."""
         ps = self.cache_cfg.page_size
         usable_blocks = max(0, (len(prompt_tokens) - 1) // ps)
         matched = evictable = 0
-        for h in block_hashes(prompt_tokens, ps)[:usable_blocks]:
+        for h in block_hashes(prompt_tokens, ps, namespace)[:usable_blocks]:
             page = self._hash_to_page.get(h)
             if page is None:
                 break
@@ -129,14 +137,15 @@ class PrefixCachingAllocator(PageAllocator):
             evictable += 1 if page in self._evictable else 0
         return matched, evictable
 
-    def can_admit(self, prompt_tokens: list, extra_tokens: int = 1) -> bool:
+    def can_admit(self, prompt_tokens: list, extra_tokens: int = 1,
+                  namespace: bytes = b"") -> bool:
         """Reuse-aware admission: a request whose prompt is mostly cached
         needs only the uncovered pages.  Matched-but-evictable pages count
         as free AND as matched, so subtract them from both sides."""
         need_total = self.pages_needed(len(prompt_tokens) + extra_tokens)
         if need_total > self.cache_cfg.max_pages_per_seq:
             return False
-        matched, evictable = self._peek_match(list(prompt_tokens))
+        matched, evictable = self._peek_match(list(prompt_tokens), namespace)
         return need_total - matched <= self.free_pages - evictable
 
     def allocate(self, seq_id: str, n_tokens: int) -> list[int]:
@@ -163,12 +172,13 @@ class PrefixCachingAllocator(PageAllocator):
 
     # -- publishing ----------------------------------------------------------
 
-    def register_blocks(self, seq_id: str, prompt_tokens: list[int]) -> None:
+    def register_blocks(self, seq_id: str, prompt_tokens: list[int],
+                        namespace: bytes = b"") -> None:
         """Content-address this sequence's full private prompt pages so
         later requests can share them (called once after prefill)."""
         ps = self.cache_cfg.page_size
         pages = self._owned.get(seq_id, [])
-        for i, h in enumerate(block_hashes(prompt_tokens, ps)):
+        for i, h in enumerate(block_hashes(prompt_tokens, ps, namespace)):
             if i >= len(pages):
                 break
             page = pages[i]

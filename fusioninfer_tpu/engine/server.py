@@ -173,7 +173,21 @@ class EngineServer:
 
     # -- request handling ----------------------------------------------------
 
-    def submit(self, prompt_tokens: list[int], params: SamplingParams) -> _RequestChannel:
+    def _lora_of(self, body: dict) -> str:
+        """OpenAI multi-LoRA convention: requesting `model: <adapter>`
+        serves through that adapter (vLLM does the same).  An unknown
+        model name is an error, not a silent base-model fallback — a
+        typo must never return wrong-model completions with a 200."""
+        name = body.get("model")
+        if name is None or name == self.model_name:
+            return ""
+        lora_set = getattr(self.engine, "lora_set", None)
+        if lora_set is not None and name in lora_set.names[1:]:
+            return name
+        raise ValueError(f"unknown model {name!r}; see /v1/models")
+
+    def submit(self, prompt_tokens: list[int], params: SamplingParams,
+               lora: str = "") -> _RequestChannel:
         request_id = uuid.uuid4().hex[:16]
         chan = _RequestChannel()
         with self._lock:
@@ -183,7 +197,7 @@ class EngineServer:
                 "last_token_time": time.monotonic(),
             }
         try:
-            request = Request(request_id, prompt_tokens, params)
+            request = Request(request_id, prompt_tokens, params, lora=lora)
             if self.prefill_upstream:
                 # PD decode role: pull KV from the prefiller over DCN
                 from fusioninfer_tpu.engine.kv_transfer import HTTPPullConnector
@@ -347,7 +361,8 @@ class EngineServer:
                 prompt = prompt[0] if prompt else ""
         params = self._sampling_params(body)
         prompt_tokens = self.tokenizer.encode(prompt)
-        chan = self.submit(prompt_tokens, params)  # raises ValueError on rejection
+        chan = self.submit(prompt_tokens, params,
+                           lora=self._lora_of(body))  # ValueError on rejection
         return chan, self._stream_chunks(chan, chat, params.stop_strings)
 
     def _stream_chunks(self, chan: _RequestChannel, chat: bool,
@@ -405,7 +420,7 @@ class EngineServer:
             prompt = prompt[0] if prompt else ""
         params = self._sampling_params(body)
         prompt_tokens = self.tokenizer.encode(prompt)
-        chan = self.submit(prompt_tokens, params)
+        chan = self.submit(prompt_tokens, params, lora=self._lora_of(body))
         tokens, finish_reason = [], "length"
         # logprob/top arrays stay index-aligned with `tokens` at all times
         # (None where unavailable, e.g. a PD-prefilled first token — the
@@ -523,15 +538,20 @@ class EngineServer:
                     self.end_headers()
                     self.wfile.write(data)
                 elif self.path == "/v1/models":
+                    models = [server.model_name]
+                    lora_set = getattr(server.engine, "lora_set", None)
+                    if lora_set is not None:
+                        models += lora_set.names[1:]  # adapters serve as models
                     self._send_json(
                         {
                             "object": "list",
                             "data": [
                                 {
-                                    "id": server.model_name,
+                                    "id": name,
                                     "object": "model",
                                     "owned_by": "fusioninfer-tpu",
                                 }
+                                for name in models
                             ],
                         }
                     )
@@ -681,6 +701,14 @@ def serve_from_args(args) -> int:
                 f"--tensor-parallel-size {tp} but only {len(devices)} devices visible"
             )
         mesh = build_mesh(infer_mesh_config(tp, tp=tp), devices[:tp])
+    lora_adapters = {}
+    for spec in getattr(args, "lora", None) or []:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(f"--lora expects NAME=PATH, got {spec!r}")
+        from fusioninfer_tpu.models.lora import load_adapter
+
+        lora_adapters[name] = load_adapter(path, cfg)
     cache_cfg = auto_cache_config(
         cfg,
         page_size=args.page_size,
@@ -695,6 +723,7 @@ def serve_from_args(args) -> int:
         cfg, cache_cfg=cache_cfg, max_batch_size=args.max_batch_size, seed=args.seed,
         mesh=mesh, params=params,
         enable_prefix_caching=not getattr(args, "no_prefix_caching", False),
+        lora_adapters=lora_adapters or None,
     )
     server = EngineServer(
         model=model_name,

@@ -214,22 +214,33 @@ def _attention(q, k, v, mask):
 
 
 def qkv_proj(
-    cfg: ModelConfig, layer: Params, x: jax.Array, positions: jax.Array
+    cfg: ModelConfig, layer: Params, x: jax.Array, positions: jax.Array,
+    lora: Params = None, adapter_ids: jax.Array = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Pre-norm + QKV projection + (optional) QK-norm + RoPE — shared by
     every execution path (full forward, paged prefill/suffix, decode) so
     model features can never drift between them.
 
     x: [B, S, D] → q [B, S, H, Hd], k/v [B, S, KV, Hd].
+    ``lora``: this layer's stacked adapter slice (``[N, d_in, r]`` per
+    projection) + per-row ``adapter_ids`` — batched multi-LoRA deltas on
+    the same normalized input the base matmuls consume.
     """
     B, S, _ = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     # invariant: callers (layer_forward / the model_runner scan bodies)
     # maybe_dequantize_tree the layer once at block entry
     h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = (h @ layer["wq"]).reshape(B, S, H, Hd)
-    k = (h @ layer["wk"]).reshape(B, S, KV, Hd)
-    v = (h @ layer["wv"]).reshape(B, S, KV, Hd)
+    q, k, v = h @ layer["wq"], h @ layer["wk"], h @ layer["wv"]
+    if lora is not None:
+        from fusioninfer_tpu.models.lora import lora_delta
+
+        q = q + lora_delta(lora, "wq", h, adapter_ids)
+        k = k + lora_delta(lora, "wk", h, adapter_ids)
+        v = v + lora_delta(lora, "wv", h, adapter_ids)
+    q = q.reshape(B, S, H, Hd)
+    k = k.reshape(B, S, KV, Hd)
+    v = v.reshape(B, S, KV, Hd)
     if cfg.qk_norm:
         q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
         k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
@@ -262,6 +273,8 @@ def layer_forward(
     mask: Optional[jax.Array] = None,
     kv: Optional[tuple[jax.Array, jax.Array]] = None,
     mesh=None,
+    lora: Params = None,
+    adapter_ids: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """One transformer block. Returns (output, (k, v)) for cache management.
 
@@ -277,7 +290,7 @@ def layer_forward(
     B, S, D = x.shape
 
     layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
-    q, k, v = qkv_proj(cfg, layer, x, positions)
+    q, k, v = qkv_proj(cfg, layer, x, positions, lora, adapter_ids)
 
     if kv is None:
         if mask is not None:
@@ -307,7 +320,12 @@ def layer_forward(
             raise ValueError("layer_forward with kv history requires a mask")
         attn_k, attn_v = kv
         attn = _attention(q, attn_k, attn_v, mask)
-    x = x + attn @ layer["wo"]
+    out_proj = attn @ layer["wo"]
+    if lora is not None:
+        from fusioninfer_tpu.models.lora import lora_delta
+
+        out_proj = out_proj + lora_delta(lora, "wo", attn, adapter_ids)
+    x = x + out_proj
     return x + mlp_block(cfg, layer, x), (k, v)
 
 
