@@ -175,6 +175,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     serve.add_argument("--no-prefix-caching", action="store_true",
                        help="disable automatic prefix caching (KV page reuse)")
+    serve.add_argument("--prefill-chunk-size", type=int, default=0,
+                       help="chunked prefill: prompts longer than this many "
+                            "tokens prefill in bounded chunks interleaved "
+                            "with decode steps (0 = monolithic prefill)")
     serve.add_argument("--enable-profiling", action="store_true",
                        help="expose /debug/profile (writes to FUSIONINFER_PROFILE_DIR)")
     serve.add_argument("--lora", action="append", default=[],

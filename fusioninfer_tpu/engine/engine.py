@@ -94,6 +94,18 @@ class _SeqState:
         return len(self.tokens) - self.n_prompt
 
 
+@dataclass
+class _PrefillingState:
+    """A long prompt mid-chunked-prefill: pages are allocated, ``pos``
+    tokens are already written to the KV pages, no batch slot yet (one is
+    reserved — admission counts prefilling toward slot pressure)."""
+
+    request: Request
+    prefix: list[int]  # full token prefix to write (prompt, or resume tokens)
+    resumed: bool
+    pos: int  # next global position to write (starts at the reused length)
+
+
 class NativeEngine:
     def __init__(
         self,
@@ -105,6 +117,8 @@ class NativeEngine:
         mesh=None,
         enable_prefix_caching: bool = True,
         lora_adapters: Optional[dict] = None,
+        prefill_chunk_size: Optional[int] = None,
+        prefill_chunks_per_step: int = 1,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -119,7 +133,24 @@ class NativeEngine:
         ``lora_adapters``: name → adapter pytree (``models.lora``); loads
         them into a batched AdapterSet so any mix of base and adapter
         requests serves in one batch (the engine side of the router's
-        lora-affinity strategy)."""
+        lora-affinity strategy).
+
+        ``prefill_chunk_size``: when set, a prompt (or prefix-cache-miss
+        suffix) longer than this many tokens prefills in bounded chunks
+        spread across successive steps instead of one monolithic forward
+        — running sequences keep decoding between chunks, so a long
+        prompt arriving mid-stream cannot stall every other client's
+        inter-token latency for its whole prefill (vLLM's chunked-prefill
+        capability, which the reference only orchestrates — pod templates
+        pass ``--enable-chunked-prefill`` through,
+        ``/root/reference/docs/.../core-design.md:29``).  Each chunk is a
+        suffix prefill at the chunk's start position, so the compiled
+        signatures are the same suffix buckets the prefix-cache path
+        already uses.  ``prefill_chunks_per_step`` bounds how many chunk
+        forwards one step may run (default 1 = strictest ITL bound).
+        Duplicate prompts that arrive while a twin is still mid-chunk
+        prefill independently (in-flight pages register in the prefix
+        cache only on completion)."""
         self.cfg = cfg.validate()
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
@@ -219,6 +250,11 @@ class NativeEngine:
         self._free_slots = list(reversed(range(max_batch_size)))
         self._cancelled: set[str] = set()
         self._lock = threading.Lock()
+        if prefill_chunk_size is not None and prefill_chunk_size < 1:
+            raise ValueError("prefill_chunk_size must be >= 1")
+        self.prefill_chunk = prefill_chunk_size
+        self.prefill_chunks_per_step = max(1, prefill_chunks_per_step)
+        self.prefilling: list[_PrefillingState] = []  # FCFS chunk queue
 
         # counters consumed by /metrics
         self.prompt_tokens_total = 0
@@ -250,11 +286,20 @@ class NativeEngine:
     def num_running(self) -> int:
         return len(self.running)
 
+    @property
+    def num_prefilling(self) -> int:
+        return len(self.prefilling)
+
     def has_work(self) -> bool:
         return bool(
             self.waiting or self.waiting_prefilled or self.running
-            or not self._slab_q.empty()
+            or self.prefilling or not self._slab_q.empty()
         )
+
+    def _avail_slots(self) -> int:
+        """Free batch slots minus one reserved per mid-prefill sequence
+        (guarantees every chunked prefill can activate on completion)."""
+        return len(self._free_slots) - len(self.prefilling)
 
     # -- PD disaggregation ---------------------------------------------------
 
@@ -267,6 +312,8 @@ class NativeEngine:
         return fut
 
     def add_prefilled_request(self, request: Request, slab) -> None:
+        """Decode-worker side: admit a request whose prefill (KV + first
+        token) was computed remotely; generation continues from there."""
         if request.lora:
             # the prefill wire carries no adapter identity yet: decoding
             # with adapter deltas over base-model KV would be silently
@@ -275,8 +322,6 @@ class NativeEngine:
                 "LoRA adapters are not yet supported on the "
                 "PD-disaggregated prefill wire"
             )
-        """Decode-worker side: admit a request whose prefill (KV + first
-        token) was computed remotely; generation continues from there."""
         if slab.page_size != self.cache_cfg.page_size:
             raise ValueError(
                 f"slab page_size {slab.page_size} != engine page_size "
@@ -346,7 +391,7 @@ class NativeEngine:
         from fusioninfer_tpu.engine.kv_transfer import inject_slab
 
         outputs = []
-        while self.waiting_prefilled and self._free_slots:
+        while self.waiting_prefilled and self._avail_slots() > 0:
             with self._lock:
                 request, slab = self.waiting_prefilled[0]
                 prefix = slab.prompt_tokens
@@ -406,6 +451,7 @@ class NativeEngine:
         outputs: list[StepOutput] = []
         outputs += self._admit_prefilled()
         outputs += self._admit()
+        outputs += self._advance_prefilling()
         outputs += self._decode()
         return [o for o in outputs if o is not None]
 
@@ -430,6 +476,16 @@ class NativeEngine:
                       if s.request.request_id in cancelled]:
             self._finish(state, outcome="cancelled")
             logger.info("cancelled %s", state.request.request_id)
+        if self.prefilling:
+            kept_pf = []
+            for st in self.prefilling:
+                if st.request.request_id in cancelled:
+                    self.alloc.release(st.request.request_id)
+                    self.cancelled_total += 1
+                    logger.info("cancelled %s mid-prefill", st.request.request_id)
+                else:
+                    kept_pf.append(st)
+            self.prefilling = kept_pf
 
     # -- scheduling ----------------------------------------------------------
 
@@ -451,7 +507,7 @@ class NativeEngine:
         """
         outputs: list[StepOutput] = []
         pending: list[tuple[Request, list[int], bool]] = []
-        while self.waiting and len(self._free_slots) > len(pending):
+        while self.waiting and self._avail_slots() > len(pending):
             request = self.waiting[0]
             prefix = request.resume_tokens or request.prompt_tokens
             # reuse-aware: a mostly-cached prompt needs few fresh pages
@@ -498,7 +554,17 @@ class NativeEngine:
                     self.alloc.release(rid)
                     outputs.append(self._fail_admission(request, e))
                     continue
-                if reused:
+                if (self.prefill_chunk is not None
+                        and len(prefix) - reused > self.prefill_chunk):
+                    # long fresh prompt or long cache-miss suffix: write it
+                    # in bounded chunks across steps (decode keeps running)
+                    if not reused:
+                        seen_prompts.add(key)
+                    self.prefilling.append(_PrefillingState(
+                        request=request, prefix=prefix, resumed=resumed,
+                        pos=reused,
+                    ))
+                elif reused:
                     try:
                         outputs.append(self._prefill_suffix_one(
                             request, prefix, resumed, reused))
@@ -565,11 +631,39 @@ class NativeEngine:
         )
 
     def _preempt_youngest(self, exclude_slot: int) -> bool:
-        """Release the youngest running sequence (≠ exclude) back to waiting."""
-        candidates = [s for s in self.running if s != exclude_slot]
-        if not candidates:
+        """Release the youngest sequence (≠ exclude) back to waiting.
+
+        Candidates are the running batch AND mid-chunked-prefill
+        sequences — a prefilling request holds its full page allocation
+        for many steps, and leaving it invisible here would let a newer
+        arrival starve older running work into ``error:kv_capacity``
+        (the exact inversion of the no-new-evicts-old invariant)."""
+        run_cands = [s for s in self.running if s != exclude_slot]
+        slot = (max(run_cands,
+                    key=lambda s: self.running[s].request.arrival_time)
+                if run_cands else None)
+        pf_idx = (max(range(len(self.prefilling)),
+                      key=lambda i: self.prefilling[i].request.arrival_time)
+                  if self.prefilling else None)
+        pick_prefilling = pf_idx is not None and (
+            slot is None
+            or self.prefilling[pf_idx].request.arrival_time
+            >= self.running[slot].request.arrival_time
+        )
+        if pick_prefilling:
+            st = self.prefilling.pop(pf_idx)
+            self.alloc.release(st.request.request_id)
+            self.preemptions_total += 1
+            # chunk progress is discarded; on re-admission the prefix
+            # re-prefills from scratch (resume state preserved verbatim)
+            if st.resumed:
+                st.request.resume_tokens = list(st.prefix)
+            self.waiting.appendleft(st.request)
+            logger.info("preempted %s mid-prefill for KV capacity",
+                        st.request.request_id)
+            return True
+        if slot is None:
             return False
-        slot = max(candidates, key=lambda s: self.running[s].request.arrival_time)
         state = self.running.pop(slot)
         self.alloc.release(state.request.request_id)
         self._free_slots.append(slot)
@@ -672,6 +766,49 @@ class NativeEngine:
             mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
         )
         return self._activate(request, prefix, resumed, logits)
+
+    def _advance_prefilling(self) -> list[StepOutput]:
+        """Run up to ``prefill_chunks_per_step`` chunk forwards, FCFS.
+        A sequence whose final chunk completes activates into the decode
+        batch (its reserved slot is guaranteed by ``_avail_slots``)."""
+        outputs: list[StepOutput] = []
+        budget = self.prefill_chunks_per_step
+        while budget > 0 and self.prefilling:
+            st = self.prefilling[0]
+            rid = st.request.request_id
+            try:
+                chunk = min(self.prefill_chunk, len(st.prefix) - st.pos)
+                row = jnp.asarray(self.alloc.page_table_row(rid))
+                suffix = st.prefix[st.pos : st.pos + chunk]
+                bucket = pick_bucket(self.buckets, chunk)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :chunk] = suffix
+                lora, ids = None, None
+                if self.lora_set is not None:
+                    lora = self.lora_set.stacked
+                    ids = jnp.asarray([self._adapter_id(st.request)], jnp.int32)
+                self.cache, logits = prefill_suffix(
+                    self.cfg, self.cache_cfg, self.params, self.cache,
+                    jnp.asarray(padded), jnp.int32(st.pos),
+                    jnp.int32(chunk), row,
+                    mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
+                )
+                st.pos += chunk
+                if st.pos == len(st.prefix):
+                    self.prefilling.pop(0)
+                    outputs.append(self._activate(
+                        st.request, st.prefix, st.resumed, logits))
+            except Exception as e:
+                logger.exception("chunked prefill of %s failed", rid)
+                # st is still the head on a chunk-forward failure but was
+                # already popped when _activate raised — never double-pop
+                # (that would drop the NEXT queue entry and leak its pages)
+                if self.prefilling and self.prefilling[0] is st:
+                    self.prefilling.pop(0)
+                self.alloc.release(rid)
+                outputs.append(self._fail_admission(st.request, e))
+            budget -= 1
+        return outputs
 
     def _prefill_fresh_group(
         self, bucket: int, items: list[tuple[Request, list[int], bool]]

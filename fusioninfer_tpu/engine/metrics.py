@@ -67,6 +67,9 @@ class EngineMetrics:
             "# HELP vllm:num_requests_waiting Number of requests waiting to be processed.",
             "# TYPE vllm:num_requests_waiting gauge",
             f"vllm:num_requests_waiting{{{labels}}} {engine.num_waiting}",
+            "# HELP fusioninfer:num_requests_prefilling Requests mid-chunked-prefill.",
+            "# TYPE fusioninfer:num_requests_prefilling gauge",
+            f"fusioninfer:num_requests_prefilling{{{labels}}} {engine.num_prefilling}",
             "# HELP vllm:gpu_cache_usage_perc KV-cache usage (1 = full).",
             "# TYPE vllm:gpu_cache_usage_perc gauge",
             f"vllm:gpu_cache_usage_perc{{{labels}}} {engine.kv_cache_usage():.6f}",

@@ -198,6 +198,14 @@ class EngineServer:
             }
         try:
             request = Request(request_id, prompt_tokens, params, lora=lora)
+            if lora and self.prefill_upstream:
+                # reject BEFORE the remote prefill RPC: the engine would
+                # refuse the adapter at admission anyway, and by then a
+                # full remote prefill + KV transfer has been burned
+                raise ValueError(
+                    "LoRA adapters are not yet supported on the "
+                    "PD-disaggregated prefill wire"
+                )
             if self.prefill_upstream:
                 # PD decode role: pull KV from the prefiller over DCN
                 from fusioninfer_tpu.engine.kv_transfer import HTTPPullConnector
@@ -361,12 +369,13 @@ class EngineServer:
                 prompt = prompt[0] if prompt else ""
         params = self._sampling_params(body)
         prompt_tokens = self.tokenizer.encode(prompt)
-        chan = self.submit(prompt_tokens, params,
-                           lora=self._lora_of(body))  # ValueError on rejection
-        return chan, self._stream_chunks(chan, chat, params.stop_strings)
+        lora = self._lora_of(body)  # ValueError on rejection
+        chan = self.submit(prompt_tokens, params, lora=lora)
+        return chan, self._stream_chunks(chan, chat, params.stop_strings,
+                                         served_model=lora or self.model_name)
 
     def _stream_chunks(self, chan: _RequestChannel, chat: bool,
-                       stops: tuple = ()):
+                       stops: tuple = (), served_model: str = ""):
         completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         created = int(time.time())
         tokens: list[int] = []
@@ -405,7 +414,9 @@ class EngineServer:
                     "id": completion_id,
                     "object": obj,
                     "created": created,
-                    "model": self.model_name,
+                    # echo the REQUESTED model (adapter name for LoRA
+                    # routing) — clients validate/account against it
+                    "model": served_model or self.model_name,
                     "choices": [choice],
                 }
                 if finish is not None:
@@ -420,7 +431,8 @@ class EngineServer:
             prompt = prompt[0] if prompt else ""
         params = self._sampling_params(body)
         prompt_tokens = self.tokenizer.encode(prompt)
-        chan = self.submit(prompt_tokens, params, lora=self._lora_of(body))
+        lora = self._lora_of(body)
+        chan = self.submit(prompt_tokens, params, lora=lora)
         tokens, finish_reason = [], "length"
         # logprob/top arrays stay index-aligned with `tokens` at all times
         # (None where unavailable, e.g. a PD-prefilled first token — the
@@ -477,7 +489,7 @@ class EngineServer:
             "id": f"cmpl-{uuid.uuid4().hex[:12]}",
             "object": "text_completion",
             "created": int(time.time()),
-            "model": self.model_name,
+            "model": lora or self.model_name,
             "choices": [
                 {"index": 0, "text": text, "finish_reason": finish_reason,
                  "logprobs": logprobs_obj}
@@ -500,7 +512,7 @@ class EngineServer:
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
             "created": completion["created"],
-            "model": self.model_name,
+            "model": completion["model"],
             "choices": [
                 {
                     "index": 0,
@@ -706,6 +718,13 @@ def serve_from_args(args) -> int:
         name, sep, path = spec.partition("=")
         if not sep or not name or not path:
             raise SystemExit(f"--lora expects NAME=PATH, got {spec!r}")
+        if name == model_name:
+            # model-name routing would shadow the adapter: requests for it
+            # would silently serve the base model with a 200
+            raise SystemExit(
+                f"--lora adapter name {name!r} collides with the served "
+                "model name; pick a distinct adapter name"
+            )
         from fusioninfer_tpu.models.lora import load_adapter
 
         lora_adapters[name] = load_adapter(path, cfg)
@@ -724,6 +743,7 @@ def serve_from_args(args) -> int:
         mesh=mesh, params=params,
         enable_prefix_caching=not getattr(args, "no_prefix_caching", False),
         lora_adapters=lora_adapters or None,
+        prefill_chunk_size=getattr(args, "prefill_chunk_size", 0) or None,
     )
     server = EngineServer(
         model=model_name,

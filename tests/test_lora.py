@@ -217,7 +217,11 @@ class TestServerRouting:
                     f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
                     headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=120) as r:
-                    return json.loads(r.read())["choices"][0]["text"]
+                    resp = json.loads(r.read())
+                # the response echoes the REQUESTED model name (OpenAI/vLLM
+                # convention), not the base model, for adapter accounting
+                assert resp["model"] == model
+                return resp["choices"][0]["text"]
 
             t_base1, t_ft = tokens("base"), tokens("ft")
             t_base2 = tokens("base")
