@@ -179,6 +179,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="chunked prefill: prompts longer than this many "
                             "tokens prefill in bounded chunks interleaved "
                             "with decode steps (0 = monolithic prefill)")
+    serve.add_argument("--speculative-ngram", type=int, default=0,
+                       help="speculative decoding: propose up to K draft "
+                            "tokens per greedy request by n-gram prompt "
+                            "lookup, verified in one forward (0 = off)")
     serve.add_argument("--enable-profiling", action="store_true",
                        help="expose /debug/profile (writes to FUSIONINFER_PROFILE_DIR)")
     serve.add_argument("--lora", action="append", default=[],

@@ -39,8 +39,10 @@ from fusioninfer_tpu.engine.model_runner import (
     prefill,
     prefill_buckets,
     prefill_suffix,
+    verify_step,
 )
 from fusioninfer_tpu.engine.prefix_cache import PrefixCachingAllocator
+from fusioninfer_tpu.engine.spec import NgramProposer
 from fusioninfer_tpu.engine.sampler import (
     SamplingParams,
     apply_penalties,
@@ -119,6 +121,7 @@ class NativeEngine:
         lora_adapters: Optional[dict] = None,
         prefill_chunk_size: Optional[int] = None,
         prefill_chunks_per_step: int = 1,
+        speculative_k: Optional[int] = None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -150,7 +153,15 @@ class NativeEngine:
         forwards one step may run (default 1 = strictest ITL bound).
         Duplicate prompts that arrive while a twin is still mid-chunk
         prefill independently (in-flight pages register in the prefix
-        cache only on completion)."""
+        cache only on completion).
+
+        ``speculative_k``: n-gram prompt-lookup speculative decoding —
+        propose up to k draft tokens per greedy sequence from its own
+        context (:class:`fusioninfer_tpu.engine.spec.NgramProposer`) and
+        verify them in ONE ``verify_step`` forward; every accepted draft
+        is a decode step skipped.  Greedy outputs are bit-identical with
+        speculation on or off.  Sampled/penalized/logprobs requests in
+        the same batch simply run unspeculated (drafts = 0)."""
         self.cfg = cfg.validate()
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
@@ -255,6 +266,12 @@ class NativeEngine:
         self.prefill_chunk = prefill_chunk_size
         self.prefill_chunks_per_step = max(1, prefill_chunks_per_step)
         self.prefilling: list[_PrefillingState] = []  # FCFS chunk queue
+        if speculative_k is not None and speculative_k < 1:
+            raise ValueError("speculative_k must be >= 1")
+        self.spec_k = speculative_k
+        self.proposer = NgramProposer() if speculative_k else None
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
 
         # counters consumed by /metrics
         self.prompt_tokens_total = 0
@@ -745,26 +762,34 @@ class NativeEngine:
         )
         self._suppress = self._suppress.at[slot].set(self._stop_suppress_row(params))
 
-    def _prefill_suffix_one(self, request: Request, prefix: list[int],
-                            resumed: bool, reused_tokens: int) -> StepOutput:
-        """Prefix-cache hit: prefill only the suffix against the cached
-        pages (positions [0, reused) already live there)."""
-        rid = request.request_id
-        row = jnp.asarray(self.alloc.page_table_row(rid))
-        suffix = prefix[reused_tokens:]
-        bucket = pick_bucket(self.buckets, len(suffix))
+    def _suffix_forward(self, request: Request, prefix: list[int],
+                        start: int, length: int) -> jax.Array:
+        """One suffix-prefill forward writing ``prefix[start:start+length]``
+        at global positions [start, start+length) → last-token logits.
+        Shared by the prefix-cache-hit path and the chunked-prefill loop
+        so bucket padding and LoRA plumbing can never drift between them."""
+        row = jnp.asarray(self.alloc.page_table_row(request.request_id))
+        suffix = prefix[start : start + length]
+        bucket = pick_bucket(self.buckets, length)
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(suffix)] = suffix
+        padded[0, :length] = suffix
         lora, ids = None, None
         if self.lora_set is not None:
             lora = self.lora_set.stacked
             ids = jnp.asarray([self._adapter_id(request)], jnp.int32)
         self.cache, logits = prefill_suffix(
             self.cfg, self.cache_cfg, self.params, self.cache,
-            jnp.asarray(padded), jnp.int32(reused_tokens),
-            jnp.int32(len(suffix)), row,
+            jnp.asarray(padded), jnp.int32(start), jnp.int32(length), row,
             mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
         )
+        return logits
+
+    def _prefill_suffix_one(self, request: Request, prefix: list[int],
+                            resumed: bool, reused_tokens: int) -> StepOutput:
+        """Prefix-cache hit: prefill only the suffix against the cached
+        pages (positions [0, reused) already live there)."""
+        logits = self._suffix_forward(request, prefix, reused_tokens,
+                                      len(prefix) - reused_tokens)
         return self._activate(request, prefix, resumed, logits)
 
     def _advance_prefilling(self) -> list[StepOutput]:
@@ -778,21 +803,8 @@ class NativeEngine:
             rid = st.request.request_id
             try:
                 chunk = min(self.prefill_chunk, len(st.prefix) - st.pos)
-                row = jnp.asarray(self.alloc.page_table_row(rid))
-                suffix = st.prefix[st.pos : st.pos + chunk]
-                bucket = pick_bucket(self.buckets, chunk)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :chunk] = suffix
-                lora, ids = None, None
-                if self.lora_set is not None:
-                    lora = self.lora_set.stacked
-                    ids = jnp.asarray([self._adapter_id(st.request)], jnp.int32)
-                self.cache, logits = prefill_suffix(
-                    self.cfg, self.cache_cfg, self.params, self.cache,
-                    jnp.asarray(padded), jnp.int32(st.pos),
-                    jnp.int32(chunk), row,
-                    mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
-                )
+                logits = self._suffix_forward(st.request, st.prefix,
+                                              st.pos, chunk)
                 st.pos += chunk
                 if st.pos == len(st.prefix):
                     self.prefilling.pop(0)
@@ -900,6 +912,22 @@ class NativeEngine:
 
     # -- decode --------------------------------------------------------------
 
+    def _spec_eligible(self, st: _SeqState) -> bool:
+        """Speculation is restricted to exact-equivalence territory:
+        greedy, penalty-free, no per-token logprobs, past min_tokens —
+        for these, draft acceptance by argmax comparison reproduces
+        sequential greedy decoding bit-for-bit.  (Penalized rows would
+        need position-wise count evolution inside the window; sampled
+        rows would need rejection sampling — both fall back to the
+        normal one-token path, losslessly.)"""
+        p = st.request.params
+        return (p.temperature == 0.0
+                and p.presence_penalty == 0.0
+                and p.frequency_penalty == 0.0
+                and p.repetition_penalty == 1.0
+                and p.logprobs is None
+                and st.n_generated >= p.min_tokens)
+
     def _decode(self) -> list[StepOutput]:
         failures = self._ensure_decode_capacity()
         live = {s: st for s, st in self.running.items()
@@ -941,14 +969,67 @@ class NativeEngine:
             seeds[slot] = st.seed
             adapter_ids[slot] = self._adapter_id(st.request)
 
+        # speculative drafts (greedy, penalty-free sequences only)
+        spec_drafts: dict[int, list[int]] = {}
+        if self.spec_k:
+            for slot, st in live.items():
+                if not self._spec_eligible(st):
+                    continue
+                # leave room for the bonus token within the output budget
+                room = st.request.params.max_tokens - st.n_generated - 1
+                room = min(room, self.spec_k,
+                           self.cache_cfg.max_len - len(st.tokens))
+                if room < 1:
+                    continue
+                d = self.proposer.propose(st.tokens, room)
+                # grow pages opportunistically; shrink drafts rather than
+                # preempt — speculation must never cost anyone else pages
+                while d:
+                    try:
+                        self.alloc.extend(st.request.request_id,
+                                          len(st.tokens) - 1, 1 + len(d))
+                        break
+                    except MemoryError:
+                        d.pop()
+                if d:
+                    spec_drafts[slot] = d
+                    page_tables[slot] = self.alloc.page_table_row(
+                        st.request.request_id)
+
         lora = self.lora_set.stacked if self.lora_set is not None else None
-        self.cache, logits = decode_step(
-            self.cfg, self.cache_cfg, self.params, self.cache,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
-            jnp.asarray(active), mesh=self._kernel_mesh,
-            lora=lora,
-            adapter_ids=jnp.asarray(adapter_ids) if lora is not None else None,
-        )
+        argmax_w = None
+        if self.spec_k:
+            # ALWAYS the verify scorer when speculation is on — even on
+            # steps with zero drafts — so a row's logits source never
+            # depends on whether a NEIGHBOR proposed drafts this step
+            # (the scorers agree only to float tolerance; a seeded
+            # sampled row must not flip tokens with batch composition)
+            C = self.spec_k + 1
+            window = np.zeros((B, C), np.int32)
+            counts_w = np.zeros((B,), np.int32)
+            for slot, st in live.items():
+                window[slot, 0] = st.tokens[-1]
+                counts_w[slot] = 1
+                for j, d in enumerate(spec_drafts.get(slot, [])):
+                    window[slot, 1 + j] = d
+                counts_w[slot] += len(spec_drafts.get(slot, []))
+            self.cache, logits_w = verify_step(
+                self.cfg, self.cache_cfg, self.params, self.cache,
+                jnp.asarray(window), jnp.asarray(positions),
+                jnp.asarray(counts_w), jnp.asarray(page_tables),
+                mesh=self._kernel_mesh, lora=lora,
+                adapter_ids=jnp.asarray(adapter_ids) if lora is not None else None,
+            )
+            argmax_w = np.asarray(jnp.argmax(logits_w, axis=-1))  # [B, C]
+            logits = logits_w[:, 0]
+        else:
+            self.cache, logits = decode_step(
+                self.cfg, self.cache_cfg, self.params, self.cache,
+                jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
+                jnp.asarray(active), mesh=self._kernel_mesh,
+                lora=lora,
+                adapter_ids=jnp.asarray(adapter_ids) if lora is not None else None,
+            )
         # raw-distribution logprobs, computed only when someone asked
         lp_n = max((st.request.params.logprobs or 0 for st in live.values()),
                    default=0)
@@ -983,6 +1064,28 @@ class NativeEngine:
 
         outputs = list(failures)
         for slot, st in live.items():
+            if argmax_w is not None and slot in spec_drafts:
+                # greedy burst: accepted drafts + the model's bonus token.
+                # argmax_w[slot, j] is the greedy token after consuming
+                # window[:j+1], so acceptance walks the window in order —
+                # bit-identical to sequential greedy decode_steps.
+                drafts = spec_drafts[slot]
+                self.spec_proposed_total += len(drafts)
+                accepted = 0
+                while (accepted < len(drafts)
+                       and drafts[accepted] == int(argmax_w[slot, accepted])):
+                    accepted += 1
+                burst = drafts[:accepted] + [int(argmax_w[slot, accepted])]
+                for i, tok in enumerate(burst):
+                    st.tokens.append(tok)
+                    self.generation_tokens_total += 1
+                    if i < accepted:  # EMITTED drafts only (a stop token
+                        self.spec_accepted_total += 1  # mid-burst discards the rest)
+                    out = self._emit(st, tok)
+                    outputs.append(out)
+                    if out.finished:
+                        break
+                continue
             token = int(sampled[slot])
             st.tokens.append(token)
             self.generation_tokens_total += 1

@@ -80,6 +80,10 @@ class EngineMetrics:
             f"vllm:prompt_tokens_total{{{labels}}} {engine.prompt_tokens_total}",
             "# TYPE vllm:generation_tokens_total counter",
             f"vllm:generation_tokens_total{{{labels}}} {engine.generation_tokens_total}",
+            "# TYPE vllm:spec_decode_num_draft_tokens_total counter",
+            f"vllm:spec_decode_num_draft_tokens_total{{{labels}}} {engine.spec_proposed_total}",
+            "# TYPE vllm:spec_decode_num_accepted_tokens_total counter",
+            f"vllm:spec_decode_num_accepted_tokens_total{{{labels}}} {engine.spec_accepted_total}",
             "# TYPE vllm:num_preemptions_total counter",
             f"vllm:num_preemptions_total{{{labels}}} {engine.preemptions_total}",
             "# TYPE vllm:request_success_total counter",

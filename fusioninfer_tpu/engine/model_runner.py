@@ -313,6 +313,123 @@ def decode_step(
     return {"k": k_cache, "v": v_cache}, logits
 
 
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
+def verify_step(
+    cfg: ModelConfig,
+    cache_cfg: CacheConfig,
+    params,
+    cache: dict,
+    tokens: jax.Array,  # [B, C] — last sampled token + draft tokens, padded
+    starts: jax.Array,  # [B] int32: global position of tokens[:, 0]
+    counts: jax.Array,  # [B] int32: real window length (0 = inactive slot)
+    page_tables: jax.Array,  # [B, max_pages_per_seq]
+    mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
+    lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
+    adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
+):
+    """Speculative-verification forward: score a C-token window per
+    sequence in ONE pass → (cache, logits [B, C, V]).
+
+    ``logits[b, i]`` is the model's next-token distribution after
+    consuming ``tokens[b, :i+1]`` — exactly what ``i+1`` sequential
+    ``decode_step`` calls would produce, at one weight-read instead of C
+    (decode is weight-bandwidth-bound, which is the whole speculative
+    win).  K/V for every real window token is scattered into the
+    sequence's pages; positions at/past ``counts[b]`` write the trash
+    page.  Rejected draft tokens need no rollback: their slots are
+    overwritten the next time those positions are written, and attention
+    masks by true length so stale entries are never read.
+
+    The capability matches vLLM's spec-decode scorer (delegated by the
+    reference, SURVEY §0 — the operator only passes engine flags
+    through); the TPU realization shares the decode kernel's head-major
+    page layout via :func:`fusioninfer_tpu.ops.paged_verify_attention`.
+    """
+    from fusioninfer_tpu.ops import dispatch, paged_verify_attention
+
+    B, C = tokens.shape
+    ps = cache_cfg.page_size
+    mp = page_tables.shape[1]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
+
+    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)  # [B, C, D]
+    offs = jnp.arange(C)[None, :]  # [1, C]
+    positions = starts[:, None] + offs  # [B, C]
+
+    live = offs < counts[:, None]  # [B, C]
+    write_page = jnp.where(
+        live,
+        jnp.take_along_axis(page_tables, positions // ps, axis=1),
+        cache_cfg.trash_page,
+    )
+    write_slot = positions % ps
+
+    # portable-path mask over the gathered [mp * ps] context
+    ctx_idx = jnp.arange(mp * ps)[None, None, :]  # [1, 1, T]
+    attend = ctx_idx <= positions[:, :, None]  # [B, C, T]
+
+    def body(x, inputs):
+        if lora is None:
+            layer, k_cache_l, v_cache_l = inputs
+            layer_lora = None
+        else:
+            layer, layer_lora, k_cache_l, v_cache_l = inputs
+        from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
+
+        layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
+        q, k, v = qkv_proj(cfg, layer, x, positions, layer_lora, adapter_ids)
+
+        # head-major cache [KV, n_pages, ps, Hd]; k is [B, C, KV, Hd]
+        k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
+            jnp.moveaxis(k, 2, 0)
+        )
+        v_cache_l = v_cache_l.at[:, write_page, write_slot].set(
+            jnp.moveaxis(v, 2, 0)
+        )
+
+        if use_kernel:
+            if mesh is not None:
+                from fusioninfer_tpu.ops.sharded import paged_verify_attention_tp
+
+                attn = paged_verify_attention_tp(
+                    mesh, q, k_cache_l, v_cache_l, page_tables, starts, counts,
+                    interpret=dispatch.kernel_interpret(),
+                )  # [B, C, H*Hd]
+            else:
+                attn = paged_verify_attention(
+                    q, k_cache_l, v_cache_l, page_tables, starts, counts,
+                    interpret=dispatch.kernel_interpret(),
+                )
+        else:
+            k_ctx = k_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)
+            v_ctx = v_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)
+            group = H // KV
+            qg = q.reshape(B, C, KV, group, Hd)
+            scores = jnp.einsum(
+                "bckgd,kbtd->bkgct", qg, k_ctx
+            ).astype(jnp.float32) / jnp.sqrt(Hd)
+            scores = jnp.where(attend[:, None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
+            attn = jnp.einsum("bkgct,kbtd->bckgd", probs, v_ctx).reshape(
+                B, C, H * Hd
+            )
+        out_proj = attn @ layer["wo"]
+        if layer_lora is not None:
+            from fusioninfer_tpu.models.lora import lora_delta
+
+            out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
+        x = x + out_proj
+        return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
+
+    xs = ((params["layers"], cache["k"], cache["v"]) if lora is None
+          else (params["layers"], lora, cache["k"], cache["v"]))
+    x, (k_cache, v_cache) = lax.scan(body, x, xs)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = lm_head(cfg, params, x)  # [B, C, V]
+    return {"k": k_cache, "v": v_cache}, logits
+
+
 def prefill_buckets(max_len: int, smallest: int = 32) -> list[int]:
     """Power-of-two padding buckets: each prompt compiles against the
     smallest bucket that holds it, bounding compile count to log2(max)."""

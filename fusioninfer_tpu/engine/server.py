@@ -658,6 +658,15 @@ class EngineServer:
             self.stop()
 
 
+def _nonneg_flag(args, name: str):
+    """0 = feature off (None); negative = clean CLI error, not an engine
+    traceback."""
+    val = getattr(args, name, 0)
+    if val < 0:
+        raise SystemExit(f"--{name.replace('_', '-')} must be >= 0")
+    return val or None
+
+
 def serve_from_args(args) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
     maybe_init_distributed()
@@ -743,7 +752,8 @@ def serve_from_args(args) -> int:
         mesh=mesh, params=params,
         enable_prefix_caching=not getattr(args, "no_prefix_caching", False),
         lora_adapters=lora_adapters or None,
-        prefill_chunk_size=getattr(args, "prefill_chunk_size", 0) or None,
+        prefill_chunk_size=_nonneg_flag(args, "prefill_chunk_size"),
+        speculative_k=_nonneg_flag(args, "speculative_ngram"),
     )
     server = EngineServer(
         model=model_name,

@@ -17,6 +17,8 @@ from fusioninfer_tpu.ops.flash_attention import (  # noqa: F401
 from fusioninfer_tpu.ops.paged_attention import (  # noqa: F401
     paged_decode_attention,
     paged_prefill_attention,
+    paged_verify_attention,
     reference_paged_attention,
     reference_paged_prefill_attention,
+    reference_paged_verify_attention,
 )

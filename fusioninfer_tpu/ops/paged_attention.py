@@ -348,6 +348,190 @@ def paged_prefill_attention(
     return out.reshape(C, H * Hd)
 
 
+def _verify_kernel(
+    # scalar prefetch
+    page_tables_ref,  # [B, mp] int32 (SMEM)
+    starts_ref,  # [B] int32 — global position of each sequence's query 0
+    counts_ref,  # [B] int32 — real queries this step (0 = inactive slot)
+    # inputs
+    q_ref,  # [C, 1, G, Hd] VMEM block (one sequence's query window)
+    k_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
+    v_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
+    # output
+    o_ref,  # [C, 1, G, Hd] VMEM block
+    # scratch
+    k_buf,  # [2, ps, Hd]
+    v_buf,
+    sem,  # [2, 2]
+    *,
+    window: int,
+    page_size: int,
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    start = starts_ref[b]
+    count = counts_ref[b]
+    n_used = jnp.where(count > 0, pl.cdiv(start + count, page_size), 0)
+
+    def dma(slot, p):
+        page = page_tables_ref[b, p]
+        return (
+            pltpu.make_async_copy(
+                k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                v_pages_ref.at[g, page], v_buf.at[slot], sem.at[slot, 1]
+            ),
+        )
+
+    @pl.when(n_used > 0)
+    def _start_first():
+        for c in dma(0, 0):
+            c.start()
+
+    G, Hd = q_ref.shape[2], q_ref.shape[3]
+    R = window * G
+    q = q_ref[:, 0].astype(jnp.float32).reshape(R, Hd) * sm_scale
+    row_pos = start + jax.lax.broadcasted_iota(
+        jnp.int32, (R, page_size), 0
+    ) // G
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = p % 2
+
+        @pl.when(p + 1 < n_used)
+        def _prefetch_next():
+            for c in dma((p + 1) % 2, p + 1):
+                c.start()
+
+        for c in dma(slot, p):
+            c.wait()
+        k = k_buf[slot]
+        v = v_buf[slot]
+
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R, ps]
+        ctx_pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (R, page_size), 1
+        )
+        s = jnp.where(ctx_pos <= row_pos, s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        pexp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(pexp, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((R, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((R, 1), jnp.float32)
+    a0 = jnp.zeros((R, Hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, a0))
+    out = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    o_ref[:, 0] = out.reshape(window, G, Hd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret")
+)
+def paged_verify_attention(
+    q: jax.Array,  # [B, C, H, Hd] — C-token verify window per sequence
+    k_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
+    v_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
+    page_tables: jax.Array,  # [B, max_pages] int32
+    starts: jax.Array,  # [B] int32 — global position of q[:, 0]
+    counts: jax.Array,  # [B] int32 — real window length (0 = inactive)
+    *,
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-query decode attention for speculative verification →
+    [B, C, H·Hd].
+
+    The batched middle ground between the single-query decode kernel and
+    the single-sequence suffix kernel: every sequence attends a short
+    window of C queries (the last sampled token + its draft tokens) at
+    per-sequence positions ``starts[b] + i`` over its own pages, causally.
+    Rows at/past ``counts[b]`` are padding with unspecified output;
+    ``counts[b] = 0`` marks an inactive slot (output zeros).  Equivalent
+    capability in the reference stack is vLLM's multi-query scorer for
+    spec decode (delegated, SURVEY §0); here it is an in-repo TPU kernel
+    sharing the decode kernel's head-major page layout.
+    """
+    B, C, H, Hd = q.shape
+    KV, _, page_size, _ = k_pages.shape
+    G = H // KV
+    sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
+
+    qg = q.reshape(B * C, KV, G, Hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, KV),
+        in_specs=[
+            pl.BlockSpec(
+                (C, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (C, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, Hd), k_pages.dtype),
+            pltpu.VMEM((2, page_size, Hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _verify_kernel,
+        window=C, page_size=page_size, sm_scale=sm_scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * C, KV, G, Hd), q.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), starts.astype(jnp.int32),
+      counts.astype(jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(B, C, H * Hd)
+
+
+def reference_paged_verify_attention(q, k_pages, v_pages, page_tables,
+                                     starts, counts):
+    """Gathered-context jnp oracle for the verify window.  Padding rows
+    (``i >= counts[b]``) and inactive slots are zeroed."""
+    B, C, H, Hd = q.shape
+    KV, _, ps, _ = k_pages.shape
+    G = H // KV
+    mp = page_tables.shape[1]
+    k_ctx = k_pages[:, page_tables].reshape(KV, B, mp * ps, Hd)
+    v_ctx = v_pages[:, page_tables].reshape(KV, B, mp * ps, Hd)
+    qg = q.reshape(B, C, KV, G, Hd)
+    s = jnp.einsum("bckgd,kbtd->bkgct", qg.astype(jnp.float32),
+                   k_ctx.astype(jnp.float32)) / jnp.sqrt(Hd)
+    pos_q = starts[:, None] + jnp.arange(C)[None, :]  # [B, C]
+    ctx = jnp.arange(mp * ps)
+    mask = ctx[None, None, :] <= pos_q[:, :, None]  # [B, C, T]
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgct,kbtd->bckgd", probs, v_ctx.astype(jnp.float32))
+    live = (jnp.arange(C)[None, :] < counts[:, None])  # [B, C]
+    out = out * live[:, :, None, None, None]
+    return out.reshape(B, C, H * Hd).astype(q.dtype)
+
+
 def reference_paged_prefill_attention(q, k_pages, v_pages, page_row, start,
                                       true_len):
     """Gathered-context jnp oracle for the suffix path (same math as

@@ -25,6 +25,7 @@ from fusioninfer_tpu.ops.flash_attention import flash_attention
 from fusioninfer_tpu.ops.paged_attention import (
     paged_decode_attention,
     paged_prefill_attention,
+    paged_verify_attention,
 )
 
 
@@ -117,3 +118,32 @@ def paged_prefill_attention_tp(
         check_vma=False,
     )
     return fn(q, k_pages, v_pages, page_row, start, true_len)
+
+
+def paged_verify_attention_tp(
+    mesh: Mesh,
+    q: jax.Array,  # [B, C, H, Hd] — H sharded over tp
+    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] — KV (leading) sharded over tp
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [B, mp] replicated
+    starts: jax.Array,  # [B] replicated
+    counts: jax.Array,  # [B] replicated
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-shard verify-window attention → [B, C, H·Hd] sharded on features."""
+    fn = shard_map(
+        partial(paged_verify_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, "tp", None),
+            P("tp", None, None, None),
+            P("tp", None, None, None),
+            P(None, None),
+            P(None),
+            P(None),
+        ),
+        out_specs=P(None, None, "tp"),
+        check_vma=False,
+    )
+    return fn(q, k_pages, v_pages, page_tables, starts, counts)

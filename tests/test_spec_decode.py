@@ -1,0 +1,259 @@
+"""Speculative decoding: n-gram proposer, verify_step, engine identity.
+
+The invariant everything hangs on: speculation may only SKIP decode
+steps, never change tokens.  Greedy output with speculation on must be
+bit-identical to speculation off; sampled/penalized requests in the same
+batch run unspeculated and keep their per-request RNG streams intact.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import CacheConfig, PageAllocator, init_kv_cache
+from fusioninfer_tpu.engine.model_runner import decode_step, prefill, verify_step
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.engine.spec import NgramProposer
+from fusioninfer_tpu.models.config import get_preset
+from fusioninfer_tpu.models.transformer import init_params
+
+CFG = get_preset("qwen3-tiny")
+
+
+class TestNgramProposer:
+    def test_finds_latest_match(self):
+        p = NgramProposer(max_ngram=2)
+        #          0  1  2  3  4  5  6  7
+        tokens = [5, 6, 9, 9, 5, 6, 7, 5]  # suffix [6?]... last is [5]
+        # suffix n=2 is (7, 5): no earlier occurrence; n=1 suffix (5,)
+        # latest earlier 5 at index 4 -> followers 6, 7, 5
+        assert p.propose(tokens, 3) == [6, 7, 5]
+
+    def test_longest_ngram_wins(self):
+        p = NgramProposer(max_ngram=3)
+        tokens = [1, 2, 3, 8, 4, 2, 3, 9, 1, 2, 3]
+        # n=3 suffix (1,2,3) matches at 0 -> follower 8
+        assert p.propose(tokens, 2) == [8, 4]
+
+    def test_periodic_run_extends(self):
+        p = NgramProposer()
+        assert p.propose([4, 4, 4, 4, 4, 4], 3) == [4, 4, 4]
+        assert p.propose([4, 4], 3) == [4]  # only one follower exists
+
+    def test_no_match(self):
+        assert NgramProposer().propose([1, 2, 3, 4], 4) == []
+
+    def test_short_sequences(self):
+        p = NgramProposer()
+        assert p.propose([], 4) == []
+        assert p.propose([7], 4) == []
+        assert p.propose([7, 7], 4) == [7]
+
+    def test_k_caps_draft(self):
+        p = NgramProposer()
+        assert p.propose([1, 2, 3, 4, 5, 1], 2) == [2, 3]
+        assert p.propose([1, 2, 3], 0) == []
+
+
+def _seeded_cache(cfg, cache_cfg, prompt_len, B):
+    """Prefill B identical prompts so decode/verify start from real KV."""
+    params = init_params(cfg, jax.random.key(0))
+    cache = init_kv_cache(cfg, cache_cfg)
+    alloc = PageAllocator(cache_cfg)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, cfg.vocab_size, prompt_len, dtype=np.int32)
+    mp = cache_cfg.max_pages_per_seq
+    rows = np.zeros((B, mp), np.int32)
+    for b in range(B):
+        alloc.allocate(str(b), prompt_len + 16)
+        rows[b] = alloc.page_table_row(str(b))
+    padded = np.tile(prompt, (B, 1))
+    cache, _ = prefill(cfg, cache_cfg, params, cache,
+                       jnp.asarray(padded),
+                       jnp.full((B,), prompt_len, jnp.int32),
+                       jnp.asarray(rows))
+    return params, cache, jnp.asarray(rows), prompt_len
+
+
+@pytest.mark.parametrize("attn_impl", ["reference", "flash"])
+class TestVerifyStep:
+    def test_matches_sequential_decode(self, attn_impl):
+        """logits[b, j] of one verify_step == the j-th sequential
+        decode_step's logits, and the final caches agree."""
+        cfg = dataclasses.replace(CFG, attn_impl=attn_impl)
+        cache_cfg = CacheConfig(n_pages=17, page_size=16, max_pages_per_seq=4)
+        B, C, plen = 2, 4, 18  # window straddles a page boundary
+        params, cache0, rows, pos0 = _seeded_cache(cfg, cache_cfg, plen, B)
+        rng = np.random.default_rng(3)
+        window = rng.integers(1, cfg.vocab_size, (B, C), dtype=np.int32)
+
+        cache_v, logits_v = verify_step(
+            cfg, cache_cfg, params, jax.tree.map(jnp.copy, cache0),
+            jnp.asarray(window), jnp.full((B,), pos0, jnp.int32),
+            jnp.full((B,), C, jnp.int32), rows,
+        )
+
+        cache_s = jax.tree.map(jnp.copy, cache0)
+        for j in range(C):
+            cache_s, logits_j = decode_step(
+                cfg, cache_cfg, params, cache_s,
+                jnp.asarray(window[:, j]),
+                jnp.full((B,), pos0 + j, jnp.int32),
+                rows, jnp.ones((B,), bool),
+            )
+            np.testing.assert_allclose(
+                np.asarray(logits_v[:, j]), np.asarray(logits_j),
+                atol=2e-2, rtol=2e-2,
+            )
+        for k in ("k", "v"):
+            np.testing.assert_allclose(
+                np.asarray(cache_v[k], np.float32),
+                np.asarray(cache_s[k], np.float32),
+                atol=1e-2, rtol=1e-2,
+            )
+
+    def test_partial_counts_mask_writes(self, attn_impl):
+        """Rows past counts[b] must not touch the sequence's pages, and
+        count-0 slots are fully inert."""
+        cfg = dataclasses.replace(CFG, attn_impl=attn_impl)
+        cache_cfg = CacheConfig(n_pages=17, page_size=16, max_pages_per_seq=4)
+        B, C, plen = 2, 4, 20
+        params, cache0, rows, pos0 = _seeded_cache(cfg, cache_cfg, plen, B)
+        window = np.full((B, C), 7, np.int32)
+        counts = np.asarray([2, 0], np.int32)
+        cache_v, _ = verify_step(
+            cfg, cache_cfg, params, jax.tree.map(jnp.copy, cache0),
+            jnp.asarray(window), jnp.full((B,), pos0, jnp.int32),
+            jnp.asarray(counts), rows,
+        )
+        ps = cache_cfg.page_size
+        k0, kv = np.asarray(cache0["k"], np.float32), np.asarray(cache_v["k"], np.float32)
+        # seq 0: positions pos0, pos0+1 written; pos0+2.. untouched
+        page = int(np.asarray(rows)[0, (pos0 + 2) // ps])
+        slot = (pos0 + 2) % ps
+        np.testing.assert_array_equal(kv[:, :, page, slot], k0[:, :, page, slot])
+        # seq 1 (count 0): all its real pages untouched (its table rows
+        # are padded with the trash page, which masked writes DO hit)
+        for p in np.asarray(rows)[1]:
+            if p == cache_cfg.trash_page:
+                continue
+            np.testing.assert_array_equal(kv[:, :, p], k0[:, :, p])
+
+
+class TestVerifyKernelOracle:
+    def test_kernel_matches_oracle(self):
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_verify_attention,
+            reference_paged_verify_attention,
+        )
+
+        B, C, H, KV, Hd, ps, n_pages, mp = 4, 8, 8, 4, 64, 16, 33, 8
+        ks = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(ks[0], (B, C, H, Hd), jnp.float32)
+        kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.float32)
+        vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), jnp.float32)
+        rng = np.random.default_rng(0)
+        tables = rng.permutation(n_pages - 1)[: B * mp].reshape(B, mp).astype(np.int32)
+        starts = np.asarray([0, 17, 30, 100], np.int32)
+        counts = np.asarray([8, 5, 1, 0], np.int32)
+        out = paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts), interpret=True,
+        )
+        ref = reference_paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts))
+        got = np.asarray(out).copy().reshape(B, C, H * Hd)
+        for b in range(B):
+            got[b, counts[b]:] = 0.0  # padding rows unspecified
+        np.testing.assert_allclose(got, np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+def _drain(engine, requests, max_steps=500):
+    import copy
+
+    for r in copy.deepcopy(requests):
+        engine.add_request(r)
+    tokens: dict[str, list[int]] = {r.request_id: [] for r in requests}
+    steps = 0
+    while engine.has_work():
+        steps += 1
+        assert steps <= max_steps, "engine did not drain"
+        for o in engine.step():
+            assert not (o.finish_reason or "").startswith("error"), o
+            tokens[o.request_id].append(o.token)
+    return tokens, steps
+
+
+class TestEngineIdentity:
+    CACHE = CacheConfig(n_pages=65, page_size=16, max_pages_per_seq=16)
+
+    def _requests(self):
+        # highly repetitive prompt -> n-gram lookup actually accepts
+        loop = [11, 12, 13, 14, 15, 16, 17, 18] * 8
+        rng = np.random.default_rng(5)
+        return [
+            Request(request_id="greedy-rep", prompt_tokens=loop,
+                    params=SamplingParams(max_tokens=24, temperature=0.0)),
+            Request(request_id="greedy-rand",
+                    prompt_tokens=rng.integers(1, CFG.vocab_size, 21).tolist(),
+                    params=SamplingParams(max_tokens=10, temperature=0.0)),
+            Request(request_id="sampled",
+                    prompt_tokens=rng.integers(1, CFG.vocab_size, 15).tolist(),
+                    params=SamplingParams(max_tokens=10, temperature=0.9,
+                                          seed=42)),
+            Request(request_id="penalized", prompt_tokens=loop[:32],
+                    params=SamplingParams(max_tokens=8, temperature=0.0,
+                                          repetition_penalty=1.3)),
+        ]
+
+    def test_identity_and_step_savings(self):
+        base = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=4)
+        spec = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=4,
+                            speculative_k=7)
+        a, steps_a = _drain(base, self._requests())
+        b, steps_b = _drain(spec, self._requests())
+        assert a == b, "speculation changed tokens"
+        assert spec.spec_proposed_total > 0
+        assert spec.spec_accepted_total > 0, (
+            "repetitive greedy prompt should accept drafts"
+        )
+        assert steps_b < steps_a, "accepted drafts should save steps"
+
+    def test_solo_greedy_repetitive(self):
+        base = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=2)
+        spec = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=2,
+                            speculative_k=4)
+        req = [Request(request_id="r",
+                       prompt_tokens=[3, 4, 5] * 12,
+                       params=SamplingParams(max_tokens=16, temperature=0.0))]
+        a, _ = _drain(base, req)
+        b, _ = _drain(spec, req)
+        assert a == b
+
+    def test_max_tokens_exact(self):
+        """A burst must stop exactly at max_tokens with finish 'length'."""
+        spec = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=2,
+                            speculative_k=7)
+        spec.add_request(Request(
+            request_id="r", prompt_tokens=[9, 8] * 16,
+            params=SamplingParams(max_tokens=5, temperature=0.0)))
+        outs = []
+        while spec.has_work():
+            outs.extend(o for o in spec.step() if o.request_id == "r")
+        assert len(outs) == 5
+        assert outs[-1].finished and outs[-1].finish_reason in ("length", "stop")
+        assert all(not o.finished for o in outs[:-1])
+
+    def test_spec_metrics_rendered(self):
+        from fusioninfer_tpu.engine.metrics import EngineMetrics
+
+        spec = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=2,
+                            speculative_k=4)
+        text = EngineMetrics("m").render(spec)
+        assert "vllm:spec_decode_num_draft_tokens_total" in text
+        assert "vllm:spec_decode_num_accepted_tokens_total" in text
