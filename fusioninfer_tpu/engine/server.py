@@ -73,6 +73,17 @@ class _RequestChannel:
                 return
 
 
+class _MultiChannel:
+    """Composite of one request's n per-choice channels, so the HTTP
+    layer's single ``abort(chan)`` tears every choice down."""
+
+    def __init__(self, chans: list[_RequestChannel]):
+        self.chans = chans
+
+
+_PUMP_DONE = object()  # sentinel: one merged sub-stream finished
+
+
 def _find_stop(text: str, stops) -> int | None:
     """Earliest index where any stop sequence begins, or None."""
     best = None
@@ -308,12 +319,16 @@ class EngineServer:
                     del self._channels[rid]
                     self._req_meta.pop(rid, None)
 
-    def abort(self, chan: _RequestChannel) -> None:
+    def abort(self, chan) -> None:
         """Idempotent teardown for a client that went away: unregister the
-        channel AND cancel the engine-side work so dead clients don't burn
-        decode steps."""
-        self._cancel_chan(chan)
-        self._release(chan)
+        channel(s) AND cancel the engine-side work so dead clients don't
+        burn decode steps.  The ``None`` put unblocks any pump thread
+        still parked on the channel queue (n>1 merged streaming)."""
+        chans = chan.chans if isinstance(chan, _MultiChannel) else [chan]
+        for c in chans:
+            self._cancel_chan(c)
+            self._release(c)
+            c.put(None)
 
     def _sampling_params(self, body: dict) -> SamplingParams:
         stop_ids = [self.tokenizer.eos_token_id]
@@ -368,20 +383,79 @@ class EngineServer:
             if isinstance(prompt, list):
                 prompt = prompt[0] if prompt else ""
         params = self._sampling_params(body)
+        n = self._n_of(body)
         prompt_tokens = self.tokenizer.encode(prompt)
         lora = self._lora_of(body)  # ValueError on rejection
-        chan = self.submit(prompt_tokens, params, lora=lora)
-        return chan, self._stream_chunks(chan, chat, params.stop_strings,
-                                         served_model=lora or self.model_name)
+        served = lora or self.model_name
+        if n == 1:
+            chan = self.submit(prompt_tokens, params, lora=lora)
+            return chan, self._stream_chunks(chan, chat, params.stop_strings,
+                                             served_model=served)
+        completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
+        created = int(time.time())  # one timestamp: chunks sharing an id
+        chans = self._submit_n(prompt_tokens, params, lora, n)
+        gens = [
+            self._stream_chunks(c, chat, params.stop_strings,
+                                served_model=served, choice_index=i,
+                                completion_id=completion_id, created=created)
+            for i, c in enumerate(chans)
+        ]
+        return _MultiChannel(chans), self._merge_streams(gens)
+
+    def _submit_n(self, prompt_tokens, params, lora: str, n: int):
+        """Submit n per-choice requests; on any failure, abort the ones
+        already submitted (they would otherwise decode to max_tokens with
+        no consumer and leak their channel registrations)."""
+        chans: list[_RequestChannel] = []
+        try:
+            for i in range(n):
+                chans.append(self.submit(
+                    prompt_tokens, self._sample_params(params, i), lora=lora))
+        except Exception:
+            for c in chans:
+                self.abort(c)
+            raise
+        return chans
+
+    def _merge_streams(self, gens):
+        """Interleave n choice streams into one SSE chunk stream (chunks
+        carry their choice index); single None sentinel at the end."""
+        out_q: queue.Queue = queue.Queue()
+
+        def pump(g):
+            try:
+                for chunk in g:
+                    if chunk is None:
+                        break
+                    out_q.put(chunk)
+            finally:
+                out_q.put(_PUMP_DONE)
+
+        for g in gens:
+            threading.Thread(target=pump, args=(g,), daemon=True).start()
+        done = 0
+        while done < len(gens):
+            item = out_q.get()
+            if item is _PUMP_DONE:
+                done += 1
+                continue
+            yield item
+        yield None
 
     def _stream_chunks(self, chan: _RequestChannel, chat: bool,
-                       stops: tuple = (), served_model: str = ""):
-        completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
-        created = int(time.time())
+                       stops: tuple = (), served_model: str = "",
+                       choice_index: int = 0, completion_id: str = "",
+                       created: int = 0):
+        completion_id = completion_id or (
+            f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
+        )
+        created = created or int(time.time())
         tokens: list[int] = []
         emitted = 0  # chars already sent
         try:
             for out in chan.stream():
+                if out is None:  # aborted mid-stream (client gone)
+                    return
                 if not (out.finished and out.finish_reason == "stop"
                         and out.token == self.tokenizer.eos_token_id):
                     tokens.append(out.token)
@@ -404,11 +478,12 @@ class EngineServer:
                           "token_logprobs": [out.logprob],
                           "top_logprobs": [out.top_logprobs or {}]}
                 if chat:
-                    choice = {"index": 0, "delta": {"content": delta}, "finish_reason": finish}
+                    choice = {"index": choice_index, "delta": {"content": delta},
+                              "finish_reason": finish}
                     obj = "chat.completion.chunk"
                 else:
-                    choice = {"index": 0, "text": delta, "finish_reason": finish,
-                              "logprobs": lp}
+                    choice = {"index": choice_index, "text": delta,
+                              "finish_reason": finish, "logprobs": lp}
                     obj = "text_completion"
                 yield {
                     "id": completion_id,
@@ -425,14 +500,67 @@ class EngineServer:
             self._release(chan)
         yield None  # sentinel: emit data: [DONE]
 
+    def _n_of(self, body: dict) -> int:
+        """OpenAI ``n``: parallel samples per request.  ``best_of`` is
+        accepted only when equal to ``n`` (its legacy default)."""
+        raw = body.get("n")
+        n = 1 if raw is None else int(raw)
+        if not 1 <= n <= 16:
+            raise ValueError("n must be between 1 and 16")
+        best_of = body.get("best_of")
+        if best_of is not None and int(best_of) != n:
+            raise ValueError("best_of != n is not supported")
+        return n
+
+    def _sample_params(self, params: SamplingParams, i: int) -> SamplingParams:
+        """Per-choice sampling params: a seeded request's n samples draw
+        from distinct derived streams (seed, seed+1, …) so they differ
+        yet stay reproducible; i=0 is bit-identical to n=1."""
+        import dataclasses as _dc
+
+        if i == 0 or params.seed is None:
+            return params
+        return _dc.replace(params, seed=params.seed + i)
+
     def handle_completion(self, body: dict) -> dict:
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
         params = self._sampling_params(body)
+        n = self._n_of(body)
         prompt_tokens = self.tokenizer.encode(prompt)
         lora = self._lora_of(body)
-        chan = self.submit(prompt_tokens, params, lora=lora)
+        # submit all n first: they decode concurrently as one batch, and
+        # the engine's same-prompt dedup turns samples 2..n into
+        # prefix-cache hits against sample 1's pages
+        chans = self._submit_n(prompt_tokens, params, lora, n)
+        choices = []
+        total_completion = 0
+        for i, chan in enumerate(chans):
+            text, finish_reason, logprobs_obj, n_tokens = self._collect_choice(
+                chan, params)
+            choices.append({"index": i, "text": text,
+                            "finish_reason": finish_reason,
+                            "logprobs": logprobs_obj})
+            total_completion += n_tokens
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:12]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": lora or self.model_name,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": total_completion,
+                "total_tokens": len(prompt_tokens) + total_completion,
+            },
+        }
+
+    def _collect_choice(self, chan: _RequestChannel,
+                        params: SamplingParams):
+        """Drain one choice's channel → (text, finish_reason,
+        logprobs_obj, n_completion_tokens), applying stop-string and
+        logprobs trimming."""
         tokens, finish_reason = [], "length"
         # logprob/top arrays stay index-aligned with `tokens` at all times
         # (None where unavailable, e.g. a PD-prefilled first token — the
@@ -443,6 +571,8 @@ class EngineServer:
         max_stop = max((len(x) for x in params.stop_strings), default=0)
         try:
             for out in chan.stream():
+                if out is None:  # aborted (server shutdown / client gone)
+                    break
                 tokens.append(out.token)
                 token_lps.append(out.logprob)
                 top_lps.append(out.top_logprobs or {})
@@ -485,21 +615,7 @@ class EngineServer:
                 ],
                 "text_offset": [],
             }
-        return {
-            "id": f"cmpl-{uuid.uuid4().hex[:12]}",
-            "object": "text_completion",
-            "created": int(time.time()),
-            "model": lora or self.model_name,
-            "choices": [
-                {"index": 0, "text": text, "finish_reason": finish_reason,
-                 "logprobs": logprobs_obj}
-            ],
-            "usage": {
-                "prompt_tokens": len(prompt_tokens),
-                "completion_tokens": len(tokens),
-                "total_tokens": len(prompt_tokens) + len(tokens),
-            },
-        }
+        return text, finish_reason, logprobs_obj, len(tokens)
 
     def handle_chat(self, body: dict) -> dict:
         messages = body.get("messages", [])
@@ -507,7 +623,6 @@ class EngineServer:
             f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
         ) + "<|assistant|>"
         completion = self.handle_completion({**body, "prompt": prompt})
-        text = completion["choices"][0]["text"]
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
@@ -515,10 +630,11 @@ class EngineServer:
             "model": completion["model"],
             "choices": [
                 {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": text},
-                    "finish_reason": completion["choices"][0]["finish_reason"],
+                    "index": c["index"],
+                    "message": {"role": "assistant", "content": c["text"]},
+                    "finish_reason": c["finish_reason"],
                 }
+                for c in completion["choices"]
             ],
             "usage": completion["usage"],
         }

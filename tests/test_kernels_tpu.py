@@ -85,6 +85,40 @@ class TestPagedAttentionHW:
         assert not np.allclose(out[1], 0.0)
 
 
+class TestPagedVerifyAttentionHW:
+    def test_verify_window_bench_shapes_bf16(self):
+        """Speculative verify window (C=8) at the bench decode config:
+        bf16 head-major pages, per-sequence starts/counts, interpret=False."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_verify_attention,
+            reference_paged_verify_attention,
+        )
+
+        B, C, H, KV, Hd, ps, n_pages, mp = 8, 8, 16, 8, 128, 128, 257, 8
+        ks = jax.random.split(jax.random.key(5), 3)
+        q = jax.random.normal(ks[0], (B, C, H, Hd), jnp.bfloat16)
+        kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        rng = np.random.default_rng(5)
+        tables = rng.permutation(n_pages - 1)[: B * mp].reshape(B, mp).astype(np.int32)
+        starts = np.asarray([0, 17, 127, 129, 500, 900, 1, 1015], np.int32)
+        counts = np.asarray([8, 5, 1, 0, 8, 3, 7, 8], np.int32)
+        out = paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts), interpret=False,
+        )
+        out.block_until_ready()
+        ref = reference_paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts))
+        got = np.asarray(out, np.float32).copy()
+        for b in range(B):
+            got[b, counts[b]:] = 0.0  # padding rows unspecified
+        np.testing.assert_allclose(
+            got, np.asarray(ref, np.float32), atol=5e-2, rtol=5e-2,
+        )
+
+
 class TestPagedPrefillAttentionHW:
     def test_suffix_bench_shapes_bf16(self):
         """Prefix-cache-hit path at bench shapes: suffix queries mid-stream

@@ -104,6 +104,19 @@ class TestQwen8BFit:
         cfg = dataclasses.replace(get_preset("llama3-70b"), quantization="int8")
         assert model_param_bytes(cfg) > 2 * V5E_HBM
 
+    def test_llama70b_bf16_fits_v5e16_slice_tp16(self):
+        """BASELINE rung 4 (one v5e-16 slice, multi-node LWS TP): bf16
+        70B over tp=16 is ~8.75 GiB weights/chip — auto_cache_config must
+        accept it AND leave a demand-shaped KV pool per chip."""
+        cfg = get_preset("llama3-70b")
+        cache = auto_cache_config(
+            cfg, page_size=128, max_model_len=4096, max_batch_size=8,
+            tp=16, hbm_bytes=V5E_HBM,
+        )
+        # demand: 32 pages/seq × 8 seqs + trash page
+        assert cache.n_pages >= 32 * 8 + 1
+        assert cache.max_pages_per_seq == 32
+
 
 class TestEngineInt8:
     CFG = dataclasses.replace(get_preset("qwen3-tiny"), quantization="int8")
