@@ -90,6 +90,7 @@ class _SeqState:
     slot: int  # batch slot
     seed: int = 0  # per-request sampling stream
     first_token_time: Optional[float] = None
+    guided: Optional[object] = None  # JsonByteMachine when guided_json
 
     @property
     def n_generated(self) -> int:
@@ -122,6 +123,7 @@ class NativeEngine:
         prefill_chunk_size: Optional[int] = None,
         prefill_chunks_per_step: int = 1,
         speculative_k: Optional[int] = None,
+        token_byte_table=None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -272,6 +274,12 @@ class NativeEngine:
         self.proposer = NgramProposer() if speculative_k else None
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
+        # guided decoding (response_format json_object): token id → byte
+        # mapping for grammar masking; None = guided requests rejected
+        self._byte_np = None
+        self._byte_dev = None
+        if token_byte_table is not None:
+            self.set_token_byte_table(token_byte_table)
 
         # counters consumed by /metrics
         self.prompt_tokens_total = 0
@@ -283,11 +291,22 @@ class NativeEngine:
 
     # -- public API ----------------------------------------------------------
 
+    def set_token_byte_table(self, table) -> None:
+        """Install the token→byte mapping guided decoding masks through
+        (built by the server from its tokenizer, ``engine/guided.py``)."""
+        self._byte_np = np.asarray(table, np.int32)
+        self._byte_dev = jnp.asarray(self._byte_np)
+
     def add_request(self, request: Request) -> None:
         if request.params.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
         if not request.prompt_tokens:
             raise ValueError("prompt must not be empty")
+        if request.params.guided_json and self._byte_np is None:
+            raise ValueError(
+                "guided JSON needs a token→byte mapping; the serving "
+                "tokenizer does not provide one"
+            )
         if len(request.prompt_tokens) + request.params.max_tokens > self.cache_cfg.max_len:
             raise ValueError(
                 f"prompt+max_tokens exceeds engine max_len {self.cache_cfg.max_len}"
@@ -337,6 +356,13 @@ class NativeEngine:
             # wrong tokens — reject loudly instead
             raise ValueError(
                 "LoRA adapters are not yet supported on the "
+                "PD-disaggregated prefill wire"
+            )
+        if request.params.guided_json:
+            # the prefiller samples the first token without the grammar
+            # mask — reject rather than return unguided output
+            raise ValueError(
+                "guided JSON is not yet supported on the "
                 "PD-disaggregated prefill wire"
             )
         if slab.page_size != self.cache_cfg.page_size:
@@ -713,9 +739,26 @@ class NativeEngine:
             row = row.at[jnp.asarray(params.stop_token_ids, jnp.int32)].set(True)
         return row
 
+    def _allowed_token_mask(self, allowed_bytes) -> jax.Array:
+        """Allowed-bytes mask ([256] or [B, 256] bool) → token-legality
+        mask ([V] or [B, V]) via the byte table — the single place the
+        byte→token semantics live for both sampling paths."""
+        tbl = self._byte_dev
+        a = jnp.asarray(allowed_bytes)
+        return (tbl >= 0) & a[..., jnp.clip(tbl, 0, 255)]
+
+    def _guided_advance(self, machine, token: int) -> Optional[str]:
+        """Advance a guided machine with an emitted token; returns "stop"
+        the moment the top-level object closes."""
+        b = int(self._byte_np[token])
+        if b >= 0:  # the grammar mask guarantees this for sampled tokens
+            machine.advance(b)
+        return "stop" if machine.done else None
+
     def _sample_first_token(self, logits: jax.Array, request: Request,
                             prefix: list[int], seed: int,
-                            n_prompt: Optional[int] = None) -> int:
+                            n_prompt: Optional[int] = None,
+                            machine=None) -> int:
         """Sample a prefill's first token with full per-request sampling
         semantics (repetition penalty over the whole prefix,
         presence/frequency over previously *generated* tokens only, stop
@@ -739,6 +782,11 @@ class NativeEngine:
         gen_index = len(prefix) - n_prompt
         if gen_index < p.min_tokens and p.stop_token_ids:
             logits = jnp.where(self._stop_suppress_row(p)[None], -jnp.inf, logits)
+        if machine is not None:
+            logits = jnp.where(
+                self._allowed_token_mask(machine.allowed_bytes())[None],
+                logits, -jnp.inf,
+            )
         keys = make_row_keys(
             jnp.asarray([seed], jnp.uint32), jnp.asarray([gen_index], jnp.int32)
         )
@@ -882,8 +930,19 @@ class NativeEngine:
                                        namespace=self._lora_ns(request))
         seq_seed = self._request_seed(request)
         n_prompt = len(request.prompt_tokens)
+        machine = None
+        if request.params.guided_json:
+            from fusioninfer_tpu.engine.guided import JsonByteMachine
+
+            machine = JsonByteMachine()
+            for t in prefix[n_prompt:]:  # resume: replay generated bytes
+                b = int(self._byte_np[t])
+                if b >= 0:
+                    machine.advance(b)
         token = self._sample_first_token(logits, request, prefix, seq_seed,
-                                         n_prompt=n_prompt)
+                                         n_prompt=n_prompt, machine=machine)
+        force_finish = (self._guided_advance(machine, token)
+                        if machine is not None else None)
         lp = tops = None
         n_lp = request.params.logprobs
         if n_lp is not None:
@@ -901,6 +960,7 @@ class NativeEngine:
             slot=slot,
             seed=seq_seed,
             first_token_time=time.monotonic(),
+            guided=machine,
         )
         self._register_slot(slot, state.tokens, n_prompt, request.params)
         self.running[slot] = state
@@ -908,7 +968,8 @@ class NativeEngine:
             self.prompt_tokens_total += len(prefix)
         self.generation_tokens_total += 1
         return self._emit(state, token, first=not resumed,
-                          logprob=lp, top_logprobs=tops)
+                          logprob=lp, top_logprobs=tops,
+                          force_finish=force_finish)
 
     # -- decode --------------------------------------------------------------
 
@@ -926,6 +987,7 @@ class NativeEngine:
                 and p.frequency_penalty == 0.0
                 and p.repetition_penalty == 1.0
                 and p.logprobs is None
+                and not p.guided_json  # drafts would bypass the grammar mask
                 and st.n_generated >= p.min_tokens)
 
     def _decode(self) -> list[StepOutput]:
@@ -1046,6 +1108,18 @@ class NativeEngine:
         # min_tokens: stop ids stay unsampleable until enough generated
         still_early = jnp.asarray(gen_counts < min_toks)[:, None]
         logits = jnp.where(still_early & self._suppress, -jnp.inf, logits)
+        # guided rows: only grammatically legal bytes are sampleable
+        guided_live = {s: st.guided for s, st in live.items()
+                       if st.guided is not None}
+        if guided_live:
+            allowed = np.zeros((B, 256), bool)
+            grow = np.zeros((B,), bool)
+            for slot, m in guided_live.items():
+                allowed[slot] = m.allowed_bytes()
+                grow[slot] = True
+            tok_ok = self._allowed_token_mask(allowed)  # [B, V]
+            logits = jnp.where(jnp.asarray(grow)[:, None] & ~tok_ok,
+                               -jnp.inf, logits)
         keys = make_row_keys(jnp.asarray(seeds), jnp.asarray(gen_counts))
         sampled_dev = sample(logits, keys, jnp.asarray(temps),
                              jnp.asarray(top_ks), jnp.asarray(top_ps))
@@ -1089,6 +1163,8 @@ class NativeEngine:
             token = int(sampled[slot])
             st.tokens.append(token)
             self.generation_tokens_total += 1
+            force_finish = (self._guided_advance(st.guided, token)
+                            if st.guided is not None else None)
             lp = tops = None
             n = st.request.params.logprobs
             if raw_logp is not None and n is not None:
@@ -1096,7 +1172,8 @@ class NativeEngine:
                 if n and top_ids is not None:
                     tops = {int(t): float(v) for t, v in
                             zip(top_ids[slot][:n], top_vals[slot][:n])}
-            outputs.append(self._emit(st, token, logprob=lp, top_logprobs=tops))
+            outputs.append(self._emit(st, token, logprob=lp, top_logprobs=tops,
+                                      force_finish=force_finish))
         return outputs
 
     def _ensure_decode_capacity(self) -> list[StepOutput]:
@@ -1134,12 +1211,13 @@ class NativeEngine:
     # -- bookkeeping ---------------------------------------------------------
 
     def _emit(self, state: _SeqState, token: int, first: bool = False,
-              logprob=None, top_logprobs=None) -> StepOutput:
+              logprob=None, top_logprobs=None,
+              force_finish: Optional[str] = None) -> StepOutput:
         params = state.request.params
-        finish_reason = None
-        if token in params.stop_token_ids:
+        finish_reason = force_finish
+        if finish_reason is None and token in params.stop_token_ids:
             finish_reason = "stop"
-        elif state.n_generated >= params.max_tokens:
+        elif finish_reason is None and state.n_generated >= params.max_tokens:
             finish_reason = "length"
         if finish_reason:
             self._finish(state)

@@ -1,0 +1,244 @@
+"""Guided decoding: byte-level JSON grammar masking.
+
+OpenAI ``response_format: {"type": "json_object"}`` realized the
+engine-native way: a pushdown automaton over BYTES tracks the JSON state
+of each guided sequence, and at every sampling step the logits of all
+tokens whose byte is not grammatically legal are masked to -inf — the
+model can only emit syntactically valid JSON, and generation force-stops
+the moment the top-level object closes.  The reference delegates this to
+vLLM's guided-decoding backends (an engine flag passthrough, SURVEY §0);
+here the automaton is exact because the in-repo tokenizer is byte-level
+(one token = one byte, ``engine/tokenizer.py``).  Tokenizers without a
+token→byte mapping reject guided requests up front rather than serving
+unconstrained output.
+
+The automaton accepts RFC 8259 JSON with a top-level OBJECT (what
+``json_object`` promises): strings with escapes and ``\\uXXXX``, numbers
+with frac/exp, literals, nested arrays/objects, and inter-token
+whitespace.  Output under ``finish_reason: "stop"`` always parses;
+hitting ``max_tokens`` mid-object returns a prefix (``finish_reason:
+"length"``), same as OpenAI.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_WS = frozenset(b" \t\n\r")
+_DIGITS = frozenset(b"0123456789")
+_HEX = frozenset(b"0123456789abcdefABCDEF")
+_ESCAPABLE = frozenset(b'"\\/bfnrtu')
+# string content: any byte except the quote, backslash and C0 controls
+_STR_BYTES = frozenset(range(0x20, 0x100)) - {0x22, 0x5C}
+
+_LITERALS = {b"t"[0]: b"rue", b"f"[0]: b"alse", b"n"[0]: b"ull"}
+
+
+def _mask(*byte_sets) -> np.ndarray:
+    m = np.zeros(256, bool)
+    for s in byte_sets:
+        m[list(s)] = True
+    return m
+
+
+class JsonByteMachine:
+    """Incremental byte-level JSON validator with ``allowed_bytes()``.
+
+    States: ``top`` (before '{'), ``value`` (a value must follow),
+    ``arr_first`` (value or ']' — empty array), ``string`` / ``escape`` /
+    ``hex`` (pending unicode-escape digits), number states (``int_neg``,
+    ``int_zero``, ``int``, ``frac_start``, ``frac``, ``exp_start``,
+    ``exp_sign``, ``exp``), ``literal`` (rest of true/false/null),
+    ``after`` (expect ',' or the closer), ``key`` (expect '"' or '}'),
+    ``key_required`` (after ',' — '}' illegal), ``colon``, ``done``.
+    """
+
+    def __init__(self):
+        self.stack: list[str] = []  # 'obj' | 'arr'
+        self.state = "top"
+        self._literal_rest = b""
+        self._hex_left = 0
+        self._in_key = False
+
+    @property
+    def done(self) -> bool:
+        return self.state == "done"
+
+    # -- allowed sets --------------------------------------------------------
+
+    def allowed_bytes(self) -> np.ndarray:
+        """[256] bool — bytes legal in the current state."""
+        s = self.state
+        if s == "top":
+            return _mask(_WS, b"{")
+        if s == "value":
+            return _mask(_WS, b'{["-tfn', _DIGITS)
+        if s == "arr_first":
+            return _mask(_WS, b'{["-tfn]', _DIGITS)
+        if s == "string":
+            return _mask(_STR_BYTES, b'"\\')
+        if s == "escape":
+            return _mask(_ESCAPABLE)
+        if s == "hex":
+            return _mask(_HEX)
+        if s == "literal":
+            return _mask(self._literal_rest[:1])
+        if s == "int_neg":
+            return _mask(_DIGITS)
+        if s == "int_zero":  # leading 0: no further integer digits
+            return self._number_end_mask(b".eE", digits=False)
+        if s == "int":
+            return self._number_end_mask(b".eE")
+        if s == "frac_start":
+            return _mask(_DIGITS)
+        if s == "frac":
+            return self._number_end_mask(b"eE")
+        if s == "exp_start":
+            return _mask(_DIGITS, b"+-")
+        if s == "exp_sign":
+            return _mask(_DIGITS)
+        if s == "exp":
+            return self._number_end_mask(b"")
+        if s == "after":
+            closer = b"}" if self.stack[-1] == "obj" else b"]"
+            return _mask(_WS, b",", closer)
+        if s == "key":
+            return _mask(_WS, b'"}')
+        if s == "key_required":
+            return _mask(_WS, b'"')
+        if s == "colon":
+            return _mask(_WS, b":")
+        if s == "done":
+            return np.zeros(256, bool)
+        raise AssertionError(f"unknown state {s}")
+
+    def _number_end_mask(self, extra: bytes, digits: bool = True) -> np.ndarray:
+        """A number may continue (digits/``extra``) or terminate on
+        whitespace, ',' or the enclosing closer."""
+        closer = b"}" if self.stack[-1] == "obj" else b"]"
+        sets = [_WS, b",", closer, extra]
+        if digits:
+            sets.append(_DIGITS)
+        return _mask(*sets)
+
+    # -- transitions ---------------------------------------------------------
+
+    def advance(self, byte: int) -> None:
+        """Consume one byte; raises ValueError on a byte the current
+        ``allowed_bytes`` would have masked (engine bug or direct misuse)."""
+        if not self.allowed_bytes()[byte]:
+            raise ValueError(f"byte {byte!r} illegal in state {self.state}")
+        s, b = self.state, byte
+        if b in _WS:
+            if s in ("int_zero", "int", "frac", "exp"):
+                self.state = "after"  # whitespace terminates a number
+            return
+        if s in ("int_zero", "int", "frac", "exp") and b in b",}]":
+            # number terminated by a structural byte: close the value,
+            # re-dispatch the byte in the 'after' state
+            self.state = "after"
+            self.advance(b)
+            return
+
+        if s == "top":
+            self.stack.append("obj")
+            self.state = "key"
+        elif s in ("value", "arr_first"):
+            if s == "arr_first" and b == b"]"[0]:
+                self.stack.pop()
+                self.state = "done" if not self.stack else "after"
+            else:
+                self._start_value(b)
+        elif s == "string":
+            if b == 0x22:
+                if self._in_key:
+                    self._in_key = False
+                    self.state = "colon"
+                else:
+                    self.state = "after"
+            elif b == 0x5C:
+                self.state = "escape"
+        elif s == "escape":
+            if b == b"u"[0]:
+                self._hex_left = 4
+                self.state = "hex"
+            else:
+                self.state = "string"
+        elif s == "hex":
+            self._hex_left -= 1
+            if self._hex_left == 0:
+                self.state = "string"
+        elif s == "literal":
+            self._literal_rest = self._literal_rest[1:]
+            if not self._literal_rest:
+                self.state = "after"
+        elif s == "int_neg":
+            self.state = "int_zero" if b == b"0"[0] else "int"
+        elif s in ("int_zero", "int"):
+            if b == b"."[0]:
+                self.state = "frac_start"
+            elif b in b"eE":
+                self.state = "exp_start"
+            # else: a digit continuing 'int'
+        elif s == "frac_start":
+            self.state = "frac"
+        elif s == "frac":
+            if b in b"eE":
+                self.state = "exp_start"
+        elif s == "exp_start":
+            self.state = "exp_sign" if b in b"+-" else "exp"
+        elif s == "exp_sign":
+            self.state = "exp"
+        elif s == "after":
+            if b == b","[0]:
+                self.state = ("key_required" if self.stack[-1] == "obj"
+                              else "value")
+            else:
+                self.stack.pop()
+                self.state = "done" if not self.stack else "after"
+        elif s in ("key", "key_required"):
+            if b == 0x22:
+                self._in_key = True
+                self.state = "string"
+            else:  # '}' closing an empty object (state 'key' only)
+                self.stack.pop()
+                self.state = "done" if not self.stack else "after"
+        elif s == "colon":
+            self.state = "value"
+        else:  # pragma: no cover
+            raise AssertionError(f"advance from {s}")
+
+    def _start_value(self, b: int) -> None:
+        if b == b"{"[0]:
+            self.stack.append("obj")
+            self.state = "key"
+        elif b == b"["[0]:
+            self.stack.append("arr")
+            self.state = "arr_first"
+        elif b == 0x22:
+            self._in_key = False
+            self.state = "string"
+        elif b == b"-"[0]:
+            self.state = "int_neg"
+        elif b == b"0"[0]:
+            self.state = "int_zero"
+        elif b in _DIGITS:
+            self.state = "int"
+        else:  # t / f / n
+            self._literal_rest = _LITERALS[b]
+            self.state = "literal"
+
+
+def build_token_byte_table(tokenizer, vocab_size: int) -> np.ndarray | None:
+    """[vocab_size] int32: token id → byte value, -1 where the token has
+    no single-byte form.  None when the tokenizer exposes no such mapping
+    (guided requests are then rejected instead of silently unguided)."""
+    offset = getattr(tokenizer, "OFFSET", None)
+    if offset is None:
+        return None
+    table = np.full(vocab_size, -1, np.int32)
+    hi = min(vocab_size, offset + 256)
+    if hi <= offset:
+        return None
+    table[offset:hi] = np.arange(hi - offset)
+    return table

@@ -35,6 +35,9 @@ class SamplingParams:
     # OpenAI `logprobs`: return the sampled token's log-probability and
     # the top-N alternatives per step (raw model distribution)
     logprobs: Optional[int] = None
+    # OpenAI `response_format: json_object`: constrain output to valid
+    # JSON via byte-level grammar masking (engine/guided.py)
+    guided_json: bool = False
 
     @property
     def greedy(self) -> bool:

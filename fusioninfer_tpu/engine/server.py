@@ -136,6 +136,12 @@ class EngineServer:
             )
         self.engine = engine
         self.tokenizer = tokenizer or load_tokenizer()
+        if getattr(engine, "_byte_np", None) is None:
+            from fusioninfer_tpu.engine.guided import build_token_byte_table
+
+            table = build_token_byte_table(self.tokenizer, engine.cfg.vocab_size)
+            if table is not None:
+                engine.set_token_byte_table(table)
         self.metrics = EngineMetrics(model)
         self.host, self.port = host, port
         self._channels: dict[str, _RequestChannel] = {}
@@ -215,6 +221,11 @@ class EngineServer:
                 # full remote prefill + KV transfer has been burned
                 raise ValueError(
                     "LoRA adapters are not yet supported on the "
+                    "PD-disaggregated prefill wire"
+                )
+            if params.guided_json and self.prefill_upstream:
+                raise ValueError(
+                    "guided JSON is not yet supported on the "
                     "PD-disaggregated prefill wire"
                 )
             if self.prefill_upstream:
@@ -343,6 +354,17 @@ class EngineServer:
         logprobs = body.get("logprobs")
         if logprobs is not None:
             logprobs = max(0, min(int(logprobs), 5))  # OpenAI caps at 5
+        rf = body.get("response_format")
+        guided_json = False
+        if rf is not None:
+            rf_type = rf.get("type") if isinstance(rf, dict) else rf
+            if rf_type == "json_object":
+                guided_json = True
+            elif rf_type not in (None, "text"):
+                raise ValueError(
+                    f"unsupported response_format type {rf_type!r}; "
+                    "supported: text, json_object"
+                )
         return SamplingParams(
             temperature=float(body.get("temperature", 1.0)),
             top_k=int(body.get("top_k", 0)),
@@ -356,6 +378,7 @@ class EngineServer:
             repetition_penalty=float(body.get("repetition_penalty", 1.0)),
             seed=int(seed) if seed is not None else None,
             logprobs=logprobs,
+            guided_json=guided_json,
         )
 
     def _cancel_chan(self, chan: "_RequestChannel") -> None:
@@ -410,7 +433,7 @@ class EngineServer:
         try:
             for i in range(n):
                 chans.append(self.submit(
-                    prompt_tokens, self._sample_params(params, i), lora=lora))
+                    prompt_tokens, self._choice_params(params, i), lora=lora))
         except Exception:
             for c in chans:
                 self.abort(c)
@@ -512,7 +535,7 @@ class EngineServer:
             raise ValueError("best_of != n is not supported")
         return n
 
-    def _sample_params(self, params: SamplingParams, i: int) -> SamplingParams:
+    def _choice_params(self, params: SamplingParams, i: int) -> SamplingParams:
         """Per-choice sampling params: a seeded request's n samples draw
         from distinct derived streams (seed, seed+1, …) so they differ
         yet stay reproducible; i=0 is bit-identical to n=1."""
