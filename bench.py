@@ -250,6 +250,20 @@ def main() -> None:
                 decode["kernel_speedup"] = round(
                     decode["kernel_tok_s"] / decode["gather_tok_s"], 3
                 )
+            # int8 KV pages: half the attention HBM traffic per step
+            try:
+                t = run_decode(
+                    jax, dataclasses.replace(base_cfg, attn_impl="flash"),
+                    batch,
+                    dataclasses.replace(cache_cfg, kv_dtype="int8"),
+                    prefix_len, warmup, steps)
+                decode["kernel_int8kv_tok_s"] = round(t, 2)
+                if decode.get("kernel_tok_s"):
+                    decode["int8kv_speedup"] = round(
+                        t / decode["kernel_tok_s"], 3)
+            except Exception as e:
+                decode["kernel_int8kv_error"] = (
+                    f"{type(e).__name__}: {str(e)[:400]}")
         else:
             from fusioninfer_tpu.ops import dispatch
 

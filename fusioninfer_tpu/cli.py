@@ -183,6 +183,11 @@ def build_parser() -> argparse.ArgumentParser:
                        help="speculative decoding: propose up to K draft "
                             "tokens per greedy request by n-gram prompt "
                             "lookup, verified in one forward (0 = off)")
+    serve.add_argument("--kv-cache-dtype", choices=("auto", "int8"),
+                       default="auto",
+                       help="int8: quantized KV pages — half the decode "
+                            "attention HBM traffic, ~2x the page pool "
+                            "(single-device; PD roles need bf16 pages)")
     serve.add_argument("--enable-profiling", action="store_true",
                        help="expose /debug/profile (writes to FUSIONINFER_PROFILE_DIR)")
     serve.add_argument("--lora", action="append", default=[],

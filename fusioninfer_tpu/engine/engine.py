@@ -168,6 +168,13 @@ class NativeEngine:
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
         self.mesh = mesh
+        if self.cache_cfg.quantized and mesh is not None:
+            # the TP kernel wrappers and KV sharding rules cover the bf16
+            # page layout; int8 pages are the single-chip capacity story
+            raise ValueError(
+                "kv_dtype=int8 is single-device serving; use bf16 KV "
+                "pages with tensor parallelism"
+            )
         self.lora_set = None
         if lora_adapters:
             from fusioninfer_tpu.models.lora import AdapterSet
@@ -343,6 +350,11 @@ class NativeEngine:
         """Prefill-worker side: queue a prefill whose KV leaves as a slab.
         Served inside :meth:`step` (engine thread owns the cache); resolves
         to a :class:`fusioninfer_tpu.engine.kv_transfer.KVSlab`."""
+        if self.cache_cfg.quantized:
+            raise ValueError(
+                "the PD KV-slab wire carries bf16 pages; kv_dtype=int8 "
+                "is not yet supported on PD roles"
+            )
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._slab_q.put((request, fut))
         return fut
@@ -364,6 +376,11 @@ class NativeEngine:
             raise ValueError(
                 "guided JSON is not yet supported on the "
                 "PD-disaggregated prefill wire"
+            )
+        if self.cache_cfg.quantized:
+            raise ValueError(
+                "the PD KV-slab wire carries bf16 pages; kv_dtype=int8 "
+                "is not yet supported on PD roles"
             )
         if slab.page_size != self.cache_cfg.page_size:
             raise ValueError(

@@ -33,6 +33,13 @@ class CacheConfig:
     n_pages: int = 256  # includes the reserved trash page
     page_size: int = 128
     max_pages_per_seq: int = 32
+    # "model" = pages in the model dtype (bf16); "int8" = per-(token,
+    # kv-head) symmetric int8 pages + f32 scales — half the page bytes
+    # (decode attention's HBM traffic) and twice the pool for the same
+    # budget.  Scales live in a SEPARATE [..., 1, page_size] array so
+    # every per-page slice keeps whole trailing tiles (Mosaic-safe,
+    # same argument as the head-major page layout).
+    kv_dtype: str = "model"
 
     @property
     def trash_page(self) -> int:
@@ -42,9 +49,15 @@ class CacheConfig:
     def max_len(self) -> int:
         return self.max_pages_per_seq * self.page_size
 
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
+
     def validate(self) -> "CacheConfig":
         if self.page_size < 1 or self.n_pages < 2 or self.max_pages_per_seq < 1:
             raise ValueError(f"invalid cache config {self}")
+        if self.kv_dtype not in ("model", "int8"):
+            raise ValueError(f"unknown kv_dtype {self.kv_dtype!r}")
         usable = self.n_pages - 1  # trash page reserved
         if self.max_pages_per_seq > usable:
             # otherwise a request the engine admits (fits max_len) could need
@@ -64,22 +77,34 @@ def init_kv_cache(cfg: ModelConfig, cache_cfg: CacheConfig) -> dict:
         cache_cfg.page_size,
         cfg.head_dim,
     )
+    if cache_cfg.quantized:
+        scale_shape = (
+            cfg.n_layers,
+            cfg.n_kv_heads,
+            cache_cfg.n_pages,
+            1,
+            cache_cfg.page_size,
+        )
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.float32),
+            "v_scale": jnp.zeros(scale_shape, jnp.float32),
+        }
     return {
         "k": jnp.zeros(shape, cfg.jax_dtype),
         "v": jnp.zeros(shape, cfg.jax_dtype),
     }
 
 
-def page_bytes(cfg: ModelConfig, page_size: int) -> int:
+def page_bytes(cfg: ModelConfig, page_size: int,
+               kv_dtype: str = "model") -> int:
     """Device bytes one KV page costs (k + v, all layers)."""
-    return (
-        2
-        * cfg.n_layers
-        * page_size
-        * cfg.n_kv_heads
-        * cfg.head_dim
-        * jnp.dtype(cfg.jax_dtype).itemsize
-    )
+    if kv_dtype == "int8":
+        per_token = cfg.head_dim * 1 + 4  # int8 values + one f32 scale
+    else:
+        per_token = cfg.head_dim * jnp.dtype(cfg.jax_dtype).itemsize
+    return 2 * cfg.n_layers * page_size * cfg.n_kv_heads * per_token
 
 
 def model_param_bytes(cfg: ModelConfig) -> int:
@@ -105,6 +130,7 @@ def auto_cache_config(
     tp: int = 1,
     hbm_bytes: int | None = None,
     prefix_caching: bool = True,
+    kv_dtype: str = "model",
 ) -> CacheConfig:
     """Size the page pool from device memory, vLLM's ``gpu_memory_utilization``
     equivalent.
@@ -134,7 +160,7 @@ def auto_cache_config(
     n_pages = min_pages
     if hbm_bytes:
         budget = int(hbm_bytes * hbm_utilization) - model_param_bytes(cfg) // tp
-        fit = budget // max(1, page_bytes(cfg, page_size) // tp)
+        fit = budget // max(1, page_bytes(cfg, page_size, kv_dtype) // tp)
         if fit < min_pages:
             raise ValueError(
                 f"model {cfg.name} with max_model_len={max_model_len} × "
@@ -146,12 +172,14 @@ def auto_cache_config(
         if prefix_caching:
             n_pages = min(int(fit), 4 * min_pages)
     return CacheConfig(
-        n_pages=n_pages, page_size=page_size, max_pages_per_seq=pages_per_seq
+        n_pages=n_pages, page_size=page_size, max_pages_per_seq=pages_per_seq,
+        kv_dtype=kv_dtype,
     ).validate()
 
 
 def kv_cache_bytes(cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
-    return cache_cfg.n_pages * page_bytes(cfg, cache_cfg.page_size)
+    return cache_cfg.n_pages * page_bytes(cfg, cache_cfg.page_size,
+                                          cache_cfg.kv_dtype)
 
 
 class PageAllocator:

@@ -26,7 +26,7 @@ from jax import lax
 
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
 from fusioninfer_tpu.models.config import ModelConfig
-from fusioninfer_tpu.models.quantization import embed_lookup
+from fusioninfer_tpu.models.quantization import embed_lookup, kv_quantize
 from fusioninfer_tpu.models.transformer import (
     layer_forward,
     lm_head,
@@ -34,6 +34,77 @@ from fusioninfer_tpu.models.transformer import (
     qkv_proj,
     rms_norm,
 )
+
+
+def _cache_xs(params, lora, cache: dict, quantized: bool) -> tuple:
+    """Per-layer scan operands: weights (+ lora) + cache arrays (+ scale
+    arrays for int8 pages)."""
+    xs = [params["layers"]]
+    if lora is not None:
+        xs.append(lora)
+    xs += [cache["k"], cache["v"]]
+    if quantized:
+        xs += [cache["k_scale"], cache["v_scale"]]
+    return tuple(xs)
+
+
+def _cache_unpack(inputs, has_lora: bool, quantized: bool):
+    it = iter(inputs)
+    layer = next(it)
+    layer_lora = next(it) if has_lora else None
+    k_cache_l, v_cache_l = next(it), next(it)
+    ks_l = next(it) if quantized else None
+    vs_l = next(it) if quantized else None
+    return layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l
+
+
+def _cache_result(scanned, quantized: bool) -> dict:
+    if quantized:
+        k_cache, v_cache, ks, vs = scanned
+        return {"k": k_cache, "v": v_cache, "k_scale": ks, "v_scale": vs}
+    k_cache, v_cache = scanned
+    return {"k": k_cache, "v": v_cache}
+
+
+def _scatter_kv(k, v, k_cache_l, v_cache_l, ks_l, vs_l,
+                write_page, write_slot, head_axis: int):
+    """Write fresh K/V (``[..., KV, Hd]`` with the head axis at
+    ``head_axis``) into head-major pages at the given page/slot maps,
+    quantizing on the way when the cache is int8 (per-token scales land
+    in the ``[KV, n_pages, 1, ps]`` scale arrays)."""
+    quantized = ks_l is not None
+    if quantized:
+        k, k_s = kv_quantize(k)
+        v, v_s = kv_quantize(v)
+    k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
+        jnp.moveaxis(k, head_axis, 0)
+    )
+    v_cache_l = v_cache_l.at[:, write_page, write_slot].set(
+        jnp.moveaxis(v, head_axis, 0)
+    )
+    if quantized:
+        # scatter via the squeezed [KV, n_pages, ps] view: the two fancy
+        # indices stay adjacent, matching the value scatter's layout
+        ks_l = ks_l[:, :, 0].at[:, write_page, write_slot].set(
+            jnp.moveaxis(k_s, head_axis, 0)
+        )[:, :, None, :]
+        vs_l = vs_l[:, :, 0].at[:, write_page, write_slot].set(
+            jnp.moveaxis(v_s, head_axis, 0)
+        )[:, :, None, :]
+    return k_cache_l, v_cache_l, ks_l, vs_l
+
+
+def _layer_out(x, k_cache_l, v_cache_l, ks_l, vs_l):
+    if ks_l is not None:
+        return x, (k_cache_l, v_cache_l, ks_l, vs_l)
+    return x, (k_cache_l, v_cache_l)
+
+
+def _dequant_gather(ctx, scale_l, pages, flat_shape):
+    """Portable-path read-side dequant: gathered int8 context ``ctx``
+    (``[KV, *flat_shape, Hd]``) × its gathered scales → f32."""
+    sc = scale_l[:, pages, 0].reshape(*flat_shape)
+    return ctx.astype(jnp.float32) * sc[..., None]
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
@@ -60,6 +131,7 @@ def prefill(
     """
     B, S = tokens.shape
     ps = cache_cfg.page_size
+    quantized = cache_cfg.quantized
     x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
 
@@ -73,30 +145,21 @@ def prefill(
     slot_of_token = jnp.broadcast_to(token_idx % ps, (B, S))
 
     def body(x, inputs):
-        if lora is None:
-            layer, k_cache_l, v_cache_l = inputs
-            layer_lora = None
-        else:
-            layer, layer_lora, k_cache_l, v_cache_l = inputs
+        layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
+            inputs, lora is not None, quantized)
         out, (k, v) = layer_forward(cfg, layer, x, positions, mesh=mesh,
                                     lora=layer_lora, adapter_ids=adapter_ids)
         # head-major per-layer cache [KV, n_pages, ps, Hd]; k is
         # [B, S, KV, Hd] → scatter [KV, B, S, Hd] at [B, S] page/slot maps
-        k_cache_l = k_cache_l.at[:, page_of_token, slot_of_token].set(
-            jnp.moveaxis(k, 2, 0)
-        )
-        v_cache_l = v_cache_l.at[:, page_of_token, slot_of_token].set(
-            jnp.moveaxis(v, 2, 0)
-        )
-        return out, (k_cache_l, v_cache_l)
+        k_cache_l, v_cache_l, ks_l, vs_l = _scatter_kv(
+            k, v, k_cache_l, v_cache_l, ks_l, vs_l,
+            page_of_token, slot_of_token, head_axis=2)
+        return _layer_out(out, k_cache_l, v_cache_l, ks_l, vs_l)
 
-    xs = ((params["layers"], cache["k"], cache["v"]) if lora is None
-          else (params["layers"], lora, cache["k"], cache["v"]))
-    x, scanned = lax.scan(body, x, xs)
-    k_cache, v_cache = scanned
+    x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[jnp.arange(B), jnp.maximum(true_lens - 1, 0)]  # [B, D]
-    return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
+    return _cache_result(scanned, quantized), lm_head(cfg, params, last)
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
@@ -135,7 +198,8 @@ def prefill_suffix(
     ps = cache_cfg.page_size
     mp = page_row.shape[0]
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    dtype_ctx = cache["k"].dtype
+    quantized = cache_cfg.quantized
+    dtype_ctx = jnp.float32 if quantized else cache["k"].dtype
     use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
 
     x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)  # [1, C, D]
@@ -152,23 +216,17 @@ def prefill_suffix(
     attend = ctx_idx <= positions[0][:, None]  # [C, T]
 
     def body(x, inputs):
-        if lora is None:
-            layer, k_cache_l, v_cache_l = inputs
-            layer_lora = None
-        else:
-            layer, layer_lora, k_cache_l, v_cache_l = inputs
+        layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
+            inputs, lora is not None, quantized)
         from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
 
         layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
         q, k, v = qkv_proj(cfg, layer, x, positions, layer_lora, adapter_ids)
 
         # head-major per-layer cache [KV, n_pages, ps, Hd]; k[0] is [C, KV, Hd]
-        k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
-            jnp.swapaxes(k[0], 0, 1)
-        )
-        v_cache_l = v_cache_l.at[:, write_page, write_slot].set(
-            jnp.swapaxes(v[0], 0, 1)
-        )
+        k_cache_l, v_cache_l, ks_l, vs_l = _scatter_kv(
+            k[0], v[0], k_cache_l, v_cache_l, ks_l, vs_l,
+            write_page, write_slot, head_axis=1)
 
         if use_kernel:
             if mesh is not None:
@@ -181,11 +239,15 @@ def prefill_suffix(
             else:
                 attn = paged_prefill_attention(
                     q[0], k_cache_l, v_cache_l, page_row, start, true_len,
+                    ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
                 )[None]
         else:
             k_ctx = k_cache_l[:, page_row].reshape(KV, mp * ps, Hd)
             v_ctx = v_cache_l[:, page_row].reshape(KV, mp * ps, Hd)
+            if quantized:
+                k_ctx = _dequant_gather(k_ctx, ks_l, page_row, (KV, mp * ps))
+                v_ctx = _dequant_gather(v_ctx, vs_l, page_row, (KV, mp * ps))
 
             group = H // KV
             qg = q.reshape(B, C, KV, group, Hd)
@@ -196,21 +258,20 @@ def prefill_suffix(
                 "bkgst,ktd->bskgd",
                 jax.nn.softmax(scores, axis=-1).astype(dtype_ctx),
                 v_ctx,
-            ).reshape(B, C, H * Hd)
+            ).reshape(B, C, H * Hd).astype(x.dtype)
         out_proj = attn @ layer["wo"]
         if layer_lora is not None:
             from fusioninfer_tpu.models.lora import lora_delta
 
             out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
         x = x + out_proj
-        return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
+        return _layer_out(x + mlp_block(cfg, layer, x),
+                          k_cache_l, v_cache_l, ks_l, vs_l)
 
-    xs = ((params["layers"], cache["k"], cache["v"]) if lora is None
-          else (params["layers"], lora, cache["k"], cache["v"]))
-    x, (k_cache, v_cache) = lax.scan(body, x, xs)
+    x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]
-    return {"k": k_cache, "v": v_cache}, lm_head(cfg, params, last)
+    return _cache_result(scanned, quantized), lm_head(cfg, params, last)
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
@@ -234,6 +295,7 @@ def decode_step(
     ps = cache_cfg.page_size
     mp = page_tables.shape[1]
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    quantized = cache_cfg.quantized
     use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
 
     x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)[:, None, :]  # [B, 1, D]
@@ -252,11 +314,8 @@ def decode_step(
     attend = attend[:, None, None, :]  # [B, 1, 1, T]
 
     def body(x, inputs):
-        if lora is None:
-            layer, k_cache_l, v_cache_l = inputs
-            layer_lora = None
-        else:
-            layer, layer_lora, k_cache_l, v_cache_l = inputs
+        layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
+            inputs, lora is not None, quantized)
         from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
 
         layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
@@ -265,12 +324,9 @@ def decode_step(
 
         # write this step's K/V into each sequence's page slot
         # (head-major cache [KV, n_pages, ps, Hd]; k[:, 0] is [B, KV, Hd])
-        k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
-            jnp.swapaxes(k[:, 0], 0, 1)
-        )
-        v_cache_l = v_cache_l.at[:, write_page, write_slot].set(
-            jnp.swapaxes(v[:, 0], 0, 1)
-        )
+        k_cache_l, v_cache_l, ks_l, vs_l = _scatter_kv(
+            k[:, 0], v[:, 0], k_cache_l, v_cache_l, ks_l, vs_l,
+            write_page, write_slot, head_axis=1)
 
         if use_kernel:
             # Pallas kernel streams only the live pages HBM→VMEM
@@ -284,33 +340,39 @@ def decode_step(
             else:
                 attn = paged_decode_attention(
                     q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
+                    ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
                 )[:, None, :]  # [B, 1, H*Hd]
         else:
             # portable path: gather pages [KV, B, mp, ps, Hd] -> [KV, B, T, Hd]
             k_ctx = k_cache_l[:, page_tables].reshape(KV, B_, mp * ps, Hd)
             v_ctx = v_cache_l[:, page_tables].reshape(KV, B_, mp * ps, Hd)
+            if quantized:
+                k_ctx = _dequant_gather(k_ctx, ks_l, page_tables,
+                                        (KV, B_, mp * ps))
+                v_ctx = _dequant_gather(v_ctx, vs_l, page_tables,
+                                        (KV, B_, mp * ps))
 
             group = H // KV
             qg = q.reshape(B_, 1, KV, group, Hd)
             scores = jnp.einsum("bskgd,kbtd->bkgst", qg, k_ctx).astype(jnp.float32) / jnp.sqrt(Hd)
             scores = jnp.where(attend[:, :, None, :, :] * jnp.ones_like(scores, bool), scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
-            attn = jnp.einsum("bkgst,kbtd->bskgd", probs, v_ctx).reshape(B_, 1, H * Hd)
+            attn = jnp.einsum("bkgst,kbtd->bskgd", probs, v_ctx).reshape(
+                B_, 1, H * Hd).astype(x.dtype)
         out_proj = attn @ layer["wo"]
         if layer_lora is not None:
             from fusioninfer_tpu.models.lora import lora_delta
 
             out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
         x = x + out_proj
-        return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
+        return _layer_out(x + mlp_block(cfg, layer, x),
+                          k_cache_l, v_cache_l, ks_l, vs_l)
 
-    xs = ((params["layers"], cache["k"], cache["v"]) if lora is None
-          else (params["layers"], lora, cache["k"], cache["v"]))
-    x, (k_cache, v_cache) = lax.scan(body, x, xs)
+    x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = lm_head(cfg, params, x[:, 0])
-    return {"k": k_cache, "v": v_cache}, logits
+    return _cache_result(scanned, quantized), logits
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
@@ -351,6 +413,7 @@ def verify_step(
     ps = cache_cfg.page_size
     mp = page_tables.shape[1]
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    quantized = cache_cfg.quantized
     use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
 
     x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)  # [B, C, D]
@@ -370,23 +433,17 @@ def verify_step(
     attend = ctx_idx <= positions[:, :, None]  # [B, C, T]
 
     def body(x, inputs):
-        if lora is None:
-            layer, k_cache_l, v_cache_l = inputs
-            layer_lora = None
-        else:
-            layer, layer_lora, k_cache_l, v_cache_l = inputs
+        layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
+            inputs, lora is not None, quantized)
         from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
 
         layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
         q, k, v = qkv_proj(cfg, layer, x, positions, layer_lora, adapter_ids)
 
         # head-major cache [KV, n_pages, ps, Hd]; k is [B, C, KV, Hd]
-        k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
-            jnp.moveaxis(k, 2, 0)
-        )
-        v_cache_l = v_cache_l.at[:, write_page, write_slot].set(
-            jnp.moveaxis(v, 2, 0)
-        )
+        k_cache_l, v_cache_l, ks_l, vs_l = _scatter_kv(
+            k, v, k_cache_l, v_cache_l, ks_l, vs_l,
+            write_page, write_slot, head_axis=2)
 
         if use_kernel:
             if mesh is not None:
@@ -399,11 +456,17 @@ def verify_step(
             else:
                 attn = paged_verify_attention(
                     q, k_cache_l, v_cache_l, page_tables, starts, counts,
+                    ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
                 )
         else:
             k_ctx = k_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)
             v_ctx = v_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)
+            if quantized:
+                k_ctx = _dequant_gather(k_ctx, ks_l, page_tables,
+                                        (KV, B, mp * ps))
+                v_ctx = _dequant_gather(v_ctx, vs_l, page_tables,
+                                        (KV, B, mp * ps))
             group = H // KV
             qg = q.reshape(B, C, KV, group, Hd)
             scores = jnp.einsum(
@@ -413,21 +476,20 @@ def verify_step(
             probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
             attn = jnp.einsum("bkgct,kbtd->bckgd", probs, v_ctx).reshape(
                 B, C, H * Hd
-            )
+            ).astype(x.dtype)
         out_proj = attn @ layer["wo"]
         if layer_lora is not None:
             from fusioninfer_tpu.models.lora import lora_delta
 
             out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
         x = x + out_proj
-        return x + mlp_block(cfg, layer, x), (k_cache_l, v_cache_l)
+        return _layer_out(x + mlp_block(cfg, layer, x),
+                          k_cache_l, v_cache_l, ks_l, vs_l)
 
-    xs = ((params["layers"], cache["k"], cache["v"]) if lora is None
-          else (params["layers"], lora, cache["k"], cache["v"]))
-    x, (k_cache, v_cache) = lax.scan(body, x, xs)
+    x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = lm_head(cfg, params, x)  # [B, C, V]
-    return {"k": k_cache, "v": v_cache}, logits
+    return _cache_result(scanned, quantized), logits
 
 
 def prefill_buckets(max_len: int, smallest: int = 32) -> list[int]:

@@ -876,6 +876,14 @@ def serve_from_args(args) -> int:
         from fusioninfer_tpu.models.lora import load_adapter
 
         lora_adapters[name] = load_adapter(path, cfg)
+    kv_dtype = getattr(args, "kv_cache_dtype", "auto")
+    if kv_dtype == "int8" and (getattr(args, "prefill_upstream", None) or None):
+        # both facts are known at startup: fail here, not after every
+        # request has burned a remote prefill + KV transfer
+        raise SystemExit(
+            "--kv-cache-dtype int8 is incompatible with --prefill-upstream: "
+            "the PD KV-slab wire carries bf16 pages"
+        )
     cache_cfg = auto_cache_config(
         cfg,
         page_size=args.page_size,
@@ -884,6 +892,7 @@ def serve_from_args(args) -> int:
         hbm_utilization=args.hbm_utilization,
         tp=tp,
         prefix_caching=not getattr(args, "no_prefix_caching", False),
+        kv_dtype="int8" if kv_dtype == "int8" else "model",
     )
     logger.info("cache: %d pages of %d tokens", cache_cfg.n_pages, cache_cfg.page_size)
     engine = NativeEngine(

@@ -157,3 +157,18 @@ def quantized_param_bytes(cfg) -> int:
         int(np.prod(leaf.shape)) * leaf.dtype.itemsize
         for leaf in jax.tree.leaves(shapes)
     )
+
+
+def kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(token, head) symmetric int8 for KV-cache pages:
+    ``[..., Hd]`` → (int8 ``[..., Hd]``, f32 scale ``[...]``).
+
+    Scale-after-dot identity the kernels rely on:
+    ``q · (s · k8) == s · (q · k8)``, so dequantization folds into a
+    per-column multiply of the score/probability matrices instead of
+    materializing dequantized pages."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale

@@ -34,42 +34,115 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _page_dma(slot, g, page, k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
+              scale_refs=None, scale_bufs=None):
+    """Async copies for one page of K/V (+ their [1, ps] scale rows when
+    the cache is int8).  Head-major pages: slicing (g, page) squeezes two
+    leading dims and copies whole trailing tiles — Mosaic-clean."""
+    copies = [
+        pltpu.make_async_copy(
+            k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
+        ),
+        pltpu.make_async_copy(
+            v_pages_ref.at[g, page], v_buf.at[slot], sem.at[slot, 1]
+        ),
+    ]
+    if scale_refs is not None:
+        ks_ref, vs_ref = scale_refs
+        ks_buf, vs_buf = scale_bufs
+        copies += [
+            pltpu.make_async_copy(
+                ks_ref.at[g, page], ks_buf.at[slot], sem.at[slot, 2]
+            ),
+            pltpu.make_async_copy(
+                vs_ref.at[g, page], vs_buf.at[slot], sem.at[slot, 3]
+            ),
+        ]
+    return copies
+
+
+def _split_rest(rest, quantized):
+    """Unpack a paged kernel's trailing refs: (scale_refs, o_ref, value
+    bufs, scale_bufs, sem) — the one place the quantized ref layout lives."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, k_buf, v_buf, ks_buf, vs_buf, sem = rest
+        return (ks_ref, vs_ref), o_ref, k_buf, v_buf, (ks_buf, vs_buf), sem
+    o_ref, k_buf, v_buf, sem = rest
+    return None, o_ref, k_buf, v_buf, None, sem
+
+
+def _page_specs_scratch(page_size, Hd, k_dtype, v_dtype, quantized):
+    """(in_specs for page operands, scratch shapes) shared by the three
+    paged kernels — quantized adds scale operands, scale buffers, and
+    two more DMA semaphores per slot."""
+    page_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (4 if quantized else 2)
+    scratch = [
+        pltpu.VMEM((2, page_size, Hd), k_dtype),
+        pltpu.VMEM((2, page_size, Hd), v_dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((2, 1, page_size), jnp.float32),
+            pltpu.VMEM((2, 1, page_size), jnp.float32),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 4 if quantized else 2)))
+    return page_specs, scratch
+
+
+def _scores(q, k, k_scale):
+    """q·kᵀ with the int8 page scale folded in AFTER the dot
+    (q·(s·k8) == s·(q·k8)) — pages never materialize dequantized."""
+    s = jax.lax.dot_general(
+        q, k.astype(jnp.float32) if k.dtype != jnp.float32 else k,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if k_scale is not None:
+        s = s * k_scale  # [1, ps] broadcasts over rows
+    return s
+
+
+def _weighted_values(pexp, v, v_scale):
+    """pexp·v with the int8 value scale folded into the probabilities."""
+    if v_scale is not None:
+        pexp = pexp * v_scale  # [1, ps] broadcast
+        v = v.astype(jnp.float32)
+    else:
+        pexp = pexp.astype(v.dtype)
+    return jax.lax.dot_general(
+        pexp, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _paged_kernel(
     # scalar prefetch
     page_tables_ref,  # [B, mp] int32 (SMEM)
     lengths_ref,  # [B] int32 — context length incl. the current token
-    # inputs
-    q_ref,  # [1, 1, G, Hd] VMEM block
-    k_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
-    v_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
-    # output
-    o_ref,  # [1, 1, G, Hd] VMEM block
-    # scratch
-    k_buf,  # [2, ps, Hd] VMEM
-    v_buf,  # [2, ps, Hd] VMEM
-    sem,  # DMA semaphores [2, 2]
-    *,
+    # inputs: q_ref [1, 1, G, Hd] VMEM block; k/v pages [KV, n_pages, ps,
+    # Hd] in ANY; when quantized, k/v scale refs [KV, n_pages, 1, ps]
+    # outputs+scratch via *rest (layout depends on `quantized`)
+    q_ref,
+    k_pages_ref,
+    v_pages_ref,
+    *rest,
     max_pages: int,
     page_size: int,
     sm_scale: float,
+    quantized: bool,
 ):
+    scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
+        rest, quantized)
+    ks_buf, vs_buf = scale_bufs if quantized else (None, None)
     b = pl.program_id(0)
     g = pl.program_id(1)
     length = lengths_ref[b]
     n_used = pl.cdiv(length, page_size)  # live pages for this sequence
 
     def dma(slot, p):
-        page = page_tables_ref[b, p]
-        # Head-major pages: slicing (g, page) squeezes two leading dims
-        # and copies one whole [ps, Hd] tile — Mosaic-clean.
-        return (
-            pltpu.make_async_copy(
-                k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
-            ),
-            pltpu.make_async_copy(
-                v_pages_ref.at[g, page], v_buf.at[slot], sem.at[slot, 1]
-            ),
-        )
+        return _page_dma(slot, g, page_tables_ref[b, p], k_pages_ref,
+                         v_pages_ref, k_buf, v_buf, sem, scale_refs,
+                         scale_bufs)
 
     @pl.when(n_used > 0)
     def _start_first():
@@ -92,11 +165,10 @@ def _paged_kernel(
             c.wait()
         k = k_buf[slot]  # [ps, Hd]
         v = v_buf[slot]
+        ks = ks_buf[slot] if quantized else None  # [1, ps]
+        vs = vs_buf[slot] if quantized else None
 
-        s = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [G, ps]
+        s = _scores(q, k, ks)  # [G, ps]
         pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (G, page_size), 1
         )
@@ -107,10 +179,7 @@ def _paged_kernel(
         pexp = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(pexp, axis=1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        acc_new = acc * alpha + _weighted_values(pexp, v, vs)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((G, 1), -jnp.inf, jnp.float32)
@@ -129,6 +198,8 @@ def paged_decode_attention(
     v_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
     page_tables: jax.Array,  # [B, max_pages] int32
     lengths: jax.Array,  # [B] int32, context length incl. current token
+    k_scales: jax.Array | None = None,  # [KV, n_pages, 1, ps] (int8 pages)
+    v_scales: jax.Array | None = None,
     *,
     sm_scale: float | None = None,
     interpret: bool = False,
@@ -136,14 +207,21 @@ def paged_decode_attention(
     """Batched one-token attention over paged KV → [B, H·Hd].
 
     Inactive batch slots should pass ``lengths = 0`` (output is zeros).
+    With int8 pages, pass the per-(page, token) f32 scale arrays — the
+    kernel streams them alongside the pages and folds dequantization
+    into the score/probability matrices.
     """
     B, H, Hd = q.shape
     KV, _, page_size, _ = k_pages.shape
     G = H // KV
     max_pages = page_tables.shape[1]
     sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
+    quantized = k_scales is not None
 
     qg = q.reshape(B, KV, G, Hd)
+
+    page_specs, scratch = _page_specs_scratch(
+        page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -153,30 +231,29 @@ def paged_decode_attention(
                 (1, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *page_specs,
         ],
         out_specs=pl.BlockSpec(
             (1, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, page_size, Hd), k_pages.dtype),
-            pltpu.VMEM((2, page_size, Hd), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _paged_kernel,
         max_pages=max_pages, page_size=page_size, sm_scale=sm_scale,
+        quantized=quantized,
     )
+    operands = [page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg,
+                k_pages, v_pages]
+    if quantized:
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, G, Hd), q.dtype),
         interpret=interpret,
-    )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg,
-      k_pages, v_pages)
+    )(*operands)
     return out.reshape(B, H * Hd)
 
 
@@ -184,21 +261,20 @@ def _suffix_kernel(
     # scalar prefetch
     page_row_ref,  # [mp] int32 (SMEM) — ONE sequence's page table
     meta_ref,  # [2] int32: (start, true_len)
-    # inputs
-    q_ref,  # [block_q, 1, G, Hd] VMEM block
-    k_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
-    v_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
-    # output
-    o_ref,  # [block_q, 1, G, Hd] VMEM block
-    # scratch
-    k_buf,  # [2, ps, Hd]
-    v_buf,
-    sem,  # [2, 2]
-    *,
+    # inputs: q_ref [block_q, 1, G, Hd] VMEM block; k/v pages in ANY;
+    # when quantized, scale refs [KV, n_pages, 1, ps] then out/scratch
+    q_ref,
+    k_pages_ref,
+    v_pages_ref,
+    *rest,
     block_q: int,
     page_size: int,
     sm_scale: float,
+    quantized: bool,
 ):
+    scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
+        rest, quantized)
+    ks_buf, vs_buf = scale_bufs if quantized else (None, None)
     g = pl.program_id(0)
     i = pl.program_id(1)  # q tile
     start = meta_ref[0]
@@ -210,15 +286,8 @@ def _suffix_kernel(
     n_used = jnp.where(n_q_real > 0, pl.cdiv(max_pos + 1, page_size), 0)
 
     def dma(slot, p):
-        page = page_row_ref[p]
-        return (
-            pltpu.make_async_copy(
-                k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
-            ),
-            pltpu.make_async_copy(
-                v_pages_ref.at[g, page], v_buf.at[slot], sem.at[slot, 1]
-            ),
-        )
+        return _page_dma(slot, g, page_row_ref[p], k_pages_ref, v_pages_ref,
+                         k_buf, v_buf, sem, scale_refs, scale_bufs)
 
     @pl.when(n_used > 0)
     def _start_first():
@@ -246,11 +315,10 @@ def _suffix_kernel(
             c.wait()
         k = k_buf[slot]  # [ps, Hd]
         v = v_buf[slot]
+        ks = ks_buf[slot] if quantized else None
+        vs = vs_buf[slot] if quantized else None
 
-        s = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [R, ps]
+        s = _scores(q, k, ks)  # [R, ps]
         ctx_pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (R, page_size), 1
         )
@@ -261,10 +329,7 @@ def _suffix_kernel(
         pexp = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(pexp, axis=1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        acc_new = acc * alpha + _weighted_values(pexp, v, vs)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((R, 1), -jnp.inf, jnp.float32)
@@ -285,6 +350,8 @@ def paged_prefill_attention(
     page_row: jax.Array,  # [max_pages] int32 — ONE sequence's pages
     start: jax.Array,  # scalar int32: global position of q[0]
     true_len: jax.Array,  # scalar int32: real (unpadded) suffix length
+    k_scales: jax.Array | None = None,  # [KV, n_pages, 1, ps] (int8 pages)
+    v_scales: jax.Array | None = None,
     *,
     sm_scale: float | None = None,
     block_q: int = 128,
@@ -310,9 +377,13 @@ def paged_prefill_attention(
     if C % block_q:
         raise ValueError(f"suffix bucket {C} not divisible by block_q {block_q}")
     n_qt = C // block_q
+    quantized = k_scales is not None
 
     qg = q.reshape(C, KV, G, Hd)
     meta = jnp.stack([jnp.int32(start), jnp.int32(true_len)])
+
+    page_specs, scratch = _page_specs_scratch(
+        page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -322,29 +393,28 @@ def paged_prefill_attention(
                 (block_q, 1, G, Hd), lambda g, i, *_: (i, g, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *page_specs,
         ],
         out_specs=pl.BlockSpec(
             (block_q, 1, G, Hd), lambda g, i, *_: (i, g, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, page_size, Hd), k_pages.dtype),
-            pltpu.VMEM((2, page_size, Hd), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _suffix_kernel,
         block_q=block_q, page_size=page_size, sm_scale=sm_scale,
+        quantized=quantized,
     )
+    operands = [page_row.astype(jnp.int32), meta, qg, k_pages, v_pages]
+    if quantized:
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((C, KV, G, Hd), q.dtype),
         interpret=interpret,
-    )(page_row.astype(jnp.int32), meta, qg, k_pages, v_pages)
+    )(*operands)
     return out.reshape(C, H * Hd)
 
 
@@ -353,21 +423,20 @@ def _verify_kernel(
     page_tables_ref,  # [B, mp] int32 (SMEM)
     starts_ref,  # [B] int32 — global position of each sequence's query 0
     counts_ref,  # [B] int32 — real queries this step (0 = inactive slot)
-    # inputs
-    q_ref,  # [C, 1, G, Hd] VMEM block (one sequence's query window)
-    k_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
-    v_pages_ref,  # [KV, n_pages, ps, Hd] in HBM/ANY
-    # output
-    o_ref,  # [C, 1, G, Hd] VMEM block
-    # scratch
-    k_buf,  # [2, ps, Hd]
-    v_buf,
-    sem,  # [2, 2]
-    *,
+    # inputs: q_ref [C, 1, G, Hd] VMEM block; k/v pages in ANY; when
+    # quantized, scale refs [KV, n_pages, 1, ps] then out/scratch
+    q_ref,
+    k_pages_ref,
+    v_pages_ref,
+    *rest,
     window: int,
     page_size: int,
     sm_scale: float,
+    quantized: bool,
 ):
+    scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
+        rest, quantized)
+    ks_buf, vs_buf = scale_bufs if quantized else (None, None)
     b = pl.program_id(0)
     g = pl.program_id(1)
     start = starts_ref[b]
@@ -375,15 +444,9 @@ def _verify_kernel(
     n_used = jnp.where(count > 0, pl.cdiv(start + count, page_size), 0)
 
     def dma(slot, p):
-        page = page_tables_ref[b, p]
-        return (
-            pltpu.make_async_copy(
-                k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
-            ),
-            pltpu.make_async_copy(
-                v_pages_ref.at[g, page], v_buf.at[slot], sem.at[slot, 1]
-            ),
-        )
+        return _page_dma(slot, g, page_tables_ref[b, p], k_pages_ref,
+                         v_pages_ref, k_buf, v_buf, sem, scale_refs,
+                         scale_bufs)
 
     @pl.when(n_used > 0)
     def _start_first():
@@ -410,11 +473,10 @@ def _verify_kernel(
             c.wait()
         k = k_buf[slot]
         v = v_buf[slot]
+        ks = ks_buf[slot] if quantized else None
+        vs = vs_buf[slot] if quantized else None
 
-        s = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [R, ps]
+        s = _scores(q, k, ks)  # [R, ps]
         ctx_pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (R, page_size), 1
         )
@@ -425,10 +487,7 @@ def _verify_kernel(
         pexp = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(pexp, axis=1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        acc_new = acc * alpha + _weighted_values(pexp, v, vs)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((R, 1), -jnp.inf, jnp.float32)
@@ -449,6 +508,8 @@ def paged_verify_attention(
     page_tables: jax.Array,  # [B, max_pages] int32
     starts: jax.Array,  # [B] int32 — global position of q[:, 0]
     counts: jax.Array,  # [B] int32 — real window length (0 = inactive)
+    k_scales: jax.Array | None = None,  # [KV, n_pages, 1, ps] (int8 pages)
+    v_scales: jax.Array | None = None,
     *,
     sm_scale: float | None = None,
     interpret: bool = False,
@@ -470,8 +531,12 @@ def paged_verify_attention(
     KV, _, page_size, _ = k_pages.shape
     G = H // KV
     sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
+    quantized = k_scales is not None
 
     qg = q.reshape(B * C, KV, G, Hd)
+
+    page_specs, scratch = _page_specs_scratch(
+        page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -481,30 +546,29 @@ def paged_verify_attention(
                 (C, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *page_specs,
         ],
         out_specs=pl.BlockSpec(
             (C, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, page_size, Hd), k_pages.dtype),
-            pltpu.VMEM((2, page_size, Hd), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _verify_kernel,
         window=C, page_size=page_size, sm_scale=sm_scale,
+        quantized=quantized,
     )
+    operands = [page_tables.astype(jnp.int32), starts.astype(jnp.int32),
+                counts.astype(jnp.int32), qg, k_pages, v_pages]
+    if quantized:
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B * C, KV, G, Hd), q.dtype),
         interpret=interpret,
-    )(page_tables.astype(jnp.int32), starts.astype(jnp.int32),
-      counts.astype(jnp.int32), qg, k_pages, v_pages)
+    )(*operands)
     return out.reshape(B, C, H * Hd)
 
 

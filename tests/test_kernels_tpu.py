@@ -73,6 +73,36 @@ class TestPagedAttentionHW:
             atol=5e-2, rtol=5e-2,
         )
 
+    def test_bench_shapes_int8_kv(self):
+        """int8 pages + [KV, n_pages, 1, ps] scale rows at the bench
+        config — the quantized DMA/scale-fold path must compile under
+        Mosaic and match the dequantized-page oracle."""
+        from fusioninfer_tpu.models.quantization import kv_quantize
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_decode_attention,
+            reference_paged_attention,
+        )
+
+        B, H, KV, Hd, ps, n_pages, mp = 8, 16, 8, 128, 128, 257, 8
+        lengths = [129, 1000, 7, 1, 0, 128, 255, 513]
+        q, kp, vp, tables, ln = _paged_setup(
+            B, H, KV, Hd, ps, n_pages, mp, lengths, jnp.bfloat16
+        )
+        k8, ksc = kv_quantize(kp)
+        v8, vsc = kv_quantize(vp)
+        out = paged_decode_attention(
+            q, k8, v8, tables, ln,
+            ksc[:, :, None, :], vsc[:, :, None, :], interpret=False,
+        )
+        out.block_until_ready()
+        kd = (k8.astype(jnp.float32) * ksc[..., None]).astype(jnp.bfloat16)
+        vd = (v8.astype(jnp.float32) * vsc[..., None]).astype(jnp.bfloat16)
+        ref = reference_paged_attention(q, kd, vd, tables, ln)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=6e-2, rtol=6e-2,
+        )
+
     def test_inactive_rows_zero(self):
         from fusioninfer_tpu.ops.paged_attention import paged_decode_attention
 

