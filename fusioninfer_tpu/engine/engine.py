@@ -7,15 +7,19 @@ sequence one token per :meth:`NativeEngine.step` with a single batched
 every compiled signature is static ``(bucket, max_batch)``; membership of
 the batch changes purely through data (page tables, active mask).
 
-Capacity pressure is handled by preempting the youngest running sequence
-(pages released, request re-queued for a fresh prefill) so the oldest
-work always completes.
+Capacity pressure is handled by preempting the least urgent sequence —
+highest ``Request.priority`` value first (vLLM semantics: lower value is
+more urgent), youngest arrival within a class — with pages released and
+the request re-queued for a fresh prefill, so the most urgent (then
+oldest) work always completes.  Victims are never more urgent than the
+work displacing them.
 """
 
 from __future__ import annotations
 
 import collections
 import concurrent.futures
+import heapq
 import itertools
 import logging
 import queue as queue_mod
@@ -61,6 +65,12 @@ class Request:
     prompt_tokens: list[int]
     params: SamplingParams = field(default_factory=SamplingParams)
     arrival_time: float = field(default_factory=time.monotonic)
+    # vLLM semantics: LOWER value schedules earlier (default 0); under KV
+    # pressure the lowest-urgency (highest value) sequence is preempted
+    # first.  Within one priority class scheduling stays FCFS and newer
+    # work never evicts older work; a higher-priority arrival MAY evict
+    # lower-priority running work — that is the point of the knob.
+    priority: int = 0
     # LoRA adapter name ("" = base model); must be loaded in the engine's
     # AdapterSet.  Prefix caching is namespaced per adapter — KV computed
     # under different adapters never cross-hits.
@@ -95,6 +105,47 @@ class _SeqState:
     @property
     def n_generated(self) -> int:
         return len(self.tokens) - self.n_prompt
+
+
+def _urgency(request: Request) -> tuple:
+    """Scheduling key: smaller = more urgent (priority value, then age).
+    Used by BOTH the wait queue (pop order) and preemption (a victim
+    must compare strictly GREATER than the work displacing it)."""
+    return (request.priority, request.arrival_time)
+
+
+class _WaitQueue:
+    """Priority queue over waiting requests: (priority, arrival, tiebreak)
+    — FCFS within a priority class; re-queued (preempted) requests keep
+    their original arrival so they return to the head of their class."""
+
+    def __init__(self):
+        self._heap: list[tuple] = []
+        self._tie = itertools.count()
+
+    def push(self, request: Request) -> None:
+        heapq.heappush(self._heap, (request.priority, request.arrival_time,
+                                    next(self._tie), request))
+
+    def peek(self) -> Request:
+        return self._heap[0][3]
+
+    def pop(self) -> Request:
+        return heapq.heappop(self._heap)[3]
+
+    def remove_ids(self, ids: set[str]) -> int:
+        kept = [e for e in self._heap if e[3].request_id not in ids]
+        removed = len(self._heap) - len(kept)
+        if removed:
+            self._heap = kept
+            heapq.heapify(self._heap)
+        return removed
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __bool__(self) -> bool:
+        return bool(self._heap)
 
 
 @dataclass
@@ -256,7 +307,7 @@ class NativeEngine:
         self._output_counts = jnp.zeros((max_batch_size, V), jnp.int32)
         self._suppress = jnp.zeros((max_batch_size, V), jnp.bool_)
 
-        self.waiting: collections.deque[Request] = collections.deque()
+        self.waiting = _WaitQueue()
         # PD decode side: requests whose KV arrived from a prefill worker
         self.waiting_prefilled: collections.deque[tuple[Request, "KVSlab"]] = (
             collections.deque()
@@ -319,7 +370,7 @@ class NativeEngine:
                 f"prompt+max_tokens exceeds engine max_len {self.cache_cfg.max_len}"
             )
         with self._lock:
-            self.waiting.append(request)
+            self.waiting.push(request)
 
     @property
     def num_waiting(self) -> int:
@@ -520,12 +571,8 @@ class NativeEngine:
             cancelled, self._cancelled = self._cancelled, set()
             if not cancelled:
                 return
-            # rebuild under the lock: add_request appends from HTTP threads
-            kept = collections.deque(
-                r for r in self.waiting if r.request_id not in cancelled
-            )
-            self.cancelled_total += len(self.waiting) - len(kept)
-            self.waiting = kept
+            # mutate under the lock: add_request pushes from HTTP threads
+            self.cancelled_total += self.waiting.remove_ids(cancelled)
             kept_p = collections.deque(
                 (r, s) for r, s in self.waiting_prefilled
                 if r.request_id not in cancelled
@@ -550,12 +597,14 @@ class NativeEngine:
     # -- scheduling ----------------------------------------------------------
 
     def _admit(self) -> list[StepOutput]:
-        """Admit waiting requests FCFS while slots and pages allow.
+        """Admit waiting requests in urgency order (priority class, then
+        FCFS) while slots and pages allow.
 
         Pages are allocated lazily (prompt + first token only); generation
-        growth is handled at decode time, where the youngest sequence is
-        preempted when the cache fills.  Admission never preempts — a newer
-        request must not evict older running work.
+        growth is handled at decode time, where the least urgent sequence
+        is preempted when the cache fills.  Admission preempts ONLY for a
+        strictly more urgent arrival — within a priority class a newer
+        request never evicts older running work.
 
         Fresh prompts that land in the SAME padding bucket prefill as one
         batched forward (power-of-two group sizes bound the compile count
@@ -567,14 +616,29 @@ class NativeEngine:
         """
         outputs: list[StepOutput] = []
         pending: list[tuple[Request, list[int], bool]] = []
-        while self.waiting and self._avail_slots() > len(pending):
-            request = self.waiting[0]
+        while self._avail_slots() > len(pending):
+            # pop atomically (HTTP threads push concurrently; a peeked
+            # heap root can move under us), push back on back-pressure
+            with self._lock:
+                if not self.waiting:
+                    break
+                request = self.waiting.pop()
             prefix = request.resume_tokens or request.prompt_tokens
+            blocked = False
             # reuse-aware: a mostly-cached prompt needs few fresh pages
-            if not self.alloc.can_admit(prefix, 1,
-                                        namespace=self._lora_ns(request)):
-                break  # wait for running work to finish or be preempted
-            self.waiting.popleft()
+            while not self.alloc.can_admit(prefix, 1,
+                                           namespace=self._lora_ns(request)):
+                # a higher-priority arrival may evict strictly less
+                # urgent running/prefilling work to get in NOW; equal or
+                # lower priority waits for capacity (classic FCFS)
+                if not self._preempt_youngest(
+                        exclude_slot=-1, than_key=_urgency(request)):
+                    with self._lock:
+                        self.waiting.push(request)
+                    blocked = True
+                    break
+            if blocked:
+                break
             resumed = request.resume_tokens is not None
             request.resume_tokens = None
             pending.append((request, prefix, resumed))
@@ -659,13 +723,15 @@ class NativeEngine:
         return outputs
 
     def _requeue_front(self, items: list[tuple[Request, list[int], bool]]) -> None:
-        """Return un-admitted burst members to the queue head (FCFS),
-        restoring resume state for preempted requests."""
+        """Return un-admitted burst members to the wait queue, restoring
+        resume state for preempted requests.  The heap orders them by
+        (priority, original arrival), so they come back to the head of
+        their class without any position bookkeeping."""
         with self._lock:
-            for request, prefix, resumed in reversed(items):
+            for request, prefix, resumed in items:
                 if resumed:
                     request.resume_tokens = list(prefix)
-                self.waiting.appendleft(request)
+                self.waiting.push(request)
 
     def _lora_ns(self, request: Request) -> bytes:
         return f"lora:{request.lora}".encode() if request.lora else b""
@@ -690,26 +756,40 @@ class NativeEngine:
             finish_reason=f"error:{e}",
         )
 
-    def _preempt_youngest(self, exclude_slot: int) -> bool:
-        """Release the youngest sequence (≠ exclude) back to waiting.
+    def _preempt_youngest(self, exclude_slot: int,
+                          than_key: Optional[tuple] = None) -> bool:
+        """Release the least urgent sequence (≠ exclude) back to waiting.
 
         Candidates are the running batch AND mid-chunked-prefill
         sequences — a prefilling request holds its full page allocation
         for many steps, and leaving it invisible here would let a newer
         arrival starve older running work into ``error:kv_capacity``
-        (the exact inversion of the no-new-evicts-old invariant)."""
+        (the exact inversion of the no-new-evicts-old invariant).
+        Victim order is least-urgent-first: highest ``priority`` value,
+        then youngest arrival — priorities trump age across classes
+        while the classic youngest-first rule holds within one.  With
+        ``than_key`` (the displacing work's own urgency), only a victim
+        STRICTLY less urgent is taken — never a priority inversion."""
         run_cands = [s for s in self.running if s != exclude_slot]
         slot = (max(run_cands,
-                    key=lambda s: self.running[s].request.arrival_time)
+                    key=lambda s: _urgency(self.running[s].request))
                 if run_cands else None)
         pf_idx = (max(range(len(self.prefilling)),
-                      key=lambda i: self.prefilling[i].request.arrival_time)
+                      key=lambda i: _urgency(self.prefilling[i].request))
                   if self.prefilling else None)
         pick_prefilling = pf_idx is not None and (
             slot is None
-            or self.prefilling[pf_idx].request.arrival_time
-            >= self.running[slot].request.arrival_time
+            or _urgency(self.prefilling[pf_idx].request)
+            >= _urgency(self.running[slot].request)
         )
+        victim_key = (
+            _urgency(self.prefilling[pf_idx].request) if pick_prefilling
+            else _urgency(self.running[slot].request) if slot is not None
+            else None
+        )
+        if victim_key is None or (than_key is not None
+                                  and victim_key <= than_key):
+            return False
         if pick_prefilling:
             st = self.prefilling.pop(pf_idx)
             self.alloc.release(st.request.request_id)
@@ -718,22 +798,26 @@ class NativeEngine:
             # re-prefills from scratch (resume state preserved verbatim)
             if st.resumed:
                 st.request.resume_tokens = list(st.prefix)
-            self.waiting.appendleft(st.request)
+            with self._lock:
+                self.waiting.push(st.request)
             logger.info("preempted %s mid-prefill for KV capacity",
                         st.request.request_id)
             return True
-        if slot is None:
-            return False
+        self._preempt_running_slot(slot)
+        return True
+
+    def _preempt_running_slot(self, slot: int) -> None:
+        """Evict one running sequence: pages released, request re-queued
+        with resume state — the client's stream continues seamlessly
+        after re-prefilling the full prefix (prompt + generated)."""
         state = self.running.pop(slot)
         self.alloc.release(state.request.request_id)
         self._free_slots.append(slot)
         self.preemptions_total += 1
-        # resume later by re-prefilling the full prefix (prompt + generated):
-        # the client's stream continues seamlessly from the same tokens
         state.request.resume_tokens = list(state.tokens)
-        self.waiting.appendleft(state.request)
+        with self._lock:
+            self.waiting.push(state.request)
         logger.info("preempted %s for KV capacity", state.request.request_id)
-        return True
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -1195,11 +1279,14 @@ class NativeEngine:
 
     def _ensure_decode_capacity(self) -> list[StepOutput]:
         """Grow page tables for sequences crossing a page boundary this
-        step; on exhaustion, preempt youngest-first until the oldest
-        sequences can proceed."""
+        step; on exhaustion, preempt least-urgent-first until the most
+        urgent sequences can proceed."""
         failures: list[StepOutput] = []
-        # oldest first so the work closest to completion survives pressure
-        for slot in sorted(self.running, key=lambda s: self.running[s].request.arrival_time):
+        # most urgent first, so pages flow to high-priority (then oldest)
+        # work and a background sequence can never preempt an urgent one
+        # just by asking first
+        for slot in sorted(self.running,
+                           key=lambda s: _urgency(self.running[s].request)):
             st = self.running.get(slot)
             if st is None or st.n_generated >= st.request.params.max_tokens:
                 continue
@@ -1209,20 +1296,31 @@ class NativeEngine:
                     self.alloc.extend(st.request.request_id, len(st.tokens) - 1, 1)
                     break
                 except MemoryError:
-                    if not self._preempt_youngest(exclude_slot=slot):
-                        # nothing to steal: only this sequence runs and the
-                        # cache is truly full — fail it rather than livelock
-                        logger.error("request %s exceeds total KV capacity", st.request.request_id)
-                        self._finish(st, outcome="error")
-                        failures.append(
-                            StepOutput(
-                                request_id=st.request.request_id,
-                                token=st.tokens[-1],
-                                finished=True,
-                                finish_reason="error:kv_capacity",
-                            )
-                        )
+                    # only a strictly less urgent victim may be evicted —
+                    # never a priority inversion
+                    if self._preempt_youngest(
+                            exclude_slot=slot,
+                            than_key=_urgency(st.request)):
+                        continue
+                    if len(self.running) > 1 or self.prefilling:
+                        # more urgent work holds the pages: step aside and
+                        # resume when capacity frees (admission's
+                        # can_admit gate prevents requeue thrash)
+                        self._preempt_running_slot(slot)
                         break
+                    # alone and the cache is truly full — fail, don't
+                    # livelock on a prompt that can never fit
+                    logger.error("request %s exceeds total KV capacity", st.request.request_id)
+                    self._finish(st, outcome="error")
+                    failures.append(
+                        StepOutput(
+                            request_id=st.request.request_id,
+                            token=st.tokens[-1],
+                            finished=True,
+                            finish_reason="error:kv_capacity",
+                        )
+                    )
+                    break
         return failures
 
     # -- bookkeeping ---------------------------------------------------------

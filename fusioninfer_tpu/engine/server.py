@@ -204,7 +204,7 @@ class EngineServer:
         raise ValueError(f"unknown model {name!r}; see /v1/models")
 
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
-               lora: str = "") -> _RequestChannel:
+               lora: str = "", priority: int = 0) -> _RequestChannel:
         request_id = uuid.uuid4().hex[:16]
         chan = _RequestChannel()
         with self._lock:
@@ -214,7 +214,8 @@ class EngineServer:
                 "last_token_time": time.monotonic(),
             }
         try:
-            request = Request(request_id, prompt_tokens, params, lora=lora)
+            request = Request(request_id, prompt_tokens, params, lora=lora,
+                              priority=priority)
             if lora and self.prefill_upstream:
                 # reject BEFORE the remote prefill RPC: the engine would
                 # refuse the adapter at admission anyway, and by then a
@@ -409,14 +410,16 @@ class EngineServer:
         n = self._n_of(body)
         prompt_tokens = self.tokenizer.encode(prompt)
         lora = self._lora_of(body)  # ValueError on rejection
+        priority = self._priority_of(body)
         served = lora or self.model_name
         if n == 1:
-            chan = self.submit(prompt_tokens, params, lora=lora)
+            chan = self.submit(prompt_tokens, params, lora=lora,
+                               priority=priority)
             return chan, self._stream_chunks(chan, chat, params.stop_strings,
                                              served_model=served)
         completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         created = int(time.time())  # one timestamp: chunks sharing an id
-        chans = self._submit_n(prompt_tokens, params, lora, n)
+        chans = self._submit_n(prompt_tokens, params, lora, n, priority)
         gens = [
             self._stream_chunks(c, chat, params.stop_strings,
                                 served_model=served, choice_index=i,
@@ -425,7 +428,8 @@ class EngineServer:
         ]
         return _MultiChannel(chans), self._merge_streams(gens)
 
-    def _submit_n(self, prompt_tokens, params, lora: str, n: int):
+    def _submit_n(self, prompt_tokens, params, lora: str, n: int,
+                  priority: int = 0):
         """Submit n per-choice requests; on any failure, abort the ones
         already submitted (they would otherwise decode to max_tokens with
         no consumer and leak their channel registrations)."""
@@ -433,7 +437,8 @@ class EngineServer:
         try:
             for i in range(n):
                 chans.append(self.submit(
-                    prompt_tokens, self._choice_params(params, i), lora=lora))
+                    prompt_tokens, self._choice_params(params, i), lora=lora,
+                    priority=priority))
         except Exception:
             for c in chans:
                 self.abort(c)
@@ -523,6 +528,11 @@ class EngineServer:
             self._release(chan)
         yield None  # sentinel: emit data: [DONE]
 
+    def _priority_of(self, body: dict) -> int:
+        """vLLM's ``priority`` extension: lower value = earlier scheduling
+        and last to be preempted; default 0."""
+        return int(body.get("priority", 0) or 0)
+
     def _n_of(self, body: dict) -> int:
         """OpenAI ``n``: parallel samples per request.  ``best_of`` is
         accepted only when equal to ``n`` (its legacy default)."""
@@ -556,7 +566,8 @@ class EngineServer:
         # submit all n first: they decode concurrently as one batch, and
         # the engine's same-prompt dedup turns samples 2..n into
         # prefix-cache hits against sample 1's pages
-        chans = self._submit_n(prompt_tokens, params, lora, n)
+        chans = self._submit_n(prompt_tokens, params, lora, n,
+                               self._priority_of(body))
         choices = []
         total_completion = 0
         for i, chan in enumerate(chans):

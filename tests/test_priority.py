@@ -1,0 +1,230 @@
+"""Priority scheduling: lower ``priority`` value = earlier admission and
+last to be preempted (vLLM's ``priority`` extension).
+
+The engine pairs with the router's queue-size strategy: the EPP steers
+load by queue depth, and priorities order work WITHIN an engine's queue.
+Default 0 everywhere preserves strict FCFS — the whole existing test
+suite runs through the same heap.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.models.config import get_preset
+
+CFG = get_preset("qwen3-tiny")
+
+
+def _req(rid, n_prompt=4, priority=0, max_tokens=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return Request(
+        request_id=rid,
+        prompt_tokens=rng.integers(1, CFG.vocab_size, n_prompt).tolist(),
+        params=SamplingParams(max_tokens=max_tokens, temperature=0.0),
+        priority=priority,
+    )
+
+
+class TestAdmissionOrder:
+    def test_high_priority_jumps_queue(self):
+        """One slot: of three queued requests, the lowest priority VALUE
+        admits first regardless of arrival order."""
+        engine = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                       max_pages_per_seq=4),
+            max_batch_size=1)
+        engine.add_request(_req("low", priority=5, seed=1))
+        engine.add_request(_req("mid", priority=1, seed=2))
+        engine.add_request(_req("urgent", priority=-1, seed=3))
+        firsts = []
+        for _ in range(40):
+            if not engine.has_work():
+                break
+            for o in engine.step():
+                if o.is_first_token:
+                    firsts.append(o.request_id)
+        assert firsts == ["urgent", "mid", "low"]
+
+    def test_fcfs_within_class(self):
+        engine = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                       max_pages_per_seq=4),
+            max_batch_size=1)
+        for i in range(3):
+            engine.add_request(_req(f"r{i}", priority=2, seed=i))
+        firsts = []
+        for _ in range(40):
+            if not engine.has_work():
+                break
+            firsts += [o.request_id for o in engine.step() if o.is_first_token]
+        assert firsts == ["r0", "r1", "r2"]
+
+
+class TestPreemptionOrder:
+    def test_low_priority_victim_even_if_older(self):
+        """KV pressure evicts the lowest-priority sequence, not the
+        youngest: an older background request yields to a newer urgent
+        one and still completes afterwards."""
+        cache = CacheConfig(n_pages=9, page_size=16, max_pages_per_seq=8)
+        engine = NativeEngine(CFG, cache_cfg=cache, max_batch_size=2,
+                              enable_prefix_caching=False)
+        # background: 15-token prompt (1 page) + long budget → will cross
+        # a page boundary on its first decode step
+        bg = _req("bg", n_prompt=15, priority=10, max_tokens=20, seed=1)
+        engine.add_request(bg)
+        engine.step()  # bg running
+        # urgent: grabs all remaining pages (7 of 8)
+        urgent = Request(
+            request_id="urgent",
+            prompt_tokens=np.random.default_rng(2).integers(
+                1, CFG.vocab_size, 111).tolist(),
+            params=SamplingParams(max_tokens=2, temperature=0.0),
+            priority=-5,
+        )
+        engine.add_request(urgent)
+        results: dict[str, list] = {"bg": [], "urgent": []}
+        preempted_before_urgent_done = None
+        for _ in range(80):
+            if not engine.has_work():
+                break
+            for o in engine.step():
+                results[o.request_id].append(o)
+                if (o.request_id == "urgent" and o.finished
+                        and preempted_before_urgent_done is None):
+                    preempted_before_urgent_done = engine.preemptions_total
+        assert not engine.has_work()
+        # bg (older, lower urgency) was the preemption victim
+        assert engine.preemptions_total >= 1
+        assert preempted_before_urgent_done >= 1
+        assert results["urgent"] and results["urgent"][-1].finish_reason in (
+            "length", "stop")
+        # and bg still finished cleanly after resuming
+        assert results["bg"] and results["bg"][-1].finish_reason in (
+            "length", "stop")
+
+
+class TestNoInversion:
+    def test_low_priority_grower_never_evicts_urgent(self):
+        """A background sequence hitting page pressure must NOT preempt a
+        more urgent running sequence — it steps aside (self-preempts) and
+        resumes; the urgent sequence is never interrupted."""
+        cache = CacheConfig(n_pages=9, page_size=16, max_pages_per_seq=8)
+        engine = NativeEngine(CFG, cache_cfg=cache, max_batch_size=2,
+                              enable_prefix_caching=False)
+        # urgent first: 111-token prompt -> 7 pages, decodes 2 tokens
+        engine.add_request(Request(
+            request_id="urgent",
+            prompt_tokens=np.random.default_rng(5).integers(
+                1, CFG.vocab_size, 111).tolist(),
+            params=SamplingParams(max_tokens=3, temperature=0.0),
+            priority=-5,
+        ))
+        engine.step()
+        # background: 15-token prompt (1 page, pool now full), long budget
+        engine.add_request(_req("bg", n_prompt=15, priority=10,
+                                max_tokens=20, seed=6))
+        results: dict[str, list] = {"urgent": [], "bg": []}
+        urgent_interrupted = False
+        for _ in range(100):
+            if not engine.has_work():
+                break
+            n_before = len(results["urgent"])
+            for o in engine.step():
+                results[o.request_id].append(o)
+            # once urgent started decoding it must emit every step until
+            # finished (its slot is never stolen by the bg grower)
+            if (results["urgent"] and not results["urgent"][-1].finished
+                    and len(results["urgent"]) == n_before):
+                urgent_interrupted = True
+        assert not engine.has_work()
+        assert not urgent_interrupted, "urgent sequence lost a step"
+        assert results["urgent"][-1].finish_reason in ("length", "stop")
+        # bg was never killed with kv_capacity — it finished after urgent
+        assert results["bg"] and results["bg"][-1].finish_reason in (
+            "length", "stop")
+
+
+class TestAdmissionPreemption:
+    def test_urgent_arrival_evicts_background(self):
+        """With the pool fully held by a background sequence, a strictly
+        more urgent arrival preempts it AT ADMISSION instead of waiting."""
+        cache = CacheConfig(n_pages=9, page_size=16, max_pages_per_seq=8)
+        engine = NativeEngine(CFG, cache_cfg=cache, max_batch_size=2,
+                              enable_prefix_caching=False)
+        engine.add_request(Request(
+            request_id="bg",
+            prompt_tokens=np.random.default_rng(8).integers(
+                1, CFG.vocab_size, 120).tolist(),  # 8 pages: whole pool
+            params=SamplingParams(max_tokens=8, temperature=0.0),
+            priority=10,
+        ))
+        engine.step()  # bg running, pool exhausted
+        engine.add_request(Request(
+            request_id="urgent", prompt_tokens=[1, 2, 3],
+            params=SamplingParams(max_tokens=2, temperature=0.0),
+            priority=-1,
+        ))
+        outs = engine.step()
+        # bg was evicted and urgent prefilled THIS step
+        assert any(o.request_id == "urgent" and o.is_first_token
+                   for o in outs)
+        assert engine.preemptions_total >= 1
+        # drain: both finish
+        fins = {o.request_id: o.finish_reason for o in outs if o.finished}
+        for _ in range(80):
+            if not engine.has_work():
+                break
+            for o in engine.step():
+                if o.finished:
+                    fins[o.request_id] = o.finish_reason
+        assert fins.get("urgent") in ("length", "stop")
+        assert fins.get("bg") in ("length", "stop")
+
+    def test_same_class_arrival_waits(self):
+        """Default-priority arrivals never evict running work (classic
+        FCFS back-pressure preserved)."""
+        cache = CacheConfig(n_pages=9, page_size=16, max_pages_per_seq=8)
+        engine = NativeEngine(CFG, cache_cfg=cache, max_batch_size=2,
+                              enable_prefix_caching=False)
+        engine.add_request(Request(
+            request_id="first",
+            prompt_tokens=np.random.default_rng(9).integers(
+                1, CFG.vocab_size, 120).tolist(),
+            params=SamplingParams(max_tokens=4, temperature=0.0),
+        ))
+        engine.step()
+        engine.add_request(Request(
+            request_id="second", prompt_tokens=[4, 5],
+            params=SamplingParams(max_tokens=2, temperature=0.0),
+        ))
+        outs = engine.step()
+        assert not any(o.request_id == "second" for o in outs)
+        assert engine.preemptions_total == 0
+
+
+class TestServerPriority:
+    def test_priority_field_accepted(self):
+        from fusioninfer_tpu.engine.server import EngineServer
+
+        eng = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                       max_pages_per_seq=4),
+            max_batch_size=2)
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=eng)
+        srv.start()
+        try:
+            body = json.dumps({"model": "qwen3-tiny", "prompt": "hi",
+                               "max_tokens": 2, "priority": -3}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            r = json.loads(urllib.request.urlopen(req, timeout=120).read())
+            assert r["choices"][0]["finish_reason"] in ("length", "stop")
+        finally:
+            srv.stop()
