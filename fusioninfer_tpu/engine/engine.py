@@ -504,11 +504,15 @@ class NativeEngine:
         outputs = []
         while self.waiting_prefilled and self._avail_slots() > 0:
             with self._lock:
-                request, slab = self.waiting_prefilled[0]
+                # urgency order within the prefilled queue too (FCFS via
+                # the arrival component when priorities tie)
+                idx = min(range(len(self.waiting_prefilled)),
+                          key=lambda i: _urgency(self.waiting_prefilled[i][0]))
+                request, slab = self.waiting_prefilled[idx]
                 prefix = slab.prompt_tokens
                 if not self.alloc.can_allocate(len(prefix) + 1):
                     break
-                self.waiting_prefilled.popleft()
+                del self.waiting_prefilled[idx]
             try:
                 self.alloc.allocate(request.request_id, len(prefix) + 1)
                 self.cache = inject_slab(
@@ -616,7 +620,17 @@ class NativeEngine:
         """
         outputs: list[StepOutput] = []
         pending: list[tuple[Request, list[int], bool]] = []
-        while self._avail_slots() > len(pending):
+        while True:
+            if self._avail_slots() <= len(pending):
+                # slot pressure: a strictly more urgent waiter may evict
+                # less urgent running/prefilling work to free a slot
+                with self._lock:
+                    head_key = (_urgency(self.waiting.peek())
+                                if self.waiting else None)
+                if head_key is None or not self._preempt_youngest(
+                        exclude_slot=-1, than_key=head_key):
+                    break
+                continue  # slot freed; re-check
             # pop atomically (HTTP threads push concurrently; a peeked
             # heap root can move under us), push back on back-pressure
             with self._lock:

@@ -185,6 +185,31 @@ class TestAdmissionPreemption:
         assert fins.get("urgent") in ("length", "stop")
         assert fins.get("bg") in ("length", "stop")
 
+    def test_urgent_arrival_evicts_for_a_slot(self):
+        """Slot pressure (not page pressure): with every batch slot held
+        by background work, a strictly more urgent arrival still gets in
+        by evicting the least urgent runner."""
+        engine = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                       max_pages_per_seq=4),
+            max_batch_size=1)
+        engine.add_request(_req("bg", priority=10, max_tokens=30, seed=1))
+        engine.step()  # bg owns the only slot; pages are plentiful
+        engine.add_request(_req("urgent", priority=-1, max_tokens=2, seed=2))
+        outs = engine.step()
+        assert any(o.request_id == "urgent" and o.is_first_token
+                   for o in outs), "urgent request did not take the slot"
+        assert engine.preemptions_total == 1
+        fins = {o.request_id: o.finish_reason for o in outs if o.finished}
+        for _ in range(80):
+            if not engine.has_work():
+                break
+            for o in engine.step():
+                if o.finished:
+                    fins[o.request_id] = o.finish_reason
+        assert fins.get("urgent") in ("length", "stop")
+        assert fins.get("bg") in ("length", "stop")  # resumed afterwards
+
     def test_same_class_arrival_waits(self):
         """Default-priority arrivals never evict running work (classic
         FCFS back-pressure preserved)."""
