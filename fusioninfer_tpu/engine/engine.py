@@ -306,6 +306,8 @@ class NativeEngine:
         self._token_counts = jnp.zeros((max_batch_size, V), jnp.int32)
         self._output_counts = jnp.zeros((max_batch_size, V), jnp.int32)
         self._suppress = jnp.zeros((max_batch_size, V), jnp.bool_)
+        # slot -> (ids, vals) device arrays for requests with logit_bias
+        self._slot_bias: dict[int, tuple[jax.Array, jax.Array]] = {}
 
         self.waiting = _WaitQueue()
         # PD decode side: requests whose KV arrived from a prefill worker
@@ -897,6 +899,10 @@ class NativeEngine:
         gen_index = len(prefix) - n_prompt
         if gen_index < p.min_tokens and p.stop_token_ids:
             logits = jnp.where(self._stop_suppress_row(p)[None], -jnp.inf, logits)
+        if p.logit_bias:
+            ids = jnp.asarray([t for t, _ in p.logit_bias], jnp.int32)
+            vals = jnp.asarray([b for _, b in p.logit_bias], jnp.float32)
+            logits = logits.at[0, ids].add(vals)
         if machine is not None:
             logits = jnp.where(
                 self._allowed_token_mask(machine.allowed_bytes())[None],
@@ -918,12 +924,21 @@ class NativeEngine:
                        params: SamplingParams) -> None:
         """Reset the slot's device sampling state: combined counts (incl.
         the first generated token) for repetition, output-only counts for
-        presence/frequency, stop-suppress mask for min_tokens."""
+        presence/frequency, stop-suppress mask for min_tokens, and the
+        request's logit-bias arrays (built ONCE here — the decode loop
+        reuses them every step instead of re-uploading the same tuples)."""
         self._token_counts = self._token_counts.at[slot].set(self._prompt_counts(tokens))
         self._output_counts = self._output_counts.at[slot].set(
             self._prompt_counts(tokens[n_prompt:])
         )
         self._suppress = self._suppress.at[slot].set(self._stop_suppress_row(params))
+        if params.logit_bias:
+            self._slot_bias[slot] = (
+                jnp.asarray([t for t, _ in params.logit_bias], jnp.int32),
+                jnp.asarray([b for _, b in params.logit_bias], jnp.float32),
+            )
+        else:
+            self._slot_bias.pop(slot, None)
 
     def _suffix_forward(self, request: Request, prefix: list[int],
                         start: int, length: int) -> jax.Array:
@@ -1103,6 +1118,7 @@ class NativeEngine:
                 and p.repetition_penalty == 1.0
                 and p.logprobs is None
                 and not p.guided_json  # drafts would bypass the grammar mask
+                and not p.logit_bias  # verify argmax ignores the bias
                 and st.n_generated >= p.min_tokens)
 
     def _decode(self) -> list[StepOutput]:
@@ -1235,6 +1251,11 @@ class NativeEngine:
             tok_ok = self._allowed_token_mask(allowed)  # [B, V]
             logits = jnp.where(jnp.asarray(grow)[:, None] & ~tok_ok,
                                -jnp.inf, logits)
+        # per-request logit_bias rows (arrays cached at slot registration)
+        for slot in live:
+            bias = self._slot_bias.get(slot)
+            if bias is not None:
+                logits = logits.at[slot, bias[0]].add(bias[1])
         keys = make_row_keys(jnp.asarray(seeds), jnp.asarray(gen_counts))
         sampled_dev = sample(logits, keys, jnp.asarray(temps),
                              jnp.asarray(top_ks), jnp.asarray(top_ps))

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.ops import masks
 from fusioninfer_tpu.models.config import ModelConfig
 from fusioninfer_tpu.models.quantization import embed_lookup, kv_quantize
 from fusioninfer_tpu.models.transformer import (
@@ -213,7 +214,8 @@ def prefill_suffix(
 
     # context mask over the gathered [mp * ps] positions (portable branch)
     ctx_idx = jnp.arange(mp * ps)[None, :]  # [1, T]
-    attend = ctx_idx <= positions[0][:, None]  # [C, T]
+    attend = masks.attend(positions[0][:, None], ctx_idx,
+                          cfg.sliding_window)  # [C, T]
 
     def body(x, inputs):
         layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
@@ -235,12 +237,14 @@ def prefill_suffix(
                 attn = paged_prefill_attention_tp(
                     mesh, q[0], k_cache_l, v_cache_l, page_row, start, true_len,
                     interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window,
                 )[None]  # [1, C, H*Hd]
             else:
                 attn = paged_prefill_attention(
                     q[0], k_cache_l, v_cache_l, page_row, start, true_len,
                     ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window,
                 )[None]
         else:
             k_ctx = k_cache_l[:, page_row].reshape(KV, mp * ps, Hd)
@@ -310,7 +314,8 @@ def decode_step(
 
     # attention mask over the gathered [mp * ps] context (reference path)
     ctx_idx = jnp.arange(mp * ps)[None, :]  # [1, T]
-    attend = ctx_idx <= positions[:, None]  # [B, T] (new token included)
+    attend = masks.attend(positions[:, None], ctx_idx,
+                          cfg.sliding_window)  # [B, T] (new token included)
     attend = attend[:, None, None, :]  # [B, 1, 1, T]
 
     def body(x, inputs):
@@ -336,12 +341,14 @@ def decode_step(
                 attn = paged_decode_attention_tp(
                     mesh, q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
                     interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window,
                 )[:, None, :]
             else:
                 attn = paged_decode_attention(
                     q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
                     ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window,
                 )[:, None, :]  # [B, 1, H*Hd]
         else:
             # portable path: gather pages [KV, B, mp, ps, Hd] -> [KV, B, T, Hd]
@@ -430,7 +437,8 @@ def verify_step(
 
     # portable-path mask over the gathered [mp * ps] context
     ctx_idx = jnp.arange(mp * ps)[None, None, :]  # [1, 1, T]
-    attend = ctx_idx <= positions[:, :, None]  # [B, C, T]
+    attend = masks.attend(positions[:, :, None], ctx_idx,
+                          cfg.sliding_window)  # [B, C, T]
 
     def body(x, inputs):
         layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
@@ -452,12 +460,14 @@ def verify_step(
                 attn = paged_verify_attention_tp(
                     mesh, q, k_cache_l, v_cache_l, page_tables, starts, counts,
                     interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window,
                 )  # [B, C, H*Hd]
             else:
                 attn = paged_verify_attention(
                     q, k_cache_l, v_cache_l, page_tables, starts, counts,
                     ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window,
                 )
         else:
             k_ctx = k_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)

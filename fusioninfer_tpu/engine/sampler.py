@@ -38,6 +38,9 @@ class SamplingParams:
     # OpenAI `response_format: json_object`: constrain output to valid
     # JSON via byte-level grammar masking (engine/guided.py)
     guided_json: bool = False
+    # OpenAI `logit_bias`: additive per-token-id logit adjustments,
+    # applied before sampling every step (±100 effectively bans/forces)
+    logit_bias: tuple[tuple[int, float], ...] = ()
 
     @property
     def greedy(self) -> bool:

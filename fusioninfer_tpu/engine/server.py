@@ -355,6 +355,21 @@ class EngineServer:
         logprobs = body.get("logprobs")
         if logprobs is not None:
             logprobs = max(0, min(int(logprobs), 5))  # OpenAI caps at 5
+        lb = body.get("logit_bias") or {}
+        if not isinstance(lb, dict):
+            raise ValueError("logit_bias must be an object of token-id: bias")
+        vocab = self.engine.cfg.vocab_size
+        logit_bias = tuple(
+            (int(t), max(-100.0, min(100.0, float(b))))  # OpenAI clamps ±100
+            for t, b in lb.items()
+        )
+        for t, _ in logit_bias:
+            if not 0 <= t < vocab:
+                # JAX would wrap negatives / drop overflows silently —
+                # a biased WRONG token must be a 400, not a 200
+                raise ValueError(
+                    f"logit_bias token id {t} outside vocab [0, {vocab})"
+                )
         rf = body.get("response_format")
         guided_json = False
         if rf is not None:
@@ -380,6 +395,7 @@ class EngineServer:
             seed=int(seed) if seed is not None else None,
             logprobs=logprobs,
             guided_json=guided_json,
+            logit_bias=logit_bias,
         )
 
     def _cancel_chan(self, chan: "_RequestChannel") -> None:

@@ -42,6 +42,12 @@ class ModelConfig:
     n_experts: int = 0
     n_experts_active: int = 2
     moe_d_ff: int = 0  # per-expert FFN width; defaults to d_ff when 0
+    # Sliding-window attention (Mistral-style): each token attends to the
+    # previous `sliding_window` positions (itself included).  None = full
+    # causal attention.  Applied in every execution path — full forward,
+    # paged prefill/suffix, decode, verify — as a static mask bound, so
+    # kernels skip out-of-window pages instead of reading them.
+    sliding_window: int | None = None
 
     @property
     def jax_dtype(self):
@@ -59,6 +65,7 @@ class ModelConfig:
         assert self.n_heads % self.n_kv_heads == 0, "GQA requires n_heads % n_kv_heads == 0"
         assert self.d_model % self.n_heads == 0 or self.head_dim, "need explicit head_dim"
         assert self.quantization in ("none", "int8"), f"unknown quantization {self.quantization!r}"
+        assert self.sliding_window is None or self.sliding_window >= 1
         if self.is_moe:
             assert self.n_experts_active <= self.n_experts
         return self
@@ -87,6 +94,15 @@ def list_presets() -> list[str]:
 
 # Tiny configs: CI / CPU-mesh tests and the driver's compile checks.
 register_preset(ModelConfig(name="qwen3-tiny"))
+register_preset(
+    ModelConfig(
+        name="mistral-tiny",
+        qk_norm=False,
+        tie_embeddings=False,
+        rope_theta=10_000.0,
+        sliding_window=24,  # small enough that tests exercise the window
+    )
+)
 register_preset(
     ModelConfig(
         name="moe-tiny",
@@ -150,6 +166,27 @@ register_preset(
         n_experts=128,
         n_experts_active=8,
         moe_d_ff=768,
+    )
+)
+
+# Mistral-7B-shaped: the sliding-window-attention family — each token
+# attends only to the trailing 4096 positions, bounding attention cost
+# and (eventually) KV residency for long contexts.
+register_preset(
+    ModelConfig(
+        name="mistral-7b",
+        vocab_size=32_768,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=14_336,
+        rope_theta=1_000_000.0,
+        qk_norm=False,
+        tie_embeddings=False,
+        max_seq_len=32_768,
+        sliding_window=4096,
     )
 )
 

@@ -308,13 +308,16 @@ def layer_forward(
                 attn = flash_attention_tp(
                     mesh, q, k, v, causal=True,
                     interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window,
                 )
             else:
                 attn = flash_attention(
-                    q, k, v, causal=True, interpret=dispatch.kernel_interpret()
+                    q, k, v, causal=True, interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window,
                 )
         else:
-            attn = _attention(q, k, v, causal_mask(S))
+            attn = _attention(q, k, v,
+                              causal_mask(S, window=cfg.sliding_window))
     else:
         if mask is None:
             raise ValueError("layer_forward with kv history requires a mask")
@@ -329,8 +332,13 @@ def layer_forward(
     return x + mlp_block(cfg, layer, x), (k, v)
 
 
-def causal_mask(S: int, dtype=jnp.bool_) -> jax.Array:
-    return jnp.tril(jnp.ones((S, S), dtype))[None, None, :, :]
+def causal_mask(S: int, dtype=jnp.bool_, window: int | None = None) -> jax.Array:
+    """Causal [1, 1, S, S] mask; ``window`` bands it Mistral-style (each
+    query sees the previous ``window`` positions, itself included)."""
+    from fusioninfer_tpu.ops.masks import attend
+
+    m = attend(jnp.arange(S)[:, None], jnp.arange(S)[None, :], window)
+    return m.astype(dtype)[None, None, :, :]
 
 
 def lm_head(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:

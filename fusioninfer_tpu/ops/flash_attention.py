@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fusioninfer_tpu.ops.masks import attend
+
 NEG_INF = -1e30  # mask value; softmax stats are fp32
 _STATS_LANES = 128  # lane width for the m/l scratch tiles
 
@@ -37,6 +39,7 @@ _STATS_LANES = 128  # lane width for the m/l scratch tiles
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     *, causal: bool, sm_scale: float, block_q: int, block_k: int, n_k: int,
+    window: int | None,
 ):
     i = pl.program_id(2)  # q tile
     j = pl.program_id(3)  # k tile
@@ -47,8 +50,14 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Tiles strictly above the causal diagonal contribute nothing.
+    # Tiles strictly above the causal diagonal contribute nothing; with a
+    # sliding window, neither do tiles entirely below the band — the
+    # tile's latest key must still be visible to its EARLIEST query
+    # (k_max > q_min - window).
     needed = True if not causal else j * block_k <= i * block_q + block_q - 1
+    if window is not None:
+        in_band = j * block_k + block_k - 1 > i * block_q - window
+        needed = jnp.logical_and(needed, in_band) if causal else in_band
 
     @pl.when(needed)
     def _tile():
@@ -58,14 +67,15 @@ def _flash_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_k]
-        if causal:
+        if causal or window is not None:
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(attend(q_pos, k_pos, window, causal=causal),
+                          s, NEG_INF)
 
         m_prev = m_ref[:, :1]  # [block_q, 1]
         l_prev = l_ref[:, :1]
@@ -93,7 +103,8 @@ def _flash_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret",
+                     "window"),
 )
 def flash_attention(
     q: jax.Array,  # [B, S, H, Hd]
@@ -105,12 +116,16 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Blockwise exact attention → [B, S, H·Hd] (model layer layout).
 
     ``S`` must divide by the (possibly clamped) block sizes — the engine's
     power-of-two prefill buckets guarantee that.  ``interpret=True`` runs
-    the same kernel in the Pallas interpreter (CPU tests).
+    the same kernel in the Pallas interpreter (CPU tests).  ``window``:
+    Mistral-style sliding window — each query attends to the previous
+    ``window`` positions (itself included); out-of-band tiles are
+    skipped entirely.
     """
     B, S, H, Hd = q.shape
     KV = k.shape[2]
@@ -130,7 +145,7 @@ def flash_attention(
     kernel = functools.partial(
         _flash_kernel,
         causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k, n_k=n_k,
+        block_q=block_q, block_k=block_k, n_k=n_k, window=window,
     )
     out = pl.pallas_call(
         kernel,
@@ -164,7 +179,8 @@ def flash_attention(
     return jnp.swapaxes(out, 1, 2).reshape(B, S, H * Hd)
 
 
-def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
+def reference_attention(q, k, v, causal: bool = True,
+                        window: int | None = None) -> jax.Array:
     """jnp oracle with identical GQA semantics, for tests and CPU fallback."""
     B, S, H, Hd = q.shape
     KV = k.shape[2]
@@ -172,8 +188,10 @@ def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
     qg = q.reshape(B, S, KV, G, Hd)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(Hd)
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), bool))
+    if causal or window is not None:
+        qi = jnp.arange(S)[:, None]
+        ki = jnp.arange(S)[None, :]
+        mask = attend(qi, ki, window, causal=causal)
         scores = jnp.where(mask[None, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))

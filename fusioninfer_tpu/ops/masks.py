@@ -1,0 +1,29 @@
+"""The one definition of attention visibility.
+
+``attend(q_pos, k_pos, window)``: key ``k_pos`` is visible to query
+``q_pos`` iff it is causal (``k <= q``) and, under a sliding window,
+within the trailing band (``q - k < window`` — the query sees the
+previous ``window`` positions, itself included; Mistral semantics).
+
+Every mask site — the four Pallas kernel bodies, the portable gather
+paths, ``causal_mask``, and the jnp oracles — routes through this
+function so the (off-by-one-sensitive) band semantics can never diverge
+between a kernel and the oracle it is tested against.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def attend(q_pos: jax.Array, k_pos: jax.Array,
+           window: int | None = None, causal: bool = True) -> jax.Array:
+    """Bool visibility mask, broadcast over ``q_pos``/``k_pos``."""
+    if causal:
+        keep = q_pos >= k_pos
+        if window is not None:
+            keep = keep & (q_pos - k_pos < window)
+        return keep
+    if window is not None:
+        return q_pos - k_pos < window
+    raise ValueError("attend() with causal=False requires a window")

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fusioninfer_tpu.ops.masks import attend
+
 NEG_INF = -1e30
 
 
@@ -130,6 +132,7 @@ def _paged_kernel(
     page_size: int,
     sm_scale: float,
     quantized: bool,
+    window: int | None,
 ):
     scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
         rest, quantized)
@@ -138,6 +141,10 @@ def _paged_kernel(
     g = pl.program_id(1)
     length = lengths_ref[b]
     n_used = pl.cdiv(length, page_size)  # live pages for this sequence
+    # sliding window: the single query (position length-1) attends only
+    # to positions >= length - window, so earlier pages are never read
+    first = (jnp.maximum(length - window, 0) // page_size
+             if window is not None else 0)
 
     def dma(slot, p):
         return _page_dma(slot, g, page_tables_ref[b, p], k_pages_ref,
@@ -146,7 +153,7 @@ def _paged_kernel(
 
     @pl.when(n_used > 0)
     def _start_first():
-        for c in dma(0, 0):
+        for c in dma(first % 2, first):
             c.start()
 
     G, Hd = q_ref.shape[2], q_ref.shape[3]
@@ -172,7 +179,7 @@ def _paged_kernel(
         pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (G, page_size), 1
         )
-        s = jnp.where(pos < length, s, NEG_INF)
+        s = jnp.where(attend(length - 1, pos, window), s, NEG_INF)
 
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
@@ -185,12 +192,12 @@ def _paged_kernel(
     m0 = jnp.full((G, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((G, 1), jnp.float32)
     a0 = jnp.zeros((G, Hd), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(first, n_used, body, (m0, l0, a0))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret")
+    jax.jit, static_argnames=("sm_scale", "interpret", "window")
 )
 def paged_decode_attention(
     q: jax.Array,  # [B, H, Hd] — one query token per sequence
@@ -203,13 +210,15 @@ def paged_decode_attention(
     *,
     sm_scale: float | None = None,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Batched one-token attention over paged KV → [B, H·Hd].
 
     Inactive batch slots should pass ``lengths = 0`` (output is zeros).
     With int8 pages, pass the per-(page, token) f32 scale arrays — the
     kernel streams them alongside the pages and folds dequantization
-    into the score/probability matrices.
+    into the score/probability matrices.  ``window``: Mistral-style
+    sliding window — out-of-window pages are skipped, not just masked.
     """
     B, H, Hd = q.shape
     KV, _, page_size, _ = k_pages.shape
@@ -242,7 +251,7 @@ def paged_decode_attention(
     kernel = functools.partial(
         _paged_kernel,
         max_pages=max_pages, page_size=page_size, sm_scale=sm_scale,
-        quantized=quantized,
+        quantized=quantized, window=window,
     )
     operands = [page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg,
                 k_pages, v_pages]
@@ -271,6 +280,7 @@ def _suffix_kernel(
     page_size: int,
     sm_scale: float,
     quantized: bool,
+    window: int | None,
 ):
     scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
         rest, quantized)
@@ -284,6 +294,10 @@ def _suffix_kernel(
     n_q_real = jnp.clip(true_len - i * block_q, 0, block_q)
     max_pos = start + i * block_q + n_q_real - 1  # last real query's position
     n_used = jnp.where(n_q_real > 0, pl.cdiv(max_pos + 1, page_size), 0)
+    # sliding window: the tile's FIRST query bounds the earliest page any
+    # of its rows may read (positions >= first_pos - window + 1)
+    first = (jnp.maximum(start + i * block_q - window + 1, 0) // page_size
+             if window is not None else 0)
 
     def dma(slot, p):
         return _page_dma(slot, g, page_row_ref[p], k_pages_ref, v_pages_ref,
@@ -291,7 +305,7 @@ def _suffix_kernel(
 
     @pl.when(n_used > 0)
     def _start_first():
-        for c in dma(0, 0):
+        for c in dma(first % 2, first):
             c.start()
 
     G, Hd = q_ref.shape[2], q_ref.shape[3]
@@ -322,7 +336,7 @@ def _suffix_kernel(
         ctx_pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (R, page_size), 1
         )
-        s = jnp.where(ctx_pos <= row_pos, s, NEG_INF)
+        s = jnp.where(attend(row_pos, ctx_pos, window), s, NEG_INF)
 
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
@@ -335,13 +349,13 @@ def _suffix_kernel(
     m0 = jnp.full((R, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((R, 1), jnp.float32)
     a0 = jnp.zeros((R, Hd), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(first, n_used, body, (m0, l0, a0))
     out = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
     o_ref[:, 0] = out.reshape(block_q, G, Hd)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "block_q", "interpret")
+    jax.jit, static_argnames=("sm_scale", "block_q", "interpret", "window")
 )
 def paged_prefill_attention(
     q: jax.Array,  # [C, H, Hd] — suffix queries, padded to bucket C
@@ -356,6 +370,7 @@ def paged_prefill_attention(
     sm_scale: float | None = None,
     block_q: int = 128,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Suffix-prefill attention over paged KV → [C, H·Hd].
 
@@ -404,7 +419,7 @@ def paged_prefill_attention(
     kernel = functools.partial(
         _suffix_kernel,
         block_q=block_q, page_size=page_size, sm_scale=sm_scale,
-        quantized=quantized,
+        quantized=quantized, window=window,
     )
     operands = [page_row.astype(jnp.int32), meta, qg, k_pages, v_pages]
     if quantized:
@@ -433,6 +448,7 @@ def _verify_kernel(
     page_size: int,
     sm_scale: float,
     quantized: bool,
+    sliding: int | None,
 ):
     scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
         rest, quantized)
@@ -442,6 +458,10 @@ def _verify_kernel(
     start = starts_ref[b]
     count = counts_ref[b]
     n_used = jnp.where(count > 0, pl.cdiv(start + count, page_size), 0)
+    # sliding window: the FIRST query (position start) bounds the
+    # earliest page any window row may read
+    first = (jnp.maximum(start - sliding + 1, 0) // page_size
+             if sliding is not None else 0)
 
     def dma(slot, p):
         return _page_dma(slot, g, page_tables_ref[b, p], k_pages_ref,
@@ -450,7 +470,7 @@ def _verify_kernel(
 
     @pl.when(n_used > 0)
     def _start_first():
-        for c in dma(0, 0):
+        for c in dma(first % 2, first):
             c.start()
 
     G, Hd = q_ref.shape[2], q_ref.shape[3]
@@ -480,7 +500,7 @@ def _verify_kernel(
         ctx_pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (R, page_size), 1
         )
-        s = jnp.where(ctx_pos <= row_pos, s, NEG_INF)
+        s = jnp.where(attend(row_pos, ctx_pos, sliding), s, NEG_INF)
 
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
@@ -493,13 +513,13 @@ def _verify_kernel(
     m0 = jnp.full((R, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((R, 1), jnp.float32)
     a0 = jnp.zeros((R, Hd), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(first, n_used, body, (m0, l0, a0))
     out = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
     o_ref[:, 0] = out.reshape(window, G, Hd)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret")
+    jax.jit, static_argnames=("sm_scale", "interpret", "window")
 )
 def paged_verify_attention(
     q: jax.Array,  # [B, C, H, Hd] — C-token verify window per sequence
@@ -513,6 +533,7 @@ def paged_verify_attention(
     *,
     sm_scale: float | None = None,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Multi-query decode attention for speculative verification →
     [B, C, H·Hd].
@@ -557,7 +578,7 @@ def paged_verify_attention(
     kernel = functools.partial(
         _verify_kernel,
         window=C, page_size=page_size, sm_scale=sm_scale,
-        quantized=quantized,
+        quantized=quantized, sliding=window,
     )
     operands = [page_tables.astype(jnp.int32), starts.astype(jnp.int32),
                 counts.astype(jnp.int32), qg, k_pages, v_pages]
@@ -573,7 +594,7 @@ def paged_verify_attention(
 
 
 def reference_paged_verify_attention(q, k_pages, v_pages, page_tables,
-                                     starts, counts):
+                                     starts, counts, window=None):
     """Gathered-context jnp oracle for the verify window.  Padding rows
     (``i >= counts[b]``) and inactive slots are zeroed."""
     B, C, H, Hd = q.shape
@@ -587,7 +608,7 @@ def reference_paged_verify_attention(q, k_pages, v_pages, page_tables,
                    k_ctx.astype(jnp.float32)) / jnp.sqrt(Hd)
     pos_q = starts[:, None] + jnp.arange(C)[None, :]  # [B, C]
     ctx = jnp.arange(mp * ps)
-    mask = ctx[None, None, :] <= pos_q[:, :, None]  # [B, C, T]
+    mask = attend(pos_q[:, :, None], ctx[None, None, :], window)  # [B, C, T]
     s = jnp.where(mask[:, None, None], s, NEG_INF)
     probs = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkgct,kbtd->bckgd", probs, v_ctx.astype(jnp.float32))
@@ -597,7 +618,7 @@ def reference_paged_verify_attention(q, k_pages, v_pages, page_tables,
 
 
 def reference_paged_prefill_attention(q, k_pages, v_pages, page_row, start,
-                                      true_len):
+                                      true_len, window=None):
     """Gathered-context jnp oracle for the suffix path (same math as
     ``prefill_suffix``'s portable branch).  Padding rows are zeroed for
     deterministic comparison."""
@@ -612,14 +633,16 @@ def reference_paged_prefill_attention(q, k_pages, v_pages, page_row, start,
                    k_ctx.astype(jnp.float32)) / jnp.sqrt(Hd)
     pos_q = start + jnp.arange(C)
     ctx = jnp.arange(mp * ps)
-    s = jnp.where((ctx[None, :] <= pos_q[:, None])[None, None], s, NEG_INF)
+    mask = attend(pos_q[:, None], ctx[None, :], window)
+    s = jnp.where(mask[None, None], s, NEG_INF)
     probs = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("kgct,ktd->ckgd", probs, v_ctx.astype(jnp.float32))
     out = out * (jnp.arange(C) < true_len)[:, None, None, None]
     return out.reshape(C, H * Hd).astype(q.dtype)
 
 
-def reference_paged_attention(q, k_pages, v_pages, page_tables, lengths):
+def reference_paged_attention(q, k_pages, v_pages, page_tables, lengths,
+                              window=None):
     """Gather-based jnp oracle (same math as the engine's portable path)."""
     B, H, Hd = q.shape
     KV, _, ps, _ = k_pages.shape
@@ -632,7 +655,8 @@ def reference_paged_attention(q, k_pages, v_pages, page_tables, lengths):
     s = jnp.einsum("bkgd,kbtd->bkgt", qg.astype(jnp.float32),
                    k_ctx.astype(jnp.float32)) / jnp.sqrt(Hd)
     pos = jnp.arange(mp * ps)[None, :]
-    s = jnp.where((pos < lengths[:, None])[:, None, None, :], s, NEG_INF)
+    mask = attend((lengths - 1)[:, None], pos, window) & (lengths > 0)[:, None]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
     # inactive slots (length 0) are fully masked: zero their output
     probs = jax.nn.softmax(s, axis=-1) * (lengths > 0)[:, None, None, None]
     out = jnp.einsum("bkgt,kbtd->bkgd", probs, v_ctx.astype(jnp.float32))

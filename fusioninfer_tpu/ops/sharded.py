@@ -51,11 +51,13 @@ def flash_attention_tp(
     *,
     causal: bool = True,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Per-shard flash attention → [B, S, H·Hd] sharded on the feature axis."""
     head_spec = P(None, None, "tp", None)
     fn = shard_map(
-        partial(flash_attention, causal=causal, interpret=interpret),
+        partial(flash_attention, causal=causal, interpret=interpret,
+                window=window),
         mesh=mesh,
         in_specs=(head_spec, head_spec, head_spec),
         out_specs=P(None, None, "tp"),
@@ -73,10 +75,11 @@ def paged_decode_attention_tp(
     lengths: jax.Array,  # [B] replicated
     *,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Per-shard paged decode attention → [B, H·Hd] sharded on features."""
     fn = shard_map(
-        partial(paged_decode_attention, interpret=interpret),
+        partial(paged_decode_attention, interpret=interpret, window=window),
         mesh=mesh,
         in_specs=(
             P(None, "tp", None),
@@ -101,10 +104,11 @@ def paged_prefill_attention_tp(
     true_len: jax.Array,  # scalar replicated
     *,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Per-shard suffix-prefill attention → [C, H·Hd] sharded on features."""
     fn = shard_map(
-        partial(paged_prefill_attention, interpret=interpret),
+        partial(paged_prefill_attention, interpret=interpret, window=window),
         mesh=mesh,
         in_specs=(
             P(None, "tp", None),
@@ -130,10 +134,11 @@ def paged_verify_attention_tp(
     counts: jax.Array,  # [B] replicated
     *,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Per-shard verify-window attention → [B, C, H·Hd] sharded on features."""
     fn = shard_map(
-        partial(paged_verify_attention, interpret=interpret),
+        partial(paged_verify_attention, interpret=interpret, window=window),
         mesh=mesh,
         in_specs=(
             P(None, None, "tp", None),

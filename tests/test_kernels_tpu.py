@@ -103,6 +103,28 @@ class TestPagedAttentionHW:
             atol=6e-2, rtol=6e-2,
         )
 
+    def test_bench_shapes_sliding_window(self):
+        """Mistral-style banded decode attention at bench shapes: the
+        kernel must skip out-of-window pages AND compile under Mosaic."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_decode_attention,
+            reference_paged_attention,
+        )
+
+        B, H, KV, Hd, ps, n_pages, mp = 8, 16, 8, 128, 128, 257, 8
+        lengths = [129, 1000, 7, 1, 0, 128, 255, 513]
+        q, kp, vp, tables, ln = _paged_setup(
+            B, H, KV, Hd, ps, n_pages, mp, lengths, jnp.bfloat16, seed=7
+        )
+        out = paged_decode_attention(q, kp, vp, tables, ln,
+                                     window=300, interpret=False)
+        out.block_until_ready()
+        ref = reference_paged_attention(q, kp, vp, tables, ln, window=300)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=5e-2, rtol=5e-2,
+        )
+
     def test_inactive_rows_zero(self):
         from fusioninfer_tpu.ops.paged_attention import paged_decode_attention
 

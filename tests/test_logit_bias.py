@@ -1,0 +1,115 @@
+"""OpenAI ``logit_bias``: per-token additive logit adjustments."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.models.config import get_preset
+
+CFG = get_preset("qwen3-tiny")
+CACHE = CacheConfig(n_pages=33, page_size=16, max_pages_per_seq=4)
+
+
+def _run(engine, reqs, max_steps=60):
+    for r in reqs:
+        engine.add_request(r)
+    toks: dict[str, list[int]] = {r.request_id: [] for r in reqs}
+    while engine.has_work():
+        max_steps -= 1
+        assert max_steps > 0
+        for o in engine.step():
+            toks[o.request_id].append(o.token)
+    return toks
+
+
+class TestEngineLogitBias:
+    def test_strong_bias_forces_token(self):
+        """+100 on one id makes greedy pick it every step (first token
+        from prefill AND decode steps)."""
+        engine = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2)
+        forced = 1234
+        toks = _run(engine, [Request(
+            request_id="r", prompt_tokens=[1, 2, 3],
+            params=SamplingParams(max_tokens=5, temperature=0.0,
+                                  logit_bias=((forced, 100.0),)))])
+        assert toks["r"] == [forced] * 5
+
+    def test_negative_bias_bans_token(self):
+        """-100 on the would-be greedy token changes the choice."""
+        engine = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2)
+        base = _run(engine, [Request(
+            request_id="a", prompt_tokens=[7, 8, 9],
+            params=SamplingParams(max_tokens=1, temperature=0.0))])
+        banned = base["a"][0]
+        engine2 = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2)
+        biased = _run(engine2, [Request(
+            request_id="b", prompt_tokens=[7, 8, 9],
+            params=SamplingParams(max_tokens=1, temperature=0.0,
+                                  logit_bias=((banned, -100.0),)))])
+        assert biased["b"][0] != banned
+
+    def test_bias_rows_isolated(self):
+        """A biased request must not change its neighbors' tokens."""
+        rng = np.random.default_rng(0)
+        mk = lambda rid, bias: Request(  # noqa: E731
+            request_id=rid,
+            prompt_tokens=rng.integers(1, CFG.vocab_size, 6).tolist(),
+            params=SamplingParams(max_tokens=4, temperature=0.0,
+                                  logit_bias=bias))
+        e1 = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0)
+        solo = _run(e1, [mk("plain", ())])
+        rng = np.random.default_rng(0)
+        e2 = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0)
+        both = _run(e2, [mk("plain", ()), mk("biased", ((42, 100.0),))])
+        assert both["plain"] == solo["plain"]
+        assert both["biased"] == [42] * 4
+
+
+class TestServerLogitBias:
+    def test_http_logit_bias(self):
+        from fusioninfer_tpu.engine.server import EngineServer
+
+        eng = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2)
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=eng)
+        srv.start()
+        try:
+            body = json.dumps({
+                "model": "qwen3-tiny", "prompt": "hi", "max_tokens": 3,
+                "temperature": 0.0,
+                # byte tokenizer: 'A' is id 65+3; force it
+                "logit_bias": {"68": 100},
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            r = json.loads(urllib.request.urlopen(req, timeout=120).read())
+            assert r["choices"][0]["text"] == "AAA"
+            # malformed rejects 400
+            bad = json.dumps({"model": "qwen3-tiny", "prompt": "x",
+                              "max_tokens": 1, "logit_bias": [1, 2]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions", data=bad,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+            # out-of-vocab ids reject 400 (JAX would silently wrap/drop)
+            for bad_id in ("-1", str(CFG.vocab_size)):
+                body = json.dumps({"model": "qwen3-tiny", "prompt": "x",
+                                   "max_tokens": 1,
+                                   "logit_bias": {bad_id: 5}}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=30)
+                assert ei.value.code == 400
+        finally:
+            srv.stop()
