@@ -52,6 +52,11 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         qk_norm=qk_norm,
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
         max_seq_len=int(hf.get("max_position_embeddings", 4096)),
+        # Mistral-family checkpoints declare their window here; null/absent
+        # means full causal attention, and Qwen2-style configs may carry a
+        # window but explicitly disable it via use_sliding_window
+        sliding_window=(hf.get("sliding_window") or None)
+        if hf.get("use_sliding_window", True) else None,
     ).validate()
 
 
@@ -214,6 +219,13 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
         "tie_word_embeddings": cfg.tie_embeddings,
         "max_position_embeddings": cfg.max_seq_len,
     }
+    if cfg.sliding_window is not None:
+        hf_cfg["sliding_window"] = cfg.sliding_window
+        if not cfg.qk_norm:
+            # a windowed qwen3-style config must KEEP its qwen3 marker —
+            # config_from_hf derives qk_norm from it on reload
+            hf_cfg["architectures"] = ["MistralForCausalLM"]
+            hf_cfg["model_type"] = "mistral"
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(hf_cfg, f, indent=2)
 

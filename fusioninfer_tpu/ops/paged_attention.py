@@ -444,7 +444,7 @@ def _verify_kernel(
     k_pages_ref,
     v_pages_ref,
     *rest,
-    window: int,
+    n_q: int,  # verify-window LENGTH (C) — `sliding` is the sliding window
     page_size: int,
     sm_scale: float,
     quantized: bool,
@@ -474,7 +474,7 @@ def _verify_kernel(
             c.start()
 
     G, Hd = q_ref.shape[2], q_ref.shape[3]
-    R = window * G
+    R = n_q * G
     q = q_ref[:, 0].astype(jnp.float32).reshape(R, Hd) * sm_scale
     row_pos = start + jax.lax.broadcasted_iota(
         jnp.int32, (R, page_size), 0
@@ -515,7 +515,7 @@ def _verify_kernel(
     a0 = jnp.zeros((R, Hd), jnp.float32)
     m, l, acc = jax.lax.fori_loop(first, n_used, body, (m0, l0, a0))
     out = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
-    o_ref[:, 0] = out.reshape(window, G, Hd)
+    o_ref[:, 0] = out.reshape(n_q, G, Hd)
 
 
 @functools.partial(
@@ -577,7 +577,7 @@ def paged_verify_attention(
     )
     kernel = functools.partial(
         _verify_kernel,
-        window=C, page_size=page_size, sm_scale=sm_scale,
+        n_q=C, page_size=page_size, sm_scale=sm_scale,
         quantized=quantized, sliding=window,
     )
     operands = [page_tables.astype(jnp.int32), starts.astype(jnp.int32),

@@ -63,6 +63,26 @@ def test_config_from_hf_qwen_vs_llama(tmp_path, params):
     save_hf_checkpoint(str(d2), llama, p2)
     cfg2 = config_from_hf(str(d2))
     assert cfg2.qk_norm is False
+    # Mistral-style: sliding_window round-trips through the HF config
+    mistral = dataclasses.replace(llama, sliding_window=512)
+    d3 = tmp_path / "mistral"
+    save_hf_checkpoint(str(d3), mistral, p2)
+    cfg3 = config_from_hf(str(d3))
+    assert cfg3.sliding_window == 512
+    # a windowed QWEN3-style config keeps its qk_norm marker on reload
+    qwen_win = dataclasses.replace(CFG, sliding_window=512)
+    d4 = tmp_path / "qwen-win"
+    save_hf_checkpoint(str(d4), qwen_win, params)
+    cfg4 = config_from_hf(str(d4))
+    assert cfg4.qk_norm is True and cfg4.sliding_window == 512
+    # Qwen2-style: use_sliding_window=false disables a declared window
+    import json as _json
+    with open(d3 / "config.json") as f:
+        raw = _json.load(f)
+    raw["use_sliding_window"] = False
+    with open(d3 / "config.json", "w") as f:
+        _json.dump(raw, f)
+    assert config_from_hf(str(d3)).sliding_window is None
 
 
 def test_missing_layer_tensor_raises(tmp_path, params):
