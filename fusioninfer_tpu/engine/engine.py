@@ -58,6 +58,11 @@ from fusioninfer_tpu.models.transformer import init_params
 
 logger = logging.getLogger("fusioninfer.engine")
 
+# prefix-cache hits whose un-cached suffix is at most this many tokens
+# batch through ONE verify_step forward (window length is part of the
+# compiled signature, so it must be a single static value)
+_SUFFIX_BATCH_WINDOW = 16
+
 
 @dataclass
 class Request:
@@ -661,6 +666,7 @@ class NativeEngine:
 
         while pending:
             fresh: list[tuple[Request, list[int], bool]] = []
+            short_hits: list[tuple[Request, list[int], bool, int]] = []
             deferred_idx: list[int] = []
             seen_prompts: set = set()
             stopped_at: Optional[int] = None
@@ -705,6 +711,13 @@ class NativeEngine:
                         pos=reused,
                     ))
                 elif reused:
+                    if len(prefix) - reused <= _SUFFIX_BATCH_WINDOW:
+                        # short suffix: batch with other hits through one
+                        # verify_step forward (the common prefix-cache
+                        # burst — N requests sharing a prompt, tails
+                        # differing by a few tokens)
+                        short_hits.append((request, prefix, resumed, reused))
+                        continue
                     try:
                         outputs.append(self._prefill_suffix_one(
                             request, prefix, resumed, reused))
@@ -735,6 +748,8 @@ class NativeEngine:
                     n = 1 << (len(items).bit_length() - 1)
                     group, items = items[:n], items[n:]
                     outputs.extend(self._prefill_fresh_group(bucket, group))
+            if short_hits:
+                outputs.extend(self._prefill_suffix_batch(short_hits))
             pending = [pending[i] for i in deferred_idx]
         return outputs
 
@@ -969,6 +984,71 @@ class NativeEngine:
         logits = self._suffix_forward(request, prefix, reused_tokens,
                                       len(prefix) - reused_tokens)
         return self._activate(request, prefix, resumed, logits)
+
+    def _prefill_suffix_batch(
+        self, items: list[tuple[Request, list[int], bool, int]]
+    ) -> list[StepOutput]:
+        """One verify_step forward for a burst of SHORT cache-hit
+        suffixes: each sequence's window is its un-cached tail at its own
+        start position — N hits sharing a prompt prefill as one pass
+        instead of N.  Error semantics mirror ``_prefill_fresh_group``:
+        a forward failure fails the whole group; an activation failure
+        fails only its own request."""
+        if len(items) == 1:
+            # no batch to amortize: the 1-sequence bucketed suffix path is
+            # far cheaper than a B-wide verify window
+            request, prefix, resumed, reused = items[0]
+            try:
+                return [self._prefill_suffix_one(request, prefix, resumed,
+                                                 reused)]
+            except Exception as e:
+                logger.exception("prefill of %s failed", request.request_id)
+                self.alloc.release(request.request_id)
+                return [self._fail_admission(request, e)]
+        # next power of two ≥ burst size: compile signatures stay bounded
+        # at log2(max_batch) variants, padding rows stay inert (counts 0)
+        B = 1 << (len(items) - 1).bit_length()
+        C = _SUFFIX_BATCH_WINDOW
+        mp = self.cache_cfg.max_pages_per_seq
+        window = np.zeros((B, C), np.int32)
+        starts = np.zeros((B,), np.int32)
+        counts = np.zeros((B,), np.int32)
+        rows = np.full((B, mp), self.cache_cfg.trash_page, np.int32)
+        ids = np.zeros((B,), np.int32)
+        for i, (request, prefix, _, reused) in enumerate(items):
+            suffix = prefix[reused:]
+            window[i, : len(suffix)] = suffix
+            starts[i] = reused
+            counts[i] = len(suffix)
+            rows[i] = self.alloc.page_table_row(request.request_id)
+            ids[i] = self._adapter_id(request)
+        lora = self.lora_set.stacked if self.lora_set is not None else None
+        try:
+            self.cache, logits = verify_step(
+                self.cfg, self.cache_cfg, self.params, self.cache,
+                jnp.asarray(window), jnp.asarray(starts), jnp.asarray(counts),
+                jnp.asarray(rows), mesh=self._kernel_mesh, lora=lora,
+                adapter_ids=jnp.asarray(ids) if lora is not None else None,
+            )
+        except Exception as e:
+            logger.exception("batched suffix prefill of %d requests failed",
+                             len(items))
+            outputs = []
+            for request, _, _, _ in items:
+                self.alloc.release(request.request_id)
+                outputs.append(self._fail_admission(request, e))
+            return outputs
+        outputs = []
+        for i, (request, prefix, resumed, reused) in enumerate(items):
+            try:
+                outputs.append(self._activate(
+                    request, prefix, resumed,
+                    logits[i, counts[i] - 1][None]))
+            except Exception as e:
+                logger.exception("activation of %s failed", request.request_id)
+                self.alloc.release(request.request_id)
+                outputs.append(self._fail_admission(request, e))
+        return outputs
 
     def _advance_prefilling(self) -> list[StepOutput]:
         """Run up to ``prefill_chunks_per_step`` chunk forwards, FCFS.

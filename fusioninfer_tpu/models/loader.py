@@ -220,12 +220,10 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
         "max_position_embeddings": cfg.max_seq_len,
     }
     if cfg.sliding_window is not None:
+        # the window key alone round-trips (config_from_hf reads it
+        # independently of architecture); rewriting model_type would
+        # silently rename the served model across a save/load cycle
         hf_cfg["sliding_window"] = cfg.sliding_window
-        if not cfg.qk_norm:
-            # a windowed qwen3-style config must KEEP its qwen3 marker —
-            # config_from_hf derives qk_norm from it on reload
-            hf_cfg["architectures"] = ["MistralForCausalLM"]
-            hf_cfg["model_type"] = "mistral"
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(hf_cfg, f, indent=2)
 

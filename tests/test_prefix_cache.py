@@ -160,3 +160,75 @@ class TestReuseAwareAdmission:
         alloc.allocate("pin", 48)  # 6 of 8 usable pages
         assert not alloc.can_admit(list(range(40)), 1)  # needs 6, 2 free
         alloc.release("pin")
+
+
+class TestBatchedSuffixPrefill:
+    """A burst of short-suffix cache hits runs as ONE verify_step forward
+    (engine._prefill_suffix_batch) — tokens must be identical to serial
+    per-request admission."""
+
+    def _mk(self, rid, prompt, seed=None, temperature=0.0):
+        return Request(
+            request_id=rid, prompt_tokens=list(prompt),
+            params=SamplingParams(max_tokens=5, temperature=temperature,
+                                  seed=seed))
+
+    def _drain(self, engine, reqs):
+        toks: dict[str, list[int]] = {r.request_id: [] for r in reqs}
+        for _ in range(80):
+            if not engine.has_work():
+                break
+            for o in engine.step():
+                assert not (o.finish_reason or "").startswith("error"), o
+                toks[o.request_id].append(o.token)
+        assert not engine.has_work()
+        return toks
+
+    def test_burst_matches_serial(self):
+        import numpy as np
+
+        common = list(range(1, 25))  # 3 full pages of 8
+        rng = np.random.default_rng(0)
+        tails = [rng.integers(1, CFG.vocab_size, n).tolist()
+                 for n in (3, 7, 12)]  # all within the batch window (16)
+        prompts = [common + t for t in tails]
+
+        def warm_engine():
+            eng = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=4, seed=0)
+            seed_req = self._mk("seed", common + [99])
+            eng.add_request(seed_req)
+            self._drain(eng, [seed_req])  # registers the common pages
+            return eng
+
+        # serial: one request at a time (hits take _prefill_suffix_one)
+        serial = warm_engine()
+        out_serial = {}
+        for i, p in enumerate(prompts):
+            r = self._mk(f"r{i}", p, seed=50 + i, temperature=0.8)
+            serial.add_request(r)
+            out_serial.update(self._drain(serial, [r]))
+
+        # burst: all three land in one admission round -> one forward
+        burst = warm_engine()
+        reqs = [self._mk(f"r{i}", p, seed=50 + i, temperature=0.8)
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            burst.add_request(r)
+        out_burst = self._drain(burst, reqs)
+        assert out_burst == out_serial
+        assert burst.prefix_cache_hit_rate() > 0
+
+    def test_long_suffix_falls_back_to_serial_path(self):
+        import numpy as np
+
+        common = list(range(1, 25))
+        tail = np.random.default_rng(1).integers(
+            1, CFG.vocab_size, 30).tolist()  # > _SUFFIX_BATCH_WINDOW
+        eng = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=4, seed=0)
+        seed_req = self._mk("seed", common + [99])
+        eng.add_request(seed_req)
+        self._drain(eng, [seed_req])
+        r = self._mk("long", common + tail)
+        eng.add_request(r)
+        toks = self._drain(eng, [r])
+        assert len(toks["long"]) == 5
