@@ -1409,6 +1409,16 @@ class NativeEngine:
                 try:
                     # input token occupies index len-1 -> need len tokens covered
                     self.alloc.extend(st.request.request_id, len(st.tokens) - 1, 1)
+                    if self.cfg.sliding_window is not None:
+                        # pages wholly below the window are dead: the
+                        # kernels start at (length - window) // ps and
+                        # never look back (length == len(tokens) here)
+                        first_live = (len(st.tokens)
+                                      - self.cfg.sliding_window)
+                        if first_live > 0:
+                            self.alloc.trim_window(
+                                st.request.request_id,
+                                first_live // self.cache_cfg.page_size)
                     break
                 except MemoryError:
                     # only a strictly less urgent victim may be evicted —

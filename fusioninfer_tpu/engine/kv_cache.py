@@ -189,6 +189,7 @@ class PageAllocator:
         self.cache_cfg = cache_cfg
         self._free: list[int] = list(range(cache_cfg.n_pages - 1))
         self._owned: dict[str, list[int]] = {}
+        self._trim_mark: dict[str, int] = {}  # seq -> pages already trimmed
 
     @property
     def free_pages(self) -> int:
@@ -248,9 +249,42 @@ class PageAllocator:
     def pages_of(self, seq_id: str) -> list[int]:
         return list(self._owned.get(seq_id, []))
 
+    def _drop_page_ref(self, page: int) -> None:
+        """One owner lets go of ``page``.  Subclass hook: the prefix-
+        caching allocator unrefs shared pages here instead of freeing."""
+        self._free.append(page)
+
+    def trim_window(self, seq_id: str, first_live_page: int) -> int:
+        """Sliding-window reclamation: drop pages wholly below the window
+        (indices < ``first_live_page``), replacing them with trash-page
+        placeholders so page-table indices keep their position mapping.
+        The attention kernels start their page loop at the window's first
+        live page, so trimmed entries are never read.  A per-sequence
+        watermark makes the per-step call O(pages newly below the window),
+        not O(all below-window pages).  Returns the pages dropped."""
+        pages = self._owned.get(seq_id)
+        if not pages:
+            return 0
+        trash = self.cache_cfg.trash_page
+        start = self._trim_mark.get(seq_id, 0)
+        end = min(first_live_page, len(pages))
+        freed = 0
+        for i in range(start, end):
+            if pages[i] != trash:
+                self._drop_page_ref(pages[i])
+                pages[i] = trash
+                freed += 1
+        if end > start:
+            self._trim_mark[seq_id] = end
+        return freed
+
     def release(self, seq_id: str) -> None:
+        trash = self.cache_cfg.trash_page
         pages = self._owned.pop(seq_id, [])
-        self._free.extend(pages)
+        self._trim_mark.pop(seq_id, None)
+        for p in pages:
+            if p != trash:
+                self._drop_page_ref(p)
 
     def page_table_row(self, seq_id: str) -> np.ndarray:
         """Fixed-width page table row, trash-padded."""

@@ -193,19 +193,24 @@ class PrefixCachingAllocator(PageAllocator):
 
     # -- release -------------------------------------------------------------
 
+    def _drop_page_ref(self, page: int) -> None:
+        """One owner lets go of ``page``: unref shared/hashed pages
+        (retaining content as evictable at zero refs), free private ones.
+        Base-class ``trim_window``/``release`` route every drop through
+        this hook, so windowed reclamation inherits sharing semantics."""
+        if page in self._refs:
+            self._refs[page] -= 1
+            if self._refs[page] <= 0:
+                del self._refs[page]
+                # retain content: evictable until the pool needs it
+                self._evictable[page] = None
+                self._evictable.move_to_end(page)
+        else:
+            self._free.append(page)
+
     def release(self, seq_id: str) -> None:
-        pages = self._owned.pop(seq_id, [])
         self._shared_of.pop(seq_id, None)
-        for page in pages:
-            if page in self._refs:
-                self._refs[page] -= 1
-                if self._refs[page] <= 0:
-                    del self._refs[page]
-                    # retain content: evictable until the pool needs it
-                    self._evictable[page] = None
-                    self._evictable.move_to_end(page)
-            else:
-                self._free.append(page)
+        super().release(seq_id)
 
     def prefix_hit_rate(self) -> float:
         if self.query_tokens_total == 0:

@@ -39,7 +39,7 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
     qk_norm = "qwen3" in arch or "qwen3" in str(hf.get("model_type", "")).lower()
     head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
     return ModelConfig(
-        name=name or hf.get("model_type", "hf-model"),
+        name=name or hf.get("fusioninfer_name") or hf.get("model_type", "hf-model"),
         vocab_size=hf["vocab_size"],
         d_model=hf["hidden_size"],
         n_layers=hf["num_hidden_layers"],
@@ -219,11 +219,17 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
         "tie_word_embeddings": cfg.tie_embeddings,
         "max_position_embeddings": cfg.max_seq_len,
     }
+    # the in-repo served name survives any model_type rewrite below
+    hf_cfg["fusioninfer_name"] = cfg.name
     if cfg.sliding_window is not None:
-        # the window key alone round-trips (config_from_hf reads it
-        # independently of architecture); rewriting model_type would
-        # silently rename the served model across a save/load cycle
         hf_cfg["sliding_window"] = cfg.sliding_window
+        if not cfg.qk_norm:
+            # external HF consumers only honor the window under the
+            # mistral architecture (LlamaConfig ignores the key — they
+            # would silently run full attention); qwen3-style configs
+            # keep their marker for qk_norm detection
+            hf_cfg["architectures"] = ["MistralForCausalLM"]
+            hf_cfg["model_type"] = "mistral"
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(hf_cfg, f, indent=2)
 

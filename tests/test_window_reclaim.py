@@ -1,0 +1,147 @@
+"""Sliding-window KV page reclamation.
+
+Windowed models' attention never reads pages wholly below the trailing
+window, so the engine frees them as decode advances — KV residency per
+sequence is bounded by the window, not the full context.  Correctness
+bars: trimming never changes tokens (the freed pages were unreadable by
+construction), page-table position mapping survives (trash
+placeholders), shared prefix pages are unreferenced rather than freed,
+and a tight cache that could NOT hold the full context serves a long
+windowed generation without preemption or kv_capacity errors.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import CacheConfig, PageAllocator
+from fusioninfer_tpu.engine.prefix_cache import PrefixCachingAllocator
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.models.config import get_preset
+
+MISTRAL = dataclasses.replace(get_preset("mistral-tiny"), dtype="float32")
+# window 24, page 16 -> at most 3 live pages per sequence
+
+
+class TestAllocatorTrim:
+    def test_base_trim_frees_and_placeholds(self):
+        cc = CacheConfig(n_pages=17, page_size=16, max_pages_per_seq=8)
+        alloc = PageAllocator(cc)
+        alloc.allocate("s", 80)  # 5 pages
+        free0 = alloc.free_pages
+        row_before = alloc.page_table_row("s")
+        assert alloc.trim_window("s", 2) == 2
+        assert alloc.free_pages == free0 + 2
+        row = alloc.page_table_row("s")
+        assert row[0] == row[1] == cc.trash_page
+        np.testing.assert_array_equal(row[2:5], row_before[2:5])
+        # idempotent; release after trim returns exactly the live pages
+        assert alloc.trim_window("s", 2) == 0
+        alloc.release("s")
+        assert alloc.free_pages == cc.n_pages - 1
+
+    def test_trim_then_extend_keeps_position_mapping(self):
+        cc = CacheConfig(n_pages=17, page_size=16, max_pages_per_seq=8)
+        alloc = PageAllocator(cc)
+        alloc.allocate("s", 40)  # 3 pages
+        alloc.trim_window("s", 1)
+        new = alloc.extend("s", 40, 20)  # grow to 60 tokens -> 4 pages
+        assert len(new) == 1
+        row = alloc.page_table_row("s")
+        assert row[0] == cc.trash_page
+        assert row[3] == new[0]  # position 48.. maps to index 3, not 0
+
+    def test_prefix_alloc_shared_pages_unref_not_freed(self):
+        cc = CacheConfig(n_pages=17, page_size=16, max_pages_per_seq=8)
+        alloc = PrefixCachingAllocator(cc)
+        prompt = list(range(1, 34))  # 33 tokens -> 2 full pages + tail
+        alloc.allocate("a", 34)
+        alloc.register_blocks("a", prompt)
+        reused = alloc.match_prefix("b", prompt + [7, 8, 9])
+        assert reused == 32
+        alloc.allocate("b", 40)
+        shared_pages = alloc.pages_of("b")[:2]
+        # b trims below its window: shared pages lose b's ref but remain
+        # owned by a (and addressable)
+        alloc.trim_window("b", 2)
+        assert all(p in alloc._refs for p in shared_pages)
+        assert alloc.pages_of("b")[0] == cc.trash_page
+        # a unaffected: its table still lists the real pages
+        assert alloc.pages_of("a")[:2] == shared_pages
+        alloc.release("a")
+        alloc.release("b")
+        # content retained as evictable, every non-shared page freed
+        assert alloc.free_pages == cc.n_pages - 1
+
+
+class TestEngineReclaim:
+    CFG_ARGS = dict(max_batch_size=2, seed=0)
+
+    def _run(self, engine, prompt, max_tokens):
+        engine.add_request(Request(
+            request_id="r", prompt_tokens=list(prompt),
+            params=SamplingParams(max_tokens=max_tokens, temperature=0.0)))
+        toks = []
+        for _ in range(max_tokens + 30):
+            if not engine.has_work():
+                break
+            for o in engine.step():
+                assert not (o.finish_reason or "").startswith("error"), o
+                toks.append(o.token)
+        assert not engine.has_work()
+        return toks
+
+    def test_long_generations_fit_tight_cache(self):
+        """Two sequences each grow to 15 pages of context (30 combined)
+        in a 16-usable-page pool: impossible untrimmed, trivial with
+        window-bounded residency — no preemption, no kv_capacity."""
+        tight = CacheConfig(n_pages=17, page_size=16, max_pages_per_seq=15)
+        engine = NativeEngine(MISTRAL, cache_cfg=tight, **self.CFG_ARGS)
+        rng = np.random.default_rng(0)
+        for i in range(2):
+            engine.add_request(Request(
+                request_id=f"r{i}",
+                prompt_tokens=rng.integers(1, MISTRAL.vocab_size, 30).tolist(),
+                params=SamplingParams(max_tokens=200, temperature=0.0)))
+        toks: dict[str, int] = {"r0": 0, "r1": 0}
+        peak_used = 0
+        for _ in range(260):
+            if not engine.has_work():
+                break
+            for o in engine.step():
+                assert not (o.finish_reason or "").startswith("error"), o
+                toks[o.request_id] += 1
+            peak_used = max(peak_used, engine.alloc.used_pages)
+        assert not engine.has_work()
+        assert toks == {"r0": 200, "r1": 200}
+        assert engine.preemptions_total == 0
+        # residency stayed window-bounded: ~2-3 live pages per sequence
+        assert peak_used <= 8, peak_used
+
+    def test_trim_never_changes_tokens(self):
+        """Tight cache (trims constantly) vs roomy cache (trims the same
+        pages but pressure-free) — identical greedy tokens."""
+        prompt = np.random.default_rng(1).integers(
+            1, MISTRAL.vocab_size, 40).tolist()
+        tight = NativeEngine(
+            MISTRAL, cache_cfg=CacheConfig(n_pages=17, page_size=16,
+                                           max_pages_per_seq=15),
+            **self.CFG_ARGS)
+        roomy = NativeEngine(
+            MISTRAL, cache_cfg=CacheConfig(n_pages=65, page_size=16,
+                                           max_pages_per_seq=16),
+            **self.CFG_ARGS)
+        a = self._run(tight, prompt, max_tokens=60)
+        b = self._run(roomy, prompt, max_tokens=60)
+        assert a == b
+
+    def test_full_attention_model_never_trims(self):
+        qwen = dataclasses.replace(get_preset("qwen3-tiny"), dtype="float32")
+        cache = CacheConfig(n_pages=33, page_size=16, max_pages_per_seq=8)
+        engine = NativeEngine(qwen, cache_cfg=cache, **self.CFG_ARGS)
+        self._run(engine, [1, 2, 3, 4], max_tokens=40)
+        # all pages a full-attention sequence touched stayed allocated
+        # until release; nothing was trash-placeheld mid-flight (verified
+        # indirectly: generation completed and the pool drained back to full)
+        assert engine.alloc.free_pages == cache.n_pages - 1
