@@ -324,6 +324,10 @@ class NativeEngine:
         self._slab_q: "queue_mod.Queue[tuple[Request, concurrent.futures.Future]]" = (
             queue_mod.Queue()
         )
+        # /v1/embeddings: served inside step() (engine thread owns device)
+        self._embed_q: "queue_mod.Queue[tuple[list[int], concurrent.futures.Future]]" = (
+            queue_mod.Queue()
+        )
         self.running: dict[int, _SeqState] = {}  # slot -> state
         self._free_slots = list(reversed(range(max_batch_size)))
         self._cancelled: set[str] = set()
@@ -395,7 +399,56 @@ class NativeEngine:
         return bool(
             self.waiting or self.waiting_prefilled or self.running
             or self.prefilling or not self._slab_q.empty()
+            or not self._embed_q.empty()
         )
+
+    def request_embedding(self, prompt_tokens: list[int]) -> concurrent.futures.Future:
+        """Queue a sequence-embedding request (last-real-token pooled,
+        L2-normalized); resolves to ``list[float]``.  Served inside
+        :meth:`step` so only the engine thread touches the device."""
+        if not prompt_tokens:
+            raise ValueError("input must not be empty")
+        if len(prompt_tokens) > self.buckets[-1]:
+            raise ValueError(
+                f"input of {len(prompt_tokens)} tokens exceeds max length "
+                f"{self.buckets[-1]}"
+            )
+        if self.mesh is not None:
+            raise ValueError("embeddings are not yet supported on meshes")
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._embed_q.put((prompt_tokens, fut))
+        return fut
+
+    def _serve_embedding_requests(self) -> None:
+        from fusioninfer_tpu.models.transformer import embed_sequences
+
+        batch: list[tuple[list[int], concurrent.futures.Future]] = []
+        while len(batch) < self.max_batch_size:
+            try:
+                batch.append(self._embed_q.get_nowait())
+            except queue_mod.Empty:
+                break
+        batch = [(t, f) for t, f in batch if f.set_running_or_notify_cancel()]
+        if not batch:
+            return
+        try:
+            bucket = pick_bucket(self.buckets, max(len(t) for t, _ in batch))
+            B = 1 << (len(batch) - 1).bit_length()  # bounded signatures
+            padded = np.zeros((B, bucket), np.int32)
+            lens = np.zeros((B,), np.int32)
+            for i, (toks, _) in enumerate(batch):
+                padded[i, : len(toks)] = toks
+                lens[i] = len(toks)
+            emb = np.asarray(embed_sequences(
+                self.cfg, self.params, jnp.asarray(padded), jnp.asarray(lens)))
+            for i, (toks, fut) in enumerate(batch):
+                self.prompt_tokens_total += len(toks)
+                fut.set_result(emb[i].tolist())
+        except Exception as e:
+            self.errors_total += 1
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
 
     def _avail_slots(self) -> int:
         """Free batch slots minus one reserved per mid-prefill sequence
@@ -570,6 +623,7 @@ class NativeEngine:
         """Admit + prefill new work, then one batched decode pass."""
         self._process_cancellations()
         self._serve_slab_requests()
+        self._serve_embedding_requests()
         outputs: list[StepOutput] = []
         outputs += self._admit_prefilled()
         outputs += self._admit()
@@ -1405,20 +1459,21 @@ class NativeEngine:
             st = self.running.get(slot)
             if st is None or st.n_generated >= st.request.params.max_tokens:
                 continue
+            if self.cfg.sliding_window is not None:
+                # reclaim BEFORE asking for pages: a newly dead page may
+                # be the very one this step needs.  Pages wholly below
+                # the window are dead — the kernels start at
+                # (length - window) // ps and never look back
+                # (length == len(tokens) here)
+                first_live = len(st.tokens) - self.cfg.sliding_window
+                if first_live > 0:
+                    self.alloc.trim_window(
+                        st.request.request_id,
+                        first_live // self.cache_cfg.page_size)
             while True:
                 try:
                     # input token occupies index len-1 -> need len tokens covered
                     self.alloc.extend(st.request.request_id, len(st.tokens) - 1, 1)
-                    if self.cfg.sliding_window is not None:
-                        # pages wholly below the window are dead: the
-                        # kernels start at (length - window) // ps and
-                        # never look back (length == len(tokens) here)
-                        first_live = (len(st.tokens)
-                                      - self.cfg.sliding_window)
-                        if first_live > 0:
-                            self.alloc.trim_window(
-                                st.request.request_id,
-                                first_live // self.cache_cfg.page_size)
                     break
                 except MemoryError:
                     # only a strictly less urgent victim may be evicted —

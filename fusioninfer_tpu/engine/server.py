@@ -667,6 +667,43 @@ class EngineServer:
             }
         return text, finish_reason, logprobs_obj, len(tokens)
 
+    def handle_embeddings(self, body: dict) -> dict:
+        """OpenAI /v1/embeddings: last-real-token pooled, L2-normalized
+        sequence embeddings from the serving model's final hidden states."""
+        raw = body.get("input")
+        if isinstance(raw, str):
+            inputs = [raw]
+        elif isinstance(raw, list):
+            inputs = raw
+        else:
+            raise ValueError("input must be a string or a list of strings")
+        if not inputs or any(not isinstance(x, str) or not x for x in inputs):
+            raise ValueError("input must be a non-empty string or list of them")
+        if len(inputs) > 64:
+            raise ValueError("at most 64 inputs per request")
+        if self._lora_of(body):  # validates the name too
+            raise ValueError("embeddings through LoRA adapters are not supported")
+        token_lists = [self.tokenizer.encode(x) for x in inputs]
+        # validate every input BEFORE enqueuing any: a late rejection must
+        # not leave earlier forwards running for a request that 400s
+        max_len = self.engine.buckets[-1]
+        for i, t in enumerate(token_lists):
+            if len(t) > max_len:
+                raise ValueError(
+                    f"input {i} has {len(t)} tokens, exceeds max {max_len}")
+        futs = [self.engine.request_embedding(t) for t in token_lists]
+        data = [
+            {"object": "embedding", "index": i, "embedding": f.result(timeout=300)}
+            for i, f in enumerate(futs)
+        ]
+        n_tokens = sum(len(t) for t in token_lists)
+        return {
+            "object": "list",
+            "data": data,
+            "model": body.get("model") or self.model_name,
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        }
+
     def handle_chat(self, body: dict) -> dict:
         messages = body.get("messages", [])
         prompt = "".join(
@@ -754,6 +791,8 @@ class EngineServer:
                             self._stream(body, chat=True)
                         else:
                             self._send_json(server.handle_chat(body))
+                    elif self.path == "/v1/embeddings":
+                        self._send_json(server.handle_embeddings(body))
                     elif self.path == "/debug/profile":
                         self._send_json(server.handle_profile(body))
                     elif self.path == "/v1/prefill":

@@ -355,13 +355,12 @@ def lm_head(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     return (x @ head).astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnums=0)
-def forward(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
-    """Full-sequence causal forward → logits [B, S, V].
-
-    The training / compile-check path: no KV cache, scan over stacked
-    layer weights.
-    """
+def hidden_states(cfg: ModelConfig, params: Params,
+                  tokens: jax.Array) -> jax.Array:
+    """Full-sequence causal trunk → final hidden states [B, S, D] —
+    the ONE definition of the no-cache forward pass, shared by
+    :func:`forward` (logits) and :func:`embed_sequences` (pooling) so
+    /v1/embeddings can never drift from generation semantics."""
     B, S = tokens.shape
     x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -371,8 +370,32 @@ def forward(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
         return out, None
 
     x, _ = lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    return lm_head(cfg, params, x)
+    return rms_norm(x, params["final_norm"], cfg.rms_eps)
+
+
+@partial(jax.jit, static_argnums=0)
+def forward(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    """Full-sequence causal forward → logits [B, S, V].
+
+    The training / compile-check path: no KV cache, scan over stacked
+    layer weights.
+    """
+    return lm_head(cfg, params, hidden_states(cfg, params, tokens))
+
+
+@partial(jax.jit, static_argnums=0)
+def embed_sequences(cfg: ModelConfig, params: Params, tokens: jax.Array,
+                    true_lens: jax.Array) -> jax.Array:
+    """Sequence embeddings for /v1/embeddings → L2-normalized [B, D].
+
+    Last-REAL-token pooling of the final hidden states (the decoder-only
+    convention: the last position has attended the whole sequence), fp32
+    normalized so cosine similarity is a dot product."""
+    B = tokens.shape[0]
+    x = hidden_states(cfg, params, tokens)
+    last = x[jnp.arange(B), jnp.maximum(true_lens - 1, 0)].astype(jnp.float32)
+    norm = jnp.linalg.norm(last, axis=-1, keepdims=True)
+    return last / jnp.maximum(norm, 1e-12)
 
 
 def loss_fn(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
