@@ -149,12 +149,14 @@ def run_decode(jax, cfg, batch: int, cache_cfg, prefix_len: int,
 
 
 def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
-             concurrency: int, max_prompt: int, max_output: int) -> dict:
+             concurrency: int, max_prompt: int, max_output: int,
+             prefill_chunk: int | None = None) -> dict:
     from fusioninfer_tpu.benchmark.loadgen import run_http_load
     from fusioninfer_tpu.engine.engine import NativeEngine
     from fusioninfer_tpu.engine.server import EngineServer
 
-    engine = NativeEngine(cfg, cache_cfg=cache_cfg, max_batch_size=max_batch_size)
+    engine = NativeEngine(cfg, cache_cfg=cache_cfg, max_batch_size=max_batch_size,
+                          prefill_chunk_size=prefill_chunk)
     srv = EngineServer(
         model=cfg.name, host="127.0.0.1", port=0, engine=engine,
     )
@@ -285,11 +287,16 @@ def main() -> None:
             if on_tpu:
                 http_cache = CacheConfig(n_pages=16 * 10 + 1, page_size=128,
                                          max_pages_per_seq=10)
+                # chunked prefill is the shipped serving config: a long
+                # prompt must not stall every stream's inter-token latency
+                chunk = 512
                 record["http"] = run_http(
                     http_cfg, max_batch_size=16, cache_cfg=http_cache,
                     n_requests=48, concurrency=12,
                     max_prompt=1024, max_output=128,
+                    prefill_chunk=chunk,
                 )
+                record["http"]["prefill_chunk"] = chunk
             else:
                 http_cache = CacheConfig(n_pages=8 * 4 + 1, page_size=64,
                                          max_pages_per_seq=4)

@@ -83,6 +83,9 @@ class _MultiChannel:
 
 _PUMP_DONE = object()  # sentinel: one merged sub-stream finished
 
+# OpenAI system_fingerprint: identifies the serving build configuration
+_FINGERPRINT = "fp_fusioninfer_tpu"
+
 
 def _find_stop(text: str, stops) -> int | None:
     """Earliest index where any stop sequence begins, or None."""
@@ -428,18 +431,21 @@ class EngineServer:
         lora = self._lora_of(body)  # ValueError on rejection
         priority = self._priority_of(body)
         served = lora or self.model_name
+        echo_prefix = prompt if (body.get("echo") and not chat) else ""
         if n == 1:
             chan = self.submit(prompt_tokens, params, lora=lora,
                                priority=priority)
             return chan, self._stream_chunks(chan, chat, params.stop_strings,
-                                             served_model=served)
+                                             served_model=served,
+                                             echo_prefix=echo_prefix)
         completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         created = int(time.time())  # one timestamp: chunks sharing an id
         chans = self._submit_n(prompt_tokens, params, lora, n, priority)
         gens = [
             self._stream_chunks(c, chat, params.stop_strings,
                                 served_model=served, choice_index=i,
-                                completion_id=completion_id, created=created)
+                                completion_id=completion_id, created=created,
+                                echo_prefix=echo_prefix)
             for i, c in enumerate(chans)
         ]
         return _MultiChannel(chans), self._merge_streams(gens)
@@ -489,7 +495,7 @@ class EngineServer:
     def _stream_chunks(self, chan: _RequestChannel, chat: bool,
                        stops: tuple = (), served_model: str = "",
                        choice_index: int = 0, completion_id: str = "",
-                       created: int = 0):
+                       created: int = 0, echo_prefix: str = ""):
         completion_id = completion_id or (
             f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         )
@@ -514,6 +520,8 @@ class EngineServer:
                     elif not out.finished:
                         full = full[: len(full) - _held_back(full, stops)]
                 delta, emitted = full[emitted:], len(full)
+                if echo_prefix:  # OpenAI echo: prompt leads the stream
+                    delta, echo_prefix = echo_prefix + delta, ""
                 lp = None
                 if out.logprob is not None:
                     tok_piece = (self.tokenizer.decode([out.token])
@@ -536,6 +544,7 @@ class EngineServer:
                     # echo the REQUESTED model (adapter name for LoRA
                     # routing) — clients validate/account against it
                     "model": served_model or self.model_name,
+                    "system_fingerprint": _FINGERPRINT,
                     "choices": [choice],
                 }
                 if finish is not None:
@@ -584,12 +593,14 @@ class EngineServer:
         # prefix-cache hits against sample 1's pages
         chans = self._submit_n(prompt_tokens, params, lora, n,
                                self._priority_of(body))
+        echo = bool(body.get("echo"))
         choices = []
         total_completion = 0
         for i, chan in enumerate(chans):
             text, finish_reason, logprobs_obj, n_tokens = self._collect_choice(
                 chan, params)
-            choices.append({"index": i, "text": text,
+            choices.append({"index": i,
+                            "text": (prompt + text) if echo else text,
                             "finish_reason": finish_reason,
                             "logprobs": logprobs_obj})
             total_completion += n_tokens
@@ -598,6 +609,7 @@ class EngineServer:
             "object": "text_completion",
             "created": int(time.time()),
             "model": lora or self.model_name,
+            "system_fingerprint": _FINGERPRINT,
             "choices": choices,
             "usage": {
                 "prompt_tokens": len(prompt_tokens),
@@ -709,12 +721,16 @@ class EngineServer:
         prompt = "".join(
             f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
         ) + "<|assistant|>"
-        completion = self.handle_completion({**body, "prompt": prompt})
+        # `echo` is a completions-only knob: echoing here would leak the
+        # internal chat template into message content
+        completion = self.handle_completion(
+            {**body, "prompt": prompt, "echo": False})
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
             "created": completion["created"],
             "model": completion["model"],
+            "system_fingerprint": _FINGERPRINT,
             "choices": [
                 {
                     "index": c["index"],

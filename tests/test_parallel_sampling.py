@@ -170,3 +170,44 @@ class TestStreamingN:
             t.join()
         for tag, r in results.items():
             assert len(r["choices"]) == 2, tag
+
+
+class TestEchoAndFingerprint:
+    def test_echo_prepends_prompt(self, server):
+        r = _post(server, "/v1/completions", {
+            "model": "qwen3-tiny", "prompt": "HELLO",
+            "max_tokens": 3, "temperature": 0.0, "echo": True,
+        })
+        assert r["choices"][0]["text"].startswith("HELLO")
+        assert r["system_fingerprint"] == "fp_fusioninfer_tpu"
+        no_echo = _post(server, "/v1/completions", {
+            "model": "qwen3-tiny", "prompt": "HELLO",
+            "max_tokens": 3, "temperature": 0.0,
+        })
+        assert r["choices"][0]["text"] == "HELLO" + no_echo["choices"][0]["text"]
+
+    def test_streamed_echo(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json.dumps({"model": "qwen3-tiny", "prompt": "ECHOME",
+                             "max_tokens": 2, "temperature": 0.0,
+                             "echo": True, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        text = ""
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for line in r:
+                line = line.strip()
+                if line.startswith(b"data: ") and b"[DONE]" not in line:
+                    c = json.loads(line[6:])
+                    assert c["system_fingerprint"] == "fp_fusioninfer_tpu"
+                    text += c["choices"][0].get("text", "")
+        assert text.startswith("ECHOME")
+
+    def test_chat_never_echoes_template(self, server):
+        r = _post(server, "/v1/chat/completions", {
+            "model": "qwen3-tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 2, "temperature": 0.0, "echo": True,
+        })
+        content = r["choices"][0]["message"]["content"]
+        assert "<|user|>" not in content and "<|assistant|>" not in content
