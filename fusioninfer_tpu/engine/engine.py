@@ -986,6 +986,7 @@ class NativeEngine:
                 jnp.asarray([p.temperature]),
                 jnp.asarray([p.top_k], jnp.int32),
                 jnp.asarray([p.top_p]),
+                jnp.asarray([p.min_p]),
             )[0]
         )
 
@@ -1270,6 +1271,7 @@ class NativeEngine:
         temps = np.zeros((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
         top_ps = np.ones((B,), np.float32)
+        min_ps = np.zeros((B,), np.float32)
         presence = np.zeros((B,), np.float32)
         frequency = np.zeros((B,), np.float32)
         repetition = np.ones((B,), np.float32)
@@ -1288,6 +1290,7 @@ class NativeEngine:
             temps[slot] = p.temperature
             top_ks[slot] = p.top_k
             top_ps[slot] = p.top_p
+            min_ps[slot] = p.min_p
             presence[slot] = p.presence_penalty
             frequency[slot] = p.frequency_penalty
             repetition[slot] = p.repetition_penalty
@@ -1392,7 +1395,8 @@ class NativeEngine:
                 logits = logits.at[slot, bias[0]].add(bias[1])
         keys = make_row_keys(jnp.asarray(seeds), jnp.asarray(gen_counts))
         sampled_dev = sample(logits, keys, jnp.asarray(temps),
-                             jnp.asarray(top_ks), jnp.asarray(top_ps))
+                             jnp.asarray(top_ks), jnp.asarray(top_ps),
+                             jnp.asarray(min_ps))
         live_slots = jnp.asarray(sorted(live), jnp.int32)
         self._token_counts = self._token_counts.at[
             live_slots, sampled_dev[live_slots]

@@ -22,6 +22,7 @@ class SamplingParams:
     temperature: float = 1.0
     top_k: int = 0  # 0 = disabled
     top_p: float = 1.0
+    min_p: float = 0.0  # vLLM-style: drop tokens with p < min_p * p_max
     max_tokens: int = 128
     min_tokens: int = 0  # stop tokens suppressed until this many generated
     stop_token_ids: tuple[int, ...] = ()
@@ -84,6 +85,7 @@ def sample(
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32, 0 = off
     top_p: jax.Array,  # [B]
+    min_p: jax.Array | None = None,  # [B], 0 = off
 ) -> jax.Array:
     """Sample one token per row; temperature <= 0 means greedy."""
     B, V = logits.shape
@@ -91,6 +93,13 @@ def sample(
 
     t = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / t
+
+    if min_p is not None:
+        # vLLM min_p: drop tokens whose probability is below
+        # min_p × the row's max probability (scale-adaptive floor)
+        probs = jax.nn.softmax(scaled, axis=-1)
+        floor = min_p[:, None] * probs.max(axis=-1, keepdims=True)
+        scaled = jnp.where(probs < floor, -jnp.inf, scaled)
 
     # top-k: mask logits below the k-th largest (per row)
     sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]

@@ -245,6 +245,7 @@ class EngineServer:
                         "temperature": params.temperature,
                         "top_k": params.top_k,
                         "top_p": params.top_p,
+                        "min_p": params.min_p,
                         "min_tokens": params.min_tokens,
                         "stop_token_ids": list(params.stop_token_ids),
                         "presence_penalty": params.presence_penalty,
@@ -313,6 +314,7 @@ class EngineServer:
             top_k=int(sampling.get("top_k", 0)),
             top_p=float(sampling.get("top_p", 1.0)),
             max_tokens=1,
+            min_p=float(sampling.get("min_p", 0.0)),
             min_tokens=int(sampling.get("min_tokens", 0)),
             stop_token_ids=tuple(
                 int(t) for t in sampling.get("stop_token_ids", ())
@@ -347,6 +349,11 @@ class EngineServer:
 
     def _sampling_params(self, body: dict) -> SamplingParams:
         stop_ids = [self.tokenizer.eos_token_id]
+        extra_stop = body.get("stop_token_ids") or []
+        if not isinstance(extra_stop, list) or any(
+                not isinstance(t, int) for t in extra_stop):
+            raise ValueError("stop_token_ids must be a list of token ids")
+        stop_ids += extra_stop
         seed = body.get("seed")
         stop = body.get("stop") or ()
         if isinstance(stop, str):
@@ -373,6 +380,16 @@ class EngineServer:
                 raise ValueError(
                     f"logit_bias token id {t} outside vocab [0, {vocab})"
                 )
+        min_p = float(body.get("min_p", 0.0))
+        if not 0.0 <= min_p <= 1.0:
+            # min_p > 1 would mask EVERY token (even the argmax) and the
+            # categorical over an all--inf row silently emits token 0 —
+            # a wrong token must be a 400, not a 200
+            raise ValueError("min_p must be in [0, 1]")
+        mt = body.get("max_tokens")
+        if mt is None:
+            mt = body.get("max_completion_tokens")  # newer OpenAI name
+        max_tokens = int(mt) if mt is not None else 128
         rf = body.get("response_format")
         guided_json = False
         if rf is not None:
@@ -388,7 +405,8 @@ class EngineServer:
             temperature=float(body.get("temperature", 1.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
-            max_tokens=int(body.get("max_tokens", 128)),
+            min_p=min_p,
+            max_tokens=max_tokens,
             min_tokens=int(body.get("min_tokens", 0)),
             stop_token_ids=tuple(stop_ids),
             stop_strings=tuple(str(x) for x in stop),
