@@ -450,23 +450,41 @@ class EngineServer:
         priority = self._priority_of(body)
         served = lora or self.model_name
         echo_prefix = prompt if (body.get("echo") and not chat) else ""
+        opts = body.get("stream_options") or {}
+        include_usage = bool(isinstance(opts, dict) and
+                             opts.get("include_usage"))
+        # completion-token counts flow from each choice generator into
+        # this accumulator so the final usage chunk can sum them
+        counts: list[int] = []
+        usage_meta = (len(prompt_tokens), counts) if include_usage else None
+        completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
+        created = int(time.time())  # one id/timestamp shared by ALL chunks
         if n == 1:
             chan = self.submit(prompt_tokens, params, lora=lora,
                                priority=priority)
-            return chan, self._stream_chunks(chan, chat, params.stop_strings,
-                                             served_model=served,
-                                             echo_prefix=echo_prefix)
-        completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
-        created = int(time.time())  # one timestamp: chunks sharing an id
+            gen = self._stream_chunks(chan, chat, params.stop_strings,
+                                      served_model=served,
+                                      completion_id=completion_id,
+                                      created=created,
+                                      echo_prefix=echo_prefix,
+                                      usage_counts=counts)
+            if include_usage:
+                gen = self._with_usage_chunk(gen, usage_meta, chat, served,
+                                             completion_id, created)
+            return chan, gen
         chans = self._submit_n(prompt_tokens, params, lora, n, priority)
         gens = [
             self._stream_chunks(c, chat, params.stop_strings,
                                 served_model=served, choice_index=i,
                                 completion_id=completion_id, created=created,
-                                echo_prefix=echo_prefix)
+                                echo_prefix=echo_prefix, usage_counts=counts)
             for i, c in enumerate(chans)
         ]
-        return _MultiChannel(chans), self._merge_streams(gens)
+        merged = self._merge_streams(gens)
+        if include_usage:
+            merged = self._with_usage_chunk(merged, usage_meta, chat, served,
+                                            completion_id, created)
+        return _MultiChannel(chans), merged
 
     def _submit_n(self, prompt_tokens, params, lora: str, n: int,
                   priority: int = 0):
@@ -510,10 +528,39 @@ class EngineServer:
             yield item
         yield None
 
+    def _with_usage_chunk(self, gen, usage_meta, chat: bool,
+                          served_model: str, completion_id: str,
+                          created: int):
+        """OpenAI stream_options.include_usage: every chunk carries
+        ``usage: null`` and one final chunk (same id/created as the
+        stream) carries the totals with empty choices."""
+        prompt_tokens, counts = usage_meta
+        for chunk in gen:
+            if chunk is None:
+                break
+            chunk.setdefault("usage", None)
+            yield chunk
+        completion = sum(counts)
+        yield {
+            "id": completion_id,
+            "object": "chat.completion.chunk" if chat else "text_completion",
+            "created": created,
+            "model": served_model,
+            "system_fingerprint": _FINGERPRINT,
+            "choices": [],
+            "usage": {
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion,
+                "total_tokens": prompt_tokens + completion,
+            },
+        }
+        yield None
+
     def _stream_chunks(self, chan: _RequestChannel, chat: bool,
                        stops: tuple = (), served_model: str = "",
                        choice_index: int = 0, completion_id: str = "",
-                       created: int = 0, echo_prefix: str = ""):
+                       created: int = 0, echo_prefix: str = "",
+                       usage_counts: list | None = None):
         completion_id = completion_id or (
             f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         )
@@ -534,6 +581,11 @@ class EngineServer:
                     if hit is not None:
                         # OpenAI semantics: the stop sequence is excluded
                         full, finish = full[:hit], "stop"
+                        # drop the tokens past the cut so streamed usage
+                        # counts match the non-streaming path exactly
+                        while tokens and len(
+                                self.tokenizer.decode(tokens[:-1])) >= hit:
+                            tokens.pop()
                         self._cancel_chan(chan)
                     elif not out.finished:
                         full = full[: len(full) - _held_back(full, stops)]
@@ -568,6 +620,8 @@ class EngineServer:
                 if finish is not None:
                     break
         finally:
+            if usage_counts is not None:
+                usage_counts.append(len(tokens))
             self._release(chan)
         yield None  # sentinel: emit data: [DONE]
 

@@ -211,3 +211,49 @@ class TestEchoAndFingerprint:
         })
         content = r["choices"][0]["message"]["content"]
         assert "<|user|>" not in content and "<|assistant|>" not in content
+
+
+class TestStreamUsage:
+    def _chunks(self, srv, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        out = []
+        with urllib.request.urlopen(req, timeout=300) as r:
+            for line in r:
+                line = line.strip()
+                if line.startswith(b"data: ") and b"[DONE]" not in line:
+                    out.append(json.loads(line[6:]))
+        return out
+
+    def test_include_usage_final_chunk(self, server):
+        chunks = self._chunks(server, {
+            "model": "qwen3-tiny", "prompt": "usage please",
+            "max_tokens": 5, "temperature": 0.0, "stream": True,
+            "stream_options": {"include_usage": True},
+        })
+        assert "usage" in chunks[-1] and chunks[-1]["choices"] == []
+        u = chunks[-1]["usage"]
+        assert u["completion_tokens"] == 5
+        assert u["total_tokens"] == u["prompt_tokens"] + 5
+        # OpenAI contract: every earlier chunk carries usage: null, and
+        # all chunks (usage one included) share the stream's id
+        assert all(c["usage"] is None for c in chunks[:-1])
+        assert len({c["id"] for c in chunks}) == 1
+
+    def test_include_usage_with_n(self, server):
+        chunks = self._chunks(server, {
+            "model": "qwen3-tiny", "prompt": "multi usage",
+            "max_tokens": 4, "n": 2, "temperature": 0.0, "stream": True,
+            "stream_options": {"include_usage": True},
+        })
+        u = chunks[-1]["usage"]
+        assert u["completion_tokens"] == 8  # summed over both choices
+
+    def test_without_option_no_usage_chunk(self, server):
+        chunks = self._chunks(server, {
+            "model": "qwen3-tiny", "prompt": "no usage",
+            "max_tokens": 3, "temperature": 0.0, "stream": True,
+        })
+        assert all("usage" not in c for c in chunks)
