@@ -59,9 +59,9 @@ from fusioninfer_tpu.models.transformer import init_params
 logger = logging.getLogger("fusioninfer.engine")
 
 # prefix-cache hits whose un-cached suffix is at most this many tokens
-# batch through ONE verify_step forward (window length is part of the
-# compiled signature, so it must be a single static value)
-_SUFFIX_BATCH_WINDOW = 16
+# batch through ONE verify_step forward; the window pads to the burst's
+# power-of-two bucket, so compiled signatures stay bounded
+_SUFFIX_BATCH_WINDOW = 128
 
 
 @dataclass
@@ -1063,7 +1063,9 @@ class NativeEngine:
         # next power of two ≥ burst size: compile signatures stay bounded
         # at log2(max_batch) variants, padding rows stay inert (counts 0)
         B = 1 << (len(items) - 1).bit_length()
-        C = _SUFFIX_BATCH_WINDOW
+        # window = the burst's longest suffix, padded to a bucket
+        C = pick_bucket(self.buckets,
+                        max(len(p) - r for _, p, _, r in items))
         mp = self.cache_cfg.max_pages_per_seq
         window = np.zeros((B, C), np.int32)
         starts = np.zeros((B,), np.int32)
@@ -1084,6 +1086,7 @@ class NativeEngine:
                 jnp.asarray(window), jnp.asarray(starts), jnp.asarray(counts),
                 jnp.asarray(rows), mesh=self._kernel_mesh, lora=lora,
                 adapter_ids=jnp.asarray(ids) if lora is not None else None,
+                last_only=True,
             )
         except Exception as e:
             logger.exception("batched suffix prefill of %d requests failed",
@@ -1097,8 +1100,7 @@ class NativeEngine:
         for i, (request, prefix, resumed, reused) in enumerate(items):
             try:
                 outputs.append(self._activate(
-                    request, prefix, resumed,
-                    logits[i, counts[i] - 1][None]))
+                    request, prefix, resumed, logits[i][None]))
             except Exception as e:
                 logger.exception("activation of %s failed", request.request_id)
                 self.alloc.release(request.request_id)

@@ -382,7 +382,8 @@ def decode_step(
     return _cache_result(scanned, quantized), logits
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "last_only"),
+         donate_argnums=(3,))
 def verify_step(
     cfg: ModelConfig,
     cache_cfg: CacheConfig,
@@ -395,9 +396,13 @@ def verify_step(
     mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
     lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
     adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
+    last_only: bool = False,  # logits at counts-1 only → [B, V]
 ):
     """Speculative-verification forward: score a C-token window per
-    sequence in ONE pass → (cache, logits [B, C, V]).
+    sequence in ONE pass → (cache, logits [B, C, V]); with ``last_only``
+    (the batched-suffix-prefill caller) only each sequence's LAST real
+    position projects through lm_head → [B, V], so a wide window never
+    materializes a [B, C, vocab] logits tensor it won't read.
 
     ``logits[b, i]`` is the model's next-token distribution after
     consuming ``tokens[b, :i+1]`` — exactly what ``i+1`` sequential
@@ -498,6 +503,9 @@ def verify_step(
 
     x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if last_only:
+        last = x[jnp.arange(B), jnp.maximum(counts - 1, 0)]  # [B, D]
+        return _cache_result(scanned, quantized), lm_head(cfg, params, last)
     logits = lm_head(cfg, params, x)  # [B, C, V]
     return _cache_result(scanned, quantized), logits
 

@@ -81,7 +81,8 @@ class _MultiChannel:
         self.chans = chans
 
 
-_PUMP_DONE = object()  # sentinel: one merged sub-stream finished
+_PUMP_DONE = object()  # sentinel: one merged sub-stream finished cleanly
+_PUMP_ABORT = object()  # sentinel: a sub-stream ended WITHOUT its None
 
 # OpenAI system_fingerprint: identifies the serving build configuration
 _FINGERPRINT = "fp_fusioninfer_tpu"
@@ -509,24 +510,31 @@ class EngineServer:
         out_q: queue.Queue = queue.Queue()
 
         def pump(g):
+            ended = False
             try:
                 for chunk in g:
                     if chunk is None:
+                        ended = True
                         break
                     out_q.put(chunk)
             finally:
-                out_q.put(_PUMP_DONE)
+                out_q.put(_PUMP_DONE if ended else _PUMP_ABORT)
 
         for g in gens:
             threading.Thread(target=pump, args=(g,), daemon=True).start()
         done = 0
+        aborted = False
         while done < len(gens):
             item = out_q.get()
-            if item is _PUMP_DONE:
+            if item is _PUMP_DONE or item is _PUMP_ABORT:
                 done += 1
+                aborted = aborted or item is _PUMP_ABORT
                 continue
             yield item
-        yield None
+        if not aborted:
+            # an aborted choice must NOT produce [DONE]: clients detect
+            # truncation by its absence
+            yield None
 
     def _with_usage_chunk(self, gen, usage_meta, chat: bool,
                           served_model: str, completion_id: str,
@@ -535,11 +543,17 @@ class EngineServer:
         ``usage: null`` and one final chunk (same id/created as the
         stream) carries the totals with empty choices."""
         prompt_tokens, counts = usage_meta
+        ended = False
         for chunk in gen:
             if chunk is None:
+                ended = True
                 break
             chunk.setdefault("usage", None)
             yield chunk
+        if not ended:
+            # aborted mid-stream: no usage chunk, no [DONE] — the client
+            # must still be able to detect truncation
+            return
         completion = sum(counts)
         yield {
             "id": completion_id,

@@ -444,7 +444,7 @@ def _verify_kernel(
     k_pages_ref,
     v_pages_ref,
     *rest,
-    n_q: int,  # verify-window LENGTH (C) — `sliding` is the sliding window
+    n_q: int,  # q-TILE length (block) — `sliding` is the sliding window
     page_size: int,
     sm_scale: float,
     quantized: bool,
@@ -455,12 +455,15 @@ def _verify_kernel(
     ks_buf, vs_buf = scale_bufs if quantized else (None, None)
     b = pl.program_id(0)
     g = pl.program_id(1)
+    i = pl.program_id(2)  # q tile within the window
     start = starts_ref[b]
     count = counts_ref[b]
-    n_used = jnp.where(count > 0, pl.cdiv(start + count, page_size), 0)
-    # sliding window: the FIRST query (position start) bounds the
-    # earliest page any window row may read
-    first = (jnp.maximum(start - sliding + 1, 0) // page_size
+    # real queries in THIS tile, and the pages their causal span covers
+    n_q_real = jnp.clip(count - i * n_q, 0, n_q)
+    max_pos = start + i * n_q + n_q_real - 1
+    n_used = jnp.where(n_q_real > 0, pl.cdiv(max_pos + 1, page_size), 0)
+    # sliding window: the tile's FIRST query bounds the earliest page
+    first = (jnp.maximum(start + i * n_q - sliding + 1, 0) // page_size
              if sliding is not None else 0)
 
     def dma(slot, p):
@@ -476,7 +479,7 @@ def _verify_kernel(
     G, Hd = q_ref.shape[2], q_ref.shape[3]
     R = n_q * G
     q = q_ref[:, 0].astype(jnp.float32).reshape(R, Hd) * sm_scale
-    row_pos = start + jax.lax.broadcasted_iota(
+    row_pos = start + i * n_q + jax.lax.broadcasted_iota(
         jnp.int32, (R, page_size), 0
     ) // G
 
@@ -519,10 +522,10 @@ def _verify_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret", "window")
+    jax.jit, static_argnames=("sm_scale", "interpret", "window", "block_q")
 )
 def paged_verify_attention(
-    q: jax.Array,  # [B, C, H, Hd] — C-token verify window per sequence
+    q: jax.Array,  # [B, C, H, Hd] — C-token query window per sequence
     k_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
     v_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
     page_tables: jax.Array,  # [B, max_pages] int32
@@ -534,25 +537,32 @@ def paged_verify_attention(
     sm_scale: float | None = None,
     interpret: bool = False,
     window: int | None = None,
+    block_q: int = 128,
 ) -> jax.Array:
-    """Multi-query decode attention for speculative verification →
-    [B, C, H·Hd].
+    """Batched multi-query paged attention → [B, C, H·Hd].
 
-    The batched middle ground between the single-query decode kernel and
-    the single-sequence suffix kernel: every sequence attends a short
-    window of C queries (the last sampled token + its draft tokens) at
-    per-sequence positions ``starts[b] + i`` over its own pages, causally.
-    Rows at/past ``counts[b]`` are padding with unspecified output;
-    ``counts[b] = 0`` marks an inactive slot (output zeros).  Equivalent
-    capability in the reference stack is vLLM's multi-query scorer for
-    spec decode (delegated, SURVEY §0); here it is an in-repo TPU kernel
-    sharing the decode kernel's head-major page layout.
+    The general ragged middle ground between the single-query decode
+    kernel and the single-sequence suffix kernel: every sequence attends
+    a window of up to C queries at per-sequence positions
+    ``starts[b] + i`` over its own pages, causally; windows longer than
+    ``block_q`` tile over the q axis with the causal wavefront bounding
+    each tile's page loop.  Serves BOTH speculative verification (small
+    C) and batched suffix prefill (C up to a bucket).  Rows at/past
+    ``counts[b]`` are padding with unspecified output; ``counts[b] = 0``
+    marks an inactive slot (output zeros).  Equivalent capability in the
+    reference stack is vLLM's multi-query scorer / ragged attention
+    (delegated, SURVEY §0); here it is an in-repo TPU kernel sharing the
+    decode kernel's head-major page layout.
     """
     B, C, H, Hd = q.shape
     KV, _, page_size, _ = k_pages.shape
     G = H // KV
     sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
     quantized = k_scales is not None
+    block_q = min(block_q, C)
+    if C % block_q:
+        raise ValueError(f"window {C} not divisible by block_q {block_q}")
+    n_qt = C // block_q
 
     qg = q.reshape(B * C, KV, G, Hd)
 
@@ -561,23 +571,25 @@ def paged_verify_attention(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B, KV),
+        grid=(B, KV, n_qt),
         in_specs=[
             pl.BlockSpec(
-                (C, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
+                (block_q, 1, G, Hd),
+                lambda b, g, i, *_, n=n_qt: (b * n + i, g, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             *page_specs,
         ],
         out_specs=pl.BlockSpec(
-            (C, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
+            (block_q, 1, G, Hd),
+            lambda b, g, i, *_, n=n_qt: (b * n + i, g, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _verify_kernel,
-        n_q=C, page_size=page_size, sm_scale=sm_scale,
+        n_q=block_q, page_size=page_size, sm_scale=sm_scale,
         quantized=quantized, sliding=window,
     )
     operands = [page_tables.astype(jnp.int32), starts.astype(jnp.int32),

@@ -184,17 +184,19 @@ class TestBatchedSuffixPrefill:
         assert not engine.has_work()
         return toks
 
+    BIG = CacheConfig(n_pages=65, page_size=8, max_pages_per_seq=24)
+
     def test_burst_matches_serial(self):
         import numpy as np
 
         common = list(range(1, 25))  # 3 full pages of 8
         rng = np.random.default_rng(0)
         tails = [rng.integers(1, CFG.vocab_size, n).tolist()
-                 for n in (3, 7, 12)]  # all within the batch window (16)
+                 for n in (3, 47, 100)]  # all within the batch window (128)
         prompts = [common + t for t in tails]
 
         def warm_engine():
-            eng = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=4, seed=0)
+            eng = NativeEngine(CFG, cache_cfg=self.BIG, max_batch_size=4, seed=0)
             seed_req = self._mk("seed", common + [99])
             eng.add_request(seed_req)
             self._drain(eng, [seed_req])  # registers the common pages
@@ -223,8 +225,8 @@ class TestBatchedSuffixPrefill:
 
         common = list(range(1, 25))
         tail = np.random.default_rng(1).integers(
-            1, CFG.vocab_size, 30).tolist()  # > _SUFFIX_BATCH_WINDOW
-        eng = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=4, seed=0)
+            1, CFG.vocab_size, 150).tolist()  # > _SUFFIX_BATCH_WINDOW
+        eng = NativeEngine(CFG, cache_cfg=self.BIG, max_batch_size=4, seed=0)
         seed_req = self._mk("seed", common + [99])
         eng.add_request(seed_req)
         self._drain(eng, [seed_req])

@@ -257,3 +257,32 @@ class TestEngineIdentity:
         text = EngineMetrics("m").render(spec)
         assert "vllm:spec_decode_num_draft_tokens_total" in text
         assert "vllm:spec_decode_num_accepted_tokens_total" in text
+
+
+    def test_kernel_q_tiling_matches_oracle(self):
+        """Windows longer than block_q tile over the q axis — the ragged
+        batched-suffix mode of the verify kernel."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_verify_attention,
+            reference_paged_verify_attention,
+        )
+
+        B, C, H, KV, Hd, ps, n_pages, mp = 3, 64, 4, 2, 64, 16, 33, 8
+        ks = jax.random.split(jax.random.key(9), 3)
+        q = jax.random.normal(ks[0], (B, C, H, Hd), jnp.float32)
+        kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.float32)
+        vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), jnp.float32)
+        rng = np.random.default_rng(9)
+        tables = rng.permutation(n_pages - 1)[: B * mp].reshape(B, mp).astype(np.int32)
+        starts = np.asarray([0, 21, 50], np.int32)
+        counts = np.asarray([64, 37, 0], np.int32)
+        out = paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts), interpret=True, block_q=16)
+        ref = reference_paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts))
+        got = np.asarray(out).copy()
+        for b in range(B):
+            got[b, counts[b]:] = 0.0
+        np.testing.assert_allclose(got, np.asarray(ref), atol=3e-4, rtol=3e-4)
