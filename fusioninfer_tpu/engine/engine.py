@@ -1040,6 +1040,37 @@ class NativeEngine:
                                       len(prefix) - reused_tokens)
         return self._activate(request, prefix, resumed, logits)
 
+    def _batched_window_forward(self, entries) -> "jax.Array":
+        """ONE multi-query verify_step for a batch of per-sequence token
+        windows — ``entries`` is ``[(request, window_tokens, start)]`` —
+        returning last-real-position logits [B, V] (padding rows inert:
+        counts 0, trash-page tables).  The single assembly point for both
+        the prefix-cache-burst and chunked-prefill batch paths; raises on
+        forward failure (the caller fails its own group)."""
+        C = pick_bucket(self.buckets, max(len(w) for _, w, _ in entries))
+        B = 1 << (len(entries) - 1).bit_length()
+        mp = self.cache_cfg.max_pages_per_seq
+        window = np.zeros((B, C), np.int32)
+        starts = np.zeros((B,), np.int32)
+        counts = np.zeros((B,), np.int32)
+        rows = np.full((B, mp), self.cache_cfg.trash_page, np.int32)
+        ids = np.zeros((B,), np.int32)
+        for i, (request, toks, start) in enumerate(entries):
+            window[i, : len(toks)] = toks
+            starts[i] = start
+            counts[i] = len(toks)
+            rows[i] = self.alloc.page_table_row(request.request_id)
+            ids[i] = self._adapter_id(request)
+        lora = self.lora_set.stacked if self.lora_set is not None else None
+        self.cache, logits = verify_step(
+            self.cfg, self.cache_cfg, self.params, self.cache,
+            jnp.asarray(window), jnp.asarray(starts), jnp.asarray(counts),
+            jnp.asarray(rows), mesh=self._kernel_mesh, lora=lora,
+            adapter_ids=jnp.asarray(ids) if lora is not None else None,
+            last_only=True,
+        )
+        return logits
+
     def _prefill_suffix_batch(
         self, items: list[tuple[Request, list[int], bool, int]]
     ) -> list[StepOutput]:
@@ -1060,34 +1091,10 @@ class NativeEngine:
                 logger.exception("prefill of %s failed", request.request_id)
                 self.alloc.release(request.request_id)
                 return [self._fail_admission(request, e)]
-        # next power of two ≥ burst size: compile signatures stay bounded
-        # at log2(max_batch) variants, padding rows stay inert (counts 0)
-        B = 1 << (len(items) - 1).bit_length()
-        # window = the burst's longest suffix, padded to a bucket
-        C = pick_bucket(self.buckets,
-                        max(len(p) - r for _, p, _, r in items))
-        mp = self.cache_cfg.max_pages_per_seq
-        window = np.zeros((B, C), np.int32)
-        starts = np.zeros((B,), np.int32)
-        counts = np.zeros((B,), np.int32)
-        rows = np.full((B, mp), self.cache_cfg.trash_page, np.int32)
-        ids = np.zeros((B,), np.int32)
-        for i, (request, prefix, _, reused) in enumerate(items):
-            suffix = prefix[reused:]
-            window[i, : len(suffix)] = suffix
-            starts[i] = reused
-            counts[i] = len(suffix)
-            rows[i] = self.alloc.page_table_row(request.request_id)
-            ids[i] = self._adapter_id(request)
-        lora = self.lora_set.stacked if self.lora_set is not None else None
         try:
-            self.cache, logits = verify_step(
-                self.cfg, self.cache_cfg, self.params, self.cache,
-                jnp.asarray(window), jnp.asarray(starts), jnp.asarray(counts),
-                jnp.asarray(rows), mesh=self._kernel_mesh, lora=lora,
-                adapter_ids=jnp.asarray(ids) if lora is not None else None,
-                last_only=True,
-            )
+            logits = self._batched_window_forward(
+                [(request, prefix[reused:], reused)
+                 for request, prefix, _, reused in items])
         except Exception as e:
             logger.exception("batched suffix prefill of %d requests failed",
                              len(items))
@@ -1108,33 +1115,73 @@ class NativeEngine:
         return outputs
 
     def _advance_prefilling(self) -> list[StepOutput]:
-        """Run up to ``prefill_chunks_per_step`` chunk forwards, FCFS.
-        A sequence whose final chunk completes activates into the decode
-        batch (its reserved slot is guaranteed by ``_avail_slots``)."""
+        """Advance EVERY mid-prefill sequence one chunk per step in one
+        batched multi-query forward (the q-tiled verify kernel) —
+        prefilling sequences progress together at full MXU utilization
+        instead of serializing across steps.  Sequences whose final chunk
+        completes activate into the decode batch (their reserved slots
+        are guaranteed by ``_avail_slots``).  A single sequence uses the
+        cheaper 1-sequence bucketed suffix path."""
         outputs: list[StepOutput] = []
-        budget = self.prefill_chunks_per_step
-        while budget > 0 and self.prefilling:
-            st = self.prefilling[0]
-            rid = st.request.request_id
-            try:
-                chunk = min(self.prefill_chunk, len(st.prefix) - st.pos)
-                logits = self._suffix_forward(st.request, st.prefix,
-                                              st.pos, chunk)
-                st.pos += chunk
-                if st.pos == len(st.prefix):
-                    self.prefilling.pop(0)
-                    outputs.append(self._activate(
-                        st.request, st.prefix, st.resumed, logits))
-            except Exception as e:
-                logger.exception("chunked prefill of %s failed", rid)
-                # st is still the head on a chunk-forward failure but was
-                # already popped when _activate raised — never double-pop
-                # (that would drop the NEXT queue entry and leak its pages)
-                if self.prefilling and self.prefilling[0] is st:
-                    self.prefilling.pop(0)
-                self.alloc.release(rid)
+        for _ in range(self.prefill_chunks_per_step):
+            if not self.prefilling:
+                break
+            if len(self.prefilling) == 1:
+                st = self.prefilling[0]
+                rid = st.request.request_id
+                try:
+                    chunk = min(self.prefill_chunk, len(st.prefix) - st.pos)
+                    logits = self._suffix_forward(st.request, st.prefix,
+                                                  st.pos, chunk)
+                    st.pos += chunk
+                    if st.pos == len(st.prefix):
+                        self.prefilling.pop(0)
+                        outputs.append(self._activate(
+                            st.request, st.prefix, st.resumed, logits))
+                except Exception as e:
+                    logger.exception("chunked prefill of %s failed", rid)
+                    # st is still the head on a chunk-forward failure but
+                    # was popped when _activate raised — never double-pop
+                    if self.prefilling and self.prefilling[0] is st:
+                        self.prefilling.pop(0)
+                    self.alloc.release(rid)
+                    outputs.append(self._fail_admission(st.request, e))
+                continue
+            outputs.extend(self._advance_prefilling_batch())
+        return outputs
+
+    def _advance_prefilling_batch(self) -> list[StepOutput]:
+        """One batched chunk forward for all prefilling sequences."""
+        take = list(self.prefilling[: self.max_batch_size])
+        chunks = [min(self.prefill_chunk, len(st.prefix) - st.pos)
+                  for st in take]
+        try:
+            logits = self._batched_window_forward(
+                [(st.request, st.prefix[st.pos : st.pos + chunks[i]], st.pos)
+                 for i, st in enumerate(take)])
+        except Exception as e:
+            logger.exception("batched chunk advance of %d prefills failed",
+                             len(take))
+            outputs = []
+            for st in take:
+                if st in self.prefilling:
+                    self.prefilling.remove(st)
+                self.alloc.release(st.request.request_id)
                 outputs.append(self._fail_admission(st.request, e))
-            budget -= 1
+            return outputs
+        outputs = []
+        for i, st in enumerate(take):
+            st.pos += chunks[i]
+            if st.pos == len(st.prefix):
+                self.prefilling.remove(st)
+                try:
+                    outputs.append(self._activate(
+                        st.request, st.prefix, st.resumed, logits[i][None]))
+                except Exception as e:
+                    logger.exception("activation of %s failed",
+                                     st.request.request_id)
+                    self.alloc.release(st.request.request_id)
+                    outputs.append(self._fail_admission(st.request, e))
         return outputs
 
     def _prefill_fresh_group(

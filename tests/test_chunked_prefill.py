@@ -323,3 +323,39 @@ class TestLifecycle:
         outs = engine.step()
         assert engine.num_prefilling == 0
         assert any(o.request_id == "s" and o.is_first_token for o in outs)
+
+
+class TestBatchedChunkAdvance:
+    def test_two_long_prompts_identity(self):
+        """Two prompts mid-chunked-prefill advance via ONE batched
+        forward per step — tokens identical to the monolithic engine."""
+        rng = np.random.default_rng(21)
+        prompts = [rng.integers(1, CFG.vocab_size, n).tolist()
+                   for n in (100, 70)]
+
+        def run(chunk):
+            eng = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                               prefill_chunk_size=chunk)
+            reqs = [Request(request_id=f"r{i}", prompt_tokens=list(p),
+                            params=SamplingParams(max_tokens=6,
+                                                  temperature=0.0))
+                    for i, p in enumerate(prompts)]
+            for r in reqs:
+                eng.add_request(r)
+            saw_two_prefilling = False
+            toks: dict[str, list[int]] = {r.request_id: [] for r in reqs}
+            for _ in range(60):
+                if not eng.has_work():
+                    break
+                if eng.num_prefilling >= 2:
+                    saw_two_prefilling = True
+                for o in eng.step():
+                    assert not (o.finish_reason or "").startswith("error"), o
+                    toks[o.request_id].append(o.token)
+            assert not eng.has_work()
+            return toks, saw_two_prefilling
+
+        mono, _ = run(None)
+        chunked, concurrent = run(16)
+        assert concurrent, "both prompts should prefill concurrently"
+        assert chunked == mono
