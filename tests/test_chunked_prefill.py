@@ -359,3 +359,25 @@ class TestBatchedChunkAdvance:
         chunked, concurrent = run(16)
         assert concurrent, "both prompts should prefill concurrently"
         assert chunked == mono
+
+
+class TestChunkedWithSpec:
+    def test_chunked_and_speculative_compose(self):
+        """Chunked prefill + speculative decoding together stay token-
+        identical to the plain engine (greedy)."""
+        rng = np.random.default_rng(31)
+        reqs = lambda: [  # noqa: E731
+            Request(request_id="rep", prompt_tokens=[5, 6, 7] * 20,
+                    params=SamplingParams(max_tokens=10, temperature=0.0)),
+            Request(request_id="rand",
+                    prompt_tokens=rng.integers(1, CFG.vocab_size, 90).tolist(),
+                    params=SamplingParams(max_tokens=6, temperature=0.0)),
+        ]
+        plain = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4)
+        both = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                            prefill_chunk_size=16, speculative_k=4)
+        rng = np.random.default_rng(31)
+        a = _run_all(plain, reqs())
+        rng = np.random.default_rng(31)
+        b = _run_all(both, reqs())
+        assert a == b

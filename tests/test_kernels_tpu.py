@@ -171,6 +171,38 @@ class TestPagedVerifyAttentionHW:
         )
 
 
+class TestBatchedWindowHW:
+    def test_q_tiled_batched_suffix_bf16(self):
+        """The batched-suffix / chunk-advance mode: per-sequence windows
+        longer than block_q, tiled over q, at bench head shapes."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_verify_attention,
+            reference_paged_verify_attention,
+        )
+
+        B, C, H, KV, Hd, ps, n_pages, mp = 4, 256, 16, 8, 128, 128, 257, 8
+        ks = jax.random.split(jax.random.key(11), 3)
+        q = jax.random.normal(ks[0], (B, C, H, Hd), jnp.bfloat16)
+        kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        rng = np.random.default_rng(11)
+        tables = rng.permutation(n_pages - 1)[: B * mp].reshape(B, mp).astype(np.int32)
+        starts = np.asarray([0, 301, 512, 77], np.int32)
+        counts = np.asarray([256, 129, 1, 0], np.int32)
+        out = paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts), interpret=False, block_q=128)
+        out.block_until_ready()
+        ref = reference_paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts))
+        got = np.asarray(out, np.float32).copy()
+        for b in range(B):
+            got[b, counts[b]:] = 0.0
+        np.testing.assert_allclose(
+            got, np.asarray(ref, np.float32), atol=5e-2, rtol=5e-2)
+
+
 class TestPagedPrefillAttentionHW:
     def test_suffix_bench_shapes_bf16(self):
         """Prefix-cache-hit path at bench shapes: suffix queries mid-stream
