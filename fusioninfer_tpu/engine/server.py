@@ -73,6 +73,11 @@ class _RequestChannel:
                 return
 
 
+class Draining(Exception):
+    """Server is draining: new work is refused with 503 so the load
+    balancer retries another replica."""
+
+
 class _MultiChannel:
     """Composite of one request's n per-choice channels, so the HTTP
     layer's single ``abort(chan)`` tears every choice down."""
@@ -152,6 +157,8 @@ class EngineServer:
         self._req_meta: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._draining = False
+        self._inflight = 0  # HTTP handlers mid-request (drain waits)
         self._httpd: ThreadingHTTPServer | None = None
         self._engine_thread: threading.Thread | None = None
         self._profiling = False
@@ -212,6 +219,10 @@ class EngineServer:
         request_id = uuid.uuid4().hex[:16]
         chan = _RequestChannel()
         with self._lock:
+            # checked under the SAME lock drain() flips the flag under:
+            # after drain sees the flag set, no new channel can register
+            if self._draining:
+                raise Draining("server is draining; retry another replica")
             self._channels[request_id] = chan
             self._req_meta[request_id] = {
                 "arrival": time.monotonic(),
@@ -302,6 +313,10 @@ class EngineServer:
         return {"status": "ok", "dir": out_dir, "seconds": seconds}
 
     def handle_prefill(self, body: dict) -> bytes:
+        if self._draining:
+            # a draining prefiller must refuse new slabs or it can never
+            # finish draining (decode replicas POST here directly)
+            raise Draining("server is draining; retry another replica")
         """Prefiller role: run one prefill, return the KV slab frame."""
         from fusioninfer_tpu.engine.kv_transfer import slab_to_bytes
 
@@ -779,6 +794,8 @@ class EngineServer:
             raise ValueError("input must be a non-empty string or list of them")
         if len(inputs) > 64:
             raise ValueError("at most 64 inputs per request")
+        if self._draining:
+            raise Draining("server is draining; retry another replica")
         if self._lora_of(body):  # validates the name too
             raise ValueError("embeddings through LoRA adapters are not supported")
         token_lists = [self.tokenizer.encode(x) for x in inputs]
@@ -845,8 +862,21 @@ class EngineServer:
                 self.wfile.write(data)
 
             def do_GET(self):
+                with server._lock:
+                    server._inflight += 1
+                try:
+                    self._do_get()
+                finally:
+                    with server._lock:
+                        server._inflight -= 1
+
+            def _do_get(self):
                 if self.path in ("/health", "/healthz", "/ping"):
-                    self._send_json({"status": "ok"})
+                    if server._draining:
+                        # readiness gate: the LB must stop routing here
+                        self._send_json({"status": "draining"}, 503)
+                    else:
+                        self._send_json({"status": "ok"})
                 elif self.path == "/metrics":
                     data = server.metrics.render(server.engine).encode()
                     self.send_response(200)
@@ -876,6 +906,15 @@ class EngineServer:
                     self._send_json({"error": {"message": f"not found: {self.path}"}}, 404)
 
             def do_POST(self):
+                with server._lock:
+                    server._inflight += 1
+                try:
+                    self._do_post()
+                finally:
+                    with server._lock:
+                        server._inflight -= 1
+
+            def _do_post(self):
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -906,6 +945,8 @@ class EngineServer:
                         self.wfile.write(frame)
                     else:
                         self._send_json({"error": {"message": f"not found: {self.path}"}}, 404)
+                except Draining as e:
+                    self._send_json({"error": {"message": str(e)}}, 503)
                 except ValueError as e:
                     self._send_json({"error": {"message": str(e)}}, 400)
                 except Exception as e:
@@ -954,11 +995,43 @@ class EngineServer:
         if self._httpd is not None:
             self._httpd.shutdown()
 
+    def drain(self, timeout: float = 120.0) -> bool:
+        """Graceful shutdown: stop ADMITTING (new requests 503) but keep
+        stepping until in-flight work finishes or the deadline passes.
+        Returns True when fully drained — the rolling-update contract the
+        operator's preStop/terminationGracePeriod expects."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = (not self._channels) and self._inflight == 0
+            if idle and not self.engine.has_work():
+                logger.info("drained cleanly")
+                return True
+            time.sleep(0.05)
+        logger.warning("drain deadline passed with work in flight")
+        return False
+
     def serve_forever(self) -> None:
+        import signal
+
         self.start()
+        stop_now = threading.Event()
+
+        def _on_term(signum, frame):
+            logger.info("SIGTERM: draining")
+            stop_now.set()
+
         try:
-            while True:
-                time.sleep(1)
+            signal.signal(signal.SIGTERM, _on_term)
+            logger.info("SIGTERM handler installed (graceful drain)")
+        except ValueError:  # non-main thread (tests)
+            logger.warning("not the main thread; SIGTERM drain disabled")
+        try:
+            while not stop_now.is_set():
+                time.sleep(0.5)
+            self.drain()
         except KeyboardInterrupt:
             pass
         finally:

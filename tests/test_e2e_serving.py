@@ -219,3 +219,64 @@ schedulingProfiles:
         picker = EndpointPicker(config, lambda: [dead, healthy], metrics)
         for _ in range(3):
             assert picker.pick("any prompt").name == "healthy"
+
+
+class TestGracefulDrain:
+    def test_drain_finishes_inflight_and_rejects_new(self):
+        import threading as _threading
+
+        from fusioninfer_tpu.engine.engine import NativeEngine
+        from fusioninfer_tpu.engine.kv_cache import CacheConfig
+        from fusioninfer_tpu.engine.server import EngineServer
+        from fusioninfer_tpu.models.config import get_preset
+
+        eng = NativeEngine(get_preset("qwen3-tiny"),
+                           cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                                 max_pages_per_seq=4),
+                           max_batch_size=2)
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=eng)
+        srv.start()
+        try:
+            result = {}
+
+            def long_request():
+                result["r"] = complete(
+                    f"http://127.0.0.1:{srv.port}", "keep going",
+                    max_tokens=40)
+
+            t = _threading.Thread(target=long_request)
+            t.start()
+            # wait until the request is actually in flight
+            assert wait_for(lambda: srv.engine.has_work(), timeout=30)
+            drain_done = {}
+
+            def drain():
+                drain_done["ok"] = srv.drain(timeout=120)
+
+            d = _threading.Thread(target=drain)
+            d.start()
+            # while draining: health is 503 and new work is refused 503
+            assert wait_for(lambda: srv._draining, timeout=10)
+            import urllib.error
+            import urllib.request
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/health", timeout=10)
+                raise AssertionError("health should 503 while draining")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            try:
+                complete(f"http://127.0.0.1:{srv.port}", "new work",
+                         max_tokens=2)
+                raise AssertionError("new request should 503 while draining")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            t.join(timeout=300)
+            d.join(timeout=300)
+            assert drain_done.get("ok") is True
+            # the in-flight request completed fully
+            assert result["r"]["choices"][0]["finish_reason"] in (
+                "length", "stop")
+        finally:
+            srv.stop()
