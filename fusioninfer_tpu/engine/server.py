@@ -93,6 +93,12 @@ _PUMP_ABORT = object()  # sentinel: a sub-stream ended WITHOUT its None
 _FINGERPRINT = "fp_fusioninfer_tpu"
 
 
+def _piece(tokenizer, token: int) -> str:
+    """A token's text form; ids with no printable form get a unique
+    placeholder so top-logprob maps never collapse distinct tokens."""
+    return tokenizer.decode([token]) or f"<token_{token}>"
+
+
 def _find_stop(text: str, stops) -> int | None:
     """Earliest index where any stop sequence begins, or None."""
     best = None
@@ -451,6 +457,7 @@ class EngineServer:
         generator's own ``finally`` never runs and the request would
         otherwise leak and keep decoding for a dead client."""
         if chat:
+            body = self._chat_logprobs_body(body)
             messages = body.get("messages", [])
             prompt = "".join(
                 f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
@@ -600,8 +607,9 @@ class EngineServer:
             for out in chan.stream():
                 if out is None:  # aborted mid-stream (client gone)
                     return
-                if not (out.finished and out.finish_reason == "stop"
-                        and out.token == self.tokenizer.eos_token_id):
+                counted = not (out.finished and out.finish_reason == "stop"
+                               and out.token == self.tokenizer.eos_token_id)
+                if counted:
                     tokens.append(out.token)
                 full = self.tokenizer.decode(tokens)
                 finish = (out.finish_reason or "length") if out.finished else None
@@ -615,24 +623,36 @@ class EngineServer:
                         while tokens and len(
                                 self.tokenizer.decode(tokens[:-1])) >= hit:
                             tokens.pop()
+                            counted = False  # its text never ships
                         self._cancel_chan(chan)
                     elif not out.finished:
                         full = full[: len(full) - _held_back(full, stops)]
                 delta, emitted = full[emitted:], len(full)
                 if echo_prefix:  # OpenAI echo: prompt leads the stream
                     delta, echo_prefix = echo_prefix + delta, ""
-                lp = None
-                if out.logprob is not None:
-                    tok_piece = (self.tokenizer.decode([out.token])
-                                 or f"<token_{out.token}>")
-                    lp = {"tokens": [tok_piece],
-                          "token_logprobs": [out.logprob],
-                          "top_logprobs": [out.top_logprobs or {}]}
+                # a logprobs entry ships only for tokens whose text is
+                # actually delivered (not the trimmed EOS / stop-cut
+                # tokens) — matching the non-streaming trim exactly
                 if chat:
                     choice = {"index": choice_index, "delta": {"content": delta},
                               "finish_reason": finish}
+                    if out.logprob is not None and counted:
+                        choice["logprobs"] = {"content": [{
+                            "token": _piece(self.tokenizer, out.token),
+                            "logprob": out.logprob,
+                            "top_logprobs": [
+                                {"token": _piece(self.tokenizer, t),
+                                 "logprob": v}
+                                for t, v in (out.top_logprobs or {}).items()
+                            ],
+                        }]}
                     obj = "chat.completion.chunk"
                 else:
+                    lp = None
+                    if out.logprob is not None and counted:
+                        lp = {"tokens": [_piece(self.tokenizer, out.token)],
+                              "token_logprobs": [out.logprob],
+                              "top_logprobs": [out.top_logprobs or {}]}
                     choice = {"index": choice_index, "text": delta,
                               "finish_reason": finish, "logprobs": lp}
                     obj = "text_completion"
@@ -764,16 +784,12 @@ class EngineServer:
                 tokens, token_lps, top_lps = tokens[:-1], token_lps[:-1], top_lps[:-1]
         logprobs_obj = None
         if params.logprobs is not None and tokens:
-            def piece(t: int) -> str:
-                # ids with no text form get a unique placeholder so the
-                # top_logprobs dict never collapses distinct alternatives
-                return self.tokenizer.decode([t]) or f"<token_{t}>"
-
             logprobs_obj = {
-                "tokens": [piece(t) for t in tokens],
+                "tokens": [_piece(self.tokenizer, t) for t in tokens],
                 "token_logprobs": token_lps,
                 "top_logprobs": [
-                    {piece(t): lp for t, lp in tops.items()} if tops else None
+                    {_piece(self.tokenizer, t): lp for t, lp in tops.items()}
+                    if tops else None
                     for tops in top_lps
                 ],
                 "text_offset": [],
@@ -819,6 +835,42 @@ class EngineServer:
             "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
         }
 
+    @staticmethod
+    def _chat_logprobs_body(body: dict) -> dict:
+        """Translate chat's logprobs knobs (``logprobs: bool`` +
+        ``top_logprobs: int``) into the completions form (``logprobs:
+        int``) the shared pipeline consumes."""
+        lp = body.get("logprobs")
+        if lp is True:
+            top = int(body.get("top_logprobs") or 0)
+            if not 0 <= top <= 5:  # this server returns at most 5
+                raise ValueError("top_logprobs must be in [0, 5]")
+            return {**body, "logprobs": top}
+        if lp is False or lp is None:
+            if body.get("top_logprobs") is not None:
+                raise ValueError("top_logprobs requires logprobs: true")
+            return {**body, "logprobs": None}
+        raise ValueError("chat logprobs must be a boolean")
+
+    @staticmethod
+    def _chat_logprobs_obj(lp_obj: dict | None) -> dict | None:
+        """Completions logprobs → chat shape: content[] of
+        {token, logprob, top_logprobs[]} entries."""
+        if lp_obj is None:
+            return None
+        content = []
+        for tok, lp, tops in zip(lp_obj["tokens"], lp_obj["token_logprobs"],
+                                 lp_obj["top_logprobs"]):
+            content.append({
+                "token": tok,
+                "logprob": lp,
+                "top_logprobs": [
+                    {"token": t, "logprob": v}
+                    for t, v in (tops or {}).items()
+                ],
+            })
+        return {"content": content}
+
     def handle_chat(self, body: dict) -> dict:
         messages = body.get("messages", [])
         prompt = "".join(
@@ -827,7 +879,8 @@ class EngineServer:
         # `echo` is a completions-only knob: echoing here would leak the
         # internal chat template into message content
         completion = self.handle_completion(
-            {**body, "prompt": prompt, "echo": False})
+            {**self._chat_logprobs_body(body), "prompt": prompt,
+             "echo": False})
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
@@ -839,6 +892,7 @@ class EngineServer:
                     "index": c["index"],
                     "message": {"role": "assistant", "content": c["text"]},
                     "finish_reason": c["finish_reason"],
+                    "logprobs": self._chat_logprobs_obj(c.get("logprobs")),
                 }
                 for c in completion["choices"]
             ],

@@ -257,3 +257,59 @@ class TestStreamUsage:
             "max_tokens": 3, "temperature": 0.0, "stream": True,
         })
         assert all("usage" not in c for c in chunks)
+
+
+class TestChatLogprobs:
+    def test_chat_logprobs_shape(self, server):
+        r = _post(server, "/v1/chat/completions", {
+            "model": "qwen3-tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "temperature": 0.0,
+            "logprobs": True, "top_logprobs": 2,
+        })
+        lp = r["choices"][0]["logprobs"]
+        assert lp is not None and len(lp["content"]) == 3
+        for entry in lp["content"]:
+            assert isinstance(entry["logprob"], float)
+            assert len(entry["top_logprobs"]) == 2
+            for alt in entry["top_logprobs"]:
+                assert set(alt) == {"token", "logprob"}
+
+    def test_chat_logprobs_false_or_absent(self, server):
+        for extra in ({}, {"logprobs": False}):
+            r = _post(server, "/v1/chat/completions", {
+                "model": "qwen3-tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2, "temperature": 0.0, **extra,
+            })
+            assert r["choices"][0]["logprobs"] is None
+
+    def test_chat_logprobs_validation(self, server):
+        for bad in ({"logprobs": 3}, {"logprobs": True, "top_logprobs": 25}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(server, "/v1/chat/completions", {
+                    "model": "qwen3-tiny",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "max_tokens": 2, **bad,
+                })
+            assert ei.value.code == 400
+
+    def test_chat_streamed_logprobs(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/chat/completions",
+            data=json.dumps({
+                "model": "qwen3-tiny",
+                "messages": [{"role": "user", "content": "stream lp"}],
+                "max_tokens": 3, "temperature": 0.0, "stream": True,
+                "logprobs": True, "top_logprobs": 1,
+            }).encode(),
+            headers={"Content-Type": "application/json"})
+        entries = 0
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for line in r:
+                line = line.strip()
+                if line.startswith(b"data: ") and b"[DONE]" not in line:
+                    c = json.loads(line[6:])["choices"][0]
+                    if c.get("logprobs"):
+                        entries += len(c["logprobs"]["content"])
+        assert entries == 3
