@@ -272,6 +272,21 @@ class TestGracefulDrain:
                 raise AssertionError("new request should 503 while draining")
             except urllib.error.HTTPError as e:
                 assert e.code == 503
+            # embeddings and PD prefill slabs are refused too
+            import json as _json
+            for path, payload in (
+                ("/v1/embeddings", {"input": "x"}),
+                ("/v1/prefill", {"request_id": "r", "prompt_tokens": [1, 2]}),
+            ):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}{path}",
+                    data=_json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                try:
+                    urllib.request.urlopen(req, timeout=30)
+                    raise AssertionError(f"{path} should 503 while draining")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503, path
             t.join(timeout=300)
             d.join(timeout=300)
             assert drain_done.get("ok") is True
