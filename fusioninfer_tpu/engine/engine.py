@@ -605,6 +605,53 @@ class NativeEngine:
                 )
         return outputs
 
+    def fail_all(self, reason: str) -> list[StepOutput]:
+        """Abandon ship for every in-flight request: running, mid-prefill,
+        queued, PD-prefilled, slab, and embedding work all finish with an
+        error so clients get a response instead of hanging on a dead
+        engine.  Pages and slots are released; the engine can accept new
+        work afterwards (a transient failure may have passed)."""
+        outputs: list[StepOutput] = []
+
+        def fail_output(request: Request) -> None:
+            outputs.append(StepOutput(
+                request_id=request.request_id, token=0, finished=True,
+                finish_reason=f"error:{reason}",
+            ))
+
+        for st in list(self.running.values()):
+            self._finish(st, outcome="error")  # slot/pages/counter
+            fail_output(st.request)
+        for st in self.prefilling:
+            self.alloc.release(st.request.request_id)
+            self.errors_total += 1
+            fail_output(st.request)
+        self.prefilling = []
+        with self._lock:
+            while self.waiting:
+                self.errors_total += 1
+                fail_output(self.waiting.pop())
+            while self.waiting_prefilled:
+                request, _ = self.waiting_prefilled.popleft()
+                self.errors_total += 1
+                fail_output(request)
+        err = RuntimeError(reason)
+        while True:
+            try:
+                _, fut = self._slab_q.get_nowait()
+            except queue_mod.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(err)
+        while True:
+            try:
+                _, fut = self._embed_q.get_nowait()
+            except queue_mod.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(err)
+        return outputs
+
     def kv_cache_usage(self) -> float:
         return self.alloc.utilization()
 

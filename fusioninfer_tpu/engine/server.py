@@ -179,16 +179,28 @@ class EngineServer:
 
     def _engine_loop(self) -> None:
         idle_sleep = 0.002
+        consecutive_failures = 0
         while not self._stop.is_set():
             if not self.engine.has_work():
-                time.sleep(idle_sleep)
+                consecutive_failures = 0  # an old incident must not
+                time.sleep(idle_sleep)    # shorten a NEW request's window
                 continue
             try:
                 outputs = self.engine.step()
-            except Exception:
-                logger.exception("engine step failed")
-                time.sleep(0.05)
-                continue
+                consecutive_failures = 0
+            except Exception as e:
+                consecutive_failures += 1
+                logger.exception("engine step failed (%d consecutive)",
+                                 consecutive_failures)
+                if consecutive_failures >= 3:
+                    # a persistent failure must not leave clients hanging
+                    # on channels forever: fail everything in flight
+                    outputs = self.engine.fail_all(
+                        f"engine step failing persistently: {e}")
+                    consecutive_failures = 0
+                else:
+                    time.sleep(0.05)
+                    continue
             now = time.monotonic()
             for out in outputs:
                 with self._lock:
@@ -607,8 +619,10 @@ class EngineServer:
             for out in chan.stream():
                 if out is None:  # aborted mid-stream (client gone)
                     return
-                counted = not (out.finished and out.finish_reason == "stop"
-                               and out.token == self.tokenizer.eos_token_id)
+                is_error = (out.finish_reason or "").startswith("error")
+                counted = not is_error and not (
+                    out.finished and out.finish_reason == "stop"
+                    and out.token == self.tokenizer.eos_token_id)
                 if counted:
                     tokens.append(out.token)
                 full = self.tokenizer.decode(tokens)
@@ -756,6 +770,9 @@ class EngineServer:
             for out in chan.stream():
                 if out is None:  # aborted (server shutdown / client gone)
                     break
+                if (out.finish_reason or "").startswith("error"):
+                    finish_reason = out.finish_reason
+                    break  # placeholder token must not join the text
                 tokens.append(out.token)
                 token_lps.append(out.logprob)
                 top_lps.append(out.top_logprobs or {})

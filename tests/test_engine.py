@@ -794,3 +794,82 @@ class TestMinPAndStopIds:
                 assert ei.value.code == 400, bad
         finally:
             srv.stop()
+
+
+class TestEngineFailureRecovery:
+    def test_fail_all_releases_everything(self):
+        from fusioninfer_tpu.models.config import get_preset
+
+        engine = NativeEngine(get_preset("qwen3-tiny"),
+                              cache_cfg=CacheConfig(n_pages=65, page_size=16,
+                                                    max_pages_per_seq=16),
+                              max_batch_size=2, prefill_chunk_size=16)
+        free0 = engine.alloc.free_pages
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        # one running, one mid-chunked-prefill, one queued
+        engine.add_request(Request("run", [1, 2, 3],
+                                   SamplingParams(max_tokens=20)))
+        engine.step()
+        engine.add_request(Request(
+            "prefilling", rng.integers(1, 1000, 100).tolist(),
+            SamplingParams(max_tokens=4)))
+        engine.add_request(Request("queued", [4, 5],
+                                   SamplingParams(max_tokens=4)))
+        engine.step()
+        assert engine.num_running and engine.num_prefilling
+        outs = engine.fail_all("boom")
+        ids = {o.request_id for o in outs}
+        assert ids == {"run", "prefilling", "queued"}
+        assert all(o.finished and o.finish_reason.startswith("error:")
+                   for o in outs)
+        assert not engine.has_work()
+        assert engine.alloc.free_pages == free0
+        # the engine still accepts and serves new work afterwards
+        engine.add_request(Request("after", [7, 8],
+                                   SamplingParams(max_tokens=2)))
+        toks = []
+        while engine.has_work():
+            toks += [o for o in engine.step() if o.request_id == "after"]
+        assert len(toks) == 2
+
+    def test_server_fails_clients_after_persistent_step_errors(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from fusioninfer_tpu.engine.server import EngineServer
+        from fusioninfer_tpu.models.config import get_preset
+
+        eng = NativeEngine(get_preset("qwen3-tiny"),
+                           cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                                 max_pages_per_seq=4),
+                           max_batch_size=2)
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=eng)
+        orig_step = eng.step
+        state = {"boom": True}
+
+        def flaky_step():
+            if state["boom"] and eng.has_work():
+                raise RuntimeError("injected persistent failure")
+            return orig_step()
+
+        eng.step = flaky_step
+        srv.start()
+        try:
+            body = json.dumps({"model": "qwen3-tiny", "prompt": "x",
+                               "max_tokens": 4}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            # the request must come back as an error, not hang forever
+            r = json.loads(urllib.request.urlopen(req, timeout=120).read())
+            assert r["choices"][0]["finish_reason"].startswith("error:")
+            # recovery: later requests succeed once the failure clears
+            state["boom"] = False
+            r2 = json.loads(urllib.request.urlopen(req, timeout=120).read())
+            assert r2["choices"][0]["finish_reason"] in ("length", "stop")
+        finally:
+            srv.stop()
