@@ -636,20 +636,15 @@ class NativeEngine:
                 self.errors_total += 1
                 fail_output(request)
         err = RuntimeError(reason)
-        while True:
-            try:
-                _, fut = self._slab_q.get_nowait()
-            except queue_mod.Empty:
-                break
-            if not fut.done():
-                fut.set_exception(err)
-        while True:
-            try:
-                _, fut = self._embed_q.get_nowait()
-            except queue_mod.Empty:
-                break
-            if not fut.done():
-                fut.set_exception(err)
+        for q in (self._slab_q, self._embed_q):
+            while True:
+                try:
+                    _, fut = q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                self.errors_total += 1
+                if not fut.done():
+                    fut.set_exception(err)
         return outputs
 
     def kv_cache_usage(self) -> float:

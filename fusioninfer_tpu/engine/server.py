@@ -23,7 +23,7 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.engine import NativeEngine, Request, StepOutput
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
 from fusioninfer_tpu.engine.metrics import EngineMetrics
 from fusioninfer_tpu.engine.sampler import SamplingParams
@@ -197,6 +197,18 @@ class EngineServer:
                     # on channels forever: fail everything in flight
                     outputs = self.engine.fail_all(
                         f"engine step failing persistently: {e}")
+                    # a request FINISHED inside the raising step is in no
+                    # engine structure but its output was lost with the
+                    # exception — cover every still-registered channel
+                    covered = {o.request_id for o in outputs}
+                    with self._lock:
+                        leftovers = [rid for rid in self._channels
+                                     if rid not in covered]
+                    for rid in leftovers:
+                        outputs.append(StepOutput(
+                            request_id=rid, token=0, finished=True,
+                            finish_reason=f"error:engine step failing "
+                                          f"persistently: {e}"))
                     consecutive_failures = 0
                 else:
                     time.sleep(0.05)

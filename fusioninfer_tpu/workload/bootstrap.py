@@ -63,6 +63,14 @@ def _add_tcp_readiness(container: dict, port: int) -> None:
     )
 
 
+def _add_http_readiness(container: dict, port: int, path: str) -> None:
+    container.setdefault(
+        "readinessProbe",
+        {"httpGet": {"path": path, "port": port},
+         "initialDelaySeconds": 5, "periodSeconds": 10},
+    )
+
+
 def _add_env(container: dict, name: str, value: str | None = None, field_path: str | None = None) -> None:
     env = container.setdefault("env", [])
     if any(e.get("name") == name for e in env):
@@ -141,6 +149,50 @@ class JaxCoordinatorBootstrap(BootstrapStrategy):
         return self._common(container, size)
 
 
+def _serving_port(container: dict) -> int:
+    """The engine's HTTP port: honor an explicit ``--port`` in the
+    container args, else the conventional 8000 (the InferencePool
+    targetPort)."""
+    args = container.get("args") or []
+    for i, a in enumerate(args):
+        if not isinstance(a, str):
+            continue
+        if a == "--port" and i + 1 < len(args):
+            try:
+                return int(args[i + 1])
+            except (TypeError, ValueError):
+                return 8000
+        if a.startswith("--port="):
+            try:
+                return int(a.split("=", 1)[1])
+            except ValueError:
+                return 8000
+    return 8000
+
+
+class NativeBootstrap(JaxCoordinatorBootstrap):
+    """The in-repo engine: same JAX-coordinator bootstrap, but leaders
+    get an HTTP readiness probe on the serving port — the engine's
+    ``/health`` goes 503 while DRAINING (graceful shutdown), so the
+    routing layer stops sending traffic before the pod terminates; a TCP
+    probe would keep it Ready to the last moment."""
+
+    def wrap_leader(self, container: dict, size: int) -> dict:
+        container = self._common(container, size)
+        _add_port(container, "jax-coord", JAX_COORDINATOR_PORT)
+        _add_http_readiness(container, _serving_port(container), "/health")
+        return container
+
+
+def native_single_host(container: dict) -> dict:
+    """Single-host native pods skip the multi-host wrap but still want
+    the drain-aware readiness probe (/health 503s while draining).
+    Mutates in place — the caller's pod spec is already a private copy
+    (``_base_pod_spec`` deep-copies the user template)."""
+    _add_http_readiness(container, _serving_port(container), "/health")
+    return container
+
+
 class NoopBootstrap(BootstrapStrategy):
     """EngineKind.CUSTOM: the user's template is authoritative."""
 
@@ -148,7 +200,7 @@ class NoopBootstrap(BootstrapStrategy):
 _STRATEGIES: dict[EngineKind, BootstrapStrategy] = {
     EngineKind.VLLM_TPU: RayBootstrap(),
     EngineKind.JETSTREAM: JaxCoordinatorBootstrap(),
-    EngineKind.NATIVE: JaxCoordinatorBootstrap(),
+    EngineKind.NATIVE: NativeBootstrap(),
     EngineKind.CUSTOM: NoopBootstrap(),
 }
 

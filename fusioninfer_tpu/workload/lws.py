@@ -20,7 +20,7 @@ from fusioninfer_tpu.api.types import EngineKind, Role
 from fusioninfer_tpu.api.topology import SliceShape, TPU_RESOURCE
 from fusioninfer_tpu.utils.hash import stamp_spec_hash
 from fusioninfer_tpu.utils.names import truncate_name
-from fusioninfer_tpu.workload.bootstrap import bootstrap_for
+from fusioninfer_tpu.workload.bootstrap import bootstrap_for, native_single_host
 from fusioninfer_tpu.workload.labels import (
     ANNOTATION_POD_GROUP,
     ANNOTATION_TASK_SPEC,
@@ -111,7 +111,12 @@ def build_lws(role: Role, cfg: LWSConfig) -> dict:
         leader_worker_template["leaderTemplate"] = _pod_template(role, cfg, leader_spec)
         leader_worker_template["workerTemplate"] = _pod_template(role, cfg, worker_spec)
     else:
-        leader_worker_template["workerTemplate"] = _pod_template(role, cfg, _base_pod_spec(role, cfg))
+        spec = _base_pod_spec(role, cfg)
+        if role.engine == EngineKind.NATIVE:
+            c = _engine_container(spec)
+            if c is not None:
+                spec["containers"][0] = native_single_host(c)
+        leader_worker_template["workerTemplate"] = _pod_template(role, cfg, spec)
 
     lws = {
         "apiVersion": LWS_API_VERSION,

@@ -140,7 +140,10 @@ class TestMultiHostJax:
             env["JAX_PROCESS_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
             == "metadata.labels['leaderworkerset.sigs.k8s.io/worker-index']"
         )
-        assert leader["readinessProbe"]["tcpSocket"]["port"] == JAX_COORDINATOR_PORT
+        # native leaders gate readiness on the serving /health endpoint,
+        # which goes 503 while draining — not a bare TCP check
+        assert leader["readinessProbe"]["httpGet"] == {
+            "path": "/health", "port": 8000}
         assert worker["env"] == leader["env"]
         assert "readinessProbe" not in worker
 
@@ -185,3 +188,27 @@ def test_build_is_deterministic_and_input_preserving():
     b = build_lws(role, CFG)
     assert a == b
     assert role.template == before  # builder must not mutate the user template
+
+
+def test_native_single_host_gets_drain_probe():
+    """A 1-host native worker still gates readiness on /health, which
+    the engine 503s while draining."""
+    role = make_role(engine=EngineKind.NATIVE,
+                     tpu=TPUSlice(type="v5e", topology="1x1"))  # one host
+    lws = build_lws(role, CFG)
+    tmpl = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]
+    c = tmpl["spec"]["containers"][0]
+    assert c["readinessProbe"]["httpGet"] == {"path": "/health", "port": 8000}
+
+
+def test_native_probe_honors_custom_port():
+    role = make_role(
+        engine=EngineKind.NATIVE,
+        tpu=TPUSlice(type="v5e", topology="1x1"),
+        template={"spec": {"containers": [{
+            "name": "engine", "image": "fusioninfer-tpu",
+            "args": ["engine", "serve", "qwen3-8b", "--port", "9000"]}]}},
+    )
+    lws = build_lws(role, CFG)
+    c = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]["containers"][0]
+    assert c["readinessProbe"]["httpGet"]["port"] == 9000
