@@ -203,3 +203,70 @@ class TestEngineInt8KV:
         with pytest.raises(ValueError, match="int8"):
             NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=2,
                          mesh=mesh)
+
+
+class TestInt8WithSlidingWindow:
+    def test_windowed_quantized_decode_kernel(self):
+        """Banding and scale folding compose: the page loop starts at the
+        window's first live page AND streams int8 scale rows from the
+        same offset."""
+        from fusioninfer_tpu.models.quantization import kv_quantize
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_decode_attention,
+            reference_paged_attention,
+        )
+
+        B, H, KV, Hd, ps, n_pages, mp = 4, 4, 2, 64, 16, 33, 8
+        ks = jax.random.split(jax.random.key(13), 3)
+        q = jax.random.normal(ks[0], (B, H, Hd), jnp.float32)
+        kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.float32)
+        vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), jnp.float32)
+        k8, ksc = kv_quantize(kp)
+        v8, vsc = kv_quantize(vp)
+        rng = np.random.default_rng(13)
+        tables = rng.permutation(n_pages - 1)[: B * mp].reshape(B, mp).astype(np.int32)
+        lengths = np.asarray([5, 40, 100, 0], np.int32)
+        out = paged_decode_attention(
+            q, k8, v8, jnp.asarray(tables), jnp.asarray(lengths),
+            ksc[:, :, None, :], vsc[:, :, None, :],
+            window=24, interpret=True)
+        kd = k8.astype(jnp.float32) * ksc[..., None]
+        vd = v8.astype(jnp.float32) * vsc[..., None]
+        ref = reference_paged_attention(
+            q, kd, vd, jnp.asarray(tables), jnp.asarray(lengths), window=24)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-4, rtol=3e-4)
+
+    def test_mistral_engine_with_int8_kv(self):
+        """mistral-tiny serves end-to-end with quantized pages + window
+        reclamation; greedy tokens match the bf16-page engine."""
+        mistral = dataclasses.replace(get_preset("mistral-tiny"),
+                                      dtype="float32")
+
+        def run(kv_dtype):
+            eng = NativeEngine(
+                mistral,
+                cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                      max_pages_per_seq=8,
+                                      kv_dtype=kv_dtype),
+                max_batch_size=2, seed=0)
+            rng = np.random.default_rng(17)
+            eng.add_request(Request(
+                request_id="r",
+                prompt_tokens=rng.integers(1, mistral.vocab_size, 50).tolist(),
+                params=SamplingParams(max_tokens=8, temperature=0.0)))
+            toks = []
+            for _ in range(40):
+                if not eng.has_work():
+                    break
+                for o in eng.step():
+                    assert not (o.finish_reason or "").startswith("error"), o
+                    toks.append(o.token)
+            assert not eng.has_work()
+            return toks
+
+        a, b = run("int8"), run("model")
+        assert len(a) == 8 and len(b) == 8
+        # int8 KV is a quantization of the same math: identical greedy
+        # tokens on this short horizon (noise rarely flips early argmax)
+        assert a == b
