@@ -980,6 +980,10 @@ class EngineServer:
                                     "id": name,
                                     "object": "model",
                                     "owned_by": "fusioninfer-tpu",
+                                    # vLLM-style capacity metadata:
+                                    # routers/clients size prompts by it
+                                    "max_model_len":
+                                        server.engine.cache_cfg.max_len,
                                 }
                                 for name in models
                             ],

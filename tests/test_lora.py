@@ -207,8 +207,9 @@ class TestServerRouting:
         try:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{srv.port}/v1/models", timeout=30) as r:
-                ids = {m["id"] for m in json.loads(r.read())["data"]}
-            assert ids == {"base", "ft"}
+                data = json.loads(r.read())["data"]
+            assert {m["id"] for m in data} == {"base", "ft"}
+            assert all(m["max_model_len"] == CACHE.max_len for m in data)
 
             def tokens(model):
                 body = json.dumps({"model": model, "prompt": "hello world!",
