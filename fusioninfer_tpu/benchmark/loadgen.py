@@ -117,9 +117,9 @@ def _classify(exc: Exception) -> str:
 
 def _one_request(
     base_url: str, prompt_len: int, output_len: int, result: LoadResult,
-    lock: threading.Lock, timeout: float, seed: int,
+    lock: threading.Lock, timeout: float, seed: int, prefix: str = "",
 ) -> None:
-    prompt = random_prompt(prompt_len, seed)
+    prompt = prefix + random_prompt(prompt_len, seed)
     body = json.dumps({
         "prompt": prompt,
         "max_tokens": output_len,
@@ -156,7 +156,7 @@ def _one_request(
         if ttft is not None:
             result.ttft_s.append(ttft)
         result.output_tokens += n_chunks
-        result.prompt_tokens += prompt_len
+        result.prompt_tokens += len(prompt)  # byte tokenizer: 1 char = 1 token
 
 
 def scrape_prefix_hit_rate(base_url: str, timeout: float = 10.0) -> float | None:
@@ -182,14 +182,27 @@ def run_http_load(
     median_output: int = 64,
     max_prompt: int = 1024,
     max_output: int = 256,
+    shared_prefix_len: int = 0,
 ) -> LoadResult:
     """Closed-loop load: ``concurrency`` worker threads drain a shared
-    queue of ShareGPT-style requests against a running server."""
+    queue of ShareGPT-style requests against a running server.
+
+    ``shared_prefix_len`` > 0 prepends the SAME ``shared_prefix_len``-token
+    prefix to every request — the prefix-cache-hit mix (system-prompt
+    style traffic), reported via ``shared_prefix_len`` in the summary so
+    a cache-skewed TTFT is always labeled as such."""
     pairs = sharegpt_lengths(
         n_requests, seed, median_prompt=median_prompt,
-        median_output=median_output, max_prompt=max_prompt,
+        median_output=median_output,
+        # max_prompt caps the TOTAL prompt: the shared prefix eats into
+        # the unique-suffix budget, not past the engine's context cap
+        max_prompt=max(4, max_prompt - shared_prefix_len),
         max_output=max_output,
     )
+    # prefix seed offset far past any per-request seed (seed + i), and
+    # non-negative even for seed=0 (default_rng rejects negatives)
+    prefix = (random_prompt(shared_prefix_len, seed + 10**9)
+              if shared_prefix_len else "")
     result = LoadResult(n_requests=n_requests, n_ok=0, duration_s=0.0)
     lock = threading.Lock()
     it = iter(enumerate(pairs))
@@ -202,7 +215,8 @@ def run_http_load(
             if nxt is None:
                 return
             i, (p_len, o_len) = nxt
-            _one_request(base_url, p_len, o_len, result, lock, timeout, seed + i)
+            _one_request(base_url, p_len, o_len, result, lock, timeout,
+                         seed + i, prefix)
 
     threads = [threading.Thread(target=worker, daemon=True) for _ in range(concurrency)]
     t0 = time.perf_counter()

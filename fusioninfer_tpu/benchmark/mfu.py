@@ -24,15 +24,34 @@ TPU_PEAK_FLOPS: dict[str, float] = {
 }
 
 
+# The tunneled single-chip environment (axon PJRT plugin) may report a
+# proxied device_kind that isn't a literal "TPU vX" string; the TPU
+# generation is then named by env instead.
+_GEN_TO_KIND = {"v4": "TPU v4", "v5e": "TPU v5e", "v5p": "TPU v5p",
+                "v6e": "TPU v6e"}
+
+
 def peak_flops(device_kind: str) -> float | None:
     """Best-effort peak lookup; longest matching key wins (``TPU v5
-    lite`` must not match the ``TPU v5`` = v5p entry)."""
+    lite`` must not match the ``TPU v5`` = v5p entry).  Falls back to
+    the ``PALLAS_AXON_TPU_GEN`` env generation when the reported kind
+    is unrecognized."""
     best = None
     for kind, peak in TPU_PEAK_FLOPS.items():
         if device_kind.startswith(kind):
             if best is None or len(kind) > len(best[0]):
                 best = (kind, peak)
-    return best[1] if best else None
+    if best:
+        return best[1]
+    if "cpu" in device_kind.lower():
+        # a CPU fallback run must never borrow the TPU gen's peak and
+        # emit a bogus (tiny) MFU labeled as utilization
+        return None
+    import os
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    mapped = _GEN_TO_KIND.get(gen)
+    return TPU_PEAK_FLOPS[mapped] if mapped else None
 
 
 def decode_flops_per_token(cfg: ModelConfig, ctx_len: int) -> float:

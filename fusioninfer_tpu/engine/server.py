@@ -399,6 +399,15 @@ class EngineServer:
         if not isinstance(extra_stop, list) or any(
                 not isinstance(t, int) for t in extra_stop):
             raise ValueError("stop_token_ids must be a list of token ids")
+        for t in extra_stop:
+            if not 0 <= t < self.engine.cfg.vocab_size:
+                # JAX wraps negative indices — an out-of-range stop id
+                # would reach the min_tokens stop-suppress scatter and
+                # silently suppress an unrelated token
+                raise ValueError(
+                    f"stop_token_ids entry {t} outside vocab "
+                    f"[0, {self.engine.cfg.vocab_size})"
+                )
         stop_ids += extra_stop
         seed = body.get("seed")
         stop = body.get("stop") or ()
@@ -828,6 +837,11 @@ class EngineServer:
     def handle_embeddings(self, body: dict) -> dict:
         """OpenAI /v1/embeddings: last-real-token pooled, L2-normalized
         sequence embeddings from the serving model's final hidden states."""
+        with self._lock:
+            # same lock drain() flips the flag under (mirrors submit()):
+            # a request racing drain() must not slip past the admission gate
+            if self._draining:
+                raise Draining("server is draining; retry another replica")
         raw = body.get("input")
         if isinstance(raw, str):
             inputs = [raw]
@@ -839,8 +853,6 @@ class EngineServer:
             raise ValueError("input must be a non-empty string or list of them")
         if len(inputs) > 64:
             raise ValueError("at most 64 inputs per request")
-        if self._draining:
-            raise Draining("server is draining; retry another replica")
         if self._lora_of(body):  # validates the name too
             raise ValueError("embeddings through LoRA adapters are not supported")
         token_lists = [self.tokenizer.encode(x) for x in inputs]

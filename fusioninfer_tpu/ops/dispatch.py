@@ -21,12 +21,32 @@ import os
 import jax
 
 
+def is_tpu_backend() -> bool:
+    """True when compute lands on a real TPU.  The tunneled single-chip
+    environment registers its PJRT plugin under the name ``axon`` —
+    ``jax.default_backend()`` says "axon" there even though the device
+    is a TPU (Mosaic lowering rules are aliased to the axon platform by
+    the plugin's registration hook), so the plugin registry name alone
+    must not gate kernel selection."""
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return True
+    if backend == "axon":
+        if os.environ.get("PALLAS_AXON_TPU_GEN", ""):
+            return True
+        try:
+            return "tpu" in (jax.devices()[0].device_kind or "").lower()
+        except Exception:  # noqa: BLE001 - never raise from a gate
+            return False
+    return False
+
+
 def resolve_attn(cfg_impl: str = "auto") -> str:
     impl = cfg_impl
     if impl == "auto":
         impl = os.environ.get("FUSIONINFER_ATTN", "") or "auto"
     if impl == "auto":
-        return "flash" if jax.default_backend() == "tpu" else "reference"
+        return "flash" if is_tpu_backend() else "reference"
     if impl not in ("flash", "reference"):
         raise ValueError(f"unknown attention impl {impl!r}")
     return impl
@@ -34,7 +54,7 @@ def resolve_attn(cfg_impl: str = "auto") -> str:
 
 def kernel_interpret() -> bool:
     """Pallas kernels interpret-execute off-TPU (CPU tests of the kernel path)."""
-    return jax.default_backend() != "tpu"
+    return not is_tpu_backend()
 
 
 def flash_seq_ok(seq_len: int) -> bool:

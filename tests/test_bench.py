@@ -1,0 +1,136 @@
+"""Unit tests for bench.py's chip-acquisition + longitudinal machinery.
+
+VERDICT r3 asks #1 and #7: the probe must capture diagnostics that can
+distinguish environment fault from builder fault, clean stale libtpu
+lockfiles, and the record must compare against prior rounds
+(``vs_prev``) and the first TPU record (``vs_baseline``).  These are
+pure-host helpers — no backend is initialized here.
+"""
+
+import fcntl
+import json
+import os
+
+import bench
+
+
+class TestLockfileInspection:
+    def test_stale_lockfile_removed(self, tmp_path):
+        lock = tmp_path / "libtpu_lockfile"
+        lock.write_text("")
+        out = bench.inspect_lockfiles((str(lock),))
+        info = out[str(lock)]
+        assert info["holder_pids"] == []
+        assert info["removed_stale"] is True
+        assert not lock.exists()
+
+    def test_held_lockfile_reports_pid_and_survives(self, tmp_path):
+        lock = tmp_path / "libtpu_lockfile"
+        lock.write_text("")
+        with open(lock) as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                out = bench.inspect_lockfiles((str(lock),))
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+        info = out[str(lock)]
+        assert os.getpid() in info["holder_pids"]
+        assert "removed_stale" not in info
+        assert lock.exists()
+
+    def test_no_lockfiles_is_clean(self, tmp_path):
+        out = bench.inspect_lockfiles((str(tmp_path / "nope"),))
+        assert out[str(tmp_path / "nope")]["holder_pids"] == []
+
+
+class TestEnvDiagnostics:
+    def test_keys_present(self):
+        d = bench.env_diagnostics()
+        assert "libtpu_version" in d
+        assert "device_files" in d
+        assert "lockfiles" in d
+        assert isinstance(d["env"], dict)
+
+    def test_env_filter_only_accelerator_vars(self, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_TEST_VAR", "1")
+        monkeypatch.setenv("HOME_FAKE_TEST_VAR", "1")
+        d = bench.env_diagnostics()
+        assert "TPU_FAKE_TEST_VAR" in d["env"]
+        assert "HOME_FAKE_TEST_VAR" not in d["env"]
+
+
+def _write_round(tmp_path, n, rec, wrapped=True):
+    body = {"n": n, "parsed": rec} if wrapped else rec
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(body))
+
+
+class TestLongitudinal:
+    def test_vs_prev_same_metric(self, tmp_path):
+        _write_round(tmp_path, 1, {"metric": "m", "value": 100.0, "backend": "cpu"})
+        _write_round(tmp_path, 2, {"metric": "m", "value": 200.0, "backend": "cpu"})
+        record = {"metric": "m", "value": 300.0, "vs_baseline": 1.0}
+        bench.longitudinal(record, tmp_path)
+        assert record["vs_prev"] == 1.5
+        assert record["prev"]["file"] == "BENCH_r02.json"
+        # no TPU record yet: vs_baseline untouched
+        assert record["vs_baseline"] == 1.0
+        assert "baseline_ref" not in record
+
+    def test_vs_baseline_first_tpu_record(self, tmp_path):
+        _write_round(tmp_path, 1, {"metric": "m", "value": 100.0, "backend": "cpu"})
+        _write_round(tmp_path, 2, {"metric": "m", "value": 1000.0, "backend": "tpu"})
+        _write_round(tmp_path, 3, {"metric": "m", "value": 1500.0, "backend": "tpu"})
+        record = {"metric": "m", "value": 2000.0, "vs_baseline": 1.0}
+        bench.longitudinal(record, tmp_path)
+        # baseline = FIRST tpu record (r02), prev = latest (r03)
+        assert record["vs_baseline"] == 2.0
+        assert record["baseline_ref"]["file"] == "BENCH_r02.json"
+        assert record["vs_prev"] == round(2000.0 / 1500.0, 3)
+
+    def test_metric_mismatch_labels_but_never_divides(self, tmp_path):
+        """A CPU-fallback round must not rebase a TPU series: differing
+        metric names record provenance but no ratio."""
+        _write_round(tmp_path, 1, {"metric": "tpu_m", "value": 5000.0,
+                                   "backend": "tpu"})
+        record = {"metric": "cpu_m", "value": 1000.0, "vs_baseline": 1.0}
+        bench.longitudinal(record, tmp_path)
+        assert "vs_prev" not in record
+        assert record["vs_baseline"] == 1.0
+        assert record["prev"]["metric"] == "tpu_m"
+        assert record["baseline_ref"]["metric"] == "tpu_m"
+
+    def test_unwrapped_and_corrupt_records_tolerated(self, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text("{not json")
+        _write_round(tmp_path, 2, {"metric": "m", "value": 10.0,
+                                   "backend": "cpu"}, wrapped=False)
+        record = {"metric": "m", "value": 20.0, "vs_baseline": 1.0}
+        bench.longitudinal(record, tmp_path)
+        assert record["vs_prev"] == 2.0
+
+    def test_no_priors_no_fields(self, tmp_path):
+        record = {"metric": "m", "value": 20.0, "vs_baseline": 1.0}
+        bench.longitudinal(record, tmp_path)
+        assert "prev" not in record and "vs_prev" not in record
+
+
+class TestSharedPrefixLoadgen:
+    def test_prefix_deterministic_and_shared(self):
+        from fusioninfer_tpu.benchmark.loadgen import random_prompt
+
+        a = random_prompt(96, 7)
+        b = random_prompt(96, 7)
+        assert a == b and len(a) == 96
+        assert random_prompt(96, 8) != a
+
+    def test_real_record_files_parse(self):
+        """The repo's own BENCH_r*.json history must stay consumable by
+        longitudinal() — guards the record format against drift."""
+        import pathlib
+
+        here = pathlib.Path(bench.__file__).resolve().parent
+        if not list(here.glob("BENCH_r*.json")):
+            return
+        record = {"metric": "decode_throughput_tiny_cpu", "value": 1.0,
+                  "vs_baseline": 1.0}
+        bench.longitudinal(record, here)
+        assert "prev" in record

@@ -28,7 +28,10 @@ if os.environ.get("FUSIONINFER_TEST_TPU", "") == "1":
     import jax.numpy as jnp
     import numpy as np
 
-    if jax.default_backend() != "tpu":  # pragma: no cover
+    # the tunneled chip's PJRT plugin registers under the name "axon";
+    # default_backend() is "axon" there even though the device is a TPU
+    _backend = jax.default_backend()
+    if _backend not in ("tpu", "axon"):  # pragma: no cover
         pytestmark = pytest.mark.skip(reason="FUSIONINFER_TEST_TPU=1 but no TPU backend")
 
 
@@ -166,6 +169,40 @@ class TestPagedVerifyAttentionHW:
         got = np.asarray(out, np.float32).copy()
         for b in range(B):
             got[b, counts[b]:] = 0.0  # padding rows unspecified
+        np.testing.assert_allclose(
+            got, np.asarray(ref, np.float32), atol=5e-2, rtol=5e-2,
+        )
+
+    def test_verify_window_non_lane_multiple_c5(self):
+        """C=5 (the dryrun's --speculative-ngram k=4 → k+1 window): a
+        q-tile whose second-minor dim is NOT a multiple of 8.  Mosaic
+        layout rejections at such shapes must surface here, not in
+        production (ADVICE r3)."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_verify_attention,
+            reference_paged_verify_attention,
+        )
+
+        B, C, H, KV, Hd, ps, n_pages, mp = 8, 5, 16, 8, 128, 128, 257, 8
+        ks = jax.random.split(jax.random.key(9), 3)
+        q = jax.random.normal(ks[0], (B, C, H, Hd), jnp.bfloat16)
+        kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        rng = np.random.default_rng(9)
+        tables = rng.permutation(n_pages - 1)[: B * mp].reshape(B, mp).astype(np.int32)
+        starts = np.asarray([0, 17, 127, 129, 500, 900, 1, 1018], np.int32)
+        counts = np.asarray([5, 3, 1, 0, 5, 2, 4, 5], np.int32)
+        out = paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts), interpret=False,
+        )
+        out.block_until_ready()
+        ref = reference_paged_verify_attention(
+            q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(counts))
+        got = np.asarray(out, np.float32).copy()
+        for b in range(B):
+            got[b, counts[b]:] = 0.0
         np.testing.assert_allclose(
             got, np.asarray(ref, np.float32), atol=5e-2, rtol=5e-2,
         )
