@@ -224,27 +224,12 @@ class NativeEngine:
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
         self.mesh = mesh
-        if self.cache_cfg.quantized and mesh is not None:
-            # the TP kernel wrappers and KV sharding rules cover the bf16
-            # page layout; int8 pages are the single-chip capacity story
-            raise ValueError(
-                "kv_dtype=int8 is single-device serving; use bf16 KV "
-                "pages with tensor parallelism"
-            )
         self.lora_set = None
         if lora_adapters:
             from fusioninfer_tpu.models.lora import AdapterSet
 
             self.lora_set = AdapterSet(self.cfg, lora_adapters)
         self._kernel_mesh = None
-        if cfg.quantization != "none" and mesh is not None:
-            # the sharding rules map named bf16 leaves; they don't know the
-            # quantized {_q8, _scale} structure yet — int8 is the 1-chip
-            # fit story (BASELINE config 2), TP shards bf16
-            raise ValueError(
-                f"quantization={cfg.quantization!r} is single-device serving; "
-                "use tp over bf16 weights for multi-chip"
-            )
         if mesh is not None:
             from fusioninfer_tpu.ops import dispatch
             from fusioninfer_tpu.ops.sharded import tp_compatible
@@ -266,9 +251,19 @@ class NativeEngine:
                     f"tp={tp} must divide n_kv_heads={cfg.n_kv_heads} to shard the KV cache"
                 )
             if params is None:
+                # sharded_init is quantization-aware: int8 configs build
+                # the quantized tree under the init jit, bf16
+                # intermediates only ever exist shard-local
                 logger.info("initializing sharded weights for %s over %s", cfg.name, mesh)
                 params = psharding.sharded_init(cfg, mesh, jax.random.key(seed))
             else:
+                if cfg.quantization == "int8":
+                    # provided params: quantize (idempotent — loader
+                    # output is already int8) before sharding so the
+                    # scale-aware specs see the quantized structure
+                    from fusioninfer_tpu.models.quantization import quantize_params
+
+                    params = quantize_params(cfg, params)
                 params = psharding.shard_params(cfg, mesh, params)
             kv_sharding = jax.sharding.NamedSharding(mesh, psharding.kv_cache_spec())
             self.cache = jax.device_put(init_kv_cache(cfg, self.cache_cfg), kv_sharding)
@@ -460,12 +455,8 @@ class NativeEngine:
     def request_prefill_slab(self, request: Request) -> concurrent.futures.Future:
         """Prefill-worker side: queue a prefill whose KV leaves as a slab.
         Served inside :meth:`step` (engine thread owns the cache); resolves
-        to a :class:`fusioninfer_tpu.engine.kv_transfer.KVSlab`."""
-        if self.cache_cfg.quantized:
-            raise ValueError(
-                "the PD KV-slab wire carries bf16 pages; kv_dtype=int8 "
-                "is not yet supported on PD roles"
-            )
+        to a :class:`fusioninfer_tpu.engine.kv_transfer.KVSlab` — int8
+        caches emit int8 slabs (scales ride the wire)."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._slab_q.put((request, fut))
         return fut
@@ -487,11 +478,6 @@ class NativeEngine:
             raise ValueError(
                 "guided JSON is not yet supported on the "
                 "PD-disaggregated prefill wire"
-            )
-        if self.cache_cfg.quantized:
-            raise ValueError(
-                "the PD KV-slab wire carries bf16 pages; kv_dtype=int8 "
-                "is not yet supported on PD roles"
             )
         if slab.page_size != self.cache_cfg.page_size:
             raise ValueError(

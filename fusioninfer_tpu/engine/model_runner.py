@@ -236,6 +236,7 @@ def prefill_suffix(
 
                 attn = paged_prefill_attention_tp(
                     mesh, q[0], k_cache_l, v_cache_l, page_row, start, true_len,
+                    ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )[None]  # [1, C, H*Hd]
@@ -340,6 +341,7 @@ def decode_step(
 
                 attn = paged_decode_attention_tp(
                     mesh, q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
+                    ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )[:, None, :]
@@ -464,6 +466,7 @@ def verify_step(
 
                 attn = paged_verify_attention_tp(
                     mesh, q, k_cache_l, v_cache_l, page_tables, starts, counts,
+                    ks_l, vs_l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )  # [B, C, H*Hd]

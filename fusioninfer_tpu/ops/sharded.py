@@ -66,6 +66,12 @@ def flash_attention_tp(
     return fn(q, k, v)
 
 
+# int8 KV pages carry per-(kv-head, page, token) scale arrays
+# [KV, n_pages, 1, ps]; the leading KV axis shards over tp exactly like
+# the pages, so each shard's kernel folds its own heads' scales.
+_SCALE_SPEC = P("tp", None, None, None)
+
+
 def paged_decode_attention_tp(
     mesh: Mesh,
     q: jax.Array,  # [B, H, Hd] — H sharded over tp
@@ -73,25 +79,32 @@ def paged_decode_attention_tp(
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, mp] replicated
     lengths: jax.Array,  # [B] replicated
+    k_scale: jax.Array | None = None,  # [KV, n_pages, 1, ps] — int8 pages
+    v_scale: jax.Array | None = None,
     *,
     interpret: bool = False,
     window: int | None = None,
 ) -> jax.Array:
     """Per-shard paged decode attention → [B, H·Hd] sharded on features."""
+    in_specs = [
+        P(None, "tp", None),
+        P("tp", None, None, None),
+        P("tp", None, None, None),
+        P(None, None),
+        P(None),
+    ]
+    args = [q, k_pages, v_pages, page_tables, lengths]
+    if k_scale is not None:
+        in_specs += [_SCALE_SPEC, _SCALE_SPEC]
+        args += [k_scale, v_scale]
     fn = shard_map(
         partial(paged_decode_attention, interpret=interpret, window=window),
         mesh=mesh,
-        in_specs=(
-            P(None, "tp", None),
-            P("tp", None, None, None),
-            P("tp", None, None, None),
-            P(None, None),
-            P(None),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, "tp"),
         check_vma=False,
     )
-    return fn(q, k_pages, v_pages, page_tables, lengths)
+    return fn(*args)
 
 
 def paged_prefill_attention_tp(
@@ -102,26 +115,33 @@ def paged_prefill_attention_tp(
     page_row: jax.Array,  # [mp] replicated
     start: jax.Array,  # scalar replicated
     true_len: jax.Array,  # scalar replicated
+    k_scale: jax.Array | None = None,  # [KV, n_pages, 1, ps] — int8 pages
+    v_scale: jax.Array | None = None,
     *,
     interpret: bool = False,
     window: int | None = None,
 ) -> jax.Array:
     """Per-shard suffix-prefill attention → [C, H·Hd] sharded on features."""
+    in_specs = [
+        P(None, "tp", None),
+        P("tp", None, None, None),
+        P("tp", None, None, None),
+        P(None),
+        P(),
+        P(),
+    ]
+    args = [q, k_pages, v_pages, page_row, start, true_len]
+    if k_scale is not None:
+        in_specs += [_SCALE_SPEC, _SCALE_SPEC]
+        args += [k_scale, v_scale]
     fn = shard_map(
         partial(paged_prefill_attention, interpret=interpret, window=window),
         mesh=mesh,
-        in_specs=(
-            P(None, "tp", None),
-            P("tp", None, None, None),
-            P("tp", None, None, None),
-            P(None),
-            P(),
-            P(),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, "tp"),
         check_vma=False,
     )
-    return fn(q, k_pages, v_pages, page_row, start, true_len)
+    return fn(*args)
 
 
 def paged_verify_attention_tp(
@@ -132,23 +152,30 @@ def paged_verify_attention_tp(
     page_tables: jax.Array,  # [B, mp] replicated
     starts: jax.Array,  # [B] replicated
     counts: jax.Array,  # [B] replicated
+    k_scale: jax.Array | None = None,  # [KV, n_pages, 1, ps] — int8 pages
+    v_scale: jax.Array | None = None,
     *,
     interpret: bool = False,
     window: int | None = None,
 ) -> jax.Array:
     """Per-shard verify-window attention → [B, C, H·Hd] sharded on features."""
+    in_specs = [
+        P(None, None, "tp", None),
+        P("tp", None, None, None),
+        P("tp", None, None, None),
+        P(None, None),
+        P(None),
+        P(None),
+    ]
+    args = [q, k_pages, v_pages, page_tables, starts, counts]
+    if k_scale is not None:
+        in_specs += [_SCALE_SPEC, _SCALE_SPEC]
+        args += [k_scale, v_scale]
     fn = shard_map(
         partial(paged_verify_attention, interpret=interpret, window=window),
         mesh=mesh,
-        in_specs=(
-            P(None, None, "tp", None),
-            P("tp", None, None, None),
-            P("tp", None, None, None),
-            P(None, None),
-            P(None),
-            P(None),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, None, "tp"),
         check_vma=False,
     )
-    return fn(q, k_pages, v_pages, page_tables, starts, counts)
+    return fn(*args)

@@ -87,6 +87,47 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Params:
     )
 
 
+def _expand_quantized_specs(spec_tree: Any, param_tree: Any,
+                            path: tuple = ()) -> Any:
+    """Spec tree congruent with a (possibly int8-quantized) param tree.
+
+    A quantized leaf is ``{"_q8": int8[...], "_scale": f32[...]}``
+    (:mod:`fusioninfer_tpu.models.quantization`): ``_q8`` keeps the bf16
+    leaf's spec; ``_scale`` keeps it too EXCEPT on the reduced axis
+    (size 1 — the contraction axis for per-channel weights, the row
+    axis for the embedding table), which must be unsharded.  This is
+    what lets int8 weights ride the same Megatron layout as bf16
+    (VERDICT r3 ask #3 — int8 was single-device by guard)."""
+    from fusioninfer_tpu.models.quantization import is_quantized
+
+    if isinstance(spec_tree, P):
+        if not is_quantized(param_tree):
+            return spec_tree
+        q8 = param_tree["_q8"]
+        nd = len(q8.shape)
+        base = tuple(spec_tree) + (None,) * (nd - len(tuple(spec_tree)))
+        # quantize_rows (embedding) reduces the LAST axis; everything
+        # else is quantize_int8 over the contraction (second-to-last)
+        reduced = nd - 1 if path and path[-1] == "embed" else nd - 2
+        scale = list(base)
+        scale[reduced] = None
+        return {"_q8": P(*base), "_scale": P(*scale)}
+    return {
+        k: _expand_quantized_specs(spec_tree[k], v, path + (k,))
+        for k, v in param_tree.items()
+    }
+
+
+def shardings_for_tree(cfg: ModelConfig, mesh: Mesh, params: Params) -> Params:
+    """``NamedSharding`` pytree congruent with ``params`` — quantized or
+    not.  ``params`` may be real arrays or ``jax.eval_shape`` structs."""
+    specs = _expand_quantized_specs(param_specs(cfg), params)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def token_spec() -> P:
     """[B, S] token ids: batch over dp, sequence over sp."""
     return P("dp", "sp")
@@ -115,17 +156,28 @@ def kv_cache_spec() -> P:
 
 
 def shard_params(cfg: ModelConfig, mesh: Mesh, params: Params) -> Params:
-    """Place an existing (host/replicated) param pytree onto the mesh."""
-    return jax.device_put(params, param_shardings(cfg, mesh))
+    """Place an existing (host/replicated) param pytree onto the mesh —
+    bf16 or int8-quantized (quantized leaves shard ``_q8`` like the bf16
+    weight and replicate the reduced scale axis)."""
+    return jax.device_put(params, shardings_for_tree(cfg, mesh, params))
 
 
 def sharded_init(cfg: ModelConfig, mesh: Mesh, key: jax.Array) -> Params:
     """Initialize parameters directly into their sharded layout — no
-    host-side full copy, so 70B-scale weights never exist unsharded."""
+    host-side full copy, so 70B-scale weights never exist unsharded.
+    ``cfg.quantization="int8"`` builds the quantized tree under the same
+    jit: bf16 intermediates exist only shard-local and transiently."""
     from fusioninfer_tpu.models.transformer import init_params
 
-    init = jax.jit(
-        lambda k: init_params(cfg, k),
-        out_shardings=param_shardings(cfg, mesh),
-    )
+    if cfg.quantization == "int8":
+        from fusioninfer_tpu.models.quantization import quantize_params
+
+        def build(k):
+            return quantize_params(cfg, init_params(cfg, k))
+    else:
+        def build(k):
+            return init_params(cfg, k)
+
+    shapes = jax.eval_shape(build, key)
+    init = jax.jit(build, out_shardings=shardings_for_tree(cfg, mesh, shapes))
     return init(key)

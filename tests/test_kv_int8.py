@@ -186,23 +186,115 @@ class TestEngineInt8KV:
         assert not eng.has_work()
         assert n == 8
 
-    def test_pd_rejected_with_int8(self):
-        eng = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=2)
-        with pytest.raises(ValueError, match="int8"):
-            eng.request_prefill_slab(Request(
-                request_id="x", prompt_tokens=[1, 2],
-                params=SamplingParams(max_tokens=2)))
+    def test_pd_pair_matches_monolithic_int8(self):
+        """PD × int8 KV (VERDICT r3 ask #3): the slab carries int8 pages
+        + scales over the FIKV1 wire and the decoder continues exactly
+        where a monolithic int8 engine would."""
+        from fusioninfer_tpu.engine.kv_transfer import (
+            slab_from_bytes,
+            slab_to_bytes,
+        )
 
-    def test_mesh_rejected_with_int8(self):
+        prompts = {"a": [3, 1, 4, 1, 5], "b": list(range(2, 22))}
+        sp = SamplingParams(temperature=0.0, max_tokens=8)
+
+        def drain(engine):
+            out = {}
+            for _ in range(100):
+                if not engine.has_work():
+                    break
+                for o in engine.step():
+                    out.setdefault(o.request_id, []).append(o.token)
+            return out
+
+        mono = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                            seed=0)
+        for rid, p in prompts.items():
+            mono.add_request(Request(rid, p, sp))
+        expected = drain(mono)
+
+        prefiller = NativeEngine(CFG, cache_cfg=_cache_cfg(),
+                                 max_batch_size=4, seed=0)
+        decoder = NativeEngine(CFG, cache_cfg=_cache_cfg(),
+                               max_batch_size=4, seed=0)
+        for rid, p in prompts.items():
+            fut = prefiller.request_prefill_slab(Request(rid, p, sp))
+            prefiller.step()
+            slab = fut.result(timeout=30)
+            assert slab.quantized and slab.k.dtype == jnp.int8
+            # over the wire: scales survive serialization
+            slab = slab_from_bytes(slab_to_bytes(slab))
+            assert slab.quantized
+            decoder.add_prefilled_request(Request(rid, p, sp), slab)
+        got = drain(decoder)
+        assert got == expected
+
+    def test_tp_mesh_matches_single_device_int8(self):
+        """tp=2 × int8 KV pages: greedy tokens identical to the
+        single-device int8 engine (scales shard over tp with their
+        pages; VERDICT r3 ask #3 lifted the guard here)."""
         from fusioninfer_tpu.parallel import MeshConfig, build_mesh
 
         devs = jax.devices()
         if len(devs) < 2:
             pytest.skip("needs multi-device CPU mesh")
+        prompts = {"a": [3, 1, 4, 1, 5], "b": list(range(2, 18))}
+        sp = SamplingParams(temperature=0.0, max_tokens=6)
+        # fp32 activations so cross-sharding argmax ties can't flip
+        cfg = dataclasses.replace(CFG, dtype="float32")
+
+        def drain(engine):
+            out = {}
+            for _ in range(100):
+                if not engine.has_work():
+                    break
+                for o in engine.step():
+                    out.setdefault(o.request_id, []).append(o.token)
+            return out
+
+        def run(mesh):
+            eng = NativeEngine(cfg, cache_cfg=_cache_cfg(),
+                               max_batch_size=4, seed=0, mesh=mesh)
+            for rid, p in prompts.items():
+                eng.add_request(Request(rid, p, sp))
+            return drain(eng)
+
+        ref = run(None)
+        assert all(len(v) == sp.max_tokens for v in ref.values())
         mesh = build_mesh(MeshConfig(tp=2), devs[:2])
-        with pytest.raises(ValueError, match="int8"):
-            NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=2,
-                         mesh=mesh)
+        got = run(mesh)
+        assert got == ref, f"tp2 int8-KV decode diverged: {got} != {ref}"
+
+    def test_tp_kernel_mesh_matches_single_device_int8(self):
+        """tp=2 × int8 KV through the shard_map'd Pallas kernels
+        (interpret off-TPU): per-shard scale folding must reproduce the
+        single-device tokens exactly."""
+        from fusioninfer_tpu.parallel import MeshConfig, build_mesh
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs multi-device CPU mesh")
+        prompts = {"a": [3, 1, 4, 1, 5]}
+        sp = SamplingParams(temperature=0.0, max_tokens=5)
+        cfg = dataclasses.replace(CFG, dtype="float32", attn_impl="flash")
+
+        def run(mesh):
+            eng = NativeEngine(cfg, cache_cfg=_cache_cfg(),
+                               max_batch_size=2, seed=0, mesh=mesh)
+            for rid, p in prompts.items():
+                eng.add_request(Request(rid, p, sp))
+            out = {}
+            for _ in range(60):
+                if not eng.has_work():
+                    break
+                for o in eng.step():
+                    out.setdefault(o.request_id, []).append(o.token)
+            return out
+
+        ref = run(None)
+        assert all(len(v) == sp.max_tokens for v in ref.values())
+        got = run(build_mesh(MeshConfig(tp=2), devs[:2]))
+        assert got == ref, f"tp2 int8-KV kernel decode diverged: {got} != {ref}"
 
 
 class TestInt8WithSlidingWindow:

@@ -89,6 +89,32 @@ def test_pd_pair_matches_monolithic_greedy():
     assert prefiller.kv_cache_usage() == 0.0 and prefiller.num_running == 0
 
 
+def test_pd_cross_precision_inject():
+    """Mixed-precision PD: an int8 prefiller's slab dequantizes into a
+    bf16 decoder's cache, and a bf16 slab requantizes into an int8
+    decoder's cache — each side keeps its configured layout and decode
+    proceeds (tokens are close, not bit-identical: one quantization
+    round-trip sits on the boundary)."""
+    int8_cache = CacheConfig(n_pages=33, page_size=8, max_pages_per_seq=8,
+                             kv_dtype="int8")
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    for pre_cfg, dec_cfg in ((int8_cache, CACHE), (CACHE, int8_cache)):
+        prefiller = NativeEngine(CFG, cache_cfg=pre_cfg, max_batch_size=2, seed=0)
+        decoder = NativeEngine(CFG, cache_cfg=dec_cfg, max_batch_size=2, seed=0)
+        fut = prefiller.request_prefill_slab(
+            Request("x", prompt, _greedy(prompt, max_tokens=4)))
+        prefiller.step()
+        slab = fut.result(timeout=30)
+        assert slab.quantized == (pre_cfg.kv_dtype == "int8")
+        slab = slab_from_bytes(slab_to_bytes(slab))  # over the wire
+        decoder.add_prefilled_request(
+            Request("x", prompt, _greedy(prompt, max_tokens=4)), slab)
+        got = _drain(decoder)
+        # first token came from the prefiller; 3 more decoded locally
+        assert len(got["x"]) == 4
+
+
 def test_pd_over_http_two_servers():
     prompt_text = "hello pd"
     prefill_srv = EngineServer(

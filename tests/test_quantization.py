@@ -139,12 +139,62 @@ class TestEngineInt8:
         assert len(first) == 6
         assert first == run()
 
-    def test_int8_rejects_mesh(self):
+    def test_int8_weights_tp_matches_single_device(self):
+        """int8 weights × tp=2 (VERDICT r3 ask #3): quantized leaves
+        shard ``_q8`` like the bf16 weight and replicate the reduced
+        scale axis — greedy tokens must match the single-device int8
+        engine exactly."""
         from fusioninfer_tpu.parallel import MeshConfig, build_mesh
 
-        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
-        with pytest.raises(ValueError, match="single-device"):
-            NativeEngine(self.CFG, cache_cfg=self.CACHE, mesh=mesh)
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs multi-device CPU mesh")
+        cfg = dataclasses.replace(self.CFG, dtype="float32")
+
+        def run(mesh):
+            engine = NativeEngine(cfg, cache_cfg=self.CACHE,
+                                  max_batch_size=2, seed=0, mesh=mesh)
+            engine.add_request(Request("r", [3, 1, 4, 1, 5], SamplingParams(
+                temperature=0.0, max_tokens=6)))
+            out = []
+            for _ in range(50):
+                if not engine.has_work():
+                    break
+                out += [o.token for o in engine.step() if o.request_id == "r"]
+            return out
+
+        ref = run(None)
+        assert len(ref) == 6
+        got = run(build_mesh(MeshConfig(tp=2), devs[:2]))
+        assert got == ref, f"tp2 int8-weight decode diverged: {got} != {ref}"
+
+    def test_quantized_sharding_specs_expand(self):
+        """shardings_for_tree maps {_q8, _scale} leaves: _q8 keeps the
+        Megatron spec, _scale unshards the reduced axis (the row-parallel
+        wo/w_down contraction axis would otherwise split size-1 scales)."""
+        from jax.sharding import PartitionSpec as P
+
+        from fusioninfer_tpu.models.quantization import quantize_params
+        from fusioninfer_tpu.models.transformer import init_params
+        from fusioninfer_tpu.parallel import MeshConfig, build_mesh
+        from fusioninfer_tpu.parallel.sharding import shardings_for_tree
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs multi-device CPU mesh")
+        mesh = build_mesh(MeshConfig(tp=2), devs[:2])
+        params = jax.eval_shape(
+            lambda: quantize_params(self.CFG, init_params(self.CFG, jax.random.key(0))))
+        sh = shardings_for_tree(self.CFG, mesh, params)
+        wo = sh["layers"]["wo"]
+        assert wo["_q8"].spec == P(None, "tp", None)
+        assert wo["_scale"].spec == P(None, None, None)
+        wq = sh["layers"]["wq"]
+        assert wq["_q8"].spec == P(None, None, "tp")
+        emb = sh["embed"]
+        assert emb["_q8"].spec == P("tp", None)
+        # norms stay plain specs
+        assert sh["final_norm"].spec == P()
 
 
 class TestMoEScalePreset:
