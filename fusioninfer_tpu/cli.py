@@ -183,6 +183,9 @@ def build_parser() -> argparse.ArgumentParser:
                        help="speculative decoding: propose up to K draft "
                             "tokens per greedy request by n-gram prompt "
                             "lookup, verified in one forward (0 = off)")
+    serve.add_argument("--dtype", default="",
+                       help="override the model compute dtype (e.g. float32 "
+                            "for exact cross-sharding equivalence checks)")
     serve.add_argument("--kv-cache-dtype", choices=("auto", "int8"),
                        default="auto",
                        help="int8: quantized KV pages — half the decode "

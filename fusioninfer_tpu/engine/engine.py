@@ -224,6 +224,13 @@ class NativeEngine:
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
         self.mesh = mesh
+        # tp meshes spanning OS processes (one LWS group = one multi-host
+        # slice) run every process's engine in SPMD lockstep; the leader
+        # broadcasts the admission event stream (engine/multihost.py)
+        from fusioninfer_tpu.engine import multihost
+
+        self._mh = (multihost.EventBroadcaster()
+                    if multihost.mesh_is_multiprocess(mesh) else None)
         self.lora_set = None
         if lora_adapters:
             from fusioninfer_tpu.models.lora import AdapterSet
@@ -375,6 +382,13 @@ class NativeEngine:
             raise ValueError(
                 f"prompt+max_tokens exceeds engine max_len {self.cache_cfg.max_len}"
             )
+        if self._mh is not None:
+            # multi-process mesh: route through the leader's event stream
+            # so every process's scheduler replays the same admission
+            from fusioninfer_tpu.engine import multihost
+
+            self._mh.queue(multihost.request_to_event(request))
+            return
         with self._lock:
             self.waiting.push(request)
 
@@ -457,6 +471,12 @@ class NativeEngine:
         Served inside :meth:`step` (engine thread owns the cache); resolves
         to a :class:`fusioninfer_tpu.engine.kv_transfer.KVSlab` — int8
         caches emit int8 slabs (scales ride the wire)."""
+        if self._mh is not None:
+            # extracting a slab pulls pages to one host; a cache sharded
+            # across processes is not fully addressable there
+            raise ValueError(
+                "PD prefill slabs are not supported on a multi-process mesh"
+            )
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._slab_q.put((request, fut))
         return fut
@@ -478,6 +498,15 @@ class NativeEngine:
             raise ValueError(
                 "guided JSON is not yet supported on the "
                 "PD-disaggregated prefill wire"
+            )
+        if self._mh is not None:
+            # the slab would enter one process's scheduler only — the
+            # next jitted step would then differ across the mesh and the
+            # SPMD collectives mismatch (same reason the prefill side
+            # raises above)
+            raise ValueError(
+                "PD prefilled admission is not supported on a "
+                "multi-process mesh"
             )
         if slab.page_size != self.cache_cfg.page_size:
             raise ValueError(
@@ -644,11 +673,41 @@ class NativeEngine:
     def cancel(self, request_id: str) -> None:
         """Abandon a request (client gone). Thread-safe; takes effect at
         the next step so only the engine thread mutates scheduling state."""
+        if self._mh is not None:
+            if self._mh.is_leader:
+                from fusioninfer_tpu.engine import multihost
+
+                self._mh.queue(multihost.cancel_event(request_id))
+            # follower: no-op — a follower-local cancellation would pull
+            # the sequence out of ITS batch only and diverge the SPMD
+            # lockstep; followers only learn of cancels via the event
+            # stream
+            return
         with self._lock:
             self._cancelled.add(request_id)
 
+    @property
+    def is_multihost(self) -> bool:
+        """True when this engine runs in cross-process SPMD lockstep —
+        the serve loop must then call :meth:`step` unconditionally (the
+        event exchange inside it is the pacing/sync point)."""
+        return self._mh is not None
+
+    def _exchange_multihost_events(self) -> None:
+        from fusioninfer_tpu.engine import multihost
+
+        for ev in self._mh.exchange():
+            if ev["type"] == "add":
+                with self._lock:
+                    self.waiting.push(multihost.request_from_event(ev))
+            elif ev["type"] == "cancel":
+                with self._lock:
+                    self._cancelled.add(ev["request_id"])
+
     def step(self) -> list[StepOutput]:
         """Admit + prefill new work, then one batched decode pass."""
+        if self._mh is not None:
+            self._exchange_multihost_events()
         self._process_cancellations()
         self._serve_slab_requests()
         self._serve_embedding_requests()

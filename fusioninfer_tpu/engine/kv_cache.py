@@ -155,7 +155,13 @@ def auto_cache_config(
     pages_per_seq = max(1, -(-max_model_len // page_size))
     min_pages = pages_per_seq * max_batch_size + 1
     if hbm_bytes is None:
-        stats = jax.devices()[0].memory_stats() or {}
+        try:
+            # local_devices: under multi-process serving, devices()[0] is
+            # the leader's device and MemoryStats on a non-addressable
+            # device raises on every follower
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except jax.errors.JaxRuntimeError:
+            stats = {}
         hbm_bytes = stats.get("bytes_limit")
     n_pages = min_pages
     if hbm_bytes:

@@ -1,0 +1,123 @@
+"""Multi-process (multi-host) serving coordination.
+
+Under multi-controller JAX, every process in the mesh must execute the
+same jitted computations in the same order — a tp mesh spanning hosts
+(one LWS group = one TPU slice, ``workload/bootstrap.py``) therefore
+needs every host's engine to run **identical scheduling decisions**.
+The reference delegates this to vLLM's Ray driver/worker split
+(``/root/reference/pkg/workload/lws.go:189-242`` wraps ``ray start``);
+the TPU-native shape is the JetStream/MaxText one: all hosts run the
+same continuous-batching loop in SPMD lockstep, and the leader (the only
+pod the operator's InferencePool routes traffic to — leader-only
+``worker-index=0`` selector, ``router/inferencepool.py``) broadcasts the
+admission-order event stream so follower schedulers replay it exactly.
+
+Mechanism: the engine's host-side state (wait queue, page allocator,
+slots, RNG seeds) is a deterministic function of the admission event
+sequence; device results pulled to host (sampled tokens) are replicated
+across the mesh, so once events match, every subsequent step matches.
+Events (request adds, cancels) are queued on the leader and fanned out
+at the top of every :meth:`NativeEngine.step` via a two-phase
+``broadcast_one_to_all`` (length, then payload) — followers block in the
+collective until the leader steps, which is also what paces the loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import TYPE_CHECKING, Any, Optional
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from fusioninfer_tpu.engine.engine import Request
+
+
+def mesh_is_multiprocess(mesh) -> bool:
+    """True when serving this mesh requires cross-process lockstep."""
+    if mesh is None:
+        return False
+    import jax
+
+    return jax.process_count() > 1
+
+
+class EventBroadcaster:
+    """Leader→all fan-out of engine admission events.
+
+    ``queue`` is called from server threads on the leader;
+    ``exchange`` is called from every process's engine thread at the top
+    of each step and returns the same event list on all processes."""
+
+    def __init__(self):
+        import jax
+
+        self.is_leader = jax.process_index() == 0
+        self._pending: list[dict] = []
+        self._lock = threading.Lock()
+
+    def queue(self, event: dict) -> None:
+        if not self.is_leader:
+            raise RuntimeError(
+                "admission events originate on the leader; follower pods "
+                "receive no traffic (InferencePool selects worker-index=0)"
+            )
+        with self._lock:
+            self._pending.append(event)
+
+    def exchange(self) -> list[dict]:
+        from jax.experimental import multihost_utils as mu
+
+        if self.is_leader:
+            with self._lock:
+                events, self._pending = self._pending, []
+            payload = json.dumps(events).encode() if events else b""
+        else:
+            payload = b""
+        n = int(mu.broadcast_one_to_all(np.int32(len(payload))))
+        if n == 0:
+            return []
+        if self.is_leader:
+            buf = np.frombuffer(payload, np.uint8)
+        else:
+            buf = np.zeros(n, np.uint8)
+        out = np.asarray(mu.broadcast_one_to_all(buf))
+        return json.loads(bytes(out.tobytes()))
+
+
+def request_to_event(request: "Request") -> dict:
+    """JSON-safe admission event carrying EVERYTHING scheduling reads —
+    including ``arrival_time`` (the FCFS key: followers must not stamp
+    their own clocks) and the explicit seed if any."""
+    return {
+        "type": "add",
+        "request": dataclasses.asdict(request),
+    }
+
+
+def cancel_event(request_id: str) -> dict:
+    return {"type": "cancel", "request_id": request_id}
+
+
+def request_from_event(event: dict) -> "Request":
+    from fusioninfer_tpu.engine.engine import Request
+    from fusioninfer_tpu.engine.sampler import SamplingParams
+
+    d: dict[str, Any] = dict(event["request"])
+    p = dict(d.pop("params"))
+    p["stop_token_ids"] = tuple(p.get("stop_token_ids", ()))
+    p["stop_strings"] = tuple(p.get("stop_strings", ()))
+    p["logit_bias"] = tuple(
+        (int(t), float(b)) for t, b in p.get("logit_bias", ()))
+    resume: Optional[list] = d.pop("resume_tokens", None)
+    return Request(
+        request_id=d["request_id"],
+        prompt_tokens=list(d["prompt_tokens"]),
+        params=SamplingParams(**p),
+        arrival_time=float(d["arrival_time"]),
+        priority=int(d.get("priority", 0)),
+        lora=d.get("lora", ""),
+        resume_tokens=list(resume) if resume is not None else None,
+    )

@@ -54,6 +54,14 @@ def maybe_init_distributed() -> None:
         process_id=process_id,
     )
     logger.info("joined JAX coordinator %s:%s as process %d/%s", leader, port, process_id, n_proc)
+    # establish the cross-process collective context NOW, while process
+    # skew is sub-second: the CPU backend's gloo rendezvous has a fixed
+    # 30s window, and the first natural collective otherwise lands after
+    # each process's independent (and contention-skewed) engine compile
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("fusioninfer:bootstrap")
+    logger.info("collective context established across %s processes", n_proc)
 
 
 class _RequestChannel:
@@ -184,7 +192,11 @@ class EngineServer:
             if not self.engine.has_work():
                 consecutive_failures = 0  # an old incident must not
                 time.sleep(idle_sleep)    # shorten a NEW request's window
-                continue
+                if not getattr(self.engine, "is_multihost", False):
+                    continue
+                # multi-process mesh: step unconditionally — the event
+                # exchange at the top of step() is what keeps leader and
+                # follower loops in SPMD lockstep (followers block there)
             try:
                 outputs = self.engine.step()
                 consecutive_failures = 0
@@ -192,6 +204,23 @@ class EngineServer:
                 consecutive_failures += 1
                 logger.exception("engine step failed (%d consecutive)",
                                  consecutive_failures)
+                if getattr(self.engine, "is_multihost", False):
+                    # a raising step on ONE process of an SPMD mesh means
+                    # the lockstep is (or is about to be) broken — local
+                    # recovery (fail_all) would mutate scheduling state
+                    # process-locally and deadlock the slice's collectives.
+                    # Fail in-flight clients, then exit: kubelet restarts
+                    # the pod and the bootstrap rejoins the group (the
+                    # operator's gang semantics restart the slice whole).
+                    for out in self.engine.fail_all(
+                            f"multihost engine step failed: {e}"):
+                        with self._lock:
+                            chan = self._channels.get(out.request_id)
+                        if chan is not None:
+                            chan.put(out)
+                    logger.critical(
+                        "multihost lockstep broken; exiting for pod restart")
+                    os._exit(13)
                 if consecutive_failures >= 3:
                     # a persistent failure must not leave clients hanging
                     # on channels forever: fail everything in flight
@@ -1192,6 +1221,11 @@ def serve_from_args(args) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, quantization=quant)
+    dtype = getattr(args, "dtype", "") or ""
+    if dtype and dtype != cfg.dtype:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, dtype=dtype)
     tp = args.tensor_parallel_size
     mesh = None
     if tp > 1:
@@ -1217,13 +1251,6 @@ def serve_from_args(args) -> int:
 
         lora_adapters[name] = load_adapter(path, cfg)
     kv_dtype = getattr(args, "kv_cache_dtype", "auto")
-    if kv_dtype == "int8" and (getattr(args, "prefill_upstream", None) or None):
-        # both facts are known at startup: fail here, not after every
-        # request has burned a remote prefill + KV transfer
-        raise SystemExit(
-            "--kv-cache-dtype int8 is incompatible with --prefill-upstream: "
-            "the PD KV-slab wire carries bf16 pages"
-        )
     cache_cfg = auto_cache_config(
         cfg,
         page_size=args.page_size,

@@ -1,22 +1,29 @@
-"""Two-process proof of the operator's multi-host bootstrap contract.
+"""Two-process proof of the operator's multi-host serving contract.
 
 SURVEY §7 hard-part 1 warns a wrong (topology env ↔ jax.distributed)
 contract "fails silently as a hung XLA init"; through round 2 the
-contract had never run as more than one real process.  This test renders
-the engine container exactly the way the operator does
+contract had never run as more than one real process.  These tests
+render the engine container exactly the way the operator does
 (:class:`fusioninfer_tpu.workload.bootstrap.JaxCoordinatorBootstrap`),
-resolves the fieldRef env the way kubelet would, then launches TWO real
-OS processes that drive ``maybe_init_distributed``
-(``engine/server.py``) to a successful ``jax.distributed.initialize``
-handshake on CPU — with a hard timeout so contract drift fails in
-seconds, not as a hang.  VERDICT r2 ask #7.
+resolve the fieldRef env the way kubelet would, then launch TWO real OS
+processes — first to a successful ``jax.distributed.initialize``
+handshake (VERDICT r2 ask #7), and then all the way through
+``serve_from_args``'s mesh-over-global-devices path to an actual tp=2
+DECODE whose tokens must match a single-process server exactly
+(VERDICT r3 ask #2: the handshake alone fenced only half the risk).
+Every wait is hard-timeout-guarded so contract drift fails in seconds,
+not as a hang.
 """
 
+import json
 import os
 import socket
 import subprocess
 import sys
 import textwrap
+import time
+import urllib.error
+import urllib.request
 
 from fusioninfer_tpu.api.types import EngineKind
 from fusioninfer_tpu.workload.bootstrap import bootstrap_for
@@ -95,6 +102,135 @@ def test_two_process_jax_coordinator_handshake():
     for rank, (rc, out, err) in enumerate(results):
         assert rc == 0, f"process {rank} failed rc={rc}\n{err[-2000:]}"
         assert f"BOOTSTRAP_OK {rank}" in out, (rank, out, err[-500:])
+
+
+def _wait_ready(port: int, proc_check, timeout: float = 150.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        proc_check()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/models", timeout=5) as r:
+                if r.status == 200:
+                    return
+        except (urllib.error.URLError, ConnectionError, TimeoutError):
+            time.sleep(0.5)
+    raise TimeoutError(f"server on :{port} not ready in {timeout}s")
+
+
+def _completion(port: int, body: dict, timeout: float = 180.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def _reference_greedy_text(prompt: str, max_tokens: int) -> str:
+    """What a single-process server would return for a greedy completion:
+    the engine's generated tokens decoded with the serving tokenizer
+    (the server builds ``choices[0].text`` exactly this way).  Computed
+    in-process — the CI box has ONE core, so a third compiling server
+    subprocess would starve the pair under test."""
+    import dataclasses
+
+    from fusioninfer_tpu.engine.engine import NativeEngine, Request
+    from fusioninfer_tpu.engine.kv_cache import auto_cache_config
+    from fusioninfer_tpu.engine.sampler import SamplingParams
+    from fusioninfer_tpu.engine.tokenizer import load_tokenizer
+    from fusioninfer_tpu.models.config import get_preset
+
+    tok = load_tokenizer()
+    cfg = dataclasses.replace(get_preset("qwen3-tiny"), dtype="float32")
+    cache = auto_cache_config(cfg, page_size=16, max_model_len=256,
+                              max_batch_size=4)
+    eng = NativeEngine(cfg, cache_cfg=cache, max_batch_size=4, seed=0)
+    eng.add_request(Request("ref", tok.encode(prompt), SamplingParams(
+        temperature=0.0, max_tokens=max_tokens)))
+    out: list[int] = []
+    for _ in range(40 + max_tokens):
+        if not eng.has_work():
+            break
+        out += [o.token for o in eng.step() if o.request_id == "ref"]
+    assert len(out) == max_tokens, out
+    if out[-1] == tok.eos_token_id:
+        out = out[:-1]
+    return tok.decode(out)
+
+
+def test_two_process_tp2_decode_token_identity():
+    """serve_from_args end to end across TWO OS processes: the leader's
+    HTTP completion (greedy) must be byte-identical to the single-process
+    engine's — the admission event stream broadcasts leader→follower and
+    both engines execute the sharded decode in SPMD lockstep
+    (``engine/multihost.py``).  float32 so cross-sharding reduction
+    order can't flip an argmax tie."""
+    strat = bootstrap_for(EngineKind.NATIVE)
+    leader_c = strat.wrap_leader({"name": "engine"}, size=2)
+    worker_c = strat.wrap_worker({"name": "engine"}, size=2)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    coord_port = str(_free_port())
+    leader_port, follower_port = _free_port(), _free_port()
+    prompt, n_out = "hello multi host decode", 8
+    expected = _reference_greedy_text(prompt, n_out)
+
+    procs: list[subprocess.Popen] = []
+    try:
+        for idx, container in enumerate([leader_c, worker_c]):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)  # one CPU device per process
+            env.update(_resolve_env(container, worker_index=idx))
+            env.update({
+                "LWS_LEADER_ADDRESS": "127.0.0.1",
+                "FUSIONINFER_COORDINATOR_PORT": coord_port,
+                "JAX_PLATFORMS": "cpu",
+                "FUSIONINFER_PLATFORM": "cpu",
+                "PYTHONPATH": repo_root,
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "fusioninfer_tpu.cli", "engine",
+                 "serve", "qwen3-tiny", "--dtype", "float32",
+                 "--host", "127.0.0.1",
+                 "--port", str(leader_port if idx == 0 else follower_port),
+                 "--tensor-parallel-size", "2",
+                 "--max-batch-size", "4", "--max-model-len", "256",
+                 "--page-size", "16", "--seed", "0"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, cwd=repo_root,
+            ))
+
+        def alive_or_fail():
+            for p in procs:
+                if p.poll() is not None:
+                    _, err = p.communicate(timeout=10)
+                    raise AssertionError(
+                        f"server exited rc={p.returncode}\n{err[-3000:]}")
+
+        _wait_ready(leader_port, alive_or_fail, timeout=300.0)
+        body = {"model": "qwen3-tiny", "prompt": prompt,
+                "max_tokens": n_out, "temperature": 0.0}
+        got = _completion(leader_port, body, timeout=300.0)
+        assert got["usage"]["completion_tokens"] == n_out, got
+        assert got["choices"][0]["text"] == expected, (
+            f"tp2 two-process decode diverged:\n"
+            f"  ref: {expected!r}\n  got: {got['choices'][0]['text']!r}")
+        # second request exercises the already-warm lockstep loop
+        expected2 = _reference_greedy_text("second wave", 5)
+        got2 = _completion(leader_port, dict(
+            body, prompt="second wave", max_tokens=5), timeout=300.0)
+        assert got2["choices"][0]["text"] == expected2
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass
 
 
 def test_single_process_is_noop():
