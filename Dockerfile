@@ -9,7 +9,10 @@ FROM python:3.12-slim AS base
 WORKDIR /app
 COPY pyproject.toml ./
 COPY fusioninfer_tpu ./fusioninfer_tpu
-RUN pip install --no-cache-dir pyyaml && pip install --no-cache-dir -e . --no-deps
+# cryptography: self-signed metrics-TLS fallback (operator/tlsutil.py);
+# python:slim also ships an openssl CLI the code falls back to
+RUN pip install --no-cache-dir pyyaml cryptography && \
+    pip install --no-cache-dir -e . --no-deps
 
 # Controller image (default target): no JAX needed to reconcile.
 FROM base AS controller

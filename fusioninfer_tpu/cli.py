@@ -39,6 +39,11 @@ def _cmd_controller_run(args: argparse.Namespace) -> int:
         leader_elect=args.leader_elect,
         leader_identity=os.environ.get("POD_NAME") or None,
         metrics_auth=args.metrics_auth,
+        metrics_tls=not args.metrics_insecure,
+        metrics_cert_path=(f"{args.metrics_cert_path}/{args.metrics_cert_name}"
+                           if args.metrics_cert_path else None),
+        metrics_key_path=(f"{args.metrics_cert_path}/{args.metrics_cert_key}"
+                          if args.metrics_cert_path else None),
     )
     mgr.run_forever()
     # mirror controller-runtime: lost leadership is a fatal exit so the
@@ -140,6 +145,16 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--volcano-queue", default="")
     run.add_argument("--leader-elect", action="store_true",
                      help="lease-based active/standby HA (coordination.k8s.io)")
+    run.add_argument("--metrics-insecure", action="store_true",
+                     help="serve metrics over plain HTTP (default: HTTPS with "
+                          "a self-signed certificate when no cert path is given "
+                          "— the reference's secure-serving posture)")
+    run.add_argument("--metrics-cert-path", default="",
+                     help="directory with the metrics serving certificate "
+                          "(reference --metrics-cert-path; hot-reloaded on "
+                          "rotation)")
+    run.add_argument("--metrics-cert-name", default="tls.crt")
+    run.add_argument("--metrics-cert-key", default="tls.key")
     run.add_argument("--metrics-auth", choices=("none", "token"), default="token",
                      help="metrics endpoint authn: bearer token via TokenReview "
                           "(or FUSIONINFER_METRICS_TOKEN static token); "

@@ -373,11 +373,19 @@ class NativeEngine:
             raise ValueError("max_tokens must be >= 1")
         if not request.prompt_tokens:
             raise ValueError("prompt must not be empty")
-        if request.params.guided_json and self._byte_np is None:
+        if (request.params.guided_json or request.params.guided_schema) \
+                and self._byte_np is None:
             raise ValueError(
                 "guided JSON needs a token→byte mapping; the serving "
                 "tokenizer does not provide one"
             )
+        if request.params.guided_schema:
+            # compile NOW (memoized) so an unsupported schema 400s at
+            # admission instead of failing the engine thread mid-serve
+            from fusioninfer_tpu.engine import guided
+
+            guided.SchemaByteMachine(
+                guided.compile_schema_str(request.params.guided_schema))
         if len(request.prompt_tokens) + request.params.max_tokens > self.cache_cfg.max_len:
             raise ValueError(
                 f"prompt+max_tokens exceeds engine max_len {self.cache_cfg.max_len}"
@@ -492,7 +500,7 @@ class NativeEngine:
                 "LoRA adapters are not yet supported on the "
                 "PD-disaggregated prefill wire"
             )
-        if request.params.guided_json:
+        if request.params.guided_json or request.params.guided_schema:
             # the prefiller samples the first token without the grammar
             # mask — reject rather than return unguided output
             raise ValueError(
@@ -1331,11 +1339,10 @@ class NativeEngine:
                                        namespace=self._lora_ns(request))
         seq_seed = self._request_seed(request)
         n_prompt = len(request.prompt_tokens)
-        machine = None
-        if request.params.guided_json:
-            from fusioninfer_tpu.engine.guided import JsonByteMachine
+        from fusioninfer_tpu.engine.guided import machine_for
 
-            machine = JsonByteMachine()
+        machine = machine_for(request.params)
+        if machine is not None:
             for t in prefix[n_prompt:]:  # resume: replay generated bytes
                 b = int(self._byte_np[t])
                 if b >= 0:
@@ -1389,6 +1396,7 @@ class NativeEngine:
                 and p.repetition_penalty == 1.0
                 and p.logprobs is None
                 and not p.guided_json  # drafts would bypass the grammar mask
+                and not p.guided_schema
                 and not p.logit_bias  # verify argmax ignores the bias
                 and st.n_generated >= p.min_tokens)
 

@@ -22,6 +22,8 @@ hitting ``max_tokens`` mid-object returns a prefix (``finish_reason:
 
 from __future__ import annotations
 
+import functools as _functools
+
 import numpy as np
 
 _WS = frozenset(b" \t\n\r")
@@ -227,6 +229,571 @@ class JsonByteMachine:
         else:  # t / f / n
             self._literal_rest = _LITERALS[b]
             self.state = "literal"
+
+
+# -- schema-constrained decoding (response_format: json_schema) --------------
+#
+# OpenAI's ``json_schema`` response format guarantees output CONFORMING
+# to a user schema, not merely valid JSON.  vLLM gives the reference's
+# users this via xgrammar/outlines backends (engine-flag passthrough);
+# here the schema compiles to a node tree and a frame-stack interpreter
+# walks it byte by byte with the same ``allowed_bytes()``/``advance()``
+# interface the engine already masks through.
+#
+# Enforced subset (the structural core): ``type`` (incl. lists),
+# ``properties`` / ``required`` / ``additionalProperties``, ``items`` /
+# ``minItems`` / ``maxItems``, ``enum`` / ``const``, ``anyOf``/``oneOf``.
+# Value-range keywords (pattern/format/minimum/...) are not byte-wise
+# enforceable and are ignored; the root must be an object (OpenAI strict
+# mode requires this too — a bare root number has no byte at which the
+# machine could *know* it is finished).
+
+
+def _dump(v) -> bytes:
+    import json
+
+    return json.dumps(v, separators=(",", ":"), ensure_ascii=True).encode()
+
+
+_ANY: dict = {"kind": "any"}
+
+
+# structural keywords the byte machine cannot enforce: compiling them to
+# "anything" would return finish_reason "stop" output that silently
+# violates the user's contract — reject at admission instead
+_UNSUPPORTED_KEYWORDS = ("$ref", "allOf", "not", "if", "then", "else",
+                         "patternProperties", "propertyNames",
+                         "unevaluatedProperties", "prefixItems", "contains")
+
+
+def compile_schema(schema) -> dict:
+    """JSON schema (dict) → node tree; raises ValueError on schemas the
+    byte machine cannot enforce (so the server 400s instead of serving
+    output that silently violates the contract)."""
+    if schema is True or schema == {}:
+        return _ANY
+    if not isinstance(schema, dict):
+        raise ValueError(f"unsupported schema: {schema!r}")
+    for kw in _UNSUPPORTED_KEYWORDS:
+        if kw in schema:
+            raise ValueError(
+                f"unsupported schema keyword {kw!r} — guided generation "
+                "enforces the structural subset (type/properties/required/"
+                "additionalProperties/items/minItems/maxItems/enum/const/"
+                "anyOf/oneOf); inline $defs references before submitting")
+    if "enum" in schema or "const" in schema:
+        values = schema["enum"] if "enum" in schema else [schema["const"]]
+        if not values:
+            raise ValueError("enum must be non-empty")
+        return {"kind": "enum", "opts": tuple(_dump(v) for v in values)}
+    for key in ("anyOf", "oneOf"):
+        if key in schema:
+            return {"kind": "union",
+                    "alts": tuple(compile_schema(s) for s in schema[key])}
+    t = schema.get("type")
+    if isinstance(t, list):
+        return {"kind": "union",
+                "alts": tuple(compile_schema(dict(schema, type=tt))
+                              for tt in t)}
+    if t == "object":
+        props = {
+            name.encode(): compile_schema(sub)
+            for name, sub in (schema.get("properties") or {}).items()
+        }
+        required = []
+        for name in schema.get("required", ()):
+            nb = name.encode()
+            if nb not in props:
+                raise ValueError(
+                    f"required property {name!r} must be declared in "
+                    "properties for guided generation")
+            required.append(nb)
+        addl = schema.get("additionalProperties", True)
+        addl_node = None if addl is False else compile_schema(
+            _coerce_bool_schema(addl))
+        return {"kind": "object", "props": props,
+                "required": frozenset(required), "addl": addl_node}
+    if t == "array":
+        lo = int(schema.get("minItems", 0))
+        hi = int(schema["maxItems"]) if "maxItems" in schema else None
+        if hi is not None and lo > hi:
+            # contradictory bounds would deadlock generation into
+            # whitespace-only output (neither ',' nor ']' ever legal)
+            raise ValueError(f"minItems {lo} > maxItems {hi}")
+        return {"kind": "array",
+                "items": compile_schema(
+                    _coerce_bool_schema(schema.get("items", True))),
+                "min": lo, "max": hi}
+    if t == "string":
+        return {"kind": "string"}
+    if t == "number":
+        return {"kind": "number"}
+    if t == "integer":
+        return {"kind": "integer"}
+    if t == "boolean":
+        return {"kind": "enum", "opts": (b"true", b"false")}
+    if t == "null":
+        return {"kind": "enum", "opts": (b"null",)}
+    if t is None:
+        return _ANY
+    raise ValueError(f"unsupported schema type {t!r}")
+
+
+def _coerce_bool_schema(s):
+    if s is True:
+        return {}
+    if s is False:
+        raise ValueError("'false' subschemas cannot guide generation")
+    return s
+
+
+@_functools.lru_cache(maxsize=256)
+def compile_schema_str(canonical: str) -> dict:
+    """Memoized compile keyed on the canonical schema string — the
+    server's 400 check, engine admission, and sequence start all share
+    ONE compile per distinct schema (nodes are read-only at runtime)."""
+    import json
+
+    return compile_schema(json.loads(canonical))
+
+
+# first byte → which value alternative it starts
+def _first_byte_mask(node) -> np.ndarray:
+    kind = node["kind"]
+    if kind == "object":
+        return _mask(b"{")
+    if kind == "array":
+        return _mask(b"[")
+    if kind == "string":
+        return _mask(b'"')
+    if kind in ("number", "integer"):
+        return _mask(_DIGITS, b"-")
+    if kind == "enum":
+        return _mask(bytes(o[0] for o in node["opts"]))
+    if kind == "union":
+        m = np.zeros(256, bool)
+        for alt in node["alts"]:
+            m |= _first_byte_mask(alt)
+        return m
+    if kind == "any":
+        return _mask(_WS, b'{["-tfn', _DIGITS)
+    raise AssertionError(kind)
+
+
+_ANY_OBJECT = {"kind": "object", "props": {}, "required": frozenset(),
+               "addl": _ANY}
+_ANY_ARRAY = {"kind": "array", "items": _ANY, "min": 0, "max": None}
+
+
+def _resolve_alt(node, b: int):
+    """The concrete alternative of ``node`` that byte ``b`` starts."""
+    if node["kind"] == "union":
+        for alt in node["alts"]:
+            if _first_byte_mask(alt)[b]:
+                return _resolve_alt(alt, b)
+        raise AssertionError(f"byte {b!r} matched no union alternative")
+    if node["kind"] == "any":
+        c = bytes([b])
+        if c == b"{":
+            return _ANY_OBJECT
+        if c == b"[":
+            return _ANY_ARRAY
+        if c == b'"':
+            return {"kind": "string"}
+        if c == b"-" or b in _DIGITS:
+            return {"kind": "number"}
+        if c == b"t":
+            return {"kind": "enum", "opts": (b"true",)}
+        if c == b"f":
+            return {"kind": "enum", "opts": (b"false",)}
+        if c == b"n":
+            return {"kind": "enum", "opts": (b"null",)}
+        raise AssertionError(f"byte {b!r} starts no JSON value")
+    return node
+
+
+class SchemaByteMachine:
+    """Schema-constrained sibling of :class:`JsonByteMachine`: same
+    ``allowed_bytes()`` / ``advance()`` / ``done`` surface, but the
+    legal-byte sets come from a compiled schema node tree — object keys
+    walk a byte-trie of the declared properties, '}' requires every
+    ``required`` key seen, arrays enforce min/maxItems, enums emit one
+    of their serialized options byte-for-byte.
+    """
+
+    def __init__(self, node: dict):
+        if node["kind"] != "object":
+            raise ValueError(
+                "json_schema guided decoding requires a top-level object "
+                "schema (OpenAI strict mode does too)")
+        self._stack: list[dict] = [{"t": "value", "node": node}]
+
+    @property
+    def done(self) -> bool:
+        return not self._stack
+
+    # -- allowed sets --------------------------------------------------------
+
+    def allowed_bytes(self) -> np.ndarray:
+        if not self._stack:
+            return np.zeros(256, bool)
+        return self._frame_allowed(len(self._stack) - 1)
+
+    def _frame_allowed(self, idx: int) -> np.ndarray:
+        f = self._stack[idx]
+        t = f["t"]
+        if t == "value":
+            return _first_byte_mask(f["node"]) | _mask(_WS)
+        if t == "obj":
+            return self._obj_allowed(f)
+        if t == "arr":
+            node, phase = f["node"], f["phase"]
+            m = np.zeros(256, bool)
+            if phase == "first":
+                if node["max"] is None or node["max"] > 0:
+                    m |= _first_byte_mask(node["items"])
+                if node["min"] == 0:
+                    m |= _mask(b"]")
+            else:  # after a value
+                if node["max"] is None or f["count"] < node["max"]:
+                    m |= _mask(b",")
+                if f["count"] >= node["min"]:
+                    m |= _mask(b"]")
+            return m | _mask(_WS)
+        if t == "str":
+            if f["sub"] == "escape":
+                return _mask(_ESCAPABLE)
+            if f["sub"] == "hex":
+                return _mask(_HEX)
+            return _mask(_STR_BYTES, b'"\\')
+        if t == "num":
+            return self._num_allowed(f, idx)
+        if t == "enum":
+            conts = bytes({o[f["pos"]] for o in f["opts"]
+                           if len(o) > f["pos"]})
+            m = _mask(conts)
+            if any(len(o) == f["pos"] for o in f["opts"]):
+                m |= self._after_pop_allowed(idx) | _mask(_WS)
+            return m
+        raise AssertionError(t)
+
+    def _obj_allowed(self, f: dict) -> np.ndarray:
+        node, phase = f["node"], f["phase"]
+        key = f.get("key")
+        if key is not None:
+            return self._key_allowed(f, key)
+        m = _mask(_WS)
+        if phase in ("first", "key_required"):
+            unseen = [nb for nb in node["props"] if nb not in f["seen"]]
+            if unseen or node["addl"] is not None:
+                m |= _mask(b'"')
+            if phase == "first" and node["required"] <= f["seen"]:
+                m |= _mask(b"}")
+        elif phase == "colon":
+            m |= _mask(b":")
+        elif phase == "after":
+            unseen = [nb for nb in node["props"] if nb not in f["seen"]]
+            if unseen or node["addl"] is not None:
+                m |= _mask(b",")
+            if node["required"] <= f["seen"]:
+                m |= _mask(b"}")
+        return m
+
+    def _key_allowed(self, f: dict, key: dict) -> np.ndarray:
+        if key["esc"] == "escape":
+            return _mask(_ESCAPABLE)
+        if key["esc"] == "hex":
+            return _mask(_HEX)
+        node = f["node"]
+        if key["free"] or node["addl"] is not None:
+            m = _mask(_STR_BYTES, b'"\\') if node["addl"] is not None \
+                else _mask(_STR_BYTES, b"\\")
+            # closing here names bytes(dec): a declared name binds its
+            # property schema — but a SEEN one would be a duplicate key
+            # whose last-wins value could violate the schema, so the
+            # quote is masked and the key must grow
+            if not self._key_close_ok(f, key):
+                m[0x22] = False
+            return m
+        pos = key["pos"]
+        conts = bytes({nb[pos] for nb, _ in key["cands"] if len(nb) > pos})
+        m = _mask(conts)
+        if self._key_close_ok(f, key):
+            m |= _mask(b'"')
+        return m
+
+    def _key_close_ok(self, f: dict, key: dict) -> bool:
+        name = bytes(key["dec"])
+        if name in f["node"]["props"]:
+            return name not in f["seen"]
+        return f["node"]["addl"] is not None
+
+    def _num_allowed(self, f: dict, idx: int) -> np.ndarray:
+        s = f["state"]
+        if s == "neg":
+            return _mask(_DIGITS)
+        if s == "frac_start":
+            return _mask(_DIGITS)
+        if s == "exp_start":
+            return _mask(_DIGITS, b"+-")
+        if s == "exp_sign":
+            return _mask(_DIGITS)
+        cont = {
+            "zero": b"." + (b"" if f["integer"] else b"eE"),
+            "int": bytes(_DIGITS) + b"." + (b"" if f["integer"] else b"eE"),
+            "frac": bytes(_DIGITS) + b"eE",
+            "exp": bytes(_DIGITS),
+        }[s]
+        if f["integer"] and s in ("zero", "int"):
+            cont = cont.replace(b".", b"")
+        return _mask(cont) | self._after_pop_allowed(idx) | _mask(_WS)
+
+    def _after_pop_allowed(self, idx: int) -> np.ndarray:
+        """What the parent would allow right after this frame completes
+        — the termination set for self-delimiting values (numbers, bare
+        enums like ``true``) whose end only a structural byte reveals.
+        Computed from the parent's REAL post-value state: '}' only once
+        every required key is seen, ']' only at/above minItems — the
+        redispatched byte never gets a second mask check, so this set
+        must already be exact."""
+        if idx == 0:
+            return np.zeros(256, bool)  # root value ends → machine done
+        parent = self._stack[idx - 1]
+        if parent["t"] == "obj":
+            node, seen = parent["node"], parent["seen"]
+            m = np.zeros(256, bool)
+            unseen = any(nb not in seen for nb in node["props"])
+            if unseen or node["addl"] is not None:
+                m |= _mask(b",")
+            if node["required"] <= seen:
+                m |= _mask(b"}")
+            return m
+        if parent["t"] == "arr":
+            node = parent["node"]
+            count_after = parent["count"] + 1  # incl. the completing value
+            m = np.zeros(256, bool)
+            if node["max"] is None or count_after < node["max"]:
+                m |= _mask(b",")
+            if count_after >= node["min"]:
+                m |= _mask(b"]")
+            return m
+        return np.zeros(256, bool)
+
+    # -- transitions ---------------------------------------------------------
+
+    def advance(self, byte: int) -> None:
+        if not self.allowed_bytes()[byte]:
+            raise ValueError(
+                f"byte {byte!r} illegal for frame {self._stack[-1]['t'] if self._stack else 'done'}")
+        self._dispatch(byte)
+
+    def _dispatch(self, b: int) -> None:
+        f = self._stack[-1]
+        t = f["t"]
+        if t == "value":
+            if b in _WS:
+                return
+            self._stack.pop()
+            self._start_value(_resolve_alt(f["node"], b), b)
+        elif t == "obj":
+            self._obj_advance(f, b)
+        elif t == "arr":
+            self._arr_advance(f, b)
+        elif t == "str":
+            self._str_advance(f, b)
+        elif t == "num":
+            self._num_advance(f, b)
+        elif t == "enum":
+            self._enum_advance(f, b)
+        else:  # pragma: no cover
+            raise AssertionError(t)
+
+    def _start_value(self, node: dict, b: int) -> None:
+        kind = node["kind"]
+        if kind == "object":
+            self._stack.append({"t": "obj", "node": node, "seen": set(),
+                                "phase": "first", "key": None})
+        elif kind == "array":
+            self._stack.append({"t": "arr", "node": node, "count": 0,
+                                "phase": "first"})
+        elif kind == "string":
+            self._stack.append({"t": "str", "sub": "content", "hex_left": 0})
+        elif kind in ("number", "integer"):
+            state = {45: "neg", 48: "zero"}.get(b, "int")
+            self._stack.append({"t": "num", "integer": kind == "integer",
+                                "state": state})
+        elif kind == "enum":
+            opts = tuple(o for o in node["opts"] if o[0] == b)
+            self._stack.append({"t": "enum", "opts": opts, "pos": 1})
+            self._enum_maybe_finish()
+        else:  # pragma: no cover
+            raise AssertionError(kind)
+
+    def _value_done(self) -> None:
+        """Top frame's value completed (its closing byte consumed)."""
+        self._stack.pop()
+        if not self._stack:
+            return  # root object closed — machine done
+        parent = self._stack[-1]
+        if parent["t"] == "obj":
+            parent["phase"] = "after"
+        elif parent["t"] == "arr":
+            parent["count"] += 1
+            parent["phase"] = "after"
+
+    def _obj_advance(self, f: dict, b: int) -> None:
+        key = f.get("key")
+        if key is not None:
+            return self._key_advance(f, key, b)
+        if b in _WS:
+            return
+        node, phase = f["node"], f["phase"]
+        c = bytes([b])
+        if phase in ("first", "key_required") and c == b'"':
+            f["key"] = {
+                "cands": [(nb, pn) for nb, pn in node["props"].items()
+                          if nb not in f["seen"]],
+                "pos": 0, "free": False, "esc": None, "dec": bytearray(),
+            }
+        elif phase == "first" and c == b"}":
+            self._value_done()
+        elif phase == "colon":  # ':'
+            f["phase"] = "value"
+            self._stack.append({"t": "value", "node": f.pop("vnode")})
+        elif phase == "after":
+            if c == b",":
+                f["phase"] = "key_required"
+            else:  # '}'
+                self._value_done()
+        else:  # pragma: no cover
+            raise AssertionError((phase, c))
+
+    _KEY_ESCAPES = {0x22: 0x22, 0x5C: 0x5C, 0x2F: 0x2F, 0x62: 0x08,
+                    0x66: 0x0C, 0x6E: 0x0A, 0x72: 0x0D, 0x74: 0x09}
+
+    def _key_advance(self, f: dict, key: dict, b: int) -> None:
+        # key["dec"] accumulates the DECODED key bytes (escapes resolved)
+        # so the close gate compares real names — "name" is "name"
+        if key["esc"] == "escape":
+            if b == b"u"[0]:
+                key["esc"] = "hex"
+                key["hexbuf"] = ""
+            else:
+                key["dec"].append(self._KEY_ESCAPES[b])
+                key["esc"] = None
+            return
+        if key["esc"] == "hex":
+            key["hexbuf"] += chr(b)
+            if len(key["hexbuf"]) == 4:
+                key["dec"] += chr(int(key["hexbuf"], 16)).encode("utf-8")
+                key["esc"] = None
+            return
+        if b == 0x22:  # closing quote: bind the key (mask vetted it)
+            name = bytes(key["dec"])
+            props = f["node"]["props"]
+            if name in props:
+                f["seen"].add(name)
+                f["vnode"] = props[name]
+            else:
+                f["vnode"] = f["node"]["addl"]
+            f["key"] = None
+            f["phase"] = "colon"
+            return
+        if b == 0x5C:
+            key["free"] = True  # escapes only make sense off-trie
+            key["esc"] = "escape"
+            return
+        key["dec"].append(b)
+        if not key["free"]:
+            nxt = [(nb, pn) for nb, pn in key["cands"]
+                   if len(nb) > key["pos"] and nb[key["pos"]] == b]
+            if nxt:
+                key["cands"] = nxt
+                key["pos"] += 1
+                return
+            key["free"] = True  # diverged → additionalProperties key
+        # free-mode content byte: tracked in dec above
+
+    def _arr_advance(self, f: dict, b: int) -> None:
+        if b in _WS:
+            return
+        c = bytes([b])
+        if c == b"]":
+            self._value_done()
+        elif c == b",":
+            self._stack.append({"t": "value", "node": f["node"]["items"]})
+        else:  # first element's first byte
+            self._start_value(_resolve_alt(f["node"]["items"], b), b)
+
+    def _str_advance(self, f: dict, b: int) -> None:
+        if f["sub"] == "escape":
+            if b == b"u"[0]:
+                f["sub"], f["hex_left"] = "hex", 4
+            else:
+                f["sub"] = "content"
+        elif f["sub"] == "hex":
+            f["hex_left"] -= 1
+            if f["hex_left"] == 0:
+                f["sub"] = "content"
+        elif b == 0x22:
+            self._value_done()
+        elif b == 0x5C:
+            f["sub"] = "escape"
+
+    def _num_advance(self, f: dict, b: int) -> None:
+        s = f["state"]
+        can_end = s in ("zero", "int", "frac", "exp")
+        if can_end and (b in _WS or bytes([b]) in (b",", b"}", b"]")):
+            self._value_done()
+            if b not in _WS:
+                self._dispatch(b)  # structural byte belongs to the parent
+            return
+        if s == "neg":
+            f["state"] = "zero" if b == 48 else "int"
+        elif s in ("zero", "int"):
+            if b == 46:  # '.'
+                f["state"] = "frac_start"
+            elif b in b"eE":
+                f["state"] = "exp_start"
+        elif s == "frac_start":
+            f["state"] = "frac"
+        elif s == "frac":
+            if b in b"eE":
+                f["state"] = "exp_start"
+        elif s == "exp_start":
+            f["state"] = "exp_sign" if b in b"+-" else "exp"
+        elif s == "exp_sign":
+            f["state"] = "exp"
+
+    def _enum_advance(self, f: dict, b: int) -> None:
+        conts = tuple(o for o in f["opts"]
+                      if len(o) > f["pos"] and o[f["pos"]] == b)
+        if conts:
+            f["opts"] = conts
+            f["pos"] += 1
+            self._enum_maybe_finish()
+            return
+        # termination byte of a completed option: belongs to the parent
+        self._value_done()
+        if b not in _WS:
+            self._dispatch(b)
+
+    def _enum_maybe_finish(self) -> None:
+        """Pop an enum frame the moment completion is unambiguous — no
+        surviving option continues past the consumed prefix.  (Ambiguous
+        prefixes, e.g. enum [1, 12], stay open until a terminator.)"""
+        f = self._stack[-1]
+        if all(len(o) == f["pos"] for o in f["opts"]):
+            self._value_done()
+
+
+def machine_for(params):
+    """The guided machine a request's sampling params ask for, or None."""
+    if getattr(params, "guided_schema", ""):
+        return SchemaByteMachine(compile_schema_str(params.guided_schema))
+    if params.guided_json:
+        return JsonByteMachine()
+    return None
 
 
 def build_token_byte_table(tokenizer, vocab_size: int) -> np.ndarray | None:

@@ -39,6 +39,9 @@ class SamplingParams:
     # OpenAI `response_format: json_object`: constrain output to valid
     # JSON via byte-level grammar masking (engine/guided.py)
     guided_json: bool = False
+    # OpenAI `response_format: json_schema`: canonical-JSON schema string
+    # compiled to a schema-constrained byte machine (guided.SchemaByteMachine)
+    guided_schema: str = ""
     # OpenAI `logit_bias`: additive per-token-id logit adjustments,
     # applied before sampling every step (±100 effectively bans/forces)
     logit_bias: tuple[tuple[int, float], ...] = ()

@@ -476,14 +476,35 @@ class EngineServer:
         max_tokens = int(mt) if mt is not None else 128
         rf = body.get("response_format")
         guided_json = False
+        guided_schema = ""
         if rf is not None:
             rf_type = rf.get("type") if isinstance(rf, dict) else rf
             if rf_type == "json_object":
                 guided_json = True
+            elif rf_type == "json_schema":
+                # OpenAI shape: {"type": "json_schema",
+                #   "json_schema": {"name": ..., "schema": {...}}}
+                js = rf.get("json_schema") if isinstance(rf, dict) else None
+                schema = js.get("schema") if isinstance(js, dict) else None
+                if not isinstance(schema, dict):
+                    raise ValueError(
+                        "response_format json_schema requires "
+                        "json_schema.schema to be an object")
+                from fusioninfer_tpu.engine.guided import (
+                    SchemaByteMachine,
+                    compile_schema_str,
+                )
+
+                guided_schema = json.dumps(schema, sort_keys=True,
+                                           separators=(",", ":"))
+                # compile here (memoized on the canonical string) so
+                # unsupported schemas 400 with the compiler's message,
+                # not a generic engine rejection
+                SchemaByteMachine(compile_schema_str(guided_schema))
             elif rf_type not in (None, "text"):
                 raise ValueError(
                     f"unsupported response_format type {rf_type!r}; "
-                    "supported: text, json_object"
+                    "supported: text, json_object, json_schema"
                 )
         return SamplingParams(
             temperature=float(body.get("temperature", 1.0)),
@@ -500,6 +521,7 @@ class EngineServer:
             seed=int(seed) if seed is not None else None,
             logprobs=logprobs,
             guided_json=guided_json,
+            guided_schema=guided_schema,
             logit_bias=logit_bias,
         )
 

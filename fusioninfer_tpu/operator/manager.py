@@ -128,7 +128,10 @@ class Manager:
                  leader_elect: bool = False,
                  leader_identity: str | None = None,
                  leader_election_config=None,
-                 metrics_auth: str = "none"):
+                 metrics_auth: str = "none",
+                 metrics_tls: bool = False,
+                 metrics_cert_path: str | None = None,
+                 metrics_key_path: str | None = None):
         """``leader_elect``: active/standby HA via a coordination.k8s.io
         Lease (the reference's ``--leader-elect``, cmd/main.go:80-82):
         controllers start only on acquiring the lease; losing it stops
@@ -141,7 +144,14 @@ class Manager:
         authn/authz FilterProvider, ``cmd/main.go:138-150``); the
         ``FUSIONINFER_METRICS_TOKEN`` env var provides a static-token
         mode for clusterless setups.  ``"none"`` serves plain (library /
-        test default)."""
+        test default).
+
+        ``metrics_tls``: serve metrics over HTTPS — the reference's
+        posture (``cmd/main.go:83-98``: secure :8443 with cert flags and
+        a cert watcher).  ``metrics_cert_path``/``metrics_key_path``
+        name the (rotatable, hot-reloaded) serving pair; when omitted a
+        self-signed pair is generated, exactly controller-runtime's
+        fallback."""
         if metrics_auth not in ("none", "token"):
             raise ValueError(f"metrics_auth must be 'none' or 'token', got {metrics_auth!r}")
         self.client = client
@@ -149,6 +159,10 @@ class Manager:
         self.probe_port = probe_port
         self.metrics_port = metrics_port
         self.metrics_auth = metrics_auth
+        self.metrics_tls = metrics_tls
+        self.metrics_cert_path = metrics_cert_path
+        self.metrics_key_path = metrics_key_path
+        self._cert_reloader = None
         # TokenReview verdict cache: token -> (authenticated, expiry);
         # guarded — ThreadingHTTPServer handlers race on it
         self._token_cache: dict[str, tuple[bool, float]] = {}
@@ -307,6 +321,8 @@ class Manager:
         mgr = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            timeout = 30  # bounds the deferred TLS handshake + request read
+
             def do_GET(self):
                 if self.path == "/metrics":
                     if not mgr._authorize_metrics(self.headers.get("Authorization")):
@@ -328,6 +344,33 @@ class Manager:
                 pass
 
         server = http.server.ThreadingHTTPServer(("", self.metrics_port), Handler)
+        if self.metrics_tls:
+            from fusioninfer_tpu.operator import tlsutil
+
+            import os as _os
+
+            cert, key = self.metrics_cert_path, self.metrics_key_path
+            if not cert or not key or not (
+                    _os.path.exists(cert) and _os.path.exists(key)):
+                # controller-runtime fallback: self-signed when no cert
+                # pair is flagged/mounted (reference cmd/main.go:83-98;
+                # the deployment's secret mount is optional)
+                import tempfile
+
+                d = tempfile.mkdtemp(prefix="fusioninfer-metrics-tls-")
+                cert, key = f"{d}/tls.crt", f"{d}/tls.key"
+                tlsutil.generate_self_signed(cert, key)
+                self.metrics_cert_path, self.metrics_key_path = cert, key
+            ctx = tlsutil.build_server_context(cert, key)
+            self._cert_reloader = tlsutil.CertReloader(ctx, cert, key).start()
+            # handshake DEFERRED to the per-connection handler thread
+            # (first read triggers it): with the default eager handshake
+            # a single idle TCP client would wedge the accept loop and
+            # every subsequent scrape; Handler.timeout bounds the
+            # handler-side handshake instead
+            server.socket = ctx.wrap_socket(
+                server.socket, server_side=True,
+                do_handshake_on_connect=False)
         threading.Thread(target=server.serve_forever, daemon=True).start()
         self._metrics_server = server
 
@@ -391,6 +434,8 @@ class Manager:
         close = getattr(self.client, "close_watches", None)
         if close is not None:
             close()
+        if self._cert_reloader is not None:
+            self._cert_reloader.stop()
         for attr in ("_probe_server", "_metrics_server"):
             server = getattr(self, attr, None)
             if server is not None:

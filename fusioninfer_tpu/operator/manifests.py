@@ -177,6 +177,11 @@ def manager_deployment() -> dict:
                                 "python", "-m", "fusioninfer_tpu.cli",
                                 "controller", "run", "--leader-elect",
                                 "--metrics-auth=token",
+                                # serve HTTPS from the mounted pair when
+                                # the (optional) secret exists; the flag
+                                # falls back to a generated self-signed
+                                # cert when the mount is empty
+                                "--metrics-cert-path=/tmp/k8s-metrics-server/metrics-certs",
                             ],
                             "env": [
                                 {
@@ -206,8 +211,27 @@ def manager_deployment() -> dict:
                                 "limits": {"cpu": "500m", "memory": "256Mi"},
                                 "requests": {"cpu": "10m", "memory": "128Mi"},
                             },
+                            "volumeMounts": [{
+                                "name": "metrics-certs",
+                                "mountPath":
+                                    "/tmp/k8s-metrics-server/metrics-certs",
+                                "readOnly": True,
+                            }],
                         }
                     ],
+                    "volumes": [{
+                        # optional: when cert-manager (or the operator's
+                        # admin) provisions `metrics-server-cert`, the
+                        # manager serves it (hot-reloading rotations);
+                        # otherwise it generates a self-signed pair —
+                        # mirrors the reference's commented cert-manager
+                        # wiring (config/default/kustomization.yaml)
+                        "name": "metrics-certs",
+                        "secret": {
+                            "secretName": "metrics-server-cert",
+                            "optional": True,
+                        },
+                    }],
                 },
             },
         },
@@ -232,6 +256,12 @@ def service_monitor() -> dict:
                 # — see rbac/metrics_reader_role_binding.yaml)
                 "bearerTokenFile":
                     "/var/run/secrets/kubernetes.io/serviceaccount/token",
+                # metrics serve HTTPS (self-signed unless cert-manager
+                # provisions metrics-server-cert) — skip verification the
+                # same way the reference's ServiceMonitor does
+                # (config/prometheus/monitor.yaml insecureSkipVerify)
+                "scheme": "https",
+                "tlsConfig": {"insecureSkipVerify": True},
             }],
             "selector": {"matchLabels": {"control-plane": "controller-manager"}},
         },
@@ -279,19 +309,24 @@ def _metrics_service() -> dict:
 
 
 def external_crd(group: str, version: str, kind: str, plural: str,
-                 singular: str, short_names: list[str] | None = None) -> dict:
-    """Minimal structural CRD for an EXTERNAL kind the operator creates
-    (LWS, PodGroup, InferencePool, HTTPRoute) or references (Gateway —
-    created by the user, named by HTTPRoute parentRefs; vendored so a
-    bare apiserver can hold the full object graph, same as the
-    reference's set).
+                 singular: str, short_names: list[str] | None = None,
+                 spec_schema: dict | None = None) -> dict:
+    """Structural CRD for an EXTERNAL kind the operator creates (LWS,
+    PodGroup, InferencePool, HTTPRoute) or references (Gateway — created
+    by the user, named by HTTPRoute parentRefs; vendored so a bare
+    apiserver can hold the full object graph, same as the reference's
+    set).
 
     The reference vendors the upstream projects' full generated schemas
-    (``config/crd/external/``) so envtest can accept the objects the
-    controller renders; these serve the same purpose for the in-repo
-    integration tier and any cluster lacking the upstream installs, but
-    are deliberately permissive — ``x-kubernetes-preserve-unknown-fields``
-    on spec/status — because the upstream controllers own validation.
+    (``config/crd/external/``) so envtest REJECTS structurally invalid
+    objects the controller renders (``suite_test.go:88-94``);
+    ``spec_schema`` carries the structural schema for the fields OUR
+    builders render (types / required / bounds for the LWS spec tree,
+    PodGroup minTaskMember/minResources, InferencePool
+    selector/endpointPickerRef, HTTPRoute rules), enforced by the
+    integration tier's ``HTTPApiServer`` via ``operator/schema.py``.
+    Kinds whose content the operator never authors (Gateway) stay
+    permissive — the upstream controllers own their validation.
     """
     versions = [{
         "name": version,
@@ -301,8 +336,9 @@ def external_crd(group: str, version: str, kind: str, plural: str,
             "openAPIV3Schema": {
                 "type": "object",
                 "properties": {
-                    "spec": {"type": "object",
-                             "x-kubernetes-preserve-unknown-fields": True},
+                    "spec": spec_schema or {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True},
                     "status": {"type": "object",
                                "x-kubernetes-preserve-unknown-fields": True},
                 },
@@ -328,22 +364,179 @@ def external_crd(group: str, version: str, kind: str, plural: str,
     }
 
 
+# a pod template: metadata/spec both present but upstream-owned — the
+# kubelet/api machinery validates PodSpecs, not these vendored CRDs
+_POD_TEMPLATE_SCHEMA: dict = {
+    "type": "object",
+    "properties": {
+        "metadata": {"type": "object",
+                     "x-kubernetes-preserve-unknown-fields": True},
+        "spec": {"type": "object",
+                 "x-kubernetes-preserve-unknown-fields": True},
+    },
+}
+
+# LWS API v1 (leaderworkerset.x-k8s.io): the fields workload/lws.py
+# renders — size is topology-derived (hosts per slice) and MUST be an
+# integer ≥ 1; a wrong type here previously passed every in-repo test
+_LWS_SPEC_SCHEMA: dict = {
+    "type": "object",
+    "required": ["leaderWorkerTemplate"],
+    "properties": {
+        "replicas": {"type": "integer", "minimum": 0},
+        "startupPolicy": {"type": "string",
+                          "enum": ["LeaderCreated", "LeaderReady"]},
+        "leaderWorkerTemplate": {
+            "type": "object",
+            "required": ["size", "workerTemplate"],
+            "properties": {
+                "size": {"type": "integer", "minimum": 1},
+                "restartPolicy": {
+                    "type": "string",
+                    "enum": ["RecreateGroupOnRestart", "Default",
+                             "None"]},
+                "leaderTemplate": _POD_TEMPLATE_SCHEMA,
+                "workerTemplate": _POD_TEMPLATE_SCHEMA,
+            },
+        },
+    },
+}
+
+# Volcano v1beta1 PodGroup: scheduling/podgroup.py renders gang counts
+# keyed "{role}-{replica}" and chip sums as resource quantities
+_PODGROUP_SPEC_SCHEMA: dict = {
+    "type": "object",
+    "required": ["minMember"],
+    "properties": {
+        "minMember": {"type": "integer", "minimum": 0},
+        "minTaskMember": {
+            "type": "object",
+            "additionalProperties": {"type": "integer", "minimum": 0},
+        },
+        "minResources": {
+            "type": "object",
+            "additionalProperties": {"x-kubernetes-int-or-string": True},
+        },
+        "queue": {"type": "string"},
+        "priorityClassName": {"type": "string"},
+    },
+}
+
+# Gateway API Inference Extension v1 InferencePool:
+# router/inferencepool.py renders the leader-only selector and the EPP
+# extension reference
+_INFERENCEPOOL_SPEC_SCHEMA: dict = {
+    "type": "object",
+    "required": ["selector", "targetPorts", "endpointPickerRef"],
+    "properties": {
+        "selector": {
+            "type": "object",
+            "properties": {
+                "matchLabels": {
+                    "type": "object",
+                    "additionalProperties": {"type": "string"},
+                },
+            },
+        },
+        "targetPorts": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["number"],
+                "properties": {"number": {"type": "integer", "minimum": 1,
+                                          "maximum": 65535}},
+            },
+        },
+        "endpointPickerRef": {
+            "type": "object",
+            "required": ["name"],
+            "properties": {
+                "group": {"type": "string"},
+                "kind": {"type": "string"},
+                "name": {"type": "string"},
+                "port": {"type": "object",
+                         "properties": {"number": {"type": "integer",
+                                                   "minimum": 1,
+                                                   "maximum": 65535}}},
+            },
+        },
+    },
+}
+
+# Gateway API v1 HTTPRoute: user parentRefs/hostnames pass through,
+# rules are force-overwritten by router/httproute.py with the
+# InferencePool backendRef
+_HTTPROUTE_SPEC_SCHEMA: dict = {
+    "type": "object",
+    "properties": {
+        "parentRefs": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["name"],
+                "properties": {
+                    "group": {"type": "string"},
+                    "kind": {"type": "string"},
+                    "name": {"type": "string"},
+                    "namespace": {"type": "string"},
+                    "sectionName": {"type": "string"},
+                    "port": {"type": "integer", "minimum": 1,
+                             "maximum": 65535},
+                },
+            },
+        },
+        "hostnames": {"type": "array", "items": {"type": "string"}},
+        "rules": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "matches": {
+                        "type": "array",
+                        "items": {"type": "object",
+                                  "x-kubernetes-preserve-unknown-fields": True},
+                    },
+                    "backendRefs": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["name"],
+                            "properties": {
+                                "group": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "name": {"type": "string"},
+                                "namespace": {"type": "string"},
+                                "port": {"type": "integer", "minimum": 1,
+                                         "maximum": 65535},
+                                "weight": {"type": "integer"},
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
 EXTERNAL_CRDS: dict[str, dict] = {
     "lws.yaml": external_crd(
         "leaderworkerset.x-k8s.io", "v1", "LeaderWorkerSet",
         "leaderworkersets", "leaderworkerset", short_names=["lws"],
+        spec_schema=_LWS_SPEC_SCHEMA,
     ),
     "podgroup.yaml": external_crd(
         "scheduling.volcano.sh", "v1beta1", "PodGroup", "podgroups", "podgroup",
-        short_names=["pg"],
+        short_names=["pg"], spec_schema=_PODGROUP_SPEC_SCHEMA,
     ),
     "inferencepool.yaml": external_crd(
         "inference.networking.k8s.io", "v1", "InferencePool",
         "inferencepools", "inferencepool",
+        spec_schema=_INFERENCEPOOL_SPEC_SCHEMA,
     ),
     "httproute.yaml": external_crd(
         "gateway.networking.k8s.io", "v1", "HTTPRoute", "httproutes",
-        "httproute",
+        "httproute", spec_schema=_HTTPROUTE_SPEC_SCHEMA,
     ),
     "gateway.yaml": external_crd(
         "gateway.networking.k8s.io", "v1", "Gateway", "gateways", "gateway",

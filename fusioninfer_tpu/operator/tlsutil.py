@@ -1,0 +1,155 @@
+"""TLS for the manager's metrics endpoint.
+
+The reference serves metrics over HTTPS with watched certificates and
+authn/authz filters (``/root/reference/cmd/main.go:83-98,138-150`` —
+``--metrics-cert-path`` flags into controller-runtime's metrics server,
+which generates a self-signed certificate when no cert dir is given and
+hot-reloads on rotation).  Round 3 closed the authn half (TokenReview
+bearer gate); this module closes the transport half:
+
+* :func:`generate_self_signed` — the no-flags default, matching
+  controller-runtime's self-signed fallback;
+* :func:`build_server_context` — an ``ssl.SSLContext`` from cert/key
+  files;
+* :class:`CertReloader` — mtime-watching hot reload so cert-manager
+  rotation (the reference's cert watcher) doesn't require a restart:
+  ``SSLContext.load_cert_chain`` on a live context applies to new
+  handshakes.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import ssl
+import threading
+
+logger = logging.getLogger("fusioninfer.tls")
+
+
+def generate_self_signed(cert_path: str, key_path: str,
+                         cn: str = "fusioninfer-metrics",
+                         days: int = 365) -> None:
+    """Write a self-signed cert/key pair (RSA-2048, SANs for localhost
+    loopback scraping) — the controller-runtime fallback when no
+    ``--metrics-cert-path`` is configured.  Uses ``cryptography`` when
+    importable, else the ``openssl`` CLI, so a slim controller image
+    never CrashLoops on the default (no-cert-secret) install."""
+    try:
+        from cryptography import x509  # noqa: F401
+    except ImportError:
+        return _generate_self_signed_openssl(cert_path, key_path, cn, days)
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    import ipaddress
+
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(
+            x509.SubjectAlternativeName([
+                x509.DNSName(cn),
+                x509.DNSName("localhost"),
+                x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+            ]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    os.makedirs(os.path.dirname(cert_path) or ".", exist_ok=True)
+    with open(key_path, "wb") as f:
+        os.fchmod(f.fileno(), 0o600)
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        ))
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    logger.info("generated self-signed metrics certificate at %s", cert_path)
+
+
+def _generate_self_signed_openssl(cert_path: str, key_path: str,
+                                  cn: str, days: int) -> None:
+    import subprocess
+
+    os.makedirs(os.path.dirname(cert_path) or ".", exist_ok=True)
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key_path, "-out", cert_path, "-days", str(days),
+         "-subj", f"/CN={cn}",
+         "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    os.chmod(key_path, 0o600)
+    logger.info("generated self-signed metrics certificate via openssl")
+
+
+def build_server_context(cert_path: str, key_path: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+class CertReloader:
+    """Hot-reload the serving certificate on file rotation (cert-manager
+    style): polls mtimes and re-loads the chain into the LIVE context —
+    new handshakes pick up the new certificate, no restart."""
+
+    def __init__(self, ctx: ssl.SSLContext, cert_path: str, key_path: str,
+                 interval_s: float = 60.0):
+        self.ctx = ctx
+        self.cert_path = cert_path
+        self.key_path = key_path
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._mtimes = self._read_mtimes()
+        self._thread: threading.Thread | None = None
+
+    def _read_mtimes(self) -> tuple:
+        try:
+            return (os.stat(self.cert_path).st_mtime,
+                    os.stat(self.key_path).st_mtime)
+        except OSError:
+            return (0.0, 0.0)
+
+    def check_once(self) -> bool:
+        """Reload if rotated; True when a reload happened."""
+        mtimes = self._read_mtimes()
+        if mtimes == self._mtimes:
+            return False
+        try:
+            self.ctx.load_cert_chain(self.cert_path, self.key_path)
+        except (OSError, ssl.SSLError) as e:
+            # half-written rotation: keep serving the old cert, retry
+            logger.warning("metrics cert reload failed (%s); keeping old", e)
+            return False
+        self._mtimes = mtimes
+        logger.info("metrics certificate reloaded from %s", self.cert_path)
+        return True
+
+    def start(self) -> "CertReloader":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.check_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="metrics-cert-reload")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
