@@ -288,13 +288,11 @@ def compile_schema(schema) -> dict:
         return {"kind": "enum", "opts": tuple(_dump(v) for v in values)}
     for key in ("anyOf", "oneOf"):
         if key in schema:
-            return {"kind": "union",
-                    "alts": tuple(compile_schema(s) for s in schema[key])}
+            return _union(tuple(compile_schema(s) for s in schema[key]))
     t = schema.get("type")
     if isinstance(t, list):
-        return {"kind": "union",
-                "alts": tuple(compile_schema(dict(schema, type=tt))
-                              for tt in t)}
+        return _union(tuple(compile_schema(dict(schema, type=tt))
+                            for tt in t))
     if t == "object":
         props = {
             name.encode(): compile_schema(sub)
@@ -345,6 +343,26 @@ def _coerce_bool_schema(s):
     if s is False:
         raise ValueError("'false' subschemas cannot guide generation")
     return s
+
+
+def _union(alts: tuple) -> dict:
+    """Union node, valid only when the first byte DECIDES the
+    alternative — otherwise generation would silently commit to
+    whichever alternative matched first (e.g. anyOf of two object
+    shapes, or ["integer", "number"]), making the others unreachable.
+    Per this module's contract that is a loud admission-time rejection,
+    not a silent narrowing."""
+    if len(alts) == 1:
+        return alts[0]
+    for i, a in enumerate(alts):
+        for b in alts[i + 1:]:
+            if (_first_byte_mask(a) & _first_byte_mask(b)).any():
+                raise ValueError(
+                    "anyOf/oneOf/type-list alternatives must be "
+                    "distinguishable by their first byte (e.g. "
+                    '["string", "null"]); overlapping alternatives '
+                    "cannot be byte-wise enforced")
+    return {"kind": "union", "alts": alts}
 
 
 @_functools.lru_cache(maxsize=256)
@@ -685,7 +703,13 @@ class SchemaByteMachine:
         if key["esc"] == "hex":
             key["hexbuf"] += chr(b)
             if len(key["hexbuf"]) == 4:
-                key["dec"] += chr(int(key["hexbuf"], 16)).encode("utf-8")
+                # surrogatepass: lone surrogates (\uD800-\uDFFF halves of
+                # a pair) are legal JSON escapes; plain utf-8 encoding
+                # raises on them, and the mask already admitted the hex
+                # digits — dec is only compared against declared names
+                # (real UTF-8), which WTF-8 surrogate bytes never equal
+                key["dec"] += chr(int(key["hexbuf"], 16)).encode(
+                    "utf-8", "surrogatepass")
                 key["esc"] = None
             return
         if b == 0x22:  # closing quote: bind the key (mask vetted it)

@@ -350,19 +350,28 @@ class Manager:
             import os as _os
 
             cert, key = self.metrics_cert_path, self.metrics_key_path
+            watch_cert, watch_key = cert, key
             if not cert or not key or not (
                     _os.path.exists(cert) and _os.path.exists(key)):
                 # controller-runtime fallback: self-signed when no cert
                 # pair is flagged/mounted (reference cmd/main.go:83-98;
-                # the deployment's secret mount is optional)
+                # the deployment's secret mount is optional).  When paths
+                # WERE configured but the files aren't there yet (cert-
+                # manager racing pod start), keep the reloader watching
+                # the configured paths — the provisioned pair hot-swaps
+                # in without a restart
                 import tempfile
 
                 d = tempfile.mkdtemp(prefix="fusioninfer-metrics-tls-")
-                cert, key = f"{d}/tls.crt", f"{d}/tls.key"
-                tlsutil.generate_self_signed(cert, key)
+                self_cert, self_key = f"{d}/tls.crt", f"{d}/tls.key"
+                tlsutil.generate_self_signed(self_cert, self_key)
+                if not cert or not key:
+                    watch_cert, watch_key = self_cert, self_key
+                cert, key = self_cert, self_key
                 self.metrics_cert_path, self.metrics_key_path = cert, key
             ctx = tlsutil.build_server_context(cert, key)
-            self._cert_reloader = tlsutil.CertReloader(ctx, cert, key).start()
+            self._cert_reloader = tlsutil.CertReloader(
+                ctx, watch_cert, watch_key).start()
             # handshake DEFERRED to the per-connection handler thread
             # (first read triggers it): with the default eager handshake
             # a single idle TCP client would wedge the accept loop and

@@ -307,3 +307,43 @@ class TestExternalCRDs:
         for fname, crd in EXTERNAL_CRDS.items():
             on_disk = _yaml.safe_load((ext_dir / fname).read_text())
             assert on_disk == crd, f"{fname} drifted; run make manifests"
+
+
+class TestMetricsCertProvisioningRace:
+    def test_configured_paths_hot_swap_when_provisioned(self, api, client,
+                                                        tmp_path):
+        """cert-manager racing pod start: flagged paths empty at startup
+        serve a self-signed pair, and the provisioned pair hot-swaps in
+        without restart (the reloader watches the CONFIGURED paths)."""
+        import ssl
+
+        cert, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+        mgr = Manager(client, namespace="default", probe_port=0,
+                      metrics_port=0, metrics_tls=True,
+                      metrics_cert_path=cert, metrics_key_path=key)
+        mgr.start()
+        try:
+            port = mgr._metrics_server.server_address[1]
+            # serving the self-signed fallback, watching the flag paths
+            assert mgr._cert_reloader.cert_path == cert
+            assert mgr.metrics_cert_path != cert
+
+            from fusioninfer_tpu.operator import tlsutil
+
+            tlsutil.generate_self_signed(cert, key, cn="provisioned-cert")
+            assert mgr._cert_reloader.check_once() is True
+
+            import socket
+
+            raw = socket.create_connection(("127.0.0.1", port), timeout=10)
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with ctx.wrap_socket(raw) as s:
+                der = s.getpeercert(binary_form=True)
+            from cryptography import x509
+
+            assert "provisioned-cert" in x509.load_der_x509_certificate(
+                der).subject.rfc4514_string()
+        finally:
+            mgr.stop()

@@ -499,3 +499,27 @@ class TestSchemaReviewHardening:
 
         s = json.dumps(_SCHEMA, sort_keys=True, separators=(",", ":"))
         assert compile_schema_str(s) is compile_schema_str(s)
+
+
+class TestSchemaRound4ReviewFixes:
+    def test_surrogate_escape_key_does_not_crash(self):
+        """\\uD83D (half an emoji pair) is a legal JSON key escape; the
+        mask admits its hex digits so advance must not raise."""
+        s = {"type": "object", "properties": {"a": {"type": "integer"}}}
+        m = SchemaByteMachine(compile_schema(s))
+        for b in b'{"\\ud83d\\ude00": 1}':
+            m.advance(b)
+        assert m.done
+
+    def test_ambiguous_union_rejected_at_compile(self):
+        for bad in ({"type": ["integer", "number"]},
+                    {"anyOf": [{"type": "object",
+                                "properties": {"a": {"type": "string"}}},
+                               {"type": "object",
+                                "properties": {"b": {"type": "string"}}}]},
+                    {"anyOf": [{"const": "ab"}, {"type": "string"}]}):
+            with pytest.raises(ValueError, match="first byte"):
+                compile_schema(bad)
+        # distinguishable unions still compile
+        compile_schema({"type": ["string", "null"]})
+        compile_schema({"anyOf": [{"type": "number"}, {"type": "boolean"}]})
