@@ -394,7 +394,7 @@ def _first_byte_mask(node) -> np.ndarray:
             m |= _first_byte_mask(alt)
         return m
     if kind == "any":
-        return _mask(_WS, b'{["-tfn', _DIGITS)
+        return _mask(b'{["-tfn', _DIGITS)
     raise AssertionError(kind)
 
 
@@ -437,6 +437,13 @@ class SchemaByteMachine:
     walk a byte-trie of the declared properties, '}' requires every
     ``required`` key seen, arrays enforce min/maxItems, enums emit one
     of their serialized options byte-for-byte.
+
+    Output is COMPACT: inter-token whitespace is masked (unlike the
+    ``json_object`` machine, which allows it).  Every emitted byte then
+    makes progress toward completion — optional whitespace both wastes
+    tokens on a real model and lets a weak model meander to max_tokens
+    without ever closing the object (xgrammar's default is compact for
+    the same reason).
     """
 
     def __init__(self, node: dict):
@@ -461,7 +468,7 @@ class SchemaByteMachine:
         f = self._stack[idx]
         t = f["t"]
         if t == "value":
-            return _first_byte_mask(f["node"]) | _mask(_WS)
+            return _first_byte_mask(f["node"])
         if t == "obj":
             return self._obj_allowed(f)
         if t == "arr":
@@ -477,7 +484,7 @@ class SchemaByteMachine:
                     m |= _mask(b",")
                 if f["count"] >= node["min"]:
                     m |= _mask(b"]")
-            return m | _mask(_WS)
+            return m
         if t == "str":
             if f["sub"] == "escape":
                 return _mask(_ESCAPABLE)
@@ -491,7 +498,7 @@ class SchemaByteMachine:
                            if len(o) > f["pos"]})
             m = _mask(conts)
             if any(len(o) == f["pos"] for o in f["opts"]):
-                m |= self._after_pop_allowed(idx) | _mask(_WS)
+                m |= self._after_pop_allowed(idx)
             return m
         raise AssertionError(t)
 
@@ -500,7 +507,7 @@ class SchemaByteMachine:
         key = f.get("key")
         if key is not None:
             return self._key_allowed(f, key)
-        m = _mask(_WS)
+        m = np.zeros(256, bool)
         if phase in ("first", "key_required"):
             unseen = [nb for nb in node["props"] if nb not in f["seen"]]
             if unseen or node["addl"] is not None:
@@ -564,7 +571,7 @@ class SchemaByteMachine:
         }[s]
         if f["integer"] and s in ("zero", "int"):
             cont = cont.replace(b".", b"")
-        return _mask(cont) | self._after_pop_allowed(idx) | _mask(_WS)
+        return _mask(cont) | self._after_pop_allowed(idx)
 
     def _after_pop_allowed(self, idx: int) -> np.ndarray:
         """What the parent would allow right after this frame completes
@@ -609,8 +616,6 @@ class SchemaByteMachine:
         f = self._stack[-1]
         t = f["t"]
         if t == "value":
-            if b in _WS:
-                return
             self._stack.pop()
             self._start_value(_resolve_alt(f["node"], b), b)
         elif t == "obj":
@@ -663,8 +668,6 @@ class SchemaByteMachine:
         key = f.get("key")
         if key is not None:
             return self._key_advance(f, key, b)
-        if b in _WS:
-            return
         node, phase = f["node"], f["phase"]
         c = bytes([b])
         if phase in ("first", "key_required") and c == b'"':
@@ -739,8 +742,6 @@ class SchemaByteMachine:
         # free-mode content byte: tracked in dec above
 
     def _arr_advance(self, f: dict, b: int) -> None:
-        if b in _WS:
-            return
         c = bytes([b])
         if c == b"]":
             self._value_done()
@@ -767,10 +768,9 @@ class SchemaByteMachine:
     def _num_advance(self, f: dict, b: int) -> None:
         s = f["state"]
         can_end = s in ("zero", "int", "frac", "exp")
-        if can_end and (b in _WS or bytes([b]) in (b",", b"}", b"]")):
+        if can_end and bytes([b]) in (b",", b"}", b"]"):
             self._value_done()
-            if b not in _WS:
-                self._dispatch(b)  # structural byte belongs to the parent
+            self._dispatch(b)  # structural byte belongs to the parent
             return
         if s == "neg":
             f["state"] = "zero" if b == 48 else "int"
@@ -799,8 +799,7 @@ class SchemaByteMachine:
             return
         # termination byte of a completed option: belongs to the parent
         self._value_done()
-        if b not in _WS:
-            self._dispatch(b)
+        self._dispatch(b)
 
     def _enum_maybe_finish(self) -> None:
         """Pop an enum frame the moment completion is unambiguous — no

@@ -542,10 +542,19 @@ class EngineServer:
         otherwise leak and keep decoding for a dead client."""
         if chat:
             body = self._chat_logprobs_body(body)
-            messages = body.get("messages", [])
-            prompt = "".join(
-                f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
-            ) + "<|assistant|>"
+            by_name, choice = self._parse_tools(body)
+            if by_name and choice not in ("none", "auto"):
+                # a forced call streams as tool_calls deltas in OpenAI's
+                # protocol; this server assembles calls from the full
+                # text — reject up front rather than stream a shape the
+                # client's SDK won't parse.  (tool_choice "auto" streams
+                # as ordinary content: opportunistic call assembly is a
+                # non-stream feature, documented in docs/design/engine.md)
+                raise ValueError(
+                    "tool_choice 'required' / named-function is not "
+                    "supported with stream=true; use stream=false")
+            prompt = self._chat_prompt(body.get("messages", []),
+                                       body.get("tools"), choice)
         else:
             prompt = body.get("prompt", "")
             if isinstance(prompt, list):
@@ -963,31 +972,172 @@ class EngineServer:
             })
         return {"content": content}
 
+    # -- tools / function calling --------------------------------------------
+
+    @staticmethod
+    def _parse_tools(body: dict) -> tuple[dict, object]:
+        """Validate OpenAI ``tools`` + ``tool_choice``; returns
+        (tools-by-name, choice) where choice is "auto" / "none" /
+        "required" / a specific tool name."""
+        tools = body.get("tools") or []
+        if not isinstance(tools, list):
+            raise ValueError("tools must be a list")
+        by_name: dict[str, dict] = {}
+        for t in tools:
+            fn = (t or {}).get("function") if isinstance(t, dict) else None
+            if (not isinstance(t, dict) or t.get("type") != "function"
+                    or not isinstance(fn, dict) or not fn.get("name")):
+                raise ValueError(
+                    "each tool must be {type: 'function', function: {name, "
+                    "...}}")
+            if fn["name"] in by_name:
+                # ambiguous: a forced call would silently bind whichever
+                # definition came last
+                raise ValueError(f"duplicate tool name {fn['name']!r}")
+            by_name[fn["name"]] = fn
+        choice = body.get("tool_choice", "auto" if by_name else "none")
+        if isinstance(choice, dict):
+            name = ((choice.get("function") or {}).get("name")
+                    if choice.get("type") == "function" else None)
+            if not name or name not in by_name:
+                raise ValueError(
+                    f"tool_choice names unknown function {name!r}")
+            choice = name
+        elif choice not in ("auto", "none", "required"):
+            raise ValueError(
+                "tool_choice must be 'auto', 'none', 'required' or "
+                "{'type': 'function', 'function': {'name': ...}}")
+        if choice == "required" and not by_name:
+            raise ValueError("tool_choice 'required' needs tools")
+        return by_name, choice
+
+    @staticmethod
+    def _tool_call_schema(by_name: dict, choice) -> dict:
+        """The json_schema constraining a forced tool call.  A single
+        known target (named choice, or 'required' with one tool) also
+        constrains ``arguments`` to that function's parameters schema;
+        with several candidate tools the argument shape depends on the
+        generated name, which a byte machine cannot condition on — the
+        name stays enum-constrained and arguments are any object."""
+        if choice in by_name:
+            targets = [choice]
+        else:  # "required"
+            targets = list(by_name)
+        if len(targets) == 1:
+            params = by_name[targets[0]].get("parameters") or {"type": "object"}
+            return {"type": "object",
+                    "properties": {"name": {"const": targets[0]},
+                                   "arguments": params},
+                    "required": ["name", "arguments"],
+                    "additionalProperties": False}
+        return {"type": "object",
+                "properties": {"name": {"enum": targets},
+                               "arguments": {"type": "object"}},
+                "required": ["name", "arguments"],
+                "additionalProperties": False}
+
+    @staticmethod
+    def _as_tool_call(text: str, by_name: dict) -> dict | None:
+        """Parse generated text as a {"name", "arguments"} call against
+        the declared tools; None when it isn't one (auto mode)."""
+        try:
+            doc = json.loads(text)
+        except ValueError:
+            return None
+        if (not isinstance(doc, dict) or set(doc) != {"name", "arguments"}
+                or doc["name"] not in by_name
+                or not isinstance(doc["arguments"], dict)):
+            return None
+        return {
+            "id": f"call_{uuid.uuid4().hex[:24]}",
+            "type": "function",
+            "function": {"name": doc["name"],
+                         # OpenAI serializes arguments as a JSON string
+                         "arguments": json.dumps(doc["arguments"])},
+        }
+
+    @staticmethod
+    def _chat_prompt(messages: list, tools: list | None = None,
+                     choice="none") -> str:
+        """Flatten chat history (and, unless tool_choice is "none", the
+        tool definitions) into the serving prompt — the ONE place the
+        tools-in-prompt decision lives, shared by the stream and
+        non-stream paths."""
+        parts = []
+        if tools and choice != "none":
+            parts.append(f"<|tools|>{json.dumps(tools)}")
+        for m in messages:
+            role = m.get("role", "user")
+            content = m.get("content")  # None on assistant tool-call turns
+            if isinstance(content, list):
+                # OpenAI array-of-parts content
+                texts = []
+                for p in content:
+                    if not isinstance(p, dict) or p.get("type") != "text":
+                        raise ValueError(
+                            "only text content parts are supported")
+                    texts.append(p.get("text") or "")
+                content = "".join(texts)
+            elif content is None:
+                content = ""
+            elif not isinstance(content, str):
+                raise ValueError("message content must be a string, a list "
+                                 "of text parts, or null")
+            if m.get("tool_calls"):  # carry history faithfully
+                content += json.dumps(m["tool_calls"])
+            if role == "tool" and m.get("tool_call_id"):
+                content = f"[{m['tool_call_id']}] {content}"
+            parts.append(f"<|{role}|>{content}")
+        return "".join(parts) + "<|assistant|>"
+
     def handle_chat(self, body: dict) -> dict:
         messages = body.get("messages", [])
-        prompt = "".join(
-            f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
-        ) + "<|assistant|>"
+        by_name, choice = self._parse_tools(body)
+        prompt = self._chat_prompt(messages, body.get("tools"), choice)
+        inner = {**self._chat_logprobs_body(body), "prompt": prompt,
+                 "echo": False}
+        forced = by_name and choice not in ("none", "auto")
+        if forced:
+            if body.get("response_format") is not None:
+                # the forced call IS the response format; silently
+                # replacing the user's schema would 200 the wrong contract
+                raise ValueError(
+                    "response_format cannot be combined with a forced "
+                    "tool_choice (the tool call defines the output shape)")
+            # guided generation GUARANTEES a well-formed call
+            inner["response_format"] = {
+                "type": "json_schema",
+                "json_schema": {"name": "tool_call",
+                                "schema": self._tool_call_schema(
+                                    by_name, choice)}}
         # `echo` is a completions-only knob: echoing here would leak the
         # internal chat template into message content
-        completion = self.handle_completion(
-            {**self._chat_logprobs_body(body), "prompt": prompt,
-             "echo": False})
+        completion = self.handle_completion(inner)
+        choices = []
+        for c in completion["choices"]:
+            call = (self._as_tool_call(c["text"], by_name)
+                    if by_name and choice != "none" else None)
+            if call is not None:
+                message = {"role": "assistant", "content": None,
+                           "tool_calls": [call]}
+                finish = ("tool_calls" if c["finish_reason"] == "stop"
+                          else c["finish_reason"])
+            else:
+                message = {"role": "assistant", "content": c["text"]}
+                finish = c["finish_reason"]
+            choices.append({
+                "index": c["index"],
+                "message": message,
+                "finish_reason": finish,
+                "logprobs": self._chat_logprobs_obj(c.get("logprobs")),
+            })
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
             "object": "chat.completion",
             "created": completion["created"],
             "model": completion["model"],
             "system_fingerprint": _FINGERPRINT,
-            "choices": [
-                {
-                    "index": c["index"],
-                    "message": {"role": "assistant", "content": c["text"]},
-                    "finish_reason": c["finish_reason"],
-                    "logprobs": self._chat_logprobs_obj(c.get("logprobs")),
-                }
-                for c in completion["choices"]
-            ],
+            "choices": choices,
             "usage": completion["usage"],
         }
 

@@ -282,25 +282,26 @@ def _schema_accepts(schema: dict, text: str) -> bool:
 
 class TestSchemaByteMachine:
     @pytest.mark.parametrize("doc", [
-        '{"name": "bob", "age": 3, "kind": "cat"}',
-        '{"age": 0, "kind": 3, "name": ""}',  # any key order; 0 legal
-        '{"name": "a", "age": -12, "kind": "dog", "tags": ["x"]}',
-        '{"name": "a", "age": 7, "kind": "dog", "tags": ["x", "y", "z"], "ok": true}',
-        ' { "name" : "s p a c e" , "age" : 42 , "kind" : "cat" }',
+        '{"name":"bob","age":3,"kind":"cat"}',
+        '{"age":0,"kind":3,"name":""}',  # any key order; 0 legal
+        '{"name":"a","age":-12,"kind":"dog","tags":["x"]}',
+        '{"name":"a","age":7,"kind":"dog","tags":["x","y","z"],"ok":true}',
+        '{"name":"s p a c e","age":42,"kind":"cat"}',
     ])
     def test_accepts_conforming(self, doc):
         assert _schema_accepts(_SCHEMA, doc)
 
     @pytest.mark.parametrize("doc", [
-        '{"name": "bob", "age": 3}',                    # missing required kind
-        '{"name": "bob", "age": 3.5, "kind": "cat"}',   # integer violated
-        '{"name": 1, "age": 3, "kind": "cat"}',         # string violated
-        '{"name": "b", "age": 3, "kind": "fox"}',       # not in enum
-        '{"name": "b", "age": 3, "kind": "cat", "extra": 1}',  # addl false
-        '{"name": "b", "age": 3, "kind": "cat", "tags": []}',  # minItems
-        '{"name": "b", "age": 3, "kind": "cat", "tags": ["a","b","c","d"]}',
-        '{"name": "b", "name": "c", "age": 3, "kind": "cat"}',  # dup key
-        '[1, 2]',                                       # root must be object
+        '{"name":"bob","age":3}',                  # missing required kind
+        '{"name":"bob","age":3.5,"kind":"cat"}',   # integer violated
+        '{"name":1,"age":3,"kind":"cat"}',         # string violated
+        '{"name":"b","age":3,"kind":"fox"}',       # not in enum
+        '{"name":"b","age":3,"kind":"cat","extra":1}',  # addl false
+        '{"name":"b","age":3,"kind":"cat","tags":[]}',  # minItems
+        '{"name":"b","age":3,"kind":"cat","tags":["a","b","c","d"]}',
+        '{"name":"b","name":"c","age":3,"kind":"cat"}',  # dup key
+        '[1,2]',                                   # root must be object
+        '{"name": "b", "age": 3, "kind": "cat"}',  # whitespace: compact only
     ])
     def test_rejects_nonconforming(self, doc):
         assert not _schema_accepts(_SCHEMA, doc)
@@ -309,11 +310,11 @@ class TestSchemaByteMachine:
         s = {"type": "object",
              "properties": {"a": {"type": "integer"}},
              "additionalProperties": {"type": "boolean"}}
-        assert _schema_accepts(s, '{"a": 1, "b": true, "zz": false}')
-        assert not _schema_accepts(s, '{"b": 1}')  # addl must be boolean
+        assert _schema_accepts(s, '{"a":1,"b":true,"zz":false}')
+        assert not _schema_accepts(s, '{"b":1}')  # addl must be boolean
         # a key diverging from the trie mid-way is an additional property
-        assert _schema_accepts(s, '{"ab": true}')
-        assert not _schema_accepts(s, '{"ab": 2}')
+        assert _schema_accepts(s, '{"ab":true}')
+        assert not _schema_accepts(s, '{"ab":2}')
 
     def test_union_and_nested(self):
         s = {"type": "object",
@@ -324,18 +325,18 @@ class TestSchemaByteMachine:
                            "required": ["x"]},
              },
              "required": ["inner"]}
-        assert _schema_accepts(s, '{"v": null, "inner": {"x": 1.5e3}}')
-        assert _schema_accepts(s, '{"inner": {"x": 2, "free": [1, {}]}}')
-        assert not _schema_accepts(s, '{"v": 3, "inner": {"x": 1}}')
-        assert not _schema_accepts(s, '{"inner": {}}')  # nested required
+        assert _schema_accepts(s, '{"v":null,"inner":{"x":1.5e3}}')
+        assert _schema_accepts(s, '{"inner":{"x":2,"free":[1,{}]}}')
+        assert not _schema_accepts(s, '{"v":3,"inner":{"x":1}}')
+        assert not _schema_accepts(s, '{"inner":{}}')  # nested required
 
     def test_enum_prefix_ambiguity(self):
         s = {"type": "object", "properties": {"n": {"enum": [1, 12, 123]}},
              "required": ["n"], "additionalProperties": False}
         for v in (1, 12, 123):
-            assert _schema_accepts(s, '{"n": %d}' % v)
-        assert not _schema_accepts(s, '{"n": 2}')
-        assert not _schema_accepts(s, '{"n": 124}')
+            assert _schema_accepts(s, '{"n":%d}' % v)
+        assert not _schema_accepts(s, '{"n":2}')
+        assert not _schema_accepts(s, '{"n":124}')
 
     def test_masked_random_walk_always_conforms(self):
         """Generation property: follow ONLY allowed bytes (seeded random
@@ -507,7 +508,7 @@ class TestSchemaRound4ReviewFixes:
         mask admits its hex digits so advance must not raise."""
         s = {"type": "object", "properties": {"a": {"type": "integer"}}}
         m = SchemaByteMachine(compile_schema(s))
-        for b in b'{"\\ud83d\\ude00": 1}':
+        for b in b'{"\\ud83d\\ude00":1}':
             m.advance(b)
         assert m.done
 

@@ -1,0 +1,258 @@
+"""OpenAI tools / function calling on the chat surface.
+
+vLLM gives the reference's users tool calling through guided decoding
+backends; here a forced call (``tool_choice`` named or ``required``)
+rides the schema-constrained byte machine — the generated text is
+GUARANTEED to be a well-formed ``{"name", "arguments"}`` call, assembled
+into OpenAI ``tool_calls`` with ``finish_reason: "tool_calls"``.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fusioninfer_tpu.engine.engine import NativeEngine
+from fusioninfer_tpu.engine.guided import build_token_byte_table
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.server import EngineServer
+from fusioninfer_tpu.engine.tokenizer import ByteTokenizer
+from fusioninfer_tpu.models.config import get_preset
+
+CFG = get_preset("qwen3-tiny")
+# tool definitions ride the prompt (<|tools|> prefix), so the context
+# budget must hold tools JSON + messages + max_tokens
+CACHE = CacheConfig(n_pages=193, page_size=16, max_pages_per_seq=48)
+
+WEATHER = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Look up current weather",
+        "parameters": {
+            "type": "object",
+            "properties": {
+                "city": {"type": "string"},
+                "unit": {"enum": ["c", "f"]},
+            },
+            "required": ["city"],
+            "additionalProperties": False,
+        },
+    },
+}
+CLOCK = {
+    "type": "function",
+    "function": {"name": "get_time", "parameters": {"type": "object"}},
+}
+
+
+@pytest.fixture(scope="module")
+def srv():
+    tok = ByteTokenizer()
+    engine = NativeEngine(
+        CFG, cache_cfg=CACHE, max_batch_size=4, seed=0,
+        token_byte_table=build_token_byte_table(tok, CFG.vocab_size))
+    server = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                          engine=engine, tokenizer=tok)
+    server.start()
+    yield server
+    server.stop()
+
+
+def _chat(srv, body: dict, timeout: float = 300.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+        data=json.dumps({"model": "qwen3-tiny", **body}).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=timeout))
+
+
+class TestForcedToolCalls:
+    def test_named_function_guarantees_schema_conformant_call(self, srv):
+        r = _chat(srv, {
+            "messages": [{"role": "user", "content": "weather in oslo?"}],
+            "tools": [WEATHER, CLOCK],
+            "tool_choice": {"type": "function",
+                            "function": {"name": "get_weather"}},
+            "max_tokens": 200, "temperature": 0.9, "seed": 11,
+        })
+        choice = r["choices"][0]
+        if choice["finish_reason"] == "length":
+            return  # budget ran out mid-call: no tool_calls claim made
+        assert choice["finish_reason"] == "tool_calls"
+        msg = choice["message"]
+        assert msg["content"] is None
+        (call,) = msg["tool_calls"]
+        assert call["type"] == "function"
+        assert call["function"]["name"] == "get_weather"
+        args = json.loads(call["function"]["arguments"])
+        assert isinstance(args["city"], str)  # required by the schema
+        assert set(args) <= {"city", "unit"}
+        if "unit" in args:
+            assert args["unit"] in ("c", "f")
+
+    def test_required_single_tool_constrains_arguments(self, srv):
+        r = _chat(srv, {
+            "messages": [{"role": "user", "content": "call something"}],
+            "tools": [WEATHER],
+            "tool_choice": "required",
+            "max_tokens": 200, "temperature": 0.9, "seed": 12,
+        })
+        choice = r["choices"][0]
+        if choice["finish_reason"] == "length":
+            return
+        (call,) = choice["message"]["tool_calls"]
+        assert call["function"]["name"] == "get_weather"
+        assert "city" in json.loads(call["function"]["arguments"])
+
+    def test_required_multi_tool_name_enum(self, srv):
+        r = _chat(srv, {
+            "messages": [{"role": "user", "content": "pick one"}],
+            "tools": [WEATHER, CLOCK],
+            "tool_choice": "required",
+            "max_tokens": 200, "temperature": 0.9, "seed": 13,
+        })
+        choice = r["choices"][0]
+        if choice["finish_reason"] == "length":
+            return
+        (call,) = choice["message"]["tool_calls"]
+        assert call["function"]["name"] in ("get_weather", "get_time")
+        json.loads(call["function"]["arguments"])  # always an object
+
+
+class TestToolPlumbing:
+    def test_tool_choice_none_is_plain_content(self, srv):
+        r = _chat(srv, {
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": [WEATHER], "tool_choice": "none",
+            "max_tokens": 6, "temperature": 0.0,
+        })
+        msg = r["choices"][0]["message"]
+        assert msg["content"] is not None
+        assert "tool_calls" not in msg
+
+    def test_tool_history_round_trips(self, srv):
+        """Assistant tool-call turns (content None) and tool-result
+        messages must flatten into the prompt without crashing."""
+        r = _chat(srv, {
+            "messages": [
+                {"role": "user", "content": "weather?"},
+                {"role": "assistant", "content": None, "tool_calls": [
+                    {"id": "call_1", "type": "function",
+                     "function": {"name": "get_weather",
+                                  "arguments": "{\"city\": \"oslo\"}"}}]},
+                {"role": "tool", "tool_call_id": "call_1",
+                 "content": "{\"temp\": -3}"},
+            ],
+            "tools": [WEATHER], "tool_choice": "none",
+            "max_tokens": 4, "temperature": 0.0,
+        })
+        assert r["choices"][0]["message"]["content"] is not None
+
+    def test_validation_errors_are_400(self, srv):
+        cases = [
+            {"tools": [{"type": "function"}]},                # no function
+            {"tools": [WEATHER],
+             "tool_choice": {"type": "function",
+                             "function": {"name": "ghost"}}},  # unknown
+            {"tool_choice": "required"},                       # no tools
+            {"tools": [WEATHER], "tool_choice": "sometimes"},  # bad enum
+            {"tools": [WEATHER], "tool_choice": "required",
+             "stream": True},                                  # no streaming
+        ]
+        for extra in cases:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+                data=json.dumps({
+                    "model": "qwen3-tiny", "max_tokens": 2,
+                    "messages": [{"role": "user", "content": "x"}],
+                    **extra}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400, extra
+
+    def test_auto_without_call_shape_is_content(self, srv):
+        """tool_choice auto leaves generation unconstrained; random
+        output that isn't a call stays ordinary content."""
+        r = _chat(srv, {
+            "messages": [{"role": "user", "content": "just chat"}],
+            "tools": [WEATHER],
+            "max_tokens": 8, "temperature": 0.0,
+        })
+        msg = r["choices"][0]["message"]
+        assert "tool_calls" not in msg or msg["content"] is None
+
+
+class TestToolsReviewFixes:
+    def test_duplicate_tool_names_rejected(self, srv):
+        dup = {"type": "function", "function": {"name": "get_weather"}}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+            data=json.dumps({"model": "qwen3-tiny", "max_tokens": 2,
+                             "messages": [{"role": "user", "content": "x"}],
+                             "tools": [WEATHER, dup]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+
+    def test_forced_call_conflicts_with_response_format(self, srv):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+            data=json.dumps({
+                "model": "qwen3-tiny", "max_tokens": 2,
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": [WEATHER], "tool_choice": "required",
+                "response_format": {"type": "json_object"}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+
+    def test_array_of_parts_content(self, srv):
+        r = _chat(srv, {
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "hello "},
+                {"type": "text", "text": "parts"}]}],
+            "max_tokens": 4, "temperature": 0.0,
+        })
+        assert r["choices"][0]["message"]["content"] is not None
+        # non-text parts are a clean 400, not a 500
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+            data=json.dumps({"model": "qwen3-tiny", "max_tokens": 2,
+                             "messages": [{"role": "user", "content": [
+                                 {"type": "image_url",
+                                  "image_url": {"url": "x"}}]}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+
+    def test_stream_none_choice_matches_nonstream_prompt(self, srv):
+        """tools + tool_choice 'none': stream and non-stream must build
+        the SAME prompt (no tool definitions shown), so the same seed
+        yields the same text."""
+        base = {"messages": [{"role": "user", "content": "same prompt?"}],
+                "tools": [WEATHER], "tool_choice": "none",
+                "max_tokens": 6, "temperature": 0.0, "seed": 5}
+        plain = _chat(srv, base)["choices"][0]["message"]["content"]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+            data=json.dumps({"model": "qwen3-tiny", **base,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        text = ""
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                delta = json.loads(payload)["choices"][0]["delta"]
+                text += delta.get("content") or ""
+        assert text == plain
