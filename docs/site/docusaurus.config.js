@@ -1,0 +1,52 @@
+// Docusaurus site for fusioninfer-tpu (reference parity:
+// /root/reference/docs/fusioninfer/docusaurus.config.ts).  Content lives
+// in the repo's plain-markdown docs tree (../..) — the canonical docs
+// readable without any build — and this site renders the same files.
+// Build: `npm install && npm run build` (needs network; not run in the
+// zero-egress CI — the site source ships, like the reference's).
+
+/** @type {import('@docusaurus/types').Config} */
+const config = {
+  title: 'fusioninfer-tpu',
+  tagline:
+    'TPU-native orchestration and serving for distributed LLM inference',
+  url: 'https://fusioninfer-tpu.github.io',
+  baseUrl: '/fusioninfer-tpu/',
+  organizationName: 'fusioninfer-tpu',
+  projectName: 'fusioninfer-tpu',
+  onBrokenLinks: 'warn',
+  onBrokenMarkdownLinks: 'warn',
+  i18n: { defaultLocale: 'en', locales: ['en'] },
+  presets: [
+    [
+      'classic',
+      /** @type {import('@docusaurus/preset-classic').Options} */
+      ({
+        docs: {
+          // the repo's markdown docs (../) are the single source of
+          // truth — no copy step; the site dir itself is excluded
+          path: '..',
+          exclude: ['site/**'],
+          routeBasePath: '/',
+          sidebarPath: './sidebars.js',
+        },
+        blog: false,
+        theme: { customCss: './src/css/custom.css' },
+      }),
+    ],
+  ],
+  themeConfig: {
+    navbar: {
+      title: 'fusioninfer-tpu',
+      items: [
+        { type: 'docSidebar', sidebarId: 'docs', label: 'Docs', position: 'left' },
+      ],
+    },
+    footer: {
+      style: 'dark',
+      copyright: 'fusioninfer-tpu — Apache-2.0',
+    },
+  },
+};
+
+module.exports = config;

@@ -1,0 +1,17 @@
+/** @type {import('@docusaurus/plugin-content-docs').SidebarsConfig} */
+const sidebars = {
+  docs: [
+    'index',
+    'quickstart',
+    'operations',
+    'clientset',
+    {
+      type: 'category',
+      label: 'Design',
+      items: ['design/crd', 'design/engine', 'design/parallelism',
+              'design/router'],
+    },
+  ],
+};
+
+module.exports = sidebars;

@@ -978,7 +978,9 @@ class EngineServer:
     def _parse_tools(body: dict) -> tuple[dict, object]:
         """Validate OpenAI ``tools`` + ``tool_choice``; returns
         (tools-by-name, choice) where choice is "auto" / "none" /
-        "required" / a specific tool name."""
+        "required" / ``("named", tool_name)`` — the tagged tuple keeps a
+        tool literally named "auto"/"required" from colliding with the
+        sentinels."""
         tools = body.get("tools") or []
         if not isinstance(tools, list):
             raise ValueError("tools must be a list")
@@ -994,6 +996,16 @@ class EngineServer:
                 # ambiguous: a forced call would silently bind whichever
                 # definition came last
                 raise ValueError(f"duplicate tool name {fn['name']!r}")
+            params = fn.get("parameters")
+            if params is not None and (
+                    not isinstance(params, dict)
+                    or params.get("type", "object") != "object"):
+                # a non-object parameters schema could never produce the
+                # {"name", "arguments": {...}} call shape — the forced
+                # path would silently return plain content
+                raise ValueError(
+                    f"tool {fn['name']!r}: parameters must be an object "
+                    "schema")
             by_name[fn["name"]] = fn
         choice = body.get("tool_choice", "auto" if by_name else "none")
         if isinstance(choice, dict):
@@ -1002,7 +1014,7 @@ class EngineServer:
             if not name or name not in by_name:
                 raise ValueError(
                     f"tool_choice names unknown function {name!r}")
-            choice = name
+            choice = ("named", name)
         elif choice not in ("auto", "none", "required"):
             raise ValueError(
                 "tool_choice must be 'auto', 'none', 'required' or "
@@ -1019,8 +1031,8 @@ class EngineServer:
         with several candidate tools the argument shape depends on the
         generated name, which a byte machine cannot condition on — the
         name stays enum-constrained and arguments are any object."""
-        if choice in by_name:
-            targets = [choice]
+        if isinstance(choice, tuple):  # ("named", name)
+            targets = [choice[1]]
         else:  # "required"
             targets = list(by_name)
         if len(targets) == 1:

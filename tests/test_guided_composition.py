@@ -1,0 +1,141 @@
+"""Composition coverage: json_schema guided decoding × the engine's
+other serving features (chunked prefill, preemption/resume, parallel
+sampling, speculative decoding) — each pair has its own failure mode
+that feature-local tests can't see.
+"""
+
+import json
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.guided import build_token_byte_table
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.engine.tokenizer import ByteTokenizer
+from fusioninfer_tpu.models.config import get_preset
+
+CFG = get_preset("qwen3-tiny")
+
+SCHEMA = json.dumps({
+    "type": "object",
+    "properties": {"kind": {"enum": ["a", "b"]},
+                   "n": {"type": "integer"}},
+    "required": ["kind", "n"],
+    "additionalProperties": False,
+}, sort_keys=True, separators=(",", ":"))
+
+
+def _engine(**kw):
+    tok = ByteTokenizer()
+    cache = kw.pop("cache_cfg", CacheConfig(n_pages=65, page_size=16,
+                                            max_pages_per_seq=16))
+    return NativeEngine(
+        CFG, cache_cfg=cache, max_batch_size=4, seed=0,
+        token_byte_table=build_token_byte_table(tok, CFG.vocab_size),
+        **kw), tok
+
+
+def _drain(engine, max_steps=500):
+    toks: dict[str, list] = {}
+    fins: dict[str, str] = {}
+    for _ in range(max_steps):
+        if not engine.has_work():
+            break
+        for o in engine.step():
+            toks.setdefault(o.request_id, []).append(o.token)
+            if o.finished:
+                fins[o.request_id] = o.finish_reason
+    return toks, fins
+
+
+def _conforms(text: str) -> None:
+    doc = json.loads(text)
+    assert set(doc) == {"kind", "n"}
+    assert doc["kind"] in ("a", "b") and isinstance(doc["n"], int)
+
+
+class TestSchemaComposition:
+    def test_with_chunked_prefill(self):
+        """A long prompt streaming in via chunked prefill must activate
+        with a FRESH machine — the schema masks generation only."""
+        engine, tok = _engine(prefill_chunk_size=16)
+        engine.add_request(Request(
+            "c", tok.encode("x" * 100),
+            SamplingParams(max_tokens=80, temperature=0.9, seed=41,
+                           guided_schema=SCHEMA)))
+        toks, fins = _drain(engine)
+        if fins["c"] == "stop":
+            _conforms(tok.decode(toks["c"]))
+        else:
+            assert fins["c"] == "length"
+
+    def test_survives_preemption_resume(self):
+        """Preempting a schema-guided sequence replays the machine over
+        the generated prefix on resume — masks must pick up EXACTLY
+        where they left off."""
+        tok = ByteTokenizer()
+        cache = CacheConfig(n_pages=9, page_size=16, max_pages_per_seq=8)
+        engine = NativeEngine(
+            CFG, cache_cfg=cache, max_batch_size=2, seed=0,
+            token_byte_table=build_token_byte_table(tok, CFG.vocab_size))
+        old = Request("g", tok.encode("0123456789abc"),
+                      SamplingParams(max_tokens=60, temperature=0.9, seed=3,
+                                     guided_schema=SCHEMA))
+        engine.add_request(old)
+        for _ in range(6):
+            engine.step()
+        # urgent arrival forces page pressure → preemption of "g"
+        engine.add_request(Request(
+            "urgent", tok.encode("y" * 90),
+            SamplingParams(max_tokens=30, temperature=0.0), priority=-1))
+        toks, fins = _drain(engine)
+        assert "g" in fins, fins
+        if fins["g"] == "stop":
+            _conforms(tok.decode(toks["g"]))
+        else:
+            assert fins["g"] == "length"
+
+    def test_parallel_requests_independent_machines(self):
+        """Several schema-guided requests in one batch: every row masks
+        through ITS machine; finished rows all conform independently."""
+        engine, tok = _engine()
+        for i in range(3):
+            engine.add_request(Request(
+                f"p{i}", tok.encode(f"req {i}"),
+                SamplingParams(max_tokens=80, temperature=0.9, seed=60 + i,
+                               guided_schema=SCHEMA)))
+        toks, fins = _drain(engine)
+        assert set(fins) == {"p0", "p1", "p2"}
+        for rid, fin in fins.items():
+            if fin == "stop":
+                _conforms(tok.decode(toks[rid]))
+
+    def test_spec_decode_engine_falls_back_for_schema_rows(self):
+        """An engine with speculative decoding on must run schema-guided
+        rows unspeculated (drafts would bypass the mask) and still
+        produce conformant output."""
+        engine, tok = _engine(speculative_k=4)
+        engine.add_request(Request(
+            "s", tok.encode("7 8 9 7 8 9 7 8 9"),
+            SamplingParams(max_tokens=80, temperature=0.9, seed=71,
+                           guided_schema=SCHEMA)))
+        # an unguided repetitive neighbor keeps the speculative path hot
+        engine.add_request(Request(
+            "free", tok.encode("1 2 3 " * 8),
+            SamplingParams(max_tokens=20, temperature=0.0)))
+        toks, fins = _drain(engine)
+        assert len(toks["free"]) == 20
+        if fins["s"] == "stop":
+            _conforms(tok.decode(toks["s"]))
+
+    def test_machine_state_not_shared_between_requests(self):
+        """The compile cache shares NODES, never machines: two requests
+        with the same schema must not interleave automaton state."""
+        from fusioninfer_tpu.engine.guided import machine_for
+
+        p = SamplingParams(guided_schema=SCHEMA)
+        m1, m2 = machine_for(p), machine_for(p)
+        for b in b'{"kind":"a"':
+            m1.advance(b)
+        # m2 still at the start: '{' legal there, illegal in m1
+        assert m2.allowed_bytes()[ord("{")]
+        assert not m1.allowed_bytes()[ord("{")]

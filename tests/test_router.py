@@ -269,3 +269,31 @@ schedulingProfiles:
 """
         with _pytest.raises(EPPSchemaError, match="undeclared"):
             validate_epp_config(bad)
+
+
+class TestEPPImagePinning:
+    def test_digest_override_accepted(self, monkeypatch):
+        from fusioninfer_tpu.router.epp import get_epp_image
+
+        digest = ("registry.k8s.io/gateway-api-inference-extension/epp"
+                  "@sha256:" + "a" * 64)
+        monkeypatch.setenv("EPP_IMAGE", digest)
+        assert get_epp_image() == digest
+
+    def test_mangled_digest_rejected_at_render(self, monkeypatch):
+        import pytest as _pytest
+
+        from fusioninfer_tpu.router.epp import get_epp_image
+
+        monkeypatch.setenv("EPP_IMAGE", "epp@sha1:deadbeef")
+        with _pytest.raises(ValueError, match="sha256"):
+            get_epp_image()
+
+    def test_short_sha256_digest_rejected(self, monkeypatch):
+        import pytest as _pytest
+
+        from fusioninfer_tpu.router.epp import get_epp_image
+
+        monkeypatch.setenv("EPP_IMAGE", "epp@sha256:deadbeef")
+        with _pytest.raises(ValueError, match="64 hex"):
+            get_epp_image()

@@ -256,3 +256,37 @@ class TestToolsReviewFixes:
                 delta = json.loads(payload)["choices"][0]["delta"]
                 text += delta.get("content") or ""
         assert text == plain
+
+
+class TestToolNameSentinelCollision:
+    def test_tool_named_auto_still_forces(self, srv):
+        """A tool literally named 'auto' with a dict tool_choice must
+        FORCE (tagged named-choice, not the 'auto' sentinel) — proven by
+        the forced-path stream rejection firing."""
+        auto_tool = {"type": "function", "function": {
+            "name": "auto", "parameters": {"type": "object"}}}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+            data=json.dumps({
+                "model": "qwen3-tiny", "max_tokens": 2, "stream": True,
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": [auto_tool],
+                "tool_choice": {"type": "function",
+                                "function": {"name": "auto"}}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400  # forced + stream → rejected
+
+    def test_non_object_parameters_rejected(self, srv):
+        bad = {"type": "function", "function": {
+            "name": "f", "parameters": {"type": "string"}}}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/chat/completions",
+            data=json.dumps({"model": "qwen3-tiny", "max_tokens": 2,
+                             "messages": [{"role": "user", "content": "x"}],
+                             "tools": [bad]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
