@@ -273,6 +273,7 @@ class NativeEngine:
 
         self._mh = (multihost.EventBroadcaster()
                     if multihost.mesh_is_multiprocess(mesh) else None)
+        self._mh_shutdown = False
         self.lora_set = None
         if lora_adapters:
             from fusioninfer_tpu.models.lora import AdapterSet
@@ -743,6 +744,20 @@ class NativeEngine:
         event exchange inside it is the pacing/sync point)."""
         return self._mh is not None
 
+    @property
+    def multihost_shutdown(self) -> bool:
+        """True once a shutdown event arrived through the admission
+        stream — EVERY process sees it at the same step, so all engine
+        loops exit together instead of one side blocking in a collective
+        the other will never join."""
+        return self._mh_shutdown
+
+    def broadcast_shutdown(self) -> None:
+        """Leader: fan a final shutdown event to all processes (the
+        server's stop path calls this before halting the engine loop)."""
+        if self._mh is not None and self._mh.is_leader:
+            self._mh.queue({"type": "shutdown"})
+
     def _exchange_multihost_events(self) -> None:
         from fusioninfer_tpu.engine import multihost
 
@@ -753,6 +768,8 @@ class NativeEngine:
             elif ev["type"] == "cancel":
                 with self._lock:
                     self._cancelled.add(ev["request_id"])
+            elif ev["type"] == "shutdown":
+                self._mh_shutdown = True
 
     def step(self) -> list[StepOutput]:
         """Admit + prefill new work, then one batched decode pass."""

@@ -257,6 +257,11 @@ class EngineServer:
                         self.metrics.e2e_latency.observe(now - meta["arrival"])
                 if chan is not None:
                     chan.put(out)
+            if getattr(self.engine, "multihost_shutdown", False):
+                # AFTER dispatching this step's outputs: the shutdown
+                # step may carry terminal tokens clients are waiting on
+                logger.info("multihost shutdown event; engine loop exits")
+                return
 
     # -- request handling ----------------------------------------------------
 
@@ -1303,6 +1308,22 @@ class EngineServer:
         logger.info("serving %s on %s:%d", self.model_name, self.host, self.port)
 
     def stop(self) -> None:
+        if getattr(self.engine, "is_multihost", False):
+            # fan a shutdown event through the admission stream FIRST:
+            # stopping the leader's engine thread outright would leave
+            # every follower blocked in its next exchange collective
+            # until the kubelet's grace period kills it.  The wait must
+            # COVER the drain budget: a follower drains idle quickly
+            # while the leader may sit in drain() up to 120 s for a slow
+            # client — bailing early would break the lockstep and hang
+            # the leader's final exchange.
+            self.engine.broadcast_shutdown()
+            deadline = time.monotonic() + 150.0
+            while (not getattr(self.engine, "multihost_shutdown", False)
+                   and self._engine_thread is not None
+                   and self._engine_thread.is_alive()
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
         self._stop.set()
         if self._httpd is not None:
             self._httpd.shutdown()

@@ -222,6 +222,24 @@ def test_two_process_tp2_decode_token_identity():
         got2 = _completion(leader_port, dict(
             body, prompt="second wave", max_tokens=5), timeout=300.0)
         assert got2["choices"][0]["text"] == expected2
+
+        # graceful group shutdown: SIGTERM both pods (what kubelet does
+        # on delete) — the leader's drain fans a shutdown event through
+        # the admission stream so no process is left blocked in a
+        # collective; both must exit 0 well inside the grace period
+        import signal as _signal
+
+        for p in procs:
+            p.send_signal(_signal.SIGTERM)
+        for p in procs:
+            try:
+                p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                raise AssertionError(
+                    "multihost process hung on SIGTERM (follower blocked "
+                    "in a collective the leader never joined?)")
+        assert [p.returncode for p in procs] == [0, 0], (
+            [p.returncode for p in procs])
     finally:
         for p in procs:
             if p.poll() is None:
