@@ -139,6 +139,38 @@ def _suppress_early_rows(logits, early, suppress):
     return jnp.where(early[:, None] & suppress, -jnp.inf, logits)
 
 
+@partial(jax.jit, static_argnames=("vocab",))
+def _histogram(tokens, n_real, vocab):
+    """Token-count row [vocab] over ``tokens[:n_real]`` (``tokens`` is
+    power-of-two padded by the caller, so jit signatures stay bounded
+    at log2(max_len) instead of one per prompt length)."""
+    w = (jnp.arange(tokens.shape[0]) < n_real).astype(jnp.int32)
+    return jnp.zeros((vocab,), jnp.int32).at[tokens].add(w)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _install_slot_rows(token_counts, output_counts, suppress, slot,
+                       counts_row, out_row, sup_row):
+    """Write one admitted request's device sampling state (both penalty
+    count rows + the stop-suppress row) in a single fused scatter call —
+    this runs per ADMISSION on the TTFT path."""
+    return (token_counts.at[slot].set(counts_row),
+            output_counts.at[slot].set(out_row),
+            suppress.at[slot].set(sup_row))
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _install_slot_rows_bumped(token_counts, output_counts, suppress, slot,
+                              counts_row, out_row, sup_row, bump_token):
+    """:func:`_install_slot_rows` + a fused +1 for the freshly sampled
+    first token — lets the activation path reuse the histograms it
+    already computed for first-token sampling instead of rebuilding
+    them over ``prefix + [token]``."""
+    return (token_counts.at[slot].set(counts_row.at[bump_token].add(1)),
+            output_counts.at[slot].set(out_row.at[bump_token].add(1)),
+            suppress.at[slot].set(sup_row))
+
+
 def _token_legality(byte_table, allowed):
     """Byte-legality → token-legality ([..., 256] bool → [..., V]): the
     ONE place the byte→token semantics live (jittable; used by both the
@@ -274,6 +306,7 @@ class NativeEngine:
         self._mh = (multihost.EventBroadcaster()
                     if multihost.mesh_is_multiprocess(mesh) else None)
         self._mh_shutdown = False
+        self._last_step_end = time.monotonic()
         self.lora_set = None
         if lora_adapters:
             from fusioninfer_tpu.models.lora import AdapterSet
@@ -771,6 +804,15 @@ class NativeEngine:
             elif ev["type"] == "shutdown":
                 self._mh_shutdown = True
 
+    def lockstep_stalled(self, threshold_s: float = 15.0) -> bool:
+        """True when a multi-process engine has not completed a step in
+        ``threshold_s`` — the loop normally exchanges every few ms, so a
+        long stall means a peer process is gone and every collective
+        from here on blocks forever.  Drain/stop use this to give up
+        instead of burning the whole grace period."""
+        return (self._mh is not None
+                and time.monotonic() - self._last_step_end > threshold_s)
+
     def step(self) -> list[StepOutput]:
         """Admit + prefill new work, then one batched decode pass."""
         if self._mh is not None:
@@ -783,6 +825,7 @@ class NativeEngine:
         outputs += self._admit()
         outputs += self._advance_prefilling()
         outputs += self._decode()
+        self._last_step_end = time.monotonic()
         return [o for o in outputs if o is not None]
 
     def _process_cancellations(self) -> None:
@@ -1070,7 +1113,14 @@ class NativeEngine:
 
     def _prompt_counts(self, prefix: list[int]) -> jax.Array:
         V = self.cfg.vocab_size
-        return jnp.zeros((V,), jnp.int32).at[jnp.asarray(prefix, jnp.int32)].add(1)
+        if not prefix:
+            return jnp.zeros((V,), jnp.int32)
+        # pad to a power of two so the jitted histogram compiles once
+        # per bucket, not once per prompt length
+        L = 1 << (len(prefix) - 1).bit_length()
+        padded = np.zeros(L, np.int32)
+        padded[: len(prefix)] = prefix
+        return _histogram(jnp.asarray(padded), jnp.int32(len(prefix)), V)
 
     def _stop_suppress_row(self, params: SamplingParams) -> jax.Array:
         V = self.cfg.vocab_size
@@ -1097,7 +1147,7 @@ class NativeEngine:
     def _sample_first_token(self, logits: jax.Array, request: Request,
                             prefix: list[int], seed: int,
                             n_prompt: Optional[int] = None,
-                            machine=None) -> int:
+                            machine=None, return_state: bool = False):
         """Sample a prefill's first token with full per-request sampling
         semantics (repetition penalty over the whole prefix,
         presence/frequency over previously *generated* tokens only, stop
@@ -1106,34 +1156,40 @@ class NativeEngine:
         ``n_prompt``: prompt length within ``prefix`` (differs on resume,
         where the prefix also carries already-generated tokens — those
         count as output for penalties, and set the PRNG counter so a
-        seeded request replays the same stream it would have continued)."""
+        seeded request replays the same stream it would have continued).
+
+        ``return_state``: also return ``(counts_row, out_row, sup_row)``
+        so the activation path can install the slot's sampling state via
+        a fused +1 bump instead of rebuilding both [V] histograms."""
         p = request.params
         if n_prompt is None:
             n_prompt = len(prefix)
-        counts = self._prompt_counts(prefix)[None]
-        out_counts = self._prompt_counts(prefix[n_prompt:])[None]
+        counts_row = self._prompt_counts(prefix)
+        out_row = self._prompt_counts(prefix[n_prompt:])
+        sup_row = self._stop_suppress_row(p)
         logits = apply_penalties(
-            logits, counts, out_counts,
+            logits, counts_row[None], out_row[None],
             jnp.asarray([p.presence_penalty]),
             jnp.asarray([p.frequency_penalty]),
             jnp.asarray([p.repetition_penalty]),
         )
         gen_index = len(prefix) - n_prompt
         if gen_index < p.min_tokens and p.stop_token_ids:
-            logits = jnp.where(self._stop_suppress_row(p)[None], -jnp.inf, logits)
+            logits = _suppress_early_rows(
+                logits, jnp.ones((1,), bool), sup_row[None])
         if p.logit_bias:
             ids = jnp.asarray([t for t, _ in p.logit_bias], jnp.int32)
             vals = jnp.asarray([b for _, b in p.logit_bias], jnp.float32)
             logits = logits.at[0, ids].add(vals)
         if machine is not None:
-            logits = jnp.where(
-                self._allowed_token_mask(machine.allowed_bytes())[None],
-                logits, -jnp.inf,
-            )
+            logits = _mask_guided_rows(
+                logits, self._byte_dev,
+                jnp.asarray(machine.allowed_bytes())[None],
+                jnp.ones((1,), bool))
         keys = make_row_keys(
             jnp.asarray([seed], jnp.uint32), jnp.asarray([gen_index], jnp.int32)
         )
-        return int(
+        token = int(
             sample(
                 logits, keys,
                 jnp.asarray([p.temperature]),
@@ -1142,19 +1198,39 @@ class NativeEngine:
                 jnp.asarray([p.min_p]),
             )[0]
         )
+        if return_state:
+            return token, (counts_row, out_row, sup_row)
+        return token
 
     def _register_slot(self, slot: int, tokens: list[int], n_prompt: int,
-                       params: SamplingParams) -> None:
+                       params: SamplingParams, state=None) -> None:
         """Reset the slot's device sampling state: combined counts (incl.
         the first generated token) for repetition, output-only counts for
         presence/frequency, stop-suppress mask for min_tokens, and the
         request's logit-bias arrays (built ONCE here — the decode loop
-        reuses them every step instead of re-uploading the same tuples)."""
-        self._token_counts = self._token_counts.at[slot].set(self._prompt_counts(tokens))
-        self._output_counts = self._output_counts.at[slot].set(
-            self._prompt_counts(tokens[n_prompt:])
-        )
-        self._suppress = self._suppress.at[slot].set(self._stop_suppress_row(params))
+        reuses them every step instead of re-uploading the same tuples).
+
+        ``state``: ``(counts_row, out_row, sup_row)`` from
+        ``_sample_first_token(return_state=True)`` — the histograms over
+        ``tokens[:-1]``; the freshly sampled ``tokens[-1]`` is bumped in
+        the fused install instead of rebuilding both [V] rows."""
+        if state is not None:
+            counts_row, out_row, sup_row = state
+            self._token_counts, self._output_counts, self._suppress = (
+                _install_slot_rows_bumped(
+                    self._token_counts, self._output_counts, self._suppress,
+                    jnp.int32(slot), counts_row, out_row, sup_row,
+                    jnp.int32(tokens[-1]),
+                ))
+        else:
+            self._token_counts, self._output_counts, self._suppress = (
+                _install_slot_rows(
+                    self._token_counts, self._output_counts, self._suppress,
+                    jnp.int32(slot),
+                    self._prompt_counts(tokens),
+                    self._prompt_counts(tokens[n_prompt:]),
+                    self._stop_suppress_row(params),
+                ))
         if params.logit_bias:
             self._slot_bias[slot] = (
                 jnp.asarray([t for t, _ in params.logit_bias], jnp.int32),
@@ -1405,8 +1481,9 @@ class NativeEngine:
                 b = int(self._byte_np[t])
                 if b >= 0:
                     machine.advance(b)
-        token = self._sample_first_token(logits, request, prefix, seq_seed,
-                                         n_prompt=n_prompt, machine=machine)
+        token, samp_state = self._sample_first_token(
+            logits, request, prefix, seq_seed,
+            n_prompt=n_prompt, machine=machine, return_state=True)
         force_finish = (self._guided_advance(machine, token)
                         if machine is not None else None)
         lp = tops = None
@@ -1428,7 +1505,8 @@ class NativeEngine:
             first_token_time=time.monotonic(),
             guided=machine,
         )
-        self._register_slot(slot, state.tokens, n_prompt, request.params)
+        self._register_slot(slot, state.tokens, n_prompt, request.params,
+                            state=samp_state)
         self.running[slot] = state
         if not resumed:
             self.prompt_tokens_total += len(prefix)

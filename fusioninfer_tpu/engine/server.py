@@ -1322,8 +1322,14 @@ class EngineServer:
             while (not getattr(self.engine, "multihost_shutdown", False)
                    and self._engine_thread is not None
                    and self._engine_thread.is_alive()
+                   and not self.engine.lockstep_stalled()
                    and time.monotonic() < deadline):
                 time.sleep(0.01)
+            if (getattr(self.engine, "is_multihost", False)
+                    and self.engine.lockstep_stalled()):
+                logger.warning(
+                    "lockstep stalled (peer process gone?); not waiting "
+                    "for the shutdown event")
         self._stop.set()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -1342,6 +1348,12 @@ class EngineServer:
             if idle and not self.engine.has_work():
                 logger.info("drained cleanly")
                 return True
+            if getattr(self.engine, "lockstep_stalled", lambda: False)():
+                # a multi-process peer is gone: mirrored work can never
+                # finish — burning the rest of the budget just delays
+                # the pod's exit into a SIGKILL
+                logger.warning("drain aborted: multihost lockstep stalled")
+                return False
             time.sleep(0.05)
         logger.warning("drain deadline passed with work in flight")
         return False
