@@ -150,24 +150,15 @@ def _histogram(tokens, n_real, vocab):
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))
 def _install_slot_rows(token_counts, output_counts, suppress, slot,
-                       counts_row, out_row, sup_row):
+                       counts_row, out_row, sup_row, bump_token, bump):
     """Write one admitted request's device sampling state (both penalty
     count rows + the stop-suppress row) in a single fused scatter call —
-    this runs per ADMISSION on the TTFT path."""
-    return (token_counts.at[slot].set(counts_row),
-            output_counts.at[slot].set(out_row),
-            suppress.at[slot].set(sup_row))
-
-
-@partial(jax.jit, donate_argnums=(0, 1, 2))
-def _install_slot_rows_bumped(token_counts, output_counts, suppress, slot,
-                              counts_row, out_row, sup_row, bump_token):
-    """:func:`_install_slot_rows` + a fused +1 for the freshly sampled
-    first token — lets the activation path reuse the histograms it
-    already computed for first-token sampling instead of rebuilding
-    them over ``prefix + [token]``."""
-    return (token_counts.at[slot].set(counts_row.at[bump_token].add(1)),
-            output_counts.at[slot].set(out_row.at[bump_token].add(1)),
+    this runs per ADMISSION on the TTFT path.  ``bump`` (0 or 1) folds
+    the freshly sampled first token into rows the caller computed over
+    the prefix only, so activation reuses the first-token-sampling
+    histograms instead of rebuilding both [V] rows."""
+    return (token_counts.at[slot].set(counts_row.at[bump_token].add(bump)),
+            output_counts.at[slot].set(out_row.at[bump_token].add(bump)),
             suppress.at[slot].set(sup_row))
 
 
@@ -307,6 +298,7 @@ class NativeEngine:
                     if multihost.mesh_is_multiprocess(mesh) else None)
         self._mh_shutdown = False
         self._last_step_end = time.monotonic()
+        self._in_step_body = False
         self.lora_set = None
         if lora_adapters:
             from fusioninfer_tpu.models.lora import AdapterSet
@@ -805,27 +797,34 @@ class NativeEngine:
                 self._mh_shutdown = True
 
     def lockstep_stalled(self, threshold_s: float = 15.0) -> bool:
-        """True when a multi-process engine has not completed a step in
-        ``threshold_s`` — the loop normally exchanges every few ms, so a
-        long stall means a peer process is gone and every collective
-        from here on blocks forever.  Drain/stop use this to give up
-        instead of burning the whole grace period."""
-        return (self._mh is not None
-                and time.monotonic() - self._last_step_end > threshold_s)
+        """True when a multi-process engine is stuck IN the event
+        exchange — the collective a dead peer blocks forever.  A step
+        that is past its exchange (``_in_step_body``) is computing or
+        compiling with every peer already synced this step (XLA compiles
+        legitimately take minutes on TPU), so it never counts as
+        stalled.  Drain/stop use this to give up on a dead group instead
+        of burning the whole grace period."""
+        if self._mh is None or self._in_step_body:
+            return False
+        return time.monotonic() - self._last_step_end > threshold_s
 
     def step(self) -> list[StepOutput]:
         """Admit + prefill new work, then one batched decode pass."""
         if self._mh is not None:
             self._exchange_multihost_events()
-        self._process_cancellations()
-        self._serve_slab_requests()
-        self._serve_embedding_requests()
-        outputs: list[StepOutput] = []
-        outputs += self._admit_prefilled()
-        outputs += self._admit()
-        outputs += self._advance_prefilling()
-        outputs += self._decode()
-        self._last_step_end = time.monotonic()
+        self._in_step_body = True
+        try:
+            self._process_cancellations()
+            self._serve_slab_requests()
+            self._serve_embedding_requests()
+            outputs: list[StepOutput] = []
+            outputs += self._admit_prefilled()
+            outputs += self._admit()
+            outputs += self._advance_prefilling()
+            outputs += self._decode()
+        finally:
+            self._in_step_body = False
+            self._last_step_end = time.monotonic()
         return [o for o in outputs if o is not None]
 
     def _process_cancellations(self) -> None:
@@ -1216,21 +1215,18 @@ class NativeEngine:
         the fused install instead of rebuilding both [V] rows."""
         if state is not None:
             counts_row, out_row, sup_row = state
-            self._token_counts, self._output_counts, self._suppress = (
-                _install_slot_rows_bumped(
-                    self._token_counts, self._output_counts, self._suppress,
-                    jnp.int32(slot), counts_row, out_row, sup_row,
-                    jnp.int32(tokens[-1]),
-                ))
+            bump_token, bump = tokens[-1], 1
         else:
-            self._token_counts, self._output_counts, self._suppress = (
-                _install_slot_rows(
-                    self._token_counts, self._output_counts, self._suppress,
-                    jnp.int32(slot),
-                    self._prompt_counts(tokens),
-                    self._prompt_counts(tokens[n_prompt:]),
-                    self._stop_suppress_row(params),
-                ))
+            counts_row = self._prompt_counts(tokens)
+            out_row = self._prompt_counts(tokens[n_prompt:])
+            sup_row = self._stop_suppress_row(params)
+            bump_token, bump = 0, 0  # rows already cover every token
+        self._token_counts, self._output_counts, self._suppress = (
+            _install_slot_rows(
+                self._token_counts, self._output_counts, self._suppress,
+                jnp.int32(slot), counts_row, out_row, sup_row,
+                jnp.int32(bump_token), jnp.int32(bump),
+            ))
         if params.logit_bias:
             self._slot_bias[slot] = (
                 jnp.asarray([t for t, _ in params.logit_bias], jnp.int32),

@@ -57,6 +57,12 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         # window but explicitly disable it via use_sliding_window
         sliding_window=(hf.get("sliding_window") or None)
         if hf.get("use_sliding_window", True) else None,
+        # MoE: Qwen3-MoE names (num_experts/moe_intermediate_size);
+        # Mixtral calls the expert count num_local_experts
+        n_experts=int(hf.get("num_experts")
+                      or hf.get("num_local_experts") or 0),
+        n_experts_active=int(hf.get("num_experts_per_tok", 2)),
+        moe_d_ff=int(hf.get("moe_intermediate_size", 0)),
     ).validate()
 
 
@@ -96,6 +102,29 @@ _TOP_MAP = {
     "lm_head.weight": ("lm_head", True),
 }
 
+# MoE router: HF stores [E, D]; native router is [D, E] (transposed)
+_ROUTER_SUFFIXES = ("mlp.gate.weight",                # Qwen3-MoE
+                    "block_sparse_moe.gate.weight")   # Mixtral
+# per-expert projections: HF suffix → our stacked key ([L, E, ...])
+_EXPERT_MAP = {
+    "gate_proj.weight": "w_gate", "up_proj.weight": "w_up",
+    "down_proj.weight": "w_down",                     # Qwen3-MoE
+    "w1.weight": "w_gate", "w3.weight": "w_up",
+    "w2.weight": "w_down",                            # Mixtral
+}
+
+
+def _parse_expert_suffix(suffix: str) -> tuple[str, int] | None:
+    """``mlp.experts.{e}.{proj}`` / ``block_sparse_moe.experts.{e}.{proj}``
+    → (our key, expert index); None when not an expert tensor."""
+    for prefix in ("mlp.experts.", "block_sparse_moe.experts."):
+        if suffix.startswith(prefix):
+            e_s, _, proj = suffix[len(prefix):].partition(".")
+            ours = _EXPERT_MAP.get(proj)
+            if ours is not None and e_s.isdigit():
+                return ours, int(e_s)
+    return None
+
 
 def load_hf_checkpoint(
     path: str,
@@ -116,6 +145,9 @@ def load_hf_checkpoint(
     L = cfg.n_layers
 
     per_layer: dict[str, dict[int, np.ndarray]] = {}
+    # MoE experts accumulate per (our key, layer, expert) and stack to
+    # the native [L, E, ...] layout once every expert has arrived
+    per_expert: dict[str, dict[int, dict[int, np.ndarray]]] = {}
     top: Params = {}
     for name, tensor in _open_safetensors(path):
         if name in _TOP_MAP:
@@ -126,10 +158,27 @@ def load_hf_checkpoint(
             continue
         rest = name[len("model.layers."):]
         idx_s, _, suffix = rest.partition(".")
+        if suffix in _ROUTER_SUFFIXES:
+            per_layer.setdefault("router", {})[int(idx_s)] = tensor.T
+            continue
+        expert = _parse_expert_suffix(suffix)
+        if expert is not None:
+            ours, e = expert
+            per_expert.setdefault(ours, {}).setdefault(int(idx_s), {})[e] = tensor.T
+            continue
         if suffix not in _LAYER_MAP:
             continue
         ours, transpose = _LAYER_MAP[suffix]
         per_layer.setdefault(ours, {})[int(idx_s)] = tensor.T if transpose else tensor
+    for ours, by_layer in per_expert.items():
+        E = cfg.n_experts
+        for i, by_e in by_layer.items():
+            missing = [e for e in range(E) if e not in by_e]
+            if missing:
+                raise ValueError(
+                    f"checkpoint missing experts {missing} for layer {i} {ours}")
+            per_layer.setdefault(ours, {})[i] = np.stack(
+                [by_e[e] for e in range(E)])
 
     quantize = cfg.quantization == "int8"
     if quantize and shardings is not None:
@@ -152,7 +201,11 @@ def load_hf_checkpoint(
                 # a bf16 8B tree plus its int8 copy would OOM one chip
                 q = (quantize_rows_host if kind == "rows" else quantize_int8_host)(arr)
                 return {k: jnp.asarray(v) for k, v in q.items()}
-        a = jnp.asarray(arr, target)
+        # the router stays fp32 (matching init_params): top-k routing is
+        # precision-sensitive and the bytes are negligible
+        leaf_dtype = (jnp.float32 if leaf_path == ("layers", "router")
+                      else target)
+        a = jnp.asarray(arr, leaf_dtype)
         if shardings is not None:
             s = shardings
             for k in leaf_path:
@@ -194,8 +247,9 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
             continue
         t = np.asarray(params[ours], np.float32)
         tensors[name] = np.ascontiguousarray(t.T) if transpose else t
+    moe_keys = {"w_gate", "w_up", "w_down"} if cfg.is_moe else set()
     for suffix, (ours, transpose) in _LAYER_MAP.items():
-        if ours not in params["layers"]:
+        if ours not in params["layers"] or ours in moe_keys:
             continue
         stacked = np.asarray(params["layers"][ours], np.float32)
         for i in range(cfg.n_layers):
@@ -203,6 +257,29 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
             tensors[f"model.layers.{i}.{suffix}"] = (
                 np.ascontiguousarray(t.T) if transpose else np.ascontiguousarray(t)
             )
+    if cfg.is_moe:
+        # name scheme follows the family so the export stays readable by
+        # HF transformers: Qwen3-MoE (qk_norm) vs Mixtral (no qk norms)
+        if cfg.qk_norm:
+            gate_name, expert_fmt = "mlp.gate.weight", "mlp.experts.{e}.{p}.weight"
+            projs = (("gate_proj", "w_gate"), ("up_proj", "w_up"),
+                     ("down_proj", "w_down"))
+        else:
+            gate_name = "block_sparse_moe.gate.weight"
+            expert_fmt = "block_sparse_moe.experts.{e}.{p}.weight"
+            projs = (("w1", "w_gate"), ("w3", "w_up"), ("w2", "w_down"))
+        router = np.asarray(params["layers"]["router"], np.float32)
+        for i in range(cfg.n_layers):
+            tensors[f"model.layers.{i}.{gate_name}"] = (
+                np.ascontiguousarray(router[i].T))
+        for hf_proj, ours in projs:
+            stacked = np.asarray(params["layers"][ours], np.float32)
+            for i in range(cfg.n_layers):
+                for e in range(cfg.n_experts):
+                    tensors[
+                        f"model.layers.{i}."
+                        + expert_fmt.format(e=e, p=hf_proj)
+                    ] = np.ascontiguousarray(stacked[i, e].T)
     save_file(tensors, os.path.join(path, "model.safetensors"))
     hf_cfg = {
         "architectures": ["Qwen3ForCausalLM" if cfg.qk_norm else "LlamaForCausalLM"],
@@ -219,6 +296,26 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
         "tie_word_embeddings": cfg.tie_embeddings,
         "max_position_embeddings": cfg.max_seq_len,
     }
+    if cfg.is_moe:
+        # real family labels so HF transformers can read the export:
+        # qk_norm MoE is Qwen3-MoE shaped, the rest is Mixtral shaped
+        # (model_type also feeds qk_norm detection on reload)
+        if cfg.qk_norm:
+            hf_cfg.update({
+                "architectures": ["Qwen3MoeForCausalLM"],
+                "model_type": "qwen3_moe",
+                "num_experts": cfg.n_experts,
+            })
+        else:
+            hf_cfg.update({
+                "architectures": ["MixtralForCausalLM"],
+                "model_type": "mixtral",
+                "num_local_experts": cfg.n_experts,
+            })
+        hf_cfg.update({
+            "num_experts_per_tok": cfg.n_experts_active,
+            "moe_intermediate_size": cfg.expert_d_ff,
+        })
     # the in-repo served name survives any model_type rewrite below
     hf_cfg["fusioninfer_name"] = cfg.name
     if cfg.sliding_window is not None:
