@@ -796,17 +796,22 @@ class NativeEngine:
             elif ev["type"] == "shutdown":
                 self._mh_shutdown = True
 
-    def lockstep_stalled(self, threshold_s: float = 15.0) -> bool:
-        """True when a multi-process engine is stuck IN the event
-        exchange — the collective a dead peer blocks forever.  A step
-        that is past its exchange (``_in_step_body``) is computing or
-        compiling with every peer already synced this step (XLA compiles
-        legitimately take minutes on TPU), so it never counts as
-        stalled.  Drain/stop use this to give up on a dead group instead
-        of burning the whole grace period."""
-        if self._mh is None or self._in_step_body:
+    def lockstep_stalled(self, threshold_s: float = 15.0,
+                         in_step_threshold_s: float = 600.0) -> bool:
+        """True when a multi-process engine looks wedged on a dead peer.
+        Two regimes: blocked in the event EXCHANGE (``_in_step_body``
+        False — the loop normally exchanges every few ms, so 15 s means
+        the peer is gone) vs blocked inside the step body (a peer can
+        die mid-collective too, but XLA compiles legitimately run
+        minutes on TPU, so only a far longer stall counts).  Drain/stop
+        use this to give up on a dead group instead of burning the whole
+        grace period."""
+        if self._mh is None:
             return False
-        return time.monotonic() - self._last_step_end > threshold_s
+        dt = time.monotonic() - self._last_step_end
+        if self._in_step_body:
+            return dt > in_step_threshold_s
+        return dt > threshold_s
 
     def step(self) -> list[StepOutput]:
         """Admit + prefill new work, then one batched decode pass."""

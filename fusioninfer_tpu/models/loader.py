@@ -170,13 +170,21 @@ def load_hf_checkpoint(
             continue
         ours, transpose = _LAYER_MAP[suffix]
         per_layer.setdefault(ours, {})[int(idx_s)] = tensor.T if transpose else tensor
+    if per_expert and not cfg.is_moe:
+        raise ValueError(
+            "checkpoint carries per-expert tensors but the config "
+            "declares no experts (num_experts/num_local_experts missing?)")
     for ours, by_layer in per_expert.items():
         E = cfg.n_experts
         for i, by_e in by_layer.items():
             missing = [e for e in range(E) if e not in by_e]
-            if missing:
+            extra = sorted(e for e in by_e if e >= E)
+            if missing or extra:
+                # silently dropping extras would load a truncated model
+                # whose router no longer matches its expert stack
                 raise ValueError(
-                    f"checkpoint missing experts {missing} for layer {i} {ours}")
+                    f"layer {i} {ours}: config declares {E} experts but "
+                    f"checkpoint is missing {missing} / has extra {extra}")
             per_layer.setdefault(ours, {})[i] = np.stack(
                 [by_e[e] for e in range(E)])
 
@@ -311,6 +319,11 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
                 "architectures": ["MixtralForCausalLM"],
                 "model_type": "mixtral",
                 "num_local_experts": cfg.n_experts,
+                # MixtralConfig sizes experts from intermediate_size —
+                # the w1/w2/w3 tensors are expert_d_ff wide, so the key
+                # must carry the EXPERT width or HF hits a shape
+                # mismatch on load
+                "intermediate_size": cfg.expert_d_ff,
             })
         hf_cfg.update({
             "num_experts_per_tok": cfg.n_experts_active,
@@ -320,11 +333,14 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
     hf_cfg["fusioninfer_name"] = cfg.name
     if cfg.sliding_window is not None:
         hf_cfg["sliding_window"] = cfg.sliding_window
-        if not cfg.qk_norm:
+        if not cfg.qk_norm and not cfg.is_moe:
             # external HF consumers only honor the window under the
             # mistral architecture (LlamaConfig ignores the key — they
             # would silently run full attention); qwen3-style configs
-            # keep their marker for qk_norm detection
+            # keep their marker for qk_norm detection, and a windowed
+            # MoE already carries the mixtral labels (MixtralConfig
+            # honors sliding_window natively — rewriting to mistral
+            # would contradict the block_sparse_moe tensors)
             hf_cfg["architectures"] = ["MistralForCausalLM"]
             hf_cfg["model_type"] = "mistral"
     with open(os.path.join(path, "config.json"), "w") as f:

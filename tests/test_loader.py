@@ -227,3 +227,52 @@ def test_config_from_hf_mixtral_names(tmp_path):
     np.testing.assert_allclose(
         np.asarray(forward(cfg2, p2, tokens)),
         np.asarray(forward(moe, p, tokens)), atol=1e-5, rtol=1e-5)
+
+
+def test_hf_moe_expert_count_mismatch_raises(tmp_path):
+    """Extra experts beyond the config's count (or expert tensors with
+    no MoE config at all) must fail loudly, never truncate."""
+    import json as _json
+
+    from fusioninfer_tpu.models.config import get_preset
+
+    moe = dataclasses.replace(get_preset("moe-tiny"), dtype="float32")
+    p = init_params(moe, jax.random.key(4))
+    d = tmp_path / "moe"
+    save_hf_checkpoint(str(d), moe, p)
+
+    cfg_path = d / "config.json"
+    hf = _json.loads(cfg_path.read_text())
+    hf["num_experts"] = moe.n_experts - 1  # fewer than the tensors carry
+    cfg_path.write_text(_json.dumps(hf))
+    with pytest.raises(ValueError, match="extra"):
+        load_hf_checkpoint(str(d))
+
+    hf.pop("num_experts")  # no MoE declaration at all
+    hf.pop("num_experts_per_tok", None)
+    hf.pop("moe_intermediate_size", None)
+    cfg_path.write_text(_json.dumps(hf))
+    with pytest.raises(ValueError, match="declares no experts"):
+        load_hf_checkpoint(str(d))
+
+
+def test_mixtral_export_intermediate_size_is_expert_width(tmp_path):
+    """MixtralConfig sizes experts from intermediate_size — the export
+    must carry the EXPERT width there, and a windowed MoE keeps its
+    mixtral labels (no mistral rewrite)."""
+    import json as _json
+
+    from fusioninfer_tpu.models.config import get_preset
+
+    moe = dataclasses.replace(get_preset("moe-tiny"), dtype="float32",
+                              qk_norm=False, d_ff=256, moe_d_ff=512,
+                              sliding_window=64)
+    p = init_params(moe, jax.random.key(5))
+    d = tmp_path / "mixtral-win"
+    save_hf_checkpoint(str(d), moe, p)
+    hf = _json.loads((d / "config.json").read_text())
+    assert hf["model_type"] == "mixtral"  # window did NOT rewrite it
+    assert hf["intermediate_size"] == 512
+    assert hf["sliding_window"] == 64
+    cfg2, p2 = load_hf_checkpoint(str(d), dtype="float32")
+    assert cfg2.expert_d_ff == 512 and cfg2.sliding_window == 64

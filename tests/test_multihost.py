@@ -1,0 +1,84 @@
+"""Multihost event protocol units: the admission events must carry
+EVERYTHING scheduling reads — a field silently dropped in serialization
+would diverge follower schedulers from the leader and deadlock the
+slice's collectives (the integration proof lives in
+tests/test_bootstrap_twoprocess.py; these pin the wire format).
+"""
+
+import json
+
+from fusioninfer_tpu.engine.engine import Request
+from fusioninfer_tpu.engine.multihost import (
+    cancel_event,
+    mesh_is_multiprocess,
+    request_from_event,
+    request_to_event,
+)
+from fusioninfer_tpu.engine.sampler import SamplingParams
+
+
+def _roundtrip(req: Request) -> Request:
+    # through real JSON, exactly like the broadcast payload
+    ev = json.loads(json.dumps(request_to_event(req)))
+    return request_from_event(ev)
+
+
+class TestRequestEventRoundTrip:
+    def test_every_scheduling_field_survives(self):
+        req = Request(
+            request_id="r-1",
+            prompt_tokens=[3, 1, 4, 1, 5],
+            params=SamplingParams(
+                temperature=0.7, top_k=40, top_p=0.9, min_p=0.05,
+                max_tokens=64, min_tokens=3,
+                stop_token_ids=(7, 9), stop_strings=("END", "\n\n"),
+                presence_penalty=0.1, frequency_penalty=0.2,
+                repetition_penalty=1.1, seed=1234, logprobs=5,
+                guided_json=True,
+                logit_bias=((42, -100.0), (7, 3.5)),
+            ),
+            arrival_time=123.456,
+            priority=-2,
+            lora="adapter-a",
+            resume_tokens=[3, 1, 4, 1, 5, 99],
+        )
+        back = _roundtrip(req)
+        assert back == req  # dataclass equality covers every field
+        # tuple-typed fields must come back as TUPLES (hashing, identity)
+        assert isinstance(back.params.stop_token_ids, tuple)
+        assert isinstance(back.params.stop_strings, tuple)
+        assert back.params.logit_bias == ((42, -100.0), (7, 3.5))
+
+    def test_guided_schema_rides_the_wire(self):
+        schema = json.dumps({"type": "object", "properties": {}},
+                            sort_keys=True, separators=(",", ":"))
+        req = Request("g", [1, 2], SamplingParams(guided_schema=schema))
+        assert _roundtrip(req).params.guided_schema == schema
+
+    def test_defaults_round_trip(self):
+        req = Request("d", [1])
+        back = _roundtrip(req)
+        assert back == req
+        assert back.resume_tokens is None
+
+    def test_arrival_time_is_the_leaders(self):
+        """FCFS depends on the LEADER's clock: followers must never
+        restamp arrival on receipt."""
+        req = Request("a", [1], arrival_time=42.0)
+        assert _roundtrip(req).arrival_time == 42.0
+
+    def test_cancel_event(self):
+        ev = json.loads(json.dumps(cancel_event("r-9")))
+        assert ev == {"type": "cancel", "request_id": "r-9"}
+
+
+class TestMeshPredicate:
+    def test_single_process_mesh_is_not_multiprocess(self):
+        import jax
+
+        from fusioninfer_tpu.parallel import MeshConfig, build_mesh
+
+        assert not mesh_is_multiprocess(None)
+        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        # all 8 virtual devices live in THIS process
+        assert not mesh_is_multiprocess(mesh)
