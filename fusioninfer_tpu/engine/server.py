@@ -188,15 +188,23 @@ class EngineServer:
     def _engine_loop(self) -> None:
         idle_sleep = 0.002
         consecutive_failures = 0
+        idle_streak = 0
         while not self._stop.is_set():
             if not self.engine.has_work():
                 consecutive_failures = 0  # an old incident must not
-                time.sleep(idle_sleep)    # shorten a NEW request's window
                 if not getattr(self.engine, "is_multihost", False):
+                    time.sleep(idle_sleep)  # shorten a NEW request's window
                     continue
                 # multi-process mesh: step unconditionally — the event
                 # exchange at the top of step() is what keeps leader and
-                # follower loops in SPMD lockstep (followers block there)
+                # follower loops in SPMD lockstep (followers block there).
+                # Escalate idle pacing (2→25 ms) so an idle slice isn't
+                # running hundreds of tiny collectives per second; the
+                # first request after idle pays at most one long tick.
+                idle_streak += 1
+                time.sleep(min(idle_sleep * idle_streak, 0.025))
+            else:
+                idle_streak = 0
             try:
                 outputs = self.engine.step()
                 consecutive_failures = 0
