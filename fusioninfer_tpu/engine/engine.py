@@ -53,6 +53,7 @@ from fusioninfer_tpu.engine.sampler import (
     apply_penalties,
     make_row_keys,
     sample,
+    spec_window_draws,
 )
 from fusioninfer_tpu.models.config import ModelConfig
 from fusioninfer_tpu.models.transformer import init_params
@@ -283,8 +284,10 @@ class NativeEngine:
         context (:class:`fusioninfer_tpu.engine.spec.NgramProposer`) and
         verify them in ONE ``verify_step`` forward; every accepted draft
         is a decode step skipped.  Greedy outputs are bit-identical with
-        speculation on or off.  Sampled/penalized/logprobs requests in
-        the same batch simply run unspeculated (drafts = 0)."""
+        speculation on or off; sampled (temperature>0) rows speculate
+        via delta-draft rejection sampling — distribution-exact and
+        deterministic per (seed, speculation config).  Penalized /
+        logprobs requests in the same batch run unspeculated (drafts=0)."""
         self.cfg = cfg.validate()
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
@@ -1520,21 +1523,22 @@ class NativeEngine:
 
     def _spec_eligible(self, st: _SeqState) -> bool:
         """Speculation is restricted to exact-equivalence territory:
-        greedy, penalty-free, no per-token logprobs, past min_tokens —
-        for these, draft acceptance by argmax comparison reproduces
-        sequential greedy decoding bit-for-bit.  (Penalized rows would
-        need position-wise count evolution inside the window; sampled
-        rows would need rejection sampling — both fall back to the
-        normal one-token path, losslessly.)"""
+        penalty-free, no per-token logprobs, past min_tokens.  Greedy
+        rows accept by argmax comparison (bit-identical to sequential
+        greedy decoding); sampled rows accept by delta-draft rejection
+        sampling over the SAME filtered distributions sequential
+        sampling uses (distribution-exact; deterministic for a given
+        seed + speculation config — see sampler.spec_window_draws).
+        Penalized rows would need position-wise count evolution inside
+        the window and fall back to the one-token path, losslessly."""
         p = st.request.params
-        return (p.temperature == 0.0
-                and p.presence_penalty == 0.0
+        return (p.presence_penalty == 0.0
                 and p.frequency_penalty == 0.0
                 and p.repetition_penalty == 1.0
                 and p.logprobs is None
                 and not p.guided_json  # drafts would bypass the grammar mask
                 and not p.guided_schema
-                and not p.logit_bias  # verify argmax ignores the bias
+                and not p.logit_bias  # verify scoring ignores the bias
                 and st.n_generated >= p.min_tokens)
 
     def _decode(self) -> list[StepOutput]:
@@ -1632,6 +1636,26 @@ class NativeEngine:
                 adapter_ids=jnp.asarray(adapter_ids) if lora is not None else None,
             )
             argmax_w = np.asarray(jnp.argmax(logits_w, axis=-1))  # [B, C]
+            if any(temps[s] > 0.0 for s in spec_drafts):
+                # sampled rows: delta-draft rejection sampling — one
+                # fused call yields the acceptance probabilities,
+                # uniforms, rejection replacements and sequential-
+                # equivalent full draws for every window position
+                counters = (gen_counts[:, None]
+                            + np.arange(C)[None, :]).reshape(-1)
+                keys_w = make_row_keys(
+                    jnp.asarray(np.repeat(seeds, C), jnp.uint32),
+                    jnp.asarray(counters, jnp.int32)).reshape(B, C)
+                draft_next = np.zeros((B, C), np.int32)
+                draft_next[:, : C - 1] = window[:, 1:]
+                full_d, p_draft_d, u_d, repl_d = spec_window_draws(
+                    logits_w.astype(jnp.float32), jnp.asarray(draft_next),
+                    keys_w, jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(min_ps))
+                full_w = np.asarray(full_d)
+                p_draft_w = np.asarray(p_draft_d)
+                u_w = np.asarray(u_d)
+                repl_w = np.asarray(repl_d)
             logits = logits_w[:, 0]
         else:
             self.cache, logits = decode_step(
@@ -1693,17 +1717,36 @@ class NativeEngine:
         outputs = list(failures)
         for slot, st in live.items():
             if argmax_w is not None and slot in spec_drafts:
-                # greedy burst: accepted drafts + the model's bonus token.
-                # argmax_w[slot, j] is the greedy token after consuming
-                # window[:j+1], so acceptance walks the window in order —
-                # bit-identical to sequential greedy decode_steps.
                 drafts = spec_drafts[slot]
                 self.spec_proposed_total += len(drafts)
-                accepted = 0
-                while (accepted < len(drafts)
-                       and drafts[accepted] == int(argmax_w[slot, accepted])):
-                    accepted += 1
-                burst = drafts[:accepted] + [int(argmax_w[slot, accepted])]
+                if temps[slot] > 0.0:
+                    # sampled burst: delta-draft rejection sampling —
+                    # accept while u < p(draft) under the position's
+                    # filtered distribution; on first rejection emit the
+                    # draft-excluded replacement, on full acceptance the
+                    # bonus draw.  Distribution-exact (Leviathan et al.)
+                    # and deterministic for a given (seed, spec config).
+                    accepted = 0
+                    while (accepted < len(drafts)
+                           and float(u_w[slot, accepted])
+                           < float(p_draft_w[slot, accepted])):
+                        accepted += 1
+                    if accepted < len(drafts):
+                        tail = int(repl_w[slot, accepted])
+                    else:
+                        tail = int(full_w[slot, len(drafts)])
+                    burst = drafts[:accepted] + [tail]
+                else:
+                    # greedy burst: accepted drafts + the model's bonus
+                    # token.  argmax_w[slot, j] is the greedy token after
+                    # consuming window[:j+1], so acceptance walks the
+                    # window in order — bit-identical to sequential
+                    # greedy decode_steps.
+                    accepted = 0
+                    while (accepted < len(drafts)
+                           and drafts[accepted] == int(argmax_w[slot, accepted])):
+                        accepted += 1
+                    burst = drafts[:accepted] + [int(argmax_w[slot, accepted])]
                 for i, tok in enumerate(burst):
                     st.tokens.append(tok)
                     self.generation_tokens_total += 1

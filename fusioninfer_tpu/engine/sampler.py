@@ -81,19 +81,19 @@ def apply_penalties(
     return logits
 
 
-@jax.jit
-def sample(
-    logits: jax.Array,  # [B, V] float32 (penalties already applied)
-    keys: jax.Array,  # [B] PRNG keys — one independent stream per row
+def filter_logits(
+    logits: jax.Array,  # [B, V] float32
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32, 0 = off
     top_p: jax.Array,  # [B]
     min_p: jax.Array | None = None,  # [B], 0 = off
 ) -> jax.Array:
-    """Sample one token per row; temperature <= 0 means greedy."""
+    """Temperature-scaled logits with min_p/top-k/top-p masks applied
+    (-inf outside the sampleable support).  The ONE place the filtered
+    sampling distribution is defined — :func:`sample` and the
+    speculative window draws both consume it, so acceptance tests can
+    never drift from what sequential sampling would do."""
     B, V = logits.shape
-    greedy_tok = jnp.argmax(logits, axis=-1)
-
     t = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / t
 
@@ -119,10 +119,82 @@ def sample(
     threshold = jnp.where(
         cutoff_mask, sorted_logits, jnp.inf
     ).min(axis=-1, keepdims=True)
-    scaled = jnp.where(scaled < threshold, -jnp.inf, scaled)
+    return jnp.where(scaled < threshold, -jnp.inf, scaled)
 
+
+@jax.jit
+def sample(
+    logits: jax.Array,  # [B, V] float32 (penalties already applied)
+    keys: jax.Array,  # [B] PRNG keys — one independent stream per row
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32, 0 = off
+    top_p: jax.Array,  # [B]
+    min_p: jax.Array | None = None,  # [B], 0 = off
+) -> jax.Array:
+    """Sample one token per row; temperature <= 0 means greedy."""
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    scaled = filter_logits(logits, temperature, top_k, top_p, min_p)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
+@jax.jit
+def spec_window_draws(
+    logits_w: jax.Array,  # [B, C, V] float32 — verify-window logits
+    draft_next: jax.Array,  # [B, C] int32: token PROPOSED after position j
+    keys_w: jax.Array,  # [B, C] PRNG keys — key (seed, gen_count + j)
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+    min_p: jax.Array,  # [B]
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Everything the host-side speculative acceptance walk needs, in
+    one fused call (delta-draft speculative sampling, Leviathan et al.):
+
+    * ``full[b, j]``    — a token sampled from position j's FILTERED
+      distribution with key (seed, gen+j); identical math and key
+      derivation to the sequential :func:`sample` path.  ``full[b, k]``
+      is the bonus token after all k drafts were accepted.
+    * ``p_draft[b, j]`` — the filtered probability of the draft token
+      proposed after position j.  With a delta draft (the n-gram
+      proposer is deterministic), accept with probability p_draft.
+    * ``u[b, j]``       — the acceptance uniform, from a fold of the
+      position's key (independent of ``full``'s draw).
+    * ``repl[b, j]``    — the rejection replacement, sampled from the
+      filtered distribution with the draft token REMOVED (for a delta
+      proposal, norm((p - q)^+) is exactly p restricted to != draft),
+      from a second fold.
+
+    Host walk: accept drafts while ``u < p_draft`` (STRICT — ``u`` can
+    be exactly 0.0 and a draft outside the filtered support has
+    p_draft == 0, which must never be accepted); on first rejection
+    emit ``repl`` at that position; on full acceptance emit the bonus
+    ``full[:, k]``.  This preserves the target distribution exactly.
+    (Rows that proposed no drafts never reach this function — they
+    sample through the regular :func:`sample` path.)
+    """
+    B, C, V = logits_w.shape
+    flat = logits_w.reshape(B * C, V)
+
+    def rep(x):
+        return jnp.repeat(x, C)
+
+    scaled = filter_logits(flat, rep(temperature), rep(top_k), rep(top_p),
+                           rep(min_p))
+    kf = keys_w.reshape(B * C)
+    greedy = jnp.argmax(flat, axis=-1)
+    full = jnp.where(rep(temperature) <= 0.0, greedy,
+                     jax.vmap(jax.random.categorical)(kf, scaled))
+    probs = jax.nn.softmax(scaled, axis=-1)
+    d = draft_next.reshape(B * C)
+    rows = jnp.arange(B * C)
+    p_draft = probs[rows, d]
+    u = jax.vmap(lambda k: jax.random.uniform(jax.random.fold_in(k, 1)))(kf)
+    masked = scaled.at[rows, d].set(-jnp.inf)
+    repl = jax.vmap(jax.random.categorical)(
+        jax.vmap(lambda k: jax.random.fold_in(k, 2))(kf), masked)
+    return (full.reshape(B, C), p_draft.reshape(B, C),
+            u.reshape(B, C), repl.reshape(B, C))
 
 
 @jax.jit

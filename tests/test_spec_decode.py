@@ -1,9 +1,12 @@
 """Speculative decoding: n-gram proposer, verify_step, engine identity.
 
-The invariant everything hangs on: speculation may only SKIP decode
-steps, never change tokens.  Greedy output with speculation on must be
-bit-identical to speculation off; sampled/penalized requests in the same
-batch run unspeculated and keep their per-request RNG streams intact.
+The invariants: greedy output with speculation on is BIT-identical to
+speculation off (argmax acceptance); sampled (temperature>0) rows
+speculate via delta-draft rejection sampling, which preserves the
+filtered target distribution EXACTLY and is deterministic for a given
+(seed, speculation config) — but is not stream-identical to the
+unspeculated run (randomness is consumed differently).  Penalized
+requests in the same batch run unspeculated, losslessly.
 """
 
 import dataclasses
@@ -217,7 +220,13 @@ class TestEngineIdentity:
                             speculative_k=7)
         a, steps_a = _drain(base, self._requests())
         b, steps_b = _drain(spec, self._requests())
-        assert a == b, "speculation changed tokens"
+        # greedy and penalized rows: BIT-identical with speculation on.
+        # The sampled row is distribution-exact, not stream-identical
+        # (rejection sampling consumes randomness differently) — its
+        # determinism contract is covered by TestSampledSpeculation.
+        for rid in ("greedy-rep", "greedy-rand", "penalized"):
+            assert a[rid] == b[rid], f"speculation changed tokens for {rid}"
+        assert len(b["sampled"]) == len(a["sampled"])
         assert spec.spec_proposed_total > 0
         assert spec.spec_accepted_total > 0, (
             "repetitive greedy prompt should accept drafts"
@@ -286,3 +295,106 @@ class TestEngineIdentity:
         for b in range(B):
             got[b, counts[b]:] = 0.0
         np.testing.assert_allclose(got, np.asarray(ref), atol=3e-4, rtol=3e-4)
+
+
+class TestSampledSpeculation:
+    """Rejection-sampling speculation for temperature>0 rows: the
+    acceptance rule preserves the target distribution EXACTLY for delta
+    drafts, output is deterministic for a (seed, spec config), and a
+    top_k=1 filtered distribution (a delta) must reproduce greedy."""
+
+    CACHE = CacheConfig(n_pages=65, page_size=16, max_pages_per_seq=16)
+
+    def test_marginal_distribution_preserved(self):
+        """Sampler-level exactness: emit = draft if u < p(draft) else
+        replacement ⇒ the emitted marginal equals the filtered target
+        distribution, whatever token is proposed."""
+        import jax
+        import jax.numpy as jnp
+
+        from fusioninfer_tpu.engine.sampler import (
+            filter_logits,
+            make_row_keys,
+            spec_window_draws,
+        )
+
+        V, N = 12, 4000
+        base = jax.random.normal(jax.random.key(0), (1, V)) * 2.0
+        temps = jnp.full((N,), 0.8, jnp.float32)
+        tks = jnp.zeros((N,), jnp.int32)
+        tps = jnp.full((N,), 0.9, jnp.float32)
+        mps = jnp.zeros((N,), jnp.float32)
+        target = np.asarray(jax.nn.softmax(filter_logits(
+            base, temps[:1], tks[:1], tps[:1], mps[:1]), axis=-1))[0]
+        draft = int(np.argsort(target)[-2])  # a plausible draft token
+
+        # one batched call: N independent keys over the SAME position
+        logits_w = jnp.tile(base.astype(jnp.float32), (N, 1))[:, None, :]
+        dn = jnp.full((N, 1), draft, jnp.int32)
+        keys = make_row_keys(jnp.full((N,), 7, jnp.uint32),
+                             jnp.arange(N, dtype=jnp.int32)).reshape(N, 1)
+        full, p_d, u, repl = spec_window_draws(
+            logits_w, dn, keys, temps, tks, tps, mps)
+        full = np.asarray(full[:, 0])
+        accept = np.asarray(u[:, 0]) < np.asarray(p_d[:, 0])
+        emitted = np.where(accept, draft, np.asarray(repl[:, 0]))
+        emp = np.bincount(emitted, minlength=V) / N
+        np.testing.assert_allclose(emp, target, atol=0.04)
+        # the independent full draw (the bonus-token path) matches the
+        # target marginal too
+        emp_full = np.bincount(full, minlength=V) / N
+        np.testing.assert_allclose(emp_full, target, atol=0.04)
+
+    def test_seeded_sampled_deterministic_under_spec(self):
+        def run():
+            eng = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=2,
+                               speculative_k=4)
+            reqs = [Request(request_id="s", prompt_tokens=[3, 4, 5] * 10,
+                            params=SamplingParams(max_tokens=16,
+                                                  temperature=0.8, seed=11))]
+            out, _ = _drain(eng, reqs)
+            return out["s"], eng.spec_proposed_total, eng.spec_accepted_total
+
+        a, prop_a, acc_a = run()
+        b, prop_b, acc_b = run()
+        assert a == b and (prop_a, acc_a) == (prop_b, acc_b)
+        assert len(a) == 16
+
+    def test_top_k_one_reproduces_greedy(self):
+        """top_k=1 collapses the filtered distribution to a delta at the
+        argmax: a 'sampled' request must then emit exactly the greedy
+        stream, speculation on or off — a sharp correctness check on
+        the acceptance math (any off-by-one in p/u/replacement shows)."""
+        prompts = [3, 4, 5] * 10
+
+        def run(spec_k, temperature, top_k=0):
+            eng = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=2,
+                               speculative_k=spec_k)
+            reqs = [Request(request_id="r", prompt_tokens=list(prompts),
+                            params=SamplingParams(max_tokens=14,
+                                                  temperature=temperature,
+                                                  top_k=top_k, seed=5))]
+            out, _ = _drain(eng, reqs)
+            return out["r"]
+
+        greedy = run(None, 0.0)
+        assert run(4, 0.9, top_k=1) == greedy
+        assert run(None, 0.9, top_k=1) == greedy
+
+    def test_sampled_spec_proposes_and_saves_steps(self):
+        """Near-greedy temperature on a repetitive prompt: the sampled
+        row follows the pattern, n-gram drafts flow, acceptance fires,
+        and accepted bursts save decode steps — through the REJECTION
+        path, not the argmax path (temperature > 0)."""
+        reqs = lambda: [Request(  # noqa: E731
+            request_id="s", prompt_tokens=[7, 8, 9] * 12,
+            params=SamplingParams(max_tokens=24, temperature=0.05, seed=2))]
+        base = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=2)
+        spec = NativeEngine(CFG, cache_cfg=self.CACHE, max_batch_size=2,
+                            speculative_k=6)
+        _, steps_a = _drain(base, reqs())
+        out, steps_b = _drain(spec, reqs())
+        assert len(out["s"]) == 24
+        assert spec.spec_proposed_total > 0  # sampled rows DO speculate
+        assert spec.spec_accepted_total > 0
+        assert steps_b < steps_a
