@@ -531,14 +531,15 @@ class SchemaByteMachine:
             return _mask(_HEX)
         node = f["node"]
         if key["free"] or node["addl"] is not None:
-            m = _mask(_STR_BYTES, b'"\\') if node["addl"] is not None \
-                else _mask(_STR_BYTES, b"\\")
+            m = _mask(_STR_BYTES, b"\\")
             # closing here names bytes(dec): a declared name binds its
             # property schema — but a SEEN one would be a duplicate key
             # whose last-wins value could violate the schema, so the
-            # quote is masked and the key must grow
-            if not self._key_close_ok(f, key):
-                m[0x22] = False
+            # quote is only legal when the decoded name is bindable
+            # (declared-and-unseen, or addl-typed).  Set, don't just
+            # clear: with addl=None a free key (entered via an escape in
+            # a declared name) must still be able to close on a match.
+            m[0x22] = self._key_close_ok(f, key)
             return m
         pos = key["pos"]
         conts = bytes({nb[pos] for nb, _ in key["cands"] if len(nb) > pos})

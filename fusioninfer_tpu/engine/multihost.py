@@ -79,12 +79,29 @@ class EventBroadcaster:
         n = int(mu.broadcast_one_to_all(np.int32(len(payload))))
         if n == 0:
             return []
+        # Pad the payload to a power-of-two bucket: broadcast_one_to_all
+        # compiles one collective per distinct array shape, so raw
+        # per-batch lengths would recompile on every new size and grow
+        # the compile cache without bound on a long-lived server.  The
+        # true length rides the int32 broadcast above; every process
+        # derives the same bucket from it.
+        bucket = _payload_bucket(n)
         if self.is_leader:
-            buf = np.frombuffer(payload, np.uint8)
+            buf = np.zeros(bucket, np.uint8)
+            buf[:n] = np.frombuffer(payload, np.uint8)
         else:
-            buf = np.zeros(n, np.uint8)
+            buf = np.zeros(bucket, np.uint8)
         out = np.asarray(mu.broadcast_one_to_all(buf))
-        return json.loads(bytes(out.tobytes()))
+        return json.loads(bytes(out[:n].tobytes()))
+
+
+def _payload_bucket(n: int, floor: int = 256) -> int:
+    """Smallest power-of-two >= max(n, floor) — bounds the number of
+    distinct broadcast shapes (and thus compiles) at log2(max payload)."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
 
 
 def request_to_event(request: "Request") -> dict:

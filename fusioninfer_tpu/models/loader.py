@@ -59,11 +59,36 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         if hf.get("use_sliding_window", True) else None,
         # MoE: Qwen3-MoE names (num_experts/moe_intermediate_size);
         # Mixtral calls the expert count num_local_experts
-        n_experts=int(hf.get("num_experts")
-                      or hf.get("num_local_experts") or 0),
-        n_experts_active=int(hf.get("num_experts_per_tok", 2)),
+        n_experts=(n_experts := int(hf.get("num_experts")
+                                    or hf.get("num_local_experts") or 0)),
+        n_experts_active=_experts_per_tok(hf, n_experts),
         moe_d_ff=int(hf.get("moe_intermediate_size", 0)),
     ).validate()
+
+
+# exact model_type → top-k; substring matching would silently mis-route
+# unknown variants (qwen3_next routes top-10, not top-8)
+_FAMILY_TOP_K = {"qwen3_moe": 8, "qwen2_moe": 4, "mixtral": 2}
+
+
+def _experts_per_tok(hf: dict, n_experts: int) -> int:
+    """Top-k routing width.  When the key is absent the HF *family*
+    default applies: Qwen3-MoE routes top-8, Mixtral top-2 — a flat
+    default of 2 would silently load a Qwen3-MoE checkpoint with the
+    wrong router and produce wrong outputs."""
+    if "num_experts_per_tok" in hf:
+        return int(hf["num_experts_per_tok"])
+    if n_experts == 0:
+        return 2  # dense model: value is unused, keep the config valid
+    model_type = str(hf.get("model_type", "")).lower()
+    try:
+        return _FAMILY_TOP_K[model_type]
+    except KeyError:
+        raise ValueError(
+            f"MoE checkpoint (n_experts={n_experts}) has no "
+            f"num_experts_per_tok and model_type={model_type!r} has no "
+            "known family default — refusing to guess the router top-k"
+        ) from None
 
 
 def _open_safetensors(path: str):

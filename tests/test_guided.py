@@ -495,6 +495,25 @@ class TestSchemaReviewHardening:
         assert not _schema_accepts(s, '{"name":"x","\\u006eame":"y"}')
         assert not _schema_accepts(s, '{"\\u006eame":1}')  # type enforced
 
+    def test_backslash_in_declared_name_can_close(self):
+        # a declared name containing a backslash forces the key into
+        # free (escape) mode; with additionalProperties=false the close
+        # quote must still be offered once the decoded name matches —
+        # a clear-only mask left the key unable to close and generation
+        # burned to max_tokens (r4 advisor finding, guided.py:534)
+        s = {"type": "object", "properties": {"a\\b": {"type": "integer"}},
+             "required": ["a\\b"], "additionalProperties": False}
+        assert _schema_accepts(s, '{"a\\\\b":7}')
+        m = SchemaByteMachine(compile_schema(s))
+        for b in b'{"a\\\\b':
+            m.advance(b)
+        assert m.allowed_bytes()[0x22]  # closing quote offered
+        # but a non-matching free key still cannot close (addl=None)
+        m2 = SchemaByteMachine(compile_schema(s))
+        for b in b'{"a\\\\c':
+            m2.advance(b)
+        assert not m2.allowed_bytes()[0x22]
+
     def test_compile_cache_shared(self):
         from fusioninfer_tpu.engine.guided import compile_schema_str
 

@@ -276,3 +276,36 @@ def test_mixtral_export_intermediate_size_is_expert_width(tmp_path):
     assert hf["sliding_window"] == 64
     cfg2, p2 = load_hf_checkpoint(str(d), dtype="float32")
     assert cfg2.expert_d_ff == 512 and cfg2.sliding_window == 64
+
+
+def test_experts_per_tok_family_defaults(tmp_path):
+    """When num_experts_per_tok is absent the FAMILY default applies:
+    Qwen3-MoE routes top-8, Mixtral top-2 — a flat default of 2 would
+    silently load Qwen3-MoE with the wrong router (r4 advisor finding,
+    loader.py:468)."""
+    import json as _json
+
+    def _cfg(extra):
+        d = tmp_path / str(abs(hash(str(extra))))
+        d.mkdir()
+        base = {"vocab_size": 64, "hidden_size": 16, "num_hidden_layers": 1,
+                "num_attention_heads": 2, "intermediate_size": 32,
+                "moe_intermediate_size": 16}
+        base.update(extra)
+        (d / "config.json").write_text(_json.dumps(base))
+        return config_from_hf(str(d))
+
+    assert _cfg({"model_type": "qwen3_moe", "num_experts": 64}
+                ).n_experts_active == 8
+    assert _cfg({"model_type": "mixtral", "num_local_experts": 8}
+                ).n_experts_active == 2
+    assert _cfg({"model_type": "qwen2_moe", "num_experts": 60}
+                ).n_experts_active == 4
+    # explicit key always wins
+    assert _cfg({"model_type": "qwen3_moe", "num_experts": 64,
+                 "num_experts_per_tok": 4}).n_experts_active == 4
+    # unknown MoE family without the key: refuse to guess
+    with pytest.raises(ValueError, match="top-k"):
+        _cfg({"model_type": "mystery_moe", "num_experts": 16})
+    # dense models don't care
+    assert _cfg({"model_type": "llama"}).n_experts == 0

@@ -82,3 +82,35 @@ class TestMeshPredicate:
         mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
         # all 8 virtual devices live in THIS process
         assert not mesh_is_multiprocess(mesh)
+
+
+class TestPayloadBucket:
+    """Broadcast payloads are padded to power-of-two buckets so the
+    collective compiles once per bucket, not once per distinct event
+    batch length (r4 advisor finding, multihost.py:86)."""
+
+    def test_bucket_values(self):
+        from fusioninfer_tpu.engine.multihost import _payload_bucket
+
+        assert _payload_bucket(0) == 256
+        assert _payload_bucket(1) == 256
+        assert _payload_bucket(256) == 256
+        assert _payload_bucket(257) == 512
+        assert _payload_bucket(5000) == 8192
+        # distinct shapes for any payload <= 1 MiB: log2(1Mi/256)+1 = 13
+        sizes = {_payload_bucket(n) for n in range(0, 1 << 20, 997)}
+        assert len(sizes) <= 13
+
+    def test_single_process_exchange_round_trips(self):
+        """The padded payload must decode to exactly the queued events
+        (the slice [:n] strips the zero padding)."""
+        from fusioninfer_tpu.engine.multihost import EventBroadcaster
+
+        b = EventBroadcaster()
+        assert b.is_leader
+        b.queue({"type": "cancel", "request_id": "x" * 300})  # > 1 bucket floor
+        b.queue(cancel_event("y"))
+        out = b.exchange()
+        assert out == [{"type": "cancel", "request_id": "x" * 300},
+                       {"type": "cancel", "request_id": "y"}]
+        assert b.exchange() == []  # empty fast path: no payload collective
