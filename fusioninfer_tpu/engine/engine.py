@@ -163,19 +163,12 @@ def _install_slot_rows(token_counts, output_counts, suppress, slot,
             suppress.at[slot].set(sup_row))
 
 
-def _token_legality(byte_table, allowed):
-    """Byte-legality → token-legality ([..., 256] bool → [..., V]): the
-    ONE place the byte→token semantics live (jittable; used by both the
-    prefill-time mask helper and the fused decode mask)."""
-    return (byte_table >= 0) & allowed[..., jnp.clip(byte_table, 0, 255)]
-
-
 @partial(jax.jit, donate_argnums=(0,))
-def _mask_guided_rows(logits, byte_table, allowed, grow):
-    """Guided rows: tokens whose byte is grammatically illegal drop to
-    -inf (byte_table maps token id → byte, -1 = no single-byte form)."""
-    return jnp.where(grow[:, None] & ~_token_legality(byte_table, allowed),
-                     -jnp.inf, logits)
+def _mask_guided_rows(logits, legal, grow):
+    """Guided rows: grammatically illegal tokens drop to -inf.  ``legal``
+    is [B, V] bool from the token masker (``engine/token_mask.py``) —
+    token-level legality, exact for multi-byte vocabs."""
+    return jnp.where(grow[:, None] & ~legal, -jnp.inf, logits)
 
 
 def _urgency(request: Request) -> tuple:
@@ -416,10 +409,17 @@ class NativeEngine:
         self.proposer = NgramProposer() if speculative_k else None
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
-        # guided decoding (response_format json_object): token id → byte
-        # mapping for grammar masking; None = guided requests rejected
-        self._byte_np = None
-        self._byte_dev = None
+        # guided decoding (response_format json_object/json_schema):
+        # token-level grammar masker built from the vocab's byte strings
+        # (engine/token_mask.py); None = guided requests rejected
+        self._masker = None
+        # device-resident [B, V] legality rows keyed by the exact
+        # (slot → machine signature) combination: inside a string or
+        # digit run the signatures repeat step after step, so the hot
+        # path reuses one uploaded array instead of a fresh B×V
+        # host→device transfer per decode step
+        self._guided_legal_dev: collections.OrderedDict = \
+            collections.OrderedDict()
         if token_byte_table is not None:
             self.set_token_byte_table(token_byte_table)
 
@@ -434,10 +434,28 @@ class NativeEngine:
     # -- public API ----------------------------------------------------------
 
     def set_token_byte_table(self, table) -> None:
-        """Install the token→byte mapping guided decoding masks through
-        (built by the server from its tokenizer, ``engine/guided.py``)."""
-        self._byte_np = np.asarray(table, np.int32)
-        self._byte_dev = jnp.asarray(self._byte_np)
+        """Legacy single-byte form: [V] int32, token id → byte value or
+        -1.  Converted to byte strings and delegated to
+        :meth:`set_guided_vocab`."""
+        arr = np.asarray(table, np.int32)
+        self.set_guided_vocab(
+            [bytes([b]) if b >= 0 else None for b in arr.tolist()])
+
+    def set_guided_vocab(self, token_bytes) -> None:
+        """Install per-token byte strings ([V] list of bytes | None) and
+        build the grammar token masker (``engine/token_mask.py``) —
+        guided decoding then works for ANY tokenizer whose vocab has a
+        byte mapping, not just the single-byte demo tokenizer."""
+        from fusioninfer_tpu.engine.token_mask import GrammarTokenMasker
+
+        V = self.cfg.vocab_size
+        tb = list(token_bytes)[:V]
+        tb += [None] * (V - len(tb))  # model vocab may exceed tokenizer's
+        self._masker = GrammarTokenMasker(tb)
+
+    @property
+    def guided_enabled(self) -> bool:
+        return self._masker is not None
 
     def add_request(self, request: Request) -> None:
         if request.params.max_tokens < 1:
@@ -445,7 +463,7 @@ class NativeEngine:
         if not request.prompt_tokens:
             raise ValueError("prompt must not be empty")
         if (request.params.guided_json or request.params.guided_schema) \
-                and self._byte_np is None:
+                and self._masker is None:
             raise ValueError(
                 "guided JSON needs a token→byte mapping; the serving "
                 "tokenizer does not provide one"
@@ -1136,19 +1154,10 @@ class NativeEngine:
             row = row.at[jnp.asarray(params.stop_token_ids, jnp.int32)].set(True)
         return row
 
-    def _allowed_token_mask(self, allowed_bytes) -> jax.Array:
-        """Allowed-bytes mask ([256] or [B, 256] bool) → token-legality
-        mask ([V] or [B, V]) via the byte table (delegates to the shared
-        :func:`_token_legality`, which the fused decode mask also uses —
-        one place for the byte→token semantics)."""
-        return _token_legality(self._byte_dev, jnp.asarray(allowed_bytes))
-
     def _guided_advance(self, machine, token: int) -> Optional[str]:
-        """Advance a guided machine with an emitted token; returns "stop"
-        the moment the top-level object closes."""
-        b = int(self._byte_np[token])
-        if b >= 0:  # the grammar mask guarantees this for sampled tokens
-            machine.advance(b)
+        """Advance a guided machine with an emitted token's bytes;
+        returns "stop" the moment the top-level object closes."""
+        self._masker.advance_token(machine, token)
         return "stop" if machine.done else None
 
     def _sample_first_token(self, logits: jax.Array, request: Request,
@@ -1190,8 +1199,8 @@ class NativeEngine:
             logits = logits.at[0, ids].add(vals)
         if machine is not None:
             logits = _mask_guided_rows(
-                logits, self._byte_dev,
-                jnp.asarray(machine.allowed_bytes())[None],
+                logits,
+                jnp.asarray(self._masker.token_mask(machine))[None],
                 jnp.ones((1,), bool))
         keys = make_row_keys(
             jnp.asarray([seed], jnp.uint32), jnp.asarray([gen_index], jnp.int32)
@@ -1482,9 +1491,7 @@ class NativeEngine:
         machine = machine_for(request.params)
         if machine is not None:
             for t in prefix[n_prompt:]:  # resume: replay generated bytes
-                b = int(self._byte_np[t])
-                if b >= 0:
-                    machine.advance(b)
+                self._masker.advance_token(machine, t)
         token, samp_state = self._sample_first_token(
             logits, request, prefix, seq_seed,
             n_prompt=n_prompt, machine=machine, return_state=True)
@@ -1686,13 +1693,22 @@ class NativeEngine:
         guided_live = {s: st.guided for s, st in live.items()
                        if st.guided is not None}
         if guided_live:
-            allowed = np.zeros((B, 256), bool)
+            key = tuple(sorted((s, m.signature())
+                               for s, m in guided_live.items()))
+            legal_dev = self._guided_legal_dev.get(key)
+            if legal_dev is None:
+                legal = np.zeros((B, self.cfg.vocab_size), bool)
+                for slot, m in guided_live.items():
+                    legal[slot] = self._masker.token_mask(m)
+                legal_dev = jnp.asarray(legal)
+                if len(self._guided_legal_dev) >= 8:  # bound HBM held
+                    self._guided_legal_dev.popitem(last=False)
+                self._guided_legal_dev[key] = legal_dev
+            else:
+                self._guided_legal_dev.move_to_end(key)
             grow = np.zeros((B,), bool)
-            for slot, m in guided_live.items():
-                allowed[slot] = m.allowed_bytes()
-                grow[slot] = True
-            logits = _mask_guided_rows(logits, self._byte_dev,
-                                       jnp.asarray(allowed),
+            grow[list(guided_live)] = True
+            logits = _mask_guided_rows(logits, legal_dev,
                                        jnp.asarray(grow))
         # per-request logit_bias rows (arrays cached at slot registration)
         for slot in live:

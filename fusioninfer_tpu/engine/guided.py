@@ -7,10 +7,11 @@ tokens whose byte is not grammatically legal are masked to -inf — the
 model can only emit syntactically valid JSON, and generation force-stops
 the moment the top-level object closes.  The reference delegates this to
 vLLM's guided-decoding backends (an engine flag passthrough, SURVEY §0);
-here the automaton is exact because the in-repo tokenizer is byte-level
-(one token = one byte, ``engine/tokenizer.py``).  Tokenizers without a
-token→byte mapping reject guided requests up front rather than serving
-unconstrained output.
+here the automata are exact at the BYTE level, and multi-byte BPE /
+SentencePiece vocabs are lifted to token-level masks by
+``engine/token_mask.py`` (a token is sampleable iff its whole byte walk
+is legal).  Tokenizers with no recoverable token→byte mapping reject
+guided requests up front rather than serving unconstrained output.
 
 The automaton accepts RFC 8259 JSON with a top-level OBJECT (what
 ``json_object`` promises): strings with escapes and ``\\uXXXX``, numbers
@@ -23,6 +24,7 @@ hitting ``max_tokens`` mid-object returns a prefix (``finish_reason:
 from __future__ import annotations
 
 import functools as _functools
+import itertools as _itertools
 
 import numpy as np
 
@@ -65,6 +67,31 @@ class JsonByteMachine:
     @property
     def done(self) -> bool:
         return self.state == "done"
+
+    # -- token-mask support (token_mask.py) ----------------------------------
+
+    def fork(self) -> "JsonByteMachine":
+        """Cheap copy for speculative byte walks (token-trie DFS)."""
+        m = JsonByteMachine.__new__(JsonByteMachine)
+        m.stack = self.stack.copy()
+        m.state = self.state
+        m._literal_rest = self._literal_rest
+        m._hex_left = self._hex_left
+        m._in_key = self._in_key
+        return m
+
+    def signature(self) -> tuple:
+        """Hashable EXACT state — equal signatures ⇒ identical legal
+        continuations (token-mask cache key)."""
+        return ("json", self.state, tuple(self.stack), self._literal_rest,
+                self._hex_left, self._in_key)
+
+    def str_run_invariant(self) -> bool:
+        """True when every byte in ``_STR_BYTES`` is legal now AND
+        consuming any of them preserves this property — lets the token
+        masker accept whole all-string trie subtrees without walking
+        them (string content is where real vocabs are fat)."""
+        return self.state == "string"
 
     # -- allowed sets --------------------------------------------------------
 
@@ -191,6 +218,8 @@ class JsonByteMachine:
             self.state = "exp_sign" if b in b"+-" else "exp"
         elif s == "exp_sign":
             self.state = "exp"
+        elif s == "exp":
+            pass  # a digit extending the exponent
         elif s == "after":
             if b == b","[0]:
                 self.state = ("key_required" if self.stack[-1] == "obj"
@@ -255,21 +284,63 @@ def _dump(v) -> bytes:
     return json.dumps(v, separators=(",", ":"), ensure_ascii=True).encode()
 
 
-_ANY: dict = {"kind": "any"}
+_NODE_UIDS = _itertools.count(1)
+
+
+def _node(d: dict) -> dict:
+    """Stamp a compiled node with a process-unique ``uid``.  Machine
+    signatures (used as token-mask cache keys, ``token_mask.py``) refer
+    to nodes by uid rather than ``id()`` — ids get recycled after gc,
+    which could alias two different schemas' cache entries."""
+    d["uid"] = next(_NODE_UIDS)
+    return d
+
+
+_ANY: dict = _node({"kind": "any"})
 
 
 # structural keywords the byte machine cannot enforce: compiling them to
 # "anything" would return finish_reason "stop" output that silently
-# violates the user's contract — reject at admission instead
-_UNSUPPORTED_KEYWORDS = ("$ref", "allOf", "not", "if", "then", "else",
+# violates the user's contract — reject at admission instead.  $ref and
+# allOf ARE supported (local refs resolve, allOf merges at compile time
+# — pydantic/zod-exported schemas are made of them); what remains here
+# is genuinely un-byte-enforceable.
+_UNSUPPORTED_KEYWORDS = ("not", "if", "then", "else",
                          "patternProperties", "propertyNames",
                          "unevaluatedProperties", "prefixItems", "contains")
+
+# keys that carry no byte-wise constraint: ignored by the compiler and
+# excluded when deciding whether a $ref has constraint siblings
+_METADATA_KEYS = frozenset((
+    "$defs", "definitions", "$schema", "$id", "$comment", "title",
+    "description", "default", "examples", "deprecated", "readOnly",
+    "writeOnly", "format", "pattern", "minimum", "maximum",
+    "exclusiveMinimum", "exclusiveMaximum", "multipleOf", "minLength",
+    "maxLength", "minProperties", "maxProperties", "uniqueItems"))
 
 
 def compile_schema(schema) -> dict:
     """JSON schema (dict) → node tree; raises ValueError on schemas the
     byte machine cannot enforce (so the server 400s instead of serving
-    output that silently violates the contract)."""
+    output that silently violates the contract).
+
+    Local ``$ref`` (``#/$defs/...`` / ``#/definitions/...``) resolve
+    against the document root — including RECURSIVE references, which
+    compile to a cyclic node graph the frame-stack machine interprets
+    lazily.  ``allOf`` merges its members' structural constraints at
+    compile time (pydantic's exporter wraps nearly every nested model in
+    one).  Union first-byte disjointness is validated in a post-pass
+    over the finished graph (cycle-safe), since a union alternative may
+    reference a node still being built."""
+    try:
+        node = _compile(schema, schema, {})
+        _validate_graph(node)
+    except RecursionError:
+        raise ValueError("schema nesting too deep to compile") from None
+    return node
+
+
+def _compile(schema, root, memo: dict) -> dict:
     if schema is True or schema == {}:
         return _ANY
     if not isinstance(schema, dict):
@@ -280,22 +351,52 @@ def compile_schema(schema) -> dict:
                 f"unsupported schema keyword {kw!r} — guided generation "
                 "enforces the structural subset (type/properties/required/"
                 "additionalProperties/items/minItems/maxItems/enum/const/"
-                "anyOf/oneOf); inline $defs references before submitting")
+                "anyOf/oneOf/allOf/$ref)")
+    if "$ref" in schema or "allOf" in schema:
+        siblings = [k for k in schema
+                    if k not in _METADATA_KEYS and k != "$ref"]
+        if "$ref" in schema and not siblings:
+            # pure reference: memoize by pointer so recursive schemas
+            # (linked lists, trees) compile to a finite cyclic graph
+            ptr = schema["$ref"]
+            hit = memo.get(ptr)
+            if hit is not None:
+                return hit
+            memo[ptr] = placeholder = _node({})
+            built = _compile(_deref(root, ptr), root, memo)
+            placeholder.update(built)  # fill in place: cycles resolve
+            if "kind" not in placeholder:
+                raise ValueError(
+                    f"$ref {ptr!r} resolves only through other $refs — "
+                    "no concrete schema to enforce")
+            return placeholder
+        # allOf (or $ref with constraint siblings): expand every
+        # fragment and merge the structural constraints
+        return _compile(_merge_fragments(_expand(schema, root, 0)),
+                        root, memo)
     if "enum" in schema or "const" in schema:
         values = schema["enum"] if "enum" in schema else [schema["const"]]
         if not values:
             raise ValueError("enum must be non-empty")
-        return {"kind": "enum", "opts": tuple(_dump(v) for v in values)}
+        return _node({"kind": "enum", "opts": tuple(_dump(v) for v in values)})
     for key in ("anyOf", "oneOf"):
         if key in schema:
-            return _union(tuple(compile_schema(s) for s in schema[key]))
+            if any(k not in _METADATA_KEYS and k != key for k in schema):
+                # sibling constraints apply IN ADDITION to the union per
+                # JSON Schema; compiling the union alone would silently
+                # drop them
+                raise ValueError(
+                    f"{key} with sibling constraint keywords is not "
+                    "byte-wise enforceable")
+            return _union(tuple(_compile(s, root, memo)
+                                for s in schema[key]))
     t = schema.get("type")
     if isinstance(t, list):
-        return _union(tuple(compile_schema(dict(schema, type=tt))
+        return _union(tuple(_compile(dict(schema, type=tt), root, memo)
                             for tt in t))
     if t == "object":
         props = {
-            name.encode(): compile_schema(sub)
+            name.encode(): _compile(sub, root, memo)
             for name, sub in (schema.get("properties") or {}).items()
         }
         required = []
@@ -307,10 +408,10 @@ def compile_schema(schema) -> dict:
                     "properties for guided generation")
             required.append(nb)
         addl = schema.get("additionalProperties", True)
-        addl_node = None if addl is False else compile_schema(
-            _coerce_bool_schema(addl))
-        return {"kind": "object", "props": props,
-                "required": frozenset(required), "addl": addl_node}
+        addl_node = None if addl is False else _compile(
+            _coerce_bool_schema(addl), root, memo)
+        return _node({"kind": "object", "props": props,
+                      "required": frozenset(required), "addl": addl_node})
     if t == "array":
         lo = int(schema.get("minItems", 0))
         hi = int(schema["maxItems"]) if "maxItems" in schema else None
@@ -318,23 +419,152 @@ def compile_schema(schema) -> dict:
             # contradictory bounds would deadlock generation into
             # whitespace-only output (neither ',' nor ']' ever legal)
             raise ValueError(f"minItems {lo} > maxItems {hi}")
-        return {"kind": "array",
-                "items": compile_schema(
-                    _coerce_bool_schema(schema.get("items", True))),
-                "min": lo, "max": hi}
+        return _node({"kind": "array",
+                      "items": _compile(
+                          _coerce_bool_schema(schema.get("items", True)),
+                          root, memo),
+                      "min": lo, "max": hi})
     if t == "string":
-        return {"kind": "string"}
+        return _node({"kind": "string"})
     if t == "number":
-        return {"kind": "number"}
+        return _node({"kind": "number"})
     if t == "integer":
-        return {"kind": "integer"}
+        return _node({"kind": "integer"})
     if t == "boolean":
-        return {"kind": "enum", "opts": (b"true", b"false")}
+        return _node({"kind": "enum", "opts": (b"true", b"false")})
     if t == "null":
-        return {"kind": "enum", "opts": (b"null",)}
+        return _node({"kind": "enum", "opts": (b"null",)})
     if t is None:
         return _ANY
     raise ValueError(f"unsupported schema type {t!r}")
+
+
+def _deref(root, ptr: str):
+    """Resolve a LOCAL JSON pointer (``#/...``) against the document
+    root.  Remote/URL refs cannot be fetched from a serving engine."""
+    if not isinstance(ptr, str) or not ptr.startswith("#"):
+        raise ValueError(
+            f"only local $ref pointers (#/...) are supported, got {ptr!r}")
+    target = root
+    for part in ptr[1:].split("/"):
+        if not part:
+            continue
+        part = part.replace("~1", "/").replace("~0", "~")
+        if isinstance(target, dict) and part in target:
+            target = target[part]
+        elif isinstance(target, list) and part.isdigit() \
+                and int(part) < len(target):
+            target = target[int(part)]
+        else:
+            raise ValueError(f"$ref {ptr!r} does not resolve")
+    return target
+
+
+def _expand(s, root, depth: int) -> list:
+    """A schema with ``$ref``/``allOf`` → flat list of plain constraint
+    fragments.  Depth-bounded: a $ref cycle reachable through allOf has
+    no finite merged form (unlike pure refs, which stay lazy)."""
+    if depth > 64:
+        raise ValueError(
+            "$ref/allOf nesting too deep — recursive references cannot "
+            "be merged under allOf")
+    if not isinstance(s, dict):
+        s = _coerce_bool_schema(s)
+    base = {k: v for k, v in s.items() if k not in ("$ref", "allOf")}
+    frags = [base] if any(k not in _METADATA_KEYS for k in base) else []
+    if "$ref" in s:
+        frags += _expand(_deref(root, s["$ref"]), root, depth + 1)
+    for sub in s.get("allOf", ()):
+        frags += _expand(sub, root, depth + 1)
+    return frags
+
+
+def _merge_fragments(frags: list) -> dict:
+    """Merge constraint fragments under allOf-intersection semantics.
+    Structural keywords compose (properties merge per-key via nested
+    allOf, required unions, bounds tighten, enums intersect); a
+    combination whose intersection the byte machine cannot express
+    (e.g. anyOf in more than one fragment) is rejected loudly."""
+    out: dict = {}
+    for f in frags:
+        for k, v in f.items():
+            if k in _METADATA_KEYS:
+                continue
+            if k not in out:
+                out[k] = v
+                continue
+            cur = out[k]
+            if k == "type":
+                cur_set = set(cur) if isinstance(cur, list) else {cur}
+                new_set = set(v) if isinstance(v, list) else {v}
+                both = cur_set & new_set
+                # integer is a subtype of number: their meet is integer —
+                # but only ACROSS the two fragments (one side must say
+                # number, the other integer; both names on the same side
+                # prove nothing about the intersection)
+                if not both and (
+                        ("integer" in cur_set and "number" in new_set)
+                        or ("number" in cur_set and "integer" in new_set)):
+                    both = {"integer"}
+                if not both:
+                    raise ValueError(
+                        f"allOf: no type satisfies both {sorted(cur_set)} "
+                        f"and {sorted(new_set)}")
+                out[k] = sorted(both) if len(both) > 1 else both.pop()
+            elif k == "properties":
+                merged = dict(cur)
+                for name, sub in v.items():
+                    merged[name] = ({"allOf": [merged[name], sub]}
+                                    if name in merged else sub)
+                out[k] = merged
+            elif k == "required":
+                out[k] = sorted(set(cur) | set(v))
+            elif k == "additionalProperties":
+                if cur is False or v is False:
+                    out[k] = False
+                elif cur is True:
+                    out[k] = v
+                elif v is not True:
+                    out[k] = {"allOf": [cur, v]}
+            elif k == "items":
+                if cur is not v:
+                    out[k] = {"allOf": [cur, v]}
+            elif k == "minItems":
+                out[k] = max(int(cur), int(v))
+            elif k == "maxItems":
+                out[k] = min(int(cur), int(v))
+            elif k in ("enum", "const"):
+                cur_vals = cur if k == "enum" else [cur]
+                new_vals = v if k == "enum" else [v]
+                keep = [x for x in cur_vals
+                        if any(_dump(x) == _dump(y) for y in new_vals)]
+                if not keep:
+                    raise ValueError("allOf: enum/const intersection is empty")
+                out[k] = keep if k == "enum" else keep[0]
+            elif k in ("anyOf", "oneOf"):
+                raise ValueError(
+                    "allOf combining multiple anyOf/oneOf branches is not "
+                    "byte-wise enforceable")
+            elif cur != v:
+                raise ValueError(
+                    f"allOf: conflicting values for {k!r}: {cur!r} vs {v!r}")
+    if "enum" in out and "const" in out:
+        keep = [x for x in out["enum"] if _dump(x) == _dump(out["const"])]
+        if not keep:
+            raise ValueError("allOf: enum/const intersection is empty")
+        del out["enum"]
+        out["const"] = keep[0]
+    if ("anyOf" in out or "oneOf" in out) and any(
+            k not in _METADATA_KEYS and k not in ("anyOf", "oneOf")
+            for k in out):
+        # _compile's anyOf branch would silently drop the sibling
+        # constraints — the union's alternatives would need the other
+        # fragments distributed into them, which is beyond byte-wise
+        # enforcement; reject loudly per the module contract
+        raise ValueError(
+            "allOf combining anyOf/oneOf with other constraints is not "
+            "byte-wise enforceable")
+    return out
 
 
 def _coerce_bool_schema(s):
@@ -346,23 +576,47 @@ def _coerce_bool_schema(s):
 
 
 def _union(alts: tuple) -> dict:
-    """Union node, valid only when the first byte DECIDES the
-    alternative — otherwise generation would silently commit to
-    whichever alternative matched first (e.g. anyOf of two object
-    shapes, or ["integer", "number"]), making the others unreachable.
-    Per this module's contract that is a loud admission-time rejection,
-    not a silent narrowing."""
+    """Union node.  Valid only when the first byte DECIDES the
+    alternative — validated in :func:`_validate_graph` once the whole
+    graph is built (an alternative may be a $ref placeholder here)."""
     if len(alts) == 1:
         return alts[0]
-    for i, a in enumerate(alts):
-        for b in alts[i + 1:]:
-            if (_first_byte_mask(a) & _first_byte_mask(b)).any():
-                raise ValueError(
-                    "anyOf/oneOf/type-list alternatives must be "
-                    "distinguishable by their first byte (e.g. "
-                    '["string", "null"]); overlapping alternatives '
-                    "cannot be byte-wise enforced")
-    return {"kind": "union", "alts": alts}
+    return _node({"kind": "union", "alts": alts})
+
+
+def _validate_graph(node: dict) -> None:
+    """Post-compile pass over the (possibly cyclic) node graph: every
+    union's alternatives must be first-byte disjoint — otherwise
+    generation would silently commit to whichever alternative matched
+    first, making the others unreachable.  Per this module's contract
+    that is a loud admission-time rejection, not a silent narrowing."""
+    seen: set = set()
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        kind = n.get("kind")
+        if kind is None:
+            raise ValueError("schema compiled to an empty node")  # pragma: no cover
+        if kind == "union":
+            alts = n["alts"]
+            for i, a in enumerate(alts):
+                for b in alts[i + 1:]:
+                    if (_first_byte_mask(a) & _first_byte_mask(b)).any():
+                        raise ValueError(
+                            "anyOf/oneOf/type-list alternatives must be "
+                            "distinguishable by their first byte (e.g. "
+                            '["string", "null"]); overlapping alternatives '
+                            "cannot be byte-wise enforced")
+            stack.extend(alts)
+        elif kind == "object":
+            stack.extend(n["props"].values())
+            if n["addl"] is not None:
+                stack.append(n["addl"])
+        elif kind == "array":
+            stack.append(n["items"])
 
 
 @_functools.lru_cache(maxsize=256)
@@ -376,7 +630,7 @@ def compile_schema_str(canonical: str) -> dict:
 
 
 # first byte → which value alternative it starts
-def _first_byte_mask(node) -> np.ndarray:
+def _first_byte_mask(node, _seen=None) -> np.ndarray:
     kind = node["kind"]
     if kind == "object":
         return _mask(b"{")
@@ -389,18 +643,33 @@ def _first_byte_mask(node) -> np.ndarray:
     if kind == "enum":
         return _mask(bytes(o[0] for o in node["opts"]))
     if kind == "union":
+        # a $ref cycle threading ONLY unions (X = anyOf[$ref X, ...])
+        # makes no byte progress — reject instead of recursing forever
+        _seen = set() if _seen is None else _seen
+        if id(node) in _seen:
+            raise ValueError(
+                "$ref cycle through anyOf/oneOf alternatives — the "
+                "alternative never reaches a concrete first byte")
+        _seen.add(id(node))
         m = np.zeros(256, bool)
         for alt in node["alts"]:
-            m |= _first_byte_mask(alt)
+            m |= _first_byte_mask(alt, _seen)
         return m
     if kind == "any":
         return _mask(b'{["-tfn', _DIGITS)
     raise AssertionError(kind)
 
 
-_ANY_OBJECT = {"kind": "object", "props": {}, "required": frozenset(),
-               "addl": _ANY}
-_ANY_ARRAY = {"kind": "array", "items": _ANY, "min": 0, "max": None}
+_ANY_OBJECT = _node({"kind": "object", "props": {}, "required": frozenset(),
+                     "addl": _ANY})
+_ANY_ARRAY = _node({"kind": "array", "items": _ANY, "min": 0, "max": None})
+# the concrete values an "any" resolves to — module constants so their
+# uids are stable for the life of the process (token-mask cache keys)
+_ANY_STRING = _node({"kind": "string"})
+_ANY_NUMBER = _node({"kind": "number"})
+_ANY_TRUE = _node({"kind": "enum", "opts": (b"true",)})
+_ANY_FALSE = _node({"kind": "enum", "opts": (b"false",)})
+_ANY_NULL = _node({"kind": "enum", "opts": (b"null",)})
 
 
 def _resolve_alt(node, b: int):
@@ -417,15 +686,15 @@ def _resolve_alt(node, b: int):
         if c == b"[":
             return _ANY_ARRAY
         if c == b'"':
-            return {"kind": "string"}
+            return _ANY_STRING
         if c == b"-" or b in _DIGITS:
-            return {"kind": "number"}
+            return _ANY_NUMBER
         if c == b"t":
-            return {"kind": "enum", "opts": (b"true",)}
+            return _ANY_TRUE
         if c == b"f":
-            return {"kind": "enum", "opts": (b"false",)}
+            return _ANY_FALSE
         if c == b"n":
-            return {"kind": "enum", "opts": (b"null",)}
+            return _ANY_NULL
         raise AssertionError(f"byte {b!r} starts no JSON value")
     return node
 
@@ -456,6 +725,75 @@ class SchemaByteMachine:
     @property
     def done(self) -> bool:
         return not self._stack
+
+    # -- token-mask support (token_mask.py) ----------------------------------
+
+    def fork(self) -> "SchemaByteMachine":
+        m = SchemaByteMachine.__new__(SchemaByteMachine)
+        m._stack = [self._copy_frame(f) for f in self._stack]
+        return m
+
+    @staticmethod
+    def _copy_frame(f: dict) -> dict:
+        g = dict(f)
+        if f["t"] == "obj":
+            g["seen"] = set(f["seen"])
+            key = f.get("key")
+            if key is not None:
+                k = dict(key)
+                k["cands"] = list(key["cands"])
+                k["dec"] = bytearray(key["dec"])
+                g["key"] = k
+        return g
+
+    def signature(self) -> tuple:
+        """Hashable EXACT state (token-mask cache key).  Compiled nodes
+        are referenced by their ``uid`` — process-unique, never recycled
+        (unlike ``id()``), so entries from different schemas can't
+        alias."""
+        sig = []
+        for f in self._stack:
+            t = f["t"]
+            if t == "value":
+                sig.append((t, f["node"]["uid"]))
+            elif t == "obj":
+                key = f.get("key")
+                ksig = None
+                if key is not None:
+                    ksig = (tuple(nb for nb, _ in key["cands"]), key["pos"],
+                            key["free"], key["esc"], bytes(key["dec"]),
+                            key.get("hexbuf", ""))
+                vnode = f.get("vnode")
+                sig.append((t, f["node"]["uid"], frozenset(f["seen"]),
+                            f["phase"], ksig,
+                            vnode["uid"] if vnode is not None else None))
+            elif t == "arr":
+                sig.append((t, f["node"]["uid"], f["count"], f["phase"]))
+            elif t == "str":
+                sig.append((t, f["sub"], f["hex_left"]))
+            elif t == "num":
+                sig.append((t, f["integer"], f["state"]))
+            else:  # enum
+                sig.append((t, f["opts"], f["pos"]))
+        return ("schema", tuple(sig))
+
+    def str_run_invariant(self) -> bool:
+        """See :meth:`JsonByteMachine.str_run_invariant`.  True in value
+        string content, and in key states where arbitrary content bytes
+        are legal (free mode, or any state under additionalProperties —
+        trie-follow with ``addl=None`` constrains bytes, so it is NOT
+        invariant)."""
+        if not self._stack:
+            return False
+        f = self._stack[-1]
+        if f["t"] == "str":
+            return f["sub"] == "content"
+        if f["t"] == "obj":
+            key = f.get("key")
+            if key is not None:
+                return key["esc"] is None and (
+                    key["free"] or f["node"]["addl"] is not None)
+        return False
 
     # -- allowed sets --------------------------------------------------------
 

@@ -159,12 +159,12 @@ class EngineServer:
             )
         self.engine = engine
         self.tokenizer = tokenizer or load_tokenizer()
-        if getattr(engine, "_byte_np", None) is None:
-            from fusioninfer_tpu.engine.guided import build_token_byte_table
+        if not getattr(engine, "guided_enabled", False):
+            from fusioninfer_tpu.engine.token_mask import token_byte_strings
 
-            table = build_token_byte_table(self.tokenizer, engine.cfg.vocab_size)
-            if table is not None:
-                engine.set_token_byte_table(table)
+            tb = token_byte_strings(self.tokenizer, engine.cfg.vocab_size)
+            if tb is not None:
+                engine.set_guided_vocab(tb)
         self.metrics = EngineMetrics(model)
         self.host, self.port = host, port
         self._channels: dict[str, _RequestChannel] = {}

@@ -468,8 +468,8 @@ class TestSchemaReviewHardening:
     keys via the additionalProperties path, contradictory array bounds."""
 
     def test_unsupported_keywords_rejected(self):
-        for bad in ({"$ref": "#/$defs/Pet"},
-                    {"allOf": [{"type": "object"}]},
+        for bad in ({"$ref": "#/$defs/Pet"},          # unresolvable ref
+                    {"not": {"type": "string"}},
                     {"type": "object",
                      "properties": {"p": {"$ref": "#/$defs/X"}}},
                     {"type": "array", "minItems": 2, "maxItems": 1}):
@@ -543,3 +543,165 @@ class TestSchemaRound4ReviewFixes:
         # distinguishable unions still compile
         compile_schema({"type": ["string", "null"]})
         compile_schema({"anyOf": [{"type": "number"}, {"type": "boolean"}]})
+
+
+class TestSchemaRefsAllOf:
+    """$ref/$defs resolution and allOf merging — what every pydantic/
+    zod-exported schema is made of (r4 VERDICT #7)."""
+
+    def test_local_defs_resolve(self):
+        s = {"type": "object",
+             "properties": {"pet": {"$ref": "#/$defs/Pet"}},
+             "required": ["pet"], "additionalProperties": False,
+             "$defs": {"Pet": {"type": "object",
+                               "properties": {"kind": {"enum": ["cat"]}},
+                               "required": ["kind"],
+                               "additionalProperties": False}}}
+        assert _schema_accepts(s, '{"pet":{"kind":"cat"}}')
+        assert not _schema_accepts(s, '{"pet":{"kind":"dog"}}')
+        assert not _schema_accepts(s, '{"pet":7}')
+
+    def test_draft07_definitions_resolve(self):
+        s = {"type": "object",
+             "properties": {"n": {"$ref": "#/definitions/num"}},
+             "required": ["n"],
+             "definitions": {"num": {"type": "integer"}}}
+        assert _schema_accepts(s, '{"n":42}')
+        assert not _schema_accepts(s, '{"n":4.5}')
+
+    def test_allof_merges_objects(self):
+        s = {"allOf": [
+            {"type": "object", "properties": {"a": {"type": "integer"}},
+             "required": ["a"]},
+            {"type": "object", "properties": {"b": {"type": "string"}},
+             "required": ["b"], "additionalProperties": False},
+        ]}
+        assert _schema_accepts(s, '{"a":1,"b":"x"}')
+        assert not _schema_accepts(s, '{"a":1}')        # b required
+        assert not _schema_accepts(s, '{"a":1,"b":"x","c":1}')  # addl False
+
+    def test_allof_per_property_intersection(self):
+        # the same property constrained by two branches: both apply
+        s = {"allOf": [
+            {"type": "object", "properties": {"v": {"type": ["integer",
+                                                             "string"]}}},
+            {"type": "object", "properties": {"v": {"type": "integer"}},
+             "required": ["v"]},
+        ]}
+        assert _schema_accepts(s, '{"v":3}')
+        assert not _schema_accepts(s, '{"v":"x"}')
+
+    def test_allof_ref_with_siblings_pydantic_style(self):
+        # pydantic wraps nested models as {"allOf": [{"$ref": ...}]}
+        # (v1) or {"$ref": ..., "description": ...} (v2)
+        s = {"type": "object",
+             "properties": {
+                 "cfg": {"allOf": [{"$ref": "#/$defs/Cfg"}],
+                         "description": "nested"},
+                 "alt": {"$ref": "#/$defs/Cfg", "title": "x"},
+             },
+             "required": ["cfg"],
+             "$defs": {"Cfg": {"type": "object",
+                               "properties": {"on": {"type": "boolean"}},
+                               "additionalProperties": False}}}
+        assert _schema_accepts(s, '{"cfg":{"on":true}}')
+        assert not _schema_accepts(s, '{"cfg":{"off":1}}')
+
+    def test_allof_type_conflict_rejected(self):
+        with pytest.raises(ValueError, match="type"):
+            compile_schema({"allOf": [{"type": "string"},
+                                      {"type": "integer"}]})
+
+    def test_allof_integer_narrows_number(self):
+        s = {"type": "object",
+             "properties": {"n": {"allOf": [{"type": "number"},
+                                            {"type": "integer"}]}},
+             "required": ["n"]}
+        assert _schema_accepts(s, '{"n":3}')
+        assert not _schema_accepts(s, '{"n":3.5}')
+
+    def test_allof_enum_intersection(self):
+        s = {"type": "object",
+             "properties": {"k": {"allOf": [{"enum": ["a", "b", "c"]},
+                                            {"enum": ["b", "c", "d"]}]}},
+             "required": ["k"]}
+        assert _schema_accepts(s, '{"k":"b"}')
+        assert not _schema_accepts(s, '{"k":"a"}')
+        with pytest.raises(ValueError, match="empty"):
+            compile_schema({"allOf": [{"enum": ["a"]}, {"enum": ["z"]}]})
+
+    def test_recursive_schema_via_pure_ref(self):
+        node = {"type": "object",
+                "properties": {"val": {"type": "integer"},
+                               "next": {"anyOf": [{"$ref": "#/$defs/N"},
+                                                  {"type": "null"}]}},
+                "required": ["val", "next"],
+                "additionalProperties": False}
+        s = {"$ref": "#/$defs/N", "$defs": {"N": node}}
+        assert _schema_accepts(
+            s, '{"val":1,"next":{"val":2,"next":null}}')
+        assert not _schema_accepts(s, '{"val":1,"next":3}')
+
+    def test_union_only_ref_cycle_rejected(self):
+        s = {"$ref": "#/$defs/X",
+             "$defs": {"X": {"anyOf": [{"$ref": "#/$defs/X"},
+                                       {"type": "null"}]}}}
+        with pytest.raises(ValueError):
+            compile_schema(s)
+
+    def test_remote_ref_rejected(self):
+        with pytest.raises(ValueError, match="local"):
+            compile_schema({"$ref": "https://example.com/s.json"})
+
+    def test_real_pydantic_export(self):
+        pydantic = pytest.importorskip("pydantic")
+
+        class Item(pydantic.BaseModel):
+            model_config = pydantic.ConfigDict(extra="forbid")
+            sku: str
+            qty: int
+
+        class Order(pydantic.BaseModel):
+            model_config = pydantic.ConfigDict(extra="forbid")
+            id: int
+            items: list[Item]
+            note: str | None = None
+
+        s = Order.model_json_schema()
+        assert "$defs" in s  # the shape this feature exists for
+        assert _schema_accepts(
+            s, '{"id":1,"items":[{"sku":"a","qty":2}],"note":null}')
+        assert not _schema_accepts(
+            s, '{"id":1,"items":[{"sku":"a","qty":"two"}],"note":null}')
+
+    def test_masked_walk_conforms_with_refs(self):
+        import random
+
+        s = {"type": "object",
+             "properties": {"pets": {"type": "array",
+                                     "items": {"$ref": "#/$defs/Pet"},
+                                     "minItems": 1, "maxItems": 2}},
+             "required": ["pets"], "additionalProperties": False,
+             "$defs": {"Pet": {"type": "object",
+                               "properties": {"kind": {"enum": ["cat",
+                                                                "dog"]}},
+                               "required": ["kind"],
+                               "additionalProperties": False}}}
+        node = compile_schema(s)
+        done = 0
+        for seed in range(8):
+            rng = random.Random(seed)
+            m = SchemaByteMachine(node)
+            out = bytearray()
+            while not m.done and len(out) < 300:
+                allowed = np.flatnonzero(m.allowed_bytes())
+                assert len(allowed)
+                b = int(rng.choice(allowed))
+                m.advance(b)
+                out.append(b)
+            if m.done:
+                d = json.loads(bytes(out))
+                assert 1 <= len(d["pets"]) <= 2
+                assert all(p["kind"] in ("cat", "dog") for p in d["pets"])
+                done += 1
+        assert done >= 4
