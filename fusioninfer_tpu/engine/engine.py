@@ -452,6 +452,9 @@ class NativeEngine:
         tb = list(token_bytes)[:V]
         tb += [None] * (V - len(tb))  # model vocab may exceed tokenizer's
         self._masker = GrammarTokenMasker(tb)
+        # machine signatures are masker-independent: rows cached under a
+        # previous vocab would silently mask by the OLD byte strings
+        self._guided_legal_dev.clear()
 
     @property
     def guided_enabled(self) -> bool:

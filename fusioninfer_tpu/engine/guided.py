@@ -410,8 +410,28 @@ def _compile(schema, root, memo: dict) -> dict:
         addl = schema.get("additionalProperties", True)
         addl_node = None if addl is False else _compile(
             _coerce_bool_schema(addl), root, memo)
+        # "x-ordered" (in-repo extension): keys must be emitted in the
+        # given order — streaming tool calls rely on the function name
+        # being decided before the arguments open.  MUST be a list: the
+        # canonical schema string sorts dict keys, so declaration order
+        # would not survive the wire (server.py:_sampling_params).
+        ordered = schema.get("x-ordered", False)
+        order = None
+        if ordered:
+            if ordered is True:
+                order = tuple(props)
+            else:
+                order = tuple(str(n).encode() for n in ordered)
+                if set(order) != set(props) or len(order) != len(props):
+                    raise ValueError(
+                        "x-ordered must list every declared property "
+                        "exactly once")
+            if addl_node is not None:
+                raise ValueError(
+                    "x-ordered requires additionalProperties: false")
         return _node({"kind": "object", "props": props,
-                      "required": frozenset(required), "addl": addl_node})
+                      "required": frozenset(required), "addl": addl_node,
+                      "order": order})
     if t == "array":
         lo = int(schema.get("minItems", 0))
         hi = int(schema["maxItems"]) if "maxItems" in schema else None
@@ -840,6 +860,17 @@ class SchemaByteMachine:
             return m
         raise AssertionError(t)
 
+    @staticmethod
+    def _unseen_candidates(node: dict, seen: set) -> list:
+        """Declared names still emittable as the NEXT key: all unseen
+        props, or — under the x-ordered extension — only the first
+        unseen name in declaration order."""
+        order = node.get("order")
+        if order is not None:
+            nxt = next((nb for nb in order if nb not in seen), None)
+            return [nxt] if nxt is not None else []
+        return [nb for nb in node["props"] if nb not in seen]
+
     def _obj_allowed(self, f: dict) -> np.ndarray:
         node, phase = f["node"], f["phase"]
         key = f.get("key")
@@ -847,7 +878,7 @@ class SchemaByteMachine:
             return self._key_allowed(f, key)
         m = np.zeros(256, bool)
         if phase in ("first", "key_required"):
-            unseen = [nb for nb in node["props"] if nb not in f["seen"]]
+            unseen = self._unseen_candidates(node, f["seen"])
             if unseen or node["addl"] is not None:
                 m |= _mask(b'"')
             if phase == "first" and node["required"] <= f["seen"]:
@@ -855,7 +886,7 @@ class SchemaByteMachine:
         elif phase == "colon":
             m |= _mask(b":")
         elif phase == "after":
-            unseen = [nb for nb in node["props"] if nb not in f["seen"]]
+            unseen = self._unseen_candidates(node, f["seen"])
             if unseen or node["addl"] is not None:
                 m |= _mask(b",")
             if node["required"] <= f["seen"]:
@@ -889,7 +920,11 @@ class SchemaByteMachine:
     def _key_close_ok(self, f: dict, key: dict) -> bool:
         name = bytes(key["dec"])
         if name in f["node"]["props"]:
-            return name not in f["seen"]
+            if name in f["seen"]:
+                return False
+            # x-ordered: an escape-spelled declared name must still be
+            # the NEXT name in declaration order to bind
+            return name in self._unseen_candidates(f["node"], f["seen"])
         return f["node"]["addl"] is not None
 
     def _num_allowed(self, f: dict, idx: int) -> np.ndarray:
@@ -1010,9 +1045,9 @@ class SchemaByteMachine:
         node, phase = f["node"], f["phase"]
         c = bytes([b])
         if phase in ("first", "key_required") and c == b'"':
+            nxt = self._unseen_candidates(node, f["seen"])
             f["key"] = {
-                "cands": [(nb, pn) for nb, pn in node["props"].items()
-                          if nb not in f["seen"]],
+                "cands": [(nb, node["props"][nb]) for nb in nxt],
                 "pos": 0, "free": False, "esc": None, "dec": bytearray(),
             }
         elif phase == "first" and c == b"}":

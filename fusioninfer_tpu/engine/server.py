@@ -556,19 +556,25 @@ class EngineServer:
         if chat:
             body = self._chat_logprobs_body(body)
             by_name, choice = self._parse_tools(body)
-            if by_name and choice not in ("none", "auto"):
-                # a forced call streams as tool_calls deltas in OpenAI's
-                # protocol; this server assembles calls from the full
-                # text — reject up front rather than stream a shape the
-                # client's SDK won't parse.  (tool_choice "auto" streams
-                # as ordinary content: opportunistic call assembly is a
-                # non-stream feature, documented in docs/design/engine.md)
-                raise ValueError(
-                    "tool_choice 'required' / named-function is not "
-                    "supported with stream=true; use stream=false")
+            forced = bool(by_name) and choice not in ("none", "auto")
+            if forced:
+                if body.get("response_format") is not None:
+                    raise ValueError(
+                        "response_format cannot be combined with a forced "
+                        "tool_choice (the tool call defines the output "
+                        "shape)")
+                # guided generation GUARANTEES a well-formed call; the
+                # x-ordered grammar puts the name first so tool_calls
+                # deltas can start the moment the arguments open
+                body = {**body, "response_format": {
+                    "type": "json_schema",
+                    "json_schema": {"name": "tool_call",
+                                    "schema": self._tool_call_schema(
+                                        by_name, choice)}}}
             prompt = self._chat_prompt(body.get("messages", []),
                                        body.get("tools"), choice)
         else:
+            by_name, choice, forced = {}, "none", False
             prompt = body.get("prompt", "")
             if isinstance(prompt, list):
                 prompt = prompt[0] if prompt else ""
@@ -588,6 +594,7 @@ class EngineServer:
         usage_meta = (len(prompt_tokens), counts) if include_usage else None
         completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         created = int(time.time())  # one id/timestamp shared by ALL chunks
+        tool_mode = bool(by_name) and choice != "none"
         if n == 1:
             chan = self.submit(prompt_tokens, params, lora=lora,
                                priority=priority)
@@ -597,6 +604,8 @@ class EngineServer:
                                       created=created,
                                       echo_prefix=echo_prefix,
                                       usage_counts=counts)
+            if tool_mode:
+                gen = self._tool_stream_adapter(gen, by_name, forced)
             if include_usage:
                 gen = self._with_usage_chunk(gen, usage_meta, chat, served,
                                              completion_id, created)
@@ -610,6 +619,8 @@ class EngineServer:
             for i, c in enumerate(chans)
         ]
         merged = self._merge_streams(gens)
+        if tool_mode:
+            merged = self._tool_stream_adapter(merged, by_name, forced)
         if include_usage:
             merged = self._with_usage_chunk(merged, usage_meta, chat, served,
                                             completion_id, created)
@@ -735,7 +746,14 @@ class EngineServer:
                         self._cancel_chan(chan)
                     elif not out.finished:
                         full = full[: len(full) - _held_back(full, stops)]
-                delta, emitted = full[emitted:], len(full)
+                if not out.finished:
+                    # hold back trailing replacement chars: a multi-byte
+                    # utf-8 sequence split across deltas decodes as
+                    # U+FFFD now but as the REAL char once its
+                    # continuation bytes arrive — shipping it early
+                    # would freeze the mojibake into the client's text
+                    full = full[:len(full.rstrip("�"))]
+                delta, emitted = full[emitted:], max(emitted, len(full))
                 if echo_prefix:  # OpenAI echo: prompt leads the stream
                     delta, echo_prefix = echo_prefix + delta, ""
                 # a logprobs entry ships only for tokens whose text is
@@ -781,6 +799,125 @@ class EngineServer:
                 usage_counts.append(len(tokens))
             self._release(chan)
         yield None  # sentinel: emit data: [DONE]
+
+    _ARGS_MARKER = '"arguments":'
+
+    def _tool_stream_adapter(self, gen, by_name: dict, forced: bool):
+        """Content deltas → OpenAI ``tool_calls`` deltas.
+
+        Forced mode (named / 'required'): the guided text is an
+        x-ordered ``{"name":"X","arguments":{...}}``, so the head delta
+        (id + type + name, empty arguments) ships the moment the
+        arguments key opens and every subsequent chunk streams raw
+        ``arguments`` fragments — the client reassembles the exact
+        object literal.  One char is held back while running so the
+        object's closing brace never leaks into the arguments string.
+
+        Auto mode: output opening with ``{`` is BUFFERED as a candidate
+        call and assembled on finish (one combined tool_calls delta);
+        anything else flushes as plain content immediately.  vLLM's
+        streamed auto-tool parsing makes the same buffer-then-decide
+        trade (reference delegation, core-design.md:29)."""
+        import re
+
+        state: dict[int, dict] = {}
+        for chunk in gen:
+            if chunk is None or not chunk.get("choices"):
+                yield chunk
+                continue
+            choice = chunk["choices"][0]
+            delta = choice.get("delta")
+            if delta is None:  # completions shape: tools are chat-only
+                yield chunk
+                continue
+            idx = choice.get("index", 0)
+            st = state.setdefault(idx, {
+                "text": "", "head_sent": False, "args_at": -1,
+                "args_sent": 0, "mode": "call" if forced else "sniff",
+                "flushed": 0,
+                "id": f"call_{uuid.uuid4().hex[:24]}"})
+            st["text"] += delta.get("content") or ""
+            finish = choice.get("finish_reason")
+            full = st["text"]
+
+            def _emit(d, fin, ch=chunk, choice=choice, i=idx):
+                out = dict(ch)
+                out["choices"] = [{**choice, "index": i, "delta": d,
+                                   "finish_reason": fin}]
+                out["choices"][0].pop("logprobs", None)
+                return out
+
+            if st["mode"] == "sniff":
+                # auto: is this a candidate call? decide on the first
+                # NON-WHITESPACE bytes (a whitespace-only first delta
+                # decides nothing yet)
+                stripped = full.lstrip()
+                if stripped and not stripped.startswith("{"):
+                    st["mode"] = "content"
+                elif finish is not None:
+                    call = self._as_tool_call(full, by_name)
+                    if call is not None:
+                        yield _emit({"role": "assistant", "content": None,
+                                     "tool_calls": [{**call, "index": 0}]},
+                                    "tool_calls" if finish == "stop"
+                                    else finish)
+                        continue
+                    st["mode"] = "content"
+            if st["mode"] == "content":
+                frag = full[st["flushed"]:]
+                st["flushed"] = len(full)
+                if frag or finish is not None:
+                    yield _emit({"content": frag}, finish)
+                continue
+            if st["mode"] == "sniff":
+                continue  # still buffering a candidate call
+
+            # forced call: stream deltas as the guided text decodes
+            if not st["head_sent"]:
+                p = full.find(self._ARGS_MARKER)
+                if p >= 0:
+                    m = re.match(r'\s*\{\s*"name"\s*:\s*"((?:[^"\\]|\\.)*)"',
+                                 full)
+                    name = json.loads(f'"{m.group(1)}"') if m else ""
+                    st["args_at"] = p + len(self._ARGS_MARKER)
+                    st["head_sent"] = True
+                    yield _emit({"role": "assistant", "content": None,
+                                 "tool_calls": [{
+                                     "index": 0, "id": st["id"],
+                                     "type": "function",
+                                     "function": {"name": name,
+                                                  "arguments": ""}}]},
+                                None)
+                elif finish is not None:  # budget died before arguments
+                    yield _emit({}, finish)
+                    continue
+            if st["head_sent"]:
+                args = full[st["args_at"]:]
+                out_fin = finish
+                if finish == "stop":
+                    # "stop" may be the grammar closing the call OR a
+                    # user stop-sequence cutting it mid-arguments — only
+                    # a text that parses as a complete call earns the
+                    # tool_calls claim (and loses its outer closer)
+                    if self._as_tool_call(full, by_name) is not None:
+                        avail = len(args) - 1
+                        out_fin = "tool_calls"
+                    else:
+                        avail = len(args)  # truncated: ship as-is
+                elif finish is not None:
+                    avail = len(args)  # length: ship the partial tail
+                else:
+                    avail = len(args) - 1  # hold back a potential closer
+                frag = args[st["args_sent"]:avail] if avail > st["args_sent"] \
+                    else ""
+                if frag:
+                    st["args_sent"] = avail
+                if frag or finish is not None:
+                    yield _emit(
+                        {"tool_calls": [{"index": 0, "function":
+                                         {"arguments": frag}}]} if frag
+                        else {},
+                        out_fin)
 
     def _priority_of(self, body: dict) -> int:
         """vLLM's ``priority`` extension: lower value = earlier scheduling
@@ -1048,18 +1185,22 @@ class EngineServer:
             targets = [choice[1]]
         else:  # "required"
             targets = list(by_name)
+        # x-ordered: the name key MUST precede arguments, so a streaming
+        # client learns the target function before any argument bytes
         if len(targets) == 1:
             params = by_name[targets[0]].get("parameters") or {"type": "object"}
             return {"type": "object",
                     "properties": {"name": {"const": targets[0]},
                                    "arguments": params},
                     "required": ["name", "arguments"],
-                    "additionalProperties": False}
+                    "additionalProperties": False,
+                    "x-ordered": ["name", "arguments"]}
         return {"type": "object",
                 "properties": {"name": {"enum": targets},
                                "arguments": {"type": "object"}},
                 "required": ["name", "arguments"],
-                "additionalProperties": False}
+                "additionalProperties": False,
+                "x-ordered": ["name", "arguments"]}
 
     @staticmethod
     def _as_tool_call(text: str, by_name: dict) -> dict | None:

@@ -705,3 +705,58 @@ class TestSchemaRefsAllOf:
                 assert all(p["kind"] in ("cat", "dog") for p in d["pets"])
                 done += 1
         assert done >= 4
+
+
+class TestOrderedObjects:
+    """The x-ordered extension (streaming tool calls): keys must come in
+    the listed order, and the list form survives canonical key-sorting."""
+
+    S = {"type": "object",
+         "properties": {"arguments": {"type": "object"},
+                        "name": {"enum": ["f", "g"]}},
+         "required": ["name", "arguments"],
+         "additionalProperties": False,
+         "x-ordered": ["name", "arguments"]}
+
+    def test_order_enforced(self):
+        assert _schema_accepts(self.S, '{"name":"f","arguments":{}}')
+        assert not _schema_accepts(self.S, '{"arguments":{},"name":"f"}')
+
+    def test_survives_canonicalization(self):
+        canonical = json.dumps(self.S, sort_keys=True,
+                               separators=(",", ":"))
+        node = compile_schema(json.loads(canonical))
+        m = SchemaByteMachine(node)
+        for b in b'{"':
+            m.advance(b)
+        # after the opening quote only 'name' (the listed first key)
+        # may continue
+        assert m.allowed_bytes()[ord("n")]
+        assert not m.allowed_bytes()[ord("a")]
+
+    def test_escaped_name_respects_order(self):
+        # with additionalProperties:false the key trie never offers the
+        # escape byte, so escape-spelled keys are masked regardless of
+        # order (generation can always spell the declared name plainly)
+        assert not _schema_accepts(
+            self.S, '{"\\u006eame":"g","arguments":{}}')
+        assert not _schema_accepts(
+            self.S, '{"\\u0061rguments":{},"name":"f"}')
+        # with an open object the escape path exists — order still binds
+        open_s = {"type": "object",
+                  "properties": {"b": {"type": "integer"},
+                                 "a": {"type": "integer"}},
+                  "required": ["b"], "additionalProperties": False,
+                  "x-ordered": ["b", "a"]}
+        assert _schema_accepts(open_s, '{"b":1,"a":2}')
+        assert not _schema_accepts(open_s, '{"a":2,"b":1}')
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="x-ordered"):
+            compile_schema({"type": "object",
+                            "properties": {"a": {"type": "integer"}},
+                            "x-ordered": ["a", "b"]})
+        with pytest.raises(ValueError, match="additionalProperties"):
+            compile_schema({"type": "object",
+                            "properties": {"a": {"type": "integer"}},
+                            "x-ordered": ["a"]})
