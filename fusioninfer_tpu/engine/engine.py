@@ -17,6 +17,7 @@ work displacing them.
 
 from __future__ import annotations
 
+import base64
 import collections
 import concurrent.futures
 import heapq
@@ -390,6 +391,12 @@ class NativeEngine:
         self._slab_q: "queue_mod.Queue[tuple[Request, concurrent.futures.Future]]" = (
             queue_mod.Queue()
         )
+        # PD × multi-process: slab prefills ride the admission event
+        # broadcast so every process runs the SAME jitted prefill +
+        # gather collectives; the deque is replayed identically
+        # everywhere, futures live on the leader only
+        self._pd_pending: collections.deque[Request] = collections.deque()
+        self._pd_futures: dict[str, concurrent.futures.Future] = {}
         # /v1/embeddings: served inside step() (engine thread owns device)
         self._embed_q: "queue_mod.Queue[tuple[list[int], concurrent.futures.Future]]" = (
             queue_mod.Queue()
@@ -508,6 +515,7 @@ class NativeEngine:
         return bool(
             self.waiting or self.waiting_prefilled or self.running
             or self.prefilling or not self._slab_q.empty()
+            or self._pd_pending
             or not self._embed_q.empty()
         )
 
@@ -571,13 +579,25 @@ class NativeEngine:
         Served inside :meth:`step` (engine thread owns the cache); resolves
         to a :class:`fusioninfer_tpu.engine.kv_transfer.KVSlab` — int8
         caches emit int8 slabs (scales ride the wire)."""
-        if self._mh is not None:
-            # extracting a slab pulls pages to one host; a cache sharded
-            # across processes is not fully addressable there
-            raise ValueError(
-                "PD prefill slabs are not supported on a multi-process mesh"
-            )
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        if self._mh is not None:
+            # multi-process mesh: the prefill must run as the SAME jitted
+            # computation on every process (SPMD), so it rides the
+            # admission event broadcast like ordinary requests; the slab
+            # is gathered to host via a mesh collective and the future
+            # resolves on the leader (the only pod routed traffic)
+            from fusioninfer_tpu.engine import multihost
+
+            with self._lock:
+                if request.request_id in self._pd_futures:
+                    raise ValueError(
+                        f"prefill for request_id {request.request_id!r} "
+                        "is already in flight")
+                self._pd_futures[request.request_id] = fut
+            ev = multihost.request_to_event(request)
+            ev["type"] = "prefill_slab"
+            self._mh.queue(ev)
+            return fut
         self._slab_q.put((request, fut))
         return fut
 
@@ -599,15 +619,6 @@ class NativeEngine:
                 "guided JSON is not yet supported on the "
                 "PD-disaggregated prefill wire"
             )
-        if self._mh is not None:
-            # the slab would enter one process's scheduler only — the
-            # next jitted step would then differ across the mesh and the
-            # SPMD collectives mismatch (same reason the prefill side
-            # raises above)
-            raise ValueError(
-                "PD prefilled admission is not supported on a "
-                "multi-process mesh"
-            )
         if slab.page_size != self.cache_cfg.page_size:
             raise ValueError(
                 f"slab page_size {slab.page_size} != engine page_size "
@@ -615,28 +626,84 @@ class NativeEngine:
             )
         if len(slab.prompt_tokens) + request.params.max_tokens > self.cache_cfg.max_len:
             raise ValueError("prompt+max_tokens exceeds engine max_len")
+        if self._mh is not None:
+            # multi-process mesh: every process's scheduler must see the
+            # SAME prefilled admission (the inject + decode are SPMD), so
+            # the slab itself rides the event broadcast.  b64-in-JSON
+            # costs ~33% on the broadcast hop; slabs already crossed DCN
+            # once to reach the leader, and followers have no other wire
+            from fusioninfer_tpu.engine import kv_transfer, multihost
+
+            ev = multihost.request_to_event(request)
+            ev["type"] = "prefilled"
+            ev["slab"] = base64.b64encode(
+                kv_transfer.slab_to_bytes(slab)).decode()
+            self._mh.queue(ev)
+            return
         with self._lock:
             self.waiting_prefilled.append((request, slab))
 
-    def _serve_slab_requests(self) -> None:
-        from fusioninfer_tpu.engine.kv_transfer import extract_slab
+    def _slab_capacity_error(self, prefix: list[int]) -> Optional[str]:
+        """Permanently-infeasible check (deterministic across processes)."""
+        need = self.alloc.pages_needed(len(prefix))
+        if (need > self.cache_cfg.max_pages_per_seq
+                or need > self.cache_cfg.n_pages - 1):
+            return (f"prompt of {len(prefix)} tokens exceeds prefill "
+                    "cache capacity")
+        return None
 
+    def _compute_slab(self, request: Request):
+        """Prefill ``request`` and extract its KV slab.  On a
+        multi-process mesh this is SPMD: every process runs the same
+        prefill and the slab is gathered to HOST arrays via a mesh
+        collective, so the leader can serialize it to the wire."""
+        from fusioninfer_tpu.engine.kv_transfer import (
+            extract_slab,
+            slab_to_host,
+        )
+
+        prefix = request.prompt_tokens
+        rid = request.request_id
+        self.alloc.allocate(rid, len(prefix))
+        try:
+            row = jnp.asarray(self.alloc.page_table_row(rid))[None]
+            bucket = pick_bucket(self.buckets, len(prefix))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(prefix)] = prefix
+            self.cache, logits = prefill(
+                self.cfg, self.cache_cfg, self.params, self.cache,
+                jnp.asarray(padded),
+                jnp.asarray([len(prefix)], jnp.int32), row,
+                mesh=self._kernel_mesh,
+            )
+            token = self._sample_first_token(
+                logits, request, prefix, self._request_seed(request)
+            )
+            slab = extract_slab(
+                self.cache, self.alloc.pages_of(rid), prefix, token,
+                self.cache_cfg.page_size,
+            )
+        finally:
+            self.alloc.release(rid)
+        self.prompt_tokens_total += len(prefix)
+        return slab_to_host(slab, multiprocess=self._mh is not None)
+
+    def _serve_slab_requests(self) -> None:
+        if self._mh is not None:
+            return self._serve_slab_requests_multihost()
         while True:
             try:
                 request, fut = self._slab_q.get_nowait()
             except queue_mod.Empty:
                 return
             prefix = request.prompt_tokens
-            need = self.alloc.pages_needed(len(prefix))
-            if (need > self.cache_cfg.max_pages_per_seq
-                    or need > self.cache_cfg.n_pages - 1):
+            err = self._slab_capacity_error(prefix)
+            if err is not None:
                 # permanently infeasible: fail now, don't spin
                 self.errors_total += 1
-                fut.set_exception(ValueError(
-                    f"prompt of {len(prefix)} tokens exceeds prefill cache capacity"
-                ))
+                fut.set_exception(ValueError(err))
                 continue
-            if need > self.alloc.free_pages:
+            if self.alloc.pages_needed(len(prefix)) > self.alloc.free_pages:
                 # transient pressure (pages held by running work): retry on
                 # the next step instead of failing the decoder's client.
                 # (The future stays pending, so the retry can still run it.)
@@ -645,33 +712,44 @@ class NativeEngine:
             if not fut.set_running_or_notify_cancel():
                 continue
             try:
-                rid = request.request_id
-                self.alloc.allocate(rid, len(prefix))
-                try:
-                    row = jnp.asarray(self.alloc.page_table_row(rid))[None]
-                    bucket = pick_bucket(self.buckets, len(prefix))
-                    padded = np.zeros((1, bucket), np.int32)
-                    padded[0, : len(prefix)] = prefix
-                    self.cache, logits = prefill(
-                        self.cfg, self.cache_cfg, self.params, self.cache,
-                        jnp.asarray(padded),
-                        jnp.asarray([len(prefix)], jnp.int32), row,
-                        mesh=self._kernel_mesh,
-                    )
-                    token = self._sample_first_token(
-                        logits, request, prefix, self._request_seed(request)
-                    )
-                    slab = extract_slab(
-                        self.cache, self.alloc.pages_of(rid), prefix, token,
-                        self.cache_cfg.page_size,
-                    )
-                finally:
-                    self.alloc.release(rid)
-                self.prompt_tokens_total += len(prefix)
-                fut.set_result(slab)
+                fut.set_result(self._compute_slab(request))
             except Exception as e:
                 self.errors_total += 1
                 fut.set_exception(e)
+
+    def _serve_slab_requests_multihost(self) -> None:
+        """Replayed identically on every process: the pending deque is
+        fed by the broadcast event stream, all branch decisions read
+        only replicated state (allocator, capacity), and the slab
+        compute + host-gather are collectives every process joins.
+        Future resolution (leader-only) happens OUTSIDE the decisions —
+        a cancelled client must not change what the group computes."""
+        while self._pd_pending:
+            request = self._pd_pending[0]
+            prefix = request.prompt_tokens
+            err = self._slab_capacity_error(prefix)
+            if err is not None:
+                self._pd_pending.popleft()
+                self.errors_total += 1
+                with self._lock:
+                    fut = self._pd_futures.pop(request.request_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(ValueError(err))
+                continue
+            if self.alloc.pages_needed(len(prefix)) > self.alloc.free_pages:
+                return  # deterministic retry at the next step
+            self._pd_pending.popleft()
+            with self._lock:
+                fut = self._pd_futures.pop(request.request_id, None)
+            try:
+                slab = self._compute_slab(request)
+            except Exception as e:
+                self.errors_total += 1
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+                continue
+            if fut is not None and not fut.done():
+                fut.set_result(slab)
 
     def _admit_prefilled(self) -> list[StepOutput]:
         from fusioninfer_tpu.engine.kv_transfer import inject_slab
@@ -760,6 +838,13 @@ class NativeEngine:
                 self.errors_total += 1
                 if not fut.done():
                     fut.set_exception(err)
+        self._pd_pending.clear()
+        with self._lock:
+            pd_futs, self._pd_futures = list(self._pd_futures.values()), {}
+        for fut in pd_futs:
+            self.errors_total += 1
+            if not fut.done():
+                fut.set_exception(err)
         return outputs
 
     def kv_cache_usage(self) -> float:
@@ -817,6 +902,16 @@ class NativeEngine:
             elif ev["type"] == "cancel":
                 with self._lock:
                     self._cancelled.add(ev["request_id"])
+            elif ev["type"] == "prefill_slab":
+                self._pd_pending.append(multihost.request_from_event(ev))
+            elif ev["type"] == "prefilled":
+                from fusioninfer_tpu.engine import kv_transfer
+
+                slab = kv_transfer.slab_from_bytes(
+                    base64.b64decode(ev["slab"]))
+                with self._lock:
+                    self.waiting_prefilled.append(
+                        (multihost.request_from_event(ev), slab))
             elif ev["type"] == "shutdown":
                 self._mh_shutdown = True
 

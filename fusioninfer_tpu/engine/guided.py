@@ -419,13 +419,18 @@ def _compile(schema, root, memo: dict) -> dict:
         order = None
         if ordered:
             if ordered is True:
-                order = tuple(props)
-            else:
-                order = tuple(str(n).encode() for n in ordered)
-                if set(order) != set(props) or len(order) != len(props):
-                    raise ValueError(
-                        "x-ordered must list every declared property "
-                        "exactly once")
+                # dict declaration order does NOT survive the canonical
+                # (key-sorted) schema string, so a bare true would
+                # silently enforce ALPHABETICAL order — reject instead
+                raise ValueError(
+                    "x-ordered must be an explicit list of property "
+                    "names (declaration order does not survive schema "
+                    "canonicalization)")
+            order = tuple(str(n).encode() for n in ordered)
+            if set(order) != set(props) or len(order) != len(props):
+                raise ValueError(
+                    "x-ordered must list every declared property "
+                    "exactly once")
             if addl_node is not None:
                 raise ValueError(
                     "x-ordered requires additionalProperties: false")

@@ -75,6 +75,31 @@ def extract_slab(cache: dict, pages: list[int], prompt_tokens: list[int],
     )
 
 
+def slab_to_host(slab: KVSlab, multiprocess: bool = False) -> KVSlab:
+    """Bring a slab's arrays to host.  Single-process: a no-op (device
+    arrays serialize lazily at the wire).  Multi-process: the cache is
+    sharded across hosts, so each array is assembled via a mesh
+    collective (``process_allgather``) — EVERY process must call this at
+    the same step; afterwards any process (in practice the leader) can
+    serialize the full slab."""
+    if not multiprocess:
+        return slab
+
+    from jax.experimental import multihost_utils as mu
+
+    def g(a):
+        return None if a is None else np.asarray(
+            mu.process_allgather(a, tiled=True))
+
+    return KVSlab(
+        k=g(slab.k), v=g(slab.v),
+        prompt_tokens=list(slab.prompt_tokens),
+        first_token=slab.first_token,
+        page_size=slab.page_size,
+        k_scale=g(slab.k_scale), v_scale=g(slab.v_scale),
+    )
+
+
 def _dequant_pages(q8: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
     """int8 pages [L, KV, n, ps, Hd] × scales [L, KV, n, 1, ps] → dtype."""
     per_token = jnp.swapaxes(scale, -1, -2)  # [L, KV, n, ps, 1]

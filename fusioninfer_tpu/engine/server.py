@@ -594,7 +594,11 @@ class EngineServer:
         usage_meta = (len(prompt_tokens), counts) if include_usage else None
         completion_id = f"{'chatcmpl' if chat else 'cmpl'}-{uuid.uuid4().hex[:12]}"
         created = int(time.time())  # one id/timestamp shared by ALL chunks
-        tool_mode = bool(by_name) and choice != "none"
+        # guided response_format + auto tools: the output is the user's
+        # requested JSON CONTENT, provably not a call — sniff-buffering
+        # it would defeat streaming and could even relabel it tool_calls
+        tool_mode = bool(by_name) and choice != "none" and (
+            forced or not (params.guided_json or params.guided_schema))
         if n == 1:
             chan = self.submit(prompt_tokens, params, lora=lora,
                                priority=priority)
@@ -746,12 +750,14 @@ class EngineServer:
                         self._cancel_chan(chan)
                     elif not out.finished:
                         full = full[: len(full) - _held_back(full, stops)]
-                if not out.finished:
+                if finish is None:
                     # hold back trailing replacement chars: a multi-byte
                     # utf-8 sequence split across deltas decodes as
                     # U+FFFD now but as the REAL char once its
                     # continuation bytes arrive — shipping it early
-                    # would freeze the mojibake into the client's text
+                    # would freeze the mojibake into the client's text.
+                    # (gated on finish, not out.finished: a stop-string
+                    # cut is this stream's LAST chunk and must flush)
                     full = full[:len(full.rstrip("�"))]
                 delta, emitted = full[emitted:], max(emitted, len(full))
                 if echo_prefix:  # OpenAI echo: prompt leads the stream
@@ -866,7 +872,11 @@ class EngineServer:
             if st["mode"] == "content":
                 frag = full[st["flushed"]:]
                 st["flushed"] = len(full)
-                if frag or finish is not None:
+                if frag == (delta.get("content") or ""):
+                    # caught up: forward the ORIGINAL chunk untouched so
+                    # per-token logprobs survive plain-content streaming
+                    yield chunk
+                elif frag or finish is not None:
                     yield _emit({"content": frag}, finish)
                 continue
             if st["mode"] == "sniff":

@@ -1,0 +1,129 @@
+"""PD disaggregation across MULTI-PROCESS meshes (r4 VERDICT #2).
+
+The acceptance-bar topology (BASELINE rung 5) is PD between two
+multi-host slices — prefiller and decoder each a multi-process SPMD
+group (`/root/reference/pkg/scheduling/podgroup.go:33-47`,
+core-design.md:85-107).  Through round 4 the native engine raised on
+any multi-process PD; this test runs the real shape at CI scale: a
+TWO-process tp=2 prefiller group and a TWO-process tp=2 decoder group
+(four OS processes, two JAX coordinators), the decoder pulling slabs
+over the HTTP wire, and the decoded text byte-identical to a
+single-process monolithic engine.
+
+Mechanics under test: slab prefills ride the prefiller group's
+admission event broadcast (every process runs the same jitted prefill +
+`process_allgather` collectives), and prefilled admissions ride the
+decoder group's broadcast carrying the slab itself, so both schedulers
+stay in SPMD lockstep (`engine/engine.py:_serve_slab_requests_multihost`).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.request
+
+from fusioninfer_tpu.api.types import EngineKind
+from fusioninfer_tpu.workload.bootstrap import bootstrap_for
+
+from tests.test_bootstrap_twoprocess import (
+    _free_port,
+    _reference_greedy_text,
+    _resolve_env,
+    _wait_ready,
+)
+
+
+def _launch_group(role: str, http_ports: tuple[int, int], coord_port: int,
+                  repo_root: str, extra_args: list[str]) -> list:
+    strat = bootstrap_for(EngineKind.NATIVE)
+    containers = [strat.wrap_leader({"name": "engine"}, size=2),
+                  strat.wrap_worker({"name": "engine"}, size=2)]
+    procs = []
+    for idx, container in enumerate(containers):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # one CPU device per process
+        env.update(_resolve_env(container, worker_index=idx))
+        env.update({
+            "LWS_LEADER_ADDRESS": "127.0.0.1",
+            "FUSIONINFER_COORDINATOR_PORT": str(coord_port),
+            "JAX_PLATFORMS": "cpu",
+            "FUSIONINFER_PLATFORM": "cpu",
+            "PYTHONPATH": repo_root,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "fusioninfer_tpu.cli", "engine",
+             "serve", "qwen3-tiny", "--dtype", "float32",
+             "--host", "127.0.0.1", "--port", str(http_ports[idx]),
+             "--tensor-parallel-size", "2",
+             "--max-batch-size", "4", "--max-model-len", "256",
+             "--page-size", "16", "--seed", "0"] + extra_args,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=repo_root,
+        ))
+    return procs
+
+
+def test_pd_two_process_pairs_token_identity():
+    """2-proc prefiller slice → 2-proc decoder slice over the HTTP pull
+    wire, greedy decode byte-identical to the monolithic engine, clean
+    group shutdown on SIGTERM for all four processes."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prompt, n_out = "pd across two multi host slices", 8
+    expected = _reference_greedy_text(prompt, n_out)
+
+    pf_ports = (_free_port(), _free_port())
+    dec_ports = (_free_port(), _free_port())
+    procs: list = []
+    try:
+        procs += _launch_group("prefill", pf_ports, _free_port(),
+                               repo_root, [])
+        procs += _launch_group(
+            "decode", dec_ports, _free_port(), repo_root,
+            ["--prefill-upstream", f"http://127.0.0.1:{pf_ports[0]}"])
+
+        def alive_or_fail():
+            for p in procs:
+                if p.poll() is not None:
+                    _, err = p.communicate(timeout=10)
+                    raise AssertionError(
+                        f"server exited rc={p.returncode}\n{err[-3000:]}")
+
+        # four concurrent first-compiles share one CI core: generous cap
+        _wait_ready(pf_ports[0], alive_or_fail, timeout=600.0)
+        _wait_ready(dec_ports[0], alive_or_fail, timeout=600.0)
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{dec_ports[0]}/v1/completions",
+            data=json.dumps({"model": "qwen3-tiny", "prompt": prompt,
+                             "max_tokens": n_out,
+                             "temperature": 0.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as r:
+            got = json.load(r)
+        assert got["usage"]["completion_tokens"] == n_out, got
+        assert got["choices"][0]["text"] == expected, (
+            f"PD multi-process decode diverged:\n"
+            f"  ref: {expected!r}\n  got: {got['choices'][0]['text']!r}")
+
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.communicate(timeout=90)
+            except subprocess.TimeoutExpired:
+                raise AssertionError(
+                    "PD multihost process hung on SIGTERM (peer blocked "
+                    "in a collective?)")
+        assert [p.returncode for p in procs] == [0, 0, 0, 0], (
+            [p.returncode for p in procs])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass
