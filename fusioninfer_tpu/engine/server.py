@@ -1290,9 +1290,14 @@ class EngineServer:
         # internal chat template into message content
         completion = self.handle_completion(inner)
         choices = []
+        # a user response_format in auto mode defines the output as
+        # CONTENT: call-shaped guided JSON must not be relabeled
+        # tool_calls (mirrors the streaming tool_mode gate)
+        assemble = by_name and choice != "none" and (
+            forced or body.get("response_format") is None)
         for c in completion["choices"]:
             call = (self._as_tool_call(c["text"], by_name)
-                    if by_name and choice != "none" else None)
+                    if assemble else None)
             if call is not None:
                 message = {"role": "assistant", "content": None,
                            "tool_calls": [call]}

@@ -35,7 +35,7 @@ from tests.test_bootstrap_twoprocess import (
 )
 
 
-def _launch_group(role: str, http_ports: tuple[int, int], coord_port: int,
+def _launch_group(http_ports: tuple[int, int], coord_port: int,
                   repo_root: str, extra_args: list[str]) -> list:
     strat = bootstrap_for(EngineKind.NATIVE)
     containers = [strat.wrap_leader({"name": "engine"}, size=2),
@@ -77,10 +77,9 @@ def test_pd_two_process_pairs_token_identity():
     dec_ports = (_free_port(), _free_port())
     procs: list = []
     try:
-        procs += _launch_group("prefill", pf_ports, _free_port(),
-                               repo_root, [])
+        procs += _launch_group(pf_ports, _free_port(), repo_root, [])
         procs += _launch_group(
-            "decode", dec_ports, _free_port(), repo_root,
+            dec_ports, _free_port(), repo_root,
             ["--prefill-upstream", f"http://127.0.0.1:{pf_ports[0]}"])
 
         def alive_or_fail():
