@@ -965,6 +965,11 @@ class NativeEngine:
             cancelled, self._cancelled = self._cancelled, set()
             if not cancelled:
                 return
+            for rid in cancelled:
+                # a request cancelled between admission and first token
+                # must not leave a timing entry behind (bounded deque,
+                # unbounded dict otherwise)
+                self._admit_t.pop(rid, None)
             # mutate under the lock: add_request pushes from HTTP threads
             self.cancelled_total += self.waiting.remove_ids(cancelled)
             kept_p = collections.deque(
@@ -1992,6 +1997,7 @@ class NativeEngine:
         self.running.pop(state.slot, None)
         self._free_slots.append(state.slot)
         self.alloc.release(state.request.request_id)
+        self._admit_t.pop(state.request.request_id, None)
         if outcome == "finished":
             self.finished_total += 1
         elif outcome == "cancelled":

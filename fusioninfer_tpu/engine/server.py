@@ -1290,11 +1290,14 @@ class EngineServer:
         # internal chat template into message content
         completion = self.handle_completion(inner)
         choices = []
-        # a user response_format in auto mode defines the output as
+        # a GUIDING response_format in auto mode defines the output as
         # CONTENT: call-shaped guided JSON must not be relabeled
-        # tool_calls (mirrors the streaming tool_mode gate)
+        # tool_calls (mirrors the streaming tool_mode gate; a bare
+        # {"type": "text"} guides nothing and changes nothing)
+        rf = body.get("response_format")
+        rf_type = rf.get("type") if isinstance(rf, dict) else rf
         assemble = by_name and choice != "none" and (
-            forced or body.get("response_format") is None)
+            forced or rf_type not in ("json_object", "json_schema"))
         for c in completion["choices"]:
             call = (self._as_tool_call(c["text"], by_name)
                     if assemble else None)
