@@ -1612,7 +1612,18 @@ def serve_from_args(args) -> int:
         cfg = dataclasses.replace(cfg, dtype=dtype)
     tp = args.tensor_parallel_size
     mesh = None
-    if tp > 1:
+    if jax.process_count() > 1:
+        # multi-process group: EVERY process must own mesh devices (a
+        # follower outside the mesh could never join the SPMD step), so
+        # the mesh spans the whole slice — dp soaks what tp doesn't
+        # (a 4-host tp=2 slice serves dp2×tp2)
+        devices = jax.devices()
+        try:
+            mesh = build_mesh(infer_mesh_config(len(devices), tp=tp),
+                              devices)
+        except ValueError as e:  # tp<=0 or non-divisor: clean CLI error
+            raise SystemExit(f"--tensor-parallel-size {tp}: {e}") from None
+    elif tp > 1:
         devices = jax.devices()
         if tp > len(devices):
             raise SystemExit(
