@@ -30,6 +30,16 @@ test-fast: ## Tests, stop at first failure.
 test-tpu: ## Hardware kernel tests on a real TPU (interpret=False, bench shapes).
 	FUSIONINFER_TEST_TPU=1 $(PYTHON) -m pytest tests/test_kernels_tpu.py -x -q
 
+KIND_CLUSTER ?= fusioninfer-tpu-e2e
+
+.PHONY: test-e2e
+test-e2e: ## kind e2e: deploy the operator into a real cluster, reconcile a sample (needs kind/kubectl/docker).
+	FUSIONINFER_E2E=1 KIND_CLUSTER=$(KIND_CLUSTER) $(PYTHON) -m pytest test/e2e/ -v -q
+
+.PHONY: cleanup-test-e2e
+cleanup-test-e2e: ## Tear down the e2e kind cluster.
+	kind delete cluster --name $(KIND_CLUSTER)
+
 .PHONY: lint
 lint: ## Gating lint: in-repo AST linter + byte-compile (CI adds ruff).
 	$(PYTHON) tools/lint.py

@@ -2,8 +2,9 @@
 // /root/reference/docs/fusioninfer/docusaurus.config.ts).  Content lives
 // in the repo's plain-markdown docs tree (../..) — the canonical docs
 // readable without any build — and this site renders the same files.
-// Build: `npm install && npm run build` (needs network; not run in the
-// zero-egress CI — the site source ships, like the reference's).
+// Build: `npm install && npm run build`.  CI builds it in the
+// network-gated `docs-site` job (.github/workflows/ci.yml) — failures
+// are visible per-run but non-blocking (registry access is external).
 
 /** @type {import('@docusaurus/types').Config} */
 const config = {

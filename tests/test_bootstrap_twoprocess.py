@@ -217,7 +217,10 @@ def _group_decode_identity(n_procs: int):
         _wait_ready(leader_port, alive_or_fail, timeout=300.0 * (n_procs // 2))
         body = {"model": "qwen3-tiny", "prompt": prompt,
                 "max_tokens": n_out, "temperature": 0.0}
-        got = _completion(leader_port, body, timeout=300.0)
+        # the SPMD decode compile happens AFTER /v1/models readiness, so
+        # the first-request window must scale with the number of
+        # concurrently-compiling processes on this single-core box too
+        got = _completion(leader_port, body, timeout=300.0 * (n_procs // 2))
         assert got["usage"]["completion_tokens"] == n_out, got
         assert got["choices"][0]["text"] == expected, (
             f"tp2 two-process decode diverged:\n"
