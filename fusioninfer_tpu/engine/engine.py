@@ -475,11 +475,11 @@ class NativeEngine:
     def guided_enabled(self) -> bool:
         return self._masker is not None
 
-    def add_request(self, request: Request) -> None:
-        if request.params.max_tokens < 1:
-            raise ValueError("max_tokens must be >= 1")
-        if not request.prompt_tokens:
-            raise ValueError("prompt must not be empty")
+    def _validate_guided(self, request: Request) -> None:
+        """Admission-time guided checks shared by every entry path
+        (direct, prefill-slab, prefilled): masker present, schema
+        compiles — a bad request 400s instead of failing the engine
+        thread mid-serve."""
         if (request.params.guided_json or request.params.guided_schema) \
                 and self._masker is None:
             raise ValueError(
@@ -487,12 +487,17 @@ class NativeEngine:
                 "tokenizer does not provide one"
             )
         if request.params.guided_schema:
-            # compile NOW (memoized) so an unsupported schema 400s at
-            # admission instead of failing the engine thread mid-serve
             from fusioninfer_tpu.engine import guided
 
             guided.SchemaByteMachine(
                 guided.compile_schema_str(request.params.guided_schema))
+
+    def add_request(self, request: Request) -> None:
+        if request.params.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        if not request.prompt_tokens:
+            raise ValueError("prompt must not be empty")
+        self._validate_guided(request)
         if len(request.prompt_tokens) + request.params.max_tokens > self.cache_cfg.max_len:
             raise ValueError(
                 f"prompt+max_tokens exceeds engine max_len {self.cache_cfg.max_len}"
@@ -587,6 +592,9 @@ class NativeEngine:
         Served inside :meth:`step` (engine thread owns the cache); resolves
         to a :class:`fusioninfer_tpu.engine.kv_transfer.KVSlab` — int8
         caches emit int8 slabs (scales ride the wire)."""
+        if request.lora:
+            self._adapter_id(request)  # unknown adapter: client error NOW
+        self._validate_guided(request)
         fut: concurrent.futures.Future = concurrent.futures.Future()
         if self._mh is not None:
             # multi-process mesh: the prefill must run as the SAME jitted
@@ -613,20 +621,10 @@ class NativeEngine:
         """Decode-worker side: admit a request whose prefill (KV + first
         token) was computed remotely; generation continues from there."""
         if request.lora:
-            # the prefill wire carries no adapter identity yet: decoding
-            # with adapter deltas over base-model KV would be silently
-            # wrong tokens — reject loudly instead
-            raise ValueError(
-                "LoRA adapters are not yet supported on the "
-                "PD-disaggregated prefill wire"
-            )
-        if request.params.guided_json or request.params.guided_schema:
-            # the prefiller samples the first token without the grammar
-            # mask — reject rather than return unguided output
-            raise ValueError(
-                "guided JSON is not yet supported on the "
-                "PD-disaggregated prefill wire"
-            )
+            # decode applies the adapter's deltas per step: it must be
+            # loaded HERE too (the prefiller already prefilled under it)
+            self._adapter_id(request)
+        self._validate_guided(request)
         if slab.page_size != self.cache_cfg.page_size:
             raise ValueError(
                 f"slab page_size {slab.page_size} != engine page_size "
@@ -670,6 +668,8 @@ class NativeEngine:
             slab_to_host,
         )
 
+        from fusioninfer_tpu.engine.guided import machine_for
+
         prefix = request.prompt_tokens
         rid = request.request_id
         self.alloc.allocate(rid, len(prefix))
@@ -678,14 +678,23 @@ class NativeEngine:
             bucket = pick_bucket(self.buckets, len(prefix))
             padded = np.zeros((1, bucket), np.int32)
             padded[0, : len(prefix)] = prefix
+            lora, ids = None, None
+            if self.lora_set is not None:
+                lora = self.lora_set.stacked
+                ids = jnp.asarray([self._adapter_id(request)], jnp.int32)
             self.cache, logits = prefill(
                 self.cfg, self.cache_cfg, self.params, self.cache,
                 jnp.asarray(padded),
                 jnp.asarray([len(prefix)], jnp.int32), row,
-                mesh=self._kernel_mesh,
+                mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
             )
+            # guided requests mask the FIRST token here on the
+            # prefiller — the decode side replays it through its own
+            # machine at admission (both roles serve the same model, so
+            # the vocab byte mapping matches)
             token = self._sample_first_token(
-                logits, request, prefix, self._request_seed(request)
+                logits, request, prefix, self._request_seed(request),
+                machine=machine_for(request.params),
             )
             slab = extract_slab(
                 self.cache, self.alloc.pages_of(rid), prefix, token,
@@ -779,6 +788,17 @@ class NativeEngine:
                 self.cache = inject_slab(
                     self.cache, slab, self.alloc.pages_of(request.request_id)
                 )
+                from fusioninfer_tpu.engine.guided import machine_for
+
+                machine = machine_for(request.params)
+                force_finish = None
+                if machine is not None:
+                    # replay the prefiller's (grammar-masked) first token
+                    # BEFORE claiming a slot: a grammar-illegal token
+                    # (unmasked slab, tokenizer skew) raises here, and
+                    # the except below releases pages, not slots
+                    self._masker.advance_token(machine, slab.first_token)
+                    force_finish = "stop" if machine.done else None
                 slot = self._free_slots.pop()
                 state = _SeqState(
                     request=request,
@@ -787,11 +807,13 @@ class NativeEngine:
                     slot=slot,
                     seed=self._request_seed(request),
                     first_token_time=time.monotonic(),
+                    guided=machine,
                 )
                 self._register_slot(slot, state.tokens, state.n_prompt, request.params)
                 self.running[slot] = state
                 self.generation_tokens_total += 1
-                outputs.append(self._emit(state, slab.first_token, first=True))
+                outputs.append(self._emit(state, slab.first_token, first=True,
+                                          force_finish=force_finish))
             except Exception as e:
                 logger.exception("prefilled admission of %s failed", request.request_id)
                 self.alloc.release(request.request_id)

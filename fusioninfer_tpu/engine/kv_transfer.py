@@ -278,11 +278,13 @@ class HTTPPullConnector:
 
     def request_prefill(self, request_id: str, prompt_tokens: list[int],
                         sampling: Optional[dict] = None,
+                        lora: str = "",
                         timeout: float = 120.0) -> KVSlab:
         body = json.dumps({
             "request_id": request_id,
             "prompt_tokens": prompt_tokens,
             "sampling": sampling or self.sampling or {},
+            "lora": lora,
         }).encode()
         req = urllib.request.Request(
             self.prefill_url.rstrip("/") + "/v1/prefill",

@@ -303,19 +303,15 @@ class EngineServer:
         try:
             request = Request(request_id, prompt_tokens, params, lora=lora,
                               priority=priority)
-            if lora and self.prefill_upstream:
-                # reject BEFORE the remote prefill RPC: the engine would
-                # refuse the adapter at admission anyway, and by then a
-                # full remote prefill + KV transfer has been burned
-                raise ValueError(
-                    "LoRA adapters are not yet supported on the "
-                    "PD-disaggregated prefill wire"
-                )
-            if params.guided_json and self.prefill_upstream:
-                raise ValueError(
-                    "guided JSON is not yet supported on the "
-                    "PD-disaggregated prefill wire"
-                )
+            if self.prefill_upstream:
+                # reject BEFORE the remote prefill RPC anything local
+                # admission would refuse (unknown adapter, guided with
+                # no masker, uncompilable schema): by admission time a
+                # full remote prefill + KV transfer would have been
+                # burned, and the client deserves an immediate 400
+                if lora:
+                    self.engine._adapter_id(request)
+                self.engine._validate_guided(request)
             if self.prefill_upstream:
                 # PD decode role: pull KV from the prefiller over DCN
                 from fusioninfer_tpu.engine.kv_transfer import HTTPPullConnector
@@ -336,7 +332,13 @@ class EngineServer:
                         "frequency_penalty": params.frequency_penalty,
                         "repetition_penalty": params.repetition_penalty,
                         "seed": params.seed,
+                        # guided: the prefiller masks the first token
+                        # under the same grammar (both roles serve the
+                        # same model/tokenizer)
+                        "guided_json": params.guided_json,
+                        "guided_schema": params.guided_schema,
                     },
+                    lora=lora,
                 )
                 self.engine.add_prefilled_request(request, slab)
             else:
@@ -411,9 +413,13 @@ class EngineServer:
             frequency_penalty=float(sampling.get("frequency_penalty", 0.0)),
             repetition_penalty=float(sampling.get("repetition_penalty", 1.0)),
             seed=int(seed) if seed is not None else None,
+            guided_json=bool(sampling.get("guided_json", False)),
+            guided_schema=str(sampling.get("guided_schema", "") or ""),
         )
         rid = body.get("request_id") or uuid.uuid4().hex[:16]
-        fut = self.engine.request_prefill_slab(Request(rid, prompt_tokens, params))
+        fut = self.engine.request_prefill_slab(
+            Request(rid, prompt_tokens, params,
+                    lora=str(body.get("lora") or "")))
         slab = fut.result(timeout=120.0)
         return slab_to_bytes(slab)
 

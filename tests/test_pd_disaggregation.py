@@ -159,3 +159,109 @@ def test_pd_over_http_two_servers():
         prefill_srv.stop()
         decode_srv.stop()
         mono_srv.stop()
+
+
+def test_pd_guided_json_over_the_wire():
+    """Guided requests now ride the PD wire (r5): the prefiller samples
+    the FIRST token under the grammar mask, the decoder replays it into
+    its own machine and keeps masking — tokens identical to a monolithic
+    guided run, and stop-finished output parses."""
+    import json as _json
+
+    from fusioninfer_tpu.engine.guided import build_token_byte_table
+    from fusioninfer_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    table = build_token_byte_table(tok, CFG.vocab_size)
+    sp = SamplingParams(temperature=0.9, max_tokens=45, seed=7,
+                        guided_json=True)
+    prompt = tok.encode("json please")
+
+    mono = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0,
+                        token_byte_table=table)
+    mono.add_request(Request("g", list(prompt), sp))
+    expected = _drain(mono, max_steps=200)
+
+    prefiller = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0,
+                             token_byte_table=table)
+    decoder = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0,
+                           token_byte_table=table)
+    fut = prefiller.request_prefill_slab(Request("g", list(prompt), sp))
+    prefiller.step()
+    slab = slab_from_bytes(slab_to_bytes(fut.result(timeout=30)))
+    decoder.add_prefilled_request(Request("g", list(prompt), sp), slab)
+    got = _drain(decoder, max_steps=200)
+
+    assert got["g"] == expected["g"]
+    text = tok.decode(got["g"])
+    if len(got["g"]) < sp.max_tokens:  # finished by grammar stop
+        assert isinstance(_json.loads(text), dict)
+
+
+def test_pd_guided_rejected_without_masker():
+    """A prefiller whose tokenizer has no byte mapping must refuse the
+    guided prefill loudly (unguided first tokens would silently violate
+    the contract)."""
+    prefiller = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0)
+    with pytest.raises(ValueError, match="byte"):
+        prefiller.request_prefill_slab(Request(
+            "g", [1, 2, 3], SamplingParams(max_tokens=4, guided_json=True)))
+
+
+def test_pd_lora_over_the_wire():
+    """LoRA rides the PD wire (r5): the prefiller prefills under the
+    adapter's deltas, the decoder decodes under them — tokens identical
+    to a monolithic adapter run, and distinct from the base model's."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from fusioninfer_tpu.models.lora import LORA_PROJS, init_adapter
+
+    # init_adapter's b=0 is an exact no-op by design — fill b so the
+    # deltas actually change tokens, in the ENGINE's dtype (a foreign
+    # dtype would break the scan carry)
+    adapter = init_adapter(CFG, rank=4, key=_jax.random.key(5), scale=2.0)
+    for i, proj in enumerate(LORA_PROJS):
+        adapter[proj]["b"] = (_jax.random.normal(
+            _jax.random.key(100 + i), adapter[proj]["b"].shape,
+            _jnp.float32) * 0.05).astype(CFG.jax_dtype)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    sp = lambda: SamplingParams(temperature=0.0, max_tokens=6)  # noqa: E731
+
+    mono = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0,
+                        lora_adapters={"ad": adapter})
+    mono.add_request(Request("x", list(prompt), sp(), lora="ad"))
+    expected = _drain(mono)
+
+    base = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0)
+    base.add_request(Request("x", list(prompt), sp()))
+    base_toks = _drain(base)
+
+    prefiller = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0,
+                             lora_adapters={"ad": adapter})
+    decoder = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0,
+                           lora_adapters={"ad": adapter})
+    fut = prefiller.request_prefill_slab(
+        Request("x", list(prompt), sp(), lora="ad"))
+    prefiller.step()
+    slab = slab_from_bytes(slab_to_bytes(fut.result(timeout=30)))
+    decoder.add_prefilled_request(
+        Request("x", list(prompt), sp(), lora="ad"), slab)
+    got = _drain(decoder)
+
+    assert got["x"] == expected["x"]
+    assert got["x"] != base_toks["x"], (
+        "adapter run matched the base model — deltas never applied")
+
+
+def test_pd_lora_unknown_adapter_fails_fast():
+    prefiller = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0)
+    with pytest.raises(ValueError, match="adapter"):
+        prefiller.request_prefill_slab(Request(
+            "x", [1, 2], SamplingParams(max_tokens=2), lora="ghost"))
+    decoder = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2, seed=0)
+    cache = init_kv_cache(CFG, CACHE)
+    slab = extract_slab(cache, [0, 1], [1, 2], first_token=3, page_size=8)
+    with pytest.raises(ValueError, match="adapter"):
+        decoder.add_prefilled_request(Request(
+            "x", [1, 2], SamplingParams(max_tokens=2), lora="ghost"), slab)
