@@ -543,8 +543,12 @@ class NativeEngine:
                 f"input of {len(prompt_tokens)} tokens exceeds max length "
                 f"{self.buckets[-1]}"
             )
-        if self.mesh is not None:
-            raise ValueError("embeddings are not yet supported on meshes")
+        if self._mh is not None:
+            # multi-process lockstep: an embedding forward on one process
+            # only would desync the group's SPMD step sequence; it would
+            # need to ride the admission event broadcast like PD slabs
+            raise ValueError(
+                "embeddings are not supported on multi-process meshes")
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._embed_q.put((prompt_tokens, fut))
         return fut
