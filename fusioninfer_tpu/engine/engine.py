@@ -397,6 +397,11 @@ class NativeEngine:
         # everywhere, futures live on the leader only
         self._pd_pending: collections.deque[Request] = collections.deque()
         self._pd_futures: dict[str, concurrent.futures.Future] = {}
+        # embeddings × multi-process: same event-broadcast pattern —
+        # every process runs the same embed forward; leader resolves
+        self._embed_pending: collections.deque[tuple[str, list[int]]] = (
+            collections.deque())
+        self._embed_futures: dict[str, concurrent.futures.Future] = {}
         # /v1/embeddings: served inside step() (engine thread owns device)
         self._embed_q: "queue_mod.Queue[tuple[list[int], concurrent.futures.Future]]" = (
             queue_mod.Queue()
@@ -528,7 +533,7 @@ class NativeEngine:
         return bool(
             self.waiting or self.waiting_prefilled or self.running
             or self.prefilling or not self._slab_q.empty()
-            or self._pd_pending
+            or self._pd_pending or self._embed_pending
             or not self._embed_q.empty()
         )
 
@@ -544,18 +549,33 @@ class NativeEngine:
                 f"{self.buckets[-1]}"
             )
         if self._mh is not None:
-            # multi-process lockstep: an embedding forward on one process
-            # only would desync the group's SPMD step sequence; it would
-            # need to ride the admission event broadcast like PD slabs
-            raise ValueError(
-                "embeddings are not supported on multi-process meshes")
+            # multi-process lockstep: the forward must run as the SAME
+            # jitted computation on every process, so the request rides
+            # the admission event broadcast like PD slabs; the future
+            # resolves on the leader (the only pod routed traffic)
+            import uuid as _uuid
+
+            eid = _uuid.uuid4().hex[:16]
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            with self._lock:
+                self._embed_futures[eid] = fut
+            try:
+                self._mh.queue({"type": "embed", "id": eid,
+                                "tokens": [int(t) for t in prompt_tokens]})
+            except Exception:
+                # queue raises on followers (no traffic should land
+                # here); the registered future must not leak
+                with self._lock:
+                    self._embed_futures.pop(eid, None)
+                raise
+            return fut
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._embed_q.put((prompt_tokens, fut))
         return fut
 
     def _serve_embedding_requests(self) -> None:
-        from fusioninfer_tpu.models.transformer import embed_sequences
-
+        if self._mh is not None:
+            return self._serve_embedding_requests_multihost()
         batch: list[tuple[list[int], concurrent.futures.Future]] = []
         while len(batch) < self.max_batch_size:
             try:
@@ -566,15 +586,7 @@ class NativeEngine:
         if not batch:
             return
         try:
-            bucket = pick_bucket(self.buckets, max(len(t) for t, _ in batch))
-            B = 1 << (len(batch) - 1).bit_length()  # bounded signatures
-            padded = np.zeros((B, bucket), np.int32)
-            lens = np.zeros((B,), np.int32)
-            for i, (toks, _) in enumerate(batch):
-                padded[i, : len(toks)] = toks
-                lens[i] = len(toks)
-            emb = np.asarray(embed_sequences(
-                self.cfg, self.params, jnp.asarray(padded), jnp.asarray(lens)))
+            emb = self._embed_batch([t for t, _ in batch])
             for i, (toks, fut) in enumerate(batch):
                 self.prompt_tokens_total += len(toks)
                 fut.set_result(emb[i].tolist())
@@ -583,6 +595,45 @@ class NativeEngine:
             for _, fut in batch:
                 if not fut.done():
                     fut.set_exception(e)
+
+    def _embed_batch(self, seqs: list[list[int]]) -> np.ndarray:
+        from fusioninfer_tpu.models.transformer import embed_sequences
+
+        bucket = pick_bucket(self.buckets, max(len(t) for t in seqs))
+        B = 1 << (len(seqs) - 1).bit_length()  # bounded signatures
+        padded = np.zeros((B, bucket), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for i, toks in enumerate(seqs):
+            padded[i, : len(toks)] = toks
+            lens[i] = len(toks)
+        return np.asarray(embed_sequences(
+            self.cfg, self.params, jnp.asarray(padded), jnp.asarray(lens)))
+
+    def _serve_embedding_requests_multihost(self) -> None:
+        """Replayed identically everywhere: the pending deque comes from
+        the broadcast, the batch is a pure function of it, and future
+        resolution (leader-only) sits outside the decisions."""
+        if not self._embed_pending:
+            return
+        batch: list[tuple[str, list[int]]] = []
+        while self._embed_pending and len(batch) < self.max_batch_size:
+            batch.append(self._embed_pending.popleft())
+        try:
+            emb = self._embed_batch([t for _, t in batch])
+        except Exception as e:
+            self.errors_total += 1
+            for eid, _ in batch:
+                with self._lock:
+                    fut = self._embed_futures.pop(eid, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+            return
+        for i, (eid, toks) in enumerate(batch):
+            self.prompt_tokens_total += len(toks)
+            with self._lock:
+                fut = self._embed_futures.pop(eid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(emb[i].tolist())
 
     def _avail_slots(self) -> int:
         """Free batch slots minus one reserved per mid-prefill sequence
@@ -873,10 +924,13 @@ class NativeEngine:
                 if not fut.done():
                     fut.set_exception(err)
         self._pd_pending.clear()
+        self._embed_pending.clear()
         self._admit_t.clear()
         with self._lock:
             pd_futs, self._pd_futures = list(self._pd_futures.values()), {}
-        for fut in pd_futs:
+            em_futs, self._embed_futures = (
+                list(self._embed_futures.values()), {})
+        for fut in pd_futs + em_futs:
             self.errors_total += 1
             if not fut.done():
                 fut.set_exception(err)
@@ -939,6 +993,9 @@ class NativeEngine:
                     self._cancelled.add(ev["request_id"])
             elif ev["type"] == "prefill_slab":
                 self._pd_pending.append(multihost.request_from_event(ev))
+            elif ev["type"] == "embed":
+                self._embed_pending.append(
+                    (ev["id"], [int(t) for t in ev["tokens"]]))
             elif ev["type"] == "prefilled":
                 from fusioninfer_tpu.engine import kv_transfer
 

@@ -231,6 +231,18 @@ def _group_decode_identity(n_procs: int):
             body, prompt="second wave", max_tokens=5), timeout=300.0)
         assert got2["choices"][0]["text"] == expected2
 
+        # embeddings ride the same admission broadcast (every process
+        # runs the embed forward in lockstep; the leader resolves)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{leader_port}/v1/embeddings",
+            data=json.dumps({"model": "qwen3-tiny",
+                             "input": "embed in lockstep"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            emb = json.load(r)
+        vec = emb["data"][0]["embedding"]
+        assert abs(sum(x * x for x in vec) - 1.0) < 1e-3  # L2-normalized
+
         # graceful group shutdown: SIGTERM both pods (what kubelet does
         # on delete) — the leader's drain fans a shutdown event through
         # the admission stream so no process is left blocked in a
