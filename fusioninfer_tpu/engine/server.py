@@ -1589,7 +1589,12 @@ def serve_from_args(args) -> int:
             import dataclasses
 
             hf_cfg = dataclasses.replace(hf_cfg, quantization=quant)
-        cfg, params = load_hf_checkpoint(load_hf, cfg=hf_cfg)
+        # pass the dtype override INTO the loader: a post-hoc cfg
+        # rewrite would leave params in the checkpoint's dtype while the
+        # KV cache and compute follow cfg — silent mixed precision
+        cfg, params = load_hf_checkpoint(
+            load_hf, cfg=hf_cfg,
+            dtype=(getattr(args, "dtype", "") or None))
         model_name = args.model if args.model != "qwen3-tiny" else cfg.name
     elif load_ckpt:
         if quant != "none":
@@ -1616,6 +1621,17 @@ def serve_from_args(args) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, dtype=dtype)
+        if params is not None:
+            # restored/loaded params must FOLLOW the override (float
+            # leaves only — int8 codes and adapter ids keep their dtype)
+            import jax.numpy as jnp
+
+            target = jnp.dtype(cfg.jax_dtype)
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(target)
+                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype,
+                                                          jnp.floating)
+                else x, params)
     tp = args.tensor_parallel_size
     mesh = None
     if jax.process_count() > 1:

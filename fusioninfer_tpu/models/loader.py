@@ -19,6 +19,7 @@ modelloader_controller.go:49-55``).  Here it is functional:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from typing import Any, Optional
@@ -166,7 +167,16 @@ def load_hf_checkpoint(
     sharding immediately, bounding host memory to one stacked tensor.
     """
     cfg = cfg or config_from_hf(path)
-    target = jnp.dtype(dtype or cfg.dtype)
+    if dtype is not None:
+        dtype = str(jnp.dtype(dtype))  # normalize objects/aliases to str
+        if dtype != cfg.dtype:
+            # the returned cfg must agree with the params it
+            # accompanies: an engine sizes its KV cache (and computes)
+            # from cfg.dtype, so a cfg still claiming bf16 over
+            # fp32-converted params would silently mix precisions
+            # (fp32 K/V scattered into bf16 pages)
+            cfg = dataclasses.replace(cfg, dtype=dtype)
+    target = jnp.dtype(cfg.dtype)
     L = cfg.n_layers
 
     per_layer: dict[str, dict[int, np.ndarray]] = {}
@@ -377,8 +387,6 @@ def save_hf_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
 
 def save_checkpoint(path: str, cfg: ModelConfig, params: Params) -> None:
     """Orbax checkpoint + sidecar model config (the resume format)."""
-    import dataclasses
-
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
