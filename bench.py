@@ -502,7 +502,13 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
     from fusioninfer_tpu.engine.server import EngineServer
 
     engine = NativeEngine(cfg, cache_cfg=cache_cfg, max_batch_size=max_batch_size,
-                          prefill_chunk_size=prefill_chunk)
+                          prefill_chunk_size=prefill_chunk,
+                          # production default (cli.py --decode-burst): on a
+                          # remote-attached chip the host round trip per
+                          # decode step dominates serving throughput.
+                          # 0 = off (classic stepping), like the CLI
+                          decode_burst_steps=max(1, int(os.environ.get(
+                              "BENCH_DECODE_BURST", "8") or 8)))
     srv = EngineServer(
         model=cfg.name, host="127.0.0.1", port=0, engine=engine,
     )
@@ -515,6 +521,7 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
             shared_prefix_len=shared_prefix_len,
         )
         out = result.summary(n_chips=1)
+        out["decode_burst"] = engine.burst_steps
         if shared_prefix_len:
             out["shared_prefix_len"] = shared_prefix_len
         # TTFT decomposition: server-side queue-wait (arrival → admission

@@ -198,6 +198,15 @@ def build_parser() -> argparse.ArgumentParser:
                        help="speculative decoding: propose up to K draft "
                             "tokens per greedy request by n-gram prompt "
                             "lookup, verified in one forward (0 = off)")
+    serve.add_argument("--decode-burst", type=int, default=8,
+                       help="multi-step decode: fuse up to N decode+sample "
+                            "steps into one device call with on-device "
+                            "token feedback — one host round trip per N "
+                            "tokens (0 or 1 = classic per-token stepping). "
+                            "Fallback is per-request: a request needing "
+                            "per-token host work (logprobs, logit_bias, "
+                            "guided decoding) single-steps while the rest "
+                            "of the batch keeps bursting")
     serve.add_argument("--dtype", default="",
                        help="override the model compute dtype (e.g. float32 "
                             "for exact cross-sharding equivalence checks)")

@@ -40,6 +40,7 @@ from fusioninfer_tpu.engine.kv_cache import (
     init_kv_cache,
 )
 from fusioninfer_tpu.engine.model_runner import (
+    decode_burst,
     decode_step,
     pick_bucket,
     prefill,
@@ -240,6 +241,7 @@ class NativeEngine:
         prefill_chunks_per_step: int = 1,
         speculative_k: Optional[int] = None,
         token_byte_table=None,
+        decode_burst_steps: int = 1,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -427,6 +429,14 @@ class NativeEngine:
             raise ValueError("speculative_k must be >= 1")
         self.spec_k = speculative_k
         self.proposer = NgramProposer() if speculative_k else None
+        # multi-step decode: fuse up to N decode+sample steps into one
+        # jitted scan with on-device token feedback (ONE host round trip
+        # per N tokens — the serving-throughput lever on remote-attached
+        # chips, see model_runner.decode_burst).  1 = classic per-token
+        # stepping; the server CLI defaults this on (--decode-burst).
+        if decode_burst_steps < 1:
+            raise ValueError("decode_burst_steps must be >= 1")
+        self.burst_steps = decode_burst_steps
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
         # guided decoding (response_format json_object/json_schema):
@@ -1413,6 +1423,7 @@ class NativeEngine:
                 jnp.asarray([p.top_k], jnp.int32),
                 jnp.asarray([p.top_p]),
                 jnp.asarray([p.min_p]),
+                mode=self._sample_mode((p,)),
             )[0]
         )
         if return_state:
@@ -1749,8 +1760,71 @@ class NativeEngine:
                 and not p.logit_bias  # verify scoring ignores the bias
                 and st.n_generated >= p.min_tokens)
 
+    @staticmethod
+    def _sample_mode(params_iter) -> str:
+        """Static fast-path hint for :func:`sampler.sample`, computed
+        host-side from the batch's sampling params: "greedy" when every
+        row is temperature<=0, "plain" when no sampled row filters
+        (skips the two [B, V] sorts that otherwise dominate a TPU
+        decode step), else the general "filtered"."""
+        mode = "greedy"
+        for p in params_iter:
+            if p.temperature <= 0.0:
+                continue
+            if p.top_k > 0 or p.top_p < 1.0 or p.min_p > 0.0:
+                return "filtered"
+            mode = "plain"
+        return mode
+
+    def _decode_need(self, st: "_SeqState", span: int) -> int:
+        """Tokens of page coverage this row needs from the next decode
+        pass: a burst row covers the whole span (clipped to its budget),
+        a single-step row covers one token."""
+        if span <= 1 or not self._row_bursts(st):
+            return 1
+        return max(1, min(span, st.request.params.max_tokens
+                          - st.n_generated))
+
+    @staticmethod
+    def _row_bursts(st: "_SeqState") -> bool:
+        """True when this row can ride a decode burst: guided masks,
+        logprobs extraction and logit_bias scatter all need host work
+        per token, so such rows take the classic single-step leg (the
+        REST of the batch keeps bursting — fallback is row-granular)."""
+        p = st.request.params
+        return (st.guided is None and p.logprobs is None
+                and not p.logit_bias)
+
+    def _burst_span(self) -> int:
+        """How many decode steps the next pass may fuse on device.
+
+        Returns either 1 (classic stepping) or ``self.burst_steps`` —
+        never an in-between value, so XLA compiles exactly two decode
+        signatures.  Speculative decoding forces 1 (it has its own
+        multi-token path); otherwise the span is chosen by the
+        burst-ELIGIBLE rows alone — ineligible rows (``_row_bursts``)
+        run the single-step leg of the same pass and never veto the
+        batch.  The decision reads only replicated scheduler state so
+        every process of a multi-host lockstep group computes the same
+        span."""
+        k = self.burst_steps
+        if k <= 1 or self.spec_k:
+            return 1
+        eligible = [st for st in self.running.values()
+                    if st.n_generated < st.request.params.max_tokens
+                    and self._row_bursts(st)]
+        if not eligible:
+            return 1
+        # only burst while it can amortize: every row short of the full
+        # span would waste steps AND fragment compile signatures if we
+        # bursted its exact remainder
+        if max(st.request.params.max_tokens - st.n_generated
+               for st in eligible) < k:
+            return 1
+        return k
+
     def _decode(self) -> list[StepOutput]:
-        failures = self._ensure_decode_capacity()
+        failures, span = self._ensure_decode_capacity(self._burst_span())
         live = {s: st for s, st in self.running.items()
                 if st.n_generated < st.request.params.max_tokens}
         if not live:
@@ -1792,6 +1866,56 @@ class NativeEngine:
             seeds[slot] = st.seed
             adapter_ids[slot] = self._adapter_id(st.request)
 
+        lora = self.lora_set.stacked if self.lora_set is not None else None
+        if span > 1:
+            burst_rows = {s: st for s, st in live.items()
+                          if self._row_bursts(st)}
+            active_burst = np.zeros((B,), bool)
+            active_burst[list(burst_rows)] = True
+            # pack every per-row control scalar into one int32 + one
+            # float32 upload: the tunnel charges per TRANSFER, not per
+            # byte (model_runner.CTL_I_COLS / CTL_F_COLS layout)
+            ctl_i = np.stack(
+                [tokens, positions, top_ks, min_toks, gen_counts,
+                 seeds.view(np.int32), adapter_ids,
+                 active_burst.astype(np.int32)], axis=1)
+            ctl_f = np.stack(
+                [temps, top_ps, min_ps, presence, frequency, repetition],
+                axis=1)
+            self.cache, sampled_dev, self._token_counts, self._output_counts = \
+                decode_burst(
+                    self.cfg, self.cache_cfg, self.params, self.cache,
+                    jnp.asarray(ctl_i), jnp.asarray(ctl_f),
+                    self._token_counts, self._output_counts, self._suppress,
+                    jnp.asarray(page_tables),
+                    n_steps=span,
+                    sample_mode=self._sample_mode(
+                        st.request.params for st in burst_rows.values()),
+                    mesh=self._kernel_mesh, lora=lora,
+                )
+            sampled_all = np.asarray(sampled_dev)  # [span, B]
+            carried = list(failures)
+            for slot, st in burst_rows.items():
+                for k in range(span):
+                    token = int(sampled_all[k, slot])
+                    st.tokens.append(token)
+                    self.generation_tokens_total += 1
+                    out = self._emit(st, token)
+                    carried.append(out)
+                    if out.finished:
+                        break  # trailing burst tokens are discarded
+            # rows needing per-token host work (guided / logprobs /
+            # logit_bias) take the classic single-step leg of this SAME
+            # pass: they advance one token while the burst rows above
+            # advanced ``span`` — row-granular fallback, so one such
+            # request never collapses the whole batch's throughput
+            live = {s: st for s, st in live.items() if s not in burst_rows}
+            if not live:
+                return carried
+            failures = carried
+            active = np.zeros((B,), bool)
+            active[list(live)] = True
+
         # speculative drafts (greedy, penalty-free sequences only)
         spec_drafts: dict[int, list[int]] = {}
         if self.spec_k:
@@ -1819,7 +1943,6 @@ class NativeEngine:
                     page_tables[slot] = self.alloc.page_table_row(
                         st.request.request_id)
 
-        lora = self.lora_set.stacked if self.lora_set is not None else None
         argmax_w = None
         if self.spec_k:
             # ALWAYS the verify scorer when speculation is on — even on
@@ -1919,7 +2042,9 @@ class NativeEngine:
         keys = make_row_keys(jnp.asarray(seeds), jnp.asarray(gen_counts))
         sampled_dev = sample(logits, keys, jnp.asarray(temps),
                              jnp.asarray(top_ks), jnp.asarray(top_ps),
-                             jnp.asarray(min_ps))
+                             jnp.asarray(min_ps),
+                             mode=self._sample_mode(
+                                 st.request.params for st in live.values()))
         live_mask = np.zeros(B, bool)
         live_mask[list(live)] = True
         self._token_counts, self._output_counts = _bump_count_rows(
@@ -1990,11 +2115,33 @@ class NativeEngine:
                                       force_finish=force_finish))
         return outputs
 
-    def _ensure_decode_capacity(self) -> list[StepOutput]:
+    def _ensure_decode_capacity(self, span: int = 1) -> tuple[list[StepOutput], int]:
         """Grow page tables for sequences crossing a page boundary this
         step; on exhaustion, preempt least-urgent-first until the most
-        urgent sequences can proceed."""
+        urgent sequences can proceed.
+
+        ``span`` > 1 pre-extends each row for up to ``span`` tokens (one
+        decode burst's worth, clipped to the row's remaining budget).  If
+        the pool can't spare burst headroom the whole pass decays to
+        span 1 — burst pages must never cause a preemption that classic
+        stepping wouldn't.  Returns ``(failures, achieved_span)``."""
         failures: list[StepOutput] = []
+        if span > 1:
+            # burst headroom is all-or-nothing: granting it to the
+            # urgency-ordered prefix of rows and only then decaying
+            # would strand the grants and can preempt a row classic
+            # stepping would have served — so price the WHOLE batch
+            # first and decay up front when the pool can't cover it
+            extra = 0
+            for st in self.running.values():
+                if st.n_generated >= st.request.params.max_tokens:
+                    continue
+                need = self._decode_need(st, span)
+                have = len(self.alloc.pages_of(st.request.request_id))
+                extra += max(0, self.alloc.pages_needed(
+                    len(st.tokens) - 1 + need) - have)
+            if extra > self.alloc.free_pages:
+                span = 1
         # most urgent first, so pages flow to high-priority (then oldest)
         # work and a background sequence can never preempt an urgent one
         # just by asking first
@@ -2015,11 +2162,19 @@ class NativeEngine:
                         st.request.request_id,
                         first_live // self.cache_cfg.page_size)
             while True:
+                need = self._decode_need(st, span)
                 try:
                     # input token occupies index len-1 -> need len tokens covered
-                    self.alloc.extend(st.request.request_id, len(st.tokens) - 1, 1)
+                    self.alloc.extend(st.request.request_id,
+                                      len(st.tokens) - 1, need)
                     break
                 except MemoryError:
+                    if span > 1:
+                        # burst headroom is a luxury: decay the whole
+                        # pass to classic stepping before touching
+                        # anyone's pages
+                        span = 1
+                        continue
                     # only a strictly less urgent victim may be evicted —
                     # never a priority inversion
                     if self._preempt_youngest(
@@ -2045,7 +2200,7 @@ class NativeEngine:
                         )
                     )
                     break
-        return failures
+        return failures, span
 
     # -- bookkeeping ---------------------------------------------------------
 

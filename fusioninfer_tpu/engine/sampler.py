@@ -11,6 +11,7 @@ packed with strangers.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Optional
 
 import jax
@@ -122,7 +123,7 @@ def filter_logits(
     return jnp.where(scaled < threshold, -jnp.inf, scaled)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("mode",))
 def sample(
     logits: jax.Array,  # [B, V] float32 (penalties already applied)
     keys: jax.Array,  # [B] PRNG keys — one independent stream per row
@@ -130,10 +131,36 @@ def sample(
     top_k: jax.Array,  # [B] int32, 0 = off
     top_p: jax.Array,  # [B]
     min_p: jax.Array | None = None,  # [B], 0 = off
+    mode: str = "filtered",
 ) -> jax.Array:
-    """Sample one token per row; temperature <= 0 means greedy."""
+    """Sample one token per row; temperature <= 0 means greedy.
+
+    ``mode`` is a STATIC fast-path hint the engine computes on the host
+    from the batch's sampling params (it knows every row's request):
+
+    * ``"greedy"``   — every row has temperature <= 0: return the
+      argmax, no keys consumed, nothing else computed.
+    * ``"plain"``    — no sampled row uses top-k/top-p/min-p: sample
+      from the temperature-scaled logits, skipping
+      :func:`filter_logits` — whose two full [B, V] sorts cost ~30 ms
+      per step at a 150k vocab on TPU and dominate the decode loop if
+      run unconditionally.
+    * ``"filtered"`` — the general path (default; always correct).
+
+    A static argument (one small compiled variant each) rather than a
+    runtime ``lax.cond``: a cond nested inside the decode-burst scan
+    sent XLA:TPU compile time through the roof, and the host already
+    knows the batch composition exactly.  Fast paths are bit-identical
+    to the filtered math: with top_k=0 and top_p=1 the filter masks
+    nothing, so its categorical draw sees the very same scaled
+    logits."""
     greedy_tok = jnp.argmax(logits, axis=-1)
-    scaled = filter_logits(logits, temperature, top_k, top_p, min_p)
+    if mode == "greedy":
+        return greedy_tok
+    if mode == "plain":
+        scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    else:
+        scaled = filter_logits(logits, temperature, top_k, top_p, min_p)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)
 

@@ -1686,6 +1686,7 @@ def serve_from_args(args) -> int:
         lora_adapters=lora_adapters or None,
         prefill_chunk_size=_nonneg_flag(args, "prefill_chunk_size"),
         speculative_k=_nonneg_flag(args, "speculative_ngram"),
+        decode_burst_steps=max(1, getattr(args, "decode_burst", 8) or 1),
     )
     server = EngineServer(
         model=model_name,

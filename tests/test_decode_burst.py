@@ -1,0 +1,201 @@
+"""Multi-step decode burst: one jitted scan decodes+samples N tokens per
+host round trip (``model_runner.decode_burst``).  The contract under test
+is bit-identity: a burst engine must emit exactly the token streams the
+classic per-token engine emits — greedy and sampled, penalized and
+min-tokens-suppressed — because the scan body inlines the very same
+sampler math with the same key derivation.
+
+Reference capability: vLLM multi-step scheduling / TPU server step
+batching (the reference delegates serving to vLLM,
+/root/reference/docs/fusioninfer/docs/design/core-design.md:29); here it is
+the lever that amortizes the host<->device round trip that dominates
+per-token latency on remote-attached TPU chips.
+"""
+
+import pytest
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.models.config import get_preset
+
+CFG = get_preset("qwen3-tiny")
+CACHE = CacheConfig(n_pages=64, page_size=8, max_pages_per_seq=8)
+
+
+def make_engine(burst=1, cache=CACHE, **over):
+    kw = dict(cfg=CFG, cache_cfg=cache, max_batch_size=4, seed=0,
+              decode_burst_steps=burst)
+    kw.update(over)
+    return NativeEngine(**kw)
+
+
+def run_to_completion(engine, max_steps=300):
+    outputs, finished = {}, {}
+    for _ in range(max_steps):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            outputs.setdefault(out.request_id, []).append(out.token)
+            if out.finished:
+                finished[out.request_id] = out.finish_reason
+    return outputs, finished
+
+
+def collect(burst, requests, cache=CACHE, **over):
+    engine = make_engine(burst, cache=cache, **over)
+    for r in requests:
+        engine.add_request(r)
+    outs, fins = run_to_completion(engine)
+    assert engine.num_running == 0
+    return outs, fins
+
+
+class TestBurstIdentity:
+    def test_greedy_identity_mid_burst_finish(self):
+        """max_tokens=10 with span 4: the last burst overruns by 2 and
+        the overrun must be discarded, not emitted."""
+        reqs = lambda: [Request("g", [2, 4, 6, 8],
+                                SamplingParams(temperature=0.0, max_tokens=10))]
+        base, fin_base = collect(1, reqs())
+        burst, fin_burst = collect(4, reqs())
+        assert burst == base
+        assert fin_burst == fin_base == {"g": "length"}
+        assert len(burst["g"]) == 10
+
+    def test_sampled_identity_with_penalties(self):
+        """Seeded sampling + presence/frequency/repetition penalties and
+        min_tokens: the scan's penalty ordering and key derivation must
+        reproduce the sequential stream exactly."""
+        def reqs():
+            return [
+                Request("s0", [1, 3, 5], SamplingParams(
+                    temperature=0.9, top_k=20, top_p=0.95, seed=7,
+                    presence_penalty=0.4, frequency_penalty=0.2,
+                    repetition_penalty=1.2, max_tokens=12)),
+                Request("s1", [9, 9, 2], SamplingParams(
+                    temperature=0.7, min_p=0.02, seed=11,
+                    min_tokens=6, stop_token_ids=[0],
+                    max_tokens=12)),
+            ]
+        base, fb = collect(1, reqs())
+        burst, fbu = collect(4, reqs())
+        assert burst == base
+        assert fbu == fb
+
+    def test_batched_identity(self):
+        reqs = lambda: [
+            Request(f"r{i}", [2 + i, 4, 6],
+                    SamplingParams(temperature=0.0, max_tokens=8))
+            for i in range(4)
+        ]
+        base, _ = collect(1, reqs())
+        burst, fins = collect(4, reqs())
+        assert burst == base
+        assert all(r == "length" for r in fins.values())
+
+    def test_stop_token_mid_burst_truncates(self):
+        """A stop token landing mid-burst must end the stream there —
+        trailing burst tokens are garbage and never reach the client."""
+        probe, _ = collect(1, [Request("p", [2, 4, 6], SamplingParams(
+            temperature=0.0, max_tokens=8))])
+        stop_tok = probe["p"][3]  # force a stop on the 4th token
+        reqs = lambda: [Request("x", [2, 4, 6], SamplingParams(
+            temperature=0.0, max_tokens=8, stop_token_ids=[stop_tok]))]
+        base, fb = collect(1, reqs())
+        burst, fbu = collect(8, reqs())
+        assert burst == base
+        assert fbu == fb == {"x": "stop"}
+        assert burst["x"][-1] == stop_tok
+
+    def test_burst_with_prefix_caching_and_page_growth(self):
+        """Bursts cross page boundaries (page_size=8, span=8): the
+        pre-extension must cover the whole burst, including for the
+        prefix-caching allocator."""
+        reqs = lambda: [Request("long", list(range(2, 12)), SamplingParams(
+            temperature=0.0, max_tokens=24))]
+        base, _ = collect(1, reqs(), enable_prefix_caching=True)
+        burst, fins = collect(8, reqs(), enable_prefix_caching=True)
+        assert burst == base
+        assert fins == {"long": "length"}
+
+
+class TestBurstFallbacks:
+    def test_logprobs_rows_fall_back(self):
+        """A logprobs request needs host-side extraction per token: it
+        single-steps (and, alone in the batch, the span decision drops
+        to 1) while logprobs still arrive."""
+        engine = make_engine(8)
+        engine.add_request(Request("lp", [2, 4], SamplingParams(
+            temperature=0.0, max_tokens=5, logprobs=3)))
+        assert engine._burst_span() == 1 or not engine.running  # pre-admission
+        outs, fins = run_to_completion(engine)
+        assert fins == {"lp": "length"}
+        assert len(outs["lp"]) == 5
+
+    def test_mixed_batch_fallback_is_row_granular(self):
+        """One logprobs request must NOT collapse the batch to classic
+        stepping: greedy neighbours keep bursting (multiple tokens per
+        engine step) and stay token-identical, while the logprobs row
+        advances one audited token per step."""
+        greedy_reqs = lambda: [
+            Request(f"g{i}", [2 + i, 4, 6],
+                    SamplingParams(temperature=0.0, max_tokens=16))
+            for i in range(2)
+        ]
+        base, _ = collect(1, greedy_reqs())
+
+        engine = make_engine(8)
+        for r in greedy_reqs():
+            engine.add_request(r)
+        engine.add_request(Request("lp", [9, 8, 7], SamplingParams(
+            temperature=0.0, max_tokens=16, logprobs=2)))
+        outs: dict[str, list] = {}
+        lp_vals: list = []
+        burst_steps_seen = 0
+        for _ in range(300):
+            if not engine.has_work():
+                break
+            per_step: dict[str, int] = {}
+            for o in engine.step():
+                outs.setdefault(o.request_id, []).append(o.token)
+                per_step[o.request_id] = per_step.get(o.request_id, 0) + 1
+                if o.request_id == "lp" and o.logprob is not None:
+                    lp_vals.append(o.logprob)
+            if any(v > 2 for k, v in per_step.items() if k.startswith("g")):
+                burst_steps_seen += 1
+            # the slow row advances one decode token per step (two on
+            # its admission step: prefill first-token + same-step decode)
+            lp_first = "lp" not in outs or len(outs["lp"]) == per_step.get("lp", 0)
+            assert per_step.get("lp", 0) <= (2 if lp_first else 1)
+        assert burst_steps_seen > 0, "greedy rows never bursted"
+        assert {k: v for k, v in outs.items() if k.startswith("g")} == base
+        assert len(outs["lp"]) == 16 and len(lp_vals) == 16
+
+    def test_memory_pressure_decays_span(self):
+        """A pool too small for burst headroom must decay to classic
+        stepping rather than preempt — and still finish everyone."""
+        tiny = CacheConfig(n_pages=10, page_size=8, max_pages_per_seq=8)
+        reqs = lambda: [
+            Request(f"m{i}", [3 + i, 5], SamplingParams(
+                temperature=0.0, max_tokens=20))
+            for i in range(3)
+        ]
+        base, fb = collect(1, reqs(), cache=tiny)
+        burst, fbu = collect(8, reqs(), cache=tiny)
+        assert burst == base
+        assert fbu == fb
+
+    def test_span_stays_one_when_remaining_short(self):
+        """All rows within k of their budget: bursting would only waste
+        steps, so the span decision must return 1."""
+        engine = make_engine(8)
+        engine.add_request(Request("short", [2, 4], SamplingParams(
+            temperature=0.0, max_tokens=3)))
+        outs, fins = run_to_completion(engine)
+        assert len(outs["short"]) == 3
+        assert fins == {"short": "length"}
+
+    def test_burst_rejects_bad_config(self):
+        with pytest.raises(ValueError):
+            make_engine(0)
