@@ -55,6 +55,7 @@ from fusioninfer_tpu.engine.sampler import (
     apply_penalties,
     make_row_keys,
     sample,
+    sample_first,
     spec_window_draws,
 )
 from fusioninfer_tpu.models.config import ModelConfig
@@ -1347,16 +1348,21 @@ class NativeEngine:
         # unseeded: stable per engine seed + admission order
         return (self._base_seed * 1_000_003 + next(self._seed_counter)) & 0x7FFFFFFF
 
+    @staticmethod
+    def _pow2_pad(tokens: list[int]) -> np.ndarray:
+        """Zero-pad to a power of two so jitted consumers compile once
+        per bucket, not once per prompt length."""
+        L = 1 << (len(tokens) - 1).bit_length()
+        padded = np.zeros(L, np.int32)
+        padded[: len(tokens)] = tokens
+        return padded
+
     def _prompt_counts(self, prefix: list[int]) -> jax.Array:
         V = self.cfg.vocab_size
         if not prefix:
             return jnp.zeros((V,), jnp.int32)
-        # pad to a power of two so the jitted histogram compiles once
-        # per bucket, not once per prompt length
-        L = 1 << (len(prefix) - 1).bit_length()
-        padded = np.zeros(L, np.int32)
-        padded[: len(prefix)] = prefix
-        return _histogram(jnp.asarray(padded), jnp.int32(len(prefix)), V)
+        return _histogram(jnp.asarray(self._pow2_pad(prefix)),
+                          jnp.int32(len(prefix)), V)
 
     def _stop_suppress_row(self, params: SamplingParams) -> jax.Array:
         V = self.cfg.vocab_size
@@ -1391,6 +1397,32 @@ class NativeEngine:
         p = request.params
         if n_prompt is None:
             n_prompt = len(prefix)
+        if not p.logit_bias and machine is None and prefix:
+            # fused admission path: one jitted call instead of ~14
+            # device ops (sampler.sample_first) — the TTFT lever on a
+            # remote-attached chip.  logit_bias / guided rows need
+            # host-side extras and keep the legacy sequence below.
+            padded = self._pow2_pad(prefix)
+            stop = (list(p.stop_token_ids)
+                    if (p.min_tokens > 0 and p.stop_token_ids) else [])
+            K = 1 << (len(stop) - 1).bit_length() if stop else 1
+            sids = np.full(K, -1, np.int32)
+            sids[: len(stop)] = stop
+            gen_index = len(prefix) - n_prompt
+            ctl_i = np.asarray(
+                [n_prompt, len(prefix), p.top_k, p.min_tokens, gen_index,
+                 np.uint32(seed).view(np.int32)], np.int32)
+            ctl_f = np.asarray(
+                [p.temperature, p.top_p, p.min_p, p.presence_penalty,
+                 p.frequency_penalty, p.repetition_penalty], np.float32)
+            tok_d, counts_row, out_row, sup_row = sample_first(
+                logits, jnp.asarray(padded), jnp.asarray(ctl_i),
+                jnp.asarray(ctl_f), jnp.asarray(sids),
+                mode=self._sample_mode((p,)))
+            token = int(tok_d)
+            if return_state:
+                return token, (counts_row, out_row, sup_row)
+            return token
         counts_row = self._prompt_counts(prefix)
         out_row = self._prompt_counts(prefix[n_prompt:])
         sup_row = self._stop_suppress_row(p)

@@ -199,3 +199,27 @@ class TestBurstFallbacks:
     def test_burst_rejects_bad_config(self):
         with pytest.raises(ValueError):
             make_engine(0)
+
+
+class TestAdmissionFastPath:
+    """The fused first-token call (sampler.sample_first) must be
+    bit-identical to the legacy ~14-op admission sequence.  A zero
+    logit_bias entry is mathematically a no-op but routes a request
+    down the legacy path — giving both paths on identical inputs."""
+
+    @pytest.mark.parametrize("params", [
+        dict(temperature=0.0, max_tokens=6),
+        dict(temperature=0.8, seed=13, max_tokens=6),
+        dict(temperature=0.8, seed=13, top_k=12, top_p=0.9, max_tokens=6),
+        dict(temperature=0.7, seed=3, presence_penalty=0.5,
+             frequency_penalty=0.3, repetition_penalty=1.3, max_tokens=6),
+        dict(temperature=0.0, min_tokens=4, stop_token_ids=[2, 9],
+             max_tokens=6),
+    ])
+    def test_fused_matches_legacy(self, params):
+        fused, ff = collect(1, [Request("r", [4, 2, 7],
+                                        SamplingParams(**params))])
+        legacy, lf = collect(1, [Request("r", [4, 2, 7], SamplingParams(
+            logit_bias=[(1, 0.0)], **params))])
+        assert fused == legacy
+        assert ff == lf
