@@ -514,6 +514,33 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
     )
     srv.start()
     try:
+        # warm the jit signatures the measured load will hit, OUTSIDE
+        # the measured window — a cold XLA compile mid-window poisons
+        # the TTFT percentiles with a number that is not serving time.
+        # That means every power-of-two prefill bucket up to max_prompt
+        # (each is its own signature), at the LOAD's sampling mode
+        # (loadgen sends temperature=0.8 with no top-k/top-p — the
+        # "plain" static variant of sample/sample_first/decode_burst)
+        # plus one greedy request for the "greedy" variants.
+        import urllib.request as _ur
+
+        def _warm(n_tokens: int, temperature: float) -> None:
+            body = json.dumps({
+                "model": cfg.name, "prompt": "w" * max(1, n_tokens - 2),
+                "max_tokens": min(24, max_output),
+                "temperature": temperature, "seed": 0,
+            }).encode()
+            req = _ur.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions", body,
+                headers={"Content-Type": "application/json"})
+            _ur.urlopen(req, timeout=600).read()
+
+        bucket = 32
+        while bucket <= max_prompt:
+            _warm(bucket, 0.8)
+            bucket *= 2
+        _warm(32, 0.0)
+        engine.admission_timings.clear()
         result = run_http_load(
             f"http://127.0.0.1:{srv.port}",
             n_requests=n_requests, concurrency=concurrency, seed=0,
@@ -522,6 +549,7 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
         )
         out = result.summary(n_chips=1)
         out["decode_burst"] = engine.burst_steps
+        out["warmed"] = True  # compiles excluded from the window
         if shared_prefix_len:
             out["shared_prefix_len"] = shared_prefix_len
         # TTFT decomposition: server-side queue-wait (arrival → admission
