@@ -536,10 +536,27 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
             _ur.urlopen(req, timeout=600).read()
 
         bucket = 32
-        while bucket <= max_prompt:
+        while True:
             _warm(bucket, 0.8)
+            if bucket >= max_prompt:  # include the round-UP bucket for
+                break                 # non-power-of-two max_prompt
             bucket *= 2
         _warm(32, 0.0)
+        if shared_prefix_len:
+            # the shared-prefix leg exercises the separately-jitted
+            # prefill_suffix (cache-hit) signature: warm it with two
+            # requests sharing a prefix
+            for tail in (" tail", " cont"):  # 2nd = cache hit → suffix
+                body = json.dumps({
+                    "model": cfg.name,
+                    "prompt": "p" * shared_prefix_len + tail,
+                    "max_tokens": min(24, max_output),
+                    "temperature": 0.8, "seed": 0,
+                }).encode()
+                req = _ur.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/completions", body,
+                    headers={"Content-Type": "application/json"})
+                _ur.urlopen(req, timeout=600).read()
         engine.admission_timings.clear()
         result = run_http_load(
             f"http://127.0.0.1:{srv.port}",

@@ -207,6 +207,11 @@ def build_parser() -> argparse.ArgumentParser:
                             "per-token host work (logprobs, logit_bias, "
                             "guided decoding) single-steps while the rest "
                             "of the batch keeps bursting")
+    serve.add_argument("--no-decode-pipeline", action="store_true",
+                       help="disable double-buffered burst pipelining "
+                            "(dispatching the next burst before the "
+                            "current one's fetch, hiding the host-device "
+                            "round trip in steady state)")
     serve.add_argument("--dtype", default="",
                        help="override the model compute dtype (e.g. float32 "
                             "for exact cross-sharding equivalence checks)")

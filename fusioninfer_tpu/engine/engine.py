@@ -243,6 +243,7 @@ class NativeEngine:
         speculative_k: Optional[int] = None,
         token_byte_table=None,
         decode_burst_steps: int = 1,
+        pipeline_bursts: bool = True,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -438,6 +439,17 @@ class NativeEngine:
         if decode_burst_steps < 1:
             raise ValueError("decode_burst_steps must be >= 1")
         self.burst_steps = decode_burst_steps
+        # double-buffered burst pipelining: in steady state (every live
+        # row bursting, no pending scheduler work) the successor burst
+        # dispatches from decode_burst's device-side control carry
+        # BEFORE the current burst's blocking fetch, hiding the
+        # host<->device round trip behind compute.  The donated-cache
+        # dependency chain serializes all device work, and chaining
+        # breaks whenever the running set changes (finish / cancel /
+        # admission / preemption), so output streams are identical to
+        # unpipelined bursting.
+        self.pipeline_bursts = pipeline_bursts
+        self._inflight = None
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
         # guided decoding (response_format json_object/json_schema):
@@ -1855,7 +1867,123 @@ class NativeEngine:
             return 1
         return k
 
+    def _dispatch_burst(self, ctl_i_dev, ctl_f_dev, page_tables_dev,
+                        span: int, mode: str, lora):
+        """Dispatch one decode burst (async) → (sampled_dev, next_ctl)."""
+        self.cache, sampled_dev, self._token_counts, self._output_counts, \
+            next_ctl = decode_burst(
+                self.cfg, self.cache_cfg, self.params, self.cache,
+                ctl_i_dev, ctl_f_dev,
+                self._token_counts, self._output_counts, self._suppress,
+                page_tables_dev,
+                n_steps=span, sample_mode=mode,
+                mesh=self._kernel_mesh, lora=lora,
+            )
+        return sampled_dev, next_ctl
+
+    def _pipeline_ready(self, snapshot: dict, span: int) -> bool:
+        """May the successor burst dispatch from the device-side carry?
+        Only in steady state: no pending scheduler work of any kind and
+        the running set EXACTLY the snapshot (same objects) — any
+        admission, cancellation, finish or preemption since the
+        snapshot was taken breaks the chain and the next pass rebuilds
+        controls from host state."""
+        if (not self.pipeline_bursts or self._mh is not None
+                or self.spec_k):
+            return False
+        if (self.waiting or self.waiting_prefilled or self.prefilling
+                or self._cancelled or not self._slab_q.empty()
+                or not self._embed_q.empty()):
+            return False
+        if len(self.running) != len(snapshot):
+            return False
+        for s, st in snapshot.items():
+            if self.running.get(s) is not st:
+                return False
+        # amortization: after the in-flight burst lands, at least one
+        # row must still have a full span of budget left (host
+        # n_generated is stale by exactly the in-flight span here)
+        return max(st.request.params.max_tokens - st.n_generated - span
+                   for st in snapshot.values()) >= span
+
+    def _extend_for_successor(self, snapshot: dict, span: int) -> bool:
+        """Pre-extend pages to cover a successor burst (positions
+        ``len-1+span .. len-2+2*span``).  All-or-nothing priced against
+        the pool first — a failed successor just means no pipelining
+        this pass, never a preemption."""
+        extra = 0
+        plan = []
+        for st in snapshot.values():
+            if self.cfg.sliding_window is not None:
+                # reclaim below-window pages BEFORE pricing — the chained
+                # fast path bypasses _ensure_decode_capacity's trim, and
+                # without it a windowed steady state would exhaust the
+                # pool and bounce out of the pipeline every other burst
+                first_live = (len(st.tokens) + span
+                              - self.cfg.sliding_window)
+                if first_live > 0:
+                    self.alloc.trim_window(
+                        st.request.request_id,
+                        first_live // self.cache_cfg.page_size)
+            rem_after = (st.request.params.max_tokens - st.n_generated
+                         - span)
+            if rem_after < 1:
+                continue  # finishes in-flight; overrun goes to trash
+            need = min(span, rem_after)
+            base = len(st.tokens) - 1 + span
+            have = len(self.alloc.pages_of(st.request.request_id))
+            extra += max(0, self.alloc.pages_needed(base + need) - have)
+            plan.append((st, base, need))
+        if extra > self.alloc.free_pages:
+            return False
+        try:
+            for st, base, need in plan:
+                self.alloc.extend(st.request.request_id, base, need)
+        except MemoryError:  # max_pages_per_seq ceiling — skip pipelining
+            return False
+        return True
+
+    def _consume_inflight(self) -> list[StepOutput]:
+        """Fetch and emit the in-flight burst, first dispatching its
+        successor from the device-side control carry when the pipeline
+        conditions hold (the dispatch must precede the blocking fetch —
+        that ordering IS the round-trip hiding)."""
+        sampled_dev, next_ctl, ctl_f_dev, snapshot, span, mode, lora = \
+            self._inflight
+        self._inflight = None
+        successor = None
+        if (self._pipeline_ready(snapshot, span)
+                and self._extend_for_successor(snapshot, span)):
+            B = self.max_batch_size
+            mp = self.cache_cfg.max_pages_per_seq
+            tables = np.full((B, mp), self.cache_cfg.trash_page, np.int32)
+            for s, st in snapshot.items():
+                tables[s] = self.alloc.page_table_row(st.request.request_id)
+            s_dev, s_next = self._dispatch_burst(
+                next_ctl, ctl_f_dev, jnp.asarray(tables), span, mode, lora)
+            successor = (s_dev, s_next, ctl_f_dev, dict(snapshot), span,
+                         mode, lora)
+        sampled_all = np.asarray(sampled_dev)  # [span, B] — blocks here
+        outputs: list[StepOutput] = []
+        for slot, st in snapshot.items():
+            if self.running.get(slot) is not st:
+                continue  # cancelled/preempted since dispatch — discard
+            for k in range(span):
+                token = int(sampled_all[k, slot])
+                st.tokens.append(token)
+                self.generation_tokens_total += 1
+                out = self._emit(st, token)
+                outputs.append(out)
+                if out.finished:
+                    break  # trailing burst tokens are discarded
+        if successor is not None and any(
+                self.running.get(s) is st for s, st in snapshot.items()):
+            self._inflight = successor
+        return outputs
+
     def _decode(self) -> list[StepOutput]:
+        if self._inflight is not None:
+            return self._consume_inflight()
         failures, span = self._ensure_decode_capacity(self._burst_span())
         live = {s: st for s, st in self.running.items()
                 if st.n_generated < st.request.params.max_tokens}
@@ -1914,28 +2042,17 @@ class NativeEngine:
             ctl_f = np.stack(
                 [temps, top_ps, min_ps, presence, frequency, repetition],
                 axis=1)
-            self.cache, sampled_dev, self._token_counts, self._output_counts = \
-                decode_burst(
-                    self.cfg, self.cache_cfg, self.params, self.cache,
-                    jnp.asarray(ctl_i), jnp.asarray(ctl_f),
-                    self._token_counts, self._output_counts, self._suppress,
-                    jnp.asarray(page_tables),
-                    n_steps=span,
-                    sample_mode=self._sample_mode(
-                        st.request.params for st in burst_rows.values()),
-                    mesh=self._kernel_mesh, lora=lora,
-                )
-            sampled_all = np.asarray(sampled_dev)  # [span, B]
-            carried = list(failures)
-            for slot, st in burst_rows.items():
-                for k in range(span):
-                    token = int(sampled_all[k, slot])
-                    st.tokens.append(token)
-                    self.generation_tokens_total += 1
-                    out = self._emit(st, token)
-                    carried.append(out)
-                    if out.finished:
-                        break  # trailing burst tokens are discarded
+            mode = self._sample_mode(
+                st.request.params for st in burst_rows.values())
+            ctl_f_dev = jnp.asarray(ctl_f)
+            sampled_dev, next_ctl = self._dispatch_burst(
+                jnp.asarray(ctl_i), ctl_f_dev, jnp.asarray(page_tables),
+                span, mode, lora)
+            # hand the fresh burst to the consume path, which may
+            # dispatch its successor before the blocking fetch
+            self._inflight = (sampled_dev, next_ctl, ctl_f_dev,
+                              dict(burst_rows), span, mode, lora)
+            carried = list(failures) + self._consume_inflight()
             # rows needing per-token host work (guided / logprobs /
             # logit_bias) take the classic single-step leg of this SAME
             # pass: they advance one token while the burst rows above

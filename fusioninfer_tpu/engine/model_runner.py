@@ -420,7 +420,7 @@ def decode_burst(
 ):
     """``n_steps`` fused decode+sample steps with on-device token
     feedback → ``(cache, sampled [n_steps, B], token_counts,
-    output_counts)``.
+    output_counts, next_ctl_i)``.
 
     The continuous-batching loop's per-token cost on a remote-attached
     TPU is dominated by the host↔device round trips — the chip decodes
@@ -498,11 +498,19 @@ def decode_burst(
         return (cache, next_tok, pos + step, tcounts, ocounts,
                 gcounts + step), sampled
 
-    (cache, _, _, token_counts, output_counts, _), sampled_all = lax.scan(
-        one, (cache, tokens, positions, token_counts, output_counts,
-              gen_counts),
-        None, length=n_steps)
-    return cache, sampled_all, token_counts, output_counts
+    (cache, toks_f, pos_f, token_counts, output_counts, gcounts_f), \
+        sampled_all = lax.scan(
+            one, (cache, tokens, positions, token_counts, output_counts,
+                  gen_counts),
+            None, length=n_steps)
+    # device-side control carry for burst PIPELINING: the successor
+    # burst's inputs (advanced tokens/positions/gen_counts, other
+    # columns copied) without any host round trip — the engine can
+    # dispatch burst N+1 from this BEFORE blocking on burst N's fetch
+    next_ctl_i = jnp.stack(
+        [toks_f, pos_f, ctl_i[:, 2], ctl_i[:, 3], gcounts_f,
+         ctl_i[:, 5], ctl_i[:, 6], ctl_i[:, 7]], axis=1)
+    return cache, sampled_all, token_counts, output_counts, next_ctl_i
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "last_only"),

@@ -1687,6 +1687,7 @@ def serve_from_args(args) -> int:
         prefill_chunk_size=_nonneg_flag(args, "prefill_chunk_size"),
         speculative_k=_nonneg_flag(args, "speculative_ngram"),
         decode_burst_steps=max(1, getattr(args, "decode_burst", 8) or 1),
+        pipeline_bursts=not getattr(args, "no_decode_pipeline", False),
     )
     server = EngineServer(
         model=model_name,

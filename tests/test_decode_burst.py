@@ -23,8 +23,8 @@ CFG = get_preset("qwen3-tiny")
 CACHE = CacheConfig(n_pages=64, page_size=8, max_pages_per_seq=8)
 
 
-def make_engine(burst=1, cache=CACHE, **over):
-    kw = dict(cfg=CFG, cache_cfg=cache, max_batch_size=4, seed=0,
+def make_engine(burst=1, cache=CACHE, cfg=CFG, **over):
+    kw = dict(cfg=cfg, cache_cfg=cache, max_batch_size=4, seed=0,
               decode_burst_steps=burst)
     kw.update(over)
     return NativeEngine(**kw)
@@ -199,6 +199,135 @@ class TestBurstFallbacks:
     def test_burst_rejects_bad_config(self):
         with pytest.raises(ValueError):
             make_engine(0)
+
+
+class TestBurstPipelining:
+    """Double-buffered bursts: the successor burst dispatches from the
+    device-side control carry BEFORE the current burst's blocking fetch.
+    Chaining must break on any scheduler change (finish, cancel,
+    admission, preemption), and every emitted stream must be identical
+    to the unpipelined engine's."""
+
+    def test_steady_state_identity(self):
+        reqs = lambda: [
+            Request(f"r{i}", [2 + i, 4, 6],
+                    SamplingParams(temperature=0.0, max_tokens=40))
+            for i in range(3)
+        ]
+        base, fb = collect(4, reqs(), pipeline_bursts=False)
+        piped, fp = collect(4, reqs(), pipeline_bursts=True)
+        assert piped == base
+        assert fp == fb
+
+    def test_pipeline_engages(self):
+        """In steady state the inflight handoff must actually happen —
+        observable as a pending _inflight between steps."""
+        engine = make_engine(4, pipeline_bursts=True)
+        engine.add_request(Request("r", [2, 4, 6], SamplingParams(
+            temperature=0.0, max_tokens=56)))
+        saw_inflight = False
+        for _ in range(40):
+            if not engine.has_work():
+                break
+            engine.step()
+            saw_inflight = saw_inflight or engine._inflight is not None
+        assert saw_inflight, "pipeline never engaged in steady state"
+        assert engine._inflight is None or not engine.has_work()
+
+    def test_stop_mid_stream_identity(self):
+        probe, _ = collect(1, [Request("p", [2, 4, 6], SamplingParams(
+            temperature=0.0, max_tokens=30))])
+        stop_tok = probe["p"][17]
+        reqs = lambda: [Request("x", [2, 4, 6], SamplingParams(
+            temperature=0.0, max_tokens=30, stop_token_ids=[stop_tok]))]
+        base, fb = collect(4, reqs(), pipeline_bursts=False)
+        piped, fp = collect(4, reqs(), pipeline_bursts=True)
+        assert piped == base
+        assert fp == fb
+
+    def test_staggered_admission_breaks_chain_correctly(self):
+        """A request arriving mid-pipeline must admit promptly and both
+        streams must match the unpipelined engine run with the same
+        arrival schedule (same step index)."""
+        def run(pipelined: bool):
+            engine = make_engine(4, pipeline_bursts=pipelined)
+            engine.add_request(Request("a", [2, 4, 6], SamplingParams(
+                temperature=0.0, max_tokens=32)))
+            outs: dict[str, list] = {}
+            steps = 0
+            while engine.has_work() and steps < 200:
+                if steps == 5:
+                    engine.add_request(Request("b", [9, 8, 7],
+                                               SamplingParams(
+                                                   temperature=0.0,
+                                                   max_tokens=24)))
+                for o in engine.step():
+                    outs.setdefault(o.request_id, []).append(o.token)
+                steps += 1
+            assert engine.num_running == 0
+            return outs
+
+        base = run(False)
+        piped = run(True)
+        # rows are independent: each request's stream must be identical
+        # regardless of pipelining-induced scheduling differences
+        assert piped["a"] == base["a"]
+        assert piped["b"] == base["b"]
+
+    def test_cancel_mid_flight(self):
+        engine = make_engine(4, pipeline_bursts=True)
+        engine.add_request(Request("keep", [2, 4, 6], SamplingParams(
+            temperature=0.0, max_tokens=32)))
+        engine.add_request(Request("gone", [9, 8, 7], SamplingParams(
+            temperature=0.0, max_tokens=32)))
+        outs: dict[str, list] = {}
+        steps = 0
+        while engine.has_work() and steps < 200:
+            if steps == 4:
+                engine.cancel("gone")
+            for o in engine.step():
+                outs.setdefault(o.request_id, []).append(o.token)
+            steps += 1
+        assert engine.num_running == 0
+        base, _ = collect(4, [Request("keep", [2, 4, 6], SamplingParams(
+            temperature=0.0, max_tokens=32))], pipeline_bursts=False)
+        assert outs["keep"] == base["keep"]
+        assert len(outs.get("gone", [])) < 32
+
+    def test_memory_pressure_skips_pipelining(self):
+        tiny = CacheConfig(n_pages=12, page_size=8, max_pages_per_seq=8)
+        reqs = lambda: [
+            Request(f"m{i}", [3 + i, 5], SamplingParams(
+                temperature=0.0, max_tokens=24))
+            for i in range(2)
+        ]
+        base, fb = collect(4, reqs(), cache=tiny, pipeline_bursts=False)
+        piped, fp = collect(4, reqs(), cache=tiny, pipeline_bursts=True)
+        assert piped == base
+        assert fp == fb
+
+    def test_sliding_window_pipelined_identity(self):
+        """Windowed models reclaim below-window pages inside the chained
+        fast path (_extend_for_successor trims) — streams must match the
+        unpipelined engine and the pool must fully drain."""
+        mistral = get_preset("mistral-tiny")  # sliding_window=24
+        reqs = lambda: [Request("w", [2, 4, 6], SamplingParams(
+            temperature=0.0, max_tokens=48))]
+        base, fb = collect(4, reqs(), cache=CACHE, cfg=mistral,
+                           pipeline_bursts=False)
+        piped, fp = collect(4, reqs(), cache=CACHE, cfg=mistral,
+                            pipeline_bursts=True)
+        assert piped == base
+        assert fp == fb
+
+    def test_kv_released_after_pipelined_run(self):
+        engine = make_engine(8, pipeline_bursts=True)
+        for i in range(3):
+            engine.add_request(Request(f"r{i}", [2 + i, 4], SamplingParams(
+                temperature=0.0, max_tokens=30)))
+        run_to_completion(engine)
+        assert engine.num_running == 0
+        assert engine.kv_cache_usage() == 0.0
 
 
 class TestAdmissionFastPath:
