@@ -344,11 +344,26 @@ def longitudinal(record: dict, here: pathlib.Path = _HERE) -> None:
             # regression — in-run reps share one contention regime and
             # systematically understate it.  TPU runs own the chip, so
             # 5% suffices there.
-            host_floor = 0.05 if record.get("backend_is_tpu") else 0.25
+            # CPU floor raised 0.25 → 0.35 (round 5): an interleaved
+            # same-box A/B of the r4 tree vs the r5 tree measured
+            # same-CODE tiny-decode spreads of 646–948 tok/s — 1-core
+            # box drift across runs exceeds 25%.  Cross-ROUND CPU
+            # comparisons additionally carry box-epoch drift; see
+            # calibration_gflops for the normalization denominator.
+            host_floor = 0.05 if record.get("backend_is_tpu") else 0.35
             floor = max(2 * rel_iqr, host_floor)
             record["vs_prev_noise_floor"] = round(floor, 4)
             record["vs_prev_significant"] = bool(
                 abs(record["vs_prev"] - 1) > floor)
+        cal = record.get("calibration_gflops")
+        pcal = prev.get("calibration_gflops")
+        if cal and pcal:
+            # box-speed-normalized comparison: each round's value is
+            # divided by its own code-frozen matmul calibration, so
+            # host-epoch drift cancels (only meaningful when both
+            # records ran the same backend class)
+            record["vs_prev_box_normalized"] = round(
+                (record["value"] / cal) / (prev["value"] / pcal), 3)
     for name, rec in prior:
         rec_on_tpu = rec.get("backend_is_tpu") or rec.get("backend") in (
             "tpu", "axon")
@@ -372,6 +387,30 @@ def pick_backend(record: dict) -> tuple[str, str]:
     if ok:
         return "", detail
     return "cpu", f"TPU unavailable, CPU fallback ({detail})"
+
+
+def run_calibration(jax) -> float:
+    """Box-speed denominator: GFLOP/s of a FIXED jitted 512x512 f32
+    matmul loop.  This code never changes across rounds, so the ratio
+    ``decode_value / calibration`` cancels host-speed drift — the r5
+    interleaved A/B measured same-code CPU decode spreads of 646-948
+    tok/s across runs of the SAME tree, which no per-run IQR can see.
+    Recorded per-round; ``longitudinal`` emits a box-normalized
+    ``vs_prev`` once two records carry it."""
+    import jax.numpy as jnp
+
+    x = jnp.ones((512, 512), jnp.float32)
+    f = jax.jit(lambda a: a @ a)
+    f(x).block_until_ready()
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(30):
+            y = f(x)
+        y.block_until_ready()
+        dt = time.perf_counter() - t0
+        best = max(best, 30 * 2 * 512 ** 3 / dt / 1e9)
+    return round(best, 2)
 
 
 def _median_iqr(vals: list[float]) -> dict:
@@ -629,6 +668,13 @@ def main() -> None:
         # a TPU, so the gate lives in dispatch.is_tpu_backend()
         on_tpu = is_tpu_backend()
         record["backend_is_tpu"] = on_tpu
+        if not on_tpu:
+            # CPU only: on TPU a 512x512 loop is host-dispatch-bound
+            # and would normalize chip throughput by Python noise
+            try:
+                record["calibration_gflops"] = run_calibration(jax)
+            except Exception as e:  # auxiliary — never abort the bench
+                record["calibration_error"] = f"{type(e).__name__}: {e}"
         if on_tpu:
             # Qwen3-1.7B shapes, 32-way continuous batch, 1 KiB-token
             # contexts: ~3.4 GiB weights + KV pages on a 16 GiB v5e chip.
