@@ -33,3 +33,23 @@ if not _ON_TPU_TIER:
     jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def nonzero_adapter(cfg, rank=4, seed=7, scale=2.0):
+    """A LoRA adapter whose deltas actually change output —
+    ``init_adapter``'s b=0 is an exact no-op by design, so tests that
+    need a behavioral adapter fill each projection's ``b`` with small
+    noise in the engine's dtype.  Shared here so every suite builds the
+    SAME adapter recipe (was copied in three places)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fusioninfer_tpu.models.lora import LORA_PROJS, init_adapter
+
+    adapter = init_adapter(cfg, rank, jax.random.key(seed), scale=scale)
+    keys = jax.random.split(jax.random.key(seed + 1), len(LORA_PROJS))
+    for k, proj in zip(keys, LORA_PROJS):
+        adapter[proj]["b"] = (jax.random.normal(
+            k, adapter[proj]["b"].shape, jnp.float32) * 0.05).astype(
+            cfg.jax_dtype)
+    return adapter

@@ -330,6 +330,49 @@ class TestBurstPipelining:
         assert engine.kv_cache_usage() == 0.0
 
 
+class TestBurstComposition:
+    """Bursting must compose with the rest of the serving matrix: LoRA
+    adapter rows (adapter_ids ride the packed ctl) and int8 KV pages
+    (quantized scatter/gather inside the scan) — token-identical to the
+    classic engine in every combination, pipelined included."""
+
+    def test_burst_lora_identity(self):
+        import dataclasses
+
+        from tests.conftest import nonzero_adapter
+
+        cfg = dataclasses.replace(CFG, dtype="float32",
+                                  attn_impl="reference")
+        adapter = nonzero_adapter(cfg)
+
+        def reqs():
+            return [
+                Request("base", [2, 4, 6], SamplingParams(
+                    temperature=0.0, max_tokens=12)),
+                Request("tuned", [2, 4, 6], SamplingParams(
+                    temperature=0.0, max_tokens=12), lora="ft"),
+            ]
+
+        base, _ = collect(1, reqs(), cfg=cfg,
+                          lora_adapters={"ft": adapter})
+        burst, fins = collect(8, reqs(), cfg=cfg,
+                              lora_adapters={"ft": adapter})
+        assert burst == base
+        assert set(fins) == {"base", "tuned"}
+        # the adapter must actually change the tuned stream
+        assert burst["base"] != burst["tuned"]
+
+    def test_burst_int8_kv_identity(self):
+        int8 = CacheConfig(n_pages=64, page_size=8, max_pages_per_seq=8,
+                           kv_dtype="int8")
+        reqs = lambda: [Request("q", [2, 4, 6, 8], SamplingParams(
+            temperature=0.0, max_tokens=20))]
+        base, fb = collect(1, reqs(), cache=int8)
+        burst, fbu = collect(8, reqs(), cache=int8)
+        assert burst == base
+        assert fbu == fb
+
+
 class TestAdmissionFastPath:
     """The fused first-token call (sampler.sample_first) must be
     bit-identical to the legacy ~14-op admission sequence.  A zero

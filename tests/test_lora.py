@@ -31,13 +31,11 @@ CACHE = CacheConfig(n_pages=65, page_size=8, max_pages_per_seq=8)
 
 
 def nonzero_adapter(rank=4, seed=7, scale=2.0):
-    """An adapter with non-trivial B so its deltas actually change output."""
-    adapter = init_adapter(CFG, rank, jax.random.key(seed), scale=scale)
-    keys = jax.random.split(jax.random.key(seed + 1), len(LORA_PROJS))
-    for k, proj in zip(keys, LORA_PROJS):
-        adapter[proj]["b"] = jax.random.normal(
-            k, adapter[proj]["b"].shape, jnp.float32) * 0.05
-    return adapter
+    """An adapter with non-trivial B so its deltas actually change
+    output (shared recipe: tests/conftest.py)."""
+    from tests.conftest import nonzero_adapter as _shared
+
+    return _shared(CFG, rank=rank, seed=seed, scale=scale)
 
 
 def merged_params(params, adapter):

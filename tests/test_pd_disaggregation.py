@@ -212,19 +212,9 @@ def test_pd_lora_over_the_wire():
     """LoRA rides the PD wire (r5): the prefiller prefills under the
     adapter's deltas, the decoder decodes under them — tokens identical
     to a monolithic adapter run, and distinct from the base model's."""
-    import jax as _jax
-    import jax.numpy as _jnp
+    from tests.conftest import nonzero_adapter
 
-    from fusioninfer_tpu.models.lora import LORA_PROJS, init_adapter
-
-    # init_adapter's b=0 is an exact no-op by design — fill b so the
-    # deltas actually change tokens, in the ENGINE's dtype (a foreign
-    # dtype would break the scan carry)
-    adapter = init_adapter(CFG, rank=4, key=_jax.random.key(5), scale=2.0)
-    for i, proj in enumerate(LORA_PROJS):
-        adapter[proj]["b"] = (_jax.random.normal(
-            _jax.random.key(100 + i), adapter[proj]["b"].shape,
-            _jnp.float32) * 0.05).astype(CFG.jax_dtype)
+    adapter = nonzero_adapter(CFG, seed=5)
     prompt = [3, 1, 4, 1, 5, 9, 2, 6]
     sp = lambda: SamplingParams(temperature=0.0, max_tokens=6)  # noqa: E731
 
