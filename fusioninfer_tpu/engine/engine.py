@@ -1392,7 +1392,8 @@ class NativeEngine:
     def _sample_first_token(self, logits: jax.Array, request: Request,
                             prefix: list[int], seed: int,
                             n_prompt: Optional[int] = None,
-                            machine=None, return_state: bool = False):
+                            machine=None, return_state: bool = False,
+                            defer_fetch: bool = False):
         """Sample a prefill's first token with full per-request sampling
         semantics (repetition penalty over the whole prefix,
         presence/frequency over previously *generated* tokens only, stop
@@ -1431,7 +1432,9 @@ class NativeEngine:
                 logits, jnp.asarray(padded), jnp.asarray(ctl_i),
                 jnp.asarray(ctl_f), jnp.asarray(sids),
                 mode=self._sample_mode((p,)))
-            token = int(tok_d)
+            # defer_fetch: hand back the DEVICE scalar so a group
+            # admission path can fetch the whole group in one transfer
+            token = tok_d if defer_fetch else int(tok_d)
             if return_state:
                 return token, (counts_row, out_row, sup_row)
             return token
@@ -1601,16 +1604,9 @@ class NativeEngine:
                 self.alloc.release(request.request_id)
                 outputs.append(self._fail_admission(request, e))
             return outputs
-        outputs = []
-        for i, (request, prefix, resumed, reused) in enumerate(items):
-            try:
-                outputs.append(self._activate(
-                    request, prefix, resumed, logits[i][None]))
-            except Exception as e:
-                logger.exception("activation of %s failed", request.request_id)
-                self.alloc.release(request.request_id)
-                outputs.append(self._fail_admission(request, e))
-        return outputs
+        return self._activate_group(
+            [(request, prefix, resumed, logits[i][None])
+             for i, (request, prefix, resumed, reused) in enumerate(items)])
 
     def _advance_prefilling(self) -> list[StepOutput]:
         """Advance EVERY mid-prefill sequence one chunk per step in one
@@ -1667,20 +1663,14 @@ class NativeEngine:
                 self.alloc.release(st.request.request_id)
                 outputs.append(self._fail_admission(st.request, e))
             return outputs
-        outputs = []
+        done = []
         for i, st in enumerate(take):
             st.pos += chunks[i]
             if st.pos == len(st.prefix):
                 self.prefilling.remove(st)
-                try:
-                    outputs.append(self._activate(
-                        st.request, st.prefix, st.resumed, logits[i][None]))
-                except Exception as e:
-                    logger.exception("activation of %s failed",
-                                     st.request.request_id)
-                    self.alloc.release(st.request.request_id)
-                    outputs.append(self._fail_admission(st.request, e))
-        return outputs
+                done.append((st.request, st.prefix, st.resumed,
+                             logits[i][None]))
+        return self._activate_group(done) if done else []
 
     def _prefill_fresh_group(
         self, bucket: int, items: list[tuple[Request, list[int], bool]]
@@ -1719,23 +1709,67 @@ class NativeEngine:
                 self.alloc.release(request.request_id)
                 outputs.append(self._fail_admission(request, e))
             return outputs
-        outputs = []
-        for i, (request, prefix, resumed) in enumerate(items):
-            try:
-                outputs.append(
-                    self._activate(request, prefix, resumed, logits[i : i + 1])
-                )
-            except Exception as e:
-                logger.exception("activation of %s failed", request.request_id)
-                self.alloc.release(request.request_id)
-                outputs.append(self._fail_admission(request, e))
-        return outputs
+        return self._activate_group(
+            [(request, prefix, resumed, logits[i : i + 1])
+             for i, (request, prefix, resumed) in enumerate(items)])
 
     def _activate(self, request: Request, prefix: list[int], resumed: bool,
                   logits: jax.Array) -> StepOutput:
         """Shared post-prefill tail: sample the first token with the
         request's full sampling semantics, claim a batch slot, register
         device-side sampling state, emit."""
+        return self._activate_finish(
+            self._activate_begin(request, prefix, resumed, logits))
+
+    def _activate_group(self, entries) -> list[StepOutput]:
+        """Activate a whole admission group with ONE blocking first-token
+        fetch.  ``entries``: ``[(request, prefix, resumed, logits_row)]``
+        (``logits_row`` shaped [1, V]).  Each request's sampling
+        dispatches asynchronously (``_activate_begin``); the pending
+        device tokens then stack into a single transfer — on a
+        remote-attached chip the per-admission blocking round trip was
+        the dominant admission cost after the fused sample_first call.
+        Per-request failures fail that admission only."""
+        outputs: list[StepOutput] = []
+        ctxs: list[dict] = []
+        for request, prefix, resumed, logits_row in entries:
+            try:
+                ctxs.append(self._activate_begin(
+                    request, prefix, resumed, logits_row))
+            except Exception as e:
+                logger.exception("activation of %s failed",
+                                 request.request_id)
+                self.alloc.release(request.request_id)
+                outputs.append(self._fail_admission(request, e))
+        pend = [c for c in ctxs if c["token"] is None]
+        if pend:
+            try:
+                toks = np.asarray(jnp.stack([c["tok_dev"] for c in pend]))
+                for c, t in zip(pend, toks):
+                    c["token"] = int(t)
+            except Exception as e:
+                logger.exception("group first-token fetch failed")
+                for c in pend:
+                    self.alloc.release(c["request"].request_id)
+                    outputs.append(self._fail_admission(c["request"], e))
+                ctxs = [c for c in ctxs if c["token"] is not None]
+        for c in ctxs:
+            try:
+                outputs.append(self._activate_finish(c))
+            except Exception as e:
+                logger.exception("activation of %s failed",
+                                 c["request"].request_id)
+                self.alloc.release(c["request"].request_id)
+                outputs.append(self._fail_admission(c["request"], e))
+        return outputs
+
+    def _activate_begin(self, request: Request, prefix: list[int],
+                        resumed: bool, logits: jax.Array) -> dict:
+        """Dispatch half of activation: everything up to (and including)
+        the first-token sampling DISPATCH, without the blocking fetch.
+        Group admission paths call this for every request, fetch all the
+        pending device tokens in ONE transfer, then finish each — one
+        round trip per admission GROUP instead of per admission."""
         rid = request.request_id
         if self.prefix_caching:
             self.alloc.register_blocks(rid, prefix,
@@ -1750,7 +1784,32 @@ class NativeEngine:
                 self._masker.advance_token(machine, t)
         token, samp_state = self._sample_first_token(
             logits, request, prefix, seq_seed,
-            n_prompt=n_prompt, machine=machine, return_state=True)
+            n_prompt=n_prompt, machine=machine, return_state=True,
+            defer_fetch=True)
+        # positive detection: only a device scalar is a deferred fetch
+        # (the legacy branch always returns a host int)
+        deferred = isinstance(token, jax.Array)
+        return {"request": request, "prefix": prefix, "resumed": resumed,
+                "logits": logits, "machine": machine,
+                "seq_seed": seq_seed, "n_prompt": n_prompt,
+                "samp_state": samp_state,
+                "token": None if deferred else token,
+                "tok_dev": token if deferred else None}
+
+    def _activate_finish(self, ctx: dict) -> StepOutput:
+        """Fetch half of activation: claim the slot, install device
+        sampling state, emit the first token."""
+        if ctx["token"] is None:
+            ctx["token"] = int(np.asarray(ctx["tok_dev"]))
+        request = ctx["request"]
+        prefix = ctx["prefix"]
+        resumed = ctx["resumed"]
+        logits = ctx["logits"]
+        machine = ctx["machine"]
+        seq_seed = ctx["seq_seed"]
+        n_prompt = ctx["n_prompt"]
+        samp_state = ctx["samp_state"]
+        token = ctx["token"]
         force_finish = (self._guided_advance(machine, token)
                         if machine is not None else None)
         lp = tops = None
@@ -1772,15 +1831,24 @@ class NativeEngine:
             first_token_time=time.monotonic(),
             guided=machine,
         )
-        self._register_slot(slot, state.tokens, n_prompt, request.params,
-                            state=samp_state)
-        self.running[slot] = state
-        if not resumed:
-            self.prompt_tokens_total += len(prefix)
-        self.generation_tokens_total += 1
-        return self._emit(state, token, first=not resumed,
-                          logprob=lp, top_logprobs=tops,
-                          force_finish=force_finish)
+        try:
+            self._register_slot(slot, state.tokens, n_prompt, request.params,
+                                state=samp_state)
+            self.running[slot] = state
+            if not resumed:
+                self.prompt_tokens_total += len(prefix)
+            self.generation_tokens_total += 1
+            return self._emit(state, token, first=not resumed,
+                              logprob=lp, top_logprobs=tops,
+                              force_finish=force_finish)
+        except Exception:
+            # transactional: a failure past the slot claim must not
+            # leak the slot or leave a running entry whose pages the
+            # caller's failure path is about to release to someone else
+            self.running.pop(slot, None)
+            if slot not in self._free_slots:
+                self._free_slots.append(slot)
+            raise
 
     # -- decode --------------------------------------------------------------
 

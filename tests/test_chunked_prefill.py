@@ -244,16 +244,18 @@ class TestLifecycle:
             CFG, cache_cfg=_cache_cfg(), max_batch_size=2,
             prefill_chunk_size=16,
         )
-        orig_activate = engine._activate
+        # patch the shared dispatch half: _activate and _activate_group
+        # both route through it
+        orig_begin = engine._activate_begin
         boom = {"armed": True}
 
         def flaky(request, prefix, resumed, logits):
             if boom["armed"]:
                 boom["armed"] = False
                 raise RuntimeError("injected activation failure")
-            return orig_activate(request, prefix, resumed, logits)
+            return orig_begin(request, prefix, resumed, logits)
 
-        engine._activate = flaky
+        engine._activate_begin = flaky
         for i in range(2):
             engine.add_request(Request(
                 request_id=f"p{i}",

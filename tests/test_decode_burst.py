@@ -330,6 +330,42 @@ class TestBurstPipelining:
         assert engine.kv_cache_usage() == 0.0
 
 
+class TestActivationTransactionality:
+    def test_finish_failure_releases_slot_and_pages(self):
+        """A failure past the slot claim (inside _emit) must roll the
+        slot and running entry back before the group path releases the
+        request's pages — otherwise the released pages would be handed
+        to a later admission while a zombie running entry still decodes
+        into them, and the slot would leak forever."""
+        engine = make_engine(1)
+        orig_emit = engine._emit
+        boom = {"armed": True}
+
+        def flaky(state, token, **kw):
+            if boom["armed"] and state.request.request_id == "bad":
+                boom["armed"] = False
+                raise RuntimeError("injected emit failure")
+            return orig_emit(state, token, **kw)
+
+        engine._emit = flaky
+        free0 = engine.alloc.free_pages
+        engine.add_request(Request("bad", [1, 2, 3], SamplingParams(
+            temperature=0.0, max_tokens=4)))
+        engine.add_request(Request("ok", [4, 5, 6], SamplingParams(
+            temperature=0.0, max_tokens=4)))
+        outs, fins = run_to_completion(engine)
+        assert fins["bad"].startswith("error")
+        assert fins["ok"] == "length" and len(outs["ok"]) == 4
+        assert engine.alloc.free_pages == free0
+        # no slot leak: a full batch still admits and completes
+        for i in range(4):
+            engine.add_request(Request(f"r{i}", [7 + i], SamplingParams(
+                temperature=0.0, max_tokens=2)))
+        _, fins2 = run_to_completion(engine)
+        assert len(fins2) == 4
+        assert all(r == "length" for r in fins2.values())
+
+
 class TestBurstComposition:
     """Bursting must compose with the rest of the serving matrix: LoRA
     adapter rows (adapter_ids ride the packed ctl) and int8 KV pages
