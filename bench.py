@@ -787,14 +787,20 @@ def main() -> None:
             # serve with whichever attention impl the decode phase proved out
             http_cfg = dataclasses.replace(base_cfg, attn_impl=impl_used)
             if on_tpu:
-                http_cache = CacheConfig(n_pages=16 * 10 + 1, page_size=128,
+                # serving config sized to the chip: batch 32 (the raw
+                # decode leg's batch) with closed-loop concurrency 32 so
+                # the continuous batch can actually fill, pool ~4.7 GiB
+                # beside ~3.4 GiB of weights on a 16 GiB v5e — round 5's
+                # decode burst + pipelining make the serving loop
+                # chip-bound enough to feed it
+                http_cache = CacheConfig(n_pages=32 * 10 + 1, page_size=128,
                                          max_pages_per_seq=10)
                 # chunked prefill is the shipped serving config: a long
                 # prompt must not stall every stream's inter-token latency
                 chunk = 512
                 record["http"] = run_http(
-                    http_cfg, max_batch_size=16, cache_cfg=http_cache,
-                    n_requests=48, concurrency=12,
+                    http_cfg, max_batch_size=32, cache_cfg=http_cache,
+                    n_requests=64, concurrency=32,
                     max_prompt=1024, max_output=128,
                     prefill_chunk=chunk,
                 )
