@@ -432,12 +432,29 @@ def _median_iqr(vals: list[float]) -> dict:
 _DECODE_REPS = 3  # timed windows per decode measurement
 
 
+def decode_tokens_needed(start: int, warmup: int, steps: int,
+                         reps: int = _DECODE_REPS) -> int:
+    """Tokens one batch row consumes in ``run_decode`` (context start +
+    warmup + timed steps + the token written on the last step).  The ONE
+    definition both run_decode's allocation and callers' pool sizing use
+    — an exact-fit pool goes stale silently otherwise."""
+    return start + warmup + steps * reps + 1
+
+
 def run_decode(jax, cfg, batch: int, cache_cfg, prefix_len: int,
-               warmup: int, steps: int, reps: int = _DECODE_REPS) -> dict:
+               warmup: int, steps: int, reps: int = _DECODE_REPS,
+               prefix_lens: list[int] | None = None) -> dict:
     """Timed decode: ``reps`` back-to-back windows of ``steps`` steps
     after one warmup, reported as median tokens/sec with the rep values
     and IQR in-record — a single 16-step window made the r4 −25% swing
-    unfalsifiable (VERDICT r4 weak #1)."""
+    unfalsifiable (VERDICT r4 weak #1).
+
+    ``prefix_lens`` (one per batch row) makes the batch RAGGED — the
+    continuous-batching production shape, where each slot sits at its
+    own context depth.  The gather path always reads (and materializes)
+    all ``max_pages_per_seq`` pages per row; the paged kernel reads only
+    each row's live pages, so raggedness is exactly where paging earns
+    its keep."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -459,33 +476,36 @@ def run_decode(jax, cfg, batch: int, cache_cfg, prefix_len: int,
         params = jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
     cache = init_kv_cache(cfg, cache_cfg)
 
+    starts = prefix_lens if prefix_lens is not None else [prefix_len] * batch
+    assert len(starts) == batch
     alloc = PageAllocator(cache_cfg)
     tables = np.zeros((batch, cache_cfg.max_pages_per_seq), np.int32)
     for i in range(batch):
-        alloc.allocate(str(i), prefix_len + warmup + steps * reps + 1)
+        alloc.allocate(str(i), decode_tokens_needed(starts[i], warmup,
+                                                    steps, reps))
         tables[i] = alloc.page_table_row(str(i))
     page_tables = jnp.asarray(tables)
     active = jnp.ones((batch,), bool)
+    base_pos = jnp.asarray(starts, jnp.int32)
     rng = np.random.default_rng(0)
 
-    def one_step(cache, pos):
+    def one_step(cache, off):
         tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, batch, dtype=np.int32))
-        positions = jnp.full((batch,), pos, jnp.int32)
-        return decode_step(cfg, cache_cfg, params, cache, tokens, positions,
-                           page_tables, active)
+        return decode_step(cfg, cache_cfg, params, cache, tokens,
+                           base_pos + off, page_tables, active)
 
-    pos = prefix_len
+    off = 0
     for _ in range(warmup):
-        cache, logits = one_step(cache, pos)
-        pos += 1
+        cache, logits = one_step(cache, off)
+        off += 1
     jax.block_until_ready(logits)
 
     vals = []
     for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(steps):
-            cache, logits = one_step(cache, pos)
-            pos += 1
+            cache, logits = one_step(cache, off)
+            off += 1
         jax.block_until_ready(logits)
         vals.append(batch * steps / (time.perf_counter() - t0))
     d = _median_iqr(vals)
@@ -746,6 +766,43 @@ def main() -> None:
             except Exception as e:
                 decode["kernel_int8kv_error"] = (
                     f"{type(e).__name__}: {str(e)[:400]}")
+            # long-context ragged leg: stratified 256..2048-token contexts
+            # (the continuous-batching steady state).  The bench's base
+            # shape (uniform ~200-token contexts, 8-page tables) hides
+            # the paged kernel's point — there, attention is a sliver of
+            # step time and kernel ≈ gather (r5 first record: 0.997).
+            # With 16-page tables and ragged depths the gather path
+            # materializes 2048 tokens/row for every row while the
+            # kernel streams only live pages.
+            lc_steps, lc_ps, lc_mp = 64, 128, 16
+            tail = decode_tokens_needed(0, warmup, lc_steps)
+            lens = [256 + (lc_ps * lc_mp - 256 - tail) * i // (batch - 1)
+                    for i in range(batch)]
+            # pool sized to actual need (not batch×16 pages): a fully
+            # provisioned 16-page × 32-row pool is ~7.5 GiB of KV at
+            # this model's [KV=8, Hd=128] × 28 layers
+            need = sum(-(-(ln + tail) // lc_ps) for ln in lens) + 1
+            long_cache = CacheConfig(n_pages=need, page_size=lc_ps,
+                                     max_pages_per_seq=lc_mp)
+            # one try per impl: a kernel failure must still leave the
+            # gather baseline (same isolation as the base legs)
+            for impl, key in (("flash", "longctx_kernel"),
+                              ("reference", "longctx_gather")):
+                try:
+                    r = run_decode(
+                        jax, dataclasses.replace(base_cfg, attn_impl=impl),
+                        batch, long_cache, 0, warmup, lc_steps,
+                        prefix_lens=lens)
+                    decode[f"{key}_tok_s"] = round(r["tok_s"], 2)
+                    decode[f"{key}_dispersion"] = r
+                except Exception as e:
+                    decode[f"{key}_error"] = (
+                        f"{type(e).__name__}: {str(e)[:400]}")
+            if decode.get("longctx_gather_tok_s") and \
+                    decode.get("longctx_kernel_tok_s"):
+                decode["longctx_kernel_speedup"] = round(
+                    decode["longctx_kernel_tok_s"]
+                    / decode["longctx_gather_tok_s"], 3)
         else:
             from fusioninfer_tpu.ops import dispatch
 

@@ -202,3 +202,24 @@ class TestSignificance:
         bench.longitudinal(record, tmp_path)
         assert record["vs_prev"] == 0.5
         assert "vs_prev_significant" not in record
+
+
+class TestRaggedDecode:
+    def test_ragged_prefix_lens_decode(self):
+        """run_decode's ragged mode (the long-context TPU leg, r5): every
+        batch row decodes from its own context depth; throughput must be
+        finite and the allocator must fit the stratified lengths."""
+        import jax
+
+        import bench as bench_mod
+        from fusioninfer_tpu.engine.kv_cache import CacheConfig
+        from fusioninfer_tpu.models.config import get_preset
+
+        cfg = get_preset("qwen3-tiny")
+        lens = [16, 40, 70, 100]
+        tail = bench_mod.decode_tokens_needed(0, 1, 4, reps=1)
+        need = sum(-(-(ln + tail) // 64) for ln in lens) + 1
+        cc = CacheConfig(n_pages=need, page_size=64, max_pages_per_seq=4)
+        r = bench_mod.run_decode(jax, cfg, 4, cc, 0, 1, 4, reps=1,
+                                 prefix_lens=lens)
+        assert r["tok_s"] > 0
