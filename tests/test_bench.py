@@ -217,9 +217,9 @@ class TestRaggedDecode:
 
         cfg = get_preset("qwen3-tiny")
         lens = [16, 40, 70, 100]
-        tail = bench_mod.decode_tokens_needed(0, 1, 4, reps=1)
-        need = sum(-(-(ln + tail) // 64) for ln in lens) + 1
-        cc = CacheConfig(n_pages=need, page_size=64, max_pages_per_seq=4)
+        cc = CacheConfig(
+            n_pages=bench_mod.decode_pool_pages(lens, 1, 4, 64, reps=1),
+            page_size=64, max_pages_per_seq=4)
         r = bench_mod.run_decode(jax, cfg, 4, cc, 0, 1, 4, reps=1,
                                  prefix_lens=lens)
         assert r["tok_s"] > 0
