@@ -57,6 +57,26 @@ def kernel_interpret() -> bool:
     return not is_tpu_backend()
 
 
+def decode_coalesce() -> bool:
+    """Decode-kernel variant gate: True = one program per sequence with a
+    single [KV, ps, Hd] DMA per page (KV× fewer DMA issues); False = the
+    per-(sequence, head) grid.  Both compute identical per-row math.
+    Default True: measured on the v5e chip (readback-synced, Qwen3-1.7B
+    batch 32), coalescing decodes +10% at ~200-token contexts and +28%
+    at ragged 256..1850-token contexts (full-model tok/s, rel_iqr ≤3%).
+    ``FUSIONINFER_DECODE_COALESCE=0/1`` overrides; read at trace time and
+    latched into the jit cache like the rest of dispatch."""
+    v = os.environ.get("FUSIONINFER_DECODE_COALESCE", "")
+    if not v:
+        return True
+    if v not in ("0", "1"):
+        # loud like resolve_attn's unknown-impl error: a typo'd knob must
+        # not silently run the default on both arms of an A/B
+        raise ValueError(
+            f"FUSIONINFER_DECODE_COALESCE must be '0' or '1', got {v!r}")
+    return v == "1"
+
+
 def flash_seq_ok(seq_len: int) -> bool:
     """Flash tiles need the sequence to divide into full blocks; the
     engine's power-of-two prefill buckets always satisfy this."""

@@ -12,14 +12,23 @@ Equivalent capability in the reference is vLLM's CUDA PagedAttention,
 which FusionInfer only orchestrates (SURVEY §0); here it is an in-repo
 TPU kernel.
 
-Layout: pages are **head-major** ``[KV, n_pages, page_size, Hd]``; grid
-``(B, KV)``; the ``G = H // KV`` query heads of a group attend together
-so each KV page is read once per group.  Head-major matters for Mosaic:
-the per-(sequence, kv-head) DMA ``k_pages.at[g, page]`` slices only
-*leading* dims, so every copy is a whole ``[page_size, Hd]`` tile of the
-(8,128)-tiled memref.  The previous ``[n_pages, ps, KV, Hd]`` layout
-sliced the tiled second-to-minor dim to width 1 per head, which Mosaic
-rejects ("Slice shape along dimension 2 must be aligned to tiling (8)").
+Layout: pages are **head-major** ``[KV, n_pages, page_size, Hd]``.  Two
+decode grids share the math (``dispatch.decode_coalesce`` picks; default
+coalesced):
+
+* **coalesced** (default): grid ``(B,)`` — one program per sequence
+  DMAs each page once for ALL KV heads (``k_pages.at[:, page]`` →
+  ``[KV, ps, Hd]``, slot scratch ``[2, KV, ps, Hd]``).  KV× fewer DMA
+  issues; measured +10%/+28% full-model decode at short/ragged contexts.
+* **per-head**: grid ``(B, KV)`` — the ``G = H // KV`` query heads of a
+  group attend together, one ``[ps, Hd]`` copy per (sequence, head).
+
+Head-major matters for Mosaic either way: both DMAs
+(``.at[g, page]`` and ``.at[:, page]``) slice only *leading* dims, so
+every copy is whole ``[page_size, Hd]`` tiles of the (8,128)-tiled
+memref.  The previous ``[n_pages, ps, KV, Hd]`` layout sliced the tiled
+second-to-minor dim to width 1 per head, which Mosaic rejects ("Slice
+shape along dimension 2 must be aligned to tiling (8)").
 """
 
 from __future__ import annotations
@@ -117,6 +126,125 @@ def _weighted_values(pexp, v, v_scale):
     )
 
 
+def _coalesced_specs_scratch(KV, page_size, Hd, k_dtype, v_dtype, quantized):
+    """in_specs + scratch for the coalesced decode kernel: page buffers
+    carry ALL KV heads of one page per slot, so a slot is one DMA."""
+    page_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (4 if quantized else 2)
+    scratch = [
+        pltpu.VMEM((2, KV, page_size, Hd), k_dtype),
+        pltpu.VMEM((2, KV, page_size, Hd), v_dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((2, KV, 1, page_size), jnp.float32),
+            pltpu.VMEM((2, KV, 1, page_size), jnp.float32),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 4 if quantized else 2)))
+    return page_specs, scratch
+
+
+def _paged_kernel_coalesced(
+    # scalar prefetch
+    page_tables_ref,  # [B, mp] int32 (SMEM)
+    lengths_ref,  # [B] int32 — context length incl. the current token
+    # inputs: q_ref [1, KV, G, Hd] VMEM block; k/v pages [KV, n_pages,
+    # ps, Hd] in ANY; when quantized, scale refs [KV, n_pages, 1, ps]
+    q_ref,
+    k_pages_ref,
+    v_pages_ref,
+    *rest,
+    max_pages: int,
+    page_size: int,
+    sm_scale: float,
+    quantized: bool,
+    window: int | None,
+):
+    """Decode attention, grid ``(B,)``: ONE program per sequence covers
+    every KV head, so each page costs one ``[KV, ps, Hd]`` DMA instead of
+    the per-(sequence, head) kernel's KV separate ``[ps, Hd]`` copies.
+    The grid kernel's page loop is DMA-issue-bound at decode shapes (the
+    per-page matmuls are tiny); issuing 1/KV as many, KV× larger copies
+    amortizes that.  MXU cost is unchanged — the per-head ``[G, ps]``
+    score dots pad to the same 8×128 tile either way."""
+    scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
+        rest, quantized)
+    ks_buf, vs_buf = scale_bufs if quantized else (None, None)
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_used = pl.cdiv(length, page_size)
+    first = (jnp.maximum(length - window, 0) // page_size
+             if window is not None else 0)
+
+    def dma(slot, p):
+        page = page_tables_ref[b, p]
+        copies = [
+            pltpu.make_async_copy(
+                k_pages_ref.at[:, page], k_buf.at[slot], sem.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                v_pages_ref.at[:, page], v_buf.at[slot], sem.at[slot, 1]
+            ),
+        ]
+        if quantized:
+            ks_ref, vs_ref = scale_refs
+            copies += [
+                pltpu.make_async_copy(
+                    ks_ref.at[:, page], ks_buf.at[slot], sem.at[slot, 2]
+                ),
+                pltpu.make_async_copy(
+                    vs_ref.at[:, page], vs_buf.at[slot], sem.at[slot, 3]
+                ),
+            ]
+        return copies
+
+    @pl.when(n_used > 0)
+    def _start_first():
+        for c in dma(first % 2, first):
+            c.start()
+
+    KV, G, Hd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    R = KV * G
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [KV, G, Hd]
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = p % 2
+
+        @pl.when(p + 1 < n_used)
+        def _prefetch_next():
+            for c in dma((p + 1) % 2, p + 1):
+                c.start()
+
+        for c in dma(slot, p):
+            c.wait()
+        s = jnp.concatenate(
+            [_scores(q[g], k_buf[slot, g],
+                     ks_buf[slot, g] if quantized else None)
+             for g in range(KV)], axis=0)  # [R, ps]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        s = jnp.where(attend(length - 1, pos, window), s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        pexp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(pexp, axis=1, keepdims=True)
+        pv = jnp.concatenate(
+            [_weighted_values(pexp[g * G:(g + 1) * G], v_buf[slot, g],
+                              vs_buf[slot, g] if quantized else None)
+             for g in range(KV)], axis=0)  # [R, Hd]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((R, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((R, 1), jnp.float32)
+    a0 = jnp.zeros((R, Hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(first, n_used, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(
+        o_ref.dtype).reshape(KV, G, Hd)
+
+
 def _paged_kernel(
     # scalar prefetch
     page_tables_ref,  # [B, mp] int32 (SMEM)
@@ -197,7 +325,7 @@ def _paged_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret", "window")
+    jax.jit, static_argnames=("sm_scale", "interpret", "window", "coalesce")
 )
 def paged_decode_attention(
     q: jax.Array,  # [B, H, Hd] — one query token per sequence
@@ -211,6 +339,7 @@ def paged_decode_attention(
     sm_scale: float | None = None,
     interpret: bool = False,
     window: int | None = None,
+    coalesce: bool | None = None,
 ) -> jax.Array:
     """Batched one-token attention over paged KV → [B, H·Hd].
 
@@ -219,6 +348,10 @@ def paged_decode_attention(
     kernel streams them alongside the pages and folds dequantization
     into the score/probability matrices.  ``window``: Mistral-style
     sliding window — out-of-window pages are skipped, not just masked.
+    ``coalesce``: one program per sequence with one [KV, ps, Hd] DMA per
+    page (KV× fewer DMA issues) vs the per-(sequence, head) grid; both
+    compute identical math per row.  ``None`` defers to
+    :func:`fusioninfer_tpu.ops.dispatch.decode_coalesce`.
     """
     B, H, Hd = q.shape
     KV, _, page_size, _ = k_pages.shape
@@ -226,30 +359,55 @@ def paged_decode_attention(
     max_pages = page_tables.shape[1]
     sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
     quantized = k_scales is not None
+    if coalesce is None:
+        from fusioninfer_tpu.ops import dispatch
+
+        coalesce = dispatch.decode_coalesce()
 
     qg = q.reshape(B, KV, G, Hd)
 
-    page_specs, scratch = _page_specs_scratch(
-        page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, KV),
-        in_specs=[
-            pl.BlockSpec(
+    if coalesce:
+        page_specs, scratch = _coalesced_specs_scratch(
+            KV, page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, KV, G, Hd), lambda b, *_: (b, 0, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                *page_specs,
+            ],
+            out_specs=pl.BlockSpec(
+                (1, KV, G, Hd), lambda b, *_: (b, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=scratch,
+        )
+        body = _paged_kernel_coalesced
+    else:
+        page_specs, scratch = _page_specs_scratch(
+            page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, KV),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                *page_specs,
+            ],
+            out_specs=pl.BlockSpec(
                 (1, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            *page_specs,
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, G, Hd), lambda b, g, *_: (b, g, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        scratch_shapes=scratch,
-    )
+            scratch_shapes=scratch,
+        )
+        body = _paged_kernel
     kernel = functools.partial(
-        _paged_kernel,
+        body,
         max_pages=max_pages, page_size=page_size, sm_scale=sm_scale,
         quantized=quantized, window=window,
     )

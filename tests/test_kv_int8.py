@@ -298,7 +298,8 @@ class TestEngineInt8KV:
 
 
 class TestInt8WithSlidingWindow:
-    def test_windowed_quantized_decode_kernel(self):
+    @pytest.mark.parametrize("coalesce", [False, True])
+    def test_windowed_quantized_decode_kernel(self, coalesce):
         """Banding and scale folding compose: the page loop starts at the
         window's first live page AND streams int8 scale rows from the
         same offset."""
@@ -321,7 +322,7 @@ class TestInt8WithSlidingWindow:
         out = paged_decode_attention(
             q, k8, v8, jnp.asarray(tables), jnp.asarray(lengths),
             ksc[:, :, None, :], vsc[:, :, None, :],
-            window=24, interpret=True)
+            window=24, interpret=True, coalesce=coalesce)
         kd = k8.astype(jnp.float32) * ksc[..., None]
         vd = v8.astype(jnp.float32) * vsc[..., None]
         ref = reference_paged_attention(

@@ -1,6 +1,7 @@
 """Pallas paged decode + suffix-prefill attention vs gather oracles (interpret mode)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -30,32 +31,36 @@ def _setup(B=3, H=4, KV=2, Hd=64, n_pages=9, ps=16, mp=4, seed=0, dtype=jnp.floa
     return q, k_pages, v_pages, jnp.asarray(tables), jnp.asarray(lengths)
 
 
-def test_matches_gather_reference():
+@pytest.mark.parametrize("coalesce", [False, True])
+def test_matches_gather_reference(coalesce):
     q, kp, vp, tables, lengths = _setup()
-    out = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True)
+    out = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True, coalesce=coalesce)
     ref = reference_paged_attention(q, kp, vp, tables, lengths)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_inactive_slot_zero_output():
+@pytest.mark.parametrize("coalesce", [False, True])
+def test_inactive_slot_zero_output(coalesce):
     q, kp, vp, tables, lengths = _setup(B=2)
     lengths = jnp.asarray([0, 5], jnp.int32)
-    out = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True)
+    out = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True, coalesce=coalesce)
     assert np.allclose(np.asarray(out)[0], 0.0)
     ref = reference_paged_attention(q, kp, vp, tables, lengths)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_gqa_grouping():
+@pytest.mark.parametrize("coalesce", [False, True])
+def test_gqa_grouping(coalesce):
     q, kp, vp, tables, lengths = _setup(H=8, KV=2, seed=4)
-    out = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True)
+    out = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True, coalesce=coalesce)
     ref = reference_paged_attention(q, kp, vp, tables, lengths)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_bf16_pages():
+@pytest.mark.parametrize("coalesce", [False, True])
+def test_bf16_pages(coalesce):
     q, kp, vp, tables, lengths = _setup(dtype=jnp.bfloat16, seed=7)
-    out = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True)
+    out = paged_decode_attention(q, kp, vp, tables, lengths, interpret=True, coalesce=coalesce)
     ref = reference_paged_attention(q, kp, vp, tables, lengths)
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=4e-2, rtol=4e-2

@@ -12,6 +12,7 @@ token identity between the portable and kernel paths.
 import dataclasses
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -68,7 +69,8 @@ class TestPagedKernelsWindow:
         return (jax.random.normal(ks[0], (KV, n_pages, ps, Hd), jnp.float32),
                 jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.float32))
 
-    def test_decode_kernel_windowed(self):
+    @pytest.mark.parametrize("coalesce", [False, True])
+    def test_decode_kernel_windowed(self, coalesce):
         from fusioninfer_tpu.ops.paged_attention import (
             paged_decode_attention,
             reference_paged_attention,
@@ -83,7 +85,7 @@ class TestPagedKernelsWindow:
         for w in (8, 24, 64):
             out = paged_decode_attention(
                 q, kp, vp, jnp.asarray(tables), jnp.asarray(lengths),
-                window=w, interpret=True)
+                window=w, interpret=True, coalesce=coalesce)
             ref = reference_paged_attention(
                 q, kp, vp, jnp.asarray(tables), jnp.asarray(lengths), window=w)
             np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
