@@ -48,8 +48,12 @@ NEG_INF = -1e30
 def _page_dma(slot, g, page, k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
               scale_refs=None, scale_bufs=None):
     """Async copies for one page of K/V (+ their [1, ps] scale rows when
-    the cache is int8).  Head-major pages: slicing (g, page) squeezes two
-    leading dims and copies whole trailing tiles — Mosaic-clean."""
+    the cache is int8) — the ONE place the quantized operand/semaphore
+    layout lives for every grid.  Head-major pages: ``g`` is either a
+    head index (per-head grids: ``.at[g, page]`` squeezes two leading
+    dims) or ``slice(None)`` (coalesced grid: ``.at[:, page]`` copies
+    all KV heads at once); both slice only leading dims and copy whole
+    trailing tiles — Mosaic-clean."""
     copies = [
         pltpu.make_async_copy(
             k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
@@ -82,19 +86,23 @@ def _split_rest(rest, quantized):
     return None, o_ref, k_buf, v_buf, None, sem
 
 
-def _page_specs_scratch(page_size, Hd, k_dtype, v_dtype, quantized):
-    """(in_specs for page operands, scratch shapes) shared by the three
+def _page_specs_scratch(page_size, Hd, k_dtype, v_dtype, quantized,
+                        heads: int | None = None):
+    """(in_specs for page operands, scratch shapes) shared by ALL the
     paged kernels — quantized adds scale operands, scale buffers, and
-    two more DMA semaphores per slot."""
+    two more DMA semaphores per slot.  ``heads``: the coalesced grid
+    buffers all KV heads of a page per slot (``[2, KV, ps, Hd]``);
+    per-head grids pass None (``[2, ps, Hd]``)."""
+    lead = () if heads is None else (heads,)
     page_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (4 if quantized else 2)
     scratch = [
-        pltpu.VMEM((2, page_size, Hd), k_dtype),
-        pltpu.VMEM((2, page_size, Hd), v_dtype),
+        pltpu.VMEM((2, *lead, page_size, Hd), k_dtype),
+        pltpu.VMEM((2, *lead, page_size, Hd), v_dtype),
     ]
     if quantized:
         scratch += [
-            pltpu.VMEM((2, 1, page_size), jnp.float32),
-            pltpu.VMEM((2, 1, page_size), jnp.float32),
+            pltpu.VMEM((2, *lead, 1, page_size), jnp.float32),
+            pltpu.VMEM((2, *lead, 1, page_size), jnp.float32),
         ]
     scratch.append(pltpu.SemaphoreType.DMA((2, 4 if quantized else 2)))
     return page_specs, scratch
@@ -124,23 +132,6 @@ def _weighted_values(pexp, v, v_scale):
         pexp, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-
-
-def _coalesced_specs_scratch(KV, page_size, Hd, k_dtype, v_dtype, quantized):
-    """in_specs + scratch for the coalesced decode kernel: page buffers
-    carry ALL KV heads of one page per slot, so a slot is one DMA."""
-    page_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (4 if quantized else 2)
-    scratch = [
-        pltpu.VMEM((2, KV, page_size, Hd), k_dtype),
-        pltpu.VMEM((2, KV, page_size, Hd), v_dtype),
-    ]
-    if quantized:
-        scratch += [
-            pltpu.VMEM((2, KV, 1, page_size), jnp.float32),
-            pltpu.VMEM((2, KV, 1, page_size), jnp.float32),
-        ]
-    scratch.append(pltpu.SemaphoreType.DMA((2, 4 if quantized else 2)))
-    return page_specs, scratch
 
 
 def _paged_kernel_coalesced(
@@ -176,26 +167,10 @@ def _paged_kernel_coalesced(
              if window is not None else 0)
 
     def dma(slot, p):
-        page = page_tables_ref[b, p]
-        copies = [
-            pltpu.make_async_copy(
-                k_pages_ref.at[:, page], k_buf.at[slot], sem.at[slot, 0]
-            ),
-            pltpu.make_async_copy(
-                v_pages_ref.at[:, page], v_buf.at[slot], sem.at[slot, 1]
-            ),
-        ]
-        if quantized:
-            ks_ref, vs_ref = scale_refs
-            copies += [
-                pltpu.make_async_copy(
-                    ks_ref.at[:, page], ks_buf.at[slot], sem.at[slot, 2]
-                ),
-                pltpu.make_async_copy(
-                    vs_ref.at[:, page], vs_buf.at[slot], sem.at[slot, 3]
-                ),
-            ]
-        return copies
+        # g = slice(None): one copy covers every KV head of the page
+        return _page_dma(slot, slice(None), page_tables_ref[b, p],
+                         k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
+                         scale_refs, scale_bufs)
 
     @pl.when(n_used > 0)
     def _start_first():
@@ -367,8 +342,9 @@ def paged_decode_attention(
     qg = q.reshape(B, KV, G, Hd)
 
     if coalesce:
-        page_specs, scratch = _coalesced_specs_scratch(
-            KV, page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
+        page_specs, scratch = _page_specs_scratch(
+            page_size, Hd, k_pages.dtype, v_pages.dtype, quantized,
+            heads=KV)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B,),

@@ -54,10 +54,14 @@ def _paged_setup(B, H, KV, Hd, ps, n_pages, mp, lengths, dtype, seed=0):
 
 
 class TestPagedAttentionHW:
-    def test_bench_shapes_bf16(self):
+    @pytest.mark.parametrize("coalesce", [True, False])
+    def test_bench_shapes_bf16(self, coalesce):
         """The exact round-2 failure config: [257, ...] bf16 page pool,
         KV=8, Hd=128, ps=128 — must COMPILE (interpret=False) and match
-        the gather oracle."""
+        the gather oracle.  BOTH decode grids compile here: the default
+        coalesced (B,) grid and the per-head (B, KV) escape hatch
+        (FUSIONINFER_DECODE_COALESCE=0) — a Mosaic bump that breaks the
+        non-default grid must fail in this tier, not at serve time."""
         from fusioninfer_tpu.ops.paged_attention import (
             paged_decode_attention,
             reference_paged_attention,
@@ -68,7 +72,8 @@ class TestPagedAttentionHW:
         q, kp, vp, tables, ln = _paged_setup(
             B, H, KV, Hd, ps, n_pages, mp, lengths, jnp.bfloat16
         )
-        out = paged_decode_attention(q, kp, vp, tables, ln, interpret=False)
+        out = paged_decode_attention(q, kp, vp, tables, ln, interpret=False,
+                                     coalesce=coalesce)
         out.block_until_ready()
         ref = reference_paged_attention(q, kp, vp, tables, ln)
         np.testing.assert_allclose(
