@@ -818,6 +818,28 @@ def main() -> None:
             except Exception as e:
                 decode["kernel_int8kv_error"] = (
                     f"{type(e).__name__}: {str(e)[:400]}")
+            # fully-quantized serving config: int8 weights AND int8 KV
+            # pages (models/quantization.py end to end) — the composed
+            # speedup a quantized deployment actually gets.  Skipped
+            # when BENCH_MODEL already pins int8 weights: the "composed"
+            # datum would silently duplicate the int8-KV leg.
+            if base_cfg.quantization != "int8":
+                try:
+                    r = run_decode(
+                        jax,
+                        dataclasses.replace(base_cfg, attn_impl="flash",
+                                            quantization="int8"),
+                        batch,
+                        dataclasses.replace(cache_cfg, kv_dtype="int8"),
+                        prefix_len, warmup, steps)
+                    decode["kernel_int8w_int8kv_tok_s"] = round(
+                        r["tok_s"], 2)
+                    if decode.get("kernel_tok_s"):
+                        decode["int8w_int8kv_speedup"] = round(
+                            r["tok_s"] / decode["kernel_tok_s"], 3)
+                except Exception as e:
+                    decode["kernel_int8w_int8kv_error"] = (
+                        f"{type(e).__name__}: {str(e)[:400]}")
             # long-context ragged leg: stratified 256..2048-token contexts
             # (the continuous-batching steady state).  The bench's base
             # shape (uniform ~200-token contexts, 8-page tables) hides
