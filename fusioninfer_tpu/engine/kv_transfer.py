@@ -136,14 +136,22 @@ def inject_slab(cache: dict, slab: KVSlab, pages: list[int]) -> dict:
     elif cache_quant and not slab.quantized:
         k, k_scale = _quant_pages(k)
         v, v_scale = _quant_pages(v)
+    # all-advanced page scatter: basic slices BEFORE an advanced index
+    # (`.at[:, :, idx]`) make XLA transpose — i.e. fully copy — the
+    # destination pool per injection (see model_runner._scatter_kv);
+    # broadcasting (L, KV, page) index arrays keeps it in place
+    L, KV = cache["k"].shape[:2]
+    li = jnp.arange(L)[:, None, None]
+    kvi = jnp.arange(KV)[None, :, None]
+    pi = idx[None, None, :]
     out = {
-        "k": cache["k"].at[:, :, idx].set(k.astype(cache["k"].dtype)),
-        "v": cache["v"].at[:, :, idx].set(v.astype(cache["v"].dtype)),
+        "k": cache["k"].at[li, kvi, pi].set(k.astype(cache["k"].dtype)),
+        "v": cache["v"].at[li, kvi, pi].set(v.astype(cache["v"].dtype)),
     }
     if cache_quant:
-        out["k_scale"] = cache["k_scale"].at[:, :, idx].set(
+        out["k_scale"] = cache["k_scale"].at[li, kvi, pi].set(
             k_scale.astype(cache["k_scale"].dtype))
-        out["v_scale"] = cache["v_scale"].at[:, :, idx].set(
+        out["v_scale"] = cache["v_scale"].at[li, kvi, pi].set(
             v_scale.astype(cache["v_scale"].dtype))
     return out
 

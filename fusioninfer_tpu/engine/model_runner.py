@@ -37,68 +37,81 @@ from fusioninfer_tpu.models.transformer import (
 )
 
 
-def _cache_xs(params, lora, cache: dict, quantized: bool) -> tuple:
-    """Per-layer scan operands: weights (+ lora) + cache arrays (+ scale
-    arrays for int8 pages)."""
+def _layer_xs(cfg, params, lora) -> tuple:
+    """Per-layer scan operands: weights (+ lora) + the layer index.  The
+    KV cache is deliberately NOT xs: it rides the scan CARRY as one
+    donated stacked pool per array, updated in place by
+    :func:`_scatter_kv` — threading it through xs→ys made XLA write a
+    fresh cache-sized ys every step (a full pool copy per decode step;
+    measured step time scaled with pool size, round 5)."""
     xs = [params["layers"]]
     if lora is not None:
         xs.append(lora)
-    xs += [cache["k"], cache["v"]]
-    if quantized:
-        xs += [cache["k_scale"], cache["v_scale"]]
+    xs.append(jnp.arange(cfg.n_layers))
     return tuple(xs)
 
 
-def _cache_unpack(inputs, has_lora: bool, quantized: bool):
+def _layer_unpack(inputs, has_lora: bool):
     it = iter(inputs)
     layer = next(it)
     layer_lora = next(it) if has_lora else None
-    k_cache_l, v_cache_l = next(it), next(it)
-    ks_l = next(it) if quantized else None
-    vs_l = next(it) if quantized else None
-    return layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l
+    return layer, layer_lora, next(it)
 
 
-def _cache_result(scanned, quantized: bool) -> dict:
-    if quantized:
-        k_cache, v_cache, ks, vs = scanned
-        return {"k": k_cache, "v": v_cache, "k_scale": ks, "v_scale": vs}
-    k_cache, v_cache = scanned
-    return {"k": k_cache, "v": v_cache}
-
-
-def _scatter_kv(k, v, k_cache_l, v_cache_l, ks_l, vs_l,
-                write_page, write_slot, head_axis: int):
+def _scatter_kv(cache: dict, l, k, v, write_page, write_slot,
+                head_axis: int) -> dict:
     """Write fresh K/V (``[..., KV, Hd]`` with the head axis at
-    ``head_axis``) into head-major pages at the given page/slot maps,
-    quantizing on the way when the cache is int8 (per-token scales land
-    in the ``[KV, n_pages, 1, ps]`` scale arrays)."""
-    quantized = ks_l is not None
+    ``head_axis``) into layer ``l`` of the stacked head-major pools
+    ``[L, KV, n_pages, ps, Hd]`` IN PLACE, quantizing on the way when
+    the cache is int8 (per-token scales land in the
+    ``[L, KV, n_pages, 1, ps]`` scale arrays).
+
+    The index expression is load-bearing: a scalar basic ``l`` followed
+    by an ADJACENT block of advanced indices (kv-head rows, page map,
+    slot map) lowers to an in-place scatter on the donated pools.  The
+    previous per-layer ``.at[:, page, slot]`` form — a basic slice
+    BEFORE the advanced block — moves the advanced dims to the front,
+    which XLA implements as a transpose of the ENTIRE operand: measured
+    89 ms per 101 MB pool on CPU, and on the chip a full-cache copy per
+    layer per step (decode time scaled with pool size, not context)."""
+    quantized = "k_scale" in cache
     if quantized:
         k, k_s = kv_quantize(k)
         v, v_s = kv_quantize(v)
-    k_cache_l = k_cache_l.at[:, write_page, write_slot].set(
-        jnp.moveaxis(k, head_axis, 0)
-    )
-    v_cache_l = v_cache_l.at[:, write_page, write_slot].set(
-        jnp.moveaxis(v, head_axis, 0)
-    )
+    KV = cache["k"].shape[1]
+    kvr = jnp.arange(KV).reshape((KV,) + (1,) * write_page.ndim)
+    wp = write_page[None]
+    ws = write_slot[None]
+    out = dict(cache)
+    out["k"] = cache["k"].at[l, kvr, wp, ws].set(
+        jnp.moveaxis(k, head_axis, 0))
+    out["v"] = cache["v"].at[l, kvr, wp, ws].set(
+        jnp.moveaxis(v, head_axis, 0))
     if quantized:
-        # scatter via the squeezed [KV, n_pages, ps] view: the two fancy
-        # indices stay adjacent, matching the value scatter's layout
-        ks_l = ks_l[:, :, 0].at[:, write_page, write_slot].set(
-            jnp.moveaxis(k_s, head_axis, 0)
-        )[:, :, None, :]
-        vs_l = vs_l[:, :, 0].at[:, write_page, write_slot].set(
-            jnp.moveaxis(v_s, head_axis, 0)
-        )[:, :, None, :]
-    return k_cache_l, v_cache_l, ks_l, vs_l
+        # scatter via the squeezed [L, KV, n_pages, ps] view (a bitcast
+        # reshape) so the advanced block stays adjacent here too
+        out["k_scale"] = cache["k_scale"][:, :, :, 0].at[
+            l, kvr, wp, ws].set(
+            jnp.moveaxis(k_s, head_axis, 0))[:, :, :, None, :]
+        out["v_scale"] = cache["v_scale"][:, :, :, 0].at[
+            l, kvr, wp, ws].set(
+            jnp.moveaxis(v_s, head_axis, 0))[:, :, :, None, :]
+    return out
 
 
-def _layer_out(x, k_cache_l, v_cache_l, ks_l, vs_l):
-    if ks_l is not None:
-        return x, (k_cache_l, v_cache_l, ks_l, vs_l)
-    return x, (k_cache_l, v_cache_l)
+def _cache_layer(cache: dict, l):
+    """Materialize ONE layer's pools (portable/gather attention branch
+    only — the Pallas kernels read the stacked pools in place via their
+    ``layer`` operand and never pay this slice)."""
+    k_l = lax.dynamic_index_in_dim(cache["k"], l, 0, keepdims=False)
+    v_l = lax.dynamic_index_in_dim(cache["v"], l, 0, keepdims=False)
+    if "k_scale" in cache:
+        ks_l = lax.dynamic_index_in_dim(cache["k_scale"], l, 0,
+                                        keepdims=False)
+        vs_l = lax.dynamic_index_in_dim(cache["v_scale"], l, 0,
+                                        keepdims=False)
+        return k_l, v_l, ks_l, vs_l
+    return k_l, v_l, None, None
 
 
 def _dequant_gather(ctx, scale_l, pages, flat_shape):
@@ -145,22 +158,21 @@ def prefill(
     )  # [B, S]
     slot_of_token = jnp.broadcast_to(token_idx % ps, (B, S))
 
-    def body(x, inputs):
-        layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
-            inputs, lora is not None, quantized)
+    def body(carry, inputs):
+        x, cache = carry
+        layer, layer_lora, l = _layer_unpack(inputs, lora is not None)
         out, (k, v) = layer_forward(cfg, layer, x, positions, mesh=mesh,
                                     lora=layer_lora, adapter_ids=adapter_ids)
-        # head-major per-layer cache [KV, n_pages, ps, Hd]; k is
-        # [B, S, KV, Hd] → scatter [KV, B, S, Hd] at [B, S] page/slot maps
-        k_cache_l, v_cache_l, ks_l, vs_l = _scatter_kv(
-            k, v, k_cache_l, v_cache_l, ks_l, vs_l,
-            page_of_token, slot_of_token, head_axis=2)
-        return _layer_out(out, k_cache_l, v_cache_l, ks_l, vs_l)
+        # stacked head-major cache [L, KV, n_pages, ps, Hd]; k is
+        # [B, S, KV, Hd] → in-place scatter at layer l, [B, S] maps
+        cache = _scatter_kv(cache, l, k, v, page_of_token, slot_of_token,
+                            head_axis=2)
+        return (out, cache), None
 
-    x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
+    (x, cache), _ = lax.scan(body, (x, cache), _layer_xs(cfg, params, lora))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[jnp.arange(B), jnp.maximum(true_lens - 1, 0)]  # [B, D]
-    return _cache_result(scanned, quantized), lm_head(cfg, params, last)
+    return cache, lm_head(cfg, params, last)
 
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
@@ -217,37 +229,39 @@ def prefill_suffix(
     attend = masks.attend(positions[0][:, None], ctx_idx,
                           cfg.sliding_window)  # [C, T]
 
-    def body(x, inputs):
-        layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
-            inputs, lora is not None, quantized)
+    def body(carry, inputs):
+        x, cache = carry
+        layer, layer_lora, l = _layer_unpack(inputs, lora is not None)
         from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
 
         layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
         q, k, v = qkv_proj(cfg, layer, x, positions, layer_lora, adapter_ids)
 
-        # head-major per-layer cache [KV, n_pages, ps, Hd]; k[0] is [C, KV, Hd]
-        k_cache_l, v_cache_l, ks_l, vs_l = _scatter_kv(
-            k[0], v[0], k_cache_l, v_cache_l, ks_l, vs_l,
-            write_page, write_slot, head_axis=1)
+        # stacked head-major cache [L, KV, n_pages, ps, Hd]; k[0] is
+        # [C, KV, Hd] → in-place scatter at layer l
+        cache = _scatter_kv(cache, l, k[0], v[0], write_page, write_slot,
+                            head_axis=1)
+        ks_s, vs_s = cache.get("k_scale"), cache.get("v_scale")
 
         if use_kernel:
             if mesh is not None:
                 from fusioninfer_tpu.ops.sharded import paged_prefill_attention_tp
 
                 attn = paged_prefill_attention_tp(
-                    mesh, q[0], k_cache_l, v_cache_l, page_row, start, true_len,
-                    ks_l, vs_l,
+                    mesh, q[0], cache["k"], cache["v"], page_row, start,
+                    true_len, ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )[None]  # [1, C, H*Hd]
             else:
                 attn = paged_prefill_attention(
-                    q[0], k_cache_l, v_cache_l, page_row, start, true_len,
-                    ks_l, vs_l,
+                    q[0], cache["k"], cache["v"], page_row, start, true_len,
+                    ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )[None]
         else:
+            k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
             k_ctx = k_cache_l[:, page_row].reshape(KV, mp * ps, Hd)
             v_ctx = v_cache_l[:, page_row].reshape(KV, mp * ps, Hd)
             if quantized:
@@ -270,13 +284,12 @@ def prefill_suffix(
 
             out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
         x = x + out_proj
-        return _layer_out(x + mlp_block(cfg, layer, x),
-                          k_cache_l, v_cache_l, ks_l, vs_l)
+        return (x + mlp_block(cfg, layer, x), cache), None
 
-    x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
+    (x, cache), _ = lax.scan(body, (x, cache), _layer_xs(cfg, params, lora))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]
-    return _cache_result(scanned, quantized), lm_head(cfg, params, last)
+    return cache, lm_head(cfg, params, last)
 
 
 def _decode_step_impl(
@@ -318,20 +331,21 @@ def _decode_step_impl(
                           cfg.sliding_window)  # [B, T] (new token included)
     attend = attend[:, None, None, :]  # [B, 1, 1, T]
 
-    def body(x, inputs):
-        layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
-            inputs, lora is not None, quantized)
+    def body(carry, inputs):
+        x, cache = carry
+        layer, layer_lora, l = _layer_unpack(inputs, lora is not None)
         from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
 
         layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
         B_, S_, D_ = x.shape
         q, k, v = qkv_proj(cfg, layer, x, pos, layer_lora, adapter_ids)
 
-        # write this step's K/V into each sequence's page slot
-        # (head-major cache [KV, n_pages, ps, Hd]; k[:, 0] is [B, KV, Hd])
-        k_cache_l, v_cache_l, ks_l, vs_l = _scatter_kv(
-            k[:, 0], v[:, 0], k_cache_l, v_cache_l, ks_l, vs_l,
-            write_page, write_slot, head_axis=1)
+        # write this step's K/V into each sequence's page slot (stacked
+        # head-major cache [L, KV, n_pages, ps, Hd]; k[:, 0] is
+        # [B, KV, Hd]) — in place at layer l
+        cache = _scatter_kv(cache, l, k[:, 0], v[:, 0],
+                            write_page, write_slot, head_axis=1)
+        ks_s, vs_s = cache.get("k_scale"), cache.get("v_scale")
 
         if use_kernel:
             # Pallas kernel streams only the live pages HBM→VMEM
@@ -339,20 +353,21 @@ def _decode_step_impl(
                 from fusioninfer_tpu.ops.sharded import paged_decode_attention_tp
 
                 attn = paged_decode_attention_tp(
-                    mesh, q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
-                    ks_l, vs_l,
+                    mesh, q[:, 0], cache["k"], cache["v"], page_tables,
+                    lengths, ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )[:, None, :]
             else:
                 attn = paged_decode_attention(
-                    q[:, 0], k_cache_l, v_cache_l, page_tables, lengths,
-                    ks_l, vs_l,
+                    q[:, 0], cache["k"], cache["v"], page_tables, lengths,
+                    ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )[:, None, :]  # [B, 1, H*Hd]
         else:
             # portable path: gather pages [KV, B, mp, ps, Hd] -> [KV, B, T, Hd]
+            k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
             k_ctx = k_cache_l[:, page_tables].reshape(KV, B_, mp * ps, Hd)
             v_ctx = v_cache_l[:, page_tables].reshape(KV, B_, mp * ps, Hd)
             if quantized:
@@ -374,13 +389,12 @@ def _decode_step_impl(
 
             out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
         x = x + out_proj
-        return _layer_out(x + mlp_block(cfg, layer, x),
-                          k_cache_l, v_cache_l, ks_l, vs_l)
+        return (x + mlp_block(cfg, layer, x), cache), None
 
-    x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
+    (x, cache), _ = lax.scan(body, (x, cache), _layer_xs(cfg, params, lora))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = lm_head(cfg, params, x[:, 0])
-    return _cache_result(scanned, quantized), logits
+    return cache, logits
 
 
 decode_step = partial(
@@ -576,37 +590,39 @@ def verify_step(
     attend = masks.attend(positions[:, :, None], ctx_idx,
                           cfg.sliding_window)  # [B, C, T]
 
-    def body(x, inputs):
-        layer, layer_lora, k_cache_l, v_cache_l, ks_l, vs_l = _cache_unpack(
-            inputs, lora is not None, quantized)
+    def body(carry, inputs):
+        x, cache = carry
+        layer, layer_lora, l = _layer_unpack(inputs, lora is not None)
         from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
 
         layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
         q, k, v = qkv_proj(cfg, layer, x, positions, layer_lora, adapter_ids)
 
-        # head-major cache [KV, n_pages, ps, Hd]; k is [B, C, KV, Hd]
-        k_cache_l, v_cache_l, ks_l, vs_l = _scatter_kv(
-            k, v, k_cache_l, v_cache_l, ks_l, vs_l,
-            write_page, write_slot, head_axis=2)
+        # stacked head-major cache [L, KV, n_pages, ps, Hd]; k is
+        # [B, C, KV, Hd] → in-place scatter at layer l
+        cache = _scatter_kv(cache, l, k, v, write_page, write_slot,
+                            head_axis=2)
+        ks_s, vs_s = cache.get("k_scale"), cache.get("v_scale")
 
         if use_kernel:
             if mesh is not None:
                 from fusioninfer_tpu.ops.sharded import paged_verify_attention_tp
 
                 attn = paged_verify_attention_tp(
-                    mesh, q, k_cache_l, v_cache_l, page_tables, starts, counts,
-                    ks_l, vs_l,
+                    mesh, q, cache["k"], cache["v"], page_tables, starts,
+                    counts, ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )  # [B, C, H*Hd]
             else:
                 attn = paged_verify_attention(
-                    q, k_cache_l, v_cache_l, page_tables, starts, counts,
-                    ks_l, vs_l,
+                    q, cache["k"], cache["v"], page_tables, starts, counts,
+                    ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window,
                 )
         else:
+            k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
             k_ctx = k_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)
             v_ctx = v_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)
             if quantized:
@@ -630,16 +646,15 @@ def verify_step(
 
             out_proj = out_proj + lora_delta(layer_lora, "wo", attn, adapter_ids)
         x = x + out_proj
-        return _layer_out(x + mlp_block(cfg, layer, x),
-                          k_cache_l, v_cache_l, ks_l, vs_l)
+        return (x + mlp_block(cfg, layer, x), cache), None
 
-    x, scanned = lax.scan(body, x, _cache_xs(params, lora, cache, quantized))
+    (x, cache), _ = lax.scan(body, (x, cache), _layer_xs(cfg, params, lora))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     if last_only:
         last = x[jnp.arange(B), jnp.maximum(counts - 1, 0)]  # [B, D]
-        return _cache_result(scanned, quantized), lm_head(cfg, params, last)
+        return cache, lm_head(cfg, params, last)
     logits = lm_head(cfg, params, x)  # [B, C, V]
-    return _cache_result(scanned, quantized), logits
+    return cache, logits
 
 
 def prefill_buckets(max_len: int, smallest: int = 32) -> list[int]:

@@ -45,21 +45,23 @@ from fusioninfer_tpu.ops.masks import attend
 NEG_INF = -1e30
 
 
-def _page_dma(slot, g, page, k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
-              scale_refs=None, scale_bufs=None):
+def _page_dma(slot, layer, g, page, k_pages_ref, v_pages_ref, k_buf, v_buf,
+              sem, scale_refs=None, scale_bufs=None):
     """Async copies for one page of K/V (+ their [1, ps] scale rows when
     the cache is int8) — the ONE place the quantized operand/semaphore
-    layout lives for every grid.  Head-major pages: ``g`` is either a
-    head index (per-head grids: ``.at[g, page]`` squeezes two leading
-    dims) or ``slice(None)`` (coalesced grid: ``.at[:, page]`` copies
-    all KV heads at once); both slice only leading dims and copy whole
+    layout lives for every grid.  Pages are layer-stacked head-major
+    ``[L, KV, n_pages, ps, Hd]``: ``layer`` is the scan's layer scalar
+    and ``g`` is either a head index (per-head grids:
+    ``.at[layer, g, page]`` squeezes three leading dims) or
+    ``slice(None)`` (coalesced grid: ``.at[layer, :, page]`` copies all
+    KV heads at once); both slice only leading dims and copy whole
     trailing tiles — Mosaic-clean."""
     copies = [
         pltpu.make_async_copy(
-            k_pages_ref.at[g, page], k_buf.at[slot], sem.at[slot, 0]
+            k_pages_ref.at[layer, g, page], k_buf.at[slot], sem.at[slot, 0]
         ),
         pltpu.make_async_copy(
-            v_pages_ref.at[g, page], v_buf.at[slot], sem.at[slot, 1]
+            v_pages_ref.at[layer, g, page], v_buf.at[slot], sem.at[slot, 1]
         ),
     ]
     if scale_refs is not None:
@@ -67,13 +69,31 @@ def _page_dma(slot, g, page, k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
         ks_buf, vs_buf = scale_bufs
         copies += [
             pltpu.make_async_copy(
-                ks_ref.at[g, page], ks_buf.at[slot], sem.at[slot, 2]
+                ks_ref.at[layer, g, page], ks_buf.at[slot], sem.at[slot, 2]
             ),
             pltpu.make_async_copy(
-                vs_ref.at[g, page], vs_buf.at[slot], sem.at[slot, 3]
+                vs_ref.at[layer, g, page], vs_buf.at[slot], sem.at[slot, 3]
             ),
         ]
     return copies
+
+
+def _as_stacked(k_pages, v_pages, k_scales, v_scales, layer):
+    """Normalize page operands to the layer-stacked ``[L, KV, …]`` form
+    the kernels use internally.  4-d single-layer arrays (standalone
+    callers, oracles, tests) wrap to ``L=1`` with ``layer=0`` — a free
+    reshape; 5-d arrays require an explicit ``layer``."""
+    if k_pages.ndim == 4:
+        if layer is not None:
+            raise ValueError("layer= only applies to stacked 5-d pages")
+        k_pages, v_pages = k_pages[None], v_pages[None]
+        if k_scales is not None:
+            k_scales, v_scales = k_scales[None], v_scales[None]
+        layer = 0
+    elif layer is None:
+        raise ValueError("stacked [L, ...] pages require layer=")
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    return k_pages, v_pages, k_scales, v_scales, layer_arr
 
 
 def _split_rest(rest, quantized):
@@ -138,8 +158,10 @@ def _paged_kernel_coalesced(
     # scalar prefetch
     page_tables_ref,  # [B, mp] int32 (SMEM)
     lengths_ref,  # [B] int32 — context length incl. the current token
-    # inputs: q_ref [1, KV, G, Hd] VMEM block; k/v pages [KV, n_pages,
-    # ps, Hd] in ANY; when quantized, scale refs [KV, n_pages, 1, ps]
+    layer_ref,  # [1] int32 — which layer of the stacked pools
+    # inputs: q_ref [1, KV, G, Hd] VMEM block; k/v pages [L, KV,
+    # n_pages, ps, Hd] in ANY; when quantized, scale refs
+    # [L, KV, n_pages, 1, ps]
     q_ref,
     k_pages_ref,
     v_pages_ref,
@@ -168,7 +190,8 @@ def _paged_kernel_coalesced(
 
     def dma(slot, p):
         # g = slice(None): one copy covers every KV head of the page
-        return _page_dma(slot, slice(None), page_tables_ref[b, p],
+        return _page_dma(slot, layer_ref[0], slice(None),
+                         page_tables_ref[b, p],
                          k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
                          scale_refs, scale_bufs)
 
@@ -224,9 +247,10 @@ def _paged_kernel(
     # scalar prefetch
     page_tables_ref,  # [B, mp] int32 (SMEM)
     lengths_ref,  # [B] int32 — context length incl. the current token
-    # inputs: q_ref [1, 1, G, Hd] VMEM block; k/v pages [KV, n_pages, ps,
-    # Hd] in ANY; when quantized, k/v scale refs [KV, n_pages, 1, ps]
-    # outputs+scratch via *rest (layout depends on `quantized`)
+    layer_ref,  # [1] int32 — which layer of the stacked pools
+    # inputs: q_ref [1, 1, G, Hd] VMEM block; k/v pages [L, KV, n_pages,
+    # ps, Hd] in ANY; when quantized, k/v scale refs [L, KV, n_pages, 1,
+    # ps]; outputs+scratch via *rest (layout depends on `quantized`)
     q_ref,
     k_pages_ref,
     v_pages_ref,
@@ -250,9 +274,9 @@ def _paged_kernel(
              if window is not None else 0)
 
     def dma(slot, p):
-        return _page_dma(slot, g, page_tables_ref[b, p], k_pages_ref,
-                         v_pages_ref, k_buf, v_buf, sem, scale_refs,
-                         scale_bufs)
+        return _page_dma(slot, layer_ref[0], g, page_tables_ref[b, p],
+                         k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
+                         scale_refs, scale_bufs)
 
     @pl.when(n_used > 0)
     def _start_first():
@@ -304,17 +328,18 @@ def _paged_kernel(
 )
 def paged_decode_attention(
     q: jax.Array,  # [B, H, Hd] — one query token per sequence
-    k_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
-    v_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
+    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] or stacked [L, KV, …]
+    v_pages: jax.Array,
     page_tables: jax.Array,  # [B, max_pages] int32
     lengths: jax.Array,  # [B] int32, context length incl. current token
-    k_scales: jax.Array | None = None,  # [KV, n_pages, 1, ps] (int8 pages)
+    k_scales: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] (int8)
     v_scales: jax.Array | None = None,
     *,
     sm_scale: float | None = None,
     interpret: bool = False,
     window: int | None = None,
     coalesce: bool | None = None,
+    layer: jax.Array | int | None = None,
 ) -> jax.Array:
     """Batched one-token attention over paged KV → [B, H·Hd].
 
@@ -327,9 +352,15 @@ def paged_decode_attention(
     page (KV× fewer DMA issues) vs the per-(sequence, head) grid; both
     compute identical math per row.  ``None`` defers to
     :func:`fusioninfer_tpu.ops.dispatch.decode_coalesce`.
+    ``layer`` + 5-d pages: read layer ``layer`` of the model's FULL
+    stacked cache in place — the layer-scan carries one donated pool and
+    no per-layer slice is ever materialized (the in-place-cache design,
+    round 5).
     """
     B, H, Hd = q.shape
-    KV, _, page_size, _ = k_pages.shape
+    k_pages, v_pages, k_scales, v_scales, layer_arr = _as_stacked(
+        k_pages, v_pages, k_scales, v_scales, layer)
+    KV, _, page_size, _ = k_pages.shape[1:]
     G = H // KV
     max_pages = page_tables.shape[1]
     sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
@@ -346,7 +377,7 @@ def paged_decode_attention(
             page_size, Hd, k_pages.dtype, v_pages.dtype, quantized,
             heads=KV)
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(B,),
             in_specs=[
                 pl.BlockSpec(
@@ -366,7 +397,7 @@ def paged_decode_attention(
         page_specs, scratch = _page_specs_scratch(
             page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(B, KV),
             in_specs=[
                 pl.BlockSpec(
@@ -387,8 +418,8 @@ def paged_decode_attention(
         max_pages=max_pages, page_size=page_size, sm_scale=sm_scale,
         quantized=quantized, window=window,
     )
-    operands = [page_tables.astype(jnp.int32), lengths.astype(jnp.int32), qg,
-                k_pages, v_pages]
+    operands = [page_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+                layer_arr, qg, k_pages, v_pages]
     if quantized:
         operands += [k_scales, v_scales]
     out = pl.pallas_call(
@@ -404,8 +435,9 @@ def _suffix_kernel(
     # scalar prefetch
     page_row_ref,  # [mp] int32 (SMEM) — ONE sequence's page table
     meta_ref,  # [2] int32: (start, true_len)
+    layer_ref,  # [1] int32 — which layer of the stacked pools
     # inputs: q_ref [block_q, 1, G, Hd] VMEM block; k/v pages in ANY;
-    # when quantized, scale refs [KV, n_pages, 1, ps] then out/scratch
+    # when quantized, scale refs [L, KV, n_pages, 1, ps] then out/scratch
     q_ref,
     k_pages_ref,
     v_pages_ref,
@@ -434,7 +466,8 @@ def _suffix_kernel(
              if window is not None else 0)
 
     def dma(slot, p):
-        return _page_dma(slot, g, page_row_ref[p], k_pages_ref, v_pages_ref,
+        return _page_dma(slot, layer_ref[0], g, page_row_ref[p],
+                         k_pages_ref, v_pages_ref,
                          k_buf, v_buf, sem, scale_refs, scale_bufs)
 
     @pl.when(n_used > 0)
@@ -493,18 +526,19 @@ def _suffix_kernel(
 )
 def paged_prefill_attention(
     q: jax.Array,  # [C, H, Hd] — suffix queries, padded to bucket C
-    k_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
-    v_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
+    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] or stacked [L, KV, …]
+    v_pages: jax.Array,
     page_row: jax.Array,  # [max_pages] int32 — ONE sequence's pages
     start: jax.Array,  # scalar int32: global position of q[0]
     true_len: jax.Array,  # scalar int32: real (unpadded) suffix length
-    k_scales: jax.Array | None = None,  # [KV, n_pages, 1, ps] (int8 pages)
+    k_scales: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] (int8)
     v_scales: jax.Array | None = None,
     *,
     sm_scale: float | None = None,
     block_q: int = 128,
     interpret: bool = False,
     window: int | None = None,
+    layer: jax.Array | int | None = None,
 ) -> jax.Array:
     """Suffix-prefill attention over paged KV → [C, H·Hd].
 
@@ -519,7 +553,9 @@ def paged_prefill_attention(
     their output is unspecified and must be discarded by the caller.
     """
     C, H, Hd = q.shape
-    KV, _, page_size, _ = k_pages.shape
+    k_pages, v_pages, k_scales, v_scales, layer_arr = _as_stacked(
+        k_pages, v_pages, k_scales, v_scales, layer)
+    KV, _, page_size, _ = k_pages.shape[1:]
     G = H // KV
     sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
     block_q = min(block_q, C)
@@ -535,7 +571,7 @@ def paged_prefill_attention(
         page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(KV, n_qt),
         in_specs=[
             pl.BlockSpec(
@@ -555,7 +591,8 @@ def paged_prefill_attention(
         block_q=block_q, page_size=page_size, sm_scale=sm_scale,
         quantized=quantized, window=window,
     )
-    operands = [page_row.astype(jnp.int32), meta, qg, k_pages, v_pages]
+    operands = [page_row.astype(jnp.int32), meta, layer_arr, qg,
+                k_pages, v_pages]
     if quantized:
         operands += [k_scales, v_scales]
     out = pl.pallas_call(
@@ -572,8 +609,9 @@ def _verify_kernel(
     page_tables_ref,  # [B, mp] int32 (SMEM)
     starts_ref,  # [B] int32 — global position of each sequence's query 0
     counts_ref,  # [B] int32 — real queries this step (0 = inactive slot)
+    layer_ref,  # [1] int32 — which layer of the stacked pools
     # inputs: q_ref [C, 1, G, Hd] VMEM block; k/v pages in ANY; when
-    # quantized, scale refs [KV, n_pages, 1, ps] then out/scratch
+    # quantized, scale refs [L, KV, n_pages, 1, ps] then out/scratch
     q_ref,
     k_pages_ref,
     v_pages_ref,
@@ -601,9 +639,9 @@ def _verify_kernel(
              if sliding is not None else 0)
 
     def dma(slot, p):
-        return _page_dma(slot, g, page_tables_ref[b, p], k_pages_ref,
-                         v_pages_ref, k_buf, v_buf, sem, scale_refs,
-                         scale_bufs)
+        return _page_dma(slot, layer_ref[0], g, page_tables_ref[b, p],
+                         k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
+                         scale_refs, scale_bufs)
 
     @pl.when(n_used > 0)
     def _start_first():
@@ -660,18 +698,19 @@ def _verify_kernel(
 )
 def paged_verify_attention(
     q: jax.Array,  # [B, C, H, Hd] — C-token query window per sequence
-    k_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
-    v_pages: jax.Array,  # [KV, n_pages, page_size, Hd]
+    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] or stacked [L, KV, …]
+    v_pages: jax.Array,
     page_tables: jax.Array,  # [B, max_pages] int32
     starts: jax.Array,  # [B] int32 — global position of q[:, 0]
     counts: jax.Array,  # [B] int32 — real window length (0 = inactive)
-    k_scales: jax.Array | None = None,  # [KV, n_pages, 1, ps] (int8 pages)
+    k_scales: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] (int8)
     v_scales: jax.Array | None = None,
     *,
     sm_scale: float | None = None,
     interpret: bool = False,
     window: int | None = None,
     block_q: int = 128,
+    layer: jax.Array | int | None = None,
 ) -> jax.Array:
     """Batched multi-query paged attention → [B, C, H·Hd].
 
@@ -689,7 +728,9 @@ def paged_verify_attention(
     decode kernel's head-major page layout.
     """
     B, C, H, Hd = q.shape
-    KV, _, page_size, _ = k_pages.shape
+    k_pages, v_pages, k_scales, v_scales, layer_arr = _as_stacked(
+        k_pages, v_pages, k_scales, v_scales, layer)
+    KV, _, page_size, _ = k_pages.shape[1:]
     G = H // KV
     sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
     quantized = k_scales is not None
@@ -704,7 +745,7 @@ def paged_verify_attention(
         page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B, KV, n_qt),
         in_specs=[
             pl.BlockSpec(
@@ -727,7 +768,7 @@ def paged_verify_attention(
         quantized=quantized, sliding=window,
     )
     operands = [page_tables.astype(jnp.int32), starts.astype(jnp.int32),
-                counts.astype(jnp.int32), qg, k_pages, v_pages]
+                counts.astype(jnp.int32), layer_arr, qg, k_pages, v_pages]
     if quantized:
         operands += [k_scales, v_scales]
     out = pl.pallas_call(

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fusioninfer_tpu.ops.flash_attention import flash_attention
 from fusioninfer_tpu.ops.paged_attention import (
+    _as_stacked,
     paged_decode_attention,
     paged_prefill_attention,
     paged_verify_attention,
@@ -66,39 +67,52 @@ def flash_attention_tp(
     return fn(q, k, v)
 
 
-# int8 KV pages carry per-(kv-head, page, token) scale arrays
-# [KV, n_pages, 1, ps]; the leading KV axis shards over tp exactly like
-# the pages, so each shard's kernel folds its own heads' scales.
-_SCALE_SPEC = P("tp", None, None, None)
+# int8 KV pages carry per-(kv-head, page, token) scale arrays — stacked
+# [L, KV, n_pages, 1, ps]; the KV axis shards over tp exactly like the
+# pages, so each shard's kernel folds its own heads' scales.
+_SCALE_SPEC = P(None, "tp", None, None, None)
+
+
 
 
 def paged_decode_attention_tp(
     mesh: Mesh,
     q: jax.Array,  # [B, H, Hd] — H sharded over tp
-    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] — KV (leading) sharded over tp
+    k_pages: jax.Array,  # [(L,) KV, n_pages, ps, Hd] — KV sharded over tp
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, mp] replicated
     lengths: jax.Array,  # [B] replicated
-    k_scale: jax.Array | None = None,  # [KV, n_pages, 1, ps] — int8 pages
+    k_scale: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] — int8
     v_scale: jax.Array | None = None,
     *,
     interpret: bool = False,
     window: int | None = None,
+    layer: jax.Array | int | None = None,
 ) -> jax.Array:
     """Per-shard paged decode attention → [B, H·Hd] sharded on features."""
+    k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
+        k_pages, v_pages, k_scale, v_scale, layer)
     in_specs = [
         P(None, "tp", None),
-        P("tp", None, None, None),
-        P("tp", None, None, None),
+        P(None, "tp", None, None, None),
+        P(None, "tp", None, None, None),
         P(None, None),
         P(None),
+        P(None),
     ]
-    args = [q, k_pages, v_pages, page_tables, lengths]
+    args = [q, k_pages, v_pages, page_tables, lengths, layer]
     if k_scale is not None:
         in_specs += [_SCALE_SPEC, _SCALE_SPEC]
         args += [k_scale, v_scale]
+
+    def run(q, kp, vp, pt, ln, l, *scales):
+        ks, vs = scales if scales else (None, None)
+        return paged_decode_attention(q, kp, vp, pt, ln, ks, vs,
+                                      interpret=interpret, window=window,
+                                      layer=l)
+
     fn = shard_map(
-        partial(paged_decode_attention, interpret=interpret, window=window),
+        run,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=P(None, "tp"),
@@ -110,32 +124,43 @@ def paged_decode_attention_tp(
 def paged_prefill_attention_tp(
     mesh: Mesh,
     q: jax.Array,  # [C, H, Hd] — H sharded over tp
-    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] — KV (leading) sharded over tp
+    k_pages: jax.Array,  # [(L,) KV, n_pages, ps, Hd] — KV sharded over tp
     v_pages: jax.Array,
     page_row: jax.Array,  # [mp] replicated
     start: jax.Array,  # scalar replicated
     true_len: jax.Array,  # scalar replicated
-    k_scale: jax.Array | None = None,  # [KV, n_pages, 1, ps] — int8 pages
+    k_scale: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] — int8
     v_scale: jax.Array | None = None,
     *,
     interpret: bool = False,
     window: int | None = None,
+    layer: jax.Array | int | None = None,
 ) -> jax.Array:
     """Per-shard suffix-prefill attention → [C, H·Hd] sharded on features."""
+    k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
+        k_pages, v_pages, k_scale, v_scale, layer)
     in_specs = [
         P(None, "tp", None),
-        P("tp", None, None, None),
-        P("tp", None, None, None),
+        P(None, "tp", None, None, None),
+        P(None, "tp", None, None, None),
         P(None),
         P(),
         P(),
+        P(None),
     ]
-    args = [q, k_pages, v_pages, page_row, start, true_len]
+    args = [q, k_pages, v_pages, page_row, start, true_len, layer]
     if k_scale is not None:
         in_specs += [_SCALE_SPEC, _SCALE_SPEC]
         args += [k_scale, v_scale]
+
+    def run(q, kp, vp, row, st, tl, l, *scales):
+        ks, vs = scales if scales else (None, None)
+        return paged_prefill_attention(q, kp, vp, row, st, tl, ks, vs,
+                                       interpret=interpret, window=window,
+                                       layer=l)
+
     fn = shard_map(
-        partial(paged_prefill_attention, interpret=interpret, window=window),
+        run,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=P(None, "tp"),
@@ -147,32 +172,43 @@ def paged_prefill_attention_tp(
 def paged_verify_attention_tp(
     mesh: Mesh,
     q: jax.Array,  # [B, C, H, Hd] — H sharded over tp
-    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] — KV (leading) sharded over tp
+    k_pages: jax.Array,  # [(L,) KV, n_pages, ps, Hd] — KV sharded over tp
     v_pages: jax.Array,
     page_tables: jax.Array,  # [B, mp] replicated
     starts: jax.Array,  # [B] replicated
     counts: jax.Array,  # [B] replicated
-    k_scale: jax.Array | None = None,  # [KV, n_pages, 1, ps] — int8 pages
+    k_scale: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] — int8
     v_scale: jax.Array | None = None,
     *,
     interpret: bool = False,
     window: int | None = None,
+    layer: jax.Array | int | None = None,
 ) -> jax.Array:
     """Per-shard verify-window attention → [B, C, H·Hd] sharded on features."""
+    k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
+        k_pages, v_pages, k_scale, v_scale, layer)
     in_specs = [
         P(None, None, "tp", None),
-        P("tp", None, None, None),
-        P("tp", None, None, None),
+        P(None, "tp", None, None, None),
+        P(None, "tp", None, None, None),
         P(None, None),
         P(None),
         P(None),
+        P(None),
     ]
-    args = [q, k_pages, v_pages, page_tables, starts, counts]
+    args = [q, k_pages, v_pages, page_tables, starts, counts, layer]
     if k_scale is not None:
         in_specs += [_SCALE_SPEC, _SCALE_SPEC]
         args += [k_scale, v_scale]
+
+    def run(q, kp, vp, pt, st, ct, l, *scales):
+        ks, vs = scales if scales else (None, None)
+        return paged_verify_attention(q, kp, vp, pt, st, ct, ks, vs,
+                                      interpret=interpret, window=window,
+                                      layer=l)
+
     fn = shard_map(
-        partial(paged_verify_attention, interpret=interpret, window=window),
+        run,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=P(None, None, "tp"),
