@@ -221,3 +221,100 @@ def test_sampler_penalties_and_seed_streams():
         for s in range(8)
     )
     assert diverged
+
+
+class TestScatterInPlace:
+    """Regression fence for the round-5 pool-copy bug: the KV page
+    scatter must never lower with a transpose of a pool-shaped operand.
+    The old ``.at[:, page, slot]`` index form (basic slice before the
+    advanced block) made jnp move the advanced dims to the front — a
+    FULL transpose (= copy) of the cache pool per layer per step.  The
+    value moveaxis is a transpose too, but of the small [B, KV, Hd]
+    update — only pool-shaped transposes are the bug."""
+
+    def test_no_pool_shaped_transpose_in_scatter(self):
+        import jax
+        import jax.numpy as jnp
+
+        from fusioninfer_tpu.engine import model_runner as mr
+
+        L, KV, P, ps, Hd, B = 3, 2, 65, 16, 32, 4
+        cache = {
+            "k": jnp.zeros((L, KV, P, ps, Hd), jnp.bfloat16),
+            "v": jnp.zeros((L, KV, P, ps, Hd), jnp.bfloat16),
+        }
+        k = jnp.zeros((B, KV, Hd), jnp.bfloat16)
+        wp = jnp.zeros((B,), jnp.int32)
+        ws = jnp.arange(B, dtype=jnp.int32)
+
+        def f(cache, k):
+            return mr._scatter_kv(cache, jnp.int32(1), k, k, wp, ws,
+                                  head_axis=1)
+
+        self._assert_no_pool_transpose(jax.make_jaxpr(f)(cache, k),
+                                       cache["k"].shape)
+
+    @staticmethod
+    def _assert_no_pool_transpose(jaxpr, *pool_shapes):
+        squeezed = [tuple(d for d in s if d != 1) for s in pool_shapes]
+        for eqn in jaxpr.jaxpr.eqns:
+            if eqn.primitive.name == "transpose":
+                shape = eqn.invars[0].aval.shape
+                assert tuple(d for d in shape if d != 1) not in squeezed, (
+                    f"pool-shaped transpose {shape} in a KV scatter "
+                    "lowering — the .at[] index form regressed to a "
+                    "copying pattern")
+
+    def test_no_pool_transpose_quantized_scatter(self):
+        """Same fence for the int8 path: value pools AND the
+        [L, KV, P, 1, ps] scale pools (squeeze/scatter/expand must not
+        reintroduce a transpose of either)."""
+        import jax
+        import jax.numpy as jnp
+
+        from fusioninfer_tpu.engine import model_runner as mr
+
+        L, KV, P, ps, Hd, B = 3, 2, 65, 16, 32, 4
+        cache = {
+            "k": jnp.zeros((L, KV, P, ps, Hd), jnp.int8),
+            "v": jnp.zeros((L, KV, P, ps, Hd), jnp.int8),
+            "k_scale": jnp.zeros((L, KV, P, 1, ps), jnp.float32),
+            "v_scale": jnp.zeros((L, KV, P, 1, ps), jnp.float32),
+        }
+        k = jnp.zeros((B, KV, Hd), jnp.bfloat16)
+        wp = jnp.zeros((B,), jnp.int32)
+        ws = jnp.arange(B, dtype=jnp.int32)
+
+        def f(cache, k):
+            return mr._scatter_kv(cache, jnp.int32(1), k, k, wp, ws,
+                                  head_axis=1)
+
+        self._assert_no_pool_transpose(
+            jax.make_jaxpr(f)(cache, k),
+            cache["k"].shape, cache["k_scale"].shape)
+
+    def test_no_pool_transpose_inject_slab(self):
+        """inject_slab's page scatter (the PD decode-side KV landing)
+        shares the bug class: a basic slice before the page index copies
+        the whole destination pool per injection."""
+        import jax
+        import jax.numpy as jnp
+
+        from fusioninfer_tpu.engine import kv_transfer
+
+        L, KV, P, ps, Hd = 3, 2, 65, 16, 32
+        cache = {
+            "k": jnp.zeros((L, KV, P, ps, Hd), jnp.bfloat16),
+            "v": jnp.zeros((L, KV, P, ps, Hd), jnp.bfloat16),
+        }
+        slab = kv_transfer.KVSlab(
+            k=jnp.zeros((L, KV, 2, ps, Hd), jnp.bfloat16),
+            v=jnp.zeros((L, KV, 2, ps, Hd), jnp.bfloat16),
+            prompt_tokens=list(range(2 * ps)), first_token=1,
+            page_size=ps)
+
+        def f(cache):
+            return kv_transfer.inject_slab(cache, slab, [3, 7])
+
+        self._assert_no_pool_transpose(jax.make_jaxpr(f)(cache),
+                                       cache["k"].shape)
