@@ -840,6 +840,34 @@ def main() -> None:
                 except Exception as e:
                     decode["kernel_int8w_int8kv_error"] = (
                         f"{type(e).__name__}: {str(e)[:400]}")
+            # in-place-cache probe (r5): decode at IDENTICAL context
+            # depth over a small vs a 4× page pool.  ratio ≈ 1 → the
+            # pools update in place; ratio ≫ 1 → some lowering still
+            # copies the pool per step (the r5 bug class: the old
+            # xs→ys scan threading + transposing scatter showed 3×
+            # here).  This records the fix's hardware truth every
+            # round without anyone re-deriving it.
+            try:
+                pool_sizes = {"small": 97, "large": 385}
+                pool_t = {}
+                for tag, npg in pool_sizes.items():
+                    cc2 = CacheConfig(n_pages=npg, page_size=128,
+                                      max_pages_per_seq=3)
+                    r = run_decode(
+                        jax, dataclasses.replace(base_cfg,
+                                                 attn_impl="flash"),
+                        batch, cc2, 128, 3, 32, reps=2)
+                    pool_t[tag] = r["tok_s"]
+                decode["pool_scaling"] = {
+                    "small_pages": pool_sizes["small"],
+                    "large_pages": pool_sizes["large"],
+                    "small_tok_s": round(pool_t["small"], 2),
+                    "large_tok_s": round(pool_t["large"], 2),
+                    "ratio": round(pool_t["small"] / pool_t["large"], 3),
+                }
+            except Exception as e:
+                decode["pool_scaling_error"] = (
+                    f"{type(e).__name__}: {str(e)[:400]}")
             # long-context ragged leg: stratified 256..2048-token contexts
             # (the continuous-batching steady state).  The bench's base
             # shape (uniform ~200-token contexts, 8-page tables) hides
