@@ -145,7 +145,6 @@ def prefill(
     """
     B, S = tokens.shape
     ps = cache_cfg.page_size
-    quantized = cache_cfg.quantized
     x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
 
