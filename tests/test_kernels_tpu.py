@@ -353,3 +353,37 @@ class TestDecodeStepHW:
         logits.block_until_ready()
         assert logits.shape == (B, cfg.vocab_size)
         assert bool(jnp.isfinite(logits.astype(jnp.float32)).all())
+
+
+class TestStackedLayerHW:
+    def test_stacked_pools_layer_indexing(self):
+        """The production in-place cache path: full [L, KV, ...] stacked
+        pools + a layer scalar-prefetch operand must COMPILE under
+        Mosaic (interpret=False) and read the right layer.  L=1
+        auto-wrap shares the DMA slicing pattern, but multi-layer
+        indexing on hardware is pinned only here."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            paged_decode_attention,
+            reference_paged_attention,
+        )
+
+        B, H, KV, Hd, ps, n_pages, mp, L = 4, 16, 8, 128, 128, 33, 4, 3
+        lengths = [129, 7, 1, 255]
+        qs, kps, vps = [], [], []
+        tables = None
+        for layer in range(L):
+            q, kp, vp, tables, ln = _paged_setup(
+                B, H, KV, Hd, ps, n_pages, mp, lengths, jnp.bfloat16,
+                seed=20 + layer)
+            qs.append(q), kps.append(kp), vps.append(vp)
+        k_stack, v_stack = jnp.stack(kps), jnp.stack(vps)
+        for layer in range(L):
+            out = paged_decode_attention(
+                qs[layer], k_stack, v_stack, tables, ln,
+                interpret=False, layer=jnp.int32(layer))
+            out.block_until_ready()
+            ref = reference_paged_attention(qs[layer], kps[layer],
+                                            vps[layer], tables, ln)
+            np.testing.assert_allclose(
+                np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                atol=5e-2, rtol=5e-2)

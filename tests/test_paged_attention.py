@@ -130,3 +130,35 @@ def test_suffix_gqa_bf16():
     np.testing.assert_allclose(
         _mask_pad(out, 30), np.asarray(ref, np.float32), atol=4e-2, rtol=4e-2
     )
+
+
+@pytest.mark.parametrize("coalesce", [False, True])
+def test_stacked_layer_operand(coalesce):
+    """The production path passes the FULL [L, KV, ...] stacked pools
+    plus a layer scalar (the in-place cache design): attending layer l
+    of the stack must equal attending that layer's 4-d slice."""
+    L = 3
+    qs, kps, vps = [], [], []
+    for layer in range(L):
+        q, kp, vp, tables, lengths = _setup(seed=10 + layer)
+        qs.append(q), kps.append(kp), vps.append(vp)
+    k_stack = jnp.stack(kps)
+    v_stack = jnp.stack(vps)
+    for layer in range(L):
+        out = paged_decode_attention(
+            qs[layer], k_stack, v_stack, tables, lengths,
+            interpret=True, coalesce=coalesce, layer=jnp.int32(layer))
+        ref = paged_decode_attention(
+            qs[layer], kps[layer], vps[layer], tables, lengths,
+            interpret=True, coalesce=coalesce)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_stacked_requires_layer():
+    q, kp, vp, tables, lengths = _setup()
+    with pytest.raises(ValueError, match="require layer"):
+        paged_decode_attention(q, jnp.stack([kp]), jnp.stack([vp]),
+                               tables, lengths, interpret=True)
+    with pytest.raises(ValueError, match="only applies"):
+        paged_decode_attention(q, kp, vp, tables, lengths,
+                               interpret=True, layer=0)
