@@ -40,9 +40,14 @@ test-e2e: ## kind e2e: deploy the operator into a real cluster, reconcile a samp
 cleanup-test-e2e: ## Tear down the e2e kind cluster.
 	kind delete cluster --name $(KIND_CLUSTER)
 
+.PHONY: chaos
+chaos: ## Fault-injection chaos suite (seeded, deterministic; docs/design/resilience.md).
+	$(PYTHON) -m pytest tests/test_resilience.py -q -m chaos
+
 .PHONY: lint
-lint: ## Gating lint: in-repo AST linter + byte-compile (CI adds ruff).
+lint: ## Gating lint: in-repo AST linter + resilience rules + byte-compile (CI adds ruff).
 	$(PYTHON) tools/lint.py
+	$(PYTHON) tools/lint_resilience.py
 	$(PYTHON) -m compileall -q fusioninfer_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: bench

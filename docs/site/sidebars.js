@@ -9,7 +9,7 @@ const sidebars = {
       type: 'category',
       label: 'Design',
       items: ['design/crd', 'design/engine', 'design/parallelism',
-              'design/router'],
+              'design/resilience', 'design/router'],
     },
   ],
 };

@@ -23,12 +23,43 @@ import io
 import json
 import queue
 import struct
+import urllib.error
 import urllib.request
+import zlib
 from dataclasses import dataclass, field
 from typing import Optional, Protocol
 
 import jax.numpy as jnp
 import numpy as np
+
+from fusioninfer_tpu.resilience import FaultInjector, InjectedFault, RetryPolicy
+
+
+class KVTransferError(Exception):
+    """A KV pull failed with transport/protocol context attached —
+    decode-loop callers see one typed error instead of raw ``urllib``
+    internals.  ``status`` is the HTTP status (None for transport-level
+    failures: refused, reset, timeout, injected drop)."""
+
+    def __init__(self, message: str, status: Optional[int] = None,
+                 body: str = ""):
+        detail = f"HTTP {status}: " if status is not None else ""
+        super().__init__(f"KV transfer failed: {detail}{message}")
+        self.status = status
+        self.body = body
+
+    @property
+    def retryable(self) -> bool:
+        """Transport failures (no status) and 5xx are worth a re-pull;
+        a 4xx is the prefiller deterministically rejecting THIS request —
+        retrying it burns the backoff budget on a doomed call."""
+        return self.status is None or self.status >= 500
+
+
+class KVSlabCorrupt(KVTransferError):
+    """The slab frame failed its CRC32 (bit-flip on the wire, truncated
+    body, or a peer serializing garbage).  Retryable: a re-pull re-runs
+    the prefill and re-serializes a fresh frame."""
 
 
 @dataclass
@@ -198,11 +229,18 @@ def slab_to_bytes(slab: KVSlab) -> bytes:
         "sections": [name for name, _ in sections],
     }
     raws = []
+    crc = 0
     for name, arr in sections:
         meta, raw = _arr_bytes(arr)
         metas[name] = meta
         metas[f"{name}_len"] = len(raw)
+        crc = zlib.crc32(raw, crc)
         raws.append(raw)
+    # integrity over the payload sections: DCN transfers cross failure
+    # domains, and a bit-flipped KV page decodes into plausible garbage
+    # tokens with no error anywhere — the checksum turns that into a
+    # loud, retryable KVSlabCorrupt on the decode side
+    metas["crc32"] = crc
     header = json.dumps(metas).encode()
     out = io.BytesIO()
     out.write(_MAGIC_Q if slab.quantized else _MAGIC)
@@ -221,9 +259,21 @@ def slab_from_bytes(data: bytes) -> KVSlab:
     off += 4
     header = json.loads(data[off : off + hlen])
     off += hlen
+    sections = header.get("sections", ["k", "v"])
+    payload_len = sum(header[f"{name}_len"] for name in sections)
+    if len(data) - off < payload_len:
+        raise KVSlabCorrupt(
+            f"truncated frame: {len(data) - off} payload bytes, "
+            f"header declares {payload_len}")
+    # pre-crc32 frames (round-5 peers) are accepted unchecked
+    if "crc32" in header:
+        crc = zlib.crc32(data[off : off + payload_len])
+        if crc != header["crc32"]:
+            raise KVSlabCorrupt(
+                f"crc32 mismatch: frame says {header['crc32']:#010x}, "
+                f"payload hashes to {crc:#010x}")
     arrays: dict[str, jnp.ndarray] = {}
-    # pre-sections frames (round-3 peers) carry exactly k and v
-    for name in header.get("sections", ["k", "v"]):
+    for name in sections:
         raw = data[off : off + header[f"{name}_len"]]
         off += header[f"{name}_len"]
         arrays[name] = _arr_from(header[name], raw)
@@ -276,13 +326,54 @@ class HTTPPullConnector:
     (NIXL-style pull model: the decoder initiates, so KV never waits in
     prefiller memory).  ``prefill_url`` points at the prefiller service
     the operator renders for the prefiller role; the transfer rides DCN.
+
+    Failure handling: every failure mode surfaces as a typed
+    :class:`KVTransferError` (HTTP status + body snippet attached; CRC
+    mismatches as :class:`KVSlabCorrupt`), and ``retry`` re-pulls with
+    backoff — a re-pull is safe because the prefiller computes per pull
+    and the frame is self-contained.  Once the budget is exhausted the
+    LAST error propagates inside :class:`RetryBudgetExhausted` and the
+    server degrades to a local re-prefill (``engine/server.py``).
+    ``fault_injector`` arms the ``kv.pull`` / ``kv.pull.response``
+    chaos sites; the default injector is a no-op.
     """
 
     prefill_url: str
     sampling: Optional[dict] = None
+    retry: Optional[RetryPolicy] = None
+    fault_injector: Optional[FaultInjector] = None
 
     def put(self, request_id: str, slab: KVSlab) -> None:  # pragma: no cover
         raise NotImplementedError("pull connector: decoder initiates")
+
+    def _pull_once(self, body: bytes, timeout: float) -> KVSlab:
+        req = urllib.request.Request(
+            self.prefill_url.rstrip("/") + "/v1/prefill",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            if self.fault_injector is not None:
+                self.fault_injector.fire("kv.pull")
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise KVTransferError(detail or e.reason, status=e.code,
+                                  body=detail) from None
+        except InjectedFault as e:
+            raise KVTransferError(str(e), status=500 if e.mode == "error"
+                                  else None) from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise KVTransferError(str(e)) from e
+        if self.fault_injector is not None:
+            data = self.fault_injector.corrupt("kv.pull.response", data)
+        try:
+            return slab_from_bytes(data)
+        except KVTransferError:
+            raise  # KVSlabCorrupt already carries context
+        except (ValueError, KeyError, struct.error) as e:
+            raise KVSlabCorrupt(f"unparseable slab frame: {e}") from e
 
     def request_prefill(self, request_id: str, prompt_tokens: list[int],
                         sampling: Optional[dict] = None,
@@ -294,13 +385,13 @@ class HTTPPullConnector:
             "sampling": sampling or self.sampling or {},
             "lora": lora,
         }).encode()
-        req = urllib.request.Request(
-            self.prefill_url.rstrip("/") + "/v1/prefill",
-            data=body,
-            headers={"Content-Type": "application/json"},
+        if self.retry is None:
+            return self._pull_once(body, timeout)
+        return self.retry.run(
+            lambda: self._pull_once(body, timeout),
+            retry_on=(KVTransferError,),
+            retry_if=lambda e: e.retryable,
         )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return slab_from_bytes(resp.read())
 
     def get(self, request_id: str, timeout: float = 30.0) -> KVSlab:
         raise NotImplementedError("use request_prefill (needs the prompt)")

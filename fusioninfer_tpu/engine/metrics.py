@@ -56,6 +56,10 @@ class EngineMetrics:
         self.ttft = Histogram(TTFT_BUCKETS)
         self.tpot = Histogram(TPOT_BUCKETS)
         self.e2e_latency = Histogram((0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+        # resilience counters (server-side): PD pulls that degraded to a
+        # local re-prefill, and watchdog deadline/stall aborts
+        self.kv_transfer_fallbacks = 0
+        self.watchdog_aborts = 0
 
     def render(self, engine) -> str:
         """Text exposition from live engine state + accumulated histograms."""
@@ -92,6 +96,12 @@ class EngineMetrics:
             f"vllm:request_failure_total{{{labels}}} {engine.errors_total}",
             "# TYPE vllm:request_cancelled_total counter",
             f"vllm:request_cancelled_total{{{labels}}} {engine.cancelled_total}",
+            "# HELP fusioninfer:kv_transfer_fallbacks_total PD pulls degraded to a local re-prefill.",
+            "# TYPE fusioninfer:kv_transfer_fallbacks_total counter",
+            f"fusioninfer:kv_transfer_fallbacks_total{{{labels}}} {self.kv_transfer_fallbacks}",
+            "# HELP fusioninfer:watchdog_aborts_total requests aborted by the deadline/stall watchdog.",
+            "# TYPE fusioninfer:watchdog_aborts_total counter",
+            f"fusioninfer:watchdog_aborts_total{{{labels}}} {self.watchdog_aborts}",
             "# HELP vllm:gpu_prefix_cache_hit_rate fraction of prompt tokens served from cached prefix pages.",
             "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
             f"vllm:gpu_prefix_cache_hit_rate{{{labels}}} {engine.prefix_cache_hit_rate():.6f}",

@@ -25,10 +25,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from fusioninfer_tpu.engine.engine import NativeEngine, Request, StepOutput
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.kv_transfer import HTTPPullConnector, KVTransferError
 from fusioninfer_tpu.engine.metrics import EngineMetrics
 from fusioninfer_tpu.engine.sampler import SamplingParams
 from fusioninfer_tpu.engine.tokenizer import load_tokenizer
 from fusioninfer_tpu.models.config import get_preset
+from fusioninfer_tpu.resilience import RetryBudgetExhausted, RetryPolicy
 
 logger = logging.getLogger("fusioninfer.server")
 
@@ -142,14 +144,45 @@ class EngineServer:
         engine: NativeEngine | None = None,
         seed: int = 0,
         prefill_upstream: str | None = None,
+        kv_retry: RetryPolicy | None = None,
+        kv_fault_injector=None,
+        default_deadline_s: float | None = None,
+        watchdog_stall_s: float | None = None,
+        watchdog_interval_s: float = 0.05,
     ):
         """``prefill_upstream``: PD-disaggregated decode mode — completions
         pull their prefill (KV slab + first token) from the prefiller
         service at this URL instead of prefilling locally; the transfer
         rides DCN between slices.  Every server also exposes
-        ``/v1/prefill`` so any instance can act as the prefiller role."""
+        ``/v1/prefill`` so any instance can act as the prefiller role.
+
+        ``kv_retry`` shapes the pull's backoff (default: 3 attempts);
+        when the budget is exhausted the request re-prefills LOCALLY —
+        slower, but it completes (graceful degradation over DCN).
+        ``kv_fault_injector`` arms the connector's chaos sites.
+
+        ``default_deadline_s`` bounds every request's wall time unless
+        the request carries its own ``deadline_s``; ``watchdog_stall_s``
+        additionally aborts any sequence that produced NO token for that
+        long (a hung decode must not wedge the batch or its client).
+        The stall clock starts at submission, so queue wait and prefill
+        count toward it — size it well above worst-case TTFT under
+        load, or leave it None and rely on deadlines.  Both are enforced
+        by a watchdog thread that cancels the request engine-side and
+        fails its channel with an ``error:`` finish."""
         self.model_name = model
         self.prefill_upstream = prefill_upstream
+        self.default_deadline_s = default_deadline_s
+        self.watchdog_stall_s = watchdog_stall_s
+        self.watchdog_interval_s = watchdog_interval_s
+        self._pull_connector = None
+        if prefill_upstream:
+            self._pull_connector = HTTPPullConnector(
+                prefill_upstream,
+                retry=kv_retry or RetryPolicy(
+                    max_attempts=3, base_delay_s=0.1, max_delay_s=2.0),
+                fault_injector=kv_fault_injector,
+            )
         if engine is None:
             # resolve the preset lazily so injected engines may carry any
             # model name (fine-tunes, tests)
@@ -175,6 +208,8 @@ class EngineServer:
         self._inflight = 0  # HTTP handlers mid-request (drain waits)
         self._httpd: ThreadingHTTPServer | None = None
         self._engine_thread: threading.Thread | None = None
+        self._watchdog_thread: threading.Thread | None = None
+        self._watchdog_started = False
         self._profiling = False
         self.enable_profiling = (
             os.environ.get("FUSIONINFER_ENABLE_PROFILING", "") == "1"
@@ -263,6 +298,10 @@ class EngineServer:
                     meta["last_token_time"] = now
                     if out.finished:
                         self.metrics.e2e_latency.observe(now - meta["arrival"])
+                        # a finished request whose client drains slowly
+                        # keeps its channel registered — the watchdog
+                        # must not count it as stalled or expired
+                        meta["finished"] = True
                 if chan is not None:
                     chan.put(out)
             if getattr(self.engine, "multihost_shutdown", False):
@@ -270,6 +309,67 @@ class EngineServer:
                 # step may carry terminal tokens clients are waiting on
                 logger.info("multihost shutdown event; engine loop exits")
                 return
+
+    # -- watchdog ------------------------------------------------------------
+
+    def _ensure_watchdog(self) -> None:
+        """Start the watchdog thread on first need: servers with neither
+        deadlines nor a stall limit configured never pay its 20 Hz lock
+        acquisitions; a per-request ``deadline_s`` arms it lazily."""
+        with self._lock:
+            if self._watchdog_started or self._stop.is_set():
+                return
+            self._watchdog_started = True
+        self._watchdog_thread = threading.Thread(
+            target=self._watchdog_loop, daemon=True, name="watchdog")
+        self._watchdog_thread.start()
+
+    def _watchdog_loop(self) -> None:
+        """Abort requests past their deadline, and (when
+        ``watchdog_stall_s`` is set) requests whose decode made no token
+        progress for that long — a hung sequence must fail ITS client,
+        not wedge the batch.  The abort is two-sided: cancel engine-side
+        (frees slot/pages at the next step) and fail the channel NOW
+        (the client must not wait on an engine that may be the hung
+        part)."""
+        while not self._stop.is_set():
+            now = time.monotonic()
+            aborts: list[tuple[str, _RequestChannel | None, str]] = []
+            with self._lock:
+                for rid, meta in self._req_meta.items():
+                    if meta.get("aborted") or meta.get("finished"):
+                        continue
+                    reason = None
+                    deadline = meta.get("deadline")
+                    if deadline is not None and now > deadline:
+                        reason = "error:deadline exceeded"
+                    elif (self.watchdog_stall_s is not None
+                          and now - meta["last_token_time"]
+                          > self.watchdog_stall_s):
+                        reason = (f"error:watchdog: no token progress in "
+                                  f"{self.watchdog_stall_s}s")
+                    if reason is not None:
+                        meta["aborted"] = True
+                        aborts.append((rid, self._channels.get(rid), reason))
+            for rid, chan, reason in aborts:
+                logger.warning("watchdog aborting %s (%s)", rid, reason)
+                self.metrics.watchdog_aborts += 1
+                self.engine.cancel(rid)
+                if chan is not None:
+                    chan.put(StepOutput(request_id=rid, token=0,
+                                        finished=True, finish_reason=reason))
+            self._stop.wait(self.watchdog_interval_s)
+
+    def _deadline_of(self, body: dict) -> float | None:
+        """Per-request wall budget (extension field ``deadline_s``);
+        falls back to the server default.  The watchdog enforces it."""
+        raw = body.get("deadline_s")
+        if raw is None:
+            return None  # submit() applies the server default
+        deadline = float(raw)
+        if deadline <= 0:
+            raise ValueError("deadline_s must be > 0")
+        return deadline
 
     # -- request handling ----------------------------------------------------
 
@@ -287,9 +387,14 @@ class EngineServer:
         raise ValueError(f"unknown model {name!r}; see /v1/models")
 
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
-               lora: str = "", priority: int = 0) -> _RequestChannel:
+               lora: str = "", priority: int = 0,
+               deadline_s: float | None = None) -> _RequestChannel:
         request_id = uuid.uuid4().hex[:16]
         chan = _RequestChannel()
+        deadline_s = deadline_s if deadline_s is not None else self.default_deadline_s
+        if deadline_s is not None:
+            self._ensure_watchdog()
+        now = time.monotonic()
         with self._lock:
             # checked under the SAME lock drain() flips the flag under:
             # after drain sees the flag set, no new channel can register
@@ -297,8 +402,9 @@ class EngineServer:
                 raise Draining("server is draining; retry another replica")
             self._channels[request_id] = chan
             self._req_meta[request_id] = {
-                "arrival": time.monotonic(),
-                "last_token_time": time.monotonic(),
+                "arrival": now,
+                "last_token_time": now,
+                "deadline": (now + deadline_s) if deadline_s else None,
             }
         try:
             request = Request(request_id, prompt_tokens, params, lora=lora,
@@ -313,34 +419,64 @@ class EngineServer:
                     self.engine._adapter_id(request)
                 self.engine._validate_guided(request)
             if self.prefill_upstream:
-                # PD decode role: pull KV from the prefiller over DCN
-                from fusioninfer_tpu.engine.kv_transfer import HTTPPullConnector
-
-                # forward the FULL sampling state: the prefiller samples the
-                # first token, so seed/penalties/min_tokens must match what
-                # an aggregated deployment would have used
-                slab = HTTPPullConnector(self.prefill_upstream).request_prefill(
-                    request_id, prompt_tokens,
-                    sampling={
-                        "temperature": params.temperature,
-                        "top_k": params.top_k,
-                        "top_p": params.top_p,
-                        "min_p": params.min_p,
-                        "min_tokens": params.min_tokens,
-                        "stop_token_ids": list(params.stop_token_ids),
-                        "presence_penalty": params.presence_penalty,
-                        "frequency_penalty": params.frequency_penalty,
-                        "repetition_penalty": params.repetition_penalty,
-                        "seed": params.seed,
-                        # guided: the prefiller masks the first token
-                        # under the same grammar (both roles serve the
-                        # same model/tokenizer)
-                        "guided_json": params.guided_json,
-                        "guided_schema": params.guided_schema,
-                    },
-                    lora=lora,
-                )
-                self.engine.add_prefilled_request(request, slab)
+                # PD decode role: pull KV from the prefiller over DCN.
+                # Forward the FULL sampling state: the prefiller samples
+                # the first token, so seed/penalties/min_tokens must
+                # match what an aggregated deployment would have used.
+                sampling = {
+                    "temperature": params.temperature,
+                    "top_k": params.top_k,
+                    "top_p": params.top_p,
+                    "min_p": params.min_p,
+                    "min_tokens": params.min_tokens,
+                    "stop_token_ids": list(params.stop_token_ids),
+                    "presence_penalty": params.presence_penalty,
+                    "frequency_penalty": params.frequency_penalty,
+                    "repetition_penalty": params.repetition_penalty,
+                    "seed": params.seed,
+                    # guided: the prefiller masks the first token
+                    # under the same grammar (both roles serve the
+                    # same model/tokenizer)
+                    "guided_json": params.guided_json,
+                    "guided_schema": params.guided_schema,
+                }
+                try:
+                    slab = self._pull_connector.request_prefill(
+                        request_id, prompt_tokens, sampling=sampling,
+                        lora=lora)
+                except (KVTransferError, RetryBudgetExhausted) as e:
+                    # graceful degradation: the transfer budget is spent,
+                    # so prefill LOCALLY — the request completes (same
+                    # tokens: identical model/params/seed), just without
+                    # the PD split's latency win for this one request
+                    logger.warning(
+                        "KV pull for %s failed (%s); falling back to "
+                        "local prefill", request_id, e)
+                    with self._lock:  # handler threads race this counter
+                        self.metrics.kv_transfer_fallbacks += 1
+                    slab = None
+                # the watchdog may have aborted THIS request while the
+                # pull blocked; its engine.cancel() was a no-op (nothing
+                # admitted yet) and the channel already carries the error
+                # finish — admitting now would decode an orphan to
+                # max_tokens with no consumer
+                with self._lock:
+                    aborted = self._req_meta.get(request_id, {}).get("aborted")
+                if not aborted:
+                    if slab is None:
+                        self.engine.add_request(request)
+                    else:
+                        self.engine.add_prefilled_request(request, slab)
+                    # the watchdog may ALSO fire between that check and
+                    # the add — its cancel lands before admission and is
+                    # drained unseen.  Re-check now that the request is
+                    # admitted and re-issue the cancel so the next step
+                    # reaps it instead of decoding an orphan.
+                    with self._lock:
+                        aborted = self._req_meta.get(
+                            request_id, {}).get("aborted")
+                    if aborted:
+                        self.engine.cancel(request_id)
             else:
                 self.engine.add_request(request)
         except Exception:
@@ -589,6 +725,7 @@ class EngineServer:
         prompt_tokens = self.tokenizer.encode(prompt)
         lora = self._lora_of(body)  # ValueError on rejection
         priority = self._priority_of(body)
+        deadline_s = self._deadline_of(body)
         served = lora or self.model_name
         echo_prefix = prompt if (body.get("echo") and not chat) else ""
         opts = body.get("stream_options") or {}
@@ -607,7 +744,7 @@ class EngineServer:
             forced or not (params.guided_json or params.guided_schema))
         if n == 1:
             chan = self.submit(prompt_tokens, params, lora=lora,
-                               priority=priority)
+                               priority=priority, deadline_s=deadline_s)
             gen = self._stream_chunks(chan, chat, params.stop_strings,
                                       served_model=served,
                                       completion_id=completion_id,
@@ -620,7 +757,8 @@ class EngineServer:
                 gen = self._with_usage_chunk(gen, usage_meta, chat, served,
                                              completion_id, created)
             return chan, gen
-        chans = self._submit_n(prompt_tokens, params, lora, n, priority)
+        chans = self._submit_n(prompt_tokens, params, lora, n, priority,
+                               deadline_s=deadline_s)
         gens = [
             self._stream_chunks(c, chat, params.stop_strings,
                                 served_model=served, choice_index=i,
@@ -637,7 +775,7 @@ class EngineServer:
         return _MultiChannel(chans), merged
 
     def _submit_n(self, prompt_tokens, params, lora: str, n: int,
-                  priority: int = 0):
+                  priority: int = 0, deadline_s: float | None = None):
         """Submit n per-choice requests; on any failure, abort the ones
         already submitted (they would otherwise decode to max_tokens with
         no consumer and leak their channel registrations)."""
@@ -646,7 +784,7 @@ class EngineServer:
             for i in range(n):
                 chans.append(self.submit(
                     prompt_tokens, self._choice_params(params, i), lora=lora,
-                    priority=priority))
+                    priority=priority, deadline_s=deadline_s))
         except Exception:
             for c in chans:
                 self.abort(c)
@@ -974,7 +1112,8 @@ class EngineServer:
         # the engine's same-prompt dedup turns samples 2..n into
         # prefix-cache hits against sample 1's pages
         chans = self._submit_n(prompt_tokens, params, lora, n,
-                               self._priority_of(body))
+                               self._priority_of(body),
+                               deadline_s=self._deadline_of(body))
         echo = bool(body.get("echo"))
         choices = []
         total_completion = 0
@@ -1475,6 +1614,8 @@ class EngineServer:
     def start(self) -> None:
         self._engine_thread = threading.Thread(target=self._engine_loop, daemon=True, name="engine")
         self._engine_thread.start()
+        if self.default_deadline_s is not None or self.watchdog_stall_s is not None:
+            self._ensure_watchdog()
 
         class _Server(ThreadingHTTPServer):
             # socketserver's default accept backlog is 5: a reconnect

@@ -12,11 +12,19 @@ import datetime
 COND_INITIALIZED = "Initialized"
 COND_ACTIVE = "Active"
 COND_FAILED = "Failed"
+# Degraded: reconciliation keeps erroring and the manager's per-key
+# retry budget ran out — the service is still being retried (at the
+# backoff ceiling) but needs attention; cleared by the next successful
+# reconcile.  The reference leans on controller-runtime's rate-limited
+# workqueue here; our manager surfaces budget exhaustion explicitly.
+COND_DEGRADED = "Degraded"
 
 REASON_CREATING = "Creating"
 REASON_PROCESSING = "Processing"
 REASON_AVAILABLE = "Available"
 REASON_FAILED = "Failed"
+REASON_RETRY_BUDGET_EXHAUSTED = "RetryBudgetExhausted"
+REASON_RECOVERED = "Recovered"
 
 
 def _now() -> str:
@@ -76,3 +84,13 @@ def set_failed(status: dict, generation: int, message: str) -> None:
 def clear_failed(status: dict, generation: int) -> None:
     if get_condition(status, COND_FAILED):
         set_condition(status, COND_FAILED, False, REASON_AVAILABLE, "", generation)
+
+
+def set_degraded(status: dict, generation: int, message: str) -> None:
+    set_condition(status, COND_DEGRADED, True, REASON_RETRY_BUDGET_EXHAUSTED,
+                  message, generation)
+
+
+def clear_degraded(status: dict, generation: int) -> None:
+    if get_condition(status, COND_DEGRADED):
+        set_condition(status, COND_DEGRADED, False, REASON_RECOVERED, "", generation)

@@ -30,6 +30,12 @@ logger = logging.getLogger("fusioninfer.kubeclient")
 
 SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# Every apiserver request carries an explicit socket timeout (watches get
+# timeout_seconds + slack instead).  A controller thread blocked forever
+# on a half-open TCP connection looks exactly like a healthy idle one —
+# the audit rule `tools/lint_resilience.py` enforces this repo-wide.
+DEFAULT_API_TIMEOUT_S = 30.0
+
 
 class KubeConfig:
     def __init__(self, host: str, token: Optional[str] = None, ca_file: Optional[str] = None,
@@ -85,7 +91,8 @@ class KubeClient(K8sClient):
     # -- plumbing --
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 query: Optional[dict] = None, timeout: float = 30.0):
+                 query: Optional[dict] = None,
+                 timeout: float = DEFAULT_API_TIMEOUT_S):
         url = self.config.host + path
         if query:
             url += "?" + urllib.parse.urlencode(query)

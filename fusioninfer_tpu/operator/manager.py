@@ -24,6 +24,7 @@ from typing import Optional
 from fusioninfer_tpu.operator.client import K8sClient
 from fusioninfer_tpu.operator.modelloader import ModelLoaderReconciler
 from fusioninfer_tpu.operator.reconciler import InferenceServiceReconciler
+from fusioninfer_tpu.resilience import RetryPolicy
 
 logger = logging.getLogger("fusioninfer.manager")
 
@@ -45,8 +46,16 @@ OWNED_KINDS = [
 ROOT_KINDS = ["InferenceService", "ModelLoader"]
 LOADER_OWNED_KINDS = ["Job"]
 
-REQUEUE_DELAY_S = 5.0
+REQUEUE_DELAY_S = 5.0  # progress requeue (no errors, still converging)
 RESYNC_PERIOD_S = 300.0
+# Error-requeue backoff (controller-runtime's rate-limited workqueue
+# equivalent): per-key exponential delays; once max_attempts consecutive
+# failures are burned the key keeps retrying at the ceiling and the
+# InferenceService reports a Degraded condition instead of hot-looping.
+DEFAULT_REQUEUE_BACKOFF = dict(
+    max_attempts=6, base_delay_s=0.5, max_delay_s=60.0, jitter="full")
+# requeue_delays keeps this many recent delays per key (observability)
+REQUEUE_HISTORY_MAX = 32
 TOKEN_CACHE_TTL_S = 60.0  # TokenReview verdicts cached per scrape token
 TOKEN_CACHE_MAX = 1024  # hard cap; oldest-expiry entries evicted beyond it
 
@@ -131,7 +140,9 @@ class Manager:
                  metrics_auth: str = "none",
                  metrics_tls: bool = False,
                  metrics_cert_path: str | None = None,
-                 metrics_key_path: str | None = None):
+                 metrics_key_path: str | None = None,
+                 requeue_backoff: RetryPolicy | None = None,
+                 fault_injector=None):
         """``leader_elect``: active/standby HA via a coordination.k8s.io
         Lease (the reference's ``--leader-elect``, cmd/main.go:80-82):
         controllers start only on acquiring the lease; losing it stops
@@ -171,6 +182,17 @@ class Manager:
         self.loader_reconciler = ModelLoaderReconciler(client)
         self.workqueue = WorkQueue()  # keys: (kind, namespace, name)
         self.metrics = ControllerMetrics()
+        # per-key error-requeue state: consecutive-failure counts feed
+        # the backoff policy; recent delays are kept for observability
+        # (and the chaos suite asserts their exponential growth)
+        self.requeue_backoff = requeue_backoff or RetryPolicy(
+            **DEFAULT_REQUEUE_BACKOFF)
+        self.requeue_delays: dict[tuple, list[float]] = {}
+        self._attempts: dict[tuple, int] = {}
+        self._degraded_marked: set[tuple] = set()
+        self._requeue_timers: list[threading.Timer] = []
+        self._timers_lock = threading.Lock()
+        self._fault_injector = fault_injector
         self._stop = threading.Event()
         self.ready = threading.Event()
         self.leadership_lost = False
@@ -229,6 +251,48 @@ class Manager:
 
     # -- worker --
 
+    def _requeue_later(self, key: tuple, delay: float) -> None:
+        """Schedule a delayed re-add; timers are tracked so stop() can
+        cancel them (a stopped manager must not keep feeding its queue).
+        The _stop check rides the same lock stop() cancels under, so a
+        worker finishing its in-flight reconcile after stop() cannot
+        slip a fresh timer past the cancellation sweep."""
+        timer = threading.Timer(delay, self.workqueue.add, args=(key,))
+        timer.daemon = True
+        with self._timers_lock:
+            if self._stop.is_set():
+                return
+            self._requeue_timers = [
+                t for t in self._requeue_timers if t.is_alive()]
+            self._requeue_timers.append(timer)
+            timer.start()
+
+    def _record_requeue_delay(self, key: tuple, delay: float) -> None:
+        history = self.requeue_delays.setdefault(key, [])
+        history.append(delay)
+        del history[:-REQUEUE_HISTORY_MAX]
+
+    def _mark_degraded(self, key: tuple, attempts: int) -> bool:
+        """Returns True once the condition no longer needs writing —
+        written, or nothing to write.  A False (status write racing an
+        apiserver outage — likely, since the object is already erroring)
+        makes the caller try again on the NEXT ceiling requeue instead
+        of losing the condition forever."""
+        kind, ns, name = key
+        if kind != "InferenceService":
+            return True  # ModelLoader status has no condition list
+        try:
+            self.reconciler.mark_degraded(
+                ns, name,
+                f"reconcile failed {attempts} consecutive times; retrying "
+                f"at the {self.requeue_backoff.max_delay_s:g}s backoff "
+                "ceiling",
+            )
+            return True
+        except Exception as e:
+            logger.warning("could not mark %s/%s Degraded: %s", ns, name, e)
+            return False
+
     def _worker(self) -> None:
         while not self._stop.is_set():
             key = self.workqueue.get(timeout=1.0)
@@ -240,19 +304,48 @@ class Manager:
             )
             t0 = time.monotonic()
             try:
+                if self._fault_injector is not None:
+                    self._fault_injector.fire(f"operator.reconcile.{kind}")
                 result = rec.reconcile(ns, name)
             except Exception:
                 logger.exception("reconcile %s %s/%s panicked", kind, ns, name)
                 result = None
-            requeued = result is not None and (result.requeue or bool(result.errors))
+            failed = result is None or bool(result.errors)
+            progressing = result is not None and result.requeue and not failed
             self.metrics.observe(
                 kind.lower(),
                 time.monotonic() - t0,
                 errors=len(result.errors) if result is not None else 1,
-                requeued=requeued,
+                requeued=failed or progressing,
             )
-            if requeued:
-                threading.Timer(REQUEUE_DELAY_S, self.workqueue.add, args=(key,)).start()
+            if failed:
+                # error requeue: per-key exponential backoff with a
+                # bounded budget — a persistently broken object retries
+                # at the ceiling and surfaces Degraded, instead of
+                # hot-looping at a flat delay (or, for panics, being
+                # silently dropped as before)
+                attempts = self._attempts.get(key, 0) + 1
+                self._attempts[key] = attempts
+                if attempts >= self.requeue_backoff.max_attempts:
+                    delay = self.requeue_backoff.max_delay_s
+                    if (key not in self._degraded_marked
+                            and self._mark_degraded(key, attempts)):
+                        self._degraded_marked.add(key)
+                else:
+                    delay = self.requeue_backoff.delay(attempts)
+                self._record_requeue_delay(key, delay)
+                self._requeue_later(key, delay)
+            elif progressing:
+                # still converging (children not ready): flat-delay poll,
+                # and a success resets the error budget (the reconcile
+                # pass itself cleared any Degraded condition)
+                self._attempts.pop(key, None)
+                self._degraded_marked.discard(key)
+                self._requeue_later(key, REQUEUE_DELAY_S)
+            else:
+                self._attempts.pop(key, None)
+                self._degraded_marked.discard(key)
+                self.requeue_delays.pop(key, None)
 
     # -- probes + metrics --
 
@@ -438,6 +531,10 @@ class Manager:
     def stop(self) -> None:
         self._stop.set()
         self.ready.clear()
+        with self._timers_lock:
+            for timer in self._requeue_timers:
+                timer.cancel()
+            self._requeue_timers.clear()
         if self.elector is not None:
             self.elector.stop()
         close = getattr(self.client, "close_watches", None)

@@ -107,6 +107,9 @@ class InferenceServiceReconciler:
 
         all_ready = self._update_component_status(svc, prev_status, status)
         cond.clear_failed(status, svc.generation)
+        # a successful pass means the retry budget's Degraded verdict no
+        # longer holds (the manager sets it; recovery clears it here)
+        cond.clear_degraded(status, svc.generation)
         if all_ready:
             cond.set_active(status, svc.generation)
         else:
@@ -115,6 +118,22 @@ class InferenceServiceReconciler:
 
         self._write_status(raw, prev_status, status)
         return result
+
+    def mark_degraded(self, namespace: str, name: str, message: str) -> None:
+        """Called by the manager when a key's requeue budget is
+        exhausted: persistent reconcile failure becomes an observable
+        ``Degraded`` condition instead of an invisible hot loop.  The
+        next successful reconcile clears it."""
+        raw = self.client.get_or_none("InferenceService", namespace, name)
+        if raw is None:
+            return  # deleted while backing off; nothing to report
+        prev_status = dict(raw.get("status") or {})
+        status = {k: (list(v) if isinstance(v, list) else dict(v)
+                      if isinstance(v, dict) else v)
+                  for k, v in prev_status.items()}
+        generation = (raw.get("metadata") or {}).get("generation", 1)
+        cond.set_degraded(status, generation, message)
+        self._write_status(raw, prev_status, status)
 
     # -- children --
 

@@ -1,0 +1,36 @@
+"""Shared resilience layer: retry/backoff, circuit breaking, deadlines,
+and deterministic fault injection.
+
+At slice scale partial failure is the steady state (PAPERS.md: TPU-fleet
+resilience from v2 to Ironwood; topology-aware preemption) — so failure
+handling is a subsystem, not per-call-site improvisation.  Four layers
+share this one model:
+
+* the operator's workqueue requeues reconcile errors with per-key
+  exponential backoff and a bounded budget that surfaces as a
+  ``Degraded`` condition (:mod:`fusioninfer_tpu.operator.manager`);
+* the KV-transfer connector retries with backoff over a CRC-checked
+  wire format and degrades to a local re-prefill when the budget is
+  exhausted (:mod:`fusioninfer_tpu.engine.kv_transfer`);
+* the router ejects failing endpoints behind circuit breakers and
+  probes them half-open (:mod:`fusioninfer_tpu.router.picker`);
+* the engine server enforces per-request deadlines with a decode-loop
+  watchdog (:mod:`fusioninfer_tpu.engine.server`).
+
+Everything here is deterministic under a seed (retry jitter, injector
+draws) so chaos runs replay bit-identically, and the injector is a
+strict no-op unless a test/chaos run arms it.  Design note:
+``docs/design/resilience.md``.
+"""
+
+from fusioninfer_tpu.resilience.breaker import CircuitBreaker
+from fusioninfer_tpu.resilience.faults import FaultInjector, InjectedFault
+from fusioninfer_tpu.resilience.retry import RetryBudgetExhausted, RetryPolicy
+
+__all__ = [
+    "CircuitBreaker",
+    "FaultInjector",
+    "InjectedFault",
+    "RetryBudgetExhausted",
+    "RetryPolicy",
+]

@@ -1,0 +1,138 @@
+"""Circuit breaker: closed → open → half-open with passive failure counts.
+
+Passive means the breaker only observes outcomes its owner reports
+(``record_success`` / ``record_failure``) — no probe traffic of its own,
+matching the EPP's health-aware routing posture where the data plane is
+the health signal.  The half-open state rations real requests as probes:
+``allow()`` hands out at most ``half_open_max_probes`` tokens per
+recovery window, so one recovering endpoint never absorbs a retry storm.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    """Thread-safe three-state breaker.
+
+    * ``closed``: all calls allowed; ``failure_threshold`` CONSECUTIVE
+      failures trip it open (a single success resets the count).
+    * ``open``: all calls refused until ``recovery_timeout_s`` elapses,
+      then the next ``allow()`` transitions to half-open.  Successes
+      reported while open are stale (sent before the trip) and ignored.
+    * ``half-open``: up to ``half_open_max_probes`` calls allowed; a
+      success closes, a failure re-opens (fresh recovery window).  A
+      probe whose outcome is never reported (caller crashed, request
+      orphaned) must not wedge the breaker: once ``recovery_timeout_s``
+      passes with no verdict, a fresh probe window opens.
+
+    ``clock`` is injectable so chaos tests drive recovery windows
+    deterministically instead of sleeping through them.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        recovery_timeout_s: float = 30.0,
+        half_open_max_probes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(f"failure_threshold must be >= 1, got {failure_threshold}")
+        if recovery_timeout_s < 0:
+            raise ValueError("recovery_timeout_s must be >= 0")
+        if half_open_max_probes < 1:
+            raise ValueError("half_open_max_probes must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.recovery_timeout_s = recovery_timeout_s
+        self.half_open_max_probes = half_open_max_probes
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probes_issued = 0
+        self._probe_window_at = 0.0
+
+    # -- state --
+
+    def _maybe_half_open_locked(self) -> None:
+        now = self._clock()
+        if (self._state == OPEN
+                and now - self._opened_at >= self.recovery_timeout_s):
+            self._state = HALF_OPEN
+            self._probes_issued = 0
+            self._probe_window_at = now
+        elif (self._state == HALF_OPEN
+                and self._probes_issued >= self.half_open_max_probes
+                and now - self._probe_window_at >= self.recovery_timeout_s):
+            # every probe went out and no verdict ever came back — the
+            # callers vanished mid-request.  Re-arm rather than wedge.
+            self._probes_issued = 0
+            self._probe_window_at = now
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed right now?  In half-open this CONSUMES a
+        probe token — callers should only ask when they will actually
+        send the request."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                return False
+            if self._probes_issued >= self.half_open_max_probes:
+                return False
+            self._probes_issued += 1
+            return True
+
+    # -- outcome reporting --
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == OPEN or (self._state == HALF_OPEN
+                                       and self._probes_issued == 0):
+                # stale evidence: a request sent BEFORE the trip just
+                # completed.  Only a half-open probe verdict may close —
+                # otherwise one slow success from a now-dead endpoint
+                # re-admits it mid-recovery-window and it flaps.
+                # Known window: outcomes are anonymous, so once a probe
+                # IS in flight a stale success arriving before the
+                # probe's verdict still closes; distinguishing them
+                # needs per-outcome probe tokens, not worth the API
+                # weight for a request that already outlived a full
+                # recovery window.
+                return
+            self._consecutive_failures = 0
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self._probes_issued = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == HALF_OPEN:
+                # the probe failed: back to a fresh recovery window
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._consecutive_failures = self.failure_threshold
+                return
+            self._consecutive_failures += 1
+            if (self._state == CLOSED
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._state = OPEN
+                self._opened_at = self._clock()

@@ -1,0 +1,139 @@
+"""Deterministic fault injection: named sites, seeded decisions.
+
+Production code carries cheap, explicit injection points (``drop`` /
+``delay`` / ``error`` / ``corrupt``) that are strict no-ops until a test
+or chaos run arms them.  Every decision draws from one seeded RNG in
+call order, so a chaos schedule replays bit-identically — the property
+that turns "flaky failure soup" into a regression suite
+(``tests/test_resilience.py``, ``make chaos``).
+
+Sites in the tree today:
+
+===========================  ================================================
+``kv.pull``                  before the decoder's prefill pull RPC
+                             (:mod:`fusioninfer_tpu.engine.kv_transfer`)
+``kv.pull.response``         corrupts the pulled slab frame (CRC32 catches)
+``router.metrics.<ep>``      a picker endpoint's metrics scrape
+                             (:mod:`fusioninfer_tpu.router.picker`)
+``operator.reconcile.<Kind>``  one reconcile invocation
+                             (:mod:`fusioninfer_tpu.operator.manager`)
+===========================  ================================================
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+MODES = ("drop", "delay", "error", "corrupt")
+
+
+class InjectedFault(Exception):
+    """Raised at an armed site (modes ``drop`` and ``error``).  ``drop``
+    models a vanished peer (callers map it to their timeout-shaped
+    error); ``error`` models an explicit failure response."""
+
+    def __init__(self, site: str, mode: str):
+        super().__init__(f"injected {mode} at {site}")
+        self.site = site
+        self.mode = mode
+
+
+@dataclass
+class _Rule:
+    mode: str
+    probability: float
+    delay_s: float
+    times: Optional[int]  # max firings; None = unlimited
+    after: int  # skip the first N calls at this site
+    calls: int = 0
+    fired: int = 0
+
+
+class FaultInjector:
+    """Seeded, thread-safe fault scheduler.  Idle cost at an unarmed
+    site is one dict lookup; the default (no rules) injector is safe to
+    leave wired in production."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._rules: dict[str, _Rule] = {}
+        self._lock = threading.Lock()
+
+    # -- arming --
+
+    def arm(self, site: str, mode: str, *, probability: float = 1.0,
+            delay_s: float = 0.05, times: Optional[int] = None,
+            after: int = 0) -> "FaultInjector":
+        """Arm one site.  ``times`` bounds total firings (``times=1`` is
+        "fail once, then heal"); ``after`` skips the first N calls;
+        ``probability`` gates each eligible call through the seeded RNG.
+        Returns self so tests can chain arms."""
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError(f"probability must be in [0, 1], got {probability}")
+        with self._lock:
+            self._rules[site] = _Rule(mode, probability, delay_s, times, after)
+        return self
+
+    def disarm(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            if site is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(site, None)
+
+    @property
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    def fired_count(self, site: str) -> int:
+        with self._lock:
+            rule = self._rules.get(site)
+            return rule.fired if rule is not None else 0
+
+    # -- decision --
+
+    def _decide(self, site: str, modes: tuple) -> Optional[_Rule]:
+        with self._lock:
+            rule = self._rules.get(site)
+            if rule is None or rule.mode not in modes:
+                return None
+            rule.calls += 1
+            if rule.calls <= rule.after:
+                return None
+            if rule.times is not None and rule.fired >= rule.times:
+                return None
+            if rule.probability < 1.0 and self._rng.random() >= rule.probability:
+                return None
+            rule.fired += 1
+            return rule
+
+    # -- injection points --
+
+    def fire(self, site: str, sleep: Callable[[float], None] = time.sleep) -> None:
+        """The call-path injection point for ``drop`` / ``error`` /
+        ``delay``.  No-op unless armed (``corrupt`` rules only act at
+        :meth:`corrupt` sites); ``delay`` sleeps then proceeds; ``drop``
+        and ``error`` raise :class:`InjectedFault`."""
+        rule = self._decide(site, ("drop", "delay", "error"))
+        if rule is None:
+            return
+        if rule.mode == "delay":
+            sleep(rule.delay_s)
+            return
+        raise InjectedFault(site, rule.mode)
+
+    def corrupt(self, site: str, data: bytes) -> bytes:
+        """The payload injection point: when armed with ``corrupt``,
+        flip the last byte (always payload, never the frame header, so
+        integrity checks — not parse errors — must catch it)."""
+        rule = self._decide(site, ("corrupt",))
+        if rule is None or not data:
+            return data
+        return data[:-1] + bytes([data[-1] ^ 0xFF])

@@ -1,0 +1,110 @@
+"""Retry policy: exponential backoff with full jitter and a deadline budget.
+
+The shape AWS/gRPC converged on — ``delay = uniform(0, min(cap, base *
+mult^attempt))`` — because full jitter decorrelates a thundering herd of
+retriers (a failed slice's worth of decode replicas all re-pulling KV at
+once) better than equal or decorrelated jitter.  Delays draw from a
+seeded RNG so a chaos run's schedule replays exactly.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+class RetryBudgetExhausted(Exception):
+    """All attempts (or the deadline budget) spent; carries the last error."""
+
+    def __init__(self, message: str, last_error: Optional[BaseException] = None):
+        super().__init__(message)
+        self.last_error = last_error
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff + full jitter, bounded by attempts AND a wall
+    budget.  ``seed`` pins the jitter stream; ``jitter="none"`` makes the
+    schedule itself the deterministic artifact (operator requeue tests).
+    """
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.2
+    max_delay_s: float = 30.0
+    multiplier: float = 2.0
+    jitter: str = "full"  # "full" | "none"
+    deadline_s: Optional[float] = None  # total wall budget across attempts
+    seed: Optional[int] = None
+    _rng: random.Random = field(init=False, repr=False, compare=False)
+    _lock: threading.Lock = field(init=False, repr=False, compare=False)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("delays must be >= 0")
+        if self.multiplier < 1.0:
+            raise ValueError(f"multiplier must be >= 1, got {self.multiplier}")
+        if self.jitter not in ("full", "none"):
+            raise ValueError(f"jitter must be 'full' or 'none', got {self.jitter!r}")
+        object.__setattr__(self, "_rng", random.Random(self.seed))
+        object.__setattr__(self, "_lock", threading.Lock())
+
+    def backoff_cap(self, attempt: int) -> float:
+        """Un-jittered delay ceiling after ``attempt`` failures (1-based)."""
+        if attempt < 1:
+            raise ValueError(f"attempt is 1-based, got {attempt}")
+        return min(self.max_delay_s,
+                   self.base_delay_s * self.multiplier ** (attempt - 1))
+
+    def delay(self, attempt: int) -> float:
+        """Next sleep after ``attempt`` consecutive failures (1-based)."""
+        cap = self.backoff_cap(attempt)
+        if self.jitter == "none":
+            return cap
+        with self._lock:  # the seeded stream must not interleave mid-draw
+            return self._rng.uniform(0.0, cap)
+
+    def run(
+        self,
+        fn: Callable,
+        *,
+        retry_on: tuple = (Exception,),
+        retry_if: Optional[Callable[[BaseException], bool]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        on_retry: Optional[Callable[[int, float, BaseException], None]] = None,
+    ):
+        """Call ``fn`` under this policy.  Retries only ``retry_on``
+        errors; anything else propagates immediately (a 400-shaped error
+        must not burn the budget of a 503-shaped one).  ``retry_if``
+        refines within a caught type — return False to propagate (one
+        exception class can carry both retryable and terminal statuses).
+        Raises :class:`RetryBudgetExhausted` wrapping the last error once
+        attempts or the deadline budget run out."""
+        start = clock()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except retry_on as e:  # noqa: PERF203
+                if retry_if is not None and not retry_if(e):
+                    raise
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    raise RetryBudgetExhausted(
+                        f"{attempt} attempt(s) failed: {e}", last_error=e
+                    ) from e
+                d = self.delay(attempt)
+                if (self.deadline_s is not None
+                        and clock() - start + d > self.deadline_s):
+                    raise RetryBudgetExhausted(
+                        f"deadline budget {self.deadline_s}s exhausted after "
+                        f"{attempt} attempt(s): {e}", last_error=e
+                    ) from e
+                if on_retry is not None:
+                    on_retry(attempt, d, e)
+                sleep(d)

@@ -29,11 +29,18 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import logging
+import threading
+import time
 import urllib.request
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from fusioninfer_tpu.resilience import CircuitBreaker
+from fusioninfer_tpu.resilience.breaker import CLOSED, OPEN
 from fusioninfer_tpu.router.epp_schema import validate_epp_config
+
+logger = logging.getLogger("fusioninfer.picker")
 
 
 @dataclass
@@ -41,6 +48,68 @@ class Endpoint:
     name: str
     url: str
     labels: dict
+
+
+class EndpointHealth:
+    """Per-endpoint circuit breakers fed by passive signals: data-plane
+    outcomes the routing caller reports (:meth:`record`) and scrape
+    failures the picker observes itself.  An OPEN endpoint is ejected
+    from candidate selection; after ``recovery_timeout_s`` it re-enters
+    half-open and :meth:`admit` rations real requests as probes — a
+    probe success recovers it, a failure re-ejects it for another
+    window."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 recovery_timeout_s: float = 15.0,
+                 half_open_max_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self._failure_threshold = failure_threshold
+        self._recovery_timeout_s = recovery_timeout_s
+        self._half_open_max_probes = half_open_max_probes
+        self._clock = clock
+        # guards the breaker DICT (creation/eviction under concurrent
+        # pick()s); each CircuitBreaker is internally locked already
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(name)
+            if b is None:
+                b = self._breakers[name] = CircuitBreaker(
+                    failure_threshold=self._failure_threshold,
+                    recovery_timeout_s=self._recovery_timeout_s,
+                    half_open_max_probes=self._half_open_max_probes,
+                    clock=self._clock,
+                )
+            return b
+
+    def admit(self, name: str) -> bool:
+        """May this endpoint receive a request?  Consumes a half-open
+        probe token when the breaker is recovering — ask only for the
+        endpoint a request will actually be sent to (the picker asks at
+        selection time, never for losing candidates)."""
+        return self.breaker(name).allow()
+
+    def record(self, name: str, ok: bool) -> None:
+        b = self.breaker(name)
+        if ok:
+            b.record_success()
+        else:
+            b.record_failure()
+
+    def state(self, name: str) -> str:
+        return self.breaker(name).state
+
+    def retain(self, names) -> None:
+        """Drop breakers for endpoints no longer in the fleet snapshot —
+        pod churn must not grow the dict forever.  A returning endpoint
+        starts with a fresh (closed) breaker and re-earns its state."""
+        keep = set(names)
+        with self._lock:
+            for name in list(self._breakers):
+                if name not in keep:
+                    del self._breakers[name]
 
 
 def scrape_metrics(url: str, timeout: float = 5.0) -> dict[str, float]:
@@ -106,10 +175,16 @@ class EndpointPicker:
 
     def __init__(self, config_yaml: str,
                  endpoints: Callable[[], list[Endpoint]],
-                 metrics: Callable[[Endpoint], dict] = None):
+                 metrics: Callable[[Endpoint], dict] = None,
+                 health: Optional[EndpointHealth] = None,
+                 fault_injector=None):
         self.config = validate_epp_config(config_yaml)
         self._endpoints = endpoints
         self._metrics = metrics or (lambda ep: scrape_metrics(ep.url))
+        # health-aware selection: callers report request outcomes via
+        # report_result(); open breakers eject endpoints from pick()
+        self.health = health or EndpointHealth()
+        self._fault_injector = fault_injector
         self._plugins = {
             (p.get("name") or p["type"]): p for p in self.config.get("plugins", [])
         }
@@ -153,6 +228,9 @@ class EndpointPicker:
         chosen endpoint's prefix blocks are recorded for affinity."""
         prof = self._profiles.get(profile) or next(iter(self._profiles.values()))
         candidates = list(self._endpoints())
+        # evict breakers for endpoints that left the fleet (before
+        # profile filters: filtered-out endpoints are still alive)
+        self.health.retain(ep.name for ep in candidates)
         scorers: list[tuple[str, dict, float]] = []
         for ref in prof.get("plugins", []):
             plugin = self._plugins.get(ref["pluginRef"])
@@ -170,22 +248,76 @@ class EndpointPicker:
                 )
         if not candidates:
             return None
-        best, best_score = None, float("-inf")
-        for ep in candidates:
-            metrics = self._metrics(ep) if any(
-                p["type"] in ("kv-cache-utilization-scorer", "queue-scorer")
-                for _, p, _ in scorers
-            ) else {}
+        # circuit breaking: endpoints with an open breaker are ejected;
+        # half-open ones compete normally but consume their rationed
+        # probe token only when actually SELECTED — an unpicked candidate
+        # must not burn the probe (no request would carry its outcome,
+        # and the breaker would wedge half-open with nothing left to
+        # close or re-open it).  If EVERY candidate is ejected, route to
+        # the full set anyway — during a total outage a guess beats a
+        # guaranteed 503; recovery then rides the normal half-open
+        # probes once each breaker's window elapses (last-resort
+        # outcomes are not probe verdicts and do not close breakers).
+        states = {ep.name: self.health.state(ep.name) for ep in candidates}
+        selectable = [ep for ep in candidates if states[ep.name] != OPEN]
+        last_resort = not selectable
+        if last_resort:
+            logger.warning(
+                "all %d candidate endpoints circuit-broken; routing "
+                "to the full set as a last resort", len(candidates))
+            selectable = candidates
+        want_metrics = any(
+            p["type"] in ("kv-cache-utilization-scorer", "queue-scorer")
+            for _, p, _ in scorers
+        )
+        ranked: list[tuple[float, int, Endpoint]] = []
+        for i, ep in enumerate(selectable):
+            metrics = self._scrape(ep) if want_metrics else {}
             total = sum(
                 w * self._score(key, plugin, prompt, ep, metrics)
                 for key, plugin, w in scorers
             )
-            if total > best_score:
-                best, best_score = ep, total
+            ranked.append((total, i, ep))
+        ranked.sort(key=lambda t: (-t[0], t[1]))  # argmax, first-wins ties
+        best = None
+        for _total, _i, ep in ranked:
+            if last_resort or states[ep.name] == CLOSED:
+                best = ep
+                break
+            if self.health.admit(ep.name):  # half-open: consume the probe
+                best = ep
+                break
+        if best is None:
+            # every selectable endpoint is half-open with its probe
+            # already in flight: best-effort route to the top score
+            best = ranked[0][2]
         for key, plugin, _ in scorers:
             if key in self._affinity:
                 self._affinity[key].record(prompt, best)
         return best
+
+    def _scrape(self, ep: Endpoint) -> dict:
+        """One endpoint's metrics, with the scrape itself as a passive
+        health signal: a raising scrape counts a breaker failure (the
+        default scraper returns {} on failure, which the scorers already
+        treat as worst — only a custom/raising metrics callable and the
+        chaos injector land here)."""
+        try:
+            if self._fault_injector is not None:
+                self._fault_injector.fire(f"router.metrics.{ep.name}")
+            return self._metrics(ep)
+        except Exception as e:
+            logger.warning("metrics scrape for %s failed: %s", ep.name, e)
+            self.health.record(ep.name, ok=False)
+            return {}
+
+    def report_result(self, endpoint: Endpoint | str, ok: bool) -> None:
+        """Data-plane feedback from the routing caller: did the request
+        this picker routed to ``endpoint`` succeed?  Failures trip the
+        endpoint's breaker (ejecting it from selection); successes close
+        it (recovering a half-open endpoint)."""
+        name = endpoint if isinstance(endpoint, str) else endpoint.name
+        self.health.record(name, ok)
 
     def pick_pd(self, prompt: str) -> tuple[Optional[Endpoint], Optional[Endpoint]]:
         """PD profiles: the prefill leg's endpoint and the decode leg's."""

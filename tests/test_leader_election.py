@@ -162,6 +162,80 @@ class TestManagerLeaderElection:
         assert wait_for(lambda: m.leadership_lost, timeout=10.0)
         assert m._stop.is_set(), "lost leadership must stop the manager"
 
+    def test_leadership_loss_mid_reconcile_preserves_queue_and_resync(self):
+        """Losing the lease while a reconcile is in flight must stop the
+        worker WITHOUT dropping the keys still queued behind it, and a
+        freshly elected manager must resync those objects from its
+        initial list — work deferred, never lost."""
+        import threading
+
+        from fusioninfer_tpu.operator.leaderelection import _rfc3339
+
+        client = FakeK8s()
+        m1 = Manager(client, probe_port=0, metrics_port=0, leader_elect=True,
+                     leader_identity="m1", leader_election_config=FAST)
+        # wedge m1's reconciler: the worker blocks mid-reconcile while
+        # more keys pile up behind it in the workqueue
+        entered = threading.Event()
+        gate = threading.Event()
+
+        def wedged_reconcile(ns, name):
+            entered.set()
+            gate.wait(timeout=30)
+            raise RuntimeError("reconcile interrupted by leadership loss")
+
+        m1.reconciler.reconcile = wedged_reconcile
+        m1.start()
+        m2 = None
+        try:
+            assert wait_for(lambda: m1.is_leader)
+            client.create(sample_service("one"))
+            assert entered.wait(10), "worker must pick up the new service"
+            queued = [("InferenceService", "default", "queued-a"),
+                      ("InferenceService", "default", "queued-b")]
+            for key in queued:
+                m1.workqueue.add(key)
+            lease = client.get("Lease", "default", m1.elector.name)
+            lease["spec"]["holderIdentity"] = "usurper"
+            lease["spec"]["renewTime"] = _rfc3339(time.time() + 60)
+            client.update(lease)
+            assert wait_for(lambda: m1.leadership_lost, timeout=10.0)
+            # the stop must not have flushed the queue: keys enqueued
+            # before the loss are still pending for whoever leads next
+            for key in queued:
+                assert key in m1.workqueue._pending, f"{key} dropped on loss"
+            gate.set()  # unblock the wedged worker; its loop exits on _stop
+            assert wait_for(
+                lambda: not any(
+                    t.is_alive() for t in m1._threads
+                    if t.name == "reconcile-worker"),
+                timeout=10.0,
+            ), "worker must exit after leadership loss"
+            assert client.get_or_none(
+                "LeaderWorkerSet", "default", "one-worker-0") is None
+
+            # the usurper dies; a new manager takes the expired lease and
+            # must converge 'one' from its startup list (clean resync)
+            lease = client.get("Lease", "default", m1.elector.name)
+            lease["spec"]["renewTime"] = _rfc3339(time.time() - 60)
+            lease["spec"]["leaseDurationSeconds"] = 1
+            client.update(lease)
+            m2 = Manager(client, probe_port=0, metrics_port=0,
+                         leader_elect=True, leader_identity="m2",
+                         leader_election_config=FAST)
+            m2.start()
+            assert wait_for(lambda: m2.is_leader, timeout=10.0)
+            assert wait_for(
+                lambda: client.get_or_none(
+                    "LeaderWorkerSet", "default", "one-worker-0") is not None,
+                timeout=10.0,
+            ), "re-elected manager must resync the interrupted service"
+        finally:
+            gate.set()
+            m1.stop()
+            if m2 is not None:
+                m2.stop()
+
 
 @pytest.mark.parametrize("bad", [
     dict(lease_duration=1.0, renew_deadline=1.0, retry_period=0.1),

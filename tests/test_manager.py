@@ -2,6 +2,7 @@
 (fake client has no watch stream -> manager falls back to list+resync),
 child-event owner mapping, and probe endpoints."""
 
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -53,15 +54,17 @@ def test_manager_reconciles_from_initial_list(unused_tcp_port=18081):
                 break
             time.sleep(0.05)
         assert fake.get("LeaderWorkerSet", "default", "svc-worker-0")
-        with urllib.request.urlopen(f"http://127.0.0.1:{unused_tcp_port}/healthz") as r:
+        with urllib.request.urlopen(f"http://127.0.0.1:{unused_tcp_port}/healthz",
+                                    timeout=10) as r:
             assert r.status == 200
-        with urllib.request.urlopen(f"http://127.0.0.1:{unused_tcp_port}/readyz") as r:
+        with urllib.request.urlopen(f"http://127.0.0.1:{unused_tcp_port}/readyz",
+                                    timeout=10) as r:
             assert r.status == 200
         # the reconcile above must be visible on the metrics endpoint
         deadline = time.time() + 5
         while time.time() < deadline:
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{unused_tcp_port + 1}/metrics"
+                f"http://127.0.0.1:{unused_tcp_port + 1}/metrics", timeout=10
             ) as r:
                 body = r.read().decode()
             if 'controller_runtime_reconcile_total{controller="inferenceservice"} 0' not in body:
@@ -71,6 +74,59 @@ def test_manager_reconciles_from_initial_list(unused_tcp_port=18081):
         assert 'controller_runtime_reconcile_total{controller="inferenceservice"} 0' not in body
     finally:
         mgr.stop()
+
+
+def test_stop_preserves_queued_keys_and_cancels_requeue_timers():
+    """stop() (the leadership-loss path ends here) must leave queued keys
+    in place for the next leader and cancel in-flight requeue timers so a
+    stopped manager does not keep feeding its own queue."""
+    mgr = Manager(FakeK8s(), namespace="default", probe_port=0)
+    key_queued = ("InferenceService", "default", "queued")
+    key_later = ("InferenceService", "default", "later")
+    mgr.workqueue.add(key_queued)
+    mgr._requeue_later(key_later, delay=0.2)
+    mgr.stop()
+    time.sleep(0.4)  # past the timer's delay: a cancelled timer stays quiet
+    assert key_queued in mgr.workqueue._pending, "stop() must not drop keys"
+    assert key_later not in mgr.workqueue._pending, (
+        "cancelled requeue timer must not re-add its key after stop()")
+    assert mgr.workqueue.get(timeout=0.05) == key_queued
+
+
+def test_error_requeue_backoff_grows_then_degrades():
+    """A key that keeps failing reconcile must see exponentially growing
+    requeue delays (never a flat hot-loop), and once the per-key budget
+    is spent the delay pins to the ceiling."""
+    from fusioninfer_tpu.resilience import RetryPolicy
+
+    class AlwaysFails(FakeK8s):
+        def get_or_none(self, kind, namespace, name):
+            raise RuntimeError("apiserver down")
+
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.01, max_delay_s=0.08,
+                         jitter="none")
+    mgr = Manager(AlwaysFails(), namespace="default", probe_port=0,
+                  requeue_backoff=policy)
+    key = ("InferenceService", "default", "svc")
+    mgr._stop.clear()
+    worker = threading.Thread(target=mgr._worker, daemon=True)
+    worker.start()
+    try:
+        mgr.workqueue.add(key)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if len(mgr.requeue_delays.get(key, [])) >= 6:
+                break
+            time.sleep(0.02)
+        delays = mgr.requeue_delays[key][:6]
+        assert len(delays) == 6, f"expected 6 requeues, saw {delays}"
+        # attempts 1..3 double each time; 4+ pin to the ceiling
+        assert delays[0] < delays[1] < delays[2], f"not growing: {delays}"
+        assert delays[1] == 2 * delays[0] and delays[2] == 4 * delays[0]
+        assert delays[3] == delays[4] == delays[5] == policy.max_delay_s
+    finally:
+        mgr.stop()
+        worker.join(timeout=5)
 
 
 def test_enqueue_owner_maps_child_to_parent():
