@@ -44,6 +44,10 @@ cleanup-test-e2e: ## Tear down the e2e kind cluster.
 chaos: ## Fault-injection chaos suite (seeded, deterministic; docs/design/resilience.md).
 	$(PYTHON) -m pytest tests/test_resilience.py -q -m chaos
 
+.PHONY: autoscale
+autoscale: ## Autoscaling suite (fake-clock control-loop + drain + chaos; docs/design/autoscaling.md).
+	$(PYTHON) -m pytest tests/test_autoscale.py tests/test_metrics.py -q
+
 .PHONY: lint
 lint: ## Gating lint: in-repo AST linter + resilience rules + byte-compile (CI adds ruff).
 	$(PYTHON) tools/lint.py

@@ -8,8 +8,8 @@ const sidebars = {
     {
       type: 'category',
       label: 'Design',
-      items: ['design/crd', 'design/engine', 'design/parallelism',
-              'design/resilience', 'design/router'],
+      items: ['design/autoscaling', 'design/crd', 'design/engine',
+              'design/parallelism', 'design/resilience', 'design/router'],
     },
   ],
 };

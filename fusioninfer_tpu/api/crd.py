@@ -54,6 +54,29 @@ def _role_schema() -> dict:
                 "type": "object",
                 "properties": {"nodeCount": {"type": "integer", "minimum": 1}},
             },
+            "autoscaling": {
+                "type": "object",
+                "properties": {
+                    "enabled": {"type": "boolean", "default": True},
+                    "minReplicas": {"type": "integer", "minimum": 1, "default": 1},
+                    "maxReplicas": {"type": "integer", "minimum": 1, "default": 4},
+                    "targets": {
+                        "type": "object",
+                        "properties": {
+                            "queueLength": {"type": "number", "minimum": 0},
+                            "kvCacheUtilization": {
+                                "type": "number",
+                                "minimum": 0,
+                                "maximum": 1,
+                            },
+                            "ttftP90Seconds": {"type": "number", "minimum": 0},
+                        },
+                    },
+                    "scaleUpStabilizationSeconds": {"type": "number", "minimum": 0},
+                    "scaleDownStabilizationSeconds": {"type": "number", "minimum": 0},
+                    "drainDeadlineSeconds": {"type": "number", "minimum": 0},
+                },
+            },
             "strategy": {
                 "type": "string",
                 "enum": [s.value for s in RoutingStrategy],

@@ -107,6 +107,119 @@ class Multinode:
 
 
 @dataclass
+class AutoscalingSpec:
+    """Slice-granular, PD-aware autoscaling for one worker-like role.
+
+    Replicas move in whole TPU-slice units (one replica = one
+    gang-scheduled slice of the role's ``tpu`` shape), between
+    ``min_replicas`` and ``max_replicas``.  Which target drives the role
+    is PD-aware: prefill roles saturate on queue wait / TTFT, decode
+    roles on KV-cache pressure (``autoscale.recommender``); a target
+    left unset simply contributes no signal.  Scale-down always drains
+    victims first (``drain_deadline_s``) — a slice is shrunk, never
+    killed mid-request.
+    """
+
+    enabled: bool = True
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # target values, HPA-style: desired = ceil(current * actual / target)
+    target_queue_length: Optional[float] = None  # waiting requests per replica
+    target_kv_cache_utilization: Optional[float] = None  # mean usage, (0, 1]
+    target_ttft_p90_s: Optional[float] = None  # windowed p90 seconds
+    # asymmetric stabilization: up fast, down slow (HPA semantics: the
+    # down window holds the MAX recommendation seen inside it)
+    scale_up_stabilization_s: float = 0.0
+    scale_down_stabilization_s: float = 300.0
+    drain_deadline_s: float = 120.0
+
+    def targets(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        if self.target_queue_length is not None:
+            out["queueLength"] = self.target_queue_length
+        if self.target_kv_cache_utilization is not None:
+            out["kvCacheUtilization"] = self.target_kv_cache_utilization
+        if self.target_ttft_p90_s is not None:
+            out["ttftP90Seconds"] = self.target_ttft_p90_s
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AutoscalingSpec":
+        targets = d.get("targets") or {}
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            min_replicas=int(d.get("minReplicas", 1)),
+            max_replicas=int(d.get("maxReplicas", 4)),
+            target_queue_length=(
+                float(targets["queueLength"]) if "queueLength" in targets else None
+            ),
+            target_kv_cache_utilization=(
+                float(targets["kvCacheUtilization"])
+                if "kvCacheUtilization" in targets else None
+            ),
+            target_ttft_p90_s=(
+                float(targets["ttftP90Seconds"])
+                if "ttftP90Seconds" in targets else None
+            ),
+            scale_up_stabilization_s=float(d.get("scaleUpStabilizationSeconds", 0.0)),
+            scale_down_stabilization_s=float(d.get("scaleDownStabilizationSeconds", 300.0)),
+            drain_deadline_s=float(d.get("drainDeadlineSeconds", 120.0)),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {
+            "enabled": self.enabled,
+            "minReplicas": self.min_replicas,
+            "maxReplicas": self.max_replicas,
+        }
+        targets = self.targets()
+        if targets:
+            out["targets"] = targets
+        if self.scale_up_stabilization_s != 0.0:
+            out["scaleUpStabilizationSeconds"] = self.scale_up_stabilization_s
+        if self.scale_down_stabilization_s != 300.0:
+            out["scaleDownStabilizationSeconds"] = self.scale_down_stabilization_s
+        if self.drain_deadline_s != 120.0:
+            out["drainDeadlineSeconds"] = self.drain_deadline_s
+        return out
+
+    def validate(self, role_name: str) -> None:
+        if self.min_replicas < 1:
+            raise ValidationError(
+                f"role {role_name!r}: autoscaling.minReplicas must be >= 1 "
+                "(scale-to-zero would leave the router nothing to drain to)"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValidationError(
+                f"role {role_name!r}: autoscaling.maxReplicas must be >= minReplicas"
+            )
+        if self.enabled and not self.targets():
+            raise ValidationError(
+                f"role {role_name!r}: autoscaling needs at least one target "
+                "(queueLength, kvCacheUtilization, or ttftP90Seconds)"
+            )
+        for key, value in self.targets().items():
+            if value <= 0:
+                raise ValidationError(
+                    f"role {role_name!r}: autoscaling target {key} must be > 0"
+                )
+        if (self.target_kv_cache_utilization is not None
+                and self.target_kv_cache_utilization > 1.0):
+            raise ValidationError(
+                f"role {role_name!r}: kvCacheUtilization target is a "
+                "fraction in (0, 1]"
+            )
+        if self.scale_up_stabilization_s < 0 or self.scale_down_stabilization_s < 0:
+            raise ValidationError(
+                f"role {role_name!r}: stabilization windows must be >= 0"
+            )
+        if self.drain_deadline_s < 0:
+            raise ValidationError(
+                f"role {role_name!r}: drainDeadlineSeconds must be >= 0"
+            )
+
+
+@dataclass
 class Role:
     name: str
     component_type: ComponentType
@@ -116,6 +229,7 @@ class Role:
     tpu: Optional[TPUSlice] = None
     multinode: Optional[Multinode] = None
     engine: EngineKind = EngineKind.VLLM_TPU
+    autoscaling: Optional[AutoscalingSpec] = None
     # router fields
     strategy: Optional[RoutingStrategy] = None
     httproute: Optional[dict] = None  # raw HTTPRouteSpec passthrough
@@ -157,6 +271,10 @@ class Role:
             tpu=TPUSlice.from_dict(d["tpu"]) if d.get("tpu") else None,
             multinode=Multinode.from_dict(d["multinode"]) if d.get("multinode") else None,
             engine=engine,
+            autoscaling=(
+                AutoscalingSpec.from_dict(d["autoscaling"])
+                if d.get("autoscaling") else None
+            ),
             strategy=strategy,
             httproute=d.get("httproute"),
             gateway=d.get("gateway"),
@@ -175,6 +293,8 @@ class Role:
                 out["tpu"] = self.tpu.to_dict()
             if self.multinode is not None:
                 out["multinode"] = self.multinode.to_dict()
+            if self.autoscaling is not None:
+                out["autoscaling"] = self.autoscaling.to_dict()
         if self.template is not None:
             out["template"] = self.template
         if self.strategy is not None:
@@ -316,7 +436,14 @@ class InferenceService:
                     raise ValidationError(f"role {role.name!r}: worker roles require a pod template")
                 if role.tpu is not None:
                     role.tpu.resolve()  # raises TopologyError on bad shapes
+                if role.autoscaling is not None:
+                    role.autoscaling.validate(role.name)
             else:
+                if role.autoscaling is not None:
+                    raise ValidationError(
+                        f"role {role.name!r}: only worker-like roles can "
+                        "carry an autoscaling stanza"
+                    )
                 if role.strategy is None and role.endpoint_picker_config is None:
                     raise ValidationError(
                         f"role {role.name!r}: router roles need a strategy or endpointPickerConfig"

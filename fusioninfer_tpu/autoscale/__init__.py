@@ -1,0 +1,45 @@
+"""Slice-granular, PD-aware autoscaling (docs/design/autoscaling.md).
+
+A metrics-driven control loop that scales prefill/decode roles in whole
+TPU-slice units: the **collector** scrapes per-endpoint engine metrics
+under PR 1's retry/breaker posture, the **policy** runs an HPA-style
+target-value law with asymmetric stabilization and whole-slice rounding,
+the **recommender** routes each role's component type to the signals
+that bind it (prefill: queue/TTFT; decode: KV residency), and the
+**drainer** shrinks via drain-then-delete so no in-flight request is
+ever killed by a scale-down.
+"""
+
+from fusioninfer_tpu.autoscale.collector import (
+    EndpointSample,
+    MetricsCollector,
+    RoleSignals,
+    parse_engine_sample,
+)
+from fusioninfer_tpu.autoscale.controller import (
+    AutoscaleController,
+    default_endpoints_for,
+)
+from fusioninfer_tpu.autoscale.drainer import DEADLINE, DRAINED, DRAINING, Drainer
+from fusioninfer_tpu.autoscale.metrics import AutoscalerMetrics
+from fusioninfer_tpu.autoscale.policy import Decision, ScalingPolicy, desired_for_ratio
+from fusioninfer_tpu.autoscale.recommender import SIGNALS_FOR_TYPE, PDRecommender
+
+__all__ = [
+    "AutoscaleController",
+    "AutoscalerMetrics",
+    "DEADLINE",
+    "DRAINED",
+    "DRAINING",
+    "Decision",
+    "Drainer",
+    "EndpointSample",
+    "MetricsCollector",
+    "PDRecommender",
+    "RoleSignals",
+    "SIGNALS_FOR_TYPE",
+    "ScalingPolicy",
+    "default_endpoints_for",
+    "desired_for_ratio",
+    "parse_engine_sample",
+]

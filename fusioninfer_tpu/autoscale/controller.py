@@ -1,0 +1,499 @@
+"""The autoscale control loop: collect → recommend → apply, per tick.
+
+One :class:`AutoscaleController` watches every ``InferenceService`` in
+its namespace and, for each worker-like role carrying an ``autoscaling``
+stanza, runs the pipeline
+
+    endpoints → MetricsCollector → PDRecommender/ScalingPolicy → verdict
+
+and applies verdicts through the API server, never directly to pods:
+
+* **Scale up** patches ``spec.roles[*].replicas`` immediately.  The
+  reconciler then renders the new LWS replica AND the grown PodGroup
+  ``minMember`` from the same spec in one pass — replicas and gang
+  quorum can never disagree (whole-slice atomicity).
+* **Scale down** first runs the drain protocol
+  (:mod:`fusioninfer_tpu.autoscale.drainer`): victims — always the
+  highest replica indexes, because the reconciler's orphan sweep deletes
+  from the top — are marked draining in the routing layer, polled to
+  zero in-flight (bounded by ``drainDeadlineSeconds``), and only then is
+  the shrink patched.  A drain whose role comes back under pressure is
+  abandoned and the victims rejoin the rotation.
+
+Observability: ``ScalingActive`` / ``ScalingLimited`` conditions on the
+InferenceService status, plus Prometheus self-metrics
+(:mod:`fusioninfer_tpu.autoscale.metrics`) served from the manager's
+metrics port.
+
+The loop never calls ``time.time()``/``time.sleep()`` (lint-enforced):
+``clock`` is injected for determinism and pacing rides an
+``Event.wait``.  Each :meth:`step` is synchronous and idempotent — tests
+drive ticks one by one against the fake API server with a fake clock.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from fusioninfer_tpu.api.types import InferenceService, Role
+from fusioninfer_tpu.autoscale.collector import MetricsCollector
+from fusioninfer_tpu.autoscale.drainer import DRAINING, Drainer
+from fusioninfer_tpu.autoscale.metrics import AutoscalerMetrics
+from fusioninfer_tpu.autoscale.recommender import PDRecommender
+from fusioninfer_tpu.operator import conditions as cond
+from fusioninfer_tpu.operator.client import Conflict, K8sClient
+from fusioninfer_tpu.router.inferencepool import BACKEND_PORT
+from fusioninfer_tpu.workload.labels import LABEL_DRAINING
+from fusioninfer_tpu.workload.lws import generate_lws_name
+
+logger = logging.getLogger("fusioninfer.autoscale.controller")
+
+DEFAULT_INTERVAL_S = 15.0
+
+# stamped on a victim's LeaderWorkerSet while it drains — the
+# cross-process routing signal: the in-process EndpointPicker excludes
+# endpoints whose labels carry it (picker.py reads LABEL_DRAINING from
+# the endpoint snapshot), and set_draining covers embedders that share
+# the picker instance directly
+DRAINING_LABEL = LABEL_DRAINING
+
+
+def lws_drain_marker(client: K8sClient, namespace: str):
+    """Default ``mark_draining`` hook: record the drain on the victim's
+    LWS object as a label.  Endpoint names ARE the LWS names
+    (:func:`default_endpoints_for`), so the hook patches the object the
+    routing layer already watches — no side channel."""
+
+    def mark(name: str, draining: bool) -> None:
+        # raises on failure: the Drainer's level-triggered sync_marks
+        # owns retries, so a Conflict with the reconciler updating the
+        # same LWS is retried next tick rather than silently dropped
+        obj = client.get_or_none("LeaderWorkerSet", namespace, name)
+        if obj is None:
+            return  # already deleted (post-shrink unmark)
+        labels = obj.setdefault("metadata", {}).setdefault("labels", {})
+        present = labels.get(DRAINING_LABEL) == "true"
+        if present == draining:
+            return  # idempotent: no write when the label already agrees
+        if draining:
+            labels[DRAINING_LABEL] = "true"
+        else:
+            del labels[DRAINING_LABEL]
+        client.update(obj)
+
+    return mark
+
+
+def default_endpoints_for(svc: InferenceService, role: Role) -> list[tuple[str, str]]:
+    """Replica-index-ordered engine endpoints for a role: the LWS leader
+    services the router scrapes, ``{lws-name}.{namespace}:BACKEND_PORT``.
+    Index order matters — scale-down victims are the highest indexes."""
+    return [
+        (
+            generate_lws_name(svc.name, role.name, i),
+            f"http://{generate_lws_name(svc.name, role.name, i)}"
+            f".{svc.namespace}:{BACKEND_PORT}",
+        )
+        for i in range(role.replicas)
+    ]
+
+
+class AutoscaleController:
+    def __init__(
+        self,
+        client: K8sClient,
+        namespace: str = "default",
+        collector: Optional[MetricsCollector] = None,
+        endpoints_for: Callable[
+            [InferenceService, Role], list[tuple[str, str]]
+        ] = default_endpoints_for,
+        clock: Callable[[], float] = time.monotonic,
+        mark_draining: Optional[Callable[[str, bool], None]] = None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        metrics: Optional[AutoscalerMetrics] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self._clock = clock
+        self.collector = collector or MetricsCollector(clock=clock)
+        self._endpoints_for = endpoints_for
+        self.recommender = PDRecommender(clock)
+        if mark_draining is None:
+            mark_draining = lws_drain_marker(client, namespace)
+        self.drainer = Drainer(clock=clock, mark_draining=mark_draining)
+        self.interval_s = interval_s
+        self.metrics = metrics or AutoscalerMetrics()
+
+    # -- loop --
+
+    def run(self, stop: threading.Event) -> None:
+        """Tick until ``stop`` is set (pacing via Event.wait, not sleep)."""
+        while not stop.is_set():
+            try:
+                self.step()
+            except Exception:
+                logger.exception("autoscale tick failed; continuing")
+            stop.wait(self.interval_s)
+
+    def step(self) -> None:
+        """One synchronous pass over every InferenceService."""
+        live_keys: set[tuple] = set()
+        live_endpoints: set[str] = set()
+        for raw in self.client.list("InferenceService", self.namespace):
+            try:
+                svc = InferenceService.from_dict(raw)
+                svc.validate()
+            except ValueError:
+                continue  # the reconciler surfaces Failed; nothing to scale
+            try:
+                self._step_service(raw, svc, live_keys, live_endpoints)
+            except Exception:
+                # one service's API hiccup must not starve the rest of
+                # the namespace (or stall their in-progress drains)
+                logger.exception("autoscale pass for %s/%s failed; "
+                                 "continuing", svc.namespace, svc.name)
+        self.recommender.forget(live_keys)
+        self.collector.retain(live_endpoints)
+        self.metrics.retain(live_keys)
+        for key in self.drainer.keys():
+            if key not in live_keys:
+                # the role's stanza was removed (or the service deleted)
+                # mid-drain: release the marks instead of leaking a
+                # permanent no-new-assignments sentence on the victims
+                self.drainer.abandon(key)
+        self.drainer.sync_marks()  # re-assert marks; retry failures
+        self._sweep_orphaned_drain_labels()
+
+    def _sweep_orphaned_drain_labels(self) -> None:
+        """Unlabel LWS objects carrying the drain label that no active
+        drain owns — a controller that crashed (or lost leadership)
+        mid-drain leaks its in-memory drain state, and an orphaned label
+        is a slice silently excluded from routing forever."""
+        owned = {
+            name
+            for key in self.drainer.keys()
+            for name, _url in self.drainer.active(key).victims
+        }
+        try:
+            labeled = self.client.list(
+                "LeaderWorkerSet", self.namespace, {LABEL_DRAINING: "true"})
+        except Exception as e:
+            logger.warning("drain-label sweep list failed: %s", e)
+            return
+        for obj in labeled:
+            name = (obj.get("metadata") or {}).get("name", "")
+            if name in owned:
+                continue
+            logger.warning(
+                "releasing orphaned drain label on %s/%s (no active drain "
+                "owns it — predecessor crashed mid-drain?)",
+                self.namespace, name)
+            try:
+                del obj["metadata"]["labels"][LABEL_DRAINING]
+                self.client.update(obj)
+            except Exception as e:
+                logger.warning("could not release drain label on %s: %s",
+                               name, e)
+
+    # -- per service --
+
+    def _step_service(self, raw: dict, svc: InferenceService,
+                      live_keys: set[tuple],
+                      live_endpoints: set[str]) -> None:
+        limited: list[str] = []
+        limit_reasons: set[str] = set()
+        no_signal: list[str] = []
+        saw_signal = False
+        enabled = False
+        # register every role's liveness FIRST: if a later role's API
+        # call raises mid-service, the end-of-step cleanup must not read
+        # the unprocessed roles as "gone" and abandon their drains /
+        # evict their breaker and stabilization state
+        scaled_roles = []
+        for role in svc.spec.worker_roles():
+            if role.autoscaling is None or not role.autoscaling.enabled:
+                continue
+            scaled_roles.append(role)
+            live_keys.add((svc.namespace, svc.name, role.name))
+            live_endpoints.update(
+                name for name, _ in self._endpoints_for(svc, role))
+        for role in scaled_roles:
+            enabled = True
+            key = (svc.namespace, svc.name, role.name)
+            try:
+                verdict = self._step_role(raw, svc, role, key,
+                                          limited, limit_reasons)
+            except Exception:
+                # one role's API hiccup must not abort its siblings (or
+                # the end-of-service condition write)
+                logger.exception("autoscale pass for %s/%s role %s failed; "
+                                 "continuing", svc.namespace, svc.name,
+                                 role.name)
+                continue
+            if verdict == "no-signal":
+                no_signal.append(role.name)
+            else:
+                saw_signal = True
+        if enabled:
+            # conservative: ONE blind role flips ScalingActive False
+            # (scaling of the sighted roles continues regardless — the
+            # condition is observability, not a gate)
+            self._write_conditions(raw, saw_signal and not no_signal,
+                                   no_signal, limited, limit_reasons)
+        else:
+            # autoscaling switched off: a lingering ScalingActive=True /
+            # ScalingLimited=True would report an autoscaler that is in
+            # fact ignoring this service
+            self._clear_conditions(raw)
+
+    def _step_role(self, raw: dict, svc: InferenceService, role: Role,
+                   key: tuple, limited: list[str],
+                   limit_reasons: set[str]) -> str:
+        """One role's tick: advance its drain or evaluate fresh signals.
+        Returns "signal" when the loop actively managed the role this
+        tick, "no-signal" when the role was blind (holding)."""
+        spec = role.autoscaling
+        assert spec is not None
+        if self.drainer.active(key) is not None:
+            # mid-drain: the loop is actively managing.  Abandoned
+            # drains re-evaluate NEXT tick: a second collect now would
+            # re-scrape the survivors and consume their TTFT bucket
+            # deltas twice in one tick
+            self._continue_drain(key, raw, svc, role)
+            return "signal"
+        signals = self.collector.collect(self._endpoints_for(svc, role))
+        if signals is None:
+            # partitioned role: hold last-known-good, say so
+            logger.warning(
+                "no usable metrics for %s/%s role %s; holding at %d "
+                "replicas", svc.namespace, svc.name, role.name,
+                role.replicas)
+            return "no-signal"
+        decision = self.recommender.recommend(key, role, role.replicas, signals)
+        if decision.limited:
+            limited.append(f"{role.name}: {decision.limit_reason}")
+            limit_reasons.add(decision.limit_reason)
+        usable = signals.fresh_endpoints + signals.stale_endpoints
+        if decision.desired > role.replicas and usable < role.replicas:
+            # replicas the last scale-up bought are still provisioning
+            # (no sample yet): buying MORE now would compound the same
+            # pressure reading straight to maxReplicas before a single
+            # new slice comes up — HPA's unready-pod discounting,
+            # slice-granular
+            logger.info(
+                "hold scale-up of %s/%s role %s: %d of %d replicas "
+                "not yet reporting", svc.namespace, svc.name,
+                role.name, role.replicas - usable, role.replicas)
+            self.metrics.observe(
+                svc.namespace, svc.name, role.name, decision.desired,
+                role.replicas, "hold")
+            return "signal"
+        if decision.desired > role.replicas:
+            if not self._apply_replicas(raw, role.name, decision.desired):
+                return "signal"  # conflicted: next tick recommends afresh
+            self.metrics.observe(
+                svc.namespace, svc.name, role.name, decision.desired,
+                role.replicas, "up", scaled_at=self._clock())
+            logger.info(
+                "scale up %s/%s role %s: %d → %d (%s)", svc.namespace,
+                svc.name, role.name, role.replicas, decision.desired,
+                "; ".join(decision.reasons))
+        elif decision.desired < role.replicas:
+            victims = self._endpoints_for(svc, role)[decision.desired:]
+            self.drainer.begin(key, victims, decision.desired,
+                               spec.drain_deadline_s)
+            # "drain" = the decision to start; "down" is recorded only
+            # when the shrink actually lands, so down-decisions and
+            # applied scales stay 1:1 on dashboards
+            self.metrics.observe(
+                svc.namespace, svc.name, role.name, decision.desired,
+                role.replicas, "drain")
+        else:
+            self.metrics.observe(
+                svc.namespace, svc.name, role.name, decision.desired,
+                role.replicas, "hold")
+        return "signal"
+
+    def _continue_drain(self, key: tuple, raw: dict, svc: InferenceService,
+                        role: Role) -> None:
+        """Advance one role's drain by one tick: abandon it if pressure
+        returned, keep waiting, or apply the shrink."""
+        state = self.drainer.active(key)
+        assert state is not None
+        # the drain plan was computed against a replica count that no
+        # longer holds (user edit mid-drain): shrinking to the stale
+        # target would sweep replicas that were never drained — abandon
+        # and re-evaluate against the new spec next tick
+        if role.replicas != state.target_replicas + len(state.victims):
+            logger.info(
+                "drain %s planned at %d replicas but spec now has %d; "
+                "abandoning", key,
+                state.target_replicas + len(state.victims), role.replicas)
+            self.drainer.abandon(key)
+            return
+        # pressure returned? re-check live signals on the SURVIVOR set —
+        # the victims are refusing new work and would bias the read; if
+        # the survivors alone could not hold the load at the post-shrink
+        # size, the shrink is wrong and the drain is abandoned (the role
+        # re-evaluates against the full fleet next tick)
+        survivors = self._endpoints_for(svc, role)[: state.target_replicas]
+        signals = self.collector.collect(survivors) if survivors else None
+        if signals is not None:
+            decision = self.recommender.recommend(
+                key, role, state.target_replicas, signals)
+            if decision.desired > state.target_replicas:
+                self.drainer.abandon(key)
+                return
+        verdict = self.drainer.poll(key, self.collector.in_flight)
+        if verdict == DRAINING:
+            return
+        # DRAINED or DEADLINE: apply the shrink; if the patch conflicts,
+        # KEEP the drain state (marks held, victims stay idle) and retry
+        # the apply next tick — releasing the victims on a failed patch
+        # would hand them fresh requests and restart the drain from zero
+        if not self._apply_replicas(raw, role.name, state.target_replicas):
+            return
+        self.metrics.observe(
+            svc.namespace, svc.name, role.name, state.target_replicas,
+            role.replicas, "down", scaled_at=self._clock())
+        logger.info(
+            "scale down %s/%s role %s: %d → %d (%s)", svc.namespace,
+            svc.name, role.name, role.replicas, state.target_replicas, verdict)
+        self.drainer.finish(key)
+
+    # -- apply --
+
+    def _apply_replicas(self, raw: dict, role_name: str, replicas: int) -> bool:
+        """Patch ONE role's replicas into the raw object and update;
+        returns False when nothing landed on the API server.
+
+        The write carries the raw dict's resourceVersion, so a user edit
+        racing the autoscaler loses nothing: our update conflicts, this
+        tick skips, and the next tick recommends against the new spec.
+        The reconciler picks the change up (spec watch) and renders the
+        LWS set and PodGroup ``minMember`` from one spec revision —
+        that's the replicas+gang atomicity contract.
+        """
+        for role_raw in (raw.get("spec") or {}).get("roles") or []:
+            if role_raw.get("name") == role_name:
+                prev = role_raw.get("replicas")
+                role_raw["replicas"] = replicas
+                break
+        else:
+            return False
+        try:
+            updated = self.client.update(raw)
+            raw["metadata"]["resourceVersion"] = (
+                updated.get("metadata") or {}).get("resourceVersion")
+            return True
+        except Conflict:
+            role_raw["replicas"] = prev  # keep raw honest for this tick
+            logger.info("replicas patch for role %s conflicted; retrying "
+                        "next tick", role_name)
+            return False
+
+    def _clear_conditions(self, raw: dict) -> None:
+        """Mark both scaling conditions False/disabled — only when they
+        exist (a never-autoscaled service gets no status churn, and the
+        list() snapshot answers that without an extra GET per tick)."""
+        meta = raw.get("metadata") or {}
+        snapshot = raw.get("status") or {}
+        if not any(cond.get_condition(snapshot, c)
+                   for c in (cond.COND_SCALING_ACTIVE,
+                             cond.COND_SCALING_LIMITED)):
+            return
+        # already cleared?  the snapshot check above only proves the
+        # conditions EXIST — skip the GET+write cycle once they are
+        # False/disabled, or every disabled service pays a no-op status
+        # PUT (and a reconciler watch wake-up) per tick forever
+        active = cond.get_condition(snapshot, cond.COND_SCALING_ACTIVE)
+        limited_cond = cond.get_condition(snapshot, cond.COND_SCALING_LIMITED)
+        if ((active is None or active.get("reason") == cond.REASON_SCALING_DISABLED)
+                and (limited_cond is None or limited_cond.get("status") == "False")):
+            return
+        fresh = self.client.get_or_none(
+            raw.get("kind", "InferenceService"),
+            meta.get("namespace", "default"), meta.get("name", ""))
+        if fresh is None:
+            return
+        prev_status = dict(fresh.get("status") or {})
+        status = {
+            k: (list(v) if isinstance(v, list) else dict(v)
+                if isinstance(v, dict) else v)
+            for k, v in prev_status.items()
+        }
+        generation = (fresh.get("metadata") or {}).get("generation", 1)
+        if cond.get_condition(status, cond.COND_SCALING_ACTIVE):
+            cond.set_condition(status, cond.COND_SCALING_ACTIVE, False,
+                               cond.REASON_SCALING_DISABLED,
+                               "autoscaling disabled", generation)
+        cond.clear_scaling_limited(status, generation)
+        if status == prev_status:
+            return
+        try:
+            self.client.update_status({
+                "apiVersion": raw["apiVersion"],
+                "kind": raw["kind"],
+                "metadata": {
+                    "name": meta["name"],
+                    "namespace": meta.get("namespace", "default"),
+                },
+                "status": status,
+            })
+        except Exception as e:
+            logger.warning("scaling condition clear failed: %s", e)
+
+    def _write_conditions(self, raw: dict, saw_signal: bool,
+                          no_signal: list[str], limited: list[str],
+                          limit_reasons: set[str]) -> None:
+        meta = raw.get("metadata") or {}
+        # re-GET before writing: the tick-start snapshot is seconds old
+        # by now (scrapes + retries happened in between) and the
+        # reconciler may have written componentStatus/Degraded since —
+        # update_status replaces the whole subresource, so building on
+        # the stale snapshot would silently revert those writes
+        fresh = self.client.get_or_none(
+            raw.get("kind", "InferenceService"),
+            meta.get("namespace", "default"), meta.get("name", ""))
+        if fresh is None:
+            return  # deleted mid-tick
+        prev_status = dict(fresh.get("status") or {})
+        status = {
+            k: (list(v) if isinstance(v, list) else dict(v)
+                if isinstance(v, dict) else v)
+            for k, v in prev_status.items()
+        }
+        generation = (fresh.get("metadata") or {}).get("generation", 1)
+        if saw_signal:
+            cond.set_scaling_active(status, generation)
+        else:
+            cond.set_scaling_inactive(
+                status, generation,
+                "no usable metrics from roles: " + ", ".join(no_signal))
+        if limited:
+            # at-max outranks at-min when different roles hit different
+            # bounds: under-capacity is the user-visible emergency
+            reason = (cond.REASON_TOO_MANY_REPLICAS
+                      if "AtMaxReplicas" in limit_reasons
+                      else cond.REASON_TOO_FEW_REPLICAS)
+            cond.set_scaling_limited(status, generation, "; ".join(limited),
+                                     reason=reason)
+        else:
+            cond.clear_scaling_limited(status, generation)
+        if status == prev_status:
+            return
+        try:
+            self.client.update_status({
+                "apiVersion": raw["apiVersion"],
+                "kind": raw["kind"],
+                "metadata": {
+                    "name": meta["name"],
+                    "namespace": meta.get("namespace", "default"),
+                },
+                "status": status,
+            })
+        except Exception as e:
+            logger.warning("scaling condition write failed: %s", e)

@@ -1,0 +1,145 @@
+"""Graceful drain: a slice is shrunk, never killed mid-request.
+
+Slice economics make drain-then-shrink the only sane scale-down on TPU
+(arXiv:2606.15870's resilience framing): killing a replica aborts every
+in-flight decode on that slice and throws away its KV working set, so
+the autoscaler instead
+
+1. marks the victim endpoints **draining** via the injected
+   ``mark_draining`` hook — the router picker stops handing them new
+   assignments (existing streams keep flowing),
+2. polls each victim's in-flight count (waiting + running) every control
+   tick, and
+3. releases the shrink once every victim reports zero in flight, or
+   once ``deadline_s`` elapses — a wedged request must not pin a slice
+   forever; past the deadline the pod's own terminationGracePeriod is
+   the last line.
+
+The state machine is non-blocking: ``poll`` returns a verdict and the
+control loop moves on — nothing sleeps holding the loop hostage.  An
+unreachable victim (``in_flight`` → None) counts as *not yet drained*:
+silence is never treated as idle.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+logger = logging.getLogger("fusioninfer.autoscale.drainer")
+
+# poll verdicts
+DRAINING = "draining"
+DRAINED = "drained"
+DEADLINE = "deadline"
+
+
+@dataclass
+class DrainState:
+    """One role's in-progress drain toward ``target_replicas``."""
+
+    victims: list[tuple[str, str]]  # [(endpoint name, url)]
+    target_replicas: int
+    started_at: float
+    deadline_s: float
+    idle: set[str] = field(default_factory=set)  # victims seen at zero in-flight
+
+
+class Drainer:
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        mark_draining: Optional[Callable[[str, bool], None]] = None,
+    ):
+        self._clock = clock
+        # hook into the routing layer (in-process: EndpointPicker.set_draining;
+        # production: the LWS drain label the routing layer filters on).
+        # Marking is LEVEL-TRIGGERED: desired state is recorded here and
+        # synced every tick, so a hook failure (Conflict with the
+        # reconciler, API hiccup) retries instead of permanently leaking
+        # a mark — a stuck "draining" label is a lost slice of capacity,
+        # a stuck unmarked victim is a drain that can never finish.
+        self._mark = mark_draining or (lambda name, draining: None)
+        self._states: dict[tuple, DrainState] = {}
+        self._marks_desired: dict[str, bool] = {}  # name -> want draining?
+
+    def active(self, key: tuple) -> Optional[DrainState]:
+        return self._states.get(key)
+
+    def keys(self) -> list[tuple]:
+        return list(self._states)
+
+    def begin(self, key: tuple, victims: list[tuple[str, str]],
+              target_replicas: int, deadline_s: float) -> DrainState:
+        state = DrainState(
+            victims=list(victims),
+            target_replicas=target_replicas,
+            started_at=self._clock(),
+            deadline_s=deadline_s,
+        )
+        self._states[key] = state
+        for name, _url in victims:
+            self._marks_desired[name] = True
+        self.sync_marks()
+        logger.info("draining %s: victims=%s target=%d deadline=%.0fs",
+                    key, [n for n, _ in victims], target_replicas, deadline_s)
+        return state
+
+    def poll(self, key: tuple,
+             in_flight: Callable[[str, str], Optional[float]]) -> str:
+        """One non-blocking drain check.  ``in_flight(name, url)`` returns
+        the victim's current waiting+running, or None when unreachable."""
+        state = self._states[key]
+        for name, url in state.victims:
+            if name in state.idle:
+                continue
+            count = in_flight(name, url)
+            if count is not None and count <= 0:
+                state.idle.add(name)
+        if len(state.idle) == len(state.victims):
+            return DRAINED
+        if self._clock() - state.started_at >= state.deadline_s:
+            logger.warning(
+                "drain %s hit its %.0fs deadline with %d/%d victims still "
+                "busy; shrinking anyway", key, state.deadline_s,
+                len(state.victims) - len(state.idle), len(state.victims))
+            return DEADLINE
+        return DRAINING
+
+    def finish(self, key: tuple) -> None:
+        """Release the drain marks and forget the state (called after the
+        shrink is applied, or when the drain is abandoned)."""
+        state = self._states.pop(key, None)
+        if state is None:
+            return
+        for name, _url in state.victims:
+            self._marks_desired[name] = False
+        self.sync_marks()
+
+    def sync_marks(self) -> None:
+        """Converge marks to the desired state — called every control
+        tick.  Wanted marks are RE-ASSERTED each call, not just until
+        the first success: a reconciler update re-rendering the victim's
+        LWS wipes the label mid-drain, and an un-restored mark means the
+        victim keeps taking traffic until the deadline kills it (the
+        hook is idempotent, so steady state costs a read, not a write).
+        Failures stay queued and retry; a satisfied unmark is forgotten
+        entirely — victims are usually deleted right after."""
+        for name, want in list(self._marks_desired.items()):
+            try:
+                self._mark(name, want)
+            except Exception as e:
+                logger.warning("drain mark(%s, %s) failed (will retry): %s",
+                               name, want, e)
+                continue
+            if not want:
+                self._marks_desired.pop(name, None)
+
+    def abandon(self, key: tuple) -> None:
+        """Cancel a drain without shrinking (e.g. load returned and the
+        recommendation flipped back up) — victims rejoin the rotation."""
+        if key in self._states:
+            logger.info("abandoning drain %s; victims rejoin rotation", key)
+            self.finish(key)

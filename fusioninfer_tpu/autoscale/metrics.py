@@ -1,0 +1,79 @@
+"""Autoscaler self-metrics, Prometheus text format.
+
+The control loop must be observable the same way the engines it scales
+are: desired vs current replicas per (service, role), decision counts by
+direction, and the time of the last applied scale — enough to answer
+"why is this fleet the size it is" from a dashboard.  Rendered alongside
+the manager's controller-runtime metrics on the operator metrics port.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Series:
+    desired: int = 0
+    current: int = 0
+    decisions: dict[str, int] = field(default_factory=dict)  # direction -> n
+    last_scale_at: float = 0.0  # collector-clock seconds; 0 = never scaled
+
+
+class AutoscalerMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, str, str], _Series] = {}
+
+    def observe(self, namespace: str, service: str, role: str,
+                desired: int, current: int, direction: str,
+                scaled_at: float | None = None) -> None:
+        with self._lock:
+            s = self._series.setdefault((namespace, service, role), _Series())
+            s.desired = desired
+            s.current = current
+            s.decisions[direction] = s.decisions.get(direction, 0) + 1
+            if scaled_at is not None:
+                s.last_scale_at = scaled_at
+
+    def retain(self, live_keys: set[tuple[str, str, str]]) -> None:
+        """Drop series for (namespace, service, role) keys no longer
+        live — a deleted service must stop reporting replica gauges."""
+        with self._lock:
+            for key in list(self._series):
+                if key not in live_keys:
+                    del self._series[key]
+
+    def render(self) -> str:
+        lines = [
+            "# HELP fusioninfer:autoscaler_desired_replicas Replicas the control loop wants.",
+            "# TYPE fusioninfer:autoscaler_desired_replicas gauge",
+            "# HELP fusioninfer:autoscaler_current_replicas Replicas the spec carries now.",
+            "# TYPE fusioninfer:autoscaler_current_replicas gauge",
+            "# HELP fusioninfer:autoscaler_decisions_total Control-loop verdicts by direction (up / drain = scale-down initiated / down = shrink applied / hold).",
+            "# TYPE fusioninfer:autoscaler_decisions_total counter",
+            # deliberately NOT named *_timestamp_seconds: the value is
+            # the injected control-loop clock (monotonic in production),
+            # not unix epoch — compare against other series from this
+            # process, never against time()
+            "# HELP fusioninfer:autoscaler_last_scale_clock_seconds Control-loop clock reading when a scale was last applied (monotonic, not epoch; 0 = never).",
+            "# TYPE fusioninfer:autoscaler_last_scale_clock_seconds gauge",
+        ]
+        body: list[str] = []
+        with self._lock:
+            for (ns, svc, role) in sorted(self._series):
+                s = self._series[(ns, svc, role)]
+                lab = f'namespace="{ns}",service="{svc}",role="{role}"'
+                body.append(f"fusioninfer:autoscaler_desired_replicas{{{lab}}} {s.desired}")
+                body.append(f"fusioninfer:autoscaler_current_replicas{{{lab}}} {s.current}")
+                for direction in sorted(s.decisions):
+                    body.append(
+                        "fusioninfer:autoscaler_decisions_total"
+                        f'{{{lab},direction="{direction}"}} {s.decisions[direction]}'
+                    )
+                body.append(
+                    "fusioninfer:autoscaler_last_scale_clock_seconds"
+                    f"{{{lab}}} {s.last_scale_at}"
+                )
+        return "\n".join(lines + body) + "\n"

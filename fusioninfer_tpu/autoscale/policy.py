@@ -1,0 +1,141 @@
+"""HPA-style target-value control law, slice-granular.
+
+The core ratio is Kubernetes HPA's: ``desired = ceil(current * actual /
+target)``, with a tolerance band around 1.0 so measurement noise never
+flaps replicas.  Two deliberate departures for TPU serving:
+
+* **Whole-slice rounding.**  One replica is one gang-scheduled TPU slice
+  (``role.tpu`` shape); fractional capacity does not exist, so desired
+  replicas always round UP to the next whole slice — under-provisioning
+  a prefill fleet shows up as TTFT violations for every user, while the
+  cost of one extra slice is bounded.
+* **Asymmetric stabilization.**  Scale up reacts fast (window defaults
+  to 0: a queue spike is users waiting *now*); scale down holds the MAX
+  recommendation seen inside ``scale_down_stabilization_s`` before
+  shrinking, because giving a slice back costs a drain + a gang
+  reschedule + cold caches — flapping down is far more expensive than
+  holding one tick too long.
+
+Clamping to ``[min_replicas, max_replicas]`` is reported via
+``Decision.limited`` so the operator can surface a ``ScalingLimited``
+condition instead of silently pinning at a bound.
+
+No wall-clock access here (``tools/lint_resilience.py`` enforces it):
+the clock arrives injected so stabilization windows run deterministically
+under test.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from fusioninfer_tpu.api.types import AutoscalingSpec
+
+# |actual/target - 1| below this is noise, not pressure (HPA default)
+TOLERANCE = 0.1
+
+
+@dataclass
+class Decision:
+    """One control-loop verdict for one role."""
+
+    desired: int
+    current: int
+    raw: int  # pre-stabilization, pre-clamp recommendation
+    limited: bool = False
+    limit_reason: str = ""  # "AtMaxReplicas" | "AtMinReplicas" | ""
+    reasons: list[str] = field(default_factory=list)  # per-signal audit trail
+
+    @property
+    def direction(self) -> str:
+        if self.desired > self.current:
+            return "up"
+        if self.desired < self.current:
+            return "down"
+        return "hold"
+
+
+def desired_for_ratio(current: int, ratio: float) -> int:
+    """The HPA ratio with the tolerance dead-band and slice ceil."""
+    if abs(ratio - 1.0) <= TOLERANCE:
+        return current
+    return max(1, math.ceil(current * ratio))
+
+
+class ScalingPolicy:
+    """Stabilized recommendation stream for ONE role.
+
+    Feed it raw per-tick recommendations (the max across the role's
+    signals); it applies the asymmetric stabilization windows and the
+    min/max clamp.
+    """
+
+    def __init__(self, spec: AutoscalingSpec, clock: Callable[[], float]):
+        self.spec = spec
+        self._clock = clock
+        self._history: list[tuple[float, int]] = []  # (t, raw desired)
+        # when continuous observation began (first decide); a window is
+        # "covered" only once we have watched the role for its full span
+        self._since: Optional[float] = None
+
+    def _prune(self, now: float) -> None:
+        horizon = max(self.spec.scale_up_stabilization_s,
+                      self.spec.scale_down_stabilization_s)
+        self._history = [(t, r) for t, r in self._history if now - t <= horizon]
+
+    def decide(self, current: int, raw: int,
+               reasons: Optional[list[str]] = None) -> Decision:
+        now = self._clock()
+        # coverage restarts whenever observation restarts: first decide
+        # ever, or after a gap long enough that the whole history aged
+        # out (e.g. the role was fully partitioned for a window's span —
+        # its first post-recovery tick must not read as "window covered"
+        # and shrink on one momentary lull)
+        self._prune(now)
+        if self._since is None or not self._history:
+            self._since = now
+        self._history.append((now, raw))
+        desired = raw
+        if desired > current and self.spec.scale_up_stabilization_s > 0:
+            # up-window: the MIN recommendation across the window must
+            # still call for growth, and the window must actually be
+            # covered — one spiky tick (or a loop that just started)
+            # does not buy a slice
+            window = [r for t, r in self._history
+                      if now - t <= self.spec.scale_up_stabilization_s]
+            if now - self._since < self.spec.scale_up_stabilization_s:
+                window.append(current)
+            desired = max(current, min(window))
+        if desired < current:
+            # down-window: hold the MAX recent recommendation — shrink
+            # only once the whole window agrees the capacity is excess.
+            # Like the up path, the window must be COVERED: a freshly
+            # (re)started controller has no history (policies live in
+            # memory) and must not drain slices on its first-tick view
+            # of a momentary lull
+            window = [r for t, r in self._history
+                      if now - t <= self.spec.scale_down_stabilization_s]
+            if now - self._since < self.spec.scale_down_stabilization_s:
+                window.append(current)
+            desired = min(current, max(window))
+        clamped = min(max(desired, self.spec.min_replicas), self.spec.max_replicas)
+        limited = clamped != desired or (
+            # also limited when pressure calls past a bound we already sit at
+            raw > self.spec.max_replicas and current >= self.spec.max_replicas
+        ) or (
+            raw < self.spec.min_replicas and current <= self.spec.min_replicas
+        )
+        reason = ""
+        if limited:
+            reason = ("AtMaxReplicas" if max(desired, raw) > self.spec.max_replicas
+                      else "AtMinReplicas")
+        return Decision(
+            desired=clamped,
+            current=current,
+            raw=raw,
+            limited=limited,
+            limit_reason=reason,
+            reasons=list(reasons or []),
+        )

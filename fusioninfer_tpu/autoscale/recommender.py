@@ -1,0 +1,97 @@
+"""PD-aware recommendation: prefill and decode scale on different signals.
+
+Disaggregated serving splits the workload's bottlenecks (PAPER;
+arXiv:2411.11560 frames the co-location topology problem): prefill
+replicas saturate on **admission** — waiting-queue depth and TTFT blow
+up first, while their KV usage stays transient — whereas decode replicas
+saturate on **residency** — KV-cache pages held for every in-flight
+sequence, while their queue stays near zero because the router only
+hands them work the prefiller already admitted.  Scaling both roles on
+one signal therefore either starves decode (queue-driven) or
+over-provisions prefill (KV-driven).  This module maps each component
+type to the signals that actually bind it:
+
+===========  ==========================================
+role          signals consulted (when a target is set)
+===========  ==========================================
+prefiller     queueLength, ttftP90Seconds
+decoder       kvCacheUtilization
+worker        all three (aggregated serving)
+===========  ==========================================
+
+Per signal the HPA ratio produces a raw desired count; the MAX across
+the role's signals wins (any saturated axis is a reason to grow), then
+the role's :class:`~fusioninfer_tpu.autoscale.policy.ScalingPolicy`
+applies stabilization and bounds.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from fusioninfer_tpu.api.types import AutoscalingSpec, ComponentType, Role
+from fusioninfer_tpu.autoscale.collector import RoleSignals
+from fusioninfer_tpu.autoscale.policy import Decision, ScalingPolicy, desired_for_ratio
+
+SIGNALS_FOR_TYPE: dict[ComponentType, tuple[str, ...]] = {
+    ComponentType.PREFILLER: ("queueLength", "ttftP90Seconds"),
+    ComponentType.DECODER: ("kvCacheUtilization",),
+    ComponentType.WORKER: ("queueLength", "ttftP90Seconds", "kvCacheUtilization"),
+}
+
+
+class PDRecommender:
+    """Holds one :class:`ScalingPolicy` per role key and turns
+    :class:`RoleSignals` into :class:`Decision`\\ s."""
+
+    def __init__(self, clock: Callable[[], float]):
+        self._clock = clock
+        self._policies: dict[tuple, ScalingPolicy] = {}
+
+    def policy(self, key: tuple, spec: AutoscalingSpec) -> ScalingPolicy:
+        policy = self._policies.get(key)
+        if policy is None or policy.spec != spec:
+            # spec edits (new targets/bounds) reset the stabilization
+            # history — old recommendations were computed under old law
+            policy = self._policies[key] = ScalingPolicy(spec, self._clock)
+        return policy
+
+    def forget(self, live_keys: set[tuple]) -> None:
+        for key in list(self._policies):
+            if key not in live_keys:
+                del self._policies[key]
+
+    def recommend(self, key: tuple, role: Role, current: int,
+                  signals: RoleSignals) -> Decision:
+        spec = role.autoscaling
+        assert spec is not None, "recommend() requires an autoscaling stanza"
+        applicable = SIGNALS_FOR_TYPE.get(role.component_type,
+                                          SIGNALS_FOR_TYPE[ComponentType.WORKER])
+        targets = spec.targets()
+        wants: list[int] = []
+        reasons: list[str] = []
+        for signal in applicable:
+            target = targets.get(signal)
+            if target is None:
+                continue
+            actual = self._actual(signal, signals)
+            if actual is None:
+                continue  # e.g. no new requests this window → no TTFT signal
+            want = desired_for_ratio(current, actual / target)
+            reasons.append(
+                f"{signal}: actual {actual:.3g} vs target {target:.3g} → {want}")
+            wants.append(want)
+        # HPA multi-metric rule: the MAX per-signal desire wins — the
+        # role shrinks only when every consulted signal agrees it should
+        raw = max(wants) if wants else current
+        return self.policy(key, spec).decide(current, raw, reasons)
+
+    @staticmethod
+    def _actual(signal: str, signals: RoleSignals) -> Optional[float]:
+        if signal == "queueLength":
+            return signals.queue_length
+        if signal == "kvCacheUtilization":
+            return signals.kv_cache_utilization
+        if signal == "ttftP90Seconds":
+            return signals.ttft_p90_s
+        return None

@@ -30,6 +30,15 @@ def _cmd_controller_run(args: argparse.Namespace) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     client = KubeClient()
+    autoscaler = None
+    if args.autoscale:
+        from fusioninfer_tpu.autoscale import AutoscaleController
+
+        autoscaler = AutoscaleController(
+            client,
+            namespace=args.namespace,
+            interval_s=args.autoscale_interval,
+        )
     mgr = Manager(
         client,
         namespace=args.namespace,
@@ -44,6 +53,7 @@ def _cmd_controller_run(args: argparse.Namespace) -> int:
                            if args.metrics_cert_path else None),
         metrics_key_path=(f"{args.metrics_cert_path}/{args.metrics_cert_key}"
                           if args.metrics_cert_path else None),
+        autoscaler=autoscaler,
     )
     mgr.run_forever()
     # mirror controller-runtime: lost leadership is a fatal exit so the
@@ -143,6 +153,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--probe-port", type=int, default=8081)
     run.add_argument("--metrics-port", type=int, default=8443)
     run.add_argument("--volcano-queue", default="")
+    run.add_argument("--autoscale", action="store_true",
+                     help="run the slice-granular autoscale loop "
+                          "(leader-only; docs/design/autoscaling.md)")
+    run.add_argument("--autoscale-interval", type=float, default=15.0,
+                     help="seconds between autoscale control-loop ticks")
     run.add_argument("--leader-elect", action="store_true",
                      help="lease-based active/standby HA (coordination.k8s.io)")
     run.add_argument("--metrics-insecure", action="store_true",

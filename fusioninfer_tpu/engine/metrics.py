@@ -10,6 +10,45 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+def histogram_quantile(
+    bounds: Sequence[float], cumulative: Sequence[float], q: float
+) -> Optional[float]:
+    """Prometheus ``histogram_quantile`` over cumulative bucket counts.
+
+    ``bounds`` are the finite upper bounds (ascending), ``cumulative`` the
+    matching cumulative counts plus one trailing entry for the +Inf
+    bucket (``len(cumulative) == len(bounds) + 1``).  Linear
+    interpolation inside the target bucket, the lowest bound for the
+    first bucket, and the highest finite bound when the quantile lands
+    in +Inf — identical conventions to PromQL, so a scraped exposition
+    and an in-process :class:`Histogram` answer the same way.  Returns
+    ``None`` when the histogram is empty (no observations → no signal).
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    if len(cumulative) != len(bounds) + 1:
+        raise ValueError(
+            f"need {len(bounds) + 1} cumulative counts for {len(bounds)} "
+            f"bounds, got {len(cumulative)}"
+        )
+    total = cumulative[-1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_cum = 0.0
+    for i, (bound, cum) in enumerate(zip(bounds, cumulative)):
+        if cum >= rank:
+            lower = bounds[i - 1] if i > 0 else 0.0
+            if cum == prev_cum:  # defensive: malformed non-increasing input
+                return bound
+            return lower + (bound - lower) * (rank - prev_cum) / (cum - prev_cum)
+        prev_cum = cum
+    # quantile falls in the +Inf bucket: PromQL returns the highest
+    # finite bound rather than inventing a value beyond the histogram
+    return bounds[-1] if bounds else None
 
 
 @dataclass
@@ -31,6 +70,17 @@ class Histogram:
                 self.counts[i] += 1
                 return
         self.counts[-1] += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile of everything observed so far (None when
+        empty).  Feeds the autoscaler's TTFT-p90 signal; interpolation
+        matches PromQL so dashboards and scaling decisions agree."""
+        cumulative: list[float] = []
+        running = 0
+        for c in self.counts:
+            running += c
+            cumulative.append(running)
+        return histogram_quantile(self.buckets, cumulative, q)
 
     def render(self, name: str, labels: str) -> list[str]:
         out = []
@@ -80,20 +130,28 @@ class EngineMetrics:
             "# HELP vllm:kv_cache_usage_perc KV-cache usage (1 = full).",
             "# TYPE vllm:kv_cache_usage_perc gauge",
             f"vllm:kv_cache_usage_perc{{{labels}}} {engine.kv_cache_usage():.6f}",
+            "# HELP vllm:prompt_tokens_total Prefill tokens processed.",
             "# TYPE vllm:prompt_tokens_total counter",
             f"vllm:prompt_tokens_total{{{labels}}} {engine.prompt_tokens_total}",
+            "# HELP vllm:generation_tokens_total Generation tokens produced.",
             "# TYPE vllm:generation_tokens_total counter",
             f"vllm:generation_tokens_total{{{labels}}} {engine.generation_tokens_total}",
+            "# HELP vllm:spec_decode_num_draft_tokens_total Draft tokens proposed by the speculator.",
             "# TYPE vllm:spec_decode_num_draft_tokens_total counter",
             f"vllm:spec_decode_num_draft_tokens_total{{{labels}}} {engine.spec_proposed_total}",
+            "# HELP vllm:spec_decode_num_accepted_tokens_total Draft tokens accepted by verification.",
             "# TYPE vllm:spec_decode_num_accepted_tokens_total counter",
             f"vllm:spec_decode_num_accepted_tokens_total{{{labels}}} {engine.spec_accepted_total}",
+            "# HELP vllm:num_preemptions_total Requests preempted to reclaim KV-cache pages.",
             "# TYPE vllm:num_preemptions_total counter",
             f"vllm:num_preemptions_total{{{labels}}} {engine.preemptions_total}",
+            "# HELP vllm:request_success_total Requests finished successfully.",
             "# TYPE vllm:request_success_total counter",
             f"vllm:request_success_total{{{labels}}} {engine.finished_total}",
+            "# HELP vllm:request_failure_total Requests finished with an error.",
             "# TYPE vllm:request_failure_total counter",
             f"vllm:request_failure_total{{{labels}}} {engine.errors_total}",
+            "# HELP vllm:request_cancelled_total Requests cancelled by the client.",
             "# TYPE vllm:request_cancelled_total counter",
             f"vllm:request_cancelled_total{{{labels}}} {engine.cancelled_total}",
             "# HELP fusioninfer:kv_transfer_fallbacks_total PD pulls degraded to a local re-prefill.",

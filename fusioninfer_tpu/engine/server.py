@@ -211,6 +211,10 @@ class EngineServer:
         self._watchdog_thread: threading.Thread | None = None
         self._watchdog_started = False
         self._profiling = False
+        # injectable so tests exercise the capture protocol without a
+        # wall-time sleep (a 0.2s capture under a loaded test host was a
+        # reliable tier-1 flake); production keeps the real sleep
+        self._profile_sleep = time.sleep
         self.enable_profiling = (
             os.environ.get("FUSIONINFER_ENABLE_PROFILING", "") == "1"
         )
@@ -515,7 +519,7 @@ class EngineServer:
             self._profiling = True
         try:
             jax.profiler.start_trace(out_dir)
-            time.sleep(seconds)
+            self._profile_sleep(seconds)
             jax.profiler.stop_trace()
         finally:
             with self._lock:

@@ -18,6 +18,16 @@ COND_FAILED = "Failed"
 # reconcile.  The reference leans on controller-runtime's rate-limited
 # workqueue here; our manager surfaces budget exhaustion explicitly.
 COND_DEGRADED = "Degraded"
+# ScalingActive: the autoscale loop is computing recommendations from
+# live metrics for EVERY autoscaled role of this service; False means at
+# least one autoscaled role's endpoints stopped answering scrapes (that
+# role holds last-known-good replicas — sighted roles keep scaling).
+# ScalingLimited: a recommendation was
+# clamped at minReplicas/maxReplicas — pressure exists the bounds won't
+# let the loop answer.  (The HPA condition vocabulary, kept name-for-name
+# so dashboards built for HPA read this operator the same way.)
+COND_SCALING_ACTIVE = "ScalingActive"
+COND_SCALING_LIMITED = "ScalingLimited"
 
 REASON_CREATING = "Creating"
 REASON_PROCESSING = "Processing"
@@ -25,6 +35,12 @@ REASON_AVAILABLE = "Available"
 REASON_FAILED = "Failed"
 REASON_RETRY_BUDGET_EXHAUSTED = "RetryBudgetExhausted"
 REASON_RECOVERED = "Recovered"
+REASON_SCALING_READY = "ValidMetricFound"
+REASON_NO_METRICS = "FailedGetMetrics"
+REASON_SCALING_DISABLED = "ScalingDisabled"
+REASON_TOO_FEW_REPLICAS = "TooFewReplicas"
+REASON_TOO_MANY_REPLICAS = "TooManyReplicas"
+REASON_WITHIN_BOUNDS = "DesiredWithinRange"
 
 
 def _now() -> str:
@@ -84,6 +100,28 @@ def set_failed(status: dict, generation: int, message: str) -> None:
 def clear_failed(status: dict, generation: int) -> None:
     if get_condition(status, COND_FAILED):
         set_condition(status, COND_FAILED, False, REASON_AVAILABLE, "", generation)
+
+
+def set_scaling_active(status: dict, generation: int) -> None:
+    set_condition(status, COND_SCALING_ACTIVE, True, REASON_SCALING_READY,
+                  "autoscaler computing recommendations from live metrics",
+                  generation)
+
+
+def set_scaling_inactive(status: dict, generation: int, message: str) -> None:
+    set_condition(status, COND_SCALING_ACTIVE, False, REASON_NO_METRICS,
+                  message, generation)
+
+
+def set_scaling_limited(status: dict, generation: int, message: str,
+                        reason: str = REASON_TOO_MANY_REPLICAS) -> None:
+    set_condition(status, COND_SCALING_LIMITED, True, reason, message, generation)
+
+
+def clear_scaling_limited(status: dict, generation: int) -> None:
+    if get_condition(status, COND_SCALING_LIMITED):
+        set_condition(status, COND_SCALING_LIMITED, False,
+                      REASON_WITHIN_BOUNDS, "", generation)
 
 
 def set_degraded(status: dict, generation: int, message: str) -> None:

@@ -142,7 +142,8 @@ class Manager:
                  metrics_cert_path: str | None = None,
                  metrics_key_path: str | None = None,
                  requeue_backoff: RetryPolicy | None = None,
-                 fault_injector=None):
+                 fault_injector=None,
+                 autoscaler=None):
         """``leader_elect``: active/standby HA via a coordination.k8s.io
         Lease (the reference's ``--leader-elect``, cmd/main.go:80-82):
         controllers start only on acquiring the lease; losing it stops
@@ -156,6 +157,11 @@ class Manager:
         ``FUSIONINFER_METRICS_TOKEN`` env var provides a static-token
         mode for clusterless setups.  ``"none"`` serves plain (library /
         test default).
+
+        ``autoscaler``: an ``autoscale.AutoscaleController`` to run as a
+        leader-only loop alongside the reconcilers (two autoscalers
+        double-patching replicas is the same hazard as two reconcilers);
+        its self-metrics are appended to this manager's /metrics body.
 
         ``metrics_tls``: serve metrics over HTTPS — the reference's
         posture (``cmd/main.go:83-98``: secure :8443 with cert flags and
@@ -193,6 +199,7 @@ class Manager:
         self._requeue_timers: list[threading.Timer] = []
         self._timers_lock = threading.Lock()
         self._fault_injector = fault_injector
+        self.autoscaler = autoscaler
         self._stop = threading.Event()
         self.ready = threading.Event()
         self.leadership_lost = False
@@ -424,7 +431,10 @@ class Manager:
                         self.end_headers()
                         self.wfile.write(b"unauthorized")
                         return
-                    body = mgr.metrics.render().encode()
+                    body = mgr.metrics.render()
+                    if mgr.autoscaler is not None:
+                        body += mgr.autoscaler.metrics.render()
+                    body = body.encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")
                     self.end_headers()
@@ -489,6 +499,10 @@ class Manager:
             threads.append(
                 threading.Thread(target=self._watch_kind, args=(kind,), daemon=True, name=f"watch-{kind}")
             )
+        if self.autoscaler is not None:
+            threads.append(threading.Thread(
+                target=self.autoscaler.run, args=(self._stop,),
+                daemon=True, name="autoscale-loop"))
         for t in threads:
             t.start()
         self._threads = threads

@@ -39,6 +39,7 @@ from typing import Callable, Optional
 from fusioninfer_tpu.resilience import CircuitBreaker
 from fusioninfer_tpu.resilience.breaker import CLOSED, OPEN
 from fusioninfer_tpu.router.epp_schema import validate_epp_config
+from fusioninfer_tpu.workload.labels import LABEL_DRAINING
 
 logger = logging.getLogger("fusioninfer.picker")
 
@@ -184,6 +185,11 @@ class EndpointPicker:
         # health-aware selection: callers report request outcomes via
         # report_result(); open breakers eject endpoints from pick()
         self.health = health or EndpointHealth()
+        # autoscaler drain protocol: endpoints marked draining receive no
+        # NEW assignments (in-flight streams keep flowing) so a shrink
+        # victim can quiesce; guarded — set_draining races pick()
+        self._draining_lock = threading.Lock()
+        self._draining: set[str] = set()
         self._fault_injector = fault_injector
         self._plugins = {
             (p.get("name") or p["type"]): p for p in self.config.get("plugins", [])
@@ -200,6 +206,21 @@ class EndpointPicker:
                     params.get("maxPrefixBlocksToMatch", 256),
                     params.get("lruCapacityPerServer", 31250),
                 )
+
+    # -- draining --
+
+    def set_draining(self, name: str, draining: bool = True) -> None:
+        """Mark/unmark an endpoint draining (the autoscaler's scale-down
+        protocol, ``fusioninfer_tpu.autoscale.drainer``)."""
+        with self._draining_lock:
+            if draining:
+                self._draining.add(name)
+            else:
+                self._draining.discard(name)
+
+    def is_draining(self, name: str) -> bool:
+        with self._draining_lock:
+            return name in self._draining
 
     # -- scoring --
 
@@ -248,24 +269,41 @@ class EndpointPicker:
                 )
         if not candidates:
             return None
-        # circuit breaking: endpoints with an open breaker are ejected;
-        # half-open ones compete normally but consume their rationed
-        # probe token only when actually SELECTED — an unpicked candidate
-        # must not burn the probe (no request would carry its outcome,
-        # and the breaker would wedge half-open with nothing left to
-        # close or re-open it).  If EVERY candidate is ejected, route to
-        # the full set anyway — during a total outage a guess beats a
-        # guaranteed 503; recovery then rides the normal half-open
-        # probes once each breaker's window elapses (last-resort
-        # outcomes are not probe verdicts and do not close breakers).
+        # selection tiers, health before drain-status: (1) live and not
+        # draining; (2) live but draining — a healthy draining endpoint
+        # beats a circuit-broken one, so a scale-down racing an outage
+        # never routes to known-dead backends while a serving victim
+        # idles; (3) last resort, the full set — during a total outage a
+        # guess beats a guaranteed 503.  Circuit breaking semantics are
+        # unchanged: OPEN ejects; half-open competes normally and
+        # consumes its rationed probe token only when actually SELECTED
+        # (an unpicked candidate must not burn the probe — no request
+        # would carry its outcome, and the breaker would wedge half-open
+        # with nothing left to close or re-open it); last-resort
+        # outcomes are not probe verdicts and do not close breakers.
+        # draining = explicitly marked on this picker (in-process
+        # embedder) OR carried as the autoscaler's LWS drain label in
+        # the endpoint snapshot (cross-process: informers/pod listers
+        # surface the label without any picker-side wiring)
+        with self._draining_lock:
+            draining = set(self._draining)
+        draining |= {ep.name for ep in candidates
+                     if ep.labels.get(LABEL_DRAINING) == "true"}
         states = {ep.name: self.health.state(ep.name) for ep in candidates}
-        selectable = [ep for ep in candidates if states[ep.name] != OPEN]
-        last_resort = not selectable
-        if last_resort:
+        live = [ep for ep in candidates if states[ep.name] != OPEN]
+        selectable = [ep for ep in live if ep.name not in draining]
+        last_resort = False
+        if not selectable and live:
+            logger.warning(
+                "all %d live candidate endpoints draining; routing to "
+                "them anyway", len(live))
+            selectable = live
+        elif not selectable:
             logger.warning(
                 "all %d candidate endpoints circuit-broken; routing "
                 "to the full set as a last resort", len(candidates))
             selectable = candidates
+            last_resort = True
         want_metrics = any(
             p["type"] in ("kv-cache-utilization-scorer", "queue-scorer")
             for _, p, _ in scorers

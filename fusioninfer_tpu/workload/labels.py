@@ -9,6 +9,10 @@ LABEL_SERVICE = "fusioninfer.io/service"
 LABEL_COMPONENT_TYPE = "fusioninfer.io/component-type"
 LABEL_ROLE_NAME = "fusioninfer.io/role-name"
 LABEL_REPLICA_INDEX = "fusioninfer.io/replica-index"
+# stamped on a victim LWS by the autoscaler while it drains
+# (autoscale/drainer.py); the router picker excludes endpoints carrying
+# it from new assignments
+LABEL_DRAINING = "fusioninfer.io/draining"
 
 # Volcano gang-scheduling pod annotations.
 ANNOTATION_POD_GROUP = "scheduling.k8s.io/group-name"

@@ -1,0 +1,1114 @@
+"""Slice-granular, PD-aware autoscaling — unit + e2e + chaos suite.
+
+Everything here runs against a fake clock (the lint gate forbids wall
+time inside ``fusioninfer_tpu/autoscale/``): stabilization windows,
+staleness cutoffs, breaker recovery and drain deadlines advance only
+when a test says so.  The e2e tier drives the real control loop against
+the fake kube API server and the real reconciler, asserting the
+acceptance path: a load ramp takes a PD-disaggregated service from min
+to max replicas in whole-slice increments with the PodGroup
+``minMember`` consistent at every step, and back down via drain with
+zero in-flight requests killed.
+"""
+
+import copy
+
+import pytest
+
+from fusioninfer_tpu.api.types import (
+    AutoscalingSpec,
+    InferenceService,
+    ValidationError,
+)
+from fusioninfer_tpu.autoscale import (
+    DEADLINE,
+    DRAINED,
+    DRAINING,
+    AutoscaleController,
+    Drainer,
+    MetricsCollector,
+    PDRecommender,
+    ScalingPolicy,
+    desired_for_ratio,
+    parse_engine_sample,
+)
+from fusioninfer_tpu.engine.metrics import TTFT_BUCKETS, Histogram
+from fusioninfer_tpu.operator.fake import FakeK8s
+from fusioninfer_tpu.operator.reconciler import InferenceServiceReconciler
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FleetSim:
+    """Simulated engine fleet: per-endpoint gauges + TTFT histogram,
+    rendered as the vLLM-compatible exposition the collector scrapes."""
+
+    def __init__(self):
+        self.engines: dict[str, dict] = {}
+        self.partitioned: set[str] = set()
+        self.fetch_count: dict[str, int] = {}
+
+    def ensure(self, name: str) -> dict:
+        return self.engines.setdefault(
+            name,
+            {"waiting": 0.0, "running": 0.0, "kv": 0.0,
+             "ttft": Histogram(TTFT_BUCKETS)},
+        )
+
+    def set(self, name: str, waiting=None, running=None, kv=None):
+        e = self.ensure(name)
+        if waiting is not None:
+            e["waiting"] = waiting
+        if running is not None:
+            e["running"] = running
+        if kv is not None:
+            e["kv"] = kv
+
+    def observe_ttft(self, name: str, values):
+        e = self.ensure(name)
+        for v in values:
+            e["ttft"].observe(v)
+
+    def in_flight(self, name: str) -> float:
+        e = self.ensure(name)
+        return e["waiting"] + e["running"]
+
+    @staticmethod
+    def name_of(url: str) -> str:
+        # default_endpoints_for: http://{lws}.{ns}:{port}
+        return url.split("//", 1)[1].split(".", 1)[0]
+
+    def fetch(self, url: str) -> str:
+        name = self.name_of(url)
+        self.fetch_count[name] = self.fetch_count.get(name, 0) + 1
+        if name in self.partitioned:
+            raise OSError(f"connection refused: {name}")
+        e = self.ensure(name)
+        labels = 'model_name="m"'
+        lines = [
+            f"vllm:num_requests_waiting{{{labels}}} {e['waiting']}",
+            f"vllm:num_requests_running{{{labels}}} {e['running']}",
+            f"vllm:kv_cache_usage_perc{{{labels}}} {e['kv']}",
+            *e["ttft"].render("vllm:time_to_first_token_seconds", labels),
+        ]
+        return "\n".join(lines) + "\n"
+
+
+def make_collector(fleet: FleetSim, clock: FakeClock, **kw) -> MetricsCollector:
+    kw.setdefault("stale_after_s", 30.0)
+    return MetricsCollector(
+        fetch=fleet.fetch, clock=clock, sleep=lambda d: None, **kw)
+
+
+# -- unit: parsing + collector -----------------------------------------------
+
+
+class TestParse:
+    def test_parses_gauges_and_ttft_buckets(self):
+        fleet = FleetSim()
+        fleet.set("e0", waiting=3, running=2, kv=0.5)
+        fleet.observe_ttft("e0", [0.05, 0.2, 4.0])
+        gauges, ttft = parse_engine_sample(fleet.fetch("http://e0.ns:8000"))
+        assert gauges["vllm:num_requests_waiting"] == 3
+        assert gauges["vllm:kv_cache_usage_perc"] == 0.5
+        assert ttft[float("inf")] == 3  # cumulative through +Inf
+        assert ttft[0.05] == 1
+
+    def test_comments_and_garbage_ignored(self):
+        gauges, ttft = parse_engine_sample(
+            "# HELP x y\n\nnot-a-metric\nvllm:num_requests_waiting{a=\"b\"} 7\n")
+        assert gauges == {"vllm:num_requests_waiting": 7.0}
+        assert ttft == {}
+
+
+class TestCollector:
+    def test_aggregates_role_means_and_inflight(self):
+        fleet, clock = FleetSim(), FakeClock()
+        fleet.set("e0", waiting=4, running=1, kv=0.2)
+        fleet.set("e1", waiting=8, running=3, kv=0.6)
+        c = make_collector(fleet, clock)
+        s = c.collect([("e0", "http://e0.ns:8000"), ("e1", "http://e1.ns:8000")])
+        assert s.queue_length == pytest.approx(6.0)
+        assert s.kv_cache_utilization == pytest.approx(0.4)
+        assert s.in_flight == pytest.approx(16.0)
+        assert s.fresh_endpoints == 2 and s.stale_endpoints == 0
+
+    def test_ttft_p90_is_windowed_not_lifetime(self):
+        """100 fast requests before the first scrape must not mask 10
+        slow ones that landed since — the p90 is computed over the
+        inter-scrape delta, exactly what the current load feels like."""
+        fleet, clock = FleetSim(), FakeClock()
+        fleet.observe_ttft("e0", [0.05] * 100)
+        c = make_collector(fleet, clock)
+        first = c.collect([("e0", "http://e0.ns:8000")])
+        assert first.ttft_p90_s <= 0.05
+        fleet.observe_ttft("e0", [2.0] * 10)  # slow burst since last tick
+        second = c.collect([("e0", "http://e0.ns:8000")])
+        # lifetime p90 would still be ~0.05 (100 of 110 fast); the
+        # windowed p90 sees only the burst
+        assert second.ttft_p90_s > 1.0
+
+    def test_ttft_counter_reset_voids_whole_previous_sample(self):
+        """An engine restart resets its histogram; mixing reset and
+        non-reset bucket deltas would yield a non-monotone pooled array
+        and a garbage quantile — the whole endpoint falls back to its
+        post-restart cumulative counts."""
+        fleet, clock = FleetSim(), FakeClock()
+        fleet.observe_ttft("e0", [0.3] * 100 + [0.8] * 5)
+        c = make_collector(fleet, clock)
+        c.collect([("e0", "http://e0.ns:8000")])
+        # restart: fresh histogram, fewer counts than before in SOME buckets
+        fleet.engines["e0"]["ttft"] = Histogram(TTFT_BUCKETS)
+        fleet.observe_ttft("e0", [0.05] * 20 + [8.0] * 2)
+        s = c.collect([("e0", "http://e0.ns:8000")])
+        assert s.ttft_p90_s is not None and 0.0 < s.ttft_p90_s <= 10.0
+
+    def test_no_new_requests_means_no_ttft_signal(self):
+        fleet, clock = FleetSim(), FakeClock()
+        fleet.observe_ttft("e0", [0.05] * 5)
+        c = make_collector(fleet, clock)
+        c.collect([("e0", "http://e0.ns:8000")])
+        idle = c.collect([("e0", "http://e0.ns:8000")])
+        assert idle.ttft_p90_s is None
+
+    def test_partitioned_endpoint_opens_breaker_and_sample_goes_stale(self):
+        fleet, clock = FleetSim(), FakeClock()
+        fleet.set("e0", waiting=6)
+        fleet.set("e1", waiting=2)
+        c = make_collector(fleet, clock, stale_after_s=30.0)
+        eps = [("e0", "http://e0.ns:8000"), ("e1", "http://e1.ns:8000")]
+        assert c.collect(eps).fresh_endpoints == 2
+        fleet.partitioned.add("e0")
+        # within the stale window the last sample fills in ALONGSIDE the
+        # healthy endpoint's fresh one
+        clock.advance(10)
+        s = c.collect(eps)
+        assert s.fresh_endpoints == 1 and s.stale_endpoints == 1
+        assert s.queue_length == pytest.approx(4.0)  # (6 stale + 2 fresh)/2
+        # breaker opens after threshold failures; further collects stop
+        # hammering the partitioned endpoint
+        clock.advance(5)
+        c.collect(eps)
+        clock.advance(5)
+        c.collect(eps)
+        assert c.breaker("e0").state == "open"
+        hammered = fleet.fetch_count["e0"]
+        clock.advance(15)  # now 35s past e0's last good sample: stale
+        s = c.collect(eps)
+        assert s.stale_endpoints == 0, "stale sample must be discarded"
+        assert s.queue_length == pytest.approx(2.0), "only live data counts"
+        assert fleet.fetch_count["e0"] == hammered, \
+            "an open breaker must stop scrape traffic"
+
+    def test_fully_partitioned_role_yields_no_signals(self):
+        """A stale sample must never DRIVE a decision alone: zero fresh
+        endpoints → collect() returns None even inside the stale window."""
+        fleet, clock = FleetSim(), FakeClock()
+        fleet.set("e0", waiting=6)
+        c = make_collector(fleet, clock, stale_after_s=30.0)
+        eps = [("e0", "http://e0.ns:8000")]
+        assert c.collect(eps) is not None
+        fleet.partitioned.add("e0")
+        clock.advance(5)  # well inside the stale window
+        assert c.collect(eps) is None
+
+
+# -- unit: policy + recommender ----------------------------------------------
+
+
+def make_spec(**kw) -> AutoscalingSpec:
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 10)
+    kw.setdefault("target_queue_length", 4.0)
+    kw.setdefault("scale_up_stabilization_s", 0.0)
+    kw.setdefault("scale_down_stabilization_s", 60.0)
+    return AutoscalingSpec(**kw)
+
+
+class TestPolicy:
+    def test_ratio_law_rounds_up_to_whole_slices(self):
+        assert desired_for_ratio(2, 1.6) == 4  # ceil(3.2)
+        assert desired_for_ratio(3, 0.4) == 2  # ceil(1.2)
+        assert desired_for_ratio(1, 12.0) == 12
+
+    def test_tolerance_dead_band_holds(self):
+        assert desired_for_ratio(4, 1.09) == 4
+        assert desired_for_ratio(4, 0.91) == 4
+        assert desired_for_ratio(4, 1.11) == 5
+
+    def test_scale_up_is_immediate_scale_down_is_stabilized(self):
+        clock = FakeClock()
+        p = ScalingPolicy(make_spec(), clock)
+        assert p.decide(1, 3).desired == 3  # up: instant
+        clock.advance(1)
+        # pressure vanished: raw says 1, but the 60s window still holds 3
+        assert p.decide(3, 1).desired == 3
+        clock.advance(30)
+        assert p.decide(3, 1).desired == 3
+        clock.advance(31)  # old high recommendation aged out
+        assert p.decide(3, 1).desired == 1
+
+    def test_up_stabilization_window_takes_min(self):
+        clock = FakeClock()
+        p = ScalingPolicy(make_spec(scale_up_stabilization_s=10.0), clock)
+        assert p.decide(2, 6).desired == 2, "one spiky tick must not scale"
+        clock.advance(5)
+        assert p.decide(2, 6).desired == 2, "window not yet covered"
+        clock.advance(6)  # pressure has now spanned the whole window
+        assert p.decide(2, 6).desired == 6, "sustained pressure scales"
+        # a dip inside the window caps the next scale-up at the dip
+        clock.advance(1)
+        p.decide(6, 6)
+        clock.advance(11)
+        p.decide(6, 8)
+        clock.advance(1)
+        assert p.decide(6, 12).desired == 8, "min over the up-window wins"
+
+    def test_clamps_report_limited(self):
+        clock = FakeClock()
+        p = ScalingPolicy(make_spec(max_replicas=4), clock)
+        d = p.decide(2, 9)
+        assert d.desired == 4 and d.limited and d.limit_reason == "AtMaxReplicas"
+        p2 = ScalingPolicy(make_spec(min_replicas=2, scale_down_stabilization_s=0.0),
+                           clock)
+        d2 = p2.decide(3, 1)
+        assert d2.desired == 2 and d2.limited and d2.limit_reason == "AtMinReplicas"
+
+    def test_down_window_needs_coverage_after_restart(self):
+        """Policies live in operator memory: a restarted controller must
+        not drain slices on its first-tick view of a momentary lull —
+        the down window has to be OBSERVED before a shrink."""
+        clock = FakeClock(1000.0)  # restart at an arbitrary clock value
+        p = ScalingPolicy(make_spec(scale_down_stabilization_s=60.0), clock)
+        assert p.decide(4, 1).desired == 4, "first tick after restart holds"
+        clock.advance(30)
+        assert p.decide(4, 1).desired == 4, "window still uncovered"
+        clock.advance(31)
+        assert p.decide(4, 1).desired == 1, \
+            "a lull observed across the whole window may shrink"
+
+    def test_observation_gap_restarts_down_coverage(self):
+        """A role partitioned long enough for its whole history to age
+        out must re-earn the down window before shrinking — the first
+        post-recovery tick is indistinguishable from a restart."""
+        clock = FakeClock()
+        p = ScalingPolicy(make_spec(scale_down_stabilization_s=60.0), clock)
+        for _ in range(5):
+            clock.advance(15)
+            p.decide(4, 4)  # healthy, covered window
+        clock.advance(120)  # partition: no decides; history ages out
+        assert p.decide(4, 1).desired == 4, \
+            "first tick after the gap must hold, not shrink"
+        for _ in range(4):
+            clock.advance(15)
+            p.decide(4, 1)
+        assert p.decide(4, 1).desired == 1, "window re-earned"
+
+    def test_pinned_at_bound_under_pressure_stays_limited(self):
+        clock = FakeClock()
+        p = ScalingPolicy(make_spec(max_replicas=4), clock)
+        assert p.decide(4, 9).limited, \
+            "pressure past a bound we already sit at is still Limited"
+
+
+def _role(d: dict):
+    from fusioninfer_tpu.api.types import Role
+
+    return Role.from_dict(d)
+
+
+class TestPDRecommender:
+    def _signals(self, queue=0.0, kv=0.0, ttft=None):
+        from fusioninfer_tpu.autoscale.collector import RoleSignals
+
+        return RoleSignals(queue_length=queue, kv_cache_utilization=kv,
+                           ttft_p90_s=ttft, in_flight=0.0,
+                           fresh_endpoints=1, stale_endpoints=0)
+
+    def _autoscaling(self):
+        return {
+            "minReplicas": 1, "maxReplicas": 8,
+            "targets": {"queueLength": 4, "kvCacheUtilization": 0.8,
+                        "ttftP90Seconds": 0.5},
+            "scaleDownStabilizationSeconds": 0,
+        }
+
+    def test_prefiller_scales_on_queue_not_kv(self):
+        rec = PDRecommender(FakeClock())
+        role = _role({"name": "p", "componentType": "prefiller",
+                      "replicas": 2, "template": {},
+                      "autoscaling": self._autoscaling()})
+        # kv pressure alone must NOT grow a prefiller (transient KV):
+        # with the queue exactly on target, saturated KV changes nothing
+        d = rec.recommend(("ns", "s", "p"), role, 2,
+                          self._signals(queue=4, kv=0.99))
+        assert d.desired == 2
+        d = rec.recommend(("ns", "s", "p"), role, 2, self._signals(queue=8))
+        assert d.desired == 4
+
+    def test_prefiller_scales_on_ttft(self):
+        rec = PDRecommender(FakeClock())
+        role = _role({"name": "p", "componentType": "prefiller",
+                      "replicas": 2, "template": {},
+                      "autoscaling": self._autoscaling()})
+        d = rec.recommend(("ns", "s", "p"), role, 2,
+                          self._signals(queue=4, ttft=1.0))  # 2x the target
+        assert d.desired == 4
+
+    def test_decoder_scales_on_kv_not_queue(self):
+        rec = PDRecommender(FakeClock())
+        role = _role({"name": "d", "componentType": "decoder",
+                      "replicas": 2, "template": {},
+                      "autoscaling": self._autoscaling()})
+        # queue pressure alone must NOT grow a decoder (admission is the
+        # prefiller's problem; decode binds on KV residency)
+        d = rec.recommend(("ns", "s", "d"), role, 2,
+                          self._signals(queue=50, kv=0.8))
+        assert d.desired == 2
+        d = rec.recommend(("ns", "s", "d"), role, 2, self._signals(kv=0.99))
+        assert d.desired == 3  # ceil(2 * 0.99/0.8)
+
+    def test_max_pressure_wins_multi_signal(self):
+        rec = PDRecommender(FakeClock())
+        role = _role({"name": "w", "componentType": "worker",
+                      "replicas": 2, "template": {},
+                      "autoscaling": self._autoscaling()})
+        # queue says shrink, kv says grow → grow wins
+        d = rec.recommend(("ns", "s", "w"), role, 2,
+                          self._signals(queue=0.0, kv=1.6))
+        assert d.desired == 4
+
+
+# -- unit: drainer + picker draining -----------------------------------------
+
+
+class TestDrainer:
+    def test_full_drain_protocol(self):
+        clock = FakeClock()
+        marks: dict[str, bool] = {}
+        d = Drainer(clock=clock,
+                    mark_draining=lambda n, v: marks.__setitem__(n, v))
+        inflight = {"v0": 3.0, "v1": 0.0}
+        key = ("ns", "svc", "role")
+        d.begin(key, [("v0", "u0"), ("v1", "u1")], target_replicas=1,
+                deadline_s=30.0)
+        assert marks == {"v0": True, "v1": True}
+        assert d.poll(key, lambda n, u: inflight[n]) == DRAINING
+        inflight["v0"] = 0.0
+        assert d.poll(key, lambda n, u: inflight[n]) == DRAINED
+        d.finish(key)
+        assert marks == {"v0": False, "v1": False}
+        assert d.active(key) is None
+
+    def test_unreachable_victim_is_not_idle(self):
+        clock = FakeClock()
+        d = Drainer(clock=clock)
+        key = ("k",)
+        d.begin(key, [("v0", "u0")], 0, deadline_s=30.0)
+        assert d.poll(key, lambda n, u: None) == DRAINING, \
+            "silence must never be treated as drained"
+
+    def test_deadline_releases_the_shrink(self):
+        clock = FakeClock()
+        d = Drainer(clock=clock)
+        key = ("k",)
+        d.begin(key, [("v0", "u0")], 0, deadline_s=30.0)
+        assert d.poll(key, lambda n, u: 5.0) == DRAINING
+        clock.advance(31)
+        assert d.poll(key, lambda n, u: 5.0) == DEADLINE
+
+    def test_failed_marks_retry_until_delivered(self):
+        """A Conflict racing the mark hook must not permanently leak a
+        draining label (or leave a victim taking traffic): desired marks
+        are level-triggered and sync_marks retries them every tick."""
+        clock = FakeClock()
+        failures = {"n": 2}
+        delivered: dict[str, bool] = {}
+
+        def flaky_mark(name, draining):
+            if failures["n"] > 0:
+                failures["n"] -= 1
+                raise OSError("apiserver connection reset")
+            delivered[name] = draining
+
+        d = Drainer(clock=clock, mark_draining=flaky_mark)
+        key = ("k",)
+        d.begin(key, [("v0", "u0")], 0, deadline_s=30.0)  # first mark fails
+        assert delivered == {}
+        d.sync_marks()  # second attempt fails too
+        assert delivered == {}
+        d.sync_marks()  # third lands
+        assert delivered == {"v0": True}
+        failures["n"] = 1
+        d.finish(key)  # unmark fails once...
+        assert delivered == {"v0": True}
+        d.sync_marks()  # ...and is retried until released
+        assert delivered == {"v0": False}
+
+    def test_idle_victim_latched_even_if_it_blips(self):
+        """A victim once seen idle stays idle (it receives no new work);
+        a later unreachable read must not un-drain it."""
+        clock = FakeClock()
+        d = Drainer(clock=clock)
+        key = ("k",)
+        d.begin(key, [("v0", "u0")], 0, deadline_s=30.0)
+        assert d.poll(key, lambda n, u: 0.0) == DRAINED
+
+
+class TestPickerDraining:
+    CONFIG = """
+apiVersion: inference.networking.x-k8s.io/v1alpha1
+kind: EndpointPickerConfig
+plugins:
+- type: queue-scorer
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: queue-scorer
+  - pluginRef: max-score-picker
+"""
+
+    def _picker(self, names):
+        from fusioninfer_tpu.router.picker import Endpoint, EndpointPicker
+
+        eps = [Endpoint(n, f"http://{n}", {}) for n in names]
+        return EndpointPicker(
+            self.CONFIG, lambda: list(eps),
+            metrics=lambda ep: {"vllm:num_requests_waiting": 0.0})
+
+    def test_draining_endpoint_gets_no_new_assignments(self):
+        p = self._picker(["a", "b"])
+        p.set_draining("a")
+        for _ in range(5):
+            assert p.pick("x").name == "b"
+        p.set_draining("a", False)
+        assert {p.pick("x").name for _ in range(5)} <= {"a", "b"}
+
+    def test_all_draining_still_routes_as_last_resort(self):
+        p = self._picker(["a", "b"])
+        p.set_draining("a")
+        p.set_draining("b")
+        assert p.pick("x") is not None, \
+            "refusing to route during a fleet-wide drain drops requests"
+
+    def test_lws_drain_label_in_endpoint_snapshot_is_honored(self):
+        """The cross-process path: an endpoint whose labels carry the
+        autoscaler's LWS drain label is excluded without anyone calling
+        set_draining on this picker instance."""
+        from fusioninfer_tpu.router.picker import Endpoint, EndpointPicker
+        from fusioninfer_tpu.workload.labels import LABEL_DRAINING
+
+        eps = [Endpoint("a", "http://a", {LABEL_DRAINING: "true"}),
+               Endpoint("b", "http://b", {})]
+        p = EndpointPicker(
+            self.CONFIG, lambda: list(eps),
+            metrics=lambda ep: {"vllm:num_requests_waiting": 0.0})
+        for _ in range(5):
+            assert p.pick("x").name == "b"
+
+    def test_healthy_draining_endpoint_beats_circuit_broken(self):
+        """Health outranks drain status: when every non-draining
+        candidate is circuit-broken, route to the live draining victim
+        rather than a known-dead endpoint."""
+        p = self._picker(["a", "b"])
+        p.set_draining("a")
+        for _ in range(5):
+            p.report_result("b", ok=False)  # b's breaker opens
+        assert p.health.state("b") == "open"
+        assert p.pick("x").name == "a"
+
+
+# -- api/schema validation ----------------------------------------------------
+
+
+class TestAutoscalingSpecValidation:
+    def _svc(self, autoscaling, component="worker"):
+        roles = [{
+            "name": "w", "componentType": component, "replicas": 1,
+            "template": {"spec": {"containers": [{"name": "e", "image": "i"}]}},
+            "autoscaling": autoscaling,
+        }]
+        if component == "prefiller":
+            roles.append({
+                "name": "d", "componentType": "decoder", "replicas": 1,
+                "template": {"spec": {"containers": [{"name": "e", "image": "i"}]}},
+            })
+        return InferenceService.from_dict({
+            "apiVersion": "fusioninfer.io/v1alpha1", "kind": "InferenceService",
+            "metadata": {"name": "s"}, "spec": {"roles": roles},
+        })
+
+    def test_roundtrip(self):
+        svc = self._svc({"minReplicas": 2, "maxReplicas": 6,
+                         "targets": {"queueLength": 4},
+                         "drainDeadlineSeconds": 45})
+        svc.validate()
+        out = svc.to_dict()["spec"]["roles"][0]["autoscaling"]
+        assert out["minReplicas"] == 2 and out["maxReplicas"] == 6
+        assert out["targets"] == {"queueLength": 4.0}
+        assert out["drainDeadlineSeconds"] == 45.0
+        assert InferenceService.from_dict(svc.to_dict()).spec.roles[0].autoscaling \
+            == svc.spec.roles[0].autoscaling
+
+    def test_bounds_and_targets_validated(self):
+        with pytest.raises(ValidationError):
+            self._svc({"minReplicas": 0, "targets": {"queueLength": 4}}).validate()
+        with pytest.raises(ValidationError):
+            self._svc({"minReplicas": 3, "maxReplicas": 2,
+                       "targets": {"queueLength": 4}}).validate()
+        with pytest.raises(ValidationError):
+            self._svc({"targets": {}}).validate()  # enabled but targetless
+        with pytest.raises(ValidationError):
+            self._svc({"targets": {"kvCacheUtilization": 1.5}}).validate()
+        with pytest.raises(ValidationError):
+            self._svc({"targets": {"queueLength": -1}}).validate()
+
+    def test_router_role_rejects_autoscaling(self):
+        svc = InferenceService.from_dict({
+            "apiVersion": "fusioninfer.io/v1alpha1", "kind": "InferenceService",
+            "metadata": {"name": "s"},
+            "spec": {"roles": [{
+                "name": "r", "componentType": "router",
+                "strategy": "prefix-cache",
+                "autoscaling": {"targets": {"queueLength": 4}},
+            }]},
+        })
+        with pytest.raises(ValidationError, match="worker-like"):
+            svc.validate()
+
+    def test_crd_schema_types_enforced(self):
+        """The structural schema the fake apiserver enforces knows the
+        stanza — wrong types fail exactly like a real CRD admission."""
+        from fusioninfer_tpu.operator.schema import CRDValidator
+
+        v = CRDValidator()
+        good = self._svc({"minReplicas": 1, "targets": {"queueLength": 4}})
+        assert v.validate(good.to_dict()) == []
+        bad = good.to_dict()
+        bad["spec"]["roles"][0]["autoscaling"]["minReplicas"] = "two"
+        errors = v.validate(bad)
+        assert errors and "minReplicas" in errors[0]
+        bad2 = good.to_dict()
+        bad2["spec"]["roles"][0]["autoscaling"]["targets"] = {
+            "kvCacheUtilization": 3}
+        assert v.validate(bad2), "kv utilization above 1 must fail the schema"
+
+
+# -- e2e: the control loop against the fake kube API server -------------------
+
+
+def pd_manifest() -> dict:
+    template = {"spec": {"containers": [
+        {"name": "engine", "image": "native:v1"}]}}
+    autoscaling = {
+        "minReplicas": 1, "maxReplicas": 3,
+        "scaleDownStabilizationSeconds": 60,
+        "drainDeadlineSeconds": 120,
+    }
+    pre = dict(autoscaling, targets={"queueLength": 4})
+    dec = dict(autoscaling, targets={"kvCacheUtilization": 0.8})
+    return {
+        "apiVersion": "fusioninfer.io/v1alpha1",
+        "kind": "InferenceService",
+        "metadata": {"name": "qwen", "namespace": "default", "generation": 1},
+        "spec": {"roles": [
+            {"name": "prefiller", "componentType": "prefiller", "replicas": 1,
+             "engine": "native", "tpu": {"type": "v5e", "topology": "4x4"},
+             "template": copy.deepcopy(template), "autoscaling": pre},
+            {"name": "decoder", "componentType": "decoder", "replicas": 1,
+             "engine": "native", "tpu": {"type": "v5e", "topology": "4x4"},
+             "template": copy.deepcopy(template), "autoscaling": dec},
+        ]},
+    }
+
+
+HOSTS_PER_SLICE = 4  # v5e 4x4 = 16 chips / 4 per host = 4 hosts per slice
+
+
+class E2EHarness:
+    def __init__(self):
+        self.fake = FakeK8s()
+        self.fake.create(pd_manifest())
+        self.reconciler = InferenceServiceReconciler(self.fake)
+        self.clock = FakeClock()
+        self.fleet = FleetSim()
+        self.marks: dict[str, bool] = {}
+        self.controller = AutoscaleController(
+            self.fake,
+            collector=make_collector(self.fleet, self.clock),
+            clock=self.clock,
+            mark_draining=lambda n, v: self.marks.__setitem__(n, v),
+        )
+        self.reconcile()
+
+    def svc(self) -> dict:
+        return self.fake.get("InferenceService", "default", "qwen")
+
+    def replicas(self, role: str) -> int:
+        for r in self.svc()["spec"]["roles"]:
+            if r["name"] == role:
+                return r["replicas"]
+        raise KeyError(role)
+
+    def condition(self, ctype: str):
+        for c in (self.svc().get("status") or {}).get("conditions") or []:
+            if c["type"] == ctype:
+                return c
+        return None
+
+    def tick(self, dt: float = 15.0):
+        self.clock.advance(dt)
+        self.controller.step()
+
+    def reconcile(self):
+        self.reconciler.reconcile("default", "qwen")
+
+    def assert_podgroup_consistent(self):
+        pg = self.fake.get("PodGroup", "default", "qwen")
+        want = (self.replicas("prefiller") + self.replicas("decoder")) \
+            * HOSTS_PER_SLICE
+        assert pg["spec"]["minMember"] == want, \
+            f"PodGroup minMember {pg['spec']['minMember']} != {want}"
+        tasks = pg["spec"]["minTaskMember"]
+        assert set(tasks) == {
+            *(f"prefiller-{i}" for i in range(self.replicas("prefiller"))),
+            *(f"decoder-{i}" for i in range(self.replicas("decoder"))),
+        }
+        assert all(v == HOSTS_PER_SLICE for v in tasks.values())
+
+    def assert_lws_set(self, role: str, n: int):
+        for i in range(n):
+            assert self.fake.get_or_none(
+                "LeaderWorkerSet", "default", f"qwen-{role}-{i}") is not None
+        assert self.fake.get_or_none(
+            "LeaderWorkerSet", "default", f"qwen-{role}-{n}") is None
+
+
+class TestE2EScaleRamp:
+    def test_load_ramp_scales_min_to_max_in_whole_slice_units(self):
+        h = E2EHarness()
+        h.assert_podgroup_consistent()
+
+        # ramp: prefill queue at 2x target, decode KV past target
+        h.fleet.set("qwen-prefiller-0", waiting=8)
+        h.fleet.set("qwen-decoder-0", kv=0.95)
+        h.tick()
+        assert h.replicas("prefiller") == 2  # ceil(1 * 8/4)
+        assert h.replicas("decoder") == 2  # ceil(1 * 0.95/0.8)
+        h.reconcile()
+        h.assert_lws_set("prefiller", 2)
+        h.assert_lws_set("decoder", 2)
+        h.assert_podgroup_consistent()
+
+        # the new replicas come up equally loaded: pressure persists
+        h.fleet.set("qwen-prefiller-1", waiting=8)
+        h.fleet.set("qwen-decoder-1", kv=0.95)
+        h.tick()
+        assert h.replicas("prefiller") == 3  # ceil(2*2) = 4 → clamped to max
+        assert h.replicas("decoder") == 3
+        h.reconcile()
+        h.assert_lws_set("prefiller", 3)
+        h.assert_podgroup_consistent()
+
+        # pinned at max under pressure: ScalingLimited surfaces it
+        h.fleet.set("qwen-prefiller-2", waiting=8)
+        h.fleet.set("qwen-decoder-2", kv=0.95)
+        h.tick()
+        assert h.replicas("prefiller") == 3
+        limited = h.condition("ScalingLimited")
+        assert limited and limited["status"] == "True"
+        assert limited["reason"] == "TooManyReplicas"
+        active = h.condition("ScalingActive")
+        assert active and active["status"] == "True"
+
+    def test_scale_up_survives_reconcile_status_writes(self):
+        """Conditions written by the autoscaler and the reconciler's
+        component status coexist on one status object."""
+        h = E2EHarness()
+        h.fleet.set("qwen-prefiller-0", waiting=8)
+        h.fleet.set("qwen-decoder-0", kv=0.1)
+        h.tick()
+        h.reconcile()
+        status = h.svc()["status"]
+        assert "componentStatus" in status
+        assert h.condition("ScalingActive") is not None
+        assert h.condition("Initialized") is not None
+
+
+class TestE2EDrainScaleDown:
+    def _ramp_to(self, h: E2EHarness, n: int):
+        h.fleet.set("qwen-prefiller-0", waiting=20)
+        h.fleet.set("qwen-decoder-0", kv=0.99)
+        while h.replicas("prefiller") < n:
+            for i in range(3):
+                h.fleet.set(f"qwen-prefiller-{i}", waiting=20)
+                h.fleet.set(f"qwen-decoder-{i}", kv=0.99)
+            h.tick()
+            h.reconcile()
+
+    def test_drain_then_scale_down_kills_no_inflight(self):
+        h = E2EHarness()
+        self._ramp_to(h, 3)
+        assert h.replicas("prefiller") == 3
+        h.assert_podgroup_consistent()
+
+        # load vanishes — but replica 2 still holds an in-flight stream
+        for i in range(3):
+            h.fleet.set(f"qwen-prefiller-{i}", waiting=0)
+            h.fleet.set(f"qwen-decoder-{i}", kv=0.1)
+        h.fleet.set("qwen-prefiller-2", running=1)
+
+        # inside the down-stabilization window: hold
+        h.tick()
+        assert h.replicas("prefiller") == 3, \
+            "scale-down must wait out the stabilization window"
+
+        # window ages out (regular 15s cadence — a single long jump
+        # would read as an observation gap and restart coverage) →
+        # drain begins; victims are marked, spec is NOT yet shrunk
+        for _ in range(5):
+            h.tick()
+        assert h.replicas("prefiller") == 3
+        assert h.marks.get("qwen-prefiller-1") is True
+        assert h.marks.get("qwen-prefiller-2") is True
+
+        # victim still busy → the loop keeps waiting
+        h.tick()
+        assert h.replicas("prefiller") == 3
+
+        # stream completes → next tick shrinks, and ONLY then
+        h.fleet.set("qwen-prefiller-2", running=0)
+        h.tick()
+        assert h.replicas("prefiller") == 1
+        assert h.fleet.in_flight("qwen-prefiller-1") == 0
+        assert h.fleet.in_flight("qwen-prefiller-2") == 0
+        assert h.marks.get("qwen-prefiller-1") is False, "marks released"
+        h.reconcile()
+        h.assert_lws_set("prefiller", 1)
+        h.assert_podgroup_consistent()
+
+    def test_drain_deadline_bounds_a_wedged_victim(self):
+        h = E2EHarness()
+        self._ramp_to(h, 3)
+        for i in range(3):
+            h.fleet.set(f"qwen-prefiller-{i}", waiting=0)
+            h.fleet.set(f"qwen-decoder-{i}", kv=0.1)
+        h.fleet.set("qwen-prefiller-2", running=1)  # wedged forever
+        for _ in range(5):
+            h.tick()  # age out the down window → drain begins
+        h.tick()  # still draining
+        assert h.replicas("prefiller") == 3
+        h.tick(121)  # past drainDeadlineSeconds=120
+        assert h.replicas("prefiller") == 1, \
+            "a wedged request must not pin a slice past the deadline"
+
+    def test_pressure_return_abandons_drain(self):
+        h = E2EHarness()
+        self._ramp_to(h, 3)
+        for i in range(3):
+            h.fleet.set(f"qwen-prefiller-{i}", waiting=0)
+            h.fleet.set(f"qwen-decoder-{i}", kv=0.1)
+        h.fleet.set("qwen-prefiller-2", running=1)
+        for _ in range(5):
+            h.tick()  # age out the down window → drain begins toward 1
+        assert h.marks.get("qwen-prefiller-1") is True
+        # load comes back hard on the survivor while victims drain
+        h.fleet.set("qwen-prefiller-0", waiting=40)
+        h.tick()
+        assert h.marks.get("qwen-prefiller-1") is False, \
+            "victims rejoin the rotation when the shrink proves wrong"
+        assert h.replicas("prefiller") == 3, "no shrink was applied"
+
+
+@pytest.mark.chaos
+class TestE2EChaosPartition:
+    def test_partitioned_role_holds_last_known_good(self):
+        h = E2EHarness()
+        h.fleet.set("qwen-prefiller-0", waiting=8)
+        h.fleet.set("qwen-decoder-0", kv=0.5)
+        h.tick()
+        assert h.replicas("prefiller") == 2
+
+        # the whole prefill fleet partitions: scrapes fail, breakers
+        # open, the stale samples age out
+        h.fleet.partitioned.update({"qwen-prefiller-0", "qwen-prefiller-1"})
+        for _ in range(3):
+            h.tick()  # 45s: breakers open, samples 45s old > stale 30s
+        assert h.controller.collector.breaker("qwen-prefiller-0").state == "open"
+        assert h.replicas("prefiller") == 2, \
+            "no usable samples → hold last-known-good, never guess"
+        active = h.condition("ScalingActive")
+        assert active and active["status"] == "False"
+        assert active["reason"] == "FailedGetMetrics"
+
+        # partition heals: scraping resumes once the breakers re-probe,
+        # and the loop goes active again
+        h.fleet.partitioned.clear()
+        h.fleet.set("qwen-prefiller-0", waiting=0)
+        h.fleet.set("qwen-prefiller-1", waiting=0)
+        h.tick(31)  # past breaker recovery_timeout_s=30 → half-open probe
+        active = h.condition("ScalingActive")
+        assert active and active["status"] == "True"
+
+    def test_partial_partition_scales_on_surviving_fresh_samples(self):
+        h = E2EHarness()
+        h.fleet.set("qwen-prefiller-0", waiting=4)
+        h.fleet.set("qwen-decoder-0", kv=0.5)
+        h.tick()
+        assert h.replicas("prefiller") == 1
+        h.fleet.partitioned.add("qwen-decoder-0")
+        h.fleet.set("qwen-prefiller-0", waiting=8)
+        h.tick()
+        assert h.replicas("prefiller") == 2, \
+            "a partitioned sibling role must not freeze healthy roles"
+
+
+class TestScaleUpProvisioningHold:
+    def test_no_compounding_while_new_replicas_provision(self):
+        """Slice gang-scheduling takes minutes: until the replicas the
+        last scale-up bought start reporting, the same pressure reading
+        must not keep buying more (HPA's unready discounting)."""
+        h = E2EHarness()
+        h.fleet.set("qwen-prefiller-0", waiting=8)
+        h.fleet.set("qwen-decoder-0", kv=0.1)
+        h.tick()
+        assert h.replicas("prefiller") == 2
+        # replica 1 never comes up (no endpoint in the fleet sim): the
+        # still-saturated old endpoint must not ramp us to max
+        h.fleet.partitioned.add("qwen-prefiller-1")
+        h.tick()
+        h.tick()
+        assert h.replicas("prefiller") == 2, \
+            "pressure from provisioning-lag must not compound to max"
+        # the new replica reports in → the ratio may grow again
+        h.fleet.partitioned.discard("qwen-prefiller-1")
+        h.fleet.set("qwen-prefiller-1", waiting=8)
+        h.tick()
+        assert h.replicas("prefiller") == 3
+
+
+class TestMidDrainSpecEdit:
+    def test_user_replica_edit_mid_drain_abandons(self):
+        """A drain planned against a stale replica count must not shrink
+        the edited spec — replicas the plan never drained would die."""
+        h = E2EHarness()
+        for i in range(3):
+            h.fleet.set(f"qwen-prefiller-{i}", waiting=20)
+            h.fleet.set(f"qwen-decoder-{i}", kv=0.5)
+        h.tick()
+        h.reconcile()
+        for i in range(3):
+            h.fleet.set(f"qwen-prefiller-{i}", waiting=0)
+        h.fleet.set("qwen-prefiller-2", running=1)  # drain stays pending
+        for _ in range(5):
+            h.tick()
+        assert h.marks.get("qwen-prefiller-1") is True  # drain active
+        raw = h.svc()
+        raw["spec"]["roles"][0]["replicas"] = 2  # user shrinks by hand
+        h.fake.update(raw)
+        h.tick()
+        assert h.marks.get("qwen-prefiller-1") is False, \
+            "stale drain plan must be abandoned on a spec edit"
+        assert h.replicas("prefiller") == 2, "the user's edit stands"
+
+
+class TestOrphanedDrainLabels:
+    def test_restarted_controller_releases_predecessor_labels(self):
+        """Drain state lives in controller memory: after a crash the
+        replacement must not leave the predecessor's drain labels
+        excluding live slices from routing forever."""
+        from fusioninfer_tpu.workload.labels import LABEL_DRAINING
+
+        fake = FakeK8s()
+        fake.create(pd_manifest())
+        InferenceServiceReconciler(fake).reconcile("default", "qwen")
+        lws = fake.get("LeaderWorkerSet", "default", "qwen-prefiller-0")
+        lws["metadata"].setdefault("labels", {})[LABEL_DRAINING] = "true"
+        fake.update(lws)  # the crashed predecessor's leftover
+        clock = FakeClock()
+        fleet = FleetSim()
+        controller = AutoscaleController(
+            fake, collector=make_collector(fleet, clock), clock=clock)
+        clock.advance(15)
+        controller.step()
+        lws = fake.get("LeaderWorkerSet", "default", "qwen-prefiller-0")
+        assert LABEL_DRAINING not in (lws["metadata"].get("labels") or {})
+
+
+class TestConditionLifecycle:
+    def test_disabling_autoscaling_clears_scaling_conditions(self):
+        """enabled: false must not leave ScalingActive=True lying — a
+        status claiming an active autoscaler that is ignoring the
+        service misleads every dashboard."""
+        h = E2EHarness()
+        h.fleet.set("qwen-prefiller-0", waiting=8)
+        h.fleet.set("qwen-decoder-0", kv=0.5)
+        h.tick()
+        assert h.condition("ScalingActive")["status"] == "True"
+        raw = h.svc()
+        for role in raw["spec"]["roles"]:
+            role.setdefault("autoscaling", {"targets": {"queueLength": 4}})
+            role["autoscaling"]["enabled"] = False
+        h.fake.update(raw)
+        h.tick()
+        active = h.condition("ScalingActive")
+        assert active["status"] == "False"
+        assert active["reason"] == "ScalingDisabled"
+        # steady state after the clear: no status PUT per tick
+        before = sum(1 for a in h.fake.actions if a[0] == "update_status")
+        h.tick()
+        h.tick()
+        after = sum(1 for a in h.fake.actions if a[0] == "update_status")
+        assert after == before, \
+            "a disabled service must not pay a no-op status write per tick"
+
+
+class TestDrainCleanup:
+    def test_disabling_autoscaling_mid_drain_releases_victims(self):
+        """Removing the stanza while a drain is in flight must not leave
+        the victims marked draining forever."""
+        h = E2EHarness()
+        for i in range(3):
+            h.fleet.set(f"qwen-prefiller-{i}", waiting=20)
+            h.fleet.set(f"qwen-decoder-{i}", kv=0.5)
+        h.tick()
+        h.reconcile()
+        for i in range(3):
+            h.fleet.set(f"qwen-prefiller-{i}", waiting=0)
+        h.fleet.set("qwen-prefiller-2", running=1)  # drain can't finish
+        for _ in range(5):
+            h.tick()
+        assert h.marks.get("qwen-prefiller-1") is True
+        raw = h.svc()
+        del raw["spec"]["roles"][0]["autoscaling"]
+        h.fake.update(raw)
+        h.tick()
+        assert h.marks.get("qwen-prefiller-1") is False
+        assert h.marks.get("qwen-prefiller-2") is False
+        assert h.replicas("prefiller") == 3, "no shrink was applied"
+
+
+class TestDefaultDrainMarker:
+    def test_drain_stamps_label_on_victim_lws(self):
+        """Without an injected hook the drain is still a real,
+        cluster-visible signal: the victim LWS carries the draining
+        label while it quiesces, and loses it on release."""
+        from fusioninfer_tpu.autoscale.controller import DRAINING_LABEL
+
+        fake = FakeK8s()
+        fake.create(pd_manifest())
+        reconciler = InferenceServiceReconciler(fake)
+        clock = FakeClock()
+        fleet = FleetSim()
+        controller = AutoscaleController(
+            fake, collector=make_collector(fleet, clock), clock=clock)
+
+        def tick(dt=15.0):
+            clock.advance(dt)
+            controller.step()
+
+        reconciler.reconcile("default", "qwen")
+        fleet.set("qwen-prefiller-0", waiting=20)
+        fleet.set("qwen-decoder-0", kv=0.5)
+        tick()
+        reconciler.reconcile("default", "qwen")
+        assert controller.client.get(
+            "InferenceService", "default", "qwen"
+        )["spec"]["roles"][0]["replicas"] == 3  # ceil(1 * 20/4) → clamp 3
+        for i in range(3):
+            fleet.set(f"qwen-prefiller-{i}", waiting=0)
+        fleet.set("qwen-prefiller-2", running=1)  # keep the drain pending
+        for _ in range(5):
+            tick()  # age out the covered down-window → drain begins
+        lws = fake.get("LeaderWorkerSet", "default", "qwen-prefiller-2")
+        assert lws["metadata"]["labels"][DRAINING_LABEL] == "true"
+        # a reconciler re-render wipes the label mid-drain: the next
+        # tick's level-triggered sync must restore it
+        del lws["metadata"]["labels"][DRAINING_LABEL]
+        fake.update(lws)
+        tick()
+        lws = fake.get("LeaderWorkerSet", "default", "qwen-prefiller-2")
+        assert lws["metadata"]["labels"][DRAINING_LABEL] == "true", \
+            "wiped drain label must be re-asserted while the drain lives"
+        fleet.set("qwen-prefiller-2", running=0)
+        tick()  # drained → shrink applied, marks released
+        assert controller.client.get(
+            "InferenceService", "default", "qwen"
+        )["spec"]["roles"][0]["replicas"] == 1
+
+
+class TestManagerIntegration:
+    def test_autoscaler_rides_the_manager(self):
+        """Full operator wiring, real threads: the autoscale loop patches
+        the spec, the manager's watch enqueues the reconcile, the LWS set
+        and PodGroup grow, and the manager's /metrics exposition carries
+        the autoscaler families."""
+        import time as _time
+        import urllib.request
+
+        from fusioninfer_tpu.operator import Manager
+
+        fake = FakeK8s()
+        fake.create(pd_manifest())
+        fleet = FleetSim()
+        fleet.set("qwen-prefiller-0", waiting=8)
+        fleet.set("qwen-decoder-0", kv=0.5)
+        controller = AutoscaleController(
+            fake,
+            collector=MetricsCollector(fetch=fleet.fetch,
+                                       sleep=lambda d: None),
+            interval_s=0.02,
+        )
+        mgr = Manager(fake, namespace="default", probe_port=0, metrics_port=0,
+                      autoscaler=controller)
+        mgr.start()
+        try:
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline:
+                lws = fake.get_or_none(
+                    "LeaderWorkerSet", "default", "qwen-prefiller-1")
+                if lws is not None:
+                    break
+                _time.sleep(0.02)
+            assert fake.get_or_none(
+                "LeaderWorkerSet", "default", "qwen-prefiller-1") is not None, \
+                "autoscaler spec patch must flow through watch → reconcile"
+            pg = fake.get("PodGroup", "default", "qwen")
+            assert "prefiller-1" in pg["spec"]["minTaskMember"]
+            port = mgr._metrics_server.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                body = r.read().decode()
+            assert "fusioninfer:autoscaler_desired_replicas" in body
+            assert "controller_runtime_reconcile_total" in body
+        finally:
+            mgr.stop()
+
+
+class TestSelfMetrics:
+    def test_exposition_reports_decisions_and_replica_gauges(self):
+        h = E2EHarness()
+        h.fleet.set("qwen-prefiller-0", waiting=8)
+        h.fleet.set("qwen-decoder-0", kv=0.1)
+        h.tick()
+        text = h.controller.metrics.render()
+        assert "# HELP fusioninfer:autoscaler_desired_replicas" in text
+        assert ('fusioninfer:autoscaler_desired_replicas{namespace="default",'
+                'service="qwen",role="prefiller"} 2') in text
+        assert ('fusioninfer:autoscaler_decisions_total{namespace="default",'
+                'service="qwen",role="prefiller",direction="up"} 1') in text
+        assert ('fusioninfer:autoscaler_decisions_total{namespace="default",'
+                'service="qwen",role="decoder",direction="hold"} 1') in text
+        assert ('fusioninfer:autoscaler_last_scale_clock_seconds'
+                '{namespace="default",service="qwen",role="prefiller"} 15')\
+            in text

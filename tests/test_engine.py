@@ -420,6 +420,13 @@ class TestProfileEndpoint:
 
         srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
                            engine=make_engine())
+        # fake capture window: the handler must request exactly the
+        # seconds the client asked for, but the test must not spend
+        # wall time inside a loaded tier-1 run (this was a reliable
+        # full-suite flake before the sleep became injectable) — the
+        # trace artifacts are written by start/stop_trace regardless
+        slept: list[float] = []
+        srv._profile_sleep = slept.append
         srv.start()
         try:
             req = urllib.request.Request(
@@ -431,12 +438,18 @@ class TestProfileEndpoint:
             with pytest.raises(urllib.error.HTTPError) as e:
                 urllib.request.urlopen(req, timeout=30)
             assert e.value.code == 400
+            assert slept == [], "a refused capture must not start a window"
 
             srv.enable_profiling = True
             srv.profile_dir = str(tmp_path)
-            with urllib.request.urlopen(req, timeout=30) as r:
+            # generous client timeout: the capture window is faked but
+            # jax.profiler start/stop_trace itself can take >30s late in
+            # a long test process (it serializes the accumulated trace
+            # state) — the 30s timeout here was the residual flake
+            with urllib.request.urlopen(req, timeout=300) as r:
                 out = json.load(r)
             assert out["status"] == "ok" and out["dir"] == str(tmp_path)
+            assert slept == [pytest.approx(0.2)]
             assert glob.glob(str(tmp_path) + "/**/*.pb", recursive=True) or \
                 glob.glob(str(tmp_path) + "/**/*.trace*", recursive=True), \
                 "no trace artifacts written"

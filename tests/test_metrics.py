@@ -1,0 +1,125 @@
+"""Histogram quantile estimation + exposition self-description.
+
+The quantile is the autoscaler's TTFT-p90 scaling signal
+(docs/design/autoscaling.md); conventions must match PromQL's
+``histogram_quantile`` so a dashboard and the control loop never
+disagree about the same buckets.
+"""
+
+import pytest
+
+from fusioninfer_tpu.engine.metrics import (
+    TTFT_BUCKETS,
+    EngineMetrics,
+    Histogram,
+    histogram_quantile,
+)
+
+
+class TestHistogramQuantile:
+    def test_empty_histogram_has_no_quantile(self):
+        h = Histogram((0.1, 1.0))
+        assert h.quantile(0.9) is None
+
+    def test_single_bucket_interpolates_from_zero(self):
+        h = Histogram((1.0, 2.0))
+        for _ in range(10):
+            h.observe(0.5)  # all land in le=1.0
+        # PromQL convention: interpolate within [0, 1.0]
+        assert h.quantile(0.5) == pytest.approx(0.5)
+        assert h.quantile(1.0) == pytest.approx(1.0)
+
+    def test_interpolation_between_bounds(self):
+        h = Histogram((1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.5, 1.5):  # cum: [1, 4, 4] of 4
+            h.observe(v)
+        # rank 0.5*4=2 lands in (1.0, 2.0]: 1 + (2-1)*(2-1)/(4-1)
+        assert h.quantile(0.5) == pytest.approx(1.0 + 1.0 / 3.0)
+
+    def test_quantile_in_inf_bucket_returns_highest_finite_bound(self):
+        h = Histogram((0.1, 0.5))
+        h.observe(100.0)  # +Inf bucket
+        assert h.quantile(0.9) == pytest.approx(0.5)
+
+    def test_monotone_in_q(self):
+        h = Histogram(TTFT_BUCKETS)
+        import random
+
+        rng = random.Random(7)
+        for _ in range(500):
+            h.observe(rng.uniform(0.0, 3.0))
+        qs = [h.quantile(q) for q in (0.1, 0.5, 0.9, 0.99)]
+        assert qs == sorted(qs)
+        # p90 of U(0,3) ≈ 2.7 lands in the (2.5, 5.0] bucket; the
+        # estimate can sit anywhere inside that bucket's bounds
+        assert 2.5 <= qs[2] <= 5.0
+
+    def test_validates_inputs(self):
+        h = Histogram((1.0,))
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+        with pytest.raises(ValueError):
+            histogram_quantile((1.0, 2.0), (1, 2), 0.5)  # missing +Inf count
+
+    def test_module_function_matches_scraped_shape(self):
+        """The same answer whether fed an in-process Histogram or
+        cumulative counts re-parsed from an exposition — the collector
+        uses the latter path."""
+        h = Histogram((0.5, 1.0, 2.0))
+        for v in (0.2, 0.7, 0.7, 1.5, 3.0):
+            h.observe(v)
+        cumulative = []
+        running = 0
+        for c in h.counts:
+            running += c
+            cumulative.append(running)
+        assert histogram_quantile(h.buckets, cumulative, 0.9) == h.quantile(0.9)
+
+
+class _EngineStub:
+    num_running = 1
+    num_waiting = 2
+    num_prefilling = 0
+    prompt_tokens_total = 10
+    generation_tokens_total = 20
+    spec_proposed_total = 0
+    spec_accepted_total = 0
+    preemptions_total = 0
+    finished_total = 3
+    errors_total = 1
+    cancelled_total = 0
+
+    def kv_cache_usage(self):
+        return 0.25
+
+    def prefix_cache_hit_rate(self):
+        return 0.0
+
+
+class TestExpositionSelfDescription:
+    def test_every_family_has_help_and_type(self):
+        """Uniformly self-describing: any line's family must have # HELP
+        and # TYPE lines (the counter families shipped without HELP)."""
+        text = EngineMetrics("m").render(_EngineStub())
+        helps, types, families = set(), set(), set()
+        for line in text.splitlines():
+            if line.startswith("# HELP "):
+                helps.add(line.split()[2])
+            elif line.startswith("# TYPE "):
+                types.add(line.split()[2])
+            elif line:
+                name = line.split("{", 1)[0]
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if name.endswith(suffix):
+                        name = name[: -len(suffix)]
+                        break
+                families.add(name)
+        assert families <= types, f"families missing TYPE: {families - types}"
+        # HELP required for every counter/gauge family (the histogram
+        # families carry TYPE only today)
+        counters_and_gauges = {
+            f for f in families
+            if not f.endswith("_seconds")  # the three histogram families
+        }
+        assert counters_and_gauges <= helps, \
+            f"families missing HELP: {sorted(counters_and_gauges - helps)}"

@@ -18,6 +18,16 @@ both "a hung or swallowed failure is invisible until slice scale":
                      timeout argument is present.  Bare ``socket()`` +
                      ``connect`` is NOT covered (needs flow analysis);
                      prefer ``create_connection`` so the lint sees it.
+  wall-clock         (``fusioninfer_tpu/autoscale/`` only) direct
+                     ``time.time()`` / ``time.sleep()`` calls — and
+                     ``from time import time/sleep`` aliases — are
+                     forbidden in the autoscale control loops: scaling
+                     decisions, stabilization windows, staleness cutoffs
+                     and drain deadlines must run against an injected
+                     clock so the chaos/e2e suites drive them
+                     deterministically (``time.monotonic`` as an
+                     injectable DEFAULT is fine; pacing belongs to
+                     ``Event.wait``).
 
 ``# noqa`` on the offending line suppresses (same convention as
 ``tools/lint.py``); use it only for call sites that provably cannot
@@ -49,6 +59,12 @@ _TIMEOUT_CALLS = {
 }
 
 
+# directory (relative to repo root) whose control loops must take an
+# injected clock; the names banned as direct calls there
+_INJECTED_CLOCK_DIR = "fusioninfer_tpu/autoscale"
+_WALL_CLOCK_BANNED = {"time", "sleep"}
+
+
 def _callee_name(func: ast.expr) -> str | None:
     if isinstance(func, ast.Attribute):
         return func.attr
@@ -72,6 +88,7 @@ def check_file(path: pathlib.Path) -> list[str]:
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: syntax-error {e.msg}"]
     rel = path.relative_to(REPO) if path.is_relative_to(REPO) else path
+    in_autoscale = str(rel).replace("\\", "/").startswith(_INJECTED_CLOCK_DIR)
     noqa_lines = {
         i + 1 for i, line in enumerate(src.splitlines()) if "# noqa" in line
     }
@@ -84,6 +101,19 @@ def check_file(path: pathlib.Path) -> list[str]:
                     "types (a swallowed failure cannot be retried or routed "
                     "around)"
                 )
+        elif isinstance(node, ast.ImportFrom):
+            if (in_autoscale and node.module == "time"
+                    and node.lineno not in noqa_lines):
+                bad = sorted(
+                    a.name for a in node.names if a.name in _WALL_CLOCK_BANNED
+                )
+                if bad:
+                    findings.append(
+                        f"{rel}:{node.lineno}: wall-clock — importing "
+                        f"{', '.join(bad)} from time in autoscale/ hides a "
+                        "wall-clock dependency; control loops take an "
+                        "injected clock"
+                    )
         elif isinstance(node, ast.Call):
             if node.lineno in noqa_lines:
                 continue
@@ -93,6 +123,17 @@ def check_file(path: pathlib.Path) -> list[str]:
                 findings.append(
                     f"{rel}:{node.lineno}: missing-timeout — {name}() without "
                     "an explicit timeout can block a thread forever"
+                )
+            if (in_autoscale
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _WALL_CLOCK_BANNED
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "time"):
+                findings.append(
+                    f"{rel}:{node.lineno}: wall-clock — time.{node.func.attr}() "
+                    "in autoscale/ breaks deterministic control-loop tests; "
+                    "take an injected clock (time.monotonic as a default "
+                    "ARGUMENT is fine, calling it inline is not)"
                 )
     return findings
 
