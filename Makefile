@@ -49,10 +49,17 @@ autoscale: ## Autoscaling suite (fake-clock control-loop + drain + chaos; docs/d
 	$(PYTHON) -m pytest tests/test_autoscale.py tests/test_metrics.py -q
 
 .PHONY: lint
-lint: ## Gating lint: in-repo AST linter + resilience rules + byte-compile (CI adds ruff).
-	$(PYTHON) tools/lint.py
-	$(PYTHON) tools/lint_resilience.py
+lint: ## Gating lint: fusionlint (all six passes, JSON archived to dist/lint.json) + byte-compile (CI adds ruff).
+	$(PYTHON) -m tools.fusionlint --json-out dist/lint.json
 	$(PYTHON) -m compileall -q fusioninfer_tpu tests tools bench.py __graft_entry__.py
+
+.PHONY: lint-changed
+lint-changed: ## Fast pre-commit lint: fusionlint over files differing from HEAD only.
+	$(PYTHON) -m tools.fusionlint --changed
+
+.PHONY: verify-manifests
+verify-manifests: ## Regenerate CRDs/config from the Python sources in memory, fail on drift; validate samples against the CRD schemas.
+	$(PYTHON) tools/verify_manifests.py
 
 .PHONY: bench
 bench: ## One-line JSON decode-throughput benchmark (real chip if present).

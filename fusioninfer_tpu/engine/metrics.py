@@ -163,10 +163,13 @@ class EngineMetrics:
             "# HELP vllm:gpu_prefix_cache_hit_rate fraction of prompt tokens served from cached prefix pages.",
             "# TYPE vllm:gpu_prefix_cache_hit_rate gauge",
             f"vllm:gpu_prefix_cache_hit_rate{{{labels}}} {engine.prefix_cache_hit_rate():.6f}",
+            "# HELP vllm:time_to_first_token_seconds Time from request arrival to first emitted token.",
             "# TYPE vllm:time_to_first_token_seconds histogram",
             *self.ttft.render("vllm:time_to_first_token_seconds", labels),
+            "# HELP vllm:time_per_output_token_seconds Per-token decode latency after the first token.",
             "# TYPE vllm:time_per_output_token_seconds histogram",
             *self.tpot.render("vllm:time_per_output_token_seconds", labels),
+            "# HELP vllm:e2e_request_latency_seconds End-to-end request latency.",
             "# TYPE vllm:e2e_request_latency_seconds histogram",
             *self.e2e_latency.render("vllm:e2e_request_latency_seconds", labels),
         ]

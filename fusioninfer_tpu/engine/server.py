@@ -527,11 +527,19 @@ class EngineServer:
         return {"status": "ok", "dir": out_dir, "seconds": seconds}
 
     def handle_prefill(self, body: dict) -> bytes:
-        if self._draining:
-            # a draining prefiller must refuse new slabs or it can never
-            # finish draining (decode replicas POST here directly)
-            raise Draining("server is draining; retry another replica")
         """Prefiller role: run one prefill, return the KV slab frame."""
+        # drain-safety: the flag is read under the lock drain() flips it
+        # under, and the ONLY route here is do_POST, whose _inflight
+        # bracket (incremented under the same lock, before this check)
+        # keeps drain()'s idle poll from reading the server as quiet
+        # while a slab request sits between this check and engine
+        # submission
+        with self._lock:
+            if self._draining:
+                # a draining prefiller must refuse new slabs or it can
+                # never finish draining (decode replicas POST here
+                # directly)
+                raise Draining("server is draining; retry another replica")
         from fusioninfer_tpu.engine.kv_transfer import slab_to_bytes
 
         prompt_tokens = [int(t) for t in body.get("prompt_tokens", [])]

@@ -138,7 +138,7 @@ class SharedInformer:
         # client-go contract: a handler registered after sync gets the
         # current cache replayed as adds (a late consumer of a SHARED
         # informer must not start blind).  Registration holds the same
-        # lock as _dispatch, so a concurrent event can neither be missed
+        # lock as _dispatch_locked, so a concurrent event can neither be missed
         # (arrives after append → dispatched) nor doubled (in the
         # snapshot AND dispatched mid-registration).
         with self._handler_lock:
@@ -172,7 +172,13 @@ class SharedInformer:
 
     # -- internals --
 
-    def _dispatch(self, event: str, *args: dict) -> None:
+    def _dispatch_locked(self, event: str, *args: dict) -> None:
+        # every caller holds _handler_lock (the `_locked` suffix is
+        # load-bearing for fusionlint's lock-discipline pass): store
+        # change + delivery must be atomic against add_event_handler's
+        # replay, which is exactly why delivery serializes with
+        # registration — a slow handler does delay add_event_handler,
+        # and that is the documented exactly-once contract, not a bug
         for h in self._handlers:
             fn = h.get(event)
             if fn is None:
@@ -204,30 +210,30 @@ class SharedInformer:
                 self._track_rv(obj)
                 prev = self.store.put(obj)
                 if prev is None:
-                    self._dispatch("add", obj)
+                    self._dispatch_locked("add", obj)
                 elif (prev["metadata"].get("resourceVersion")
                       != meta.get("resourceVersion")):
-                    self._dispatch("update", prev, obj)
+                    self._dispatch_locked("update", prev, obj)
                 elif fire == "resync":
-                    self._dispatch("update", prev, obj)
+                    self._dispatch_locked("update", prev, obj)
             for stale in [o for o in self.store.list()
                           if self.store._key(o) not in seen]:
                 self.store.remove(stale)
-                self._dispatch("delete", stale)
+                self._dispatch_locked("delete", stale)
 
     def _handle_event(self, etype: str, obj: dict) -> None:
         self._track_rv(obj)
         with self._handler_lock:  # store change + delivery are atomic
             if etype == "DELETED":
                 prev = self.store.remove(obj)
-                self._dispatch("delete", prev or obj)
+                self._dispatch_locked("delete", prev or obj)
                 return
             prev = self.store.put(obj)
             if prev is None:
-                self._dispatch("add", obj)
+                self._dispatch_locked("add", obj)
             elif (prev["metadata"].get("resourceVersion")
                   != (obj.get("metadata") or {}).get("resourceVersion")):
-                self._dispatch("update", prev, obj)
+                self._dispatch_locked("update", prev, obj)
 
     def _run(self) -> None:
         self._last_rv = ""

@@ -44,17 +44,25 @@ class FakeK8s(K8sClient):
     def token_review(self, token: str) -> bool:
         """Fake authentication.k8s.io/v1 TokenReview: authenticated iff the
         test registered the token in ``valid_tokens``."""
-        self.actions.append(("tokenreview", "TokenReview", "-"))
-        return token in self.valid_tokens
+        # called from metrics-handler threads concurrently with the
+        # reconcile worker's CRUD appends
+        with self._lock:
+            self.actions.append(("tokenreview", "TokenReview", "-"))
+            return token in self.valid_tokens
 
     def metrics_access_review(self, token: str) -> bool:
         """Fake authn+authz: authenticated AND bound to metrics-reader."""
-        self.actions.append(("accessreview", "SubjectAccessReview", "-"))
-        return token in self.valid_tokens and token in self.metrics_reader_tokens
+        with self._lock:
+            self.actions.append(("accessreview", "SubjectAccessReview", "-"))
+            return (token in self.valid_tokens
+                    and token in self.metrics_reader_tokens)
 
     # -- watch stream (apiserver watch equivalent) --
 
-    def _publish(self, etype: str, obj: dict) -> None:
+    def _publish_locked(self, etype: str, obj: dict) -> None:
+        """Fan one event out to every watcher; every caller already
+        holds ``self._lock`` (the ``_locked`` suffix is load-bearing:
+        fusionlint's lock-discipline pass trusts it)."""
         for q in list(self._watchers):
             q.put((etype, copy.deepcopy(obj)))
 
@@ -165,7 +173,7 @@ class FakeK8s(K8sClient):
             meta["resourceVersion"] = str(next(self._rv))
             self._objects[key] = stored
             self.actions.append(("create", kind, name))
-            self._publish("ADDED", stored)
+            self._publish_locked("ADDED", stored)
             return copy.deepcopy(stored)
 
     def update(self, obj: dict) -> dict:
@@ -187,7 +195,7 @@ class FakeK8s(K8sClient):
                 stored["status"] = copy.deepcopy(existing["status"])
             self._objects[key] = stored
             self.actions.append(("update", kind, name))
-            self._publish("MODIFIED", stored)
+            self._publish_locked("MODIFIED", stored)
             return copy.deepcopy(stored)
 
     def update_status(self, obj: dict) -> dict:
@@ -200,7 +208,7 @@ class FakeK8s(K8sClient):
             existing["status"] = copy.deepcopy(obj.get("status") or {})
             existing["metadata"]["resourceVersion"] = str(next(self._rv))
             self.actions.append(("update_status", kind, name))
-            self._publish("MODIFIED", existing)
+            self._publish_locked("MODIFIED", existing)
             return copy.deepcopy(existing)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -210,12 +218,13 @@ class FakeK8s(K8sClient):
             if obj is None:
                 raise NotFound(kind, namespace, name)
             self.actions.append(("delete", kind, name))
-            self._publish("DELETED", obj)
-            self._cascade(obj["metadata"].get("uid"))
+            self._publish_locked("DELETED", obj)
+            self._cascade_locked(obj["metadata"].get("uid"))
 
     # -- test conveniences --
 
-    def _cascade(self, uid: Optional[str]) -> None:
+    def _cascade_locked(self, uid: Optional[str]) -> None:
+        # caller holds self._lock (RLock: delete() re-enters via recursion)
         if not uid:
             return
         orphans = [
@@ -226,8 +235,8 @@ class FakeK8s(K8sClient):
             child = self._objects.pop(key, None)
             if child is not None:
                 self.actions.append(("delete", kind, name))
-                self._publish("DELETED", child)
-                self._cascade(child["metadata"].get("uid"))
+                self._publish_locked("DELETED", child)
+                self._cascade_locked(child["metadata"].get("uid"))
 
     def set_status(self, kind: str, namespace: str, name: str, status: dict) -> None:
         """Simulate an external controller (LWS, Volcano) reporting status."""
@@ -237,7 +246,7 @@ class FakeK8s(K8sClient):
                 raise NotFound(kind, namespace, name)
             obj["status"] = copy.deepcopy(status)
             obj["metadata"]["resourceVersion"] = str(next(self._rv))
-            self._publish("MODIFIED", obj)
+            self._publish_locked("MODIFIED", obj)
 
     def resource_version(self, kind: str, namespace: str, name: str) -> str:
         return self.get(kind, namespace, name)["metadata"]["resourceVersion"]

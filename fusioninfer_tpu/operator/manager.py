@@ -111,9 +111,13 @@ class ControllerMetrics:
 
     def render(self) -> str:
         lines = [
+            "# HELP controller_runtime_reconcile_total Total reconciliations per controller.",
             "# TYPE controller_runtime_reconcile_total counter",
+            "# HELP controller_runtime_reconcile_errors_total Reconciliations that returned an error.",
             "# TYPE controller_runtime_reconcile_errors_total counter",
+            "# HELP controller_runtime_reconcile_requeue_total Reconciliations that requeued their key.",
             "# TYPE controller_runtime_reconcile_requeue_total counter",
+            "# HELP controller_runtime_reconcile_time_seconds Reconcile wall time per controller.",
             "# TYPE controller_runtime_reconcile_time_seconds summary",
         ]
         with self._lock:
@@ -193,6 +197,11 @@ class Manager:
         # (and the chaos suite asserts their exponential growth)
         self.requeue_backoff = requeue_backoff or RetryPolicy(
             **DEFAULT_REQUEUE_BACKOFF)
+        # guarded: the reconcile worker mutates these while other
+        # threads read them — stop() racing a finishing reconcile, and
+        # the chaos suite asserting backoff growth mid-run (fusionlint
+        # lock-discipline)
+        self._requeue_state_lock = threading.Lock()
         self.requeue_delays: dict[tuple, list[float]] = {}
         self._attempts: dict[tuple, int] = {}
         self._degraded_marked: set[tuple] = set()
@@ -275,9 +284,10 @@ class Manager:
             timer.start()
 
     def _record_requeue_delay(self, key: tuple, delay: float) -> None:
-        history = self.requeue_delays.setdefault(key, [])
-        history.append(delay)
-        del history[:-REQUEUE_HISTORY_MAX]
+        with self._requeue_state_lock:
+            history = self.requeue_delays.setdefault(key, [])
+            history.append(delay)
+            del history[:-REQUEUE_HISTORY_MAX]
 
     def _mark_degraded(self, key: tuple, attempts: int) -> bool:
         """Returns True once the condition no longer needs writing —
@@ -331,13 +341,19 @@ class Manager:
                 # at the ceiling and surfaces Degraded, instead of
                 # hot-looping at a flat delay (or, for panics, being
                 # silently dropped as before)
-                attempts = self._attempts.get(key, 0) + 1
-                self._attempts[key] = attempts
+                with self._requeue_state_lock:
+                    attempts = self._attempts.get(key, 0) + 1
+                    self._attempts[key] = attempts
+                    needs_degraded_mark = (
+                        attempts >= self.requeue_backoff.max_attempts
+                        and key not in self._degraded_marked)
                 if attempts >= self.requeue_backoff.max_attempts:
                     delay = self.requeue_backoff.max_delay_s
-                    if (key not in self._degraded_marked
-                            and self._mark_degraded(key, attempts)):
-                        self._degraded_marked.add(key)
+                    # the status write happens OUTSIDE the state lock (it
+                    # is an API call that can block on a slow apiserver)
+                    if needs_degraded_mark and self._mark_degraded(key, attempts):
+                        with self._requeue_state_lock:
+                            self._degraded_marked.add(key)
                 else:
                     delay = self.requeue_backoff.delay(attempts)
                 self._record_requeue_delay(key, delay)
@@ -346,13 +362,15 @@ class Manager:
                 # still converging (children not ready): flat-delay poll,
                 # and a success resets the error budget (the reconcile
                 # pass itself cleared any Degraded condition)
-                self._attempts.pop(key, None)
-                self._degraded_marked.discard(key)
+                with self._requeue_state_lock:
+                    self._attempts.pop(key, None)
+                    self._degraded_marked.discard(key)
                 self._requeue_later(key, REQUEUE_DELAY_S)
             else:
-                self._attempts.pop(key, None)
-                self._degraded_marked.discard(key)
-                self.requeue_delays.pop(key, None)
+                with self._requeue_state_lock:
+                    self._attempts.pop(key, None)
+                    self._degraded_marked.discard(key)
+                    self.requeue_delays.pop(key, None)
 
     # -- probes + metrics --
 

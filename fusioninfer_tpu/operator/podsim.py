@@ -127,7 +127,7 @@ class LWSSimulator:
         labels = self._pod_labels(lws)
         server = self.engine_factory(self._prefiller_url(labels))
         server.start()
-        self.servers[name] = server
+        self.servers[name] = server  # noqa:lock-discipline — confined to the simulator thread; stop() joins it before reading
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -155,7 +155,7 @@ class LWSSimulator:
     def _reap(self, live_names: set) -> None:
         for name in [n for n in self.servers if n not in live_names]:
             try:
-                self.servers.pop(name).stop()
+                self.servers.pop(name).stop()  # noqa:lock-discipline — confined to the simulator thread; stop() joins it before reading
                 self.client.delete("Pod", self.namespace, f"{name}-0")
             except Exception:
                 logger.exception("podsim reap of %s failed", name)

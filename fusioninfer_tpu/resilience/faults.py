@@ -90,7 +90,8 @@ class FaultInjector:
 
     @property
     def active(self) -> bool:
-        return bool(self._rules)
+        with self._lock:  # arm()/disarm() mutate _rules from test threads
+            return bool(self._rules)
 
     def fired_count(self, site: str) -> int:
         with self._lock:

@@ -36,7 +36,10 @@ _CONFIG_FILE = "config.yaml"
 
 
 def get_epp_image() -> str:
-    image = os.environ.get(EPP_IMAGE_ENV, DEFAULT_EPP_IMAGE)
+    # deliberate deploy-time knob (the reference's RELATED_IMAGE
+    # pattern): the env var is constant per environment, so re-render
+    # stays byte-stable within any one controller process
+    image = os.environ.get(EPP_IMAGE_ENV, DEFAULT_EPP_IMAGE)  # noqa:render-purity — deploy-time knob, constant per environment
     if "@" in image:
         # a digest-form override with a mangled digest would fail only
         # at pod pull time; fail at render instead

@@ -142,6 +142,11 @@ class _PrefixAffinity:
         self.max_blocks = max(1, max_blocks)
         self._lru: "collections.OrderedDict[str, str]" = collections.OrderedDict()
         self._capacity = max(16, lru_capacity)
+        # concurrent pick()s (one per routed request, on server handler
+        # threads) score and record against the same LRU; OrderedDict
+        # move-to-end/evict is a multi-step mutation and must not
+        # interleave (fusionlint lock-discipline)
+        self._lock = threading.Lock()
 
     def _block_hashes(self, prompt: str) -> list[str]:
         hashes, chain = [], b""
@@ -157,18 +162,21 @@ class _PrefixAffinity:
         if not hashes:
             return 0.0
         matched = 0
-        for h in hashes:  # leading consecutive blocks held by this endpoint
-            if self._lru.get(h) != endpoint.name:
-                break
-            matched += 1
+        with self._lock:
+            for h in hashes:  # leading consecutive blocks held by this endpoint
+                if self._lru.get(h) != endpoint.name:
+                    break
+                matched += 1
         return matched / len(hashes)
 
     def record(self, prompt: str, endpoint: Endpoint) -> None:
-        for h in self._block_hashes(prompt):
-            self._lru.pop(h, None)
-            self._lru[h] = endpoint.name
-        while len(self._lru) > self._capacity:
-            self._lru.popitem(last=False)
+        hashes = self._block_hashes(prompt)
+        with self._lock:
+            for h in hashes:
+                self._lru.pop(h, None)
+                self._lru[h] = endpoint.name
+            while len(self._lru) > self._capacity:
+                self._lru.popitem(last=False)
 
 
 class EndpointPicker:

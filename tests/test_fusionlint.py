@@ -1,0 +1,852 @@
+"""fusionlint — the project static-analysis framework (ISSUE 3).
+
+Every pass gets the fixture triple the framework contract demands:
+snippets that MUST flag, snippets that MUST NOT flag, and snippets whose
+``# noqa:<rule>`` suppression must hold (plus unused-suppression
+detection).  The suite closes with the self-check: the repo itself is
+clean under all six passes, the legacy shims still gate, and
+``make verify-manifests``' checks hold — the acceptance criteria of the
+issue, executable.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.fusionlint import config as fl_config
+from tools.fusionlint.core import (
+    REPO,
+    collect_files,
+    run_passes,
+    to_json,
+    to_sarif,
+)
+from tools.fusionlint.passes import ALL_PASSES, build_passes
+from tools.fusionlint.passes.conditionsvocab import ConditionsVocabularyPass
+from tools.fusionlint.passes.hygiene import HygienePass
+from tools.fusionlint.passes.lockdiscipline import LockDisciplinePass
+from tools.fusionlint.passes.metricsconv import MetricsConventionsPass
+from tools.fusionlint.passes.renderpurity import RenderPurityPass
+from tools.fusionlint.passes.resilience import ResiliencePass
+
+
+def lint(tmp_path, source: str, passes, name: str = "fixture.py"):
+    """Write a fixture module and run the given passes over it."""
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return run_passes(passes, [path])
+
+
+def rules_of(result) -> list[str]:
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------- hygiene
+
+
+class TestHygienePass:
+    def test_flags_the_classic_sins(self, tmp_path):
+        result = lint(tmp_path, """\
+            import os
+            from json import *
+
+            def f(x=[]):
+                try:
+                    return {"a": 1, "a": 2}
+                except:
+                    pass
+        """, [HygienePass()])
+        assert set(rules_of(result)) == {
+            "unused-import", "star-import", "mutable-default",
+            "duplicate-dict-key", "bare-except"}
+
+    def test_clean_module_stays_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            import json
+
+            def f(x=None):
+                try:
+                    return json.dumps({"a": 1, "b": x})
+                except ValueError:
+                    return "{}"
+        """, [HygienePass()])
+        assert result.findings == []
+
+    def test_fstring_without_placeholder_but_not_format_specs(self, tmp_path):
+        result = lint(tmp_path, """\
+            v = 1.0
+            bad = f"no placeholders here"
+            ok = f"{v:.6f}"
+        """, [HygienePass()])
+        assert rules_of(result) == ["f-string-no-placeholder"]
+
+    def test_all_export_counts_as_usage(self, tmp_path):
+        result = lint(tmp_path, """\
+            from json import dumps
+
+            __all__ = ["dumps"]
+        """, [HygienePass()])
+        assert result.findings == []
+
+    def test_legacy_ruff_code_noqa_is_blanket(self, tmp_path):
+        # `# noqa: F401` predates fusionlint rule ids (re-export marker);
+        # a foreign-code-only list keeps the legacy blanket behavior
+        result = lint(tmp_path, """\
+            from json import dumps  # noqa: F401
+        """, [HygienePass()])
+        assert result.findings == []
+        assert result.suppressed == 1
+
+    def test_rule_specific_noqa_respected(self, tmp_path):
+        result = lint(tmp_path, """\
+            try:
+                x = 1
+            except:  # noqa:bare-except — fixture exercises the suppression path
+                pass
+        """, [HygienePass()])
+        assert result.findings == []
+        assert result.suppressed == 1
+
+    def test_wrong_rule_noqa_does_not_suppress(self, tmp_path):
+        result = lint(tmp_path, """\
+            try:
+                x = 1
+            except:  # noqa:missing-timeout
+                pass
+        """, [HygienePass()])
+        # the bare-except survives; the missing-timeout directive is NOT
+        # reported unused because no selected pass owns that rule here
+        assert rules_of(result) == ["bare-except"]
+
+    def test_unused_suppression_is_flagged(self, tmp_path):
+        result = lint(tmp_path, """\
+            x = 1  # noqa:bare-except
+        """, [HygienePass()])
+        assert rules_of(result) == ["unused-suppression"]
+
+    def test_hyphen_justification_stays_rule_specific(self, tmp_path):
+        # '# noqa:rule - why' (ASCII hyphen) must NOT widen into a
+        # blanket suppression: the rule list stops at the first
+        # non-token text, so other rules on the line still fire
+        result = lint(tmp_path, """\
+            from json import dumps
+            try:
+                x = 1
+            except:  # noqa:bare-except - justification with a plain hyphen
+                pass
+        """, [HygienePass()])
+        assert rules_of(result) == ["unused-import"]
+        assert result.suppressed == 1
+
+    def test_noqa_in_docstring_is_prose(self, tmp_path):
+        result = lint(tmp_path, '''\
+            """Docs may say # noqa:bare-except without arming anything."""
+            x = 1
+        ''', [HygienePass()])
+        assert result.findings == []
+
+
+# -------------------------------------------------------------- resilience
+
+
+class TestResiliencePass:
+    def test_missing_timeout_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            import urllib.request
+
+            def fetch(url):
+                return urllib.request.urlopen(url)
+        """, [ResiliencePass()])
+        assert rules_of(result) == ["missing-timeout"]
+
+    def test_explicit_timeout_is_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            import urllib.request
+
+            def fetch(url):
+                return urllib.request.urlopen(url, timeout=5.0)
+        """, [ResiliencePass()])
+        assert result.findings == []
+
+    def test_wall_clock_is_per_package_configurable(self, tmp_path):
+        src = """\
+            import time
+
+            def tick():
+                return time.time()
+        """
+        banned = ResiliencePass(
+            wall_clock_packages={str(tmp_path): ("time", "sleep")})
+        assert rules_of(lint(tmp_path, src, [banned])) == ["wall-clock"]
+        # the same file under a config that does not name this package
+        elsewhere = ResiliencePass(
+            wall_clock_packages={"some/other/pkg": ("time", "sleep")})
+        assert lint(tmp_path, src, [elsewhere]).findings == []
+
+    def test_wall_clock_from_import_alias_flags(self, tmp_path):
+        banned = ResiliencePass(
+            wall_clock_packages={str(tmp_path): ("time", "sleep")})
+        result = lint(tmp_path, """\
+            from time import sleep
+        """, [banned])
+        assert rules_of(result) == ["wall-clock"]
+
+    def test_repo_config_still_covers_autoscale(self):
+        assert any(p.rstrip("/").endswith("autoscale")
+                   for p in fl_config.WALL_CLOCK_PACKAGES)
+
+
+# ---------------------------------------------------------- lock-discipline
+
+
+def _lockpass():
+    return LockDisciplinePass(modules=["*"])
+
+
+class TestLockDisciplinePass:
+    def test_guarded_elsewhere_unguarded_here_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def drop(self, k):
+                    self._items.pop(k, None)
+        """, [_lockpass()])
+        assert rules_of(result) == ["lock-discipline"]
+        assert "_items" in result.findings[0].message
+
+    def test_consistent_locking_is_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def drop(self, k):
+                    with self._lock:
+                        self._items.pop(k, None)
+        """, [_lockpass()])
+        assert result.findings == []
+
+    def test_container_mutation_in_thread_target_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.jobs = []
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.jobs.append(1)
+        """, [_lockpass()])
+        assert rules_of(result) == ["lock-discipline"]
+
+    def test_init_mutations_never_flag(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = {}
+                    self.items["seed"] = 1
+        """, [_lockpass()])
+        assert result.findings == []
+
+    def test_event_and_queue_are_threadsafe(self, tmp_path):
+        result = lint(tmp_path, """\
+            import queue
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stop = threading.Event()
+                    self._q = queue.Queue()
+                    self._flagged = False
+
+                def stop(self):
+                    with self._lock:
+                        self._flagged = True
+                        self._stop.set()
+
+                def running(self):
+                    return not self._stop.is_set()
+        """, [_lockpass()])
+        assert result.findings == []
+
+    def test_locked_suffix_convention_trusted(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k):
+                    with self._lock:
+                        self._put_locked(k)
+
+                def _put_locked(self, k):
+                    self._items[k] = 1
+        """, [_lockpass()])
+        assert result.findings == []
+
+    def test_exposure_propagates_to_helper_classes(self, tmp_path):
+        # the picker pattern: a lock-free helper instantiated and driven
+        # by a lock-owning (thread-shared) class
+        result = lint(tmp_path, """\
+            import threading
+
+            class _Cache:
+                def __init__(self):
+                    self._entries = {}
+
+                def record(self, k, v):
+                    self._entries[k] = v
+
+            class Picker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cache = _Cache()
+                    self._draining = set()
+
+                def pick(self, k):
+                    with self._lock:
+                        self._draining.add(k)
+                    self._cache.record(k, 1)
+        """, [_lockpass()])
+        assert rules_of(result) == ["lock-discipline"]
+        assert "_Cache" in result.findings[0].message
+        assert "Picker" in result.findings[0].message
+
+    def test_noqa_with_justification_suppresses(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.jobs = []
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.jobs.append(1)  # noqa:lock-discipline — single consumer by construction
+        """, [_lockpass()])
+        assert result.findings == []
+        assert result.suppressed == 1
+
+    def test_file_pragma_disables_rule_for_file(self, tmp_path):
+        result = lint(tmp_path, """\
+            # fusionlint: disable=lock-discipline — fixture: loop thread owns all state
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.jobs = []
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.jobs.append(1)
+        """, [_lockpass()])
+        assert result.findings == []
+
+    def test_clock_attr_is_not_a_lock(self, tmp_path):
+        # "_clock" and "block_size" must not read as lock ownership
+        result = lint(tmp_path, """\
+            class Policy:
+                def __init__(self, clock):
+                    self._clock = clock
+                    self.block_size = 4
+                    self._history = []
+
+                def decide(self):
+                    self._history.append(self._clock())
+        """, [_lockpass()])
+        assert result.findings == []
+
+
+# ------------------------------------------------------------ render-purity
+
+
+def _puritypass():
+    return RenderPurityPass(modules=["*"])
+
+
+class TestRenderPurityPass:
+    @pytest.mark.parametrize("stmt,what", [
+        ("import time\n\ndef build():\n    return {'t': time.time()}\n",
+         "time.time"),
+        ("import os\n\ndef build():\n    return {'e': os.environ.get('X')}\n",
+         "os.environ"),
+        ("import os\n\ndef build():\n    return {'e': os.getenv('X')}\n",
+         "os.getenv"),
+        ("import uuid\n\ndef build():\n    return {'u': uuid.uuid4().hex}\n",
+         "uuid"),
+        ("import random\n\ndef build():\n    return {'r': random.random()}\n",
+         "random"),
+        ("def build(p):\n    return {'d': open(p).read()}\n", "open"),
+        ("import urllib.request\n\ndef build(u):\n"
+         "    return urllib.request.urlopen(u, timeout=1)\n", "urlopen"),
+        ("import datetime\n\ndef build():\n"
+         "    return {'t': datetime.datetime.now()}\n", "datetime"),
+    ])
+    def test_impure_constructs_flag(self, tmp_path, stmt, what):
+        result = lint(tmp_path, stmt, [_puritypass()])
+        assert rules_of(result) == ["render-purity"], what
+
+    def test_pure_builder_is_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            def build_lws(name, replicas):
+                return {
+                    "apiVersion": "leaderworkerset.x-k8s.io/v1",
+                    "kind": "LeaderWorkerSet",
+                    "metadata": {"name": name},
+                    "spec": {"replicas": replicas},
+                }
+        """, [_puritypass()])
+        assert result.findings == []
+
+    def test_module_level_env_read_is_exempt(self, tmp_path):
+        # import time runs once; the constant is stable per process
+        result = lint(tmp_path, """\
+            import os
+
+            DEFAULT_IMAGE = os.environ.get("IMG", "img:latest")
+
+            def build():
+                return {"image": DEFAULT_IMAGE}
+        """, [_puritypass()])
+        assert result.findings == []
+
+    def test_out_of_scope_module_is_exempt(self, tmp_path):
+        scoped = RenderPurityPass(modules=["some/other/module.py"])
+        result = lint(tmp_path, """\
+            import time
+
+            def build():
+                return {"t": time.time()}
+        """, [scoped])
+        assert result.findings == []
+
+    def test_noqa_respected(self, tmp_path):
+        result = lint(tmp_path, """\
+            import os
+
+            def build():
+                return {"e": os.environ.get("X")}  # noqa:render-purity — deploy-time knob
+        """, [_puritypass()])
+        assert result.findings == []
+        assert result.suppressed == 1
+
+
+# ------------------------------------------------------ metrics-conventions
+
+
+def _metricspass(globs=("*",)):
+    return MetricsConventionsPass(modules=list(globs))
+
+
+class TestMetricsConventionsPass:
+    def test_counter_without_total_suffix_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            LINES = [
+                "# HELP app_requests Requests seen.",
+                "# TYPE app_requests counter",
+            ]
+
+            def render(n):
+                return [f"app_requests{{x=\\"1\\"}} {n}"]
+        """, [_metricspass()])
+        assert rules_of(result) == ["metrics-conventions"]
+        assert "_total" in result.findings[0].message
+
+    def test_missing_help_and_type_flag(self, tmp_path):
+        result = lint(tmp_path, """\
+            def render(n):
+                return [f"app_requests_total{{x=\\"1\\"}} {n}"]
+        """, [_metricspass()])
+        assert sorted(rules_of(result)) == [
+            "metrics-conventions", "metrics-conventions"]
+        messages = " ".join(f.message for f in result.findings)
+        assert "# HELP" in messages and "# TYPE" in messages
+
+    def test_well_formed_family_is_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            LINES = [
+                "# HELP app_requests_total Requests seen.",
+                "# TYPE app_requests_total counter",
+            ]
+
+            def render(n):
+                return [f"app_requests_total{{x=\\"1\\"}} {n}"]
+        """, [_metricspass()])
+        assert result.findings == []
+
+    def test_histogram_series_fold_into_base_family(self, tmp_path):
+        result = lint(tmp_path, """\
+            LINES = [
+                "# HELP app_latency_seconds Latency.",
+                "# TYPE app_latency_seconds histogram",
+            ]
+
+            def render(hist, labels):
+                return hist.render("app_latency_seconds", labels)
+        """, [_metricspass()])
+        assert result.findings == []
+
+    def test_total_family_must_be_counter(self, tmp_path):
+        result = lint(tmp_path, """\
+            LINES = [
+                "# HELP app_x_total X.",
+                "# TYPE app_x_total gauge",
+            ]
+        """, [_metricspass()])
+        assert rules_of(result) == ["metrics-conventions"]
+
+    def test_histogram_needs_unit_suffix(self, tmp_path):
+        result = lint(tmp_path, """\
+            LINES = [
+                "# HELP app_latency Latency.",
+                "# TYPE app_latency histogram",
+            ]
+        """, [_metricspass()])
+        assert rules_of(result) == ["metrics-conventions"]
+        assert "unit suffix" in result.findings[0].message
+
+    def test_duplicate_family_across_modules_flags(self, tmp_path):
+        src = """\
+            LINES = [
+                "# HELP app_x_total X.",
+                "# TYPE app_x_total counter",
+            ]
+        """
+        a = tmp_path / "mod_a.py"
+        b = tmp_path / "mod_b.py"
+        a.write_text(textwrap.dedent(src))
+        b.write_text(textwrap.dedent(src))
+        result = run_passes([_metricspass()], [a, b])
+        assert rules_of(result) == ["metrics-conventions"]
+        assert "already declared" in result.findings[0].message
+
+
+# ---------------------------------------------------- conditions-vocabulary
+
+
+@pytest.fixture
+def vocab_file(tmp_path):
+    path = tmp_path / "conditions.py"
+    path.write_text(textwrap.dedent("""\
+        COND_READY = "Ready"
+        COND_DEGRADED = "Degraded"
+        REASON_ALL_GOOD = "AllGood"
+        REASON_BROKEN = "Broken"
+    """))
+    return path
+
+
+def _vocabpass(vocab_file):
+    return ConditionsVocabularyPass(
+        conditions_path=str(vocab_file), scope=["*"])
+
+
+class TestConditionsVocabularyPass:
+    def test_undeclared_literal_flags(self, tmp_path, vocab_file):
+        result = lint(tmp_path, """\
+            from conditions import set_condition
+
+            def mark(status):
+                set_condition(status, "Raedy", True, "AllGood", "msg", 1)
+        """, [_vocabpass(vocab_file)], name="user.py")
+        assert rules_of(result) == ["conditions-vocabulary"]
+        assert "Raedy" in result.findings[0].message
+
+    def test_declared_literal_and_constant_are_clean(self, tmp_path, vocab_file):
+        result = lint(tmp_path, """\
+            import conditions as cond
+
+            def mark(status):
+                cond.set_condition(status, cond.COND_READY, True,
+                                   "AllGood", "msg", 1)
+        """, [_vocabpass(vocab_file)], name="user.py")
+        assert result.findings == []
+
+    def test_stale_constant_reference_flags(self, tmp_path, vocab_file):
+        result = lint(tmp_path, """\
+            import conditions as cond
+
+            def mark(status):
+                cond.set_condition(status, cond.COND_RENAMED_AWAY, True,
+                                   cond.REASON_ALL_GOOD, "msg", 1)
+        """, [_vocabpass(vocab_file)], name="user.py")
+        assert rules_of(result) == ["conditions-vocabulary"]
+        assert "COND_RENAMED_AWAY" in result.findings[0].message
+
+    def test_local_variable_resolved_through_ifexp(self, tmp_path, vocab_file):
+        result = lint(tmp_path, """\
+            import conditions as cond
+
+            def mark(status, bad):
+                reason = (cond.REASON_BROKEN if bad
+                          else cond.REASON_ALL_GOOD)
+                cond.set_condition(status, cond.COND_READY, True,
+                                   reason, "msg", 1)
+        """, [_vocabpass(vocab_file)], name="user.py")
+        assert result.findings == []
+
+    def test_unresolvable_variable_flags(self, tmp_path, vocab_file):
+        result = lint(tmp_path, """\
+            import conditions as cond
+
+            def mark(status, reason):
+                cond.set_condition(status, cond.COND_READY, True,
+                                   reason, "msg", 1)
+        """, [_vocabpass(vocab_file)], name="user.py")
+        assert rules_of(result) == ["conditions-vocabulary"]
+
+    def test_declaring_module_itself_is_exempt(self, vocab_file, tmp_path):
+        # helpers inside conditions.py pass parameters through by design
+        pass_ = ConditionsVocabularyPass(
+            conditions_path=str(vocab_file), scope=["*"])
+        src = vocab_file.read_text() + textwrap.dedent("""\
+
+            def set_condition(status, cond_type, ok, reason, msg, gen):
+                status[cond_type] = (ok, reason, msg, gen)
+
+            def helper(status, reason):
+                set_condition(status, COND_READY, True, reason, "m", 1)
+        """)
+        vocab_file.write_text(src)
+        result = run_passes([pass_], [vocab_file])
+        assert result.findings == []
+
+    def test_repo_vocabulary_loads(self):
+        p = ConditionsVocabularyPass()
+        names, values = p.vocab["type"]
+        assert "COND_ACTIVE" in names and "ScalingActive" in values
+        names, values = p.vocab["reason"]
+        assert "REASON_TOO_MANY_REPLICAS" in names
+
+
+# ------------------------------------------------------------- framework
+
+
+class TestFramework:
+    def test_syntax_error_is_reported_not_raised(self, tmp_path):
+        result = lint(tmp_path, "def broken(:\n", [HygienePass()])
+        assert rules_of(result) == ["syntax-error"]
+
+    def test_json_report_shape(self, tmp_path):
+        result = lint(tmp_path, "try:\n    x = 1\nexcept:\n    pass\n",
+                      [HygienePass()])
+        doc = json.loads(to_json(result))
+        assert doc["tool"] == "fusionlint" and doc["files"] == 1
+        (finding,) = doc["findings"]
+        assert finding["rule"] == "bare-except"
+        assert finding["line"] == 3
+
+    def test_sarif_report_shape(self, tmp_path):
+        result = lint(tmp_path, "try:\n    x = 1\nexcept:\n    pass\n",
+                      [HygienePass()])
+        doc = json.loads(to_sarif(result))
+        assert doc["version"] == "2.1.0"
+        (run,) = doc["runs"]
+        (res,) = run["results"]
+        assert res["ruleId"] == "bare-except"
+
+    def test_pass_selection_rejects_unknown(self):
+        with pytest.raises(ValueError, match="unknown pass"):
+            build_passes(["no-such-pass"])
+
+    def test_every_pass_has_unique_rules(self):
+        owners: dict[str, str] = {}
+        for cls in ALL_PASSES:
+            inst = cls()
+            for rule in inst.rules:
+                assert rule not in owners, (
+                    f"rule {rule} owned by both {owners[rule]} and "
+                    f"{inst.name}")
+                owners[rule] = inst.name
+
+    def test_findings_are_stably_sorted(self, tmp_path):
+        result = lint(tmp_path, """\
+            from json import dumps
+            from os import path
+        """, [HygienePass()])
+        assert [f.line for f in result.findings] == sorted(
+            f.line for f in result.findings)
+
+
+# ------------------------------------------------------- repo-level gates
+
+
+@pytest.fixture(scope="module")
+def repo_result():
+    files = collect_files(fl_config.DEFAULT_TARGETS)
+    return run_passes(build_passes(), files)
+
+
+class TestRepoIsClean:
+    def test_repo_clean_under_all_passes(self, repo_result):
+        assert repo_result.findings == [], "\n".join(
+            f.render() for f in repo_result.findings)
+
+    def test_all_six_passes_ran(self, repo_result):
+        assert repo_result.passes == [
+            "hygiene", "resilience", "lock-discipline", "render-purity",
+            "metrics-conventions", "conditions-vocabulary"]
+
+    def test_repo_coverage_is_real(self, repo_result):
+        # the walk must actually see the codebase (a broken DEFAULT_TARGETS
+        # would make the clean gate vacuous)
+        assert repo_result.files > 100
+
+
+class TestLegacyShims:
+    @pytest.mark.parametrize("shim", ["tools/lint.py",
+                                      "tools/lint_resilience.py"])
+    def test_shim_exits_zero_on_clean_repo(self, shim):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / shim)],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_shim_exits_one_on_findings(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("try:\n    x = 1\nexcept:\n    pass\n")
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools/lint.py"), str(bad)],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 1
+        assert "bare-except" in proc.stdout
+
+    def test_resilience_shim_keeps_historical_coverage_only(self, tmp_path):
+        # the legacy tool never emitted hygiene rules beyond bare-except;
+        # an unused import must stay exit-0 under the shim
+        f = tmp_path / "legacy.py"
+        f.write_text("import os\n")
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools/lint_resilience.py"), str(f)],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # while its own historical rules still gate
+        f.write_text("try:\n    x = 1\nexcept:\n    pass\n")
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools/lint_resilience.py"), str(f)],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 1
+        assert "bare-except" in proc.stdout
+
+    def test_changed_mode_survives_out_of_repo_paths(self, tmp_path):
+        f = tmp_path / "outside.py"
+        f.write_text("x = 1\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.fusionlint", "--changed", str(f)],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "Traceback" not in proc.stderr
+
+    def test_module_entry_point_seeded_violation(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\n\n\ndef f():\n    return time.time()\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.fusionlint", str(bad),
+             "--format", "json"],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        # hygiene is clean on it; the point is exit-0/1 and JSON shape
+        doc = json.loads(proc.stdout)
+        assert doc["files"] == 1
+        assert proc.returncode == 0
+
+    def test_json_out_archives_report(self, tmp_path):
+        out = tmp_path / "lint.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.fusionlint",
+             str(REPO / "tools" / "verify_manifests.py"),
+             "--json-out", str(out)],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(out.read_text())["tool"] == "fusionlint"
+
+
+class TestVerifyManifests:
+    def test_repo_config_has_no_drift(self):
+        from tools.verify_manifests import check_drift
+        assert check_drift(REPO / "config") == []
+
+    def test_repo_samples_validate(self):
+        from tools.verify_manifests import check_samples
+        assert check_samples(REPO / "config" / "samples") == []
+
+    def test_drift_is_detected(self, tmp_path):
+        import shutil
+
+        from tools.verify_manifests import check_drift
+        cfg = tmp_path / "config"
+        shutil.copytree(REPO / "config", cfg)
+        crd = next(iter(sorted((cfg / "crd" / "bases").glob("*.yaml"))))
+        crd.write_text(crd.read_text() + "# drift\n")
+        problems = check_drift(cfg)
+        assert any("drifted" in p for p in problems)
+
+    def test_missing_and_stale_files_are_detected(self, tmp_path):
+        import shutil
+
+        from tools.verify_manifests import check_drift
+        cfg = tmp_path / "config"
+        shutil.copytree(REPO / "config", cfg)
+        next(iter(sorted((cfg / "rbac").glob("*.yaml")))).unlink()
+        (cfg / "rbac" / "zz_stale.yaml").write_text("kind: Stale\n")
+        problems = check_drift(cfg)
+        assert any("missing" in p for p in problems)
+        assert any("stale" in p for p in problems)
+
+    def test_invalid_sample_is_detected(self, tmp_path):
+        from tools.verify_manifests import check_samples
+        samples = tmp_path / "samples"
+        samples.mkdir()
+        (samples / "bad.yaml").write_text(textwrap.dedent("""\
+            apiVersion: fusioninfer.io/v1alpha1
+            kind: InferenceService
+            metadata:
+              name: bad
+            spec:
+              roles:
+                - name: worker
+                  replicas: "not-an-int"
+        """))
+        problems = check_samples(samples)
+        assert problems and any("replicas" in p for p in problems)
+
+
+class TestChangedMode:
+    def test_changed_files_returns_repo_relative_paths(self):
+        from tools.fusionlint.core import changed_files
+        changed = changed_files()
+        assert changed is None or isinstance(changed, set)

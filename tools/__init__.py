@@ -1,0 +1,2 @@
+# Makes in-repo developer tooling importable as ``tools.*``
+# (``python -m tools.fusionlint``); nothing here ships in the images.

@@ -1,0 +1,45 @@
+"""fusionlint — the project's plugin-based static-analysis framework.
+
+The reference operator leans on Go's toolchain for the invariants its
+correctness rides on: ``go vet`` + golangci-lint for hygiene, ``-race``
+for lock discipline, and a Makefile drift gate for generated manifests.
+This package is the Python port's equivalent, grown from the two ad-hoc
+linters of PR 1-2 (``tools/lint.py``, ``tools/lint_resilience.py``)
+into one framework with project-specific passes:
+
+========================  =============================================
+pass                      rules
+========================  =============================================
+hygiene                   unused-import, bare-except, mutable-default,
+                          duplicate-dict-key, f-string-no-placeholder,
+                          star-import
+resilience                missing-timeout, wall-clock (per-package,
+                          configured in ``tools/fusionlint/config.py``)
+lock-discipline           heuristic race detection: ``self._*`` state
+                          guarded somewhere but touched lock-free in
+                          thread-reachable code; unguarded mutable
+                          containers mutated from threads
+render-purity             manifest-producing modules must be
+                          deterministic (no wall clock, randomness,
+                          env, I/O) — reconciler idempotency depends
+                          on byte-stable re-render
+metrics-conventions       Prometheus exposition rules: ``_total``
+                          counters, HELP/TYPE per family, no duplicate
+                          families across modules
+conditions-vocabulary     status-condition type/reason strings must be
+                          the constants ``operator/conditions.py``
+                          declares
+========================  =============================================
+
+Run ``python -m tools.fusionlint --help``.  Design notes:
+``docs/design/static-analysis.md``.
+"""
+
+from tools.fusionlint.core import (
+    Finding,
+    LintPass,
+    Module,
+    run_passes,
+)
+
+__all__ = ["Finding", "LintPass", "Module", "run_passes"]

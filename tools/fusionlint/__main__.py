@@ -1,0 +1,7 @@
+"""``python -m tools.fusionlint`` entry point."""
+
+import sys
+
+from tools.fusionlint.cli import main
+
+sys.exit(main())

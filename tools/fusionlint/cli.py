@@ -1,0 +1,115 @@
+"""fusionlint command line.
+
+Usage::
+
+    python -m tools.fusionlint [paths...] [options]
+
+Options:
+  --select PASS[,PASS]   run only the named passes (default: all six)
+  --format {text,json,sarif}
+  --output FILE          write the report to FILE instead of stdout
+  --json-out FILE        additionally write the JSON report to FILE
+                         (``make lint`` archives it under dist/)
+  --changed              lint only files differing from HEAD (staged,
+                         unstaged, or untracked) — fast pre-commit mode
+  --list-passes          print the pass catalog and exit
+
+Exit code 1 when any finding is emitted (including unused
+suppressions), 0 when clean.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from tools.fusionlint import config
+from tools.fusionlint.core import (
+    REPO,
+    changed_files,
+    collect_files,
+    print_text_report,
+    render,
+    run_passes,
+    summary_line,
+    to_json,
+)
+from tools.fusionlint.passes import ALL_PASSES, build_passes
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fusionlint",
+        description="project static-analysis framework "
+                    "(docs/design/static-analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/dirs to lint (default: "
+                        f"{' '.join(config.DEFAULT_TARGETS)})")
+    p.add_argument("--select", default="",
+                   help="comma-separated pass names to run")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule ids to emit (others are "
+                        "computed but dropped; the legacy shims pin "
+                        "their historical coverage with this)")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
+    p.add_argument("--output", default="",
+                   help="write the report here instead of stdout")
+    p.add_argument("--json-out", default="",
+                   help="additionally write the JSON report here")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files differing from HEAD")
+    p.add_argument("--list-passes", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_passes:
+        for cls in ALL_PASSES:
+            inst = cls()
+            print(f"{inst.name}: {', '.join(inst.rules)}")
+        return 0
+    try:
+        passes = build_passes(
+            [s.strip() for s in args.select.split(",") if s.strip()] or None)
+    except ValueError as e:
+        print(f"fusionlint: {e}", file=sys.stderr)
+        return 2
+    files = collect_files(args.paths or config.DEFAULT_TARGETS)
+    if args.changed:
+        changed = changed_files()
+        if changed is None:
+            print("fusionlint: git unavailable; linting the full set",
+                  file=sys.stderr)
+        else:
+            files = [
+                f for f in files
+                if f.is_relative_to(REPO)
+                and str(f.relative_to(REPO)).replace("\\", "/") in changed
+            ]
+    only_rules = {r.strip().lower()
+                  for r in args.rules.split(",") if r.strip()} or None
+    result = run_passes(passes, files, only_rules=only_rules)
+    report = render(result, args.format)
+    if args.output:
+        out = pathlib.Path(args.output)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(report)
+        print(summary_line(result),
+              file=sys.stderr if result.findings else sys.stdout)
+    elif args.format == "text":
+        print_text_report(result)
+    else:
+        sys.stdout.write(report)
+        print(summary_line(result), file=sys.stderr)
+    if args.json_out:
+        out = pathlib.Path(args.json_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(to_json(result))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,83 @@
+"""Project configuration for fusionlint passes.
+
+One place for every path-scoped knob, so adding a package to a
+discipline is a one-line diff here instead of a constant edit inside a
+pass (the wall-clock rule was hard-coded to ``autoscale/`` through PR 2;
+it is now the ``WALL_CLOCK_PACKAGES`` table below).  All paths are
+repo-relative with forward slashes; ``*_MODULES`` entries are fnmatch
+globs matched against ``Module.rel``.
+"""
+
+from __future__ import annotations
+
+# what `python -m tools.fusionlint` lints when no paths are given
+DEFAULT_TARGETS = [
+    "fusioninfer_tpu", "tests", "tools", "bench.py", "__graft_entry__.py",
+]
+
+# -- resilience pass ---------------------------------------------------
+
+# package prefix -> names banned as direct `time.X()` calls (and as
+# `from time import X` aliases) inside it.  Control loops listed here
+# must take an injected clock so chaos/e2e suites drive them
+# deterministically; `time.monotonic` as a default ARGUMENT is fine,
+# calling it inline is not.  Pacing belongs to `Event.wait`.
+WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
+    "fusioninfer_tpu/autoscale": ("time", "sleep"),
+}
+
+# -- lock-discipline pass ----------------------------------------------
+
+# packages whose classes are analyzed (tests/tools spin up throwaway
+# threads constantly and would drown the signal)
+LOCK_DISCIPLINE_MODULES = [
+    "fusioninfer_tpu/*.py",
+    "fusioninfer_tpu/*/*.py",
+]
+
+# -- render-purity pass ------------------------------------------------
+
+# manifest-producing modules: the reconciler's idempotency contract is
+# that re-rendering the same spec yields byte-identical children, so
+# nothing here may consult wall clocks, randomness, the environment, or
+# do I/O inside a function body (module level runs once at import and is
+# therefore stable for the life of the process).
+# workload/bootstrap.py is deliberately absent: it is pod RUNTIME code
+# (jax distributed init from the downward API), not a manifest producer.
+# operator/manifests.py is the I/O shell that WRITES the rendered tree;
+# its builders stay pure and the write helpers are its whole point.
+RENDER_PURE_MODULES = [
+    "fusioninfer_tpu/operator/render.py",
+    "fusioninfer_tpu/workload/lws.py",
+    "fusioninfer_tpu/workload/labels.py",
+    "fusioninfer_tpu/scheduling/podgroup.py",
+    "fusioninfer_tpu/router/epp.py",
+    "fusioninfer_tpu/router/epp_schema.py",
+    "fusioninfer_tpu/router/httproute.py",
+    "fusioninfer_tpu/router/inferencepool.py",
+    "fusioninfer_tpu/router/strategy.py",
+    "fusioninfer_tpu/api/crd.py",
+    "fusioninfer_tpu/api/modelloader.py",
+]
+
+# -- metrics-conventions pass ------------------------------------------
+
+# modules that render Prometheus exposition text
+METRICS_MODULES = [
+    "fusioninfer_tpu/engine/metrics.py",
+    "fusioninfer_tpu/autoscale/metrics.py",
+    "fusioninfer_tpu/operator/manager.py",
+]
+
+# -- conditions-vocabulary pass ----------------------------------------
+
+# the module that DECLARES the condition type/reason vocabulary
+CONDITIONS_MODULE = "fusioninfer_tpu/operator/conditions.py"
+# modules whose condition-setter call sites are checked
+CONDITIONS_SCOPE = ["fusioninfer_tpu/*.py", "fusioninfer_tpu/*/*.py"]
+# callee name -> positional index of (cond_type, reason); None = not
+# passed positionally at that site (kwarg-only)
+CONDITION_SETTERS: dict[str, tuple[int | None, int | None]] = {
+    "set_condition": (1, 3),
+    "set_scaling_limited": (None, 3),
+}

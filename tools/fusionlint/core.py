@@ -1,0 +1,339 @@
+"""fusionlint core: file walking, parsing, suppression, output, runner.
+
+The framework owns everything rule-agnostic so a pass is just AST logic:
+
+* **Module records** — each file is read and parsed once; every pass
+  shares the same ``ast.Module`` (passes must not mutate it).
+* **Suppression** — ``# noqa`` on a line suppresses every rule there
+  (the legacy convention from ``tools/lint.py``); ``# noqa:rule-a`` or
+  ``# noqa:rule-a,rule-b`` suppresses only the named rules.  A
+  rule-specific directive that suppressed nothing is itself flagged as
+  ``unused-suppression`` — dead suppressions hide future regressions
+  (checked only for rules a selected pass owns, so running a pass subset
+  through the legacy shims never misfires).
+* **Output** — text (one ``path:line: [rule] message`` per finding),
+  ``--format json``, and ``--format sarif`` (SARIF 2.1.0, the format CI
+  annotation uploaders eat).  ``--json-out`` tees the JSON report to a
+  file regardless of the primary format (``make lint`` archives it).
+* **--changed** — lint only files differing from ``HEAD`` (staged,
+  unstaged, or untracked), for fast pre-commit runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import io
+import json
+import pathlib
+import re
+import subprocess
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+# a blanket "# noqa" suppresses all rules on the line; "# noqa:a,b" only
+# rules a and b.  The rule list is a strict comma-separated token
+# grammar that ends at the first non-token text, so a justification may
+# follow after ANY separator ("— why", "- why", "because …") without
+# the prose being folded into the rule list (folding would silently
+# widen a rule-specific directive into a blanket one).
+# Only real COMMENT tokens count — "# noqa" inside a docstring is prose.
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?::\s*([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*))?")
+# fusionlint rule ids are lowercase-kebab; ruff/flake8 codes (F401, E722)
+# are foreign.  A noqa listing only foreign codes keeps the legacy
+# "any # noqa suppresses everything" behavior so existing `# noqa: F401`
+# re-export markers keep working.
+_FUSION_RULE_RE = re.compile(r"^[a-z][a-z0-9]*(-[a-z0-9]+)*$")
+# file-level escape hatch: `# fusionlint: disable=rule-a,rule-b` on a
+# comment line disables those rules for the whole file.  Reserved for
+# files whose concurrency/purity model is sound but outside what the
+# heuristics can see (say why in the same comment).
+_PRAGMA_RE = re.compile(r"#\s*fusionlint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+def callee_name(expr: ast.expr) -> Optional[str]:
+    """Terminal symbol of a Name/Attribute reference: ``self.x.m`` →
+    ``m``, ``urlopen`` → ``urlopen``; None for anything else.  Shared by
+    every pass that keys behavior on a callee or reference name."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """One parsed source file, shared by every pass."""
+
+    def __init__(self, path: pathlib.Path):
+        self.path = path
+        rel = path.relative_to(REPO) if path.is_relative_to(REPO) else path
+        self.rel = str(rel).replace("\\", "/")
+        self.src = path.read_text()
+        self.lines = self.src.splitlines()
+        self.syntax_error: Optional[Finding] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(
+                self.src, filename=str(path))
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = Finding(
+                "syntax-error", self.rel, e.lineno or 1, str(e.msg))
+        # line -> None (blanket noqa) | frozenset of rule names
+        self.noqa: dict[int, Optional[frozenset[str]]] = {}
+        self.disabled_rules: set[str] = set()
+        for line_no, comment in self._comments():
+            m = _PRAGMA_RE.search(comment)
+            if m:
+                self.disabled_rules.update(
+                    r.strip().lower()
+                    for r in m.group(1).split(",") if r.strip())
+                continue
+            m = _NOQA_RE.search(comment)
+            if not m:
+                continue
+            if m.group(1) is None:
+                self.noqa[line_no] = None
+                continue
+            tokens = [t.strip() for t in m.group(1).split(",") if t.strip()]
+            ours = frozenset(
+                t.lower() for t in tokens if _FUSION_RULE_RE.match(t.lower())
+                and not re.fullmatch(r"[a-z]\d+", t.lower()))
+            # only foreign codes (ruff/flake8) listed: legacy blanket
+            self.noqa[line_no] = ours or None
+
+    def _comments(self):
+        """(line, text) for every real comment token; falls back to a
+        raw line scan when the file does not tokenize."""
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.src).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            for i, line in enumerate(self.lines):
+                if "#" in line:
+                    yield i + 1, line[line.index("#"):]
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.disabled_rules:
+            return True
+        if line not in self.noqa:
+            return False
+        rules = self.noqa[line]
+        return rules is None or rule in rules
+
+    def matches(self, patterns: Sequence[str]) -> bool:
+        return any(fnmatch.fnmatch(self.rel, p) for p in patterns)
+
+
+class LintPass:
+    """Base class for a fusionlint pass.
+
+    Subclasses set ``name`` (the pass id used by ``--select``) and
+    ``rules`` (every rule id the pass can emit — the suppression layer
+    uses it for unused-``noqa`` detection) and override
+    :meth:`check_module` (per-file) and/or :meth:`finalize`
+    (cross-file, runs after every module was checked).
+    """
+
+    name: str = ""
+    rules: tuple[str, ...] = ()
+
+    def check_module(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, modules: list[Module]) -> Iterable[Finding]:
+        return ()
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding]
+    files: int
+    passes: list[str]
+    suppressed: int = 0
+    raw: list[Finding] = field(default_factory=list)
+
+
+def collect_files(targets: Sequence[str]) -> list[pathlib.Path]:
+    files: list[pathlib.Path] = []
+    for t in targets:
+        p = pathlib.Path(t)
+        if not p.is_absolute():
+            p = REPO / t
+        if p.is_dir():
+            files.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts)
+        elif p.suffix == ".py" and p.exists():
+            files.append(p)
+    return files
+
+
+def changed_files() -> Optional[set[str]]:
+    """Repo-relative paths of files differing from HEAD (tracked changes
+    plus untracked); None when git is unavailable (callers fall back to
+    the full set)."""
+    try:
+        diff = subprocess.run(
+            ["git", "-C", str(REPO), "diff", "--name-only", "HEAD", "--"],
+            capture_output=True, text=True, timeout=30, check=True)
+        untracked = subprocess.run(
+            ["git", "-C", str(REPO), "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30, check=True)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out = set()
+    for blob in (diff.stdout, untracked.stdout):
+        out.update(line.strip() for line in blob.splitlines() if line.strip())
+    return out
+
+
+def run_passes(passes: Sequence[LintPass],
+               files: Sequence[pathlib.Path],
+               only_rules: Optional[set[str]] = None) -> RunResult:
+    """Parse every file once, run every pass, apply suppression, and
+    flag unused rule-specific suppressions.  ``only_rules`` restricts
+    the emitted rule set (the legacy shims pin their historical
+    coverage with it); unused-suppression detection narrows with it so
+    a directive for an unemitted rule is never called dead."""
+    modules = [Module(f) for f in files]
+    raw: list[Finding] = []
+    for mod in modules:
+        if mod.syntax_error is not None:
+            raw.append(mod.syntax_error)
+    for p in passes:
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            raw.extend(p.check_module(mod))
+        raw.extend(p.finalize([m for m in modules if m.tree is not None]))
+
+    universe = {rule for p in passes for rule in p.rules}
+    if only_rules is not None:
+        universe &= only_rules
+        raw = [f for f in raw
+               if f.rule in only_rules or f.rule == "syntax-error"]
+    by_rel = {m.rel: m for m in modules}
+    kept: list[Finding] = []
+    used: set[tuple[str, int, str]] = set()
+    suppressed = 0
+    for f in raw:
+        mod = by_rel.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            suppressed += 1
+            used.add((f.path, f.line, f.rule))
+        else:
+            kept.append(f)
+    # unused rule-specific suppressions (blanket "# noqa" is exempt: the
+    # legacy convention predates rule ids and tests use it generically)
+    for mod in modules:
+        for line, rules in sorted(mod.noqa.items()):
+            if rules is None:
+                continue
+            for rule in sorted(rules):
+                if rule in universe and (mod.rel, line, rule) not in used:
+                    kept.append(Finding(
+                        "unused-suppression", mod.rel, line,
+                        f"'# noqa:{rule}' suppresses nothing on this line "
+                        "— remove it (dead suppressions hide future "
+                        "regressions)"))
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return RunResult(
+        findings=kept, files=len(modules),
+        passes=[p.name for p in passes], suppressed=suppressed, raw=raw)
+
+
+# -- reports --
+
+
+def to_json(result: RunResult) -> str:
+    return json.dumps(
+        {
+            "version": 1,
+            "tool": "fusionlint",
+            "passes": result.passes,
+            "files": result.files,
+            "suppressed": result.suppressed,
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in result.findings
+            ],
+        },
+        indent=2,
+    ) + "\n"
+
+
+def to_sarif(result: RunResult) -> str:
+    rules = sorted({f.rule for f in result.findings})
+    return json.dumps(
+        {
+            "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                        "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "fusionlint",
+                    "rules": [{"id": r} for r in rules],
+                }},
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [{
+                            "physicalLocation": {
+                                "artifactLocation": {"uri": f.path},
+                                "region": {"startLine": f.line},
+                            },
+                        }],
+                    }
+                    for f in result.findings
+                ],
+            }],
+        },
+        indent=2,
+    ) + "\n"
+
+
+def render(result: RunResult, fmt: str) -> str:
+    if fmt == "json":
+        return to_json(result)
+    if fmt == "sarif":
+        return to_sarif(result)
+    return "".join(f.render() + "\n" for f in result.findings)
+
+
+def summary_line(result: RunResult) -> str:
+    n = len(result.findings)
+    status = "clean" if n == 0 else f"{n} finding(s)"
+    return (f"fusionlint: {status} across {result.files} files "
+            f"(passes: {', '.join(result.passes)}; "
+            f"{result.suppressed} suppressed)")
+
+
+def print_text_report(result: RunResult, stream=None) -> None:
+    stream = stream or sys.stdout
+    for f in result.findings:
+        print(f.render(), file=stream)
+    print(summary_line(result),
+          file=sys.stderr if result.findings else stream)

@@ -1,0 +1,40 @@
+"""Pass registry: every fusionlint pass, in gate order.
+
+Adding a pass: subclass :class:`tools.fusionlint.core.LintPass` in a new
+module here, set ``name``/``rules``, and append it to ``ALL_PASSES``.
+The runner, suppression layer, output formats, ``--select``, and the
+``--changed`` mode come for free.  Give it fixture coverage in
+``tests/test_fusionlint.py`` (flag / no-flag / noqa triplets) and a row
+in ``docs/design/static-analysis.md``.
+"""
+
+from __future__ import annotations
+
+from tools.fusionlint.passes.conditionsvocab import ConditionsVocabularyPass
+from tools.fusionlint.passes.hygiene import HygienePass
+from tools.fusionlint.passes.lockdiscipline import LockDisciplinePass
+from tools.fusionlint.passes.metricsconv import MetricsConventionsPass
+from tools.fusionlint.passes.renderpurity import RenderPurityPass
+from tools.fusionlint.passes.resilience import ResiliencePass
+
+ALL_PASSES = [
+    HygienePass,
+    ResiliencePass,
+    LockDisciplinePass,
+    RenderPurityPass,
+    MetricsConventionsPass,
+    ConditionsVocabularyPass,
+]
+
+
+def build_passes(select: list[str] | None = None):
+    """Instantiate passes; ``select`` filters by pass name."""
+    passes = [cls() for cls in ALL_PASSES]
+    if select:
+        unknown = set(select) - {p.name for p in passes}
+        if unknown:
+            raise ValueError(
+                f"unknown pass(es): {', '.join(sorted(unknown))} "
+                f"(have: {', '.join(p.name for p in passes)})")
+        passes = [p for p in passes if p.name in select]
+    return passes

@@ -1,0 +1,181 @@
+"""Conditions-vocabulary pass — status conditions speak one dialect.
+
+``operator/conditions.py`` declares the condition type and reason
+vocabulary (kept name-for-name with the reference controller and the
+HPA condition set, so dashboards built for either read this operator
+unchanged).  A call site that invents its own string — ``"Degarded"``,
+``"TooManyReplica"`` — ships a typo straight into every ``kubectl
+wait --for=condition=…`` and alerting rule downstream, and nothing in
+the type system pushes back because conditions are stringly-typed
+dicts.
+
+This pass reads the vocabulary straight out of the AST of the declaring
+module (module-level ``COND_*``/``REASON_*`` string constants) and then
+checks every ``set_condition``-family call site in scope:
+
+* a literal string argument must be one of the declared **values**;
+* a ``COND_*``/``REASON_*`` symbol must be one of the declared
+  **names** (catches stale references after a rename);
+* a local variable is resolved through simple assignment/conditional
+  flow inside the enclosing function — every value it can hold must be
+  declared; anything the resolver cannot prove is flagged (hoist the
+  choice into an ``IfExp`` over declared constants, as
+  ``autoscale/controller.py`` does).
+
+The declaring module itself is exempt (its helpers pass parameters
+through by design).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.fusionlint import config
+from tools.fusionlint.core import REPO, Finding, LintPass, Module, callee_name
+
+_PREFIXES = {"type": "COND_", "reason": "REASON_"}
+
+
+def _load_vocabulary(path: pathlib.Path) -> dict[str, tuple[set, set]]:
+    """{"type"|"reason": (declared constant names, declared values)}."""
+    vocab = {"type": (set(), set()), "reason": (set(), set())}
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return vocab
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        for tgt in node.targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            for kind, prefix in _PREFIXES.items():
+                if tgt.id.startswith(prefix):
+                    names, values = vocab[kind]
+                    names.add(tgt.id)
+                    values.add(node.value.value)
+    return vocab
+
+
+class ConditionsVocabularyPass(LintPass):
+    name = "conditions-vocabulary"
+    rules = ("conditions-vocabulary",)
+
+    def __init__(self, conditions_path: str | None = None,
+                 scope: list[str] | None = None,
+                 setters: dict[str, tuple[int | None, int | None]] | None = None):
+        self.conditions_rel = (config.CONDITIONS_MODULE
+                               if conditions_path is None else conditions_path)
+        path = pathlib.Path(self.conditions_rel)
+        if not path.is_absolute():
+            path = REPO / path
+        self.vocab = _load_vocabulary(path)
+        self.scope = config.CONDITIONS_SCOPE if scope is None else scope
+        self.setters = (config.CONDITION_SETTERS if setters is None
+                        else setters)
+
+    # -- argument validation --
+
+    def _check_expr(self, expr: ast.expr, kind: str,
+                    assignments: dict[str, list[ast.expr]],
+                    depth: int = 0) -> str | None:
+        """None when the expression provably resolves to declared
+        vocabulary; else a human-readable reason."""
+        names, values = self.vocab[kind]
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, str) and expr.value in values:
+                return None
+            return (f"literal {expr.value!r} is not a declared condition "
+                    f"{kind} (declare it in {self.conditions_rel} or use "
+                    "an existing constant)")
+        sym = callee_name(expr)
+        if sym is not None and sym.startswith(_PREFIXES[kind]):
+            if sym in names:
+                return None
+            return (f"{sym} is not declared in {self.conditions_rel} "
+                    "(stale reference after a rename?)")
+        if isinstance(expr, ast.IfExp):
+            for branch in (expr.body, expr.orelse):
+                reason = self._check_expr(branch, kind, assignments, depth)
+                if reason is not None:
+                    return reason
+            return None
+        if (isinstance(expr, ast.Name) and depth < 4
+                and expr.id in assignments):
+            for value in assignments[expr.id]:
+                reason = self._check_expr(value, kind, assignments,
+                                          depth + 1)
+                if reason is not None:
+                    return reason
+            return None
+        return (f"condition {kind} cannot be verified statically — pass a "
+                f"{_PREFIXES[kind]}* constant from {self.conditions_rel} "
+                "(or a local variable assigned only from them)")
+
+    @staticmethod
+    def _argument(call: ast.Call, kwarg: str,
+                  index: int | None) -> ast.expr | None:
+        for kw in call.keywords:
+            if kw.arg == kwarg:
+                return kw.value
+        if index is not None and len(call.args) > index:
+            return call.args[index]
+        return None
+
+    # -- per module --
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if mod.rel == self.conditions_rel or not mod.matches(self.scope):
+            return []
+        tree = mod.tree
+        assert tree is not None
+        findings: list[Finding] = []
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        scope_assignments: dict[ast.AST, dict[str, list[ast.expr]]] = {}
+
+        def enclosing_scope(node: ast.AST) -> ast.AST:
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+                cur = parents.get(cur)
+            return cur or tree
+
+        def assignments_in(scope: ast.AST) -> dict[str, list[ast.expr]]:
+            cached = scope_assignments.get(scope)
+            if cached is None:
+                cached = {}
+                for node in ast.walk(scope):
+                    if isinstance(node, ast.Assign):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                cached.setdefault(tgt.id, []).append(
+                                    node.value)
+                scope_assignments[scope] = cached
+            return cached
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = callee_name(node.func)
+            spec = self.setters.get(callee or "")
+            if spec is None:
+                continue
+            type_idx, reason_idx = spec
+            assignments = assignments_in(enclosing_scope(node))
+            for kind, kwarg, idx in (("type", "cond_type", type_idx),
+                                     ("reason", "reason", reason_idx)):
+                arg = self._argument(node, kwarg, idx)
+                if arg is None:
+                    continue
+                why = self._check_expr(arg, kind, assignments)
+                if why is not None:
+                    findings.append(Finding(
+                        "conditions-vocabulary", mod.rel, arg.lineno, why))
+        return findings

@@ -1,0 +1,139 @@
+"""Hygiene pass — the gating subset of what golangci-lint gives the
+reference, migrated verbatim from the PR 1 ``tools/lint.py`` rules (the
+serving/CI image ships no third-party linter and installs are
+forbidden; GitHub CI layers real ruff on top).
+
+Rules:
+  unused-import            imported name never referenced in the module
+  bare-except              ``except:`` catches KeyboardInterrupt/SystemExit
+                           and turns every failure into silence
+  mutable-default          def f(x=[]) / {} / set() — shared across calls
+  duplicate-dict-key       literal dict with a repeated constant key
+  f-string-no-placeholder  f"..." with nothing interpolated
+  star-import              ``from x import *`` defeats static analysis
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.fusionlint.core import Finding, LintPass, Module
+
+
+class _Names(ast.NodeVisitor):
+    """Every identifier usage: loads, attribute roots."""
+
+    def __init__(self) -> None:
+        self.used: set[str] = set()
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        root = node
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name):
+            self.used.add(root.id)
+        self.generic_visit(node)
+
+
+def _exported(tree: ast.Module) -> set[str]:
+    """Strings in ``__all__`` count as usage (re-export modules)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets)
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    out.add(elt.value)
+    return out
+
+
+class HygienePass(LintPass):
+    name = "hygiene"
+    rules = (
+        "unused-import",
+        "bare-except",
+        "mutable-default",
+        "duplicate-dict-key",
+        "f-string-no-placeholder",
+        "star-import",
+    )
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        tree = mod.tree
+        assert tree is not None
+        findings: list[Finding] = []
+        names = _Names()
+        names.visit(tree)
+        used = names.used | _exported(tree)
+        # format specs (":.6f") parse as nested JoinedStr nodes — they
+        # are not f-strings the author wrote
+        format_specs = {
+            id(n.format_spec)
+            for n in ast.walk(tree)
+            if isinstance(n, ast.FormattedValue) and n.format_spec is not None
+        }
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        findings.append(Finding(
+                            "star-import", mod.rel, node.lineno,
+                            f"star import from {node.module} defeats "
+                            "static analysis"))
+                        continue
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if bound not in used:
+                        findings.append(Finding(
+                            "unused-import", mod.rel, node.lineno,
+                            f"imported name {bound!r} is never used"))
+            elif isinstance(node, ast.ExceptHandler) and node.type is None:
+                findings.append(Finding(
+                    "bare-except", mod.rel, node.lineno,
+                    "bare `except:` — name the exception types (a "
+                    "swallowed failure cannot be retried or routed "
+                    "around)"))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for default in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None
+                ]:
+                    if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(default, ast.Call)
+                        and isinstance(default.func, ast.Name)
+                        and default.func.id in ("list", "dict", "set")
+                    ):
+                        findings.append(Finding(
+                            "mutable-default", mod.rel, default.lineno,
+                            f"mutable default in {node.name}() is shared "
+                            "across calls"))
+            elif isinstance(node, ast.Dict):
+                seen: set = set()
+                for key in node.keys:
+                    if isinstance(key, ast.Constant):
+                        try:
+                            if key.value in seen:
+                                findings.append(Finding(
+                                    "duplicate-dict-key", mod.rel,
+                                    key.lineno,
+                                    f"duplicate dict key {key.value!r}"))
+                            seen.add(key.value)
+                        except TypeError:
+                            pass
+            elif isinstance(node, ast.JoinedStr):
+                if id(node) in format_specs:
+                    continue
+                if not any(isinstance(v, ast.FormattedValue)
+                           for v in node.values):
+                    findings.append(Finding(
+                        "f-string-no-placeholder", mod.rel, node.lineno,
+                        "f-string without placeholders"))
+        return findings

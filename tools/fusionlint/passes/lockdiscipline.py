@@ -1,0 +1,473 @@
+"""Lock-discipline pass — a heuristic race detector for ``self._*`` state.
+
+Go gives the reference ``-race`` at test time; CPython has no such
+runtime, and the GIL makes races *rarer*, not absent (any ``dict``/
+``set``/``list`` compound update, any check-then-act, any iteration
+concurrent with mutation can still interleave).  This pass encodes the
+project's locking convention statically:
+
+1. **Infer the guarded set.**  Within each class, attributes assigned a
+   ``threading.Lock()``/``RLock()``/``Condition()`` (or whose name
+   contains ``lock``) are lock attributes; every ``self.X`` touched
+   inside a ``with self.<lock>:`` block anywhere in the class is a
+   *guarded* attribute — the author has declared X shared.
+2. **Find thread-reachable code.**  Entry points are methods used as
+   ``threading.Thread``/``threading.Timer`` targets in the file, methods
+   named ``run`` (the Thread-subclass convention — the manager launches
+   ``AutoscaleController.run`` this way), every method of
+   ``BaseHTTPRequestHandler`` subclasses (one thread per connection
+   under ``ThreadingHTTPServer``), and — when the class owns a lock —
+   every public method (owning a lock is the class's own declaration
+   that instances are shared across threads).  Reachability closes over
+   ``self.method()`` calls.
+3. **Flag the holes.**  In reachable methods (``__init__`` excluded:
+   construction happens-before thread start), flag
+   (a) any access to a guarded attribute outside every lock, and
+   (b) any **mutation** of a mutable-container attribute (``{}``,
+   ``[]``, ``set()``, ``OrderedDict()``, …) outside every lock —
+   subscript stores/deletes, augmented assigns, and mutator method
+   calls (``.append``/``.pop``/``.setdefault``/…).
+
+This is a heuristic, and deliberately a *ratchet*: state that is never
+locked anywhere and never crosses the file's own threading seams is not
+flagged (cross-module sharing needs whole-program analysis), but the
+moment a class adopts a lock, every lock-free touch of its shared state
+becomes a finding.  A justified single-thread invariant is suppressed
+with ``# noqa:lock-discipline — <why this cannot race>``; the
+suppression must carry that justification (ISSUE 3 satellite 1).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.fusionlint import config
+from tools.fusionlint.core import Finding, LintPass, Module, callee_name
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+# internally-synchronized stdlib types: state of these attrs needs no
+# caller-side lock (Event flags, queue.Queue hand-off)
+_THREADSAFE_FACTORIES = {"Event", "Queue", "SimpleQueue", "LifoQueue",
+                         "PriorityQueue"}
+_CONTAINER_FACTORIES = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                        "deque", "Counter"}
+_CONTAINER_LITERALS = (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                       ast.ListComp, ast.SetComp)
+_MUTATORS = {"append", "add", "pop", "popitem", "update", "clear",
+             "setdefault", "extend", "remove", "discard", "insert",
+             "appendleft", "popleft"}
+_THREAD_FACTORIES = {"Thread", "Timer"}
+_SKIP_METHODS = {"__init__", "__post_init__", "__new__"}
+# attr names that ARE locks by naming convention: "lock" as its own
+# underscore-separated word ("_lock", "timers_lock", "rlock") — not a
+# substring hit inside "clock" or "block_size"
+_LOCK_NAME_RE = re.compile(r"(^|_)r?locks?($|_)")
+
+
+def _thread_target_names(tree: ast.Module) -> set[str]:
+    """Method/function names handed to Thread/Timer anywhere in the file."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if callee_name(node.func) not in _THREAD_FACTORIES:
+            continue
+        exprs: list[ast.expr] = []
+        for kw in node.keywords:
+            if kw.arg in ("target", "function"):
+                exprs.append(kw.value)
+        # Timer(delay, fn, ...) — fn is the 2nd positional
+        if callee_name(node.func) == "Timer" and len(node.args) >= 2:
+            exprs.append(node.args[1])
+        elif node.args:  # Thread(group, target, ...) is rare; be generous
+            exprs.extend(node.args[:2])
+        for e in exprs:
+            name = callee_name(e)
+            if name:
+                out.add(name)
+    return out
+
+
+def _is_handler_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = callee_name(base) or ""
+        if "RequestHandler" in name:
+            return True
+    return False
+
+
+@dataclass
+class _Access:
+    attr: str
+    line: int
+    under_lock: bool
+    mutation: bool  # in-place container change (x[k]=, .append, +=, del)
+    write: bool = False  # whole-attribute rebind (self.x = ...)
+
+
+@dataclass
+class _MethodScan:
+    accesses: list[_Access] = field(default_factory=list)
+    calls: set[str] = field(default_factory=set)  # self.m() callees
+
+
+@dataclass
+class _ClassAnalysis:
+    methods: set[str]
+    lock_attrs: set[str]
+    container_attrs: set[str]
+    guarded: set[str]
+    scans: dict[str, _MethodScan]
+    entries: set[str]
+    instantiates: set[str]  # capitalized callees (candidate helper classes)
+    is_handler: bool = False
+    thread_targeted: bool = False
+    propagated_from: str | None = None
+
+
+class _MethodVisitor:
+    """Recursive walk of one method body tracking with-lock nesting."""
+
+    def __init__(self, lock_attrs: set[str]):
+        self.lock_attrs = lock_attrs
+        self.scan = _MethodScan()
+
+    # -- helpers --
+
+    def _self_attr(self, node: ast.expr) -> str | None:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _record(self, attr: str, line: int, depth: int,
+                mutation: bool, write: bool = False) -> None:
+        self.scan.accesses.append(
+            _Access(attr, line, depth > 0, mutation, write))
+
+    # -- walk --
+
+    def walk(self, stmts: list[ast.stmt], depth: int = 0) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, depth)
+
+    def _stmt(self, node: ast.stmt, depth: int) -> None:
+        if isinstance(node, ast.With):
+            d = depth
+            for item in node.items:
+                attr = self._self_attr(item.context_expr)
+                if attr is not None and attr in self.lock_attrs:
+                    d += 1
+                else:
+                    self._expr(item.context_expr, depth)
+                if item.optional_vars is not None:
+                    self._expr(item.optional_vars, depth)
+            self.walk(node.body, d)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def's body runs when CALLED, which may be after
+            # the enclosing lock was released — scan conservatively as
+            # lock-free
+            self.walk(node.body, 0)
+            return
+        if isinstance(node, ast.ClassDef):
+            return  # nested classes (HTTP handlers) close over locals,
+            # not self — out of this heuristic's reach
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                self._target(tgt, depth)
+            self._expr(node.value, depth)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._target(node.target, depth, aug=True)
+            self._expr(node.value, depth)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._expr(node.value, depth)
+            self._target(node.target, depth)
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                self._target(tgt, depth, delete=True)
+            return
+        # generic statement: visit child statements with the same depth,
+        # expressions via _expr
+        for fname, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self.walk(value, depth)
+                else:
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            self._expr(v, depth)
+            elif isinstance(value, ast.expr):
+                self._expr(value, depth)
+
+    def _target(self, node: ast.expr, depth: int, aug: bool = False,
+                delete: bool = False) -> None:
+        attr = self._self_attr(node)
+        if attr is not None:
+            # plain rebind `self.x = ...` is a write; += is a mutation
+            self._record(attr, node.lineno, depth, mutation=aug,
+                         write=not aug and not delete)
+            return
+        if isinstance(node, ast.Subscript):
+            # self.x[k] = / del self.x[k] / self.x[k] += — container mutation
+            attr = self._self_attr(node.value)
+            if attr is not None:
+                self._record(attr, node.lineno, depth, mutation=True)
+            else:
+                self._expr(node.value, depth)
+            self._expr(node.slice, depth)
+            return
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self._target(elt, depth, aug=aug, delete=delete)
+            return
+        self._expr(node, depth)
+
+    def _expr(self, node: ast.expr, depth: int) -> None:
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                # self.m(...) — call-graph edge
+                if (isinstance(func.value, ast.Name)
+                        and func.value.id == "self"):
+                    self.scan.calls.add(func.attr)
+                    self._record(func.attr, func.lineno, depth,
+                                 mutation=False)
+                else:
+                    # self.x.append(...) — mutator on a container attr
+                    attr = self._self_attr(func.value)
+                    if attr is not None:
+                        self._record(attr, func.lineno, depth,
+                                     mutation=func.attr in _MUTATORS)
+                    else:
+                        self._expr(func.value, depth)
+            else:
+                self._expr(func, depth)
+            for a in node.args:
+                self._expr(a, depth)
+            for kw in node.keywords:
+                self._expr(kw.value, depth)
+            return
+        attr = self._self_attr(node)
+        if attr is not None:
+            self._record(attr, node.lineno, depth, mutation=False)
+            return
+        if isinstance(node, (ast.Lambda,)):
+            self._expr(node.body, 0)  # runs later; conservatively lock-free
+            return
+        for _f, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._expr(v, depth)
+                    elif isinstance(v, ast.comprehension):
+                        self._expr(v.iter, depth)
+                        self._expr(v.target, depth)
+                        for c in v.ifs:
+                            self._expr(c, depth)
+            elif isinstance(value, ast.expr):
+                self._expr(value, depth)
+
+
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    rules = ("lock-discipline",)
+
+    def __init__(self, modules: list[str] | None = None):
+        self.module_globs = (config.LOCK_DISCIPLINE_MODULES
+                             if modules is None else modules)
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if not mod.matches(self.module_globs):
+            return []
+        tree = mod.tree
+        assert tree is not None
+        thread_targets = _thread_target_names(tree)
+        classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+        analyses = {
+            cls.name: a
+            for cls in classes
+            if (a := self._analyze_class(cls, thread_targets)) is not None
+        }
+        # exposure propagation: an instance CREATED by a thread-exposed
+        # class lives on that class's threads — _PrefixAffinity has no
+        # lock of its own, but EndpointPicker (which owns one and is
+        # picked from concurrently) instantiates and drives it, so its
+        # public methods run on the picker's threads.  Propagated
+        # exposure treats the helper's public methods as entry points.
+        exposed = {name for name, a in analyses.items() if a.entries}
+        changed = True
+        while changed:
+            changed = False
+            for name in sorted(exposed):
+                for inst in analyses[name].instantiates:
+                    if inst in analyses and inst not in exposed:
+                        a = analyses[inst]
+                        a.entries = {
+                            n for n in a.methods if not n.startswith("_")
+                        } - _SKIP_METHODS
+                        a.propagated_from = name
+                        if a.entries:
+                            exposed.add(inst)
+                            changed = True
+        findings: list[Finding] = []
+        for cls in classes:
+            a = analyses.get(cls.name)
+            if a is not None and cls.name in exposed:
+                findings.extend(self._flag_class(mod, cls, a))
+        return findings
+
+    # -- per class --
+
+    def _analyze_class(self, cls: ast.ClassDef,
+                       thread_targets: set[str]) -> "_ClassAnalysis | None":
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not methods:
+            return None
+
+        # phase 1: lock attributes (assignment scan across all methods)
+        lock_attrs: set[str] = set()
+        threadsafe_attrs: set[str] = set()
+        container_attrs: set[str] = set()
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [node.target]  # self.x: dict[...] = {}
+                else:
+                    continue
+                for tgt in targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    value = node.value
+                    callee = (callee_name(value.func)
+                              if isinstance(value, ast.Call) else None)
+                    name = tgt.attr.lower()
+                    if callee in _LOCK_FACTORIES or _LOCK_NAME_RE.search(name):
+                        lock_attrs.add(tgt.attr)
+                    elif callee in _THREADSAFE_FACTORIES:
+                        threadsafe_attrs.add(tgt.attr)
+                    elif (isinstance(value, _CONTAINER_LITERALS)
+                          or callee in _CONTAINER_FACTORIES):
+                        container_attrs.add(tgt.attr)
+        container_attrs -= threadsafe_attrs
+        # dataclass-style class-level `x: dict = field(default_factory=dict)`
+        for node in cls.body:
+            if (isinstance(node, ast.AnnAssign)
+                    and isinstance(node.target, ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and callee_name(node.value.func) == "field"):
+                for kw in node.value.keywords:
+                    if (kw.arg == "default_factory"
+                            and isinstance(kw.value, ast.Name)
+                            and kw.value.id in _CONTAINER_FACTORIES):
+                        container_attrs.add(node.target.id)
+
+        # phase 2: scan every method.  The `_locked` naming convention
+        # (breaker.py: `_maybe_half_open_locked`) means "caller holds
+        # the lock" — such bodies scan at lock depth 1.
+        scans: dict[str, _MethodScan] = {}
+        for name, m in methods.items():
+            visitor = _MethodVisitor(lock_attrs)
+            visitor.walk(m.body, depth=1 if name.endswith("_locked") else 0)
+            scans[name] = visitor.scan
+
+        # guarded = attrs the class WRITES or MUTATES under a lock
+        # somewhere: the lock demonstrably protects their mutation, so a
+        # lock-free touch elsewhere is a hole.  (An attr merely READ
+        # under a lock — a config scalar consulted inside a critical
+        # section — is not thereby declared shared.)
+        guarded: set[str] = set()
+        for scan in scans.values():
+            for acc in scan.accesses:
+                if (acc.under_lock and acc.attr not in lock_attrs
+                        and (acc.mutation or acc.write)):
+                    guarded.add(acc.attr)
+        guarded -= set(methods)  # self.method() calls are not state
+        guarded -= threadsafe_attrs
+
+        # phase 3: entry points from direct evidence (propagated
+        # exposure is added by check_module) + classes this one creates
+        entries = {
+            name for name in methods
+            if name in thread_targets or name == "run"
+        }
+        if _is_handler_class(cls):
+            entries |= set(methods)
+        if lock_attrs:
+            entries |= {n for n in methods if not n.startswith("_")}
+        entries -= _SKIP_METHODS
+        instantiates: set[str] = set()
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call):
+                    callee = callee_name(node.func)
+                    if callee and callee.lstrip("_")[:1].isupper():
+                        instantiates.add(callee)
+        return _ClassAnalysis(
+            methods=set(methods),
+            lock_attrs=lock_attrs,
+            container_attrs=container_attrs,
+            guarded=guarded,
+            scans=scans,
+            entries=entries,
+            instantiates=instantiates,
+            is_handler=_is_handler_class(cls),
+            thread_targeted=bool(set(methods) & thread_targets),
+        )
+
+    def _flag_class(self, mod: Module, cls: ast.ClassDef,
+                    a: "_ClassAnalysis") -> list[Finding]:
+        reachable: set[str] = set()
+        frontier = sorted(a.entries)
+        while frontier:
+            name = frontier.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            frontier.extend(
+                c for c in a.scans[name].calls
+                if c in a.methods and c not in reachable)
+        reachable -= _SKIP_METHODS
+
+        findings: list[Finding] = []
+        seen: set[tuple[str, int]] = set()
+        why = (f"instantiated by thread-exposed {a.propagated_from}"
+               if a.propagated_from else "reachable from thread-entry points")
+        for name in sorted(reachable):
+            for acc in a.scans[name].accesses:
+                if acc.under_lock or acc.attr in a.lock_attrs:
+                    continue
+                key = (acc.attr, acc.line)
+                if key in seen:
+                    continue
+                if acc.attr in a.guarded:
+                    seen.add(key)
+                    findings.append(Finding(
+                        "lock-discipline", mod.rel, acc.line,
+                        f"self.{acc.attr} is guarded by a lock elsewhere "
+                        f"in {cls.name} but accessed lock-free in "
+                        f"{name}(), which is {why}"))
+                elif acc.mutation and acc.attr in a.container_attrs:
+                    seen.add(key)
+                    findings.append(Finding(
+                        "lock-discipline", mod.rel, acc.line,
+                        f"self.{acc.attr} is a mutable container on "
+                        f"{cls.name} (a class that crosses thread "
+                        f"boundaries: {why}) mutated without a lock "
+                        f"in {name}()"))
+        return findings

@@ -1,0 +1,196 @@
+"""Metrics-conventions pass — Prometheus exposition rules, statically.
+
+The engine's ``/metrics`` is scraped by the EPP scorers, the autoscale
+collector, and (in production) a real Prometheus; the manager's port
+serves controller-runtime-compatible series plus autoscaler
+self-metrics.  Exposition mistakes are contract breaks that only
+surface when a dashboard silently reads nothing: a counter without
+``_total`` won't match recording rules, a family without ``# TYPE`` is
+untyped everywhere downstream, duplicate family names across two
+modules collide the moment both bodies are concatenated onto one port
+(exactly what ``Manager._serve_metrics`` does with the autoscaler).
+
+The pass statically extracts, from each module in ``config.
+METRICS_MODULES``:
+
+* ``# HELP <family> …`` / ``# TYPE <family> <type>`` string literals,
+* sample families from f-string constants shaped ``family{…`` , and
+* histogram/summary families passed to ``*.render("family", labels)``.
+
+Rules (all emitted as ``metrics-conventions``):
+  * every sample family has ``# TYPE`` and ``# HELP`` in its module
+    (``_bucket``/``_sum``/``_count`` fold into their base family);
+  * ``counter`` families end in ``_total``; ``_total`` families are
+    typed ``counter``;
+  * ``histogram``/``summary`` families carry a unit suffix
+    (``_seconds``/``_bytes``);
+  * the declared TYPE is a real Prometheus type;
+  * no family is declared in two different modules (cross-file).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.fusionlint import config
+from tools.fusionlint.core import Finding, LintPass, Module
+
+_FAMILY = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_FAMILY})\s+\S")
+_TYPE_RE = re.compile(rf"^# TYPE ({_FAMILY})\s+(\S+)")
+_SAMPLE_RE = re.compile(rf"^({_FAMILY})\{{")
+_VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
+_UNIT_SUFFIXES = ("_seconds", "_bytes")
+
+
+@dataclass
+class _ModuleMetrics:
+    help: dict[str, int] = field(default_factory=dict)     # family -> line
+    types: dict[str, tuple[str, int]] = field(default_factory=dict)
+    samples: dict[str, int] = field(default_factory=dict)  # family -> line
+
+
+def _string_constants(tree: ast.Module):
+    """Yield (line, text) for every string constant and for the leading
+    constant chunk of every f-string (enough to read the family name out
+    of ``f"family{{{labels}}} {value}"``).  Non-leading f-string
+    fragments are skipped — ``f"{name}_bucket…"`` names its family
+    dynamically and is handled by the ``.render()`` call extraction."""
+    fragment_ids: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.JoinedStr):
+            for i, v in enumerate(node.values):
+                if i > 0 or not isinstance(v, ast.Constant):
+                    fragment_ids.add(id(v))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and id(node) not in fragment_ids):
+            yield node.lineno, node.value
+
+
+def _render_call_families(tree: ast.Module):
+    """Families passed as ``something.render("family", …)`` — the
+    Histogram helper renders ``_bucket``/``_sum``/``_count`` series for
+    the family named by its first argument."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "render"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and re.fullmatch(_FAMILY, node.args[0].value)):
+            yield node.lineno, node.args[0].value
+
+
+def _extract(mod: Module) -> _ModuleMetrics:
+    out = _ModuleMetrics()
+    assert mod.tree is not None
+    for line, text in _string_constants(mod.tree):
+        for chunk in text.split("\n"):
+            m = _HELP_RE.match(chunk)
+            if m:
+                out.help.setdefault(m.group(1), line)
+                continue
+            m = _TYPE_RE.match(chunk)
+            if m:
+                out.types.setdefault(m.group(1), (m.group(2), line))
+                continue
+            m = _SAMPLE_RE.match(chunk)
+            if m:
+                out.samples.setdefault(m.group(1), line)
+    for line, fam in _render_call_families(mod.tree):
+        out.samples.setdefault(fam, line)
+    return out
+
+
+def _base_family(name: str, declared: dict) -> str:
+    """Fold ``X_bucket``/``X_sum``/``X_count`` into ``X`` when ``X`` is a
+    declared histogram/summary family."""
+    for suffix in _SERIES_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if base in declared:
+                return base
+    return name
+
+
+class MetricsConventionsPass(LintPass):
+    name = "metrics-conventions"
+    rules = ("metrics-conventions",)
+
+    def __init__(self, modules: list[str] | None = None):
+        self.module_globs = (config.METRICS_MODULES
+                             if modules is None else modules)
+        self._per_module: dict[str, _ModuleMetrics] = {}
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if not mod.matches(self.module_globs):
+            return []
+        metrics = _extract(mod)
+        self._per_module[mod.rel] = metrics
+        findings: list[Finding] = []
+
+        families = dict(metrics.samples)
+        # fold _bucket/_sum/_count series into their base family
+        for fam in list(families):
+            base = _base_family(fam, metrics.types)
+            if base != fam:
+                families.setdefault(base, families.pop(fam))
+
+        for fam, line in sorted(families.items()):
+            if fam not in metrics.types:
+                findings.append(Finding(
+                    "metrics-conventions", mod.rel, line,
+                    f"family {fam} is exposed without a '# TYPE' line in "
+                    "this module (untyped everywhere downstream)"))
+            if fam not in metrics.help:
+                findings.append(Finding(
+                    "metrics-conventions", mod.rel, line,
+                    f"family {fam} is exposed without a '# HELP' line in "
+                    "this module"))
+        for fam, (ftype, line) in sorted(metrics.types.items()):
+            if ftype not in _VALID_TYPES:
+                findings.append(Finding(
+                    "metrics-conventions", mod.rel, line,
+                    f"family {fam} declares unknown type {ftype!r} "
+                    f"(valid: {', '.join(sorted(_VALID_TYPES))})"))
+                continue
+            if ftype == "counter" and not fam.endswith("_total"):
+                findings.append(Finding(
+                    "metrics-conventions", mod.rel, line,
+                    f"counter family {fam} must end in _total (Prometheus "
+                    "naming convention; recording rules match on it)"))
+            if fam.endswith("_total") and ftype != "counter":
+                findings.append(Finding(
+                    "metrics-conventions", mod.rel, line,
+                    f"family {fam} ends in _total but is typed {ftype} — "
+                    "_total is reserved for counters"))
+            if (ftype in ("histogram", "summary")
+                    and not fam.endswith(_UNIT_SUFFIXES)):
+                findings.append(Finding(
+                    "metrics-conventions", mod.rel, line,
+                    f"{ftype} family {fam} should carry a unit suffix "
+                    f"({' or '.join(_UNIT_SUFFIXES)})"))
+        return findings
+
+    def finalize(self, modules: list[Module]) -> list[Finding]:
+        findings: list[Finding] = []
+        owners: dict[str, tuple[str, int]] = {}
+        for rel in sorted(self._per_module):
+            metrics = self._per_module[rel]
+            for fam, (_t, line) in sorted(metrics.types.items()):
+                if fam in owners:
+                    prev_rel, _prev_line = owners[fam]
+                    findings.append(Finding(
+                        "metrics-conventions", rel, line,
+                        f"family {fam} is already declared in {prev_rel} — "
+                        "two modules exporting one family collide when "
+                        "their bodies share a port"))
+                else:
+                    owners[fam] = (rel, line)
+        self._per_module.clear()
+        return findings

@@ -1,0 +1,139 @@
+"""Render-purity pass — manifest producers must be deterministic.
+
+The reconciler's idempotency contract is that rendering the same
+``InferenceService`` spec twice yields byte-identical children: the
+spec-hash stamping (``utils/hash.py``), the drift detection in the
+reconcile loop, and ``make verify-manifests`` all assume it.  A builder
+that consults a wall clock, randomness, the process environment, or
+does I/O breaks that silently — every reconcile pass sees a "changed"
+child and rewrites it, which at slice scale is a self-inflicted write
+storm against the API server.
+
+Scope is the module list in ``tools/fusionlint/config.py:
+RENDER_PURE_MODULES``.  Module-level statements are exempt — they run
+once at import, so a constant initialized from the environment is
+stable for the life of the process; the ban applies inside function
+bodies, where re-evaluation per render is what destroys byte-stability.
+
+Banned inside functions of pure modules:
+
+* ``time.*`` calls, ``datetime…now()/utcnow()/today()``
+* ``random.*``, ``uuid.*``, ``secrets.*`` calls
+* ``os.environ`` access, ``os.getenv()``, ``os.urandom()``
+* file/network I/O: ``open()``, ``input()``, ``urlopen()``,
+  ``socket.*`` and ``requests.*`` calls
+
+A deliberate deploy-time knob (e.g. an env-var image override) is
+suppressed with ``# noqa:render-purity — <why this stays stable per
+environment>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.fusionlint import config
+from tools.fusionlint.core import Finding, LintPass, Module
+
+_BANNED_ROOTS = {
+    "time": "wall clock",
+    "random": "randomness",
+    "uuid": "randomness",
+    "secrets": "randomness",
+    "socket": "network I/O",
+    "requests": "network I/O",
+    "urllib": "network I/O",
+}
+_BANNED_CALLS = {
+    "open": "file I/O",
+    "input": "console I/O",
+    "urlopen": "network I/O",
+    "getenv": "environment read",
+    "urandom": "randomness",
+}
+_BANNED_DATETIME_ATTRS = {"now", "utcnow", "today"}
+
+
+def _root_name(expr: ast.expr) -> str | None:
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _dotted(expr: ast.expr) -> str:
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+class RenderPurityPass(LintPass):
+    name = "render-purity"
+    rules = ("render-purity",)
+
+    def __init__(self, modules: list[str] | None = None):
+        self.module_globs = (config.RENDER_PURE_MODULES
+                             if modules is None else modules)
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if not mod.matches(self.module_globs):
+            return []
+        tree = mod.tree
+        assert tree is not None
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for inner in ast.walk(node):
+                    f = self._check_node(mod, inner)
+                    if f is not None:
+                        findings.append(f)
+        # dedup (nested functions are walked from each enclosing def)
+        uniq = {(f.line, f.message): f for f in findings}
+        return [uniq[k] for k in sorted(uniq)]
+
+    def _check_node(self, mod: Module, node: ast.AST) -> Finding | None:
+        # os.environ in any expression position (read, .get, subscript)
+        if (isinstance(node, ast.Attribute) and node.attr == "environ"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "os"):
+            return Finding(
+                "render-purity", mod.rel, node.lineno,
+                "os.environ in a manifest-rendering function breaks "
+                "byte-stable re-render (environment read)")
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name is None:
+            return None
+        if isinstance(func, ast.Name) and name in _BANNED_CALLS:
+            return Finding(
+                "render-purity", mod.rel, node.lineno,
+                f"{name}() in a manifest-rendering function breaks "
+                f"byte-stable re-render ({_BANNED_CALLS[name]})")
+        if isinstance(func, ast.Attribute):
+            root = _root_name(func)
+            if root in _BANNED_ROOTS:
+                return Finding(
+                    "render-purity", mod.rel, node.lineno,
+                    f"{_dotted(func)}() in a manifest-rendering function "
+                    "breaks byte-stable re-render "
+                    f"({_BANNED_ROOTS[root]})")
+            if name in _BANNED_CALLS and root == "os":
+                return Finding(
+                    "render-purity", mod.rel, node.lineno,
+                    f"os.{name}() in a manifest-rendering function breaks "
+                    f"byte-stable re-render ({_BANNED_CALLS[name]})")
+            if (name in _BANNED_DATETIME_ATTRS
+                    and root in ("datetime", "date")):
+                return Finding(
+                    "render-purity", mod.rel, node.lineno,
+                    f"{_dotted(func)}() in a manifest-rendering function "
+                    "breaks byte-stable re-render (wall clock)")
+        return None

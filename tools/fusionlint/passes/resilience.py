@@ -1,0 +1,98 @@
+"""Resilience pass — the static half of the fault-tolerance contract
+(PR 1's ``tools/lint_resilience.py``, minus bare-except which now lives
+in the hygiene pass so each rule has exactly one owner).
+
+Rules:
+  missing-timeout    a blocking network call without an explicit
+                     ``timeout=`` can hang a controller/decode/router
+                     thread forever on a half-open TCP connection,
+                     which monitoring cannot tell apart from healthy
+                     idle.  Flags ``urlopen``, ``socket.create_connection``,
+                     and ``http.client`` connection constructors when no
+                     timeout argument is present.
+  wall-clock         direct ``time.time()`` / ``time.sleep()`` calls —
+                     and ``from time import time/sleep`` aliases — are
+                     forbidden in packages whose control loops must run
+                     against an injected clock (deterministic chaos/e2e
+                     suites).  Per-package, configured in
+                     ``tools/fusionlint/config.py: WALL_CLOCK_PACKAGES``
+                     instead of PR 2's hard-coded ``autoscale/``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.fusionlint import config
+from tools.fusionlint.core import Finding, LintPass, Module, callee_name
+
+# callables that block on the network and accept a timeout argument;
+# name -> position of the timeout parameter in the positional arg list
+_TIMEOUT_CALLS = {
+    "urlopen": 2,             # urllib.request.urlopen(url, data, timeout)
+    "create_connection": 1,   # socket.create_connection(address, timeout)
+    "HTTPConnection": 2,      # http.client.HTTPConnection(host, port, timeout)
+    "HTTPSConnection": 2,
+}
+
+
+def _has_timeout(call: ast.Call, positional_slot: int) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if any(kw.arg is None for kw in call.keywords):  # **kwargs: trust it
+        return True
+    return len(call.args) > positional_slot
+
+
+class ResiliencePass(LintPass):
+    name = "resilience"
+    rules = ("missing-timeout", "wall-clock")
+
+    def __init__(self,
+                 wall_clock_packages: dict[str, tuple[str, ...]] | None = None):
+        self.wall_clock_packages = (
+            config.WALL_CLOCK_PACKAGES if wall_clock_packages is None
+            else wall_clock_packages)
+
+    def _banned_names(self, mod: Module) -> tuple[str, ...]:
+        for prefix, banned in self.wall_clock_packages.items():
+            if mod.rel.startswith(prefix.rstrip("/") + "/"):
+                return banned
+        return ()
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        tree = mod.tree
+        assert tree is not None
+        banned = self._banned_names(mod)
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if banned and node.module == "time":
+                    bad = sorted(
+                        a.name for a in node.names if a.name in banned)
+                    if bad:
+                        findings.append(Finding(
+                            "wall-clock", mod.rel, node.lineno,
+                            f"importing {', '.join(bad)} from time hides a "
+                            "wall-clock dependency; control loops in this "
+                            "package take an injected clock"))
+            elif isinstance(node, ast.Call):
+                name = callee_name(node.func)
+                slot = _TIMEOUT_CALLS.get(name or "")
+                if slot is not None and not _has_timeout(node, slot):
+                    findings.append(Finding(
+                        "missing-timeout", mod.rel, node.lineno,
+                        f"{name}() without an explicit timeout can block "
+                        "a thread forever"))
+                if (banned
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in banned
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "time"):
+                    findings.append(Finding(
+                        "wall-clock", mod.rel, node.lineno,
+                        f"time.{node.func.attr}() breaks deterministic "
+                        "control-loop tests in this package; take an "
+                        "injected clock (time.monotonic as a default "
+                        "ARGUMENT is fine, calling it inline is not)"))
+        return findings

@@ -1,160 +1,25 @@
 #!/usr/bin/env python
-"""In-repo AST linter — the gating subset of what golangci-lint gives the
-reference (``/root/reference/.github/workflows/ci.yml:15-30``).
+"""Thin shim over fusionlint's hygiene pass.
 
-The serving/CI image ships no third-party linter (no ruff/flake8/pylint,
-and installs are forbidden), so this implements the high-signal checks as
-a hard gate that CAN fail — replacing round 1-2's decorative
-``ruff check || true``.  GitHub CI additionally installs real ruff (it
-has network) and runs it gating; this tool keeps the same bar enforceable
-inside the image.
+The PR 1 AST linter grew into the plugin-pass framework at
+``tools/fusionlint/`` (docs/design/static-analysis.md); this entry
+point survives so ``python tools/lint.py [paths...]`` and every CI/
+Makefile invocation keep working.  New callers should prefer::
 
-Checks:
-  unused-import        imported name never referenced in the module
-  bare-except          ``except:`` catching everything incl. KeyboardInterrupt
-  mutable-default      def f(x=[]) / {} / set() — shared across calls
-  duplicate-dict-key   literal dict with a repeated constant key
-  f-string-no-placeholder  f"..." with nothing interpolated
-  star-import          ``from x import *`` defeats static analysis
+    python -m tools.fusionlint [--select hygiene] [paths...]
 
-Usage: python tools/lint.py [paths...]   (defaults to the repo sources)
-Exit code 1 when any finding is emitted.
+Exit code 1 when any finding is emitted, same as always.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-DEFAULT_TARGETS = ["fusioninfer_tpu", "tests", "tools", "bench.py", "__graft_entry__.py"]
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-class _Names(ast.NodeVisitor):
-    """Collect every identifier usage (loads, attribute roots, strings in
-    __all__)."""
-
-    def __init__(self) -> None:
-        self.used: set[str] = set()
-
-    def visit_Name(self, node: ast.Name) -> None:
-        self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        root = node
-        while isinstance(root, ast.Attribute):
-            root = root.value
-        if isinstance(root, ast.Name):
-            self.used.add(root.id)
-        self.generic_visit(node)
-
-
-def _exported(tree: ast.Module) -> set[str]:
-    out: set[str] = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Assign)
-            and any(isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets)
-            and isinstance(node.value, (ast.List, ast.Tuple))
-        ):
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
-                    out.add(elt.value)
-    return out
-
-
-def check_file(path: pathlib.Path) -> list[str]:
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax-error {e.msg}"]
-    findings: list[str] = []
-    rel = path.relative_to(REPO) if path.is_relative_to(REPO) else path
-
-    names = _Names()
-    names.visit(tree)
-    used = names.used | _exported(tree)
-    # format specs (":.6f") parse as nested JoinedStr nodes — not f-strings
-    format_specs = {
-        id(n.format_spec)
-        for n in ast.walk(tree)
-        if isinstance(n, ast.FormattedValue) and n.format_spec is not None
-    }
-    noqa_lines = {
-        i + 1 for i, line in enumerate(src.splitlines()) if "# noqa" in line
-    }
-
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            if node.lineno in noqa_lines:
-                continue
-            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
-                continue
-            for alias in node.names:
-                if alias.name == "*":
-                    findings.append(f"{rel}:{node.lineno}: star-import from {node.module}")
-                    continue
-                bound = alias.asname or alias.name.split(".")[0]
-                if bound not in used:
-                    findings.append(f"{rel}:{node.lineno}: unused-import {bound}")
-        elif isinstance(node, ast.ExceptHandler) and node.type is None:
-            if node.lineno not in noqa_lines:
-                findings.append(f"{rel}:{node.lineno}: bare-except")
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None
-            ]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
-                    isinstance(default, ast.Call)
-                    and isinstance(default.func, ast.Name)
-                    and default.func.id in ("list", "dict", "set")
-                ):
-                    findings.append(
-                        f"{rel}:{default.lineno}: mutable-default in {node.name}()"
-                    )
-        elif isinstance(node, ast.Dict):
-            seen: set = set()
-            for key in node.keys:
-                if isinstance(key, ast.Constant):
-                    try:
-                        if key.value in seen:
-                            findings.append(
-                                f"{rel}:{key.lineno}: duplicate-dict-key {key.value!r}"
-                            )
-                        seen.add(key.value)
-                    except TypeError:
-                        pass
-        elif isinstance(node, ast.JoinedStr):
-            if node.lineno in noqa_lines or id(node) in format_specs:
-                continue
-            if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-                findings.append(f"{rel}:{node.lineno}: f-string-no-placeholder")
-    return findings
-
-
-def main(argv: list[str]) -> int:
-    targets = argv or DEFAULT_TARGETS
-    files: list[pathlib.Path] = []
-    for t in targets:
-        p = (REPO / t) if not pathlib.Path(t).is_absolute() else pathlib.Path(t)
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py":
-            files.append(p)
-    findings: list[str] = []
-    for f in files:
-        findings.extend(check_file(f))
-    for line in findings:
-        print(line)
-    if findings:
-        print(f"lint: {len(findings)} finding(s) across {len(files)} files", file=sys.stderr)
-        return 1
-    print(f"lint: clean ({len(files)} files)")
-    return 0
+from tools.fusionlint.cli import main  # noqa: E402
 
 
 if __name__ == "__main__":
-    raise SystemExit(main(sys.argv[1:]))
+    raise SystemExit(main(["--select", "hygiene", *sys.argv[1:]]))

@@ -1,167 +1,34 @@
 #!/usr/bin/env python
-"""Resilience lint — the static half of the fault-tolerance contract.
+"""Thin shim over fusionlint's resilience (+ bare-except) rules.
 
-The chaos suite (``tests/test_resilience.py``) proves the failure paths
-we wrote; this pass catches the ones we forgot to write.  Two rules,
-both "a hung or swallowed failure is invisible until slice scale":
+The PR 1 resilience linter's rules live in the fusionlint framework now
+(``tools/fusionlint/``, docs/design/static-analysis.md): missing-timeout
+and the per-package wall-clock rule moved to the ``resilience`` pass
+(package table: ``tools/fusionlint/config.py: WALL_CLOCK_PACKAGES``),
+and bare-except is owned by the ``hygiene`` pass.  This entry point
+keeps ``python tools/lint_resilience.py [paths...]`` working with the
+same coverage: both passes run, and ``--rules`` pins the emitted set to
+exactly this shim's historical rules.
 
-  bare-except        ``except:`` eats KeyboardInterrupt/SystemExit and
-                     turns every failure into silence — name the types
-                     (retry_on in the resilience layer names them too).
-  missing-timeout    a blocking network call without an explicit
-                     ``timeout=`` can hang a controller/decode/router
-                     thread forever on a half-open TCP connection, which
-                     monitoring cannot tell apart from healthy idle.
-                     Flags ``urlopen``, ``socket.create_connection``,
-                     and ``http.client`` connection constructors
-                     (``HTTPConnection``/``HTTPSConnection``) when no
-                     timeout argument is present.  Bare ``socket()`` +
-                     ``connect`` is NOT covered (needs flow analysis);
-                     prefer ``create_connection`` so the lint sees it.
-  wall-clock         (``fusioninfer_tpu/autoscale/`` only) direct
-                     ``time.time()`` / ``time.sleep()`` calls — and
-                     ``from time import time/sleep`` aliases — are
-                     forbidden in the autoscale control loops: scaling
-                     decisions, stabilization windows, staleness cutoffs
-                     and drain deadlines must run against an injected
-                     clock so the chaos/e2e suites drive them
-                     deterministically (``time.monotonic`` as an
-                     injectable DEFAULT is fine; pacing belongs to
-                     ``Event.wait``).
-
-``# noqa`` on the offending line suppresses (same convention as
-``tools/lint.py``); use it only for call sites that provably cannot
-block (e.g. a connection to a just-bound localhost listener in a test
-would still rather pass an explicit timeout).
-
-Usage: python tools/lint_resilience.py [paths...]
-Exit code 1 when any finding is emitted.  Wired into ``make lint``.
+Exit code 1 when any finding is emitted, same as always.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-DEFAULT_TARGETS = [
-    "fusioninfer_tpu", "tests", "tools", "bench.py", "__graft_entry__.py",
-]
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# callables that block on the network and accept a timeout argument;
-# name -> position of the timeout parameter in the positional arg list
-_TIMEOUT_CALLS = {
-    "urlopen": 2,             # urllib.request.urlopen(url, data, timeout)
-    "create_connection": 1,   # socket.create_connection(address, timeout)
-    "HTTPConnection": 2,      # http.client.HTTPConnection(host, port, timeout)
-    "HTTPSConnection": 2,
-}
-
-
-# directory (relative to repo root) whose control loops must take an
-# injected clock; the names banned as direct calls there
-_INJECTED_CLOCK_DIR = "fusioninfer_tpu/autoscale"
-_WALL_CLOCK_BANNED = {"time", "sleep"}
-
-
-def _callee_name(func: ast.expr) -> str | None:
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return None
-
-
-def _has_timeout(call: ast.Call, positional_slot: int) -> bool:
-    if any(kw.arg == "timeout" for kw in call.keywords):
-        return True
-    if any(kw.arg is None for kw in call.keywords):  # **kwargs: trust it
-        return True
-    return len(call.args) > positional_slot
-
-
-def check_file(path: pathlib.Path) -> list[str]:
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax-error {e.msg}"]
-    rel = path.relative_to(REPO) if path.is_relative_to(REPO) else path
-    in_autoscale = str(rel).replace("\\", "/").startswith(_INJECTED_CLOCK_DIR)
-    noqa_lines = {
-        i + 1 for i, line in enumerate(src.splitlines()) if "# noqa" in line
-    }
-    findings: list[str] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if node.lineno not in noqa_lines:
-                findings.append(
-                    f"{rel}:{node.lineno}: bare-except — name the exception "
-                    "types (a swallowed failure cannot be retried or routed "
-                    "around)"
-                )
-        elif isinstance(node, ast.ImportFrom):
-            if (in_autoscale and node.module == "time"
-                    and node.lineno not in noqa_lines):
-                bad = sorted(
-                    a.name for a in node.names if a.name in _WALL_CLOCK_BANNED
-                )
-                if bad:
-                    findings.append(
-                        f"{rel}:{node.lineno}: wall-clock — importing "
-                        f"{', '.join(bad)} from time in autoscale/ hides a "
-                        "wall-clock dependency; control loops take an "
-                        "injected clock"
-                    )
-        elif isinstance(node, ast.Call):
-            if node.lineno in noqa_lines:
-                continue
-            name = _callee_name(node.func)
-            slot = _TIMEOUT_CALLS.get(name or "")
-            if slot is not None and not _has_timeout(node, slot):
-                findings.append(
-                    f"{rel}:{node.lineno}: missing-timeout — {name}() without "
-                    "an explicit timeout can block a thread forever"
-                )
-            if (in_autoscale
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _WALL_CLOCK_BANNED
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "time"):
-                findings.append(
-                    f"{rel}:{node.lineno}: wall-clock — time.{node.func.attr}() "
-                    "in autoscale/ breaks deterministic control-loop tests; "
-                    "take an injected clock (time.monotonic as a default "
-                    "ARGUMENT is fine, calling it inline is not)"
-                )
-    return findings
-
-
-def main(argv: list[str]) -> int:
-    targets = argv or DEFAULT_TARGETS
-    files: list[pathlib.Path] = []
-    for t in targets:
-        p = (REPO / t) if not pathlib.Path(t).is_absolute() else pathlib.Path(t)
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py":
-            files.append(p)
-    findings: list[str] = []
-    for f in files:
-        findings.extend(check_file(f))
-    for line in findings:
-        print(line)
-    if findings:
-        print(
-            f"lint-resilience: {len(findings)} finding(s) across "
-            f"{len(files)} files",
-            file=sys.stderr,
-        )
-        return 1
-    print(f"lint-resilience: clean ({len(files)} files)")
-    return 0
+from tools.fusionlint.cli import main  # noqa: E402
 
 
 if __name__ == "__main__":
-    raise SystemExit(main(sys.argv[1:]))
+    # --rules pins the historical coverage: the hygiene pass carries
+    # more rules than this tool ever emitted, and the shim contract is
+    # "same findings, same exit codes"
+    raise SystemExit(main([
+        "--select", "resilience,hygiene",
+        "--rules", "missing-timeout,wall-clock,bare-except",
+        *sys.argv[1:],
+    ]))
