@@ -64,6 +64,12 @@ verify-manifests: ## Regenerate CRDs/config from the Python sources in memory, f
 .PHONY: bench
 bench: ## One-line JSON decode-throughput benchmark (real chip if present).
 	$(PYTHON) bench.py
+	$(PYTHON) tools/check_bench_record.py BENCH_OUT.json
+
+.PHONY: bench-smoke
+bench-smoke: ## CPU bench smoke + assert ceiling_fraction/scheduler fields land in the record.
+	BENCH_PLATFORM=cpu $(PYTHON) bench.py
+	$(PYTHON) tools/check_bench_record.py BENCH_OUT.json
 
 .PHONY: dryrun
 dryrun: ## Multichip sharding dry-run on 8 virtual CPU devices.

@@ -322,6 +322,86 @@ def run_hw_test_tier(record: dict) -> None:
         }
 
 
+def attach_tpu_evidence(record: dict, here: pathlib.Path = _HERE) -> None:
+    """Relay-death-proofing (VERDICT r5 ask #4): a round that produced
+    chip numbers must never ship a record that says only "CPU fallback".
+    When this run is NOT on the chip, embed the newest in-repo TPU
+    evidence file (``TPU_EVIDENCE*.json`` — the side artifact the TPU
+    leg writes) into the record: headline value, its file timestamp, and
+    the relay post-mortem from THIS run's probe.  ``in_round`` is true
+    when the evidence is newer than every committed ``BENCH_r*.json``
+    (i.e. it was produced this round, before the relay died), false when
+    it is a prior round's artifact carried for context."""
+    if record.get("backend_is_tpu"):
+        return
+    import re
+
+    def _round_no(p: pathlib.Path) -> int | None:
+        m = re.search(r"_r(\d+)", p.name)
+        return int(m.group(1)) if m else None
+
+    # newest evidence = highest ROUND NUMBER (checkout-proof — a fresh
+    # git clone stamps every file with one mtime, and lexicographic
+    # sorting would rank r100 before r99); mtime only breaks ties among
+    # unnumbered files
+    best: tuple[pathlib.Path, dict] | None = None
+    for p in sorted(here.glob("TPU_EVIDENCE*.json")):
+        try:
+            rec = json.loads(p.read_text())
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and "parsed" in rec:
+            rec = rec["parsed"]
+        if not isinstance(rec, dict) or "value" not in rec:
+            continue
+
+        def _key(path: pathlib.Path) -> tuple:
+            n = _round_no(path)
+            return (n is not None, n if n is not None else -1,
+                    path.stat().st_mtime)
+
+        if best is None or _key(p) >= _key(best[0]):
+            best = (p, rec)
+    if best is None:
+        return
+    path, rec = best
+    # in-round determination: the round NUMBER in the filename is the
+    # deterministic signal: evidence numbered past every committed
+    # BENCH_r*.json was produced this round.  Unnumbered evidence falls
+    # back to strictly-newer mtime.
+
+    bench_rounds = [n for n in (_round_no(p)
+                                for p in here.glob("BENCH_r*.json"))
+                    if n is not None]
+    ev_round = _round_no(path)
+    if not bench_rounds:
+        in_round = True
+    elif ev_round is not None:
+        in_round = ev_round > max(bench_rounds)
+    else:
+        in_round = path.stat().st_mtime > max(
+            p.stat().st_mtime for p in here.glob("BENCH_r*.json"))
+    evidence: dict = {
+        "file": path.name,
+        "in_round": in_round,
+        "mtime_epoch_s": round(path.stat().st_mtime, 1),
+        "metric": rec.get("metric"),
+        "value": rec.get("value"),
+        "unit": rec.get("unit"),
+        "backend": rec.get("backend"),
+        "http": {k: rec["http"][k] for k in
+                 ("ttft_p50_ms", "output_tok_per_s_per_chip",
+                  "ceiling_fraction")
+                 if isinstance(rec.get("http"), dict) and k in rec["http"]},
+    }
+    relay = (record.get("env_diagnostics") or {}).get("axon_relay")
+    if relay is not None:
+        evidence["relay_post_mortem"] = relay
+    if record.get("probe"):
+        evidence["fallback_reason"] = record["probe"]
+    record["tpu_evidence"] = evidence
+
+
 def longitudinal(record: dict, here: pathlib.Path = _HERE) -> None:
     """vs_prev against the latest prior round's record; vs_baseline
     against the FIRST prior record with ``backend: tpu``.  Metrics must
@@ -476,6 +556,17 @@ def decode_tokens_needed(start: int, warmup: int, steps: int,
     return start + warmup + steps * reps + 1
 
 
+def stratified_lens(batch: int, span_tokens: int, tail: int,
+                    base: int = 256) -> list[int]:
+    """Per-row context depths for the ragged long-context leg: linear
+    strata from ``base`` up to ``span_tokens - tail`` (room for the
+    timed window).  ``max(batch - 1, 1)``: a ``batch == 1`` leg
+    (BENCH_MODEL debug runs) yields ``[base]`` instead of
+    ZeroDivisionError-ing the whole record (ADVICE r5)."""
+    return [base + (span_tokens - base - tail) * i // max(batch - 1, 1)
+            for i in range(batch)]
+
+
 def decode_pool_pages(lens: list[int], warmup: int, steps: int,
                       page_size: int, reps: int = _DECODE_REPS) -> int:
     """Exact-fit page-pool size for a ragged ``run_decode``: per-row
@@ -616,6 +707,11 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
 
     engine = NativeEngine(cfg, cache_cfg=cache_cfg, max_batch_size=max_batch_size,
                           prefill_chunk_size=prefill_chunk,
+                          # token-budgeted scheduling: seeded by the chunk
+                          # size (the shipped compat default) unless
+                          # BENCH_TOKEN_BUDGET pins it for an A/B
+                          token_budget=int(os.environ.get(
+                              "BENCH_TOKEN_BUDGET", "0") or 0) or None,
                           # production default (cli.py --decode-burst): on a
                           # remote-attached chip the host round trip per
                           # decode step dominates serving throughput.
@@ -680,6 +776,9 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
         out = result.summary(n_chips=1)
         out["decode_burst"] = engine.burst_steps
         out["warmed"] = True  # compiles excluded from the window
+        # token-budget scheduler evidence: budget, utilization, decision
+        # counters and the adaptive-burst span histogram (engine/sched.py)
+        out["scheduler"] = engine.sched.snapshot()
         if shared_prefix_len:
             out["shared_prefix_len"] = shared_prefix_len
         # TTFT decomposition: server-side queue-wait (arrival → admission
@@ -878,8 +977,7 @@ def main() -> None:
             # kernel streams only live pages.
             lc_steps, lc_ps, lc_mp = 64, 128, 16
             tail = decode_tokens_needed(0, warmup, lc_steps)
-            lens = [256 + (lc_ps * lc_mp - 256 - tail) * i // (batch - 1)
-                    for i in range(batch)]
+            lens = stratified_lens(batch, lc_ps * lc_mp, tail)
             # pool sized to actual need (not batch×16 pages): a fully
             # provisioned 16-page × 32-row pool is ~7.5 GiB of KV at
             # this model's [KV=8, Hd=128] × 28 layers
@@ -996,8 +1094,17 @@ def main() -> None:
                     max_prompt=128, max_output=32,
                     prefill_chunk=chunk, shared_prefix_len=96,
                 )
+            # decode-ceiling fraction: HTTP output tok/s/chip over the
+            # SAME-config raw decode tok/s — the serving-path-gap metric
+            # (VERDICT r5 ask #1: 126/550 = 0.23 was the round-5 truth)
+            for leg in ("http", "http_prefix_mix"):
+                if leg in record and tok_s:
+                    record[leg]["ceiling_fraction"] = round(
+                        record[leg].get("output_tok_per_s_per_chip", 0.0)
+                        / tok_s, 4)
     except Exception as e:  # never a traceback instead of the JSON line
         record["error"] = f"{type(e).__name__}: {e}"
+    attach_tpu_evidence(record)
     longitudinal(record)
     line = json.dumps(record)
     # sidecar copy: the driver captures a bounded log tail, which truncated

@@ -10,7 +10,7 @@ const sidebars = {
       label: 'Design',
       items: ['design/autoscaling', 'design/crd', 'design/engine',
               'design/parallelism', 'design/resilience', 'design/router',
-              'design/static-analysis'],
+              'design/scheduler', 'design/static-analysis'],
     },
   ],
 };

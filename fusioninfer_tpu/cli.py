@@ -208,7 +208,25 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--prefill-chunk-size", type=int, default=0,
                        help="chunked prefill: prompts longer than this many "
                             "tokens prefill in bounded chunks interleaved "
-                            "with decode steps (0 = monolithic prefill)")
+                            "with decode steps (0 = monolithic prefill). "
+                            "Compat alias: when set it also seeds the "
+                            "per-step token budget (--tokens-per-step)")
+    serve.add_argument("--tokens-per-step", type=int, default=0,
+                       help="token-budgeted scheduling: each engine step "
+                            "processes at most this many tokens — the "
+                            "running batch's decode tokens first, the "
+                            "remainder as adaptively-sized prefill chunks "
+                            "that shrink under decode load instead of "
+                            "stalling streams (docs/design/scheduler.md). "
+                            "0 = derive from a measured prefill forward at "
+                            "startup (multi-host slices fall back to 512)")
+    serve.add_argument("--no-token-budget", action="store_true",
+                       help="skip the startup-derived token budget "
+                            "(monolithic prefill). An explicit "
+                            "--prefill-chunk-size still seeds a budget of "
+                            "chunk tokens/step — chunked prefill is "
+                            "budget-scheduled in this engine; there is no "
+                            "fixed-chunk legacy mode")
     serve.add_argument("--speculative-ngram", type=int, default=0,
                        help="speculative decoding: propose up to K draft "
                             "tokens per greedy request by n-gram prompt "

@@ -254,6 +254,7 @@ class NativeEngine:
         lora_adapters: Optional[dict] = None,
         prefill_chunk_size: Optional[int] = None,
         prefill_chunks_per_step: int = 1,
+        token_budget: Optional[int] = None,
         speculative_k: Optional[int] = None,
         token_byte_table=None,
         decode_burst_steps: int = 1,
@@ -285,11 +286,19 @@ class NativeEngine:
         ``/root/reference/docs/.../core-design.md:29``).  Each chunk is a
         suffix prefill at the chunk's start position, so the compiled
         signatures are the same suffix buckets the prefix-cache path
-        already uses.  ``prefill_chunks_per_step`` bounds how many chunk
-        forwards one step may run (default 1 = strictest ITL bound).
-        Duplicate prompts that arrive while a twin is still mid-chunk
-        prefill independently (in-flight pages register in the prefix
-        cache only on completion).
+        already uses.  Both knobs are COMPAT ALIASES for ``token_budget``
+        (``budget = chunk × chunks_per_step``): chunk sizes are decided
+        per step by the budget ledger — the remainder after decode's
+        charge, split over the in-flight prefills — not by a fixed loop
+        count; ``prefill_chunk_size`` keeps only its admission-threshold
+        role.  Duplicate prompts that arrive while a twin is still
+        mid-chunk prefill independently (in-flight pages register in the
+        prefix cache only on completion).
+
+        ``token_budget``: tokens one :meth:`step` may process (decode
+        charged first, remainder on adaptively-sized prefill chunks —
+        docs/design/scheduler.md).  ``None`` with no chunk knobs =
+        monolithic prefill (the library default).
 
         ``speculative_k``: n-gram prompt-lookup speculative decoding —
         propose up to k draft tokens per greedy sequence from its own
@@ -438,8 +447,32 @@ class NativeEngine:
         self._lock = threading.Lock()
         if prefill_chunk_size is not None and prefill_chunk_size < 1:
             raise ValueError("prefill_chunk_size must be >= 1")
+        if token_budget is not None and token_budget < 1:
+            raise ValueError("token_budget must be >= 1")
         self.prefill_chunk = prefill_chunk_size
         self.prefill_chunks_per_step = max(1, prefill_chunks_per_step)
+        # token-budgeted scheduling (Sarathi-style): each step's budget
+        # is charged with the running batch's decode tokens first; the
+        # remainder buys adaptively-sized prefill chunks (engine/sched.py).
+        # The legacy chunk knobs are compat aliases that seed the budget
+        # (chunk × chunks_per_step = the old max per-step prefill work).
+        from fusioninfer_tpu.engine.sched import TokenBudget
+
+        if token_budget is None and prefill_chunk_size is not None:
+            token_budget = prefill_chunk_size * self.prefill_chunks_per_step
+        self.sched = TokenBudget(token_budget)
+        # pre-seed the only two span keys a dispatch can ever record
+        # ({1, burst_steps}): /metrics iterates this dict from an HTTP
+        # thread, and pre-seeding means record_span only ever updates
+        # values — no resize can race the exposition's iteration
+        self.sched.burst_span_steps[1] = 0
+        if decode_burst_steps > 1:
+            self.sched.burst_span_steps[decode_burst_steps] = 0
+        if self.prefill_chunk is None and token_budget is not None:
+            # budget without an explicit chunk size: the budget IS the
+            # chunking threshold (any longer prompt streams in chunks)
+            self.prefill_chunk = token_budget
+        self._step_prefill_left = 0  # set by step(); spent by _admit
         self.prefilling: list[_PrefillingState] = []  # FCFS chunk queue
         if speculative_k is not None and speculative_k < 1:
             raise ValueError("speculative_k must be >= 1")
@@ -516,6 +549,56 @@ class NativeEngine:
     @property
     def guided_enabled(self) -> bool:
         return self._masker is not None
+
+    @property
+    def token_budget(self) -> Optional[int]:
+        return self.sched.tokens_per_step
+
+    def set_token_budget(self, tokens_per_step: int) -> None:
+        """Install (or retune) the per-step token budget.  Enables
+        budgeted chunked prefill when the engine was built without one."""
+        if tokens_per_step < 1:
+            raise ValueError("token_budget must be >= 1")
+        self.sched.tokens_per_step = tokens_per_step
+        if self.prefill_chunk is None:
+            self.prefill_chunk = tokens_per_step
+
+    def calibrate_token_budget(self, target_step_s: float = 0.05,
+                               floor: int = 32, cap: int = 4096) -> int:
+        """Derive the token budget from MEASURED step latency: time one
+        real suffix-prefill forward on this engine's compiled path (the
+        same kernels serving will use), convert tokens/second into the
+        tokens/step that keep a step under ``target_step_s``, and
+        install it.  The probe writes into scratch pages that are
+        released before returning (pages are always overwritten before
+        they are read, and attention masks by true length, so the junk
+        KV is unreachable).  Multi-process engines must NOT calibrate
+        (per-process timing skew would diverge the SPMD lockstep) —
+        callers pass an explicit budget there."""
+        if self._mh is not None:
+            raise RuntimeError(
+                "calibrate_token_budget is single-process only; pass an "
+                "explicit token budget on multi-host meshes")
+        from fusioninfer_tpu.engine.sched import derive_token_budget
+
+        n = min(256, self.buckets[-1],
+                self.cache_cfg.max_pages_per_seq * self.cache_cfg.page_size)
+        probe = Request("__budget_probe__", [1] * n)
+        self.alloc.allocate(probe.request_id, n)
+        try:
+            self._suffix_forward(probe, probe.prompt_tokens, 0, n)  # compile
+            t0 = time.perf_counter()
+            logits = self._suffix_forward(probe, probe.prompt_tokens, 0, n)
+            # D2H scalar fetch: the only fence that includes execution on
+            # the tunneled chip (block_until_ready returns at enqueue)
+            float(logits[0, 0])
+            dt = time.perf_counter() - t0
+        finally:
+            self.alloc.release(probe.request_id)
+        budget = derive_token_budget(dt / n, target_step_s=target_step_s,
+                                     floor=floor, cap=cap)
+        self.set_token_budget(budget)
+        return budget
 
     def _validate_guided(self, request: Request) -> None:
         """Admission-time guided checks shared by every entry path
@@ -1072,6 +1155,20 @@ class NativeEngine:
             self._serve_embedding_requests()
             outputs: list[StepOutput] = []
             outputs += self._admit_prefilled()
+            # open the step's token ledger AFTER prefilled admissions
+            # (they decode this step too): the budget is charged with
+            # the running batch's decode tokens first, and _admit /
+            # _advance_prefilling spend the remainder on prefill work.
+            # Reads only replicated scheduler state (SPMD-safe).
+            # speculative rows verify up to spec_k drafts + 1 token per
+            # step: charge the worst case so the prefill remainder can
+            # never let a step blow the budget (conservative — shrunken
+            # drafts just leave some budget unspent)
+            per_row = 1 + (self.spec_k or 0)
+            self._step_prefill_left = self.sched.begin_step(
+                per_row * sum(1 for st in self.running.values()
+                              if st.n_generated
+                              < st.request.params.max_tokens))
             outputs += self._admit()
             outputs += self._advance_prefilling()
             outputs += self._decode()
@@ -1212,10 +1309,20 @@ class NativeEngine:
                     self.alloc.release(rid)
                     outputs.append(self._fail_admission(request, e))
                     continue
+                suffix_len = len(prefix) - reused
+                # budget gate: even a SHORT suffix defers to the chunked
+                # queue once this step's prefill remainder is spent —
+                # admission work never exceeds the budget in one step
+                # (the Sarathi stall-free property; the deferred request
+                # starts chunking this same step in _advance_prefilling)
+                over_budget = (self.sched.tokens_per_step is not None
+                               and suffix_len > self._step_prefill_left)
                 if (self.prefill_chunk is not None
-                        and len(prefix) - reused > self.prefill_chunk):
+                        and (suffix_len > self.prefill_chunk or over_budget)):
                     # long fresh prompt or long cache-miss suffix: write it
                     # in bounded chunks across steps (decode keeps running)
+                    if suffix_len <= self.prefill_chunk:
+                        self.sched.admission_deferred_total += 1
                     if not reused:
                         seen_prompts.add(key)
                     self.prefilling.append(_PrefillingState(
@@ -1223,7 +1330,8 @@ class NativeEngine:
                         pos=reused,
                     ))
                 elif reused:
-                    if len(prefix) - reused <= _SUFFIX_BATCH_WINDOW:
+                    self._reserve_prefill(suffix_len)
+                    if suffix_len <= _SUFFIX_BATCH_WINDOW:
                         # short suffix: batch with other hits through one
                         # verify_step forward (the common prefix-cache
                         # burst — N requests sharing a prompt, tails
@@ -1238,6 +1346,7 @@ class NativeEngine:
                         self.alloc.release(rid)
                         outputs.append(self._fail_admission(request, e))
                 else:
+                    self._reserve_prefill(suffix_len)
                     seen_prompts.add(key)
                     fresh.append((request, prefix, resumed))
 
@@ -1553,6 +1662,9 @@ class NativeEngine:
         pages (positions [0, reused) already live there)."""
         logits = self._suffix_forward(request, prefix, reused_tokens,
                                       len(prefix) - reused_tokens)
+        # lifetime ledger charged after the forward (the step remainder
+        # was reserved at classification; see _reserve_prefill)
+        self.sched.charge_prefill(len(prefix) - reused_tokens)
         return self._activate(request, prefix, resumed, logits)
 
     def _batched_window_forward(self, entries) -> "jax.Array":
@@ -1618,51 +1730,85 @@ class NativeEngine:
                 self.alloc.release(request.request_id)
                 outputs.append(self._fail_admission(request, e))
             return outputs
+        self.sched.charge_prefill(
+            sum(len(prefix) - reused for _, prefix, _, reused in items))
         return self._activate_group(
             [(request, prefix, resumed, logits[i][None])
              for i, (request, prefix, resumed, reused) in enumerate(items)])
 
-    def _advance_prefilling(self) -> list[StepOutput]:
-        """Advance EVERY mid-prefill sequence one chunk per step in one
-        batched multi-query forward (the q-tiled verify kernel) —
-        prefilling sequences progress together at full MXU utilization
-        instead of serializing across steps.  Sequences whose final chunk
-        completes activate into the decode batch (their reserved slots
-        are guaranteed by ``_avail_slots``).  A single sequence uses the
-        cheaper 1-sequence bucketed suffix path."""
-        outputs: list[StepOutput] = []
-        for _ in range(self.prefill_chunks_per_step):
-            if not self.prefilling:
-                break
-            if len(self.prefilling) == 1:
-                st = self.prefilling[0]
-                rid = st.request.request_id
-                try:
-                    chunk = min(self.prefill_chunk, len(st.prefix) - st.pos)
-                    logits = self._suffix_forward(st.request, st.prefix,
-                                                  st.pos, chunk)
-                    st.pos += chunk
-                    if st.pos == len(st.prefix):
-                        self.prefilling.pop(0)
-                        outputs.append(self._activate(
-                            st.request, st.prefix, st.resumed, logits))
-                except Exception as e:
-                    logger.exception("chunked prefill of %s failed", rid)
-                    # st is still the head on a chunk-forward failure but
-                    # was popped when _activate raised — never double-pop
-                    if self.prefilling and self.prefilling[0] is st:
-                        self.prefilling.pop(0)
-                    self.alloc.release(rid)
-                    outputs.append(self._fail_admission(st.request, e))
-                continue
-            outputs.extend(self._advance_prefilling_batch())
-        return outputs
+    def _chunk_budget(self) -> int:
+        """Prefill tokens this step may still spend, adaptively sized:
+        what is left of the step budget after decode's charge and
+        admission's spending — floored at one token per in-flight
+        prefill so a saturated decode batch can never starve a prompt
+        outright (a 1-token trickle is negligible compute)."""
+        n = min(len(self.prefilling), self.max_batch_size)
+        return max(self._step_prefill_left, n)
 
-    def _advance_prefilling_batch(self) -> list[StepOutput]:
-        """One batched chunk forward for all prefilling sequences."""
+    def _reserve_prefill(self, n: int) -> None:
+        """Reserve ``n`` tokens of this STEP's prefill remainder at
+        classification time, so later pops in the same admission round
+        see the budget already claimed.  The lifetime ledger
+        (``sched.charge_prefill``) is charged separately, AFTER the
+        forward succeeds — a failed forward spends the step's reservation
+        (the step did attempt the work) but must never inflate the
+        lifetime spent-token counters."""
+        self._step_prefill_left = max(0, self._step_prefill_left - n)
+
+    def _spend_prefill(self, n: int, chunks: int = 0) -> None:
+        """Reserve + charge in one call — the chunk-advance paths, where
+        the forward has already succeeded when this runs."""
+        self._reserve_prefill(n)
+        self.sched.charge_prefill(n, chunks=chunks)
+
+    def _advance_prefilling(self) -> list[StepOutput]:
+        """Advance EVERY mid-prefill sequence one budgeted chunk per
+        step in one batched multi-query forward (the q-tiled verify
+        kernel) — prefilling sequences progress together at full MXU
+        utilization instead of serializing across steps.  Chunk sizes
+        come from the step's remaining token budget split over the
+        in-flight prefills (``_chunk_budget``): they shrink under decode
+        load and grow to the full budget when the batch is idle.
+        Sequences whose final chunk completes activate into the decode
+        batch (their reserved slots are guaranteed by ``_avail_slots``).
+        A single sequence uses the cheaper 1-sequence bucketed suffix
+        path."""
+        outputs: list[StepOutput] = []
+        if not self.prefilling:
+            return outputs
+        budget = self._chunk_budget()
+        if len(self.prefilling) == 1:
+            st = self.prefilling[0]
+            rid = st.request.request_id
+            try:
+                chunk = min(budget, len(st.prefix) - st.pos)
+                logits = self._suffix_forward(st.request, st.prefix,
+                                              st.pos, chunk)
+                # charged after the forward: a failed chunk must not
+                # count as spent work
+                self._spend_prefill(chunk, chunks=1)
+                st.pos += chunk
+                if st.pos == len(st.prefix):
+                    self.prefilling.pop(0)
+                    outputs.append(self._activate(
+                        st.request, st.prefix, st.resumed, logits))
+            except Exception as e:
+                logger.exception("chunked prefill of %s failed", rid)
+                # st is still the head on a chunk-forward failure but
+                # was popped when _activate raised — never double-pop
+                if self.prefilling and self.prefilling[0] is st:
+                    self.prefilling.pop(0)
+                self.alloc.release(rid)
+                outputs.append(self._fail_admission(st.request, e))
+            return outputs
+        return self._advance_prefilling_batch(budget)
+
+    def _advance_prefilling_batch(self, budget: int) -> list[StepOutput]:
+        """One batched chunk forward for all prefilling sequences; the
+        step's prefill budget splits evenly across them (≥ 1 each)."""
         take = list(self.prefilling[: self.max_batch_size])
-        chunks = [min(self.prefill_chunk, len(st.prefix) - st.pos)
-                  for st in take]
+        share = max(1, budget // len(take))
+        chunks = [min(share, len(st.prefix) - st.pos) for st in take]
         try:
             logits = self._batched_window_forward(
                 [(st.request, st.prefix[st.pos : st.pos + chunks[i]], st.pos)
@@ -1677,6 +1823,9 @@ class NativeEngine:
                 self.alloc.release(st.request.request_id)
                 outputs.append(self._fail_admission(st.request, e))
             return outputs
+        # charged after the forward: a failed batch must not count as
+        # spent work
+        self._spend_prefill(sum(chunks), chunks=len(take))
         done = []
         for i, st in enumerate(take):
             st.pos += chunks[i]
@@ -1723,6 +1872,7 @@ class NativeEngine:
                 self.alloc.release(request.request_id)
                 outputs.append(self._fail_admission(request, e))
             return outputs
+        self.sched.charge_prefill(sum(len(p) for _, p, _ in items))
         return self._activate_group(
             [(request, prefix, resumed, logits[i : i + 1])
              for i, (request, prefix, resumed) in enumerate(items)])
@@ -1932,7 +2082,15 @@ class NativeEngine:
         run the single-step leg of the same pass and never veto the
         batch.  The decision reads only replicated scheduler state so
         every process of a multi-host lockstep group computes the same
-        span."""
+        span.
+
+        ADMISSION-AWARE: a burst amortizes host round trips exactly when
+        there is nothing else to schedule.  While the wait queue (or any
+        other admission work: mid-chunk prefills, PD-prefilled arrivals,
+        pending cancels) is non-empty, the span clamps to 1 so the next
+        admission pass runs after ONE decode step instead of up to
+        ``burst_steps`` of queue-wait — the burst resumes the moment the
+        queue is dry."""
         k = self.burst_steps
         if k <= 1 or self.spec_k:
             return 1
@@ -1947,11 +2105,33 @@ class NativeEngine:
         if max(st.request.params.max_tokens - st.n_generated
                for st in eligible) < k:
             return 1
+        if self._admission_pending():
+            # counted only when a burst WOULD have dispatched but for
+            # the pending admission work — the clamp metric must track
+            # actual trade-offs, not idle chunk-prefill steps
+            self.sched.burst_clamped_total += 1
+            return 1
         return k
+
+    def _admission_pending(self) -> bool:
+        """Any scheduler work besides decoding the current batch?  All
+        inputs are replicated state (the leader-only future maps are NOT
+        consulted): multi-host processes answer identically.  The
+        single-host ``_cancelled`` read is lock-free by design — a cancel
+        racing this check is caught by the next step's drain."""
+        return bool(
+            self.waiting or self.waiting_prefilled or self.prefilling
+            or self._cancelled or not self._slab_q.empty()
+            or not self._embed_q.empty()
+            or self._pd_pending or self._embed_pending
+        )
 
     def _dispatch_burst(self, ctl_i_dev, ctl_f_dev, page_tables_dev,
                         span: int, mode: str, lora):
         """Dispatch one decode burst (async) → (sampled_dev, next_ctl)."""
+        from fusioninfer_tpu.ops import dispatch
+
+        self.sched.record_span(span)
         self.cache, sampled_dev, self._token_counts, self._output_counts, \
             next_ctl = decode_burst(
                 self.cfg, self.cache_cfg, self.params, self.cache,
@@ -1960,6 +2140,10 @@ class NativeEngine:
                 page_tables_dev,
                 n_steps=span, sample_mode=mode,
                 mesh=self._kernel_mesh, lora=lora,
+                # resolved HERE, outside the jit, so an env-var flip
+                # mid-process retraces instead of silently serving the
+                # stale latched variant (ops/dispatch.py)
+                coalesce=dispatch.decode_coalesce(),
             )
         return sampled_dev, next_ctl
 
@@ -1973,9 +2157,10 @@ class NativeEngine:
         if (not self.pipeline_bursts or self._mh is not None
                 or self.spec_k):
             return False
-        if (self.waiting or self.waiting_prefilled or self.prefilling
-                or self._cancelled or not self._slab_q.empty()
-                or not self._embed_q.empty()):
+        # same predicate as _burst_span's clamp — the two gates enforce
+        # one invariant (a burst never adds queue-wait) and must not
+        # drift as admission sources are added
+        if self._admission_pending():
             return False
         if len(self.running) != len(snapshot):
             return False
@@ -2045,6 +2230,8 @@ class NativeEngine:
                 next_ctl, ctl_f_dev, jnp.asarray(tables), span, mode, lora)
             successor = (s_dev, s_next, ctl_f_dev, dict(snapshot), span,
                          mode, lora)
+            self.sched.dispatch_ahead_total += 1
+        self.sched.charge_decode(span * len(snapshot))
         sampled_all = np.asarray(sampled_dev)  # [span, B] — blocks here
         outputs: list[StepOutput] = []
         for slot, st in snapshot.items():
@@ -2109,9 +2296,23 @@ class NativeEngine:
             adapter_ids[slot] = self._adapter_id(st.request)
 
         lora = self.lora_set.stacked if self.lora_set is not None else None
-        if span > 1:
-            burst_rows = {s: st for s, st in live.items()
-                          if self._row_bursts(st)}
+        # on burst-enabled engines the fused decode+sample path
+        # (decode_burst) runs at EVERY span, including 1: a span-1
+        # "burst" is one fused step (3 control uploads instead of ~14)
+        # whose control carry lets _consume_inflight dispatch step N+1
+        # from the device-side sampled tokens BEFORE fetching step N to
+        # the host — dispatch-ahead pipelining, so host bookkeeping,
+        # detokenization and HTTP streaming overlap device compute even
+        # when admission pressure clamps the span.  Engines configured
+        # classic (burst_steps == 1) keep the legacy per-token path —
+        # and its exact page-extension timing, which the preemption
+        # fixtures pin.  Speculative decoding keeps its own multi-token
+        # path; guided/logprobs/logit_bias rows need host work per token
+        # and take the classic leg below.
+        burst_rows = ({s: st for s, st in live.items()
+                       if self._row_bursts(st)}
+                      if self.burst_steps > 1 and not self.spec_k else {})
+        if burst_rows:
             active_burst = np.zeros((B,), bool)
             active_burst[list(burst_rows)] = True
             # pack every per-row control scalar into one int32 + one
@@ -2220,12 +2421,18 @@ class NativeEngine:
                 repl_w = np.asarray(repl_d)
             logits = logits_w[:, 0]
         else:
+            from fusioninfer_tpu.ops import dispatch as _dispatch
+
             self.cache, logits = decode_step(
                 self.cfg, self.cache_cfg, self.params, self.cache,
                 jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
                 jnp.asarray(active), mesh=self._kernel_mesh,
                 lora=lora,
                 adapter_ids=jnp.asarray(adapter_ids) if lora is not None else None,
+                # eager env-var resolution: a mid-process flip of
+                # FUSIONINFER_DECODE_COALESCE must retrace, not silently
+                # reuse the latched variant (ops/dispatch.py)
+                coalesce=_dispatch.decode_coalesce(),
             )
         # raw-distribution logprobs, computed only when someone asked
         lp_n = max((st.request.params.logprobs or 0 for st in live.values()),
@@ -2287,6 +2494,8 @@ class NativeEngine:
             top_vals = np.asarray(top_lp[0]) if top_lp is not None else None
             top_ids = np.asarray(top_lp[1]) if top_lp is not None else None
 
+        self.sched.charge_decode(
+            len(live) + sum(len(d) for d in spec_drafts.values()))
         outputs = list(failures)
         for slot, st in live.items():
             if argmax_w is not None and slot in spec_drafts:

@@ -173,4 +173,50 @@ class EngineMetrics:
             "# TYPE vllm:e2e_request_latency_seconds histogram",
             *self.e2e_latency.render("vllm:e2e_request_latency_seconds", labels),
         ]
+        lines += self._render_scheduler(engine, labels)
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_scheduler(engine, labels: str) -> list[str]:
+        """Token-budget scheduler families (docs/design/scheduler.md):
+        budget utilization, the scheduler's decision counters, and the
+        adaptive-burst span histogram.  Engines predating the budget
+        scheduler (test stubs) simply omit the families."""
+        sched = getattr(engine, "sched", None)
+        if sched is None:
+            return []
+        lines = [
+            "# HELP fusioninfer:sched_token_budget Configured tokens-per-step budget (0 = unbudgeted).",
+            "# TYPE fusioninfer:sched_token_budget gauge",
+            f"fusioninfer:sched_token_budget{{{labels}}} {sched.tokens_per_step or 0}",
+            "# HELP fusioninfer:sched_budget_utilization Lifetime fraction of budgeted tokens spent (decode + prefill).",
+            "# TYPE fusioninfer:sched_budget_utilization gauge",
+            f"fusioninfer:sched_budget_utilization{{{labels}}} {sched.utilization():.4f}",
+            "# HELP fusioninfer:sched_steps_total Engine scheduler steps executed.",
+            "# TYPE fusioninfer:sched_steps_total counter",
+            f"fusioninfer:sched_steps_total{{{labels}}} {sched.steps_total}",
+            "# HELP fusioninfer:sched_decode_tokens_total Decode tokens charged against the step budget.",
+            "# TYPE fusioninfer:sched_decode_tokens_total counter",
+            f"fusioninfer:sched_decode_tokens_total{{{labels}}} {sched.decode_tokens_total}",
+            "# HELP fusioninfer:sched_prefill_tokens_total Prefill tokens charged against the step budget.",
+            "# TYPE fusioninfer:sched_prefill_tokens_total counter",
+            f"fusioninfer:sched_prefill_tokens_total{{{labels}}} {sched.prefill_tokens_total}",
+            "# HELP fusioninfer:sched_chunks_total Adaptively-sized prefill chunk forwards scheduled.",
+            "# TYPE fusioninfer:sched_chunks_total counter",
+            f"fusioninfer:sched_chunks_total{{{labels}}} {sched.chunks_total}",
+            "# HELP fusioninfer:sched_admission_deferred_total Admissions routed to chunked prefill because the step budget was spent.",
+            "# TYPE fusioninfer:sched_admission_deferred_total counter",
+            f"fusioninfer:sched_admission_deferred_total{{{labels}}} {sched.admission_deferred_total}",
+            "# HELP fusioninfer:sched_burst_clamped_total Decode bursts clamped to span 1 because admission work was pending.",
+            "# TYPE fusioninfer:sched_burst_clamped_total counter",
+            f"fusioninfer:sched_burst_clamped_total{{{labels}}} {sched.burst_clamped_total}",
+            "# HELP fusioninfer:sched_dispatch_ahead_total Successor decode bursts dispatched before the in-flight fetch.",
+            "# TYPE fusioninfer:sched_dispatch_ahead_total counter",
+            f"fusioninfer:sched_dispatch_ahead_total{{{labels}}} {sched.dispatch_ahead_total}",
+            "# HELP fusioninfer:sched_burst_span_steps_total Decode dispatches by fused span (adaptive-burst histogram).",
+            "# TYPE fusioninfer:sched_burst_span_steps_total counter",
+        ]
+        for span, count in sorted(sched.burst_span_steps.items()):
+            lines.append(
+                f'fusioninfer:sched_burst_span_steps_total{{{labels},span="{span}"}} {count}')
+        return lines

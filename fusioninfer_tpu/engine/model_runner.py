@@ -303,6 +303,9 @@ def _decode_step_impl(
     mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
     lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
     adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
+    coalesce: bool = None,  # decode-kernel grid; the ENGINE resolves the
+    # FUSIONINFER_DECODE_COALESCE env var eagerly per call so a
+    # mid-process flip retraces instead of reusing the latched variant
 ):
     """One decode step for the whole running batch → (cache, logits [B, V])."""
     from fusioninfer_tpu.ops import dispatch, paged_decode_attention
@@ -355,14 +358,14 @@ def _decode_step_impl(
                     mesh, q[:, 0], cache["k"], cache["v"], page_tables,
                     lengths, ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window,
+                    window=cfg.sliding_window, coalesce=coalesce,
                 )[:, None, :]
             else:
                 attn = paged_decode_attention(
                     q[:, 0], cache["k"], cache["v"], page_tables, lengths,
                     ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window,
+                    window=cfg.sliding_window, coalesce=coalesce,
                 )[:, None, :]  # [B, 1, H*Hd]
         else:
             # portable path: gather pages [KV, B, mp, ps, Hd] -> [KV, B, T, Hd]
@@ -397,7 +400,7 @@ def _decode_step_impl(
 
 
 decode_step = partial(
-    jax.jit, static_argnums=(0, 1), static_argnames=("mesh",),
+    jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "coalesce"),
     donate_argnums=(3,))(_decode_step_impl)
 
 
@@ -413,7 +416,7 @@ CTL_F_COLS = ("temperature", "top_p", "min_p", "presence", "frequency",
 
 
 @partial(jax.jit, static_argnums=(0, 1),
-         static_argnames=("mesh", "n_steps", "sample_mode"),
+         static_argnames=("mesh", "n_steps", "sample_mode", "coalesce"),
          donate_argnums=(3, 6, 7))
 def decode_burst(
     cfg: ModelConfig,
@@ -430,6 +433,7 @@ def decode_burst(
     sample_mode: str = "filtered",  # static hint, see sampler.sample
     mesh=None,
     lora=None,
+    coalesce: bool = None,  # decode-kernel grid, resolved by the caller
 ):
     """``n_steps`` fused decode+sample steps with on-device token
     feedback → ``(cache, sampled [n_steps, B], token_counts,
@@ -494,7 +498,8 @@ def decode_burst(
         act = active & (pos < max_tokens_covered)
         cache, logits = _decode_step_impl(
             cfg, cache_cfg, params, cache, toks, pos, page_tables, act,
-            mesh=mesh, lora=lora, adapter_ids=adapter_ids)
+            mesh=mesh, lora=lora, adapter_ids=adapter_ids,
+            coalesce=coalesce)
         logits = apply_penalties(logits, tcounts, ocounts,
                                  presence, frequency, repetition)
         logits = jnp.where((gcounts < min_toks)[:, None] & suppress,

@@ -1,0 +1,116 @@
+"""Token-budgeted step scheduling (Sarathi-style stall-free batching).
+
+One :class:`TokenBudget` per engine is the scheduler's ledger: every
+:meth:`NativeEngine.step` gets a budget of tokens it may process, which
+is *first* charged with the running batch's decode tokens; the remainder
+is spent on adaptively-sized prefill chunks.  Chunk size therefore
+shrinks under decode load instead of stalling running streams, and grows
+to the full budget when the batch is idle — replacing the fixed
+``prefill_chunk_size`` / ``prefill_chunks_per_step`` pair (which survive
+as compat aliases that seed the budget: ``budget = chunk × per_step``).
+
+The class is pure bookkeeping — no clocks, no device work — so the
+engine's scheduling decisions stay a deterministic function of
+replicated state (the multi-host SPMD lockstep requirement).  The one
+measurement in this module, :func:`derive_token_budget`, converts a
+MEASURED per-token prefill latency into a tokens/step budget targeting a
+step-time bound; the engine runs the timed forward
+(:meth:`NativeEngine.calibrate_token_budget`) and this function only
+does the arithmetic, so it stays unit-testable without a device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def derive_token_budget(
+    per_token_s: float,
+    target_step_s: float = 0.05,
+    floor: int = 32,
+    cap: int = 4096,
+) -> int:
+    """Tokens/step that keep one step's prefill work under
+    ``target_step_s`` given a measured ``per_token_s`` prefill cost.
+
+    ``floor`` guards against a pathological measurement starving prefill
+    (a budget below the batch size would trickle single tokens);
+    ``cap`` bounds the budget on very fast hosts so a step never
+    monopolizes the device with one enormous chunk anyway.
+    """
+    if per_token_s <= 0.0:
+        return cap
+    return max(floor, min(cap, int(target_step_s / per_token_s)))
+
+
+@dataclass
+class TokenBudget:
+    """Per-step token ledger + lifetime scheduler counters.
+
+    ``tokens_per_step is None`` disables budgeting (monolithic prefill,
+    the library default); the counters still accumulate so /metrics can
+    always report the scheduler's behavior.
+    """
+
+    tokens_per_step: Optional[int] = None
+
+    # lifetime counters (consumed by engine /metrics and the bench)
+    steps_total: int = 0
+    decode_tokens_total: int = 0
+    prefill_tokens_total: int = 0
+    chunks_total: int = 0
+    # requests routed to the chunked-prefill queue because the STEP
+    # budget was spent (not because the prompt exceeded the chunk
+    # threshold) — the admission-smoothing decision counter
+    admission_deferred_total: int = 0
+    # decode bursts clamped to span 1 because admission work was pending
+    burst_clamped_total: int = 0
+    # successor bursts dispatched BEFORE the in-flight fetch (the
+    # dispatch-ahead pipelining counter)
+    dispatch_ahead_total: int = 0
+    # adaptive-burst histogram: dispatched span -> dispatch count
+    burst_span_steps: dict = field(default_factory=dict)
+
+    def begin_step(self, decode_charge: int) -> int:
+        """Open a step's ledger: charge the running batch's decode
+        tokens first and return the PREFILL remainder.  With no budget
+        configured the remainder is unbounded (monolithic semantics)."""
+        self.steps_total += 1
+        if self.tokens_per_step is None:
+            return 1 << 30
+        return max(0, self.tokens_per_step - decode_charge)
+
+    def charge_decode(self, n: int) -> None:
+        self.decode_tokens_total += n
+
+    def charge_prefill(self, n: int, chunks: int = 0) -> None:
+        self.prefill_tokens_total += n
+        self.chunks_total += chunks
+
+    def record_span(self, span: int) -> None:
+        self.burst_span_steps[span] = self.burst_span_steps.get(span, 0) + 1
+
+    def utilization(self) -> float:
+        """Lifetime fraction of budgeted tokens actually spent (0 when
+        no budget is configured or no step has run)."""
+        if not self.tokens_per_step or not self.steps_total:
+            return 0.0
+        spent = self.decode_tokens_total + self.prefill_tokens_total
+        return min(1.0, spent / (self.tokens_per_step * self.steps_total))
+
+    def snapshot(self) -> dict:
+        """JSON-ready view for bench records and debugging."""
+        return {
+            "token_budget": self.tokens_per_step or 0,
+            "steps": self.steps_total,
+            "decode_tokens": self.decode_tokens_total,
+            "prefill_tokens": self.prefill_tokens_total,
+            "chunks": self.chunks_total,
+            "admission_deferred": self.admission_deferred_total,
+            "burst_clamped": self.burst_clamped_total,
+            "dispatch_ahead": self.dispatch_ahead_total,
+            "burst_span_steps": {str(k): v for k, v in
+                                 sorted(self.burst_span_steps.items())},
+            "budget_utilization": round(self.utilization(), 4),
+        }

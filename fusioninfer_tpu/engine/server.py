@@ -1840,16 +1840,31 @@ def serve_from_args(args) -> int:
         kv_dtype="int8" if kv_dtype == "int8" else "model",
     )
     logger.info("cache: %d pages of %d tokens", cache_cfg.n_pages, cache_cfg.page_size)
+    no_budget = getattr(args, "no_token_budget", False)
+    tokens_per_step = _nonneg_flag(args, "tokens_per_step")
     engine = NativeEngine(
         cfg, cache_cfg=cache_cfg, max_batch_size=args.max_batch_size, seed=args.seed,
         mesh=mesh, params=params,
         enable_prefix_caching=not getattr(args, "no_prefix_caching", False),
         lora_adapters=lora_adapters or None,
         prefill_chunk_size=_nonneg_flag(args, "prefill_chunk_size"),
+        token_budget=None if no_budget else tokens_per_step,
         speculative_k=_nonneg_flag(args, "speculative_ngram"),
         decode_burst_steps=max(1, getattr(args, "decode_burst", 8) or 1),
         pipeline_bursts=not getattr(args, "no_decode_pipeline", False),
     )
+    if not no_budget and engine.token_budget is None:
+        # --tokens-per-step 0 (the default): derive the budget from a
+        # MEASURED prefill forward on the engine's compiled path so the
+        # shipped serving config bounds per-step prefill work out of the
+        # box.  Multi-process meshes must not calibrate (per-process
+        # timing skew would diverge the SPMD lockstep): fixed default.
+        if engine.is_multihost:
+            engine.set_token_budget(512)
+        else:
+            budget = engine.calibrate_token_budget()
+            logger.info("token budget derived from measured step latency: "
+                        "%d tokens/step", budget)
     server = EngineServer(
         model=model_name,
         host=args.host,

@@ -64,8 +64,15 @@ def decode_coalesce() -> bool:
     Default True: measured on the v5e chip (readback-synced, Qwen3-1.7B
     batch 32), coalescing decodes +10% at ~200-token contexts and +28%
     at ragged 256..1850-token contexts (full-model tok/s, rel_iqr ≤3%).
-    ``FUSIONINFER_DECODE_COALESCE=0/1`` overrides; read at trace time and
-    latched into the jit cache like the rest of dispatch."""
+    ``FUSIONINFER_DECODE_COALESCE=0/1`` overrides.  The ENGINE resolves
+    this eagerly at every decode dispatch and passes the concrete bool
+    into the jitted step as a static argument — flipping the env var
+    mid-process therefore retraces and takes effect, instead of the jit
+    cache silently serving the variant latched at first trace (the
+    pre-round-6 behavior).  The coalesced grid additionally falls back
+    to the per-head grid when its double-buffered scratch would exceed
+    the conservative VMEM budget
+    (:func:`fusioninfer_tpu.ops.paged_attention.coalesce_fits_vmem`)."""
     v = os.environ.get("FUSIONINFER_DECODE_COALESCE", "")
     if not v:
         return True

@@ -106,6 +106,41 @@ def _split_rest(rest, quantized):
     return None, o_ref, k_buf, v_buf, None, sem
 
 
+# conservative VMEM ceiling for the coalesced grid's double-buffered
+# page scratch: VMEM is ~16 MiB/core on current TPU generations (pallas
+# guide), and the kernel also needs its q/out blocks plus compiler
+# temporaries — so the scratch may take at most half.  Oversized
+# configurations (huge page_size × Hd × KV products) fall back to the
+# per-head grid, whose per-slot scratch is KV× smaller, instead of
+# failing Mosaic allocation at trace time.
+_COALESCE_VMEM_SCRATCH_BUDGET = 8 * 1024 * 1024
+
+
+def coalesced_scratch_bytes(page_size: int, Hd: int, kv_heads: int,
+                            k_dtype, v_dtype, quantized: bool) -> int:
+    """Bytes of VMEM scratch the coalesced grid allocates: two slots of
+    ``[KV, ps, Hd]`` K and V page buffers (+ two f32 ``[KV, 1, ps]``
+    scale rows per slot when the cache is int8)."""
+    per_slot = kv_heads * page_size * Hd * (
+        jnp.dtype(k_dtype).itemsize + jnp.dtype(v_dtype).itemsize)
+    if quantized:
+        per_slot += 2 * kv_heads * page_size * jnp.dtype(jnp.float32).itemsize
+    return 2 * per_slot
+
+
+def coalesce_fits_vmem(page_size: int, Hd: int, kv_heads: int,
+                       k_dtype, v_dtype, quantized: bool,
+                       budget: int | None = None) -> bool:
+    """True when the coalesced grid's double-buffered scratch fits the
+    conservative VMEM budget; callers fall back to the per-head grid
+    otherwise.  ``budget`` resolves at CALL time so tests (and future
+    per-generation tables) can tune the module default."""
+    if budget is None:
+        budget = _COALESCE_VMEM_SCRATCH_BUDGET
+    return coalesced_scratch_bytes(
+        page_size, Hd, kv_heads, k_dtype, v_dtype, quantized) <= budget
+
+
 def _page_specs_scratch(page_size, Hd, k_dtype, v_dtype, quantized,
                         heads: int | None = None):
     """(in_specs for page operands, scratch shapes) shared by ALL the
@@ -369,6 +404,12 @@ def paged_decode_attention(
         from fusioninfer_tpu.ops import dispatch
 
         coalesce = dispatch.decode_coalesce()
+    if coalesce and not coalesce_fits_vmem(
+            page_size, Hd, KV, k_pages.dtype, v_pages.dtype, quantized):
+        # the coalesced double-buffered scratch would blow the VMEM
+        # budget at this (KV, page_size, Hd): take the per-head grid
+        # (KV× smaller slots) instead of failing Mosaic allocation
+        coalesce = False
 
     qg = q.reshape(B, KV, G, Hd)
 

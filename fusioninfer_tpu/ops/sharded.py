@@ -87,6 +87,7 @@ def paged_decode_attention_tp(
     *,
     interpret: bool = False,
     window: int | None = None,
+    coalesce: bool | None = None,  # resolved by the engine per call
     layer: jax.Array | int | None = None,
 ) -> jax.Array:
     """Per-shard paged decode attention → [B, H·Hd] sharded on features."""
@@ -109,7 +110,7 @@ def paged_decode_attention_tp(
         ks, vs = scales if scales else (None, None)
         return paged_decode_attention(q, kp, vp, pt, ln, ks, vs,
                                       interpret=interpret, window=window,
-                                      layer=l)
+                                      coalesce=coalesce, layer=l)
 
     fn = shard_map(
         run,

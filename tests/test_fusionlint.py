@@ -200,6 +200,29 @@ class TestResiliencePass:
         assert any(p.rstrip("/").endswith("autoscale")
                    for p in fl_config.WALL_CLOCK_PACKAGES)
 
+    def test_wall_clock_exact_module_key(self, tmp_path):
+        """A key may name one module exactly (the token-budget scheduler
+        is a single file, not a package — PR 4); sibling modules in the
+        same directory stay uncovered."""
+        src = """\
+            import time
+
+            def tick():
+                return time.time()
+        """
+        covered = ResiliencePass(
+            wall_clock_packages={str(tmp_path / "fixture.py"):
+                                 ("time", "sleep")})
+        assert rules_of(lint(tmp_path, src, [covered])) == ["wall-clock"]
+        sibling = ResiliencePass(
+            wall_clock_packages={str(tmp_path / "other.py"):
+                                 ("time", "sleep")})
+        assert lint(tmp_path, src, [sibling]).findings == []
+
+    def test_repo_config_covers_scheduler_module(self):
+        assert "fusioninfer_tpu/engine/sched.py" in \
+            fl_config.WALL_CLOCK_PACKAGES
+
 
 # ---------------------------------------------------------- lock-discipline
 

@@ -162,3 +162,90 @@ def test_stacked_requires_layer():
     with pytest.raises(ValueError, match="only applies"):
         paged_decode_attention(q, kp, vp, tables, lengths,
                                interpret=True, layer=0)
+
+
+class TestCoalesceVmemGuard:
+    """The coalesced grid's double-buffered [2, KV, ps, Hd] scratch must
+    fit a conservative VMEM budget; oversized configurations fall back
+    to the per-head grid instead of failing Mosaic allocation."""
+
+    def test_scratch_bytes_math(self):
+        from fusioninfer_tpu.ops.paged_attention import coalesced_scratch_bytes
+
+        # 2 slots x KV=2 heads x 16 x 64 x (4 + 4) bytes f32 K+V
+        assert coalesced_scratch_bytes(16, 64, 2, jnp.float32, jnp.float32,
+                                       quantized=False) == 2 * 2 * 16 * 64 * 8
+        # int8 adds two f32 [1, ps] scale rows per head per slot
+        q8 = coalesced_scratch_bytes(16, 64, 2, jnp.int8, jnp.int8,
+                                     quantized=True)
+        assert q8 == 2 * (2 * 16 * 64 * 2 + 2 * 2 * 16 * 4)
+
+    def test_fits_vmem_boundary(self):
+        from fusioninfer_tpu.ops.paged_attention import coalesce_fits_vmem
+
+        assert coalesce_fits_vmem(128, 128, 8, jnp.bfloat16, jnp.bfloat16,
+                                  quantized=False)  # the serving shape
+        # a pathological KV x ps x Hd product must NOT coalesce
+        assert not coalesce_fits_vmem(2048, 256, 32, jnp.float32,
+                                      jnp.float32, quantized=False)
+        # explicit budget override for unit determinism
+        assert not coalesce_fits_vmem(16, 64, 2, jnp.float32, jnp.float32,
+                                      quantized=False, budget=1024)
+
+    def test_oversized_request_falls_back_to_per_head_grid(self, monkeypatch):
+        """coalesce=True with an over-budget scratch must route to the
+        per-head kernel (observable: the coalesced body is never
+        entered) and still produce oracle-exact output."""
+        from fusioninfer_tpu.ops import paged_attention as pa
+
+        def bomb(*a, **k):
+            raise AssertionError("coalesced kernel entered despite "
+                                 "over-budget scratch")
+
+        monkeypatch.setattr(pa, "_paged_kernel_coalesced", bomb)
+        monkeypatch.setattr(pa, "_COALESCE_VMEM_SCRATCH_BUDGET", 1024)
+        q, kp, vp, tables, lengths = _setup()
+        out = pa.paged_decode_attention.__wrapped__(
+            q, kp, vp, tables, lengths, interpret=True, coalesce=True)
+        ref = reference_paged_attention(q, kp, vp, tables, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestEagerCoalesceResolution:
+    """Flipping FUSIONINFER_DECODE_COALESCE mid-process must take effect:
+    the engine resolves the env var OUTSIDE the jitted step and passes
+    the concrete bool as a static argument, so the flip retraces instead
+    of silently reusing the latched variant (ADVICE r5)."""
+
+    def test_decode_step_takes_coalesce_static(self, monkeypatch):
+        from fusioninfer_tpu.engine.engine import NativeEngine, Request
+        from fusioninfer_tpu.engine.sampler import SamplingParams
+        from fusioninfer_tpu.engine.kv_cache import CacheConfig
+        from fusioninfer_tpu.models.config import get_preset
+
+        engine = NativeEngine(
+            get_preset("qwen3-tiny"),
+            cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                  max_pages_per_seq=4),
+            max_batch_size=2)
+        engine.add_request(Request("a", [2, 4], SamplingParams(
+            max_tokens=6, temperature=0.0)))
+        outs = []
+        monkeypatch.setenv("FUSIONINFER_DECODE_COALESCE", "1")
+        for _ in range(3):
+            outs += engine.step()
+        # flip mid-stream: the next step resolves the new value eagerly
+        monkeypatch.setenv("FUSIONINFER_DECODE_COALESCE", "0")
+        while engine.has_work():
+            outs += engine.step()
+        toks = [o.token for o in outs if o.request_id == "a"]
+        # both grids compute identical math: the stream is unbroken
+        assert len(toks) == 6
+
+    def test_bad_env_value_raises(self, monkeypatch):
+        from fusioninfer_tpu.ops import dispatch
+
+        monkeypatch.setenv("FUSIONINFER_DECODE_COALESCE", "yes")
+        with pytest.raises(ValueError):
+            dispatch.decode_coalesce()

@@ -1,0 +1,65 @@
+"""Assert the bench record carries the serving-path-gap evidence fields.
+
+The CPU bench smoke (``make bench-smoke``, CI's "bench smoke" step) runs
+``bench.py`` and then this checker against the sidecar record: the
+``http`` leg must report ``ceiling_fraction`` (HTTP output tok/s over
+the same-config raw decode tok/s) and the token-budget scheduler's
+fields (``scheduler.token_budget`` etc., see engine/sched.py) plus the
+TTFT decomposition's ``queue_wait_ms`` — so a regression that silently
+drops the scheduling evidence fails CI instead of shipping a blind
+record.  Usage: ``python tools/check_bench_record.py [BENCH_OUT.json]``.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+
+def check_record(record: dict) -> list[str]:
+    """Return the list of missing-field complaints (empty = pass)."""
+    problems: list[str] = []
+    http = record.get("http")
+    if not isinstance(http, dict):
+        if record.get("error"):
+            problems.append(f"bench errored: {record['error']}")
+        # else: a decode-only run (BENCH_SKIP_HTTP=1) is exempt — there
+        # is no http leg to assert against
+        return problems
+    if "ceiling_fraction" not in http:
+        problems.append("http.ceiling_fraction missing")
+    sched = http.get("scheduler")
+    if not isinstance(sched, dict):
+        problems.append("http.scheduler missing")
+    else:
+        for field in ("token_budget", "budget_utilization",
+                      "burst_span_steps", "burst_clamped"):
+            if field not in sched:
+                problems.append(f"http.scheduler.{field} missing")
+    if "queue_wait_ms" not in http:
+        problems.append("http.queue_wait_ms (TTFT decomposition) missing")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    path = pathlib.Path(argv[1]) if len(argv) > 1 else (
+        pathlib.Path(__file__).resolve().parent.parent / "BENCH_OUT.json")
+    try:
+        record = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"check_bench_record: cannot read {path}: {e}",
+              file=sys.stderr)
+        return 2
+    problems = check_record(record)
+    if problems:
+        for p in problems:
+            print(f"check_bench_record: {p}", file=sys.stderr)
+        return 1
+    print(f"check_bench_record: {path.name} carries ceiling_fraction + "
+          "scheduler budget fields")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

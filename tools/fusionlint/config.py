@@ -17,13 +17,19 @@ DEFAULT_TARGETS = [
 
 # -- resilience pass ---------------------------------------------------
 
-# package prefix -> names banned as direct `time.X()` calls (and as
-# `from time import X` aliases) inside it.  Control loops listed here
-# must take an injected clock so chaos/e2e suites drive them
-# deterministically; `time.monotonic` as a default ARGUMENT is fine,
-# calling it inline is not.  Pacing belongs to `Event.wait`.
+# package prefix (or exact module path) -> names banned as direct
+# `time.X()` calls (and as `from time import X` aliases) inside it.
+# Control loops listed here must take an injected clock so chaos/e2e
+# suites drive them deterministically; `time.monotonic` as a default
+# ARGUMENT is fine, calling it inline is not.  Pacing belongs to
+# `Event.wait`.
 WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     "fusioninfer_tpu/autoscale": ("time", "sleep"),
+    # the token-budget scheduler must stay a pure function of replicated
+    # scheduler state (SPMD lockstep): no wall clocks, no sleeps —
+    # latency measurement lives engine-side (calibrate_token_budget)
+    # and uses perf_counter explicitly, never time()/sleep()
+    "fusioninfer_tpu/engine/sched.py": ("time", "sleep"),
 }
 
 # -- lock-discipline pass ----------------------------------------------

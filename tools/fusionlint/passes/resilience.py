@@ -56,7 +56,11 @@ class ResiliencePass(LintPass):
 
     def _banned_names(self, mod: Module) -> tuple[str, ...]:
         for prefix, banned in self.wall_clock_packages.items():
-            if mod.rel.startswith(prefix.rstrip("/") + "/"):
+            # a key may name a package (prefix match) or one module
+            # exactly (the scheduler lives in a single file, not its own
+            # package — PR 4)
+            if mod.rel == prefix or mod.rel.startswith(
+                    prefix.rstrip("/") + "/"):
                 return banned
         return ()
 
