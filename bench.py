@@ -700,7 +700,8 @@ def run_admissions(cfg, cache_cfg, max_batch_size: int = 8,
 def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
              concurrency: int, max_prompt: int, max_output: int,
              prefill_chunk: int | None = None,
-             shared_prefix_len: int = 0) -> dict:
+             shared_prefix_len: int = 0,
+             decode_burst_default: int = 8) -> dict:
     from fusioninfer_tpu.benchmark.loadgen import run_http_load
     from fusioninfer_tpu.engine.engine import NativeEngine
     from fusioninfer_tpu.engine.server import EngineServer
@@ -715,9 +716,19 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
                           # production default (cli.py --decode-burst): on a
                           # remote-attached chip the host round trip per
                           # decode step dominates serving throughput.
-                          # 0 = off (classic stepping), like the CLI
+                          # 0 = off (classic stepping), like the CLI.
+                          # The CPU smoke passes decode_burst_default=1 so
+                          # the fused mixed-batch path (burst-1 engines)
+                          # runs default-on there; BENCH_DECODE_BURST
+                          # still pins either config for an A/B
                           decode_burst_steps=max(1, int(os.environ.get(
-                              "BENCH_DECODE_BURST", "8") or 8)))
+                              "BENCH_DECODE_BURST", "")
+                              or decode_burst_default)),
+                          # fused mixed-batch stepping (one weight pass
+                          # for decode + prefill chunks); BENCH_FUSED_STEP=0
+                          # restores the split dispatch for an A/B
+                          fused_step=os.environ.get(
+                              "BENCH_FUSED_STEP", "1") != "0")
     srv = EngineServer(
         model=cfg.name, host="127.0.0.1", port=0, engine=engine,
     )
@@ -775,10 +786,16 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
         )
         out = result.summary(n_chips=1)
         out["decode_burst"] = engine.burst_steps
+        out["fused_step"] = engine.fused_step_enabled
         out["warmed"] = True  # compiles excluded from the window
         # token-budget scheduler evidence: budget, utilization, decision
         # counters and the adaptive-burst span histogram (engine/sched.py)
         out["scheduler"] = engine.sched.snapshot()
+        # serving-path-gap evidence: weight-streaming forwards per step
+        # (1.0 = every step is one weight pass, the fused-step target;
+        # ≥ 2 is the split prefill+decode dispatch under mixed load)
+        out["weight_passes_per_step"] = round(
+            engine.sched.weight_passes_per_step(), 4)
         if shared_prefix_len:
             out["shared_prefix_len"] = shared_prefix_len
         # TTFT decomposition: server-side queue-wait (arrival → admission
@@ -878,6 +895,18 @@ def main() -> None:
             record["metric"] = "decode_throughput_tiny_cpu"
 
         decode: dict = {}
+        # interpretability anchor for every kernel-vs-gather speedup in
+        # this record (ADVICE r5 #4): the portable gather baseline pays a
+        # per-layer dynamic-slice of the stacked KV pool
+        # (model_runner._cache_layer) before its cache[page_tables]
+        # gather, while the Pallas kernels read the stacked pools in
+        # place via their layer operand — cross-round speedup deltas
+        # must be read against that baseline definition, not as pure
+        # attention-kernel wins
+        decode["gather_baseline_note"] = (
+            "gather baseline includes a per-layer dynamic-slice of the "
+            "stacked KV pool (model_runner._cache_layer); kernels read "
+            "pages in place via their layer operand")
         tok_s = 0.0
         impl_used = None
         if on_tpu:
@@ -1079,11 +1108,15 @@ def main() -> None:
                 http_cache = CacheConfig(n_pages=8 * 4 + 1, page_size=64,
                                          max_pages_per_seq=4)
                 chunk = 64
+                # burst 1 on CPU: there is no host↔device tunnel to
+                # amortize, and burst-1 engines run the fused
+                # mixed-batch step default-on — the smoke then gates
+                # weight_passes_per_step ≈ 1 under mixed load
                 record["http"] = run_http(
                     http_cfg, max_batch_size=8, cache_cfg=http_cache,
                     n_requests=12, concurrency=4,
                     max_prompt=128, max_output=32,
-                    prefill_chunk=chunk,
+                    prefill_chunk=chunk, decode_burst_default=1,
                 )
                 record["http"]["prefill_chunk"] = chunk
                 # prefix-cache-hit mix: shared 96-token prefix across
@@ -1093,6 +1126,7 @@ def main() -> None:
                     n_requests=8, concurrency=4,
                     max_prompt=128, max_output=32,
                     prefill_chunk=chunk, shared_prefix_len=96,
+                    decode_burst_default=1,
                 )
             # decode-ceiling fraction: HTTP output tok/s/chip over the
             # SAME-config raw decode tok/s — the serving-path-gap metric

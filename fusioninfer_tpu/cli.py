@@ -245,6 +245,15 @@ def build_parser() -> argparse.ArgumentParser:
                             "(dispatching the next burst before the "
                             "current one's fetch, hiding the host-device "
                             "round trip in steady state)")
+    serve.add_argument("--fused-step", action=argparse.BooleanOptionalAction,
+                       default=True,
+                       help="fuse each step's decode rows and budgeted "
+                            "prefill-chunk rows into ONE forward so the "
+                            "weights stream from HBM once per step "
+                            "(--no-fused-step restores the split "
+                            "prefill-then-decode dispatch).  Burst engines "
+                            "(--decode-burst > 1) keep the split "
+                            "dispatch-ahead path either way")
     serve.add_argument("--dtype", default="",
                        help="override the model compute dtype (e.g. float32 "
                             "for exact cross-sharding equivalence checks)")

@@ -53,9 +53,11 @@ from fusioninfer_tpu.engine.kv_cache import (
     PageAllocator,
     init_kv_cache,
 )
+from fusioninfer_tpu.engine.fused import pack_mixed_batch
 from fusioninfer_tpu.engine.model_runner import (
     decode_burst,
     decode_step,
+    fused_step,
     pick_bucket,
     prefill,
     prefill_buckets,
@@ -259,6 +261,7 @@ class NativeEngine:
         token_byte_table=None,
         decode_burst_steps: int = 1,
         pipeline_bursts: bool = True,
+        fused_step: bool = True,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -308,7 +311,18 @@ class NativeEngine:
         speculation on or off; sampled (temperature>0) rows speculate
         via delta-draft rejection sampling — distribution-exact and
         deterministic per (seed, speculation config).  Penalized /
-        logprobs requests in the same batch run unspeculated (drafts=0)."""
+        logprobs requests in the same batch run unspeculated (drafts=0).
+
+        ``fused_step``: when a step has BOTH decode work and budgeted
+        prefill-chunk work, pack them into ONE forward
+        (:func:`model_runner.fused_step`) so the weights stream from HBM
+        once per step instead of once per row-kind — decode is
+        weight-bandwidth-bound, so the chunk rows ride nearly free.
+        Greedy output streams are bit-identical with the flag on or off.
+        Burst-enabled engines (``decode_burst_steps > 1``) keep the
+        classic split dispatch either way: their span-1 fused
+        decode+sample path carries the dispatch-ahead control chain the
+        mixed-batch forward cannot."""
         self.cfg = cfg.validate()
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
@@ -497,6 +511,9 @@ class NativeEngine:
         # unpipelined bursting.
         self.pipeline_bursts = pipeline_bursts
         self._inflight = None
+        # fused mixed-batch stepping (decode + prefill chunks in one
+        # weight pass); burst engines keep the split dispatch-ahead path
+        self.fused_step_enabled = fused_step
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
         # guided decoding (response_format json_object/json_schema):
@@ -863,6 +880,7 @@ class NativeEngine:
                 jnp.asarray([len(prefix)], jnp.int32), row,
                 mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
             )
+            self.sched.charge_weight_pass()
             # guided requests mask the FIRST token here on the
             # prefiller — the decode side replays it through its own
             # machine at admission (both roles serve the same model, so
@@ -1170,8 +1188,13 @@ class NativeEngine:
                               if st.n_generated
                               < st.request.params.max_tokens))
             outputs += self._admit()
-            outputs += self._advance_prefilling()
-            outputs += self._decode()
+            if self._use_fused_step():
+                # both row kinds exist: ONE weight pass covers this
+                # step's decode rows and its budgeted prefill chunks
+                outputs += self._fused_step()
+            else:
+                outputs += self._advance_prefilling()
+                outputs += self._decode()
         finally:
             self._in_step_body = False
             self._last_step_end = time.monotonic()
@@ -1654,6 +1677,7 @@ class NativeEngine:
             jnp.asarray(padded), jnp.int32(start), jnp.int32(length), row,
             mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
         )
+        self.sched.charge_weight_pass()
         return logits
 
     def _prefill_suffix_one(self, request: Request, prefix: list[int],
@@ -1696,6 +1720,7 @@ class NativeEngine:
             adapter_ids=jnp.asarray(ids) if lora is not None else None,
             last_only=True,
         )
+        self.sched.charge_weight_pass()
         return logits
 
     def _prefill_suffix_batch(
@@ -1872,6 +1897,7 @@ class NativeEngine:
                 self.alloc.release(request.request_id)
                 outputs.append(self._fail_admission(request, e))
             return outputs
+        self.sched.charge_weight_pass()
         self.sched.charge_prefill(sum(len(p) for _, p, _ in items))
         return self._activate_group(
             [(request, prefix, resumed, logits[i : i + 1])
@@ -2132,6 +2158,8 @@ class NativeEngine:
         from fusioninfer_tpu.ops import dispatch
 
         self.sched.record_span(span)
+        # a span-k burst scans the layer stack k times: k weight streams
+        self.sched.charge_weight_pass(span)
         self.cache, sampled_dev, self._token_counts, self._output_counts, \
             next_ctl = decode_burst(
                 self.cfg, self.cache_cfg, self.params, self.cache,
@@ -2250,6 +2278,152 @@ class NativeEngine:
             self._inflight = successor
         return outputs
 
+    def _use_fused_step(self) -> bool:
+        """One dispatch for this step's decode AND chunk work?  True only
+        when both row kinds exist on a fused-enabled classic engine —
+        burst engines (``burst_steps > 1``) keep the split path: their
+        span-1 fused decode+sample dispatch carries the dispatch-ahead
+        control chain the mixed-batch forward cannot.  Reads only
+        replicated scheduler state, so every process of a multi-host
+        lockstep group answers identically."""
+        return (self.fused_step_enabled and self.burst_steps == 1
+                and self._inflight is None
+                and bool(self.prefilling)
+                and any(st.n_generated < st.request.params.max_tokens
+                        for st in self.running.values()))
+
+    def _fused_step(self) -> list[StepOutput]:
+        """Advance every mid-prefill sequence one budgeted chunk AND
+        decode the running batch in ONE weight pass
+        (:func:`model_runner.fused_step`): rows 0..B-1 are the decode
+        slots (spec windows included), rows B.. the chunk windows, so
+        the fused logits' first B rows feed the exact split-path
+        sampling tail and the chunk rows' last-token logits feed
+        activation.  Emission order matches the split path — chunk
+        activations first, then decode tokens.  A forward failure fails
+        the chunk rows (``_advance_prefilling_batch`` semantics) and
+        re-dispatches decode split for this step."""
+        failures, _ = self._ensure_decode_capacity(1)
+        live = {s: st for s, st in self.running.items()
+                if st.n_generated < st.request.params.max_tokens}
+        take = list(self.prefilling[: self.max_batch_size])
+        if not live or not take:
+            # capacity pressure preempted one row kind away since the
+            # step() gate: run the split halves (each no-ops if empty)
+            return failures + self._advance_prefilling() + self._decode()
+        B = self.max_batch_size
+        budget = self._chunk_budget()
+        share = max(1, budget // len(take))
+        chunks = [min(share, len(st.prefix) - st.pos) for st in take]
+        ctl = self._decode_controls(live)
+        lora = ctl["lora"]
+        spec_drafts = self._propose_drafts(live, ctl) if self.spec_k else {}
+        if self.spec_k:
+            window, counts_w = self._spec_window(live, spec_drafts)
+        else:
+            window = ctl["tokens"][:, None]  # [B, 1] — single-query rows
+            counts_w = ctl["active"].astype(np.int32)
+        entries = [
+            (st.prefix[st.pos: st.pos + chunks[i]], st.pos,
+             self.alloc.page_table_row(st.request.request_id),
+             self._adapter_id(st.request))
+            for i, st in enumerate(take)
+        ]
+        bucket = pick_bucket(self.buckets,
+                             max(window.shape[1], max(chunks)))
+        packed = pack_mixed_batch(
+            window, counts_w, ctl["positions"], ctl["page_tables"],
+            ctl["adapter_ids"], entries, bucket, self.cache_cfg.trash_page)
+        try:
+            self.cache, logits_f = fused_step(
+                self.cfg, self.cache_cfg, self.params, self.cache,
+                jnp.asarray(packed.tokens), jnp.asarray(packed.starts),
+                jnp.asarray(packed.counts), jnp.asarray(packed.page_tables),
+                jnp.asarray(packed.sel), mesh=self._kernel_mesh, lora=lora,
+                adapter_ids=(jnp.asarray(packed.adapter_ids)
+                             if lora is not None else None),
+            )
+        except Exception as e:
+            logger.exception("fused mixed-batch step of %d chunks failed",
+                             len(take))
+            outputs = list(failures)
+            for st in take:
+                if st in self.prefilling:
+                    self.prefilling.remove(st)
+                self.alloc.release(st.request.request_id)
+                outputs.append(self._fail_admission(st.request, e))
+            # decode rows were untouched by the failed dispatch: serve
+            # them through the classic split decode this step
+            return outputs + self._decode()
+        self.sched.charge_weight_pass()
+        self.sched.record_fused(packed.packed_tokens)
+        # chunk bookkeeping mirrors _advance_prefilling_batch: charged
+        # after the forward, completed prefills activate into their
+        # reserved slots off their chunk row's last-token logits
+        self._spend_prefill(sum(chunks), chunks=len(take))
+        done = []
+        for i, st in enumerate(take):
+            st.pos += chunks[i]
+            if st.pos == len(st.prefix):
+                self.prefilling.remove(st)
+                done.append((st.request, st.prefix, st.resumed,
+                             logits_f[B + i][:1]))
+        outputs = list(failures)
+        if done:
+            outputs += self._activate_group(done)
+        # decode sampling/spec-verify off the slot-aligned first B rows
+        spec = (self._spec_draws(logits_f[:B], window, ctl, spec_drafts)
+                if self.spec_k else None)
+        return outputs + self._decode_finish(live, logits_f[:B, 0], ctl,
+                                             spec_drafts, spec, [])
+
+    def _decode_controls(self, live: dict) -> dict:
+        """Per-slot numpy control arrays for a decode pass (split or
+        fused): one entry per batch slot, trash/zero for dead slots."""
+        B = self.max_batch_size
+        mp = self.cache_cfg.max_pages_per_seq
+        ctl = {
+            "tokens": np.zeros((B,), np.int32),
+            "positions": np.zeros((B,), np.int32),
+            "page_tables": np.full((B, mp), self.cache_cfg.trash_page,
+                                   np.int32),
+            "active": np.zeros((B,), bool),
+            "temps": np.zeros((B,), np.float32),
+            "top_ks": np.zeros((B,), np.int32),
+            "top_ps": np.ones((B,), np.float32),
+            "min_ps": np.zeros((B,), np.float32),
+            "presence": np.zeros((B,), np.float32),
+            "frequency": np.zeros((B,), np.float32),
+            "repetition": np.ones((B,), np.float32),
+            "min_toks": np.zeros((B,), np.int32),
+            "gen_counts": np.zeros((B,), np.int32),
+            "seeds": np.zeros((B,), np.uint32),
+            "adapter_ids": np.zeros((B,), np.int32),
+        }
+        for slot, st in live.items():
+            ctl["tokens"][slot] = st.tokens[-1]
+            # the input token was sampled last step but its KV is not yet
+            # written; it lands at index len-1 (cache holds tokens[0..len-2])
+            ctl["positions"][slot] = len(st.tokens) - 1
+            ctl["page_tables"][slot] = self.alloc.page_table_row(
+                st.request.request_id)
+            ctl["active"][slot] = True
+            p = st.request.params
+            ctl["temps"][slot] = p.temperature
+            ctl["top_ks"][slot] = p.top_k
+            ctl["top_ps"][slot] = p.top_p
+            ctl["min_ps"][slot] = p.min_p
+            ctl["presence"][slot] = p.presence_penalty
+            ctl["frequency"][slot] = p.frequency_penalty
+            ctl["repetition"][slot] = p.repetition_penalty
+            ctl["min_toks"][slot] = p.min_tokens
+            ctl["gen_counts"][slot] = st.n_generated
+            ctl["seeds"][slot] = st.seed
+            ctl["adapter_ids"][slot] = self._adapter_id(st.request)
+        ctl["lora"] = (self.lora_set.stacked
+                       if self.lora_set is not None else None)
+        return ctl
+
     def _decode(self) -> list[StepOutput]:
         if self._inflight is not None:
             return self._consume_inflight()
@@ -2259,43 +2433,8 @@ class NativeEngine:
         if not live:
             return failures
         B = self.max_batch_size
-        mp = self.cache_cfg.max_pages_per_seq
-        tokens = np.zeros((B,), np.int32)
-        positions = np.zeros((B,), np.int32)
-        page_tables = np.full((B, mp), self.cache_cfg.trash_page, np.int32)
-        active = np.zeros((B,), bool)
-        temps = np.zeros((B,), np.float32)
-        top_ks = np.zeros((B,), np.int32)
-        top_ps = np.ones((B,), np.float32)
-        min_ps = np.zeros((B,), np.float32)
-        presence = np.zeros((B,), np.float32)
-        frequency = np.zeros((B,), np.float32)
-        repetition = np.ones((B,), np.float32)
-        min_toks = np.zeros((B,), np.int32)
-        gen_counts = np.zeros((B,), np.int32)
-        seeds = np.zeros((B,), np.uint32)
-        adapter_ids = np.zeros((B,), np.int32)
-        for slot, st in live.items():
-            tokens[slot] = st.tokens[-1]
-            # the input token was sampled last step but its KV is not yet
-            # written; it lands at index len-1 (cache holds tokens[0..len-2])
-            positions[slot] = len(st.tokens) - 1
-            page_tables[slot] = self.alloc.page_table_row(st.request.request_id)
-            active[slot] = True
-            p = st.request.params
-            temps[slot] = p.temperature
-            top_ks[slot] = p.top_k
-            top_ps[slot] = p.top_p
-            min_ps[slot] = p.min_p
-            presence[slot] = p.presence_penalty
-            frequency[slot] = p.frequency_penalty
-            repetition[slot] = p.repetition_penalty
-            min_toks[slot] = p.min_tokens
-            gen_counts[slot] = st.n_generated
-            seeds[slot] = st.seed
-            adapter_ids[slot] = self._adapter_id(st.request)
-
-        lora = self.lora_set.stacked if self.lora_set is not None else None
+        ctl = self._decode_controls(live)
+        lora = ctl["lora"]
         # on burst-enabled engines the fused decode+sample path
         # (decode_burst) runs at EVERY span, including 1: a span-1
         # "burst" is one fused step (3 control uploads instead of ~14)
@@ -2319,17 +2458,19 @@ class NativeEngine:
             # float32 upload: the tunnel charges per TRANSFER, not per
             # byte (model_runner.CTL_I_COLS / CTL_F_COLS layout)
             ctl_i = np.stack(
-                [tokens, positions, top_ks, min_toks, gen_counts,
-                 seeds.view(np.int32), adapter_ids,
+                [ctl["tokens"], ctl["positions"], ctl["top_ks"],
+                 ctl["min_toks"], ctl["gen_counts"],
+                 ctl["seeds"].view(np.int32), ctl["adapter_ids"],
                  active_burst.astype(np.int32)], axis=1)
             ctl_f = np.stack(
-                [temps, top_ps, min_ps, presence, frequency, repetition],
+                [ctl["temps"], ctl["top_ps"], ctl["min_ps"],
+                 ctl["presence"], ctl["frequency"], ctl["repetition"]],
                 axis=1)
             mode = self._sample_mode(
                 st.request.params for st in burst_rows.values())
             ctl_f_dev = jnp.asarray(ctl_f)
             sampled_dev, next_ctl = self._dispatch_burst(
-                jnp.asarray(ctl_i), ctl_f_dev, jnp.asarray(page_tables),
+                jnp.asarray(ctl_i), ctl_f_dev, jnp.asarray(ctl["page_tables"]),
                 span, mode, lora)
             # hand the fresh burst to the consume path, which may
             # dispatch its successor before the blocking fetch
@@ -2345,95 +2486,130 @@ class NativeEngine:
             if not live:
                 return carried
             failures = carried
-            active = np.zeros((B,), bool)
-            active[list(live)] = True
+            ctl["active"] = np.zeros((B,), bool)
+            ctl["active"][list(live)] = True
 
-        # speculative drafts (greedy, penalty-free sequences only)
-        spec_drafts: dict[int, list[int]] = {}
-        if self.spec_k:
-            for slot, st in live.items():
-                if not self._spec_eligible(st):
-                    continue
-                # leave room for the bonus token within the output budget
-                room = st.request.params.max_tokens - st.n_generated - 1
-                room = min(room, self.spec_k,
-                           self.cache_cfg.max_len - len(st.tokens))
-                if room < 1:
-                    continue
-                d = self.proposer.propose(st.tokens, room)
-                # grow pages opportunistically; shrink drafts rather than
-                # preempt — speculation must never cost anyone else pages
-                while d:
-                    try:
-                        self.alloc.extend(st.request.request_id,
-                                          len(st.tokens) - 1, 1 + len(d))
-                        break
-                    except MemoryError:
-                        d.pop()
-                if d:
-                    spec_drafts[slot] = d
-                    page_tables[slot] = self.alloc.page_table_row(
-                        st.request.request_id)
-
-        argmax_w = None
+        spec_drafts = self._propose_drafts(live, ctl) if self.spec_k else {}
+        spec = None
         if self.spec_k:
             # ALWAYS the verify scorer when speculation is on — even on
             # steps with zero drafts — so a row's logits source never
             # depends on whether a NEIGHBOR proposed drafts this step
             # (the scorers agree only to float tolerance; a seeded
             # sampled row must not flip tokens with batch composition)
-            C = self.spec_k + 1
-            window = np.zeros((B, C), np.int32)
-            counts_w = np.zeros((B,), np.int32)
-            for slot, st in live.items():
-                window[slot, 0] = st.tokens[-1]
-                counts_w[slot] = 1
-                for j, d in enumerate(spec_drafts.get(slot, [])):
-                    window[slot, 1 + j] = d
-                counts_w[slot] += len(spec_drafts.get(slot, []))
+            window, counts_w = self._spec_window(live, spec_drafts)
             self.cache, logits_w = verify_step(
                 self.cfg, self.cache_cfg, self.params, self.cache,
-                jnp.asarray(window), jnp.asarray(positions),
-                jnp.asarray(counts_w), jnp.asarray(page_tables),
+                jnp.asarray(window), jnp.asarray(ctl["positions"]),
+                jnp.asarray(counts_w), jnp.asarray(ctl["page_tables"]),
                 mesh=self._kernel_mesh, lora=lora,
-                adapter_ids=jnp.asarray(adapter_ids) if lora is not None else None,
+                adapter_ids=(jnp.asarray(ctl["adapter_ids"])
+                             if lora is not None else None),
             )
-            argmax_w = np.asarray(jnp.argmax(logits_w, axis=-1))  # [B, C]
-            if any(temps[s] > 0.0 for s in spec_drafts):
-                # sampled rows: delta-draft rejection sampling — one
-                # fused call yields the acceptance probabilities,
-                # uniforms, rejection replacements and sequential-
-                # equivalent full draws for every window position
-                counters = (gen_counts[:, None]
-                            + np.arange(C)[None, :]).reshape(-1)
-                keys_w = make_row_keys(
-                    jnp.asarray(np.repeat(seeds, C), jnp.uint32),
-                    jnp.asarray(counters, jnp.int32)).reshape(B, C)
-                draft_next = np.zeros((B, C), np.int32)
-                draft_next[:, : C - 1] = window[:, 1:]
-                full_d, p_draft_d, u_d, repl_d = spec_window_draws(
-                    logits_w.astype(jnp.float32), jnp.asarray(draft_next),
-                    keys_w, jnp.asarray(temps), jnp.asarray(top_ks),
-                    jnp.asarray(top_ps), jnp.asarray(min_ps))
-                full_w = np.asarray(full_d)
-                p_draft_w = np.asarray(p_draft_d)
-                u_w = np.asarray(u_d)
-                repl_w = np.asarray(repl_d)
+            self.sched.charge_weight_pass()
+            spec = self._spec_draws(logits_w, window, ctl, spec_drafts)
             logits = logits_w[:, 0]
         else:
             from fusioninfer_tpu.ops import dispatch as _dispatch
 
             self.cache, logits = decode_step(
                 self.cfg, self.cache_cfg, self.params, self.cache,
-                jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(page_tables),
-                jnp.asarray(active), mesh=self._kernel_mesh,
+                jnp.asarray(ctl["tokens"]), jnp.asarray(ctl["positions"]),
+                jnp.asarray(ctl["page_tables"]),
+                jnp.asarray(ctl["active"]), mesh=self._kernel_mesh,
                 lora=lora,
-                adapter_ids=jnp.asarray(adapter_ids) if lora is not None else None,
+                adapter_ids=(jnp.asarray(ctl["adapter_ids"])
+                             if lora is not None else None),
                 # eager env-var resolution: a mid-process flip of
                 # FUSIONINFER_DECODE_COALESCE must retrace, not silently
                 # reuse the latched variant (ops/dispatch.py)
                 coalesce=_dispatch.decode_coalesce(),
             )
+            self.sched.charge_weight_pass()
+        return self._decode_finish(live, logits, ctl, spec_drafts, spec,
+                                   failures)
+
+    def _propose_drafts(self, live: dict, ctl: dict) -> dict[int, list[int]]:
+        """Speculative drafts (greedy, penalty-free sequences only);
+        extends pages opportunistically and refreshes the extended rows
+        in ``ctl['page_tables']``."""
+        spec_drafts: dict[int, list[int]] = {}
+        for slot, st in live.items():
+            if not self._spec_eligible(st):
+                continue
+            # leave room for the bonus token within the output budget
+            room = st.request.params.max_tokens - st.n_generated - 1
+            room = min(room, self.spec_k,
+                       self.cache_cfg.max_len - len(st.tokens))
+            if room < 1:
+                continue
+            d = self.proposer.propose(st.tokens, room)
+            # grow pages opportunistically; shrink drafts rather than
+            # preempt — speculation must never cost anyone else pages
+            while d:
+                try:
+                    self.alloc.extend(st.request.request_id,
+                                      len(st.tokens) - 1, 1 + len(d))
+                    break
+                except MemoryError:
+                    d.pop()
+            if d:
+                spec_drafts[slot] = d
+                ctl["page_tables"][slot] = self.alloc.page_table_row(
+                    st.request.request_id)
+        return spec_drafts
+
+    def _spec_window(self, live: dict, spec_drafts: dict):
+        """Per-slot verify windows: the input token + its drafts."""
+        B = self.max_batch_size
+        C = self.spec_k + 1
+        window = np.zeros((B, C), np.int32)
+        counts_w = np.zeros((B,), np.int32)
+        for slot, st in live.items():
+            window[slot, 0] = st.tokens[-1]
+            counts_w[slot] = 1
+            for j, d in enumerate(spec_drafts.get(slot, [])):
+                window[slot, 1 + j] = d
+            counts_w[slot] += len(spec_drafts.get(slot, []))
+        return window, counts_w
+
+    def _spec_draws(self, logits_w, window, ctl: dict,
+                    spec_drafts: dict) -> dict:
+        """Host-side spec-verify products off the window logits
+        [B, C, V]: greedy argmaxes always; for sampled rows the
+        delta-draft rejection draws — one fused call yields the
+        acceptance probabilities, uniforms, rejection replacements and
+        sequential-equivalent full draws for every window position."""
+        B = self.max_batch_size
+        C = self.spec_k + 1
+        spec = {"argmax_w": np.asarray(jnp.argmax(logits_w, axis=-1))}
+        if any(ctl["temps"][s] > 0.0 for s in spec_drafts):
+            counters = (ctl["gen_counts"][:, None]
+                        + np.arange(C)[None, :]).reshape(-1)
+            keys_w = make_row_keys(
+                jnp.asarray(np.repeat(ctl["seeds"], C), jnp.uint32),
+                jnp.asarray(counters, jnp.int32)).reshape(B, C)
+            draft_next = np.zeros((B, C), np.int32)
+            draft_next[:, : C - 1] = window[:, 1:]
+            full_d, p_draft_d, u_d, repl_d = spec_window_draws(
+                logits_w.astype(jnp.float32), jnp.asarray(draft_next),
+                keys_w, jnp.asarray(ctl["temps"]), jnp.asarray(ctl["top_ks"]),
+                jnp.asarray(ctl["top_ps"]), jnp.asarray(ctl["min_ps"]))
+            spec["full_w"] = np.asarray(full_d)
+            spec["p_draft_w"] = np.asarray(p_draft_d)
+            spec["u_w"] = np.asarray(u_d)
+            spec["repl_w"] = np.asarray(repl_d)
+        return spec
+
+    def _decode_finish(self, live: dict, logits, ctl: dict,
+                       spec_drafts: dict, spec: Optional[dict],
+                       failures: list) -> list[StepOutput]:
+        """The decode sampling tail shared by the split and fused paths:
+        penalties → min-tokens suppression → guided masks → logit bias →
+        sample → count bump → emit (with spec-window acceptance when
+        speculation is on).  ``logits`` are the batch's slot-aligned
+        next-token logits [B, V] from whichever forward ran."""
+        B = self.max_batch_size
         # raw-distribution logprobs, computed only when someone asked
         lp_n = max((st.request.params.logprobs or 0 for st in live.values()),
                    default=0)
@@ -2445,12 +2621,14 @@ class NativeEngine:
                 top_lp = jax.lax.top_k(raw_logp, lp_n)
         logits = apply_penalties(
             logits, self._token_counts, self._output_counts,
-            jnp.asarray(presence), jnp.asarray(frequency), jnp.asarray(repetition),
+            jnp.asarray(ctl["presence"]), jnp.asarray(ctl["frequency"]),
+            jnp.asarray(ctl["repetition"]),
         )
         # min_tokens: stop ids stay unsampleable until enough generated
         # (fused jit: the eager where/& chain was a per-step host cost)
         logits = _suppress_early_rows(
-            logits, jnp.asarray(gen_counts < min_toks), self._suppress)
+            logits, jnp.asarray(ctl["gen_counts"] < ctl["min_toks"]),
+            self._suppress)
         # guided rows: only grammatically legal bytes are sampleable
         guided_live = {s: st.guided for s, st in live.items()
                        if st.guided is not None}
@@ -2477,10 +2655,12 @@ class NativeEngine:
             bias = self._slot_bias.get(slot)
             if bias is not None:
                 logits = logits.at[slot, bias[0]].add(bias[1])
-        keys = make_row_keys(jnp.asarray(seeds), jnp.asarray(gen_counts))
-        sampled_dev = sample(logits, keys, jnp.asarray(temps),
-                             jnp.asarray(top_ks), jnp.asarray(top_ps),
-                             jnp.asarray(min_ps),
+        keys = make_row_keys(jnp.asarray(ctl["seeds"]),
+                             jnp.asarray(ctl["gen_counts"]))
+        sampled_dev = sample(logits, keys, jnp.asarray(ctl["temps"]),
+                             jnp.asarray(ctl["top_ks"]),
+                             jnp.asarray(ctl["top_ps"]),
+                             jnp.asarray(ctl["min_ps"]),
                              mode=self._sample_mode(
                                  st.request.params for st in live.values()))
         live_mask = np.zeros(B, bool)
@@ -2497,11 +2677,12 @@ class NativeEngine:
         self.sched.charge_decode(
             len(live) + sum(len(d) for d in spec_drafts.values()))
         outputs = list(failures)
+        argmax_w = spec["argmax_w"] if spec is not None else None
         for slot, st in live.items():
             if argmax_w is not None and slot in spec_drafts:
                 drafts = spec_drafts[slot]
                 self.spec_proposed_total += len(drafts)
-                if temps[slot] > 0.0:
+                if ctl["temps"][slot] > 0.0:
                     # sampled burst: delta-draft rejection sampling —
                     # accept while u < p(draft) under the position's
                     # filtered distribution; on first rejection emit the
@@ -2510,13 +2691,13 @@ class NativeEngine:
                     # and deterministic for a given (seed, spec config).
                     accepted = 0
                     while (accepted < len(drafts)
-                           and float(u_w[slot, accepted])
-                           < float(p_draft_w[slot, accepted])):
+                           and float(spec["u_w"][slot, accepted])
+                           < float(spec["p_draft_w"][slot, accepted])):
                         accepted += 1
                     if accepted < len(drafts):
-                        tail = int(repl_w[slot, accepted])
+                        tail = int(spec["repl_w"][slot, accepted])
                     else:
-                        tail = int(full_w[slot, len(drafts)])
+                        tail = int(spec["full_w"][slot, len(drafts)])
                     burst = drafts[:accepted] + [tail]
                 else:
                     # greedy burst: accepted drafts + the model's bonus

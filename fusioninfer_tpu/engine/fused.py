@@ -1,0 +1,109 @@
+"""Mixed-batch packing for the fused one-weight-pass engine step.
+
+Pure host-side assembly (numpy only — no device work, no clocks): given
+the decode rows' control state and the step's budgeted prefill-chunk
+entries, build the ragged row set :func:`engine.model_runner.fused_step`
+consumes.  Row layout is load-bearing:
+
+* rows ``0 .. B-1`` are the decode batch SLOTS, so the fused logits'
+  first ``B`` rows line up with the engine's slot-indexed device
+  sampling state (penalty count tables, suppress masks) and the decode
+  sampling tail runs unchanged;
+* rows ``B ..`` carry this step's prefill chunks, one row per
+  mid-prefill sequence, each at its own start position;
+* trailing rows up to the power-of-two pad are inert (count 0, trash
+  page tables) so compiled signatures stay bounded at
+  log2(rows) × log2(window) combinations.
+
+Keeping this a pure function of its inputs keeps the fused scheduling
+decision a deterministic function of replicated scheduler state (the
+multi-host SPMD lockstep requirement) and makes the packing
+unit-testable without an engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class FusedBatch:
+    """Operand set for one ``fused_step`` dispatch (all numpy, ready for
+    ``jnp.asarray``)."""
+
+    tokens: np.ndarray  # [BF, C] int32 — per-row token windows
+    starts: np.ndarray  # [BF] int32 — global position of each row's col 0
+    counts: np.ndarray  # [BF] int32 — real window length (0 = inert row)
+    page_tables: np.ndarray  # [BF, mp] int32
+    sel: np.ndarray  # [BF, W] int32 — positions projected through lm_head
+    adapter_ids: np.ndarray  # [BF] int32
+    packed_tokens: int  # real (non-padding) tokens in this dispatch
+
+
+def pow2_rows(n: int) -> int:
+    """Smallest power of two ≥ n (compile-signature bounding)."""
+    return 1 << max(0, n - 1).bit_length()
+
+
+def pack_mixed_batch(
+    window: np.ndarray,  # [B, W] decode-row token windows (col 0 = input)
+    counts_w: np.ndarray,  # [B] real decode window lengths (0 = inactive)
+    positions: np.ndarray,  # [B] global position of each decode row's col 0
+    decode_tables: np.ndarray,  # [B, mp] decode-row page tables
+    decode_adapters: np.ndarray,  # [B] adapter ids
+    chunk_entries: list,  # [(tokens list, start, table_row, adapter_id)]
+    bucket: int,  # padded window width C (covers W and every chunk)
+    trash_page: int,
+) -> FusedBatch:
+    """Pack decode rows + prefill-chunk rows into one ragged row set.
+
+    ``sel`` width is the decode window width W: decode rows project
+    positions ``0..W-1`` (their sampled-token logits, and the full spec
+    window when speculation is on); chunk rows project only their last
+    real position, replicated across W (the activation path reads col 0
+    alone).
+    """
+    B, W = window.shape
+    mp = decode_tables.shape[1]
+    n_chunks = len(chunk_entries)
+    BF = pow2_rows(B + n_chunks)
+    C = bucket
+    if C < W:
+        raise ValueError(f"bucket {C} narrower than decode window {W}")
+
+    tokens = np.zeros((BF, C), np.int32)
+    starts = np.zeros((BF,), np.int32)
+    counts = np.zeros((BF,), np.int32)
+    tables = np.full((BF, mp), trash_page, np.int32)
+    sel = np.zeros((BF, W), np.int32)
+    ids = np.zeros((BF,), np.int32)
+
+    tokens[:B, :W] = window
+    starts[:B] = positions
+    counts[:B] = counts_w
+    tables[:B] = decode_tables
+    sel[:B] = np.arange(W)[None, :]
+    ids[:B] = decode_adapters
+
+    for j, (toks, start, table_row, adapter_id) in enumerate(chunk_entries):
+        r = B + j
+        if len(toks) > C:
+            raise ValueError(f"chunk of {len(toks)} tokens exceeds bucket {C}")
+        tokens[r, : len(toks)] = toks
+        starts[r] = start
+        counts[r] = len(toks)
+        tables[r] = table_row
+        # activation reads column 0 only; replicating the last real
+        # position across all W columns keeps sel a static [BF, W]
+        # shape at the cost of (W-1) duplicate lm_head positions per
+        # chunk row — W is the spec window (≤ spec_k+1), so the waste
+        # is a handful of [D, V] projections per step
+        sel[r] = len(toks) - 1
+        ids[r] = adapter_id
+
+    return FusedBatch(
+        tokens=tokens, starts=starts, counts=counts, page_tables=tables,
+        sel=sel, adapter_ids=ids, packed_tokens=int(counts.sum()),
+    )

@@ -213,10 +213,36 @@ class EngineMetrics:
             "# HELP fusioninfer:sched_dispatch_ahead_total Successor decode bursts dispatched before the in-flight fetch.",
             "# TYPE fusioninfer:sched_dispatch_ahead_total counter",
             f"fusioninfer:sched_dispatch_ahead_total{{{labels}}} {sched.dispatch_ahead_total}",
+            "# HELP fusioninfer:sched_fused_steps_total Steps that ran the fused mixed-batch forward (decode + prefill chunks in one weight pass).",
+            "# TYPE fusioninfer:sched_fused_steps_total counter",
+            f"fusioninfer:sched_fused_steps_total{{{labels}}} {sched.fused_steps_total}",
+            "# HELP fusioninfer:sched_weight_passes_total Weight-streaming forward passes dispatched on the serving path (a span-k decode burst counts k).",
+            "# TYPE fusioninfer:sched_weight_passes_total counter",
+            f"fusioninfer:sched_weight_passes_total{{{labels}}} {sched.weight_passes_total}",
             "# HELP fusioninfer:sched_burst_span_steps_total Decode dispatches by fused span (adaptive-burst histogram).",
             "# TYPE fusioninfer:sched_burst_span_steps_total counter",
         ]
         for span, count in sorted(sched.burst_span_steps.items()):
             lines.append(
                 f'fusioninfer:sched_burst_span_steps_total{{{labels},span="{span}"}} {count}')
+        lines += [
+            "# HELP fusioninfer:sched_fused_packed_tokens Real (non-padding) tokens packed into each fused mixed-batch forward.",
+            "# TYPE fusioninfer:sched_fused_packed_tokens histogram",
+        ]
+        from fusioninfer_tpu.engine.sched import PACKED_TOKENS_BUCKETS
+
+        cumulative = 0
+        for b in PACKED_TOKENS_BUCKETS:
+            cumulative += sched.fused_packed_tokens.get(b, 0)
+            lines.append(
+                f'fusioninfer:sched_fused_packed_tokens_bucket{{{labels},le="{b}"}} {cumulative}')
+        cumulative += sched.fused_packed_tokens.get(float("inf"), 0)
+        lines.append(
+            f'fusioninfer:sched_fused_packed_tokens_bucket{{{labels},le="+Inf"}} {cumulative}')
+        lines.append(
+            f"fusioninfer:sched_fused_packed_tokens_sum{{{labels}}} "
+            f"{sched.fused_packed_tokens_sum}")
+        lines.append(
+            f"fusioninfer:sched_fused_packed_tokens_count{{{labels}}} "
+            f"{sched.fused_steps_total}")
         return lines

@@ -531,9 +531,7 @@ def decode_burst(
     return cache, sampled_all, token_counts, output_counts, next_ctl_i
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "last_only"),
-         donate_argnums=(3,))
-def verify_step(
+def _window_forward_impl(
     cfg: ModelConfig,
     cache_cfg: CacheConfig,
     params,
@@ -546,12 +544,18 @@ def verify_step(
     lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
     adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
     last_only: bool = False,  # logits at counts-1 only → [B, V]
+    sel: jax.Array = None,  # [B, W] per-row positions to project → [B, W, V]
 ):
     """Speculative-verification forward: score a C-token window per
     sequence in ONE pass → (cache, logits [B, C, V]); with ``last_only``
     (the batched-suffix-prefill caller) only each sequence's LAST real
     position projects through lm_head → [B, V], so a wide window never
-    materializes a [B, C, vocab] logits tensor it won't read.
+    materializes a [B, C, vocab] logits tensor it won't read.  With
+    ``sel`` (the fused mixed-batch step) each row projects its OWN
+    per-row window positions through lm_head → [B, W, V]: decode rows
+    read position 0 (or their spec window), prefill-chunk rows read
+    their chunk's last real token — one lm_head over W columns instead
+    of C.
 
     ``logits[b, i]`` is the model's next-token distribution after
     consuming ``tokens[b, :i+1]`` — exactly what ``i+1`` sequential
@@ -654,11 +658,89 @@ def verify_step(
 
     (x, cache), _ = lax.scan(body, (x, cache), _layer_xs(cfg, params, lora))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if sel is not None:
+        idx = jnp.clip(sel.astype(jnp.int32), 0, C - 1)  # [B, W]
+        picked = jnp.take_along_axis(x, idx[:, :, None], axis=1)  # [B, W, D]
+        return cache, lm_head(cfg, params, picked)  # [B, W, V]
     if last_only:
         last = x[jnp.arange(B), jnp.maximum(counts - 1, 0)]  # [B, D]
         return cache, lm_head(cfg, params, last)
     logits = lm_head(cfg, params, x)  # [B, C, V]
     return cache, logits
+
+
+verify_step = partial(
+    jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "last_only"),
+    donate_argnums=(3,))(_window_forward_impl)
+
+
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",),
+         donate_argnums=(3,))
+def fused_step(
+    cfg: ModelConfig,
+    cache_cfg: CacheConfig,
+    params,
+    cache: dict,
+    tokens: jax.Array,  # [B, C] — per-row ragged token windows, padded
+    starts: jax.Array,  # [B] int32: global position of tokens[:, 0]
+    counts: jax.Array,  # [B] int32: real window length (0 = inactive row)
+    page_tables: jax.Array,  # [B, max_pages_per_seq]
+    sel: jax.Array,  # [B, W] int32: positions whose logits each row needs
+    mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
+    lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
+    adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
+):
+    """ONE weight pass over a mixed decode + prefill-chunk batch →
+    (cache, logits [B, W, V]).
+
+    The unified engine step: the running batch's decode rows (window
+    C=1, or their speculative verify window) and the step's budgeted
+    prefill-chunk rows (window C=chunk) pack into a single embed →
+    layer-scan → lm_head forward, so the weights stream from HBM once
+    per step instead of once per row-kind.  Decode is weight-bandwidth-
+    bound (the serving gap measured in TPU_EVIDENCE_r05), so chunked
+    prefill riding the same pass is nearly free — the Sarathi-style
+    coalescing the token budget (engine/sched.py) was built for, and
+    the shape the Ragged Paged Attention line of work builds TPU
+    serving around (PAPERS.md).
+
+    Raggedness is per row, not per array: every row attends its own
+    ``counts[b]``-token window at positions ``starts[b] + i`` over its
+    own pages via :func:`fusioninfer_tpu.ops.paged_verify_attention`
+    (per-row counts cover both row kinds; the portable gather branch
+    does the same masked math).  ``sel`` keeps lm_head narrow: each row
+    projects only the W positions it will actually read — decode rows
+    their sampled-token logits (and spec windows), chunk rows their
+    last real token for activation — never a [B, C, V] tensor.
+
+    KV scatter, attention masking, and per-position math are exactly
+    :func:`verify_step`'s, so a fused step's decode logits are the same
+    math as a split step's, and its chunk writes are the same pages a
+    split chunk forward would fill.
+
+    Two acknowledged trades (docs/design/scheduler.md):
+
+    * On the flash-kernel path a mixed step scores decode rows with the
+      verify kernel while decode-only steps keep the coalesced decode
+      kernel — the kernels agree to float tolerance, not bit-for-bit,
+      so a seeded sampled stream on a TPU engine can see scorer
+      switches when neighbors start/finish prefilling (the portable
+      branch is bit-exact, which the equivalence suite pins).  The
+      engine already accepts composition-dependent scorers at admission
+      (a short cache-hit suffix scores through ``verify_step`` when
+      batched, ``prefill_suffix`` solo); ``--no-fused-step`` restores a
+      single decode scorer per stream.
+    * The packed rectangle pads every decode row to the chunk bucket C,
+      so dense (embed/QKV/MLP) work grows with C even though decode
+      rows carry one real token.  The win rests on mixed steps being
+      weight-bandwidth-bound; very large chunk budgets over big live
+      batches on compute-rich backends shift that balance — a
+      one-dimensional ragged concat (one token axis, per-token row
+      ids) is the follow-up shape that removes the padding entirely.
+    """
+    return _window_forward_impl(
+        cfg, cache_cfg, params, cache, tokens, starts, counts, page_tables,
+        mesh=mesh, lora=lora, adapter_ids=adapter_ids, sel=sel)
 
 
 def prefill_buckets(max_len: int, smallest: int = 32) -> list[int]:

@@ -24,6 +24,10 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+# upper bounds for the fused-step packed-tokens histogram (real tokens
+# per fused mixed-batch dispatch); the last implicit bucket is +Inf
+PACKED_TOKENS_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
 
 def derive_token_budget(
     per_token_s: float,
@@ -71,6 +75,18 @@ class TokenBudget:
     dispatch_ahead_total: int = 0
     # adaptive-burst histogram: dispatched span -> dispatch count
     burst_span_steps: dict = field(default_factory=dict)
+    # fused mixed-batch steps: decode rows + budgeted prefill chunks in
+    # ONE forward (one weight pass instead of one per row-kind)
+    fused_steps_total: int = 0
+    # weight-streaming forwards dispatched on the serving path (fresh
+    # prefill, suffix/chunk, verify, decode, fused — a decode burst of
+    # span k streams the weights k times).  weight_passes / steps is the
+    # serving-path-gap metric the fused step exists to push toward 1.
+    weight_passes_total: int = 0
+    # packed-tokens histogram for fused dispatches: non-cumulative
+    # counts keyed by PACKED_TOKENS_BUCKETS upper bound (inf = overflow)
+    fused_packed_tokens: dict = field(default_factory=dict)
+    fused_packed_tokens_sum: int = 0
 
     def begin_step(self, decode_charge: int) -> int:
         """Open a step's ledger: charge the running batch's decode
@@ -90,6 +106,30 @@ class TokenBudget:
 
     def record_span(self, span: int) -> None:
         self.burst_span_steps[span] = self.burst_span_steps.get(span, 0) + 1
+
+    def charge_weight_pass(self, n: int = 1) -> None:
+        self.weight_passes_total += n
+
+    def record_fused(self, packed_tokens: int) -> None:
+        """One fused mixed-batch dispatch packing ``packed_tokens`` real
+        (non-padding) tokens."""
+        self.fused_steps_total += 1
+        self.fused_packed_tokens_sum += packed_tokens
+        for b in PACKED_TOKENS_BUCKETS:
+            if packed_tokens <= b:
+                self.fused_packed_tokens[b] = (
+                    self.fused_packed_tokens.get(b, 0) + 1)
+                return
+        inf = float("inf")
+        self.fused_packed_tokens[inf] = self.fused_packed_tokens.get(inf, 0) + 1
+
+    def weight_passes_per_step(self) -> float:
+        """Lifetime weight-streaming forwards per engine step (1.0 =
+        every step is one weight pass, the fused-step target; ≥ 2 is
+        the split prefill+decode dispatch under mixed load)."""
+        if not self.steps_total:
+            return 0.0
+        return self.weight_passes_total / self.steps_total
 
     def utilization(self) -> float:
         """Lifetime fraction of budgeted tokens actually spent (0 when
@@ -113,4 +153,8 @@ class TokenBudget:
             "burst_span_steps": {str(k): v for k, v in
                                  sorted(self.burst_span_steps.items())},
             "budget_utilization": round(self.utilization(), 4),
+            "fused_steps": self.fused_steps_total,
+            "weight_passes": self.weight_passes_total,
+            "weight_passes_per_step": round(self.weight_passes_per_step(), 4),
+            "fused_packed_tokens_sum": self.fused_packed_tokens_sum,
         }

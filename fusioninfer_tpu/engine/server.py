@@ -1852,6 +1852,7 @@ def serve_from_args(args) -> int:
         speculative_k=_nonneg_flag(args, "speculative_ngram"),
         decode_burst_steps=max(1, getattr(args, "decode_burst", 8) or 1),
         pipeline_bursts=not getattr(args, "no_decode_pipeline", False),
+        fused_step=getattr(args, "fused_step", True),
     )
     if not no_budget and engine.token_budget is None:
         # --tokens-per-step 0 (the default): derive the budget from a

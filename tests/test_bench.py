@@ -309,10 +309,12 @@ class TestBenchRecordChecker:
     def _good(self):
         return {"http": {
             "ceiling_fraction": 0.4,
+            "weight_passes_per_step": 1.05,
             "queue_wait_ms": {"p50": 1.0, "p90": 2.0, "max": 3.0},
             "scheduler": {"token_budget": 64, "budget_utilization": 0.5,
                           "burst_span_steps": {"1": 3},
-                          "burst_clamped": 1},
+                          "burst_clamped": 1,
+                          "fused_steps": 7, "weight_passes": 21},
         }}
 
     def test_complete_record_passes(self):
@@ -329,6 +331,21 @@ class TestBenchRecordChecker:
         problems = check_record(rec)
         assert any("ceiling_fraction" in p for p in problems)
         assert any("token_budget" in p for p in problems)
+
+    def test_missing_fused_evidence_flagged(self):
+        """The fused-step evidence fields (weight_passes_per_step +
+        scheduler.fused_steps/weight_passes) gate the smoke like the
+        round-5 ceiling_fraction fields do."""
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        del rec["http"]["weight_passes_per_step"]
+        del rec["http"]["scheduler"]["fused_steps"]
+        del rec["http"]["scheduler"]["weight_passes"]
+        problems = check_record(rec)
+        assert any("weight_passes_per_step" in p for p in problems)
+        assert any("scheduler.fused_steps" in p for p in problems)
+        assert any("scheduler.weight_passes" in p for p in problems)
 
     def test_decode_only_run_is_exempt(self):
         """BENCH_SKIP_HTTP=1 records have no http leg by design — the

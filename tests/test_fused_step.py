@@ -1,0 +1,390 @@
+"""Fused mixed-batch steps (docs/design/scheduler.md, engine.md).
+
+One weight pass per engine step: when a step has BOTH decode work and
+budgeted prefill-chunk work, the engine packs them into a single
+``model_runner.fused_step`` forward instead of dispatching a chunk
+forward and a decode forward back to back.  The invariants under test:
+
+* output streams are BIT-IDENTICAL with the fused path on vs off —
+  greedy and seeded-sampled, including prefix-cache hits,
+  preemption/resume, LoRA adapter rows, speculative-decode rows, and
+  mid-chunk cancellation;
+* the ``weight_passes_per_step`` ledger shows ≈ 1 pass/step under mixed
+  load on the fused path vs ≥ 2 on the split path, and decode-only
+  stepping is untouched;
+* burst engines (``decode_burst_steps > 1``) never take the fused path
+  (their span-1 dispatch carries the dispatch-ahead control chain);
+* the new ``/metrics`` families render with HELP/TYPE lines;
+* the packing helper (`engine/fused.py`) lays rows out slot-aligned.
+"""
+
+import numpy as np
+import pytest
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.fused import FusedBatch, pack_mixed_batch, pow2_rows
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.models.config import get_preset
+
+CFG = get_preset("qwen3-tiny")
+
+
+def _cache_cfg() -> CacheConfig:
+    return CacheConfig(n_pages=65, page_size=16, max_pages_per_seq=16)
+
+
+def _run_all(engine, requests, max_steps=400):
+    for r in requests:
+        engine.add_request(r)
+    tokens: dict[str, list[int]] = {r.request_id: [] for r in requests}
+    for _ in range(max_steps):
+        if not engine.has_work():
+            break
+        for out in engine.step():
+            assert not (out.finish_reason or "").startswith("error"), out
+            tokens[out.request_id].append(out.token)
+    assert not engine.has_work(), "engine did not drain"
+    return tokens
+
+
+def _mixed_reqs(seed=5, max_tokens=8):
+    """A decode stream + a long chunking prompt + a short prompt — the
+    mixed-load shape the fused step exists for."""
+    rng = np.random.default_rng(seed)
+    return [
+        Request("stream", [1, 2, 3],
+                SamplingParams(max_tokens=20, temperature=0.0)),
+        Request("long", rng.integers(1, CFG.vocab_size, 100).tolist(),
+                SamplingParams(max_tokens=max_tokens, temperature=0.8,
+                               seed=77)),
+        Request("short", rng.integers(1, CFG.vocab_size, 9).tolist(),
+                SamplingParams(max_tokens=4, temperature=0.0)),
+    ]
+
+
+class TestPacking:
+    def test_pow2_rows(self):
+        assert [pow2_rows(n) for n in (1, 2, 3, 8, 9)] == [1, 2, 4, 8, 16]
+
+    def test_slot_aligned_layout(self):
+        window = np.array([[7], [0], [9], [0]], np.int32)  # B=4, W=1
+        counts_w = np.array([1, 0, 1, 0], np.int32)
+        positions = np.array([5, 0, 12, 0], np.int32)
+        tables = np.arange(8, dtype=np.int32).reshape(4, 2)
+        adapters = np.array([0, 0, 1, 0], np.int32)
+        entries = [([3, 4, 5], 32, np.array([6, 7], np.int32), 2)]
+        p = pack_mixed_batch(window, counts_w, positions, tables, adapters,
+                             entries, bucket=32, trash_page=99)
+        assert isinstance(p, FusedBatch)
+        assert p.tokens.shape == (8, 32)  # pow2(4 + 1) rows
+        # decode rows are the batch SLOTS (logits row i == slot i)
+        assert p.tokens[0, 0] == 7 and p.counts[0] == 1 and p.starts[0] == 5
+        assert p.counts[1] == 0
+        assert (p.sel[:4] == 0).all()  # W=1: decode rows read position 0
+        # chunk row rides row B, reads its last real position
+        assert list(p.tokens[4, :3]) == [3, 4, 5]
+        assert p.starts[4] == 32 and p.counts[4] == 3 and p.sel[4, 0] == 2
+        assert p.adapter_ids[4] == 2
+        # padding rows are inert
+        assert p.counts[5:].sum() == 0 and (p.page_tables[5:] == 99).all()
+        assert p.packed_tokens == 5  # 2 live decode + 3 chunk tokens
+
+    def test_spec_window_sel(self):
+        window = np.array([[7, 8, 9], [0, 0, 0]], np.int32)  # W=3
+        p = pack_mixed_batch(window, np.array([3, 0], np.int32),
+                             np.array([4, 0], np.int32),
+                             np.full((2, 2), 0, np.int32),
+                             np.zeros(2, np.int32),
+                             [([1], 0, np.zeros(2, np.int32), 0)],
+                             bucket=32, trash_page=9)
+        assert list(p.sel[0]) == [0, 1, 2]  # decode rows: the spec window
+        assert (p.sel[2] == 0).all()  # 1-token chunk: last real position
+
+    def test_oversized_chunk_rejected(self):
+        with pytest.raises(ValueError):
+            pack_mixed_batch(
+                np.zeros((1, 1), np.int32), np.zeros(1, np.int32),
+                np.zeros(1, np.int32), np.zeros((1, 2), np.int32),
+                np.zeros(1, np.int32),
+                [(list(range(40)), 0, np.zeros(2, np.int32), 0)],
+                bucket=32, trash_page=9)
+
+
+class TestEquivalence:
+    """Bit-identity: the fused step must be invisible in the streams."""
+
+    def _ab(self, reqs_fn, **engine_kw):
+        split = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                             token_budget=16, fused_step=False, **engine_kw)
+        fused = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                             token_budget=16, fused_step=True, **engine_kw)
+        a = _run_all(split, reqs_fn())
+        b = _run_all(fused, reqs_fn())
+        assert fused.sched.fused_steps_total > 0, \
+            "fused path never engaged — the A/B proves nothing"
+        assert a == b
+        return split, fused
+
+    def test_mixed_load_greedy_and_seeded_sampled(self):
+        self._ab(_mixed_reqs)
+
+    def test_logprobs_and_bias_rows_in_the_mix(self):
+        """Tail-path rows (logprobs, logit_bias) share the fused decode
+        logits; their streams and the batch's must not move."""
+        long = np.random.default_rng(11).integers(
+            1, CFG.vocab_size, 90).tolist()
+
+        def reqs():
+            return [
+                Request("lp", [4, 5, 6],
+                        SamplingParams(max_tokens=12, temperature=0.0,
+                                       logprobs=2)),
+                Request("bias", [6, 5, 4],
+                        SamplingParams(max_tokens=12, temperature=0.0,
+                                       logit_bias=((7, 3.0),))),
+                Request("long", list(long),
+                        SamplingParams(max_tokens=3, temperature=0.0)),
+            ]
+
+        self._ab(reqs)
+
+    def test_prefix_cache_hit_suffix_chunks(self):
+        """A long cache-hit suffix chunks from its reused start position
+        — the fused chunk row must start mid-sequence (over pages a
+        prior request wrote)."""
+        rng = np.random.default_rng(3)
+        shared = rng.integers(1, CFG.vocab_size, 64).tolist()
+        tail = rng.integers(1, CFG.vocab_size, 60).tolist()
+
+        def run(fused_on):
+            engine = NativeEngine(CFG, cache_cfg=_cache_cfg(),
+                                  max_batch_size=4, token_budget=16,
+                                  fused_step=fused_on)
+            # warm the cache to completion first, so the long suffix
+            # below is a genuine page-aligned prefix hit
+            toks = dict(_run_all(engine, [Request(
+                "warm", shared + [11],
+                SamplingParams(max_tokens=2, temperature=0.0))]))
+            engine.add_request(Request(
+                "stream", [9, 8, 7],
+                SamplingParams(max_tokens=24, temperature=0.0)))
+            engine.add_request(Request(
+                "hit", shared + tail,
+                SamplingParams(max_tokens=4, temperature=0.0)))
+            toks.update({"stream": [], "hit": []})
+            for _ in range(200):
+                if not engine.has_work():
+                    break
+                for o in engine.step():
+                    assert not (o.finish_reason or "").startswith("error"), o
+                    toks[o.request_id].append(o.token)
+            assert not engine.has_work()
+            return toks, engine
+
+        a, split = run(False)
+        b, fused = run(True)
+        assert fused.sched.fused_steps_total > 0
+        assert a == b
+        assert fused.prefix_cache_hit_rate() > 0
+        assert split.prefix_cache_hit_rate() > 0
+
+    def test_preemption_resume(self):
+        """Preempted-and-resumed sequences (the prefix-cache resume
+        path: the full prompt+generated prefix re-prefills) stream
+        identically fused vs split."""
+        cache = CacheConfig(n_pages=9, page_size=16, max_pages_per_seq=8)
+
+        def run(fused_on):
+            engine = NativeEngine(CFG, cache_cfg=cache, max_batch_size=2,
+                                  enable_prefix_caching=False,
+                                  token_budget=16, fused_step=fused_on)
+            engine.add_request(Request(
+                "old", list(range(1, 16)),
+                SamplingParams(max_tokens=20, temperature=0.0)))
+            engine.step()
+            engine.add_request(Request(
+                "long", list(range(1, 112)),
+                SamplingParams(max_tokens=2, temperature=0.0)))
+            results: dict[str, list] = {"old": [], "long": []}
+            for _ in range(120):
+                if not engine.has_work():
+                    break
+                for o in engine.step():
+                    results[o.request_id].append(
+                        (o.token, o.finished, o.finish_reason))
+            assert not engine.has_work()
+            return results, engine
+
+        a, ea = run(False)
+        b, eb = run(True)
+        assert ea.preemptions_total >= 1 and eb.preemptions_total >= 1
+        assert a == b
+
+    def test_lora_adapter_rows(self):
+        import jax
+
+        from fusioninfer_tpu.models.lora import init_adapter
+
+        adapters = {"a1": init_adapter(CFG, 4, jax.random.key(3))}
+        long = np.random.default_rng(2).integers(
+            1, CFG.vocab_size, 70).tolist()
+
+        def reqs():
+            return [
+                Request("base", [1, 2, 3],
+                        SamplingParams(max_tokens=12, temperature=0.0)),
+                Request("lor", list(long),
+                        SamplingParams(max_tokens=4, temperature=0.0),
+                        lora="a1"),
+            ]
+
+        self._ab(reqs, lora_adapters=adapters)
+
+    def test_spec_decode_rows(self):
+        """Speculative rows keep their verify windows inside the fused
+        forward (decode rows carry count = 1 + drafts); greedy streams
+        stay bit-identical."""
+        long = np.random.default_rng(5).integers(
+            1, CFG.vocab_size, 90).tolist()
+
+        def reqs():
+            return [
+                Request("rep", [5, 6, 7, 5, 6, 7, 5, 6],
+                        SamplingParams(max_tokens=16, temperature=0.0)),
+                Request("long", list(long),
+                        SamplingParams(max_tokens=4, temperature=0.0)),
+            ]
+
+        split, fused = self._ab(reqs, speculative_k=2)
+        assert fused.spec_proposed_total > 0
+
+    def test_mid_chunk_cancellation(self):
+        """Cancelling a mid-chunk prompt between fused steps releases
+        its pages and leaves the surviving stream bit-identical."""
+        def run(fused_on):
+            engine = NativeEngine(CFG, cache_cfg=_cache_cfg(),
+                                  max_batch_size=4, token_budget=16,
+                                  fused_step=fused_on)
+            engine.add_request(Request(
+                "stream", [1, 2, 3],
+                SamplingParams(max_tokens=20, temperature=0.0)))
+            engine.step()
+            engine.add_request(Request(
+                "long", list(range(1, 120)),
+                SamplingParams(max_tokens=4, temperature=0.0)))
+            engine.step()
+            engine.step()
+            assert engine.num_prefilling == 1  # mid-chunk
+            engine.cancel("long")
+            toks = []
+            for _ in range(100):
+                if not engine.has_work():
+                    break
+                for o in engine.step():
+                    assert not (o.finish_reason or "").startswith("error"), o
+                    if o.request_id == "stream":
+                        toks.append(o.token)
+            assert not engine.has_work()
+            return toks, engine
+
+        a, ea = run(False)
+        b, eb = run(True)
+        assert a == b
+        assert eb.cancelled_total == 1
+        # every page returned (one reserved trash page stays allocator-held)
+        assert eb.alloc.free_pages == ea.alloc.free_pages
+
+
+class TestWeightPassLedger:
+    def test_mixed_load_one_pass_per_fused_step(self):
+        """During the fused regime every step with both row kinds is ONE
+        weight pass; the split engine pays ≥ 2 on those same steps."""
+        split = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                             token_budget=16, fused_step=False)
+        fused = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                             token_budget=16, fused_step=True)
+        _run_all(split, _mixed_reqs())
+        _run_all(fused, _mixed_reqs())
+        assert fused.sched.fused_steps_total > 0
+        assert (fused.sched.weight_passes_total
+                < split.sched.weight_passes_total)
+        # the fused engine's whole run sits near one pass per step; the
+        # split engine pays the extra chunk forwards
+        assert fused.sched.weight_passes_per_step() < \
+            split.sched.weight_passes_per_step()
+        assert fused.sched.weight_passes_per_step() < 1.5
+        snap = fused.sched.snapshot()
+        assert snap["fused_steps"] == fused.sched.fused_steps_total
+        assert snap["weight_passes"] == fused.sched.weight_passes_total
+        assert snap["weight_passes_per_step"] > 0
+        assert snap["fused_packed_tokens_sum"] > 0
+
+    def test_decode_only_is_one_pass_per_step_and_untouched(self):
+        """No prefill work → the fused path never engages and decode
+        stepping is exactly one weight pass per step."""
+        engine = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=2,
+                              token_budget=16, fused_step=True)
+        _run_all(engine, [Request("d", [1, 2, 3],
+                                  SamplingParams(max_tokens=10,
+                                                 temperature=0.0))])
+        assert engine.sched.fused_steps_total == 0
+        # admission step pays the prefill pass; every other step is 1
+        assert engine.sched.weight_passes_total <= engine.sched.steps_total + 1
+
+    def test_burst_engines_never_fuse(self):
+        engine = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                              token_budget=16, decode_burst_steps=4,
+                              fused_step=True)
+        _run_all(engine, _mixed_reqs())
+        assert engine.sched.fused_steps_total == 0
+
+    def test_flag_off_never_fuses(self):
+        engine = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                              token_budget=16, fused_step=False)
+        _run_all(engine, _mixed_reqs())
+        assert engine.sched.fused_steps_total == 0
+
+    def test_packed_tokens_histogram_observes(self):
+        engine = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                              token_budget=16, fused_step=True)
+        _run_all(engine, _mixed_reqs())
+        hist = engine.sched.fused_packed_tokens
+        assert sum(hist.values()) == engine.sched.fused_steps_total
+        assert engine.sched.fused_packed_tokens_sum >= \
+            engine.sched.fused_steps_total
+
+
+class TestCLIAndMetrics:
+    def test_serve_flag_round_trip(self):
+        from fusioninfer_tpu.cli import build_parser
+
+        p = build_parser()
+        assert p.parse_args(["engine", "serve"]).fused_step is True
+        assert p.parse_args(
+            ["engine", "serve", "--no-fused-step"]).fused_step is False
+        assert p.parse_args(
+            ["engine", "serve", "--fused-step"]).fused_step is True
+
+    def test_metrics_families_rendered(self):
+        from fusioninfer_tpu.engine.metrics import EngineMetrics
+
+        engine = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+                              token_budget=16, fused_step=True)
+        _run_all(engine, _mixed_reqs())
+        text = EngineMetrics("m").render(engine)
+        for family in ("fusioninfer:sched_fused_steps_total",
+                       "fusioninfer:sched_weight_passes_total",
+                       "fusioninfer:sched_fused_packed_tokens"):
+            assert f"# TYPE {family} " in text, family
+            assert f"# HELP {family} " in text, family
+        # the histogram renders cumulative buckets + sum + count, and
+        # the +Inf bucket equals the count (Prometheus contract)
+        inf = [ln for ln in text.splitlines()
+               if ln.startswith("fusioninfer:sched_fused_packed_tokens_bucket")
+               and 'le="+Inf"' in ln]
+        cnt = [ln for ln in text.splitlines()
+               if ln.startswith("fusioninfer:sched_fused_packed_tokens_count")]
+        assert len(inf) == 1 and len(cnt) == 1
+        assert inf[0].rsplit(" ", 1)[1] == cnt[0].rsplit(" ", 1)[1]
+        assert int(cnt[0].rsplit(" ", 1)[1]) == engine.sched.fused_steps_total

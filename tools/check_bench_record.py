@@ -3,9 +3,12 @@
 The CPU bench smoke (``make bench-smoke``, CI's "bench smoke" step) runs
 ``bench.py`` and then this checker against the sidecar record: the
 ``http`` leg must report ``ceiling_fraction`` (HTTP output tok/s over
-the same-config raw decode tok/s) and the token-budget scheduler's
-fields (``scheduler.token_budget`` etc., see engine/sched.py) plus the
-TTFT decomposition's ``queue_wait_ms`` — so a regression that silently
+the same-config raw decode tok/s), ``weight_passes_per_step`` (the
+fused-step evidence: weight-streaming forwards per engine step — ≈ 1
+under mixed load on the fused path, ≥ 2 split) and the token-budget
+scheduler's fields (``scheduler.token_budget``, ``fused_steps``,
+``weight_passes`` etc., see engine/sched.py) plus the TTFT
+decomposition's ``queue_wait_ms`` — so a regression that silently
 drops the scheduling evidence fails CI instead of shipping a blind
 record.  Usage: ``python tools/check_bench_record.py [BENCH_OUT.json]``.
 """
@@ -29,12 +32,16 @@ def check_record(record: dict) -> list[str]:
         return problems
     if "ceiling_fraction" not in http:
         problems.append("http.ceiling_fraction missing")
+    if "weight_passes_per_step" not in http:
+        problems.append(
+            "http.weight_passes_per_step (fused-step evidence) missing")
     sched = http.get("scheduler")
     if not isinstance(sched, dict):
         problems.append("http.scheduler missing")
     else:
         for field in ("token_budget", "budget_utilization",
-                      "burst_span_steps", "burst_clamped"):
+                      "burst_span_steps", "burst_clamped",
+                      "fused_steps", "weight_passes"):
             if field not in sched:
                 problems.append(f"http.scheduler.{field} missing")
     if "queue_wait_ms" not in http:

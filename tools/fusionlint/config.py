@@ -30,6 +30,9 @@ WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     # latency measurement lives engine-side (calibrate_token_budget)
     # and uses perf_counter explicitly, never time()/sleep()
     "fusioninfer_tpu/engine/sched.py": ("time", "sleep"),
+    # fused-step packing is pure host-side assembly feeding the same
+    # SPMD-replicated scheduling decision: same discipline as sched.py
+    "fusioninfer_tpu/engine/fused.py": ("time", "sleep"),
 }
 
 # -- lock-discipline pass ----------------------------------------------

@@ -23,7 +23,7 @@ Rules (all emitted as ``metrics-conventions``):
   * ``counter`` families end in ``_total``; ``_total`` families are
     typed ``counter``;
   * ``histogram``/``summary`` families carry a unit suffix
-    (``_seconds``/``_bytes``);
+    (``_seconds``/``_bytes``/``_tokens``);
   * the declared TYPE is a real Prometheus type;
   * no family is declared in two different modules (cross-file).
 """
@@ -43,7 +43,9 @@ _TYPE_RE = re.compile(rf"^# TYPE ({_FAMILY})\s+(\S+)")
 _SAMPLE_RE = re.compile(rf"^({_FAMILY})\{{")
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
-_UNIT_SUFFIXES = ("_seconds", "_bytes")
+# _tokens is this project's domain unit (packed-tokens / chunk-size
+# histograms observe token counts, not time or bytes)
+_UNIT_SUFFIXES = ("_seconds", "_bytes", "_tokens")
 
 
 @dataclass
