@@ -26,6 +26,10 @@ test: ## Unit + integration tests (virtual 8-device CPU mesh).
 test-fast: ## Tests, stop at first failure.
 	$(PYTHON) -m pytest tests/ -x -q
 
+.PHONY: fast
+fast: ## Sub-2-minute smoke tier (curated module list: tests/conftest.py FAST_MODULES).
+	$(PYTHON) -m pytest tests/ -q -m fast
+
 .PHONY: test-tpu
 test-tpu: ## Hardware kernel tests on a real TPU (interpret=False, bench shapes).
 	FUSIONINFER_TEST_TPU=1 $(PYTHON) -m pytest tests/test_kernels_tpu.py -x -q

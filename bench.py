@@ -139,16 +139,43 @@ def _lockfile_holders(path: str) -> list[int]:
     return pids
 
 
+def _lockfile_held(path: str) -> bool:
+    """True when SOMEONE holds a flock on ``path``, probed with a
+    non-blocking flock on a fresh file description (flock conflicts
+    across open()s even within one process).  The authoritative held
+    check: /proc/locks is absent in some sandboxes (this container's
+    4.4 kernel), and inode matching alone would misread a held lock as
+    stale and remove it from under its holder."""
+    import fcntl
+
+    try:
+        with open(path) as f:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return True
+            fcntl.flock(f, fcntl.LOCK_UN)
+            return False
+    except OSError:
+        return False
+
+
 def inspect_lockfiles(paths: tuple[str, ...] = ()) -> dict:
-    """Record every libtpu lockfile and its live holders; remove stale
-    ones (file present, no process holds the lock) so a crashed prior
-    bench can't wedge this one."""
+    """Record every libtpu lockfile, whether it is held, and its live
+    holders (when /proc/locks can name them); remove stale ones (file
+    present, nobody holds the lock) so a crashed prior bench can't
+    wedge this one."""
     if not paths:
         paths = tuple(glob.glob("/tmp/libtpu_lockfile*"))
     out: dict = {"checked": list(paths)}
     for path in paths:
-        info: dict = {"holder_pids": _lockfile_holders(path)}
-        if not info["holder_pids"]:
+        info: dict = {"holder_pids": _lockfile_holders(path),
+                      "held": _lockfile_held(path)}
+        # stale only when BOTH signals clear: the flock probe misses
+        # fcntl-style holders (and returns False on EACCES), /proc/locks
+        # is absent in some sandboxes — either alone could misread a
+        # held lock as stale and remove it from under its holder
+        if not info["held"] and not info["holder_pids"]:
             try:
                 os.unlink(path)
                 info["removed_stale"] = True
@@ -697,6 +724,142 @@ def run_admissions(cfg, cache_cfg, max_batch_size: int = 8,
             "n_requests": n_requests}
 
 
+def run_kernel_microbench(jax, on_tpu: bool,
+                          calibration_gflops: float | None) -> dict:
+    """Raw attention-op microbench with dispersion (same reps/IQR shape
+    as the decode legs): the ONE ragged kernel against (a) the portable
+    flat-gather baseline and (b) the retired padded-rectangle layout —
+    the verify kernel over ``[rows, C]`` with every decode row padded to
+    the chunk bucket — at a mixed decode+chunk shape.  Ratios > 1 mean
+    the ragged kernel wins; ``mfu_box`` is the ragged leg's attention
+    FLOP/s over this box's calibrated matmul ceiling (VERDICT #8).  On
+    CPU the kernels run in interpret mode: the ratios there prove the
+    leg's plumbing, not kernel performance — the TPU evidence path is
+    the real measurement."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fusioninfer_tpu.ops.paged_attention import (
+        paged_verify_attention,
+        ragged_paged_attention,
+        reference_ragged_paged_attention,
+    )
+
+    if on_tpu:
+        # serving shapes: Qwen3-1.7B heads, 32 decode rows at ragged
+        # ~short contexts + one 512-token chunk row (the fused-step mix)
+        KV, G, Hd, ps, mp = 8, 4, 128, 128, 16
+        b_dec, chunk, iters = 32, 512, 10
+        interpret = False
+    else:
+        KV, G, Hd, ps, mp = 2, 2, 64, 16, 4
+        b_dec, chunk, iters = 4, 24, 2
+        interpret = True
+    H = KV * G
+    reps = 5
+    rng = np.random.default_rng(0)
+    # decode rows at stratified context depths; one chunk row from 0
+    lens = [ps + (ps * (mp - 1) - ps) * i // max(b_dec - 1, 1)
+            for i in range(b_dec)]
+    R = b_dec + 1
+    q_lens = np.array([1] * b_dec + [chunk], np.int32)
+    q_begins = np.concatenate([[0], np.cumsum(q_lens)[:-1]]).astype(np.int32)
+    starts = np.array(lens + [0], np.int32)
+    T = int(q_lens.sum())
+    n_pages = int(sum(-(-(l + 1) // ps) for l in lens)
+                  + -(-chunk // ps) + 1)
+    tables = np.full((R, mp), n_pages - 1, np.int32)
+    nxt = 0
+    for r in range(R):
+        need = -(-int(starts[r] + q_lens[r]) // ps)
+        for i in range(min(need, mp)):
+            tables[r, i] = nxt
+            nxt += 1
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    q = jax.random.normal(kq, (T, H, Hd), dt)
+    k_pages = jax.random.normal(kk, (KV, n_pages, ps, Hd), dt)
+    v_pages = jax.random.normal(kv, (KV, n_pages, ps, Hd), dt)
+    tables_d = jnp.asarray(tables)
+    starts_d = jnp.asarray(starts)
+    q_begins_d = jnp.asarray(q_begins)
+    q_lens_d = jnp.asarray(q_lens)
+    # the retired rectangle: every row padded to the chunk bucket C
+    C = 1 << (int(chunk) - 1).bit_length()
+    q_rect = np.zeros((R, C, H, Hd), np.float32)
+    qn = np.asarray(q, np.float32)
+    for r in range(R):
+        q_rect[r, : q_lens[r]] = qn[q_begins[r]: q_begins[r] + q_lens[r]]
+    q_rect_d = jnp.asarray(q_rect, dt)
+    counts_d = jnp.asarray(q_lens)
+
+    gather = jax.jit(reference_ragged_paged_attention)
+
+    legs = {
+        "ragged": lambda: ragged_paged_attention(
+            q, k_pages, v_pages, tables_d, starts_d, q_begins_d, q_lens_d,
+            interpret=interpret),
+        "gather": lambda: gather(q, k_pages, v_pages, tables_d, starts_d,
+                                 q_begins_d, q_lens_d),
+        "padded_rect": lambda: paged_verify_attention(
+            q_rect_d, k_pages, v_pages, tables_d, starts_d, counts_d,
+            interpret=interpret),
+    }
+    out: dict = {
+        "shape": {"kv_heads": KV, "group": G, "head_dim": Hd,
+                  "page_size": ps, "decode_rows": b_dec, "chunk": chunk,
+                  "flat_tokens": T, "rect_bucket": C, "iters": iters,
+                  "interpret": interpret},
+        "note": ("ragged = one flat ragged kernel (decode rows + chunk "
+                 "row, zero padding); padded_rect = the retired "
+                 "[rows, C] layout through the verify kernel; gather = "
+                 "portable flat-gather baseline.  calls/s medians; "
+                 "interpret=True legs prove plumbing, not speed"),
+    }
+    rates: dict = {}
+    for name, fn in legs.items():
+        try:
+            # compile + one untimed warm window outside the measurement
+            # (first post-compile calls still pay allocator/thread
+            # warmup; the median absorbs the rest)
+            for _ in range(1 + iters):
+                o = fn()
+            float(jnp.asarray(o, jnp.float32).ravel()[0])
+            vals = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    o = fn()
+                # D2H readback: the only fence that includes execution
+                # on the tunneled chip (enqueue != done)
+                float(jnp.asarray(o, jnp.float32).ravel()[0])
+                vals.append(iters / (time.perf_counter() - t0))
+            d = _median_iqr(vals)
+            out[name] = {"calls_per_s": round(d["median"], 3),
+                         "reps": d["reps"], "iqr": d["iqr"],
+                         "rel_iqr": d["rel_iqr"]}
+            rates[name] = d["median"]
+        except Exception as e:
+            out[f"{name}_error"] = f"{type(e).__name__}: {str(e)[:400]}"
+    if rates.get("ragged") and rates.get("gather"):
+        out["ragged_vs_gather"] = round(rates["ragged"] / rates["gather"], 3)
+    if rates.get("ragged") and rates.get("padded_rect"):
+        out["ragged_vs_padded"] = round(
+            rates["ragged"] / rates["padded_rect"], 3)
+    if rates.get("ragged"):
+        # causal attention FLOPs of the REAL tokens only (the ragged
+        # kernel's whole point): 4·H·Hd per (token, visible position)
+        visible = sum(int(starts[r]) + i + 1
+                      for r in range(R) for i in range(int(q_lens[r])))
+        flops = 4.0 * H * Hd * visible
+        out["attn_gflops_per_call"] = round(flops / 1e9, 4)
+        if calibration_gflops:
+            out["mfu_box"] = round(
+                rates["ragged"] * flops / (calibration_gflops * 1e9), 4)
+    return out
+
+
 def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
              concurrency: int, max_prompt: int, max_output: int,
              prefill_chunk: int | None = None,
@@ -1061,6 +1224,16 @@ def main() -> None:
         except Exception as e:
             record["admissions"] = {
                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+        # raw-kernel microbench: the ragged kernel's own evidence leg
+        # (ragged-vs-gather, ragged-vs-padded-rectangle, mfu_box with
+        # dispersion) — independent of the full-model decode legs above
+        try:
+            record["kernel_microbench"] = run_kernel_microbench(
+                jax, on_tpu, record.get("calibration_gflops"))
+        except Exception as e:
+            record["kernel_microbench"] = {
+                "error": f"{type(e).__name__}: {str(e)[:400]}"}
 
         # MFU context: mean position over the FULL timed span (reps
         # windows), else attention FLOPs are understated

@@ -53,17 +53,15 @@ from fusioninfer_tpu.engine.kv_cache import (
     PageAllocator,
     init_kv_cache,
 )
-from fusioninfer_tpu.engine.fused import pack_mixed_batch
+from fusioninfer_tpu.engine.fused import pack_ragged_batch, pow2_rows
 from fusioninfer_tpu.engine.model_runner import (
     decode_burst,
-    decode_step,
     fused_step,
     pick_bucket,
     prefill,
     prefill_buckets,
-    prefill_suffix,
-    verify_step,
 )
+from fusioninfer_tpu.ops import dispatch as ops_dispatch
 from fusioninfer_tpu.engine.prefix_cache import PrefixCachingAllocator
 from fusioninfer_tpu.engine.spec import NgramProposer
 from fusioninfer_tpu.engine.sampler import (
@@ -80,7 +78,7 @@ from fusioninfer_tpu.models.transformer import init_params
 logger = logging.getLogger("fusioninfer.engine")
 
 # prefix-cache hits whose un-cached suffix is at most this many tokens
-# batch through ONE verify_step forward; the window pads to the burst's
+# batch through ONE ragged forward; the flat axis pads to the burst's
 # power-of-two bucket, so compiled signatures stay bounded
 _SUFFIX_BATCH_WINDOW = 128
 
@@ -306,7 +304,7 @@ class NativeEngine:
         ``speculative_k``: n-gram prompt-lookup speculative decoding —
         propose up to k draft tokens per greedy sequence from its own
         context (:class:`fusioninfer_tpu.engine.spec.NgramProposer`) and
-        verify them in ONE ``verify_step`` forward; every accepted draft
+        verify them in ONE ragged spec-window forward; every accepted draft
         is a decode step skipped.  Greedy outputs are bit-identical with
         speculation on or off; sampled (temperature>0) rows speculate
         via delta-draft rejection sampling — distribution-exact and
@@ -511,6 +509,12 @@ class NativeEngine:
         # unpipelined bursting.
         self.pipeline_bursts = pipeline_bursts
         self._inflight = None
+        # ragged-dispatch compile discipline: descriptor rows and the
+        # chunk lm_head group are pinned per engine (R = pow2(2B),
+        # NC = pow2(B)), so the only varying jit-signature dimension of
+        # the one ragged forward is the pow2 flat-token bucket
+        self._ragged_rows = pow2_rows(2 * self.max_batch_size)
+        self._ragged_chunk_rows = pow2_rows(self.max_batch_size)
         # fused mixed-batch stepping (decode + prefill chunks in one
         # weight pass); burst engines keep the split dispatch-ahead path
         self.fused_step_enabled = fused_step
@@ -1661,24 +1665,17 @@ class NativeEngine:
                         start: int, length: int) -> jax.Array:
         """One suffix-prefill forward writing ``prefix[start:start+length]``
         at global positions [start, start+length) → last-token logits.
-        Shared by the prefix-cache-hit path and the chunked-prefill loop
-        so bucket padding and LoRA plumbing can never drift between them."""
-        row = jnp.asarray(self.alloc.page_table_row(request.request_id))
-        suffix = prefix[start : start + length]
-        bucket = pick_bucket(self.buckets, length)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :length] = suffix
-        lora, ids = None, None
-        if self.lora_set is not None:
-            lora = self.lora_set.stacked
-            ids = jnp.asarray([self._adapter_id(request)], jnp.int32)
-        self.cache, logits = prefill_suffix(
-            self.cfg, self.cache_cfg, self.params, self.cache,
-            jnp.asarray(padded), jnp.int32(start), jnp.int32(length), row,
-            mesh=self._kernel_mesh, lora=lora, adapter_ids=ids,
-        )
-        self.sched.charge_weight_pass()
-        return logits
+        Shared by the prefix-cache-hit path and the chunked-prefill loop.
+
+        This is the SAME ragged dispatch every other forward uses, as a
+        one-chunk pack — not a private rectangle path.  A sequence's
+        K/V bytes must be identical whether its chunk ran solo, in a
+        batched advance, or fused with decode rows: with int8 pages a
+        low-bit difference in the pre-quantization values moves
+        whole quantization buckets, and the old solo-vs-batched scorer
+        split measurably flipped seeded streams downstream."""
+        return self._batched_window_forward(
+            [(request, prefix[start: start + length], start)])[0][None]
 
     def _prefill_suffix_one(self, request: Request, prefix: list[int],
                             resumed: bool, reused_tokens: int) -> StepOutput:
@@ -1691,42 +1688,59 @@ class NativeEngine:
         self.sched.charge_prefill(len(prefix) - reused_tokens)
         return self._activate(request, prefix, resumed, logits)
 
-    def _batched_window_forward(self, entries) -> "jax.Array":
-        """ONE multi-query verify_step for a batch of per-sequence token
-        windows — ``entries`` is ``[(request, window_tokens, start)]`` —
-        returning last-real-position logits [B, V] (padding rows inert:
-        counts 0, trash-page tables).  The single assembly point for both
-        the prefix-cache-burst and chunked-prefill batch paths; raises on
-        forward failure (the caller fails its own group)."""
-        C = pick_bucket(self.buckets, max(len(w) for _, w, _ in entries))
-        B = 1 << (len(entries) - 1).bit_length()
-        mp = self.cache_cfg.max_pages_per_seq
-        window = np.zeros((B, C), np.int32)
-        starts = np.zeros((B,), np.int32)
-        counts = np.zeros((B,), np.int32)
-        rows = np.full((B, mp), self.cache_cfg.trash_page, np.int32)
-        ids = np.zeros((B,), np.int32)
-        for i, (request, toks, start) in enumerate(entries):
-            window[i, : len(toks)] = toks
-            starts[i] = start
-            counts[i] = len(toks)
-            rows[i] = self.alloc.page_table_row(request.request_id)
-            ids[i] = self._adapter_id(request)
-        lora = self.lora_set.stacked if self.lora_set is not None else None
-        self.cache, logits = verify_step(
+    def _ragged_forward(self, packed, lora):
+        """Dispatch ONE flat ragged forward (the one kernel, the one
+        signature family) and charge its weight pass →
+        ``(logits [B, W, V], chunk_logits [NC, V])``.  Every engine
+        forward that reads paged context — decode rows, spec windows,
+        chunk advances, batched cache-hit suffixes, mixed fused steps —
+        assembles a :class:`RaggedBatch` and lands here, so no path can
+        reacquire a private scorer."""
+        self.cache, logits, chunk_logits = fused_step(
             self.cfg, self.cache_cfg, self.params, self.cache,
-            jnp.asarray(window), jnp.asarray(starts), jnp.asarray(counts),
-            jnp.asarray(rows), mesh=self._kernel_mesh, lora=lora,
-            adapter_ids=jnp.asarray(ids) if lora is not None else None,
-            last_only=True,
+            jnp.asarray(packed.tokens), jnp.asarray(packed.row_starts),
+            jnp.asarray(packed.q_begins), jnp.asarray(packed.q_lens),
+            jnp.asarray(packed.page_tables), jnp.asarray(packed.sel),
+            jnp.asarray(packed.chunk_sel),
+            mesh=self._kernel_mesh, lora=lora,
+            adapter_ids=(jnp.asarray(packed.adapter_ids)
+                         if lora is not None else None),
+            # eager env-var resolution: a mid-process flip of
+            # FUSIONINFER_DECODE_COALESCE must retrace, not silently
+            # reuse the latched variant (ops/dispatch.py)
+            coalesce=ops_dispatch.decode_coalesce(),
         )
         self.sched.charge_weight_pass()
-        return logits
+        return logits, chunk_logits
+
+    def _batched_window_forward(self, entries) -> "jax.Array":
+        """ONE ragged multi-query forward for a batch of per-sequence
+        token windows — ``entries`` is ``[(request, window_tokens,
+        start)]`` — returning last-real-token logits [B, V] (inert pad
+        entries: zero-length segments, trash-page tables).  The single
+        assembly point for both the prefix-cache-burst and
+        chunked-prefill batch paths; raises on forward failure (the
+        caller fails its own group)."""
+        B = len(entries)
+        chunk_entries = [
+            (toks, start, self.alloc.page_table_row(request.request_id),
+             self._adapter_id(request))
+            for request, toks, start in entries
+        ]
+        packed = pack_ragged_batch(
+            np.zeros((0, 1), np.int32), np.zeros((0,), np.int32),
+            np.zeros((0,), np.int32),
+            np.zeros((0, self.cache_cfg.max_pages_per_seq), np.int32),
+            np.zeros((0,), np.int32), chunk_entries,
+            self.cache_cfg.trash_page, rows=self._ragged_rows,
+            chunk_rows=self._ragged_chunk_rows)
+        lora = self.lora_set.stacked if self.lora_set is not None else None
+        return self._ragged_forward(packed, lora)[1][:B]
 
     def _prefill_suffix_batch(
         self, items: list[tuple[Request, list[int], bool, int]]
     ) -> list[StepOutput]:
-        """One verify_step forward for a burst of SHORT cache-hit
+        """One ragged multi-query forward for a burst of SHORT cache-hit
         suffixes: each sequence's window is its un-cached tail at its own
         start position — N hits sharing a prompt prefill as one pass
         instead of N.  Error semantics mirror ``_prefill_fresh_group``:
@@ -2311,38 +2325,25 @@ class NativeEngine:
             # capacity pressure preempted one row kind away since the
             # step() gate: run the split halves (each no-ops if empty)
             return failures + self._advance_prefilling() + self._decode()
-        B = self.max_batch_size
         budget = self._chunk_budget()
         share = max(1, budget // len(take))
         chunks = [min(share, len(st.prefix) - st.pos) for st in take]
         ctl = self._decode_controls(live)
         lora = ctl["lora"]
         spec_drafts = self._propose_drafts(live, ctl) if self.spec_k else {}
-        if self.spec_k:
-            window, counts_w = self._spec_window(live, spec_drafts)
-        else:
-            window = ctl["tokens"][:, None]  # [B, 1] — single-query rows
-            counts_w = ctl["active"].astype(np.int32)
+        window, counts_w = self._decode_window(live, ctl, spec_drafts)
         entries = [
             (st.prefix[st.pos: st.pos + chunks[i]], st.pos,
              self.alloc.page_table_row(st.request.request_id),
              self._adapter_id(st.request))
             for i, st in enumerate(take)
         ]
-        bucket = pick_bucket(self.buckets,
-                             max(window.shape[1], max(chunks)))
-        packed = pack_mixed_batch(
+        packed = pack_ragged_batch(
             window, counts_w, ctl["positions"], ctl["page_tables"],
-            ctl["adapter_ids"], entries, bucket, self.cache_cfg.trash_page)
+            ctl["adapter_ids"], entries, self.cache_cfg.trash_page,
+            rows=self._ragged_rows, chunk_rows=self._ragged_chunk_rows)
         try:
-            self.cache, logits_f = fused_step(
-                self.cfg, self.cache_cfg, self.params, self.cache,
-                jnp.asarray(packed.tokens), jnp.asarray(packed.starts),
-                jnp.asarray(packed.counts), jnp.asarray(packed.page_tables),
-                jnp.asarray(packed.sel), mesh=self._kernel_mesh, lora=lora,
-                adapter_ids=(jnp.asarray(packed.adapter_ids)
-                             if lora is not None else None),
-            )
+            logits_f, chunk_logits = self._ragged_forward(packed, lora)
         except Exception as e:
             logger.exception("fused mixed-batch step of %d chunks failed",
                              len(take))
@@ -2355,7 +2356,6 @@ class NativeEngine:
             # decode rows were untouched by the failed dispatch: serve
             # them through the classic split decode this step
             return outputs + self._decode()
-        self.sched.charge_weight_pass()
         self.sched.record_fused(packed.packed_tokens)
         # chunk bookkeeping mirrors _advance_prefilling_batch: charged
         # after the forward, completed prefills activate into their
@@ -2367,14 +2367,14 @@ class NativeEngine:
             if st.pos == len(st.prefix):
                 self.prefilling.remove(st)
                 done.append((st.request, st.prefix, st.resumed,
-                             logits_f[B + i][:1]))
+                             chunk_logits[i][None]))
         outputs = list(failures)
         if done:
             outputs += self._activate_group(done)
-        # decode sampling/spec-verify off the slot-aligned first B rows
-        spec = (self._spec_draws(logits_f[:B], window, ctl, spec_drafts)
+        # decode sampling/spec-verify off the slot-aligned decode rows
+        spec = (self._spec_draws(logits_f, window, ctl, spec_drafts)
                 if self.spec_k else None)
-        return outputs + self._decode_finish(live, logits_f[:B, 0], ctl,
+        return outputs + self._decode_finish(live, logits_f[:, 0], ctl,
                                              spec_drafts, spec, [])
 
     def _decode_controls(self, live: dict) -> dict:
@@ -2490,44 +2490,36 @@ class NativeEngine:
             ctl["active"][list(live)] = True
 
         spec_drafts = self._propose_drafts(live, ctl) if self.spec_k else {}
+        # the split decode forward is the SAME ragged dispatch the fused
+        # path uses, with zero chunk rows — decode rows (and their spec
+        # windows) score through the one ragged kernel either way, so a
+        # row's logits bits never depend on whether a neighbor starts or
+        # finishes prefilling (the retired verify-vs-coalesced scorer
+        # switch agreed only to float tolerance)
+        window, counts_w = self._decode_window(live, ctl, spec_drafts)
+        packed = pack_ragged_batch(
+            window, counts_w, ctl["positions"], ctl["page_tables"],
+            ctl["adapter_ids"], [], self.cache_cfg.trash_page,
+            # chunk_rows=0: an empty chunk group, not the padded one — a
+            # decode-only step must not pay NC dead lm_head rows
+            rows=self._ragged_rows, chunk_rows=0)
+        logits_f, _ = self._ragged_forward(packed, lora)
         spec = None
         if self.spec_k:
-            # ALWAYS the verify scorer when speculation is on — even on
-            # steps with zero drafts — so a row's logits source never
-            # depends on whether a NEIGHBOR proposed drafts this step
-            # (the scorers agree only to float tolerance; a seeded
-            # sampled row must not flip tokens with batch composition)
-            window, counts_w = self._spec_window(live, spec_drafts)
-            self.cache, logits_w = verify_step(
-                self.cfg, self.cache_cfg, self.params, self.cache,
-                jnp.asarray(window), jnp.asarray(ctl["positions"]),
-                jnp.asarray(counts_w), jnp.asarray(ctl["page_tables"]),
-                mesh=self._kernel_mesh, lora=lora,
-                adapter_ids=(jnp.asarray(ctl["adapter_ids"])
-                             if lora is not None else None),
-            )
-            self.sched.charge_weight_pass()
-            spec = self._spec_draws(logits_w, window, ctl, spec_drafts)
-            logits = logits_w[:, 0]
-        else:
-            from fusioninfer_tpu.ops import dispatch as _dispatch
-
-            self.cache, logits = decode_step(
-                self.cfg, self.cache_cfg, self.params, self.cache,
-                jnp.asarray(ctl["tokens"]), jnp.asarray(ctl["positions"]),
-                jnp.asarray(ctl["page_tables"]),
-                jnp.asarray(ctl["active"]), mesh=self._kernel_mesh,
-                lora=lora,
-                adapter_ids=(jnp.asarray(ctl["adapter_ids"])
-                             if lora is not None else None),
-                # eager env-var resolution: a mid-process flip of
-                # FUSIONINFER_DECODE_COALESCE must retrace, not silently
-                # reuse the latched variant (ops/dispatch.py)
-                coalesce=_dispatch.decode_coalesce(),
-            )
-            self.sched.charge_weight_pass()
+            spec = self._spec_draws(logits_f, window, ctl, spec_drafts)
+        logits = logits_f[:, 0]
         return self._decode_finish(live, logits, ctl, spec_drafts, spec,
                                    failures)
+
+    def _decode_window(self, live: dict, ctl: dict, spec_drafts: dict):
+        """The decode rows' token windows for a ragged dispatch: the
+        spec verify window (input token + drafts) when speculation is
+        on — even on steps with zero drafts, so a row's window width
+        never depends on a NEIGHBOR's drafts — else the single input
+        token per live slot."""
+        if self.spec_k:
+            return self._spec_window(live, spec_drafts)
+        return ctl["tokens"][:, None], ctl["active"].astype(np.int32)
 
     def _propose_drafts(self, live: dict, ctl: dict) -> dict[int, list[int]]:
         """Speculative drafts (greedy, penalty-free sequences only);

@@ -174,7 +174,8 @@ def prefill(
     return cache, lm_head(cfg, params, last)
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "coalesce"),
+         donate_argnums=(3,))
 def prefill_suffix(
     cfg: ModelConfig,
     cache_cfg: CacheConfig,
@@ -188,6 +189,8 @@ def prefill_suffix(
     lora=None,  # stacked AdapterSet tree; the cached prefix pages were
     adapter_ids: jax.Array = None,  # written under THIS adapter (the
     # engine namespaces the prefix cache per adapter)
+    coalesce: bool = None,  # ragged-grid variant (ops/dispatch.py);
+    # the engine resolves the env var eagerly per call
 ):
     """Prefill a prompt SUFFIX against cached prefix pages (the automatic
     prefix-caching path): token i sits at global position ``start + i``,
@@ -196,15 +199,16 @@ def prefill_suffix(
     (cache, logits at the last real suffix token [1, V]).
 
     Attention dispatch mirrors ``decode_step``: on the kernel path the
-    Pallas suffix kernel streams pages in place
-    (:func:`fusioninfer_tpu.ops.paged_attention.paged_prefill_attention`),
-    per tensor-parallel shard when a tp-only ``mesh`` is given; the
-    portable branch gathers the page context and relies on XLA SPMD.
+    ONE ragged kernel streams pages in place
+    (:func:`fusioninfer_tpu.ops.ragged_paged_attention`, a single-row
+    descriptor set), per tensor-parallel shard when a tp-only ``mesh``
+    is given; the portable branch gathers the page context and relies
+    on XLA SPMD.
     This is the data path behind the router's flagship prefix-cache
     strategy (reference ``pkg/router/strategy.go:51-77`` routes for cache
     hits; the hit's compute happens here).
     """
-    from fusioninfer_tpu.ops import dispatch, paged_prefill_attention
+    from fusioninfer_tpu.ops import dispatch, ragged_paged_attention
 
     B, C = tokens.shape
     ps = cache_cfg.page_size
@@ -243,21 +247,29 @@ def prefill_suffix(
         ks_s, vs_s = cache.get("k_scale"), cache.get("v_scale")
 
         if use_kernel:
+            # the ONE ragged kernel, degenerate descriptors: a single
+            # row of true_len tokens starting mid-sequence
             if mesh is not None:
-                from fusioninfer_tpu.ops.sharded import paged_prefill_attention_tp
+                from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
 
-                attn = paged_prefill_attention_tp(
-                    mesh, q[0], cache["k"], cache["v"], page_row, start,
-                    true_len, ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window,
-                )[None]  # [1, C, H*Hd]
-            else:
-                attn = paged_prefill_attention(
-                    q[0], cache["k"], cache["v"], page_row, start, true_len,
+                attn = ragged_paged_attention_tp(
+                    mesh, q[0], cache["k"], cache["v"], page_row[None],
+                    jnp.reshape(start, (1,)).astype(jnp.int32),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.reshape(true_len, (1,)).astype(jnp.int32),
                     ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window,
+                    window=cfg.sliding_window, coalesce=coalesce,
+                )[None]  # [1, C, H*Hd]
+            else:
+                attn = ragged_paged_attention(
+                    q[0], cache["k"], cache["v"], page_row[None],
+                    jnp.reshape(start, (1,)).astype(jnp.int32),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.reshape(true_len, (1,)).astype(jnp.int32),
+                    ks_s, vs_s, layer=l,
+                    interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window, coalesce=coalesce,
                 )[None]
         else:
             k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
@@ -308,7 +320,7 @@ def _decode_step_impl(
     # mid-process flip retraces instead of reusing the latched variant
 ):
     """One decode step for the whole running batch → (cache, logits [B, V])."""
-    from fusioninfer_tpu.ops import dispatch, paged_decode_attention
+    from fusioninfer_tpu.ops import dispatch, ragged_paged_attention
 
     B = tokens.shape[0]
     ps = cache_cfg.page_size
@@ -324,8 +336,6 @@ def _decode_step_impl(
         active, page_tables[jnp.arange(B), positions // ps], cache_cfg.trash_page
     )
     write_slot = positions % ps
-    # context length per sequence incl. the token written this step
-    lengths = jnp.where(active, positions + 1, 0)
 
     # attention mask over the gathered [mp * ps] context (reference path)
     ctx_idx = jnp.arange(mp * ps)[None, :]  # [1, T]
@@ -350,20 +360,24 @@ def _decode_step_impl(
         ks_s, vs_s = cache.get("k_scale"), cache.get("v_scale")
 
         if use_kernel:
-            # Pallas kernel streams only the live pages HBM→VMEM
+            # the ONE ragged kernel, degenerate descriptors: B rows of
+            # one token each (q_len = active) — the same kernel (and
+            # bits) the fused mixed-batch path scores decode rows with
             if mesh is not None:
-                from fusioninfer_tpu.ops.sharded import paged_decode_attention_tp
+                from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
 
-                attn = paged_decode_attention_tp(
+                attn = ragged_paged_attention_tp(
                     mesh, q[:, 0], cache["k"], cache["v"], page_tables,
-                    lengths, ks_s, vs_s, layer=l,
+                    positions, jnp.arange(B_, dtype=jnp.int32),
+                    active.astype(jnp.int32), ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window, coalesce=coalesce,
                 )[:, None, :]
             else:
-                attn = paged_decode_attention(
-                    q[:, 0], cache["k"], cache["v"], page_tables, lengths,
-                    ks_s, vs_s, layer=l,
+                attn = ragged_paged_attention(
+                    q[:, 0], cache["k"], cache["v"], page_tables,
+                    positions, jnp.arange(B_, dtype=jnp.int32),
+                    active.astype(jnp.int32), ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
                     window=cfg.sliding_window, coalesce=coalesce,
                 )[:, None, :]  # [B, 1, H*Hd]
@@ -545,6 +559,7 @@ def _window_forward_impl(
     adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
     last_only: bool = False,  # logits at counts-1 only → [B, V]
     sel: jax.Array = None,  # [B, W] per-row positions to project → [B, W, V]
+    coalesce: bool = None,  # ragged-grid variant, resolved by the engine
 ):
     """Speculative-verification forward: score a C-token window per
     sequence in ONE pass → (cache, logits [B, C, V]); with ``last_only``
@@ -569,10 +584,11 @@ def _window_forward_impl(
 
     The capability matches vLLM's spec-decode scorer (delegated by the
     reference, SURVEY §0 — the operator only passes engine flags
-    through); the TPU realization shares the decode kernel's head-major
-    page layout via :func:`fusioninfer_tpu.ops.paged_verify_attention`.
+    through); the TPU realization flattens the window rectangle into
+    the ONE ragged kernel (:func:`fusioninfer_tpu.ops.
+    ragged_paged_attention`) on the head-major page layout.
     """
-    from fusioninfer_tpu.ops import dispatch, paged_verify_attention
+    from fusioninfer_tpu.ops import dispatch, ragged_paged_attention
 
     B, C = tokens.shape
     ps = cache_cfg.page_size
@@ -613,22 +629,27 @@ def _window_forward_impl(
         ks_s, vs_s = cache.get("k_scale"), cache.get("v_scale")
 
         if use_kernel:
+            # the ONE ragged kernel on the flattened window rectangle:
+            # row b's segment sits at flat offset b*C with its real
+            # count — padding columns belong to no row
+            qf = q.reshape(B * C, H, Hd)
+            q_begins = jnp.arange(B, dtype=jnp.int32) * C
             if mesh is not None:
-                from fusioninfer_tpu.ops.sharded import paged_verify_attention_tp
+                from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
 
-                attn = paged_verify_attention_tp(
-                    mesh, q, cache["k"], cache["v"], page_tables, starts,
-                    counts, ks_s, vs_s, layer=l,
+                attn = ragged_paged_attention_tp(
+                    mesh, qf, cache["k"], cache["v"], page_tables, starts,
+                    q_begins, counts, ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window,
-                )  # [B, C, H*Hd]
+                    window=cfg.sliding_window, coalesce=coalesce,
+                ).reshape(B, C, H * Hd)
             else:
-                attn = paged_verify_attention(
-                    q, cache["k"], cache["v"], page_tables, starts, counts,
-                    ks_s, vs_s, layer=l,
+                attn = ragged_paged_attention(
+                    qf, cache["k"], cache["v"], page_tables, starts,
+                    q_begins, counts, ks_s, vs_s, layer=l,
                     interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window,
-                )
+                    window=cfg.sliding_window, coalesce=coalesce,
+                ).reshape(B, C, H * Hd)
         else:
             k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
             k_ctx = k_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)
@@ -670,77 +691,181 @@ def _window_forward_impl(
 
 
 verify_step = partial(
-    jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "last_only"),
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("mesh", "last_only", "coalesce"),
     donate_argnums=(3,))(_window_forward_impl)
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",),
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "coalesce"),
          donate_argnums=(3,))
 def fused_step(
     cfg: ModelConfig,
     cache_cfg: CacheConfig,
     params,
     cache: dict,
-    tokens: jax.Array,  # [B, C] — per-row ragged token windows, padded
-    starts: jax.Array,  # [B] int32: global position of tokens[:, 0]
-    counts: jax.Array,  # [B] int32: real window length (0 = inactive row)
-    page_tables: jax.Array,  # [B, max_pages_per_seq]
-    sel: jax.Array,  # [B, W] int32: positions whose logits each row needs
+    tokens: jax.Array,  # [T] int32 — flat ragged-concat token axis
+    row_starts: jax.Array,  # [R] int32: global position of row's token 0
+    q_begins: jax.Array,  # [R] int32: flat offset of each row's segment
+    q_lens: jax.Array,  # [R] int32: row token count (0 = inert row)
+    page_tables: jax.Array,  # [R, max_pages_per_seq]
+    sel: jax.Array,  # [B, W] int32: decode slots' FLAT window indices
+    chunk_sel: jax.Array,  # [NC] int32: chunk rows' FLAT last-token indices
     mesh=None,  # tp-only serving mesh: shard_map'd kernels per TP shard
     lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
-    adapter_ids: jax.Array = None,  # [B] int32; 0 = base model
+    adapter_ids: jax.Array = None,  # [R] int32 per ROW; 0 = base model
+    coalesce: bool = None,  # ragged-grid variant, resolved by the engine
 ):
-    """ONE weight pass over a mixed decode + prefill-chunk batch →
-    (cache, logits [B, W, V]).
+    """ONE weight pass over a flat ragged-concat token axis →
+    (cache, logits [B, W, V], chunk_logits [NC, V]).
 
-    The unified engine step: the running batch's decode rows (window
-    C=1, or their speculative verify window) and the step's budgeted
-    prefill-chunk rows (window C=chunk) pack into a single embed →
-    layer-scan → lm_head forward, so the weights stream from HBM once
-    per step instead of once per row-kind.  Decode is weight-bandwidth-
-    bound (the serving gap measured in TPU_EVIDENCE_r05), so chunked
-    prefill riding the same pass is nearly free — the Sarathi-style
-    coalescing the token budget (engine/sched.py) was built for, and
-    the shape the Ragged Paged Attention line of work builds TPU
-    serving around (PAPERS.md).
+    The unified engine step: decode rows (q_len=1), speculative verify
+    windows (q_len=1+drafts) and budgeted prefill chunks (q_len=chunk)
+    concatenate along ONE token dimension — ``T = Σ q_lens`` plus the
+    power-of-two signature pad — and ride a single embed → layer-scan →
+    lm_head forward.  Decode is weight-bandwidth-bound (the serving gap
+    measured in TPU_EVIDENCE_r05), so chunked prefill riding the same
+    pass is nearly free; unlike the retired ``[rows, C]`` rectangle,
+    dense (embed/QKV/MLP) work grows with the REAL token count — a
+    decode row costs one token whatever the chunk bucket is (the Ragged
+    Paged Attention layout, PAPERS.md).
 
-    Raggedness is per row, not per array: every row attends its own
-    ``counts[b]``-token window at positions ``starts[b] + i`` over its
-    own pages via :func:`fusioninfer_tpu.ops.paged_verify_attention`
-    (per-row counts cover both row kinds; the portable gather branch
-    does the same masked math).  ``sel`` keeps lm_head narrow: each row
-    projects only the W positions it will actually read — decode rows
+    Attention is :func:`fusioninfer_tpu.ops.ragged_paged_attention` —
+    the same kernel decode-only and chunk-only dispatches use, with
+    per-token output bits independent of what else shares the batch —
+    so there is no scorer switch anywhere on the model path: split and
+    fused engine streams are bit-identical, kernel and portable alike.
+    The portable branch gathers each token's own pages with the exact
+    einsum structure of ``decode_step``'s (flat tokens ride the batch
+    axis).
+
+    ``sel``/``chunk_sel`` keep lm_head narrow AND shape-stable: only
+    the flat positions the engine will read project — decode slots
     their sampled-token logits (and spec windows), chunk rows their
-    last real token for activation — never a [B, C, V] tensor.
-
-    KV scatter, attention masking, and per-position math are exactly
-    :func:`verify_step`'s, so a fused step's decode logits are the same
-    math as a split step's, and its chunk writes are the same pages a
-    split chunk forward would fill.
-
-    Two acknowledged trades (docs/design/scheduler.md):
-
-    * On the flash-kernel path a mixed step scores decode rows with the
-      verify kernel while decode-only steps keep the coalesced decode
-      kernel — the kernels agree to float tolerance, not bit-for-bit,
-      so a seeded sampled stream on a TPU engine can see scorer
-      switches when neighbors start/finish prefilling (the portable
-      branch is bit-exact, which the equivalence suite pins).  The
-      engine already accepts composition-dependent scorers at admission
-      (a short cache-hit suffix scores through ``verify_step`` when
-      batched, ``prefill_suffix`` solo); ``--no-fused-step`` restores a
-      single decode scorer per stream.
-    * The packed rectangle pads every decode row to the chunk bucket C,
-      so dense (embed/QKV/MLP) work grows with C even though decode
-      rows carry one real token.  The win rests on mixed steps being
-      weight-bandwidth-bound; very large chunk budgets over big live
-      batches on compute-rich backends shift that balance — a
-      one-dimensional ragged concat (one token axis, per-token row
-      ids) is the follow-up shape that removes the padding entirely.
+    last real token for activation — never a [T, V] tensor.  The two
+    groups project through SEPARATE lm_head calls because XLA's bf16
+    matmul bits vary with the row count: the decode group is always
+    ``[B·W, D]`` (constant per engine) and the chunk group ``[NC, D]``
+    (the pow2-padded chunk count, equal between a split chunk advance
+    and the fused step that absorbs it), so a stream's logits bits
+    never depend on which dispatch computed them.
     """
-    return _window_forward_impl(
-        cfg, cache_cfg, params, cache, tokens, starts, counts, page_tables,
-        mesh=mesh, lora=lora, adapter_ids=adapter_ids, sel=sel)
+    from fusioninfer_tpu.ops import dispatch, ragged_paged_attention
+    from fusioninfer_tpu.ops.paged_attention import ragged_token_rows
+
+    T = tokens.shape[0]
+    ps = cache_cfg.page_size
+    mp = page_tables.shape[1]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    quantized = cache_cfg.quantized
+    use_kernel = dispatch.resolve_attn(cfg.attn_impl) == "flash"
+
+    row_of, off, live = ragged_token_rows(q_begins, q_lens, T)
+    positions = jnp.where(live, row_starts[row_of] + off, 0)
+    tables_tok = page_tables[row_of]  # [T, mp] — each token's row's pages
+    write_page = jnp.where(
+        live, tables_tok[jnp.arange(T), positions // ps],
+        cache_cfg.trash_page,
+    )
+    write_slot = positions % ps
+    adapter_tok = adapter_ids[row_of] if adapter_ids is not None else None
+
+    x = embed_lookup(params["embed"], tokens, cfg.jax_dtype)[:, None, :]
+    pos2 = positions[:, None]  # [T, 1]
+
+    # portable-path mask over each token's own gathered [mp * ps] context
+    ctx_idx = jnp.arange(mp * ps)[None, :]  # [1, T_ctx]
+    attend = masks.attend(positions[:, None], ctx_idx,
+                          cfg.sliding_window) & live[:, None]
+    attend = attend[:, None, None, :]  # [T, 1, 1, T_ctx]
+
+    def body(carry, inputs):
+        x, cache = carry
+        layer, layer_lora, l = _layer_unpack(inputs, lora is not None)
+        from fusioninfer_tpu.models.quantization import maybe_dequantize_tree
+
+        layer = maybe_dequantize_tree(layer, cfg.jax_dtype)
+        q, k, v = qkv_proj(cfg, layer, x, pos2, layer_lora, adapter_tok)
+
+        # stacked head-major cache [L, KV, n_pages, ps, Hd]; k[:, 0] is
+        # [T, KV, Hd] → in-place scatter at layer l, per-token maps
+        cache = _scatter_kv(cache, l, k[:, 0], v[:, 0],
+                            write_page, write_slot, head_axis=1)
+        ks_s, vs_s = cache.get("k_scale"), cache.get("v_scale")
+
+        if use_kernel:
+            if mesh is not None:
+                from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
+
+                attn = ragged_paged_attention_tp(
+                    mesh, q[:, 0], cache["k"], cache["v"], page_tables,
+                    row_starts, q_begins, q_lens, ks_s, vs_s, layer=l,
+                    interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window, coalesce=coalesce,
+                )[:, None, :]
+            else:
+                attn = ragged_paged_attention(
+                    q[:, 0], cache["k"], cache["v"], page_tables,
+                    row_starts, q_begins, q_lens, ks_s, vs_s, layer=l,
+                    interpret=dispatch.kernel_interpret(),
+                    window=cfg.sliding_window, coalesce=coalesce,
+                )[:, None, :]  # [T, 1, H*Hd]
+        else:
+            # portable flat gather: decode_step's einsum with the flat
+            # tokens on the batch axis — per-token bits independent of
+            # the rest of the batch, so split/fused stay bit-identical.
+            # int8 pages fold their scales AFTER the dots (the kernel's
+            # scale-after-dot identity): multiplying the scale into the
+            # contraction operand lets XLA move it inside or outside
+            # the Σ_d per shape — a T-dependent algebraic rewrite that
+            # flipped sampled streams between split and fused packs
+            k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
+            k_ctx = k_cache_l[:, tables_tok].reshape(KV, T, mp * ps, Hd)
+            v_ctx = v_cache_l[:, tables_tok].reshape(KV, T, mp * ps, Hd)
+            if quantized:
+                k_ctx = k_ctx.astype(jnp.float32)
+                v_ctx = v_ctx.astype(jnp.float32)
+                # per-(head, token, position) scale planes [KV, T, S] →
+                # broadcast over the score axes (b=token, k, g, s=1, t)
+                k_sc = ks_l[:, tables_tok, 0].reshape(
+                    KV, T, mp * ps).transpose(1, 0, 2)[:, :, None, None, :]
+                v_sc = vs_l[:, tables_tok, 0].reshape(
+                    KV, T, mp * ps).transpose(1, 0, 2)[:, :, None, None, :]
+
+            group = H // KV
+            qg = q.reshape(T, 1, KV, group, Hd)
+            scores = jnp.einsum("bskgd,kbtd->bkgst", qg, k_ctx).astype(
+                jnp.float32) / jnp.sqrt(Hd)
+            if quantized:
+                scores = scores * k_sc
+            scores = jnp.where(
+                attend[:, :, None, :, :] * jnp.ones_like(scores, bool),
+                scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
+            if quantized:
+                probs = probs * v_sc
+            attn = jnp.einsum("bkgst,kbtd->bskgd", probs, v_ctx).reshape(
+                T, 1, H * Hd).astype(x.dtype)
+        out_proj = attn @ layer["wo"]
+        if layer_lora is not None:
+            from fusioninfer_tpu.models.lora import lora_delta
+
+            out_proj = out_proj + lora_delta(layer_lora, "wo", attn,
+                                             adapter_tok)
+        x = x + out_proj
+        return (x + mlp_block(cfg, layer, x), cache), None
+
+    (x, cache), _ = lax.scan(body, (x, cache), _layer_xs(cfg, params, lora))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    h = x[:, 0]  # [T, D]
+    idx = jnp.clip(sel.astype(jnp.int32), 0, T - 1)  # [B, W]
+    # FLAT [B·W, D] through lm_head — the same [N, D] @ [D, V] shape
+    # decode_step projects, so a decode row's logits bits match the
+    # classic/burst path's exactly
+    logits = lm_head(cfg, params, h[idx.reshape(idx.size)])  # [B·W, V]
+    logits = logits.reshape(*idx.shape, logits.shape[-1])  # [B, W, V]
+    cidx = jnp.clip(chunk_sel.astype(jnp.int32), 0, T - 1)  # [NC]
+    chunk_logits = lm_head(cfg, params, h[cidx])  # [NC, V]
+    return cache, logits, chunk_logits
 
 
 def prefill_buckets(max_len: int, smallest: int = 32) -> list[int]:

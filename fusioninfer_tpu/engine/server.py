@@ -109,6 +109,19 @@ def _piece(tokenizer, token: int) -> str:
     return tokenizer.decode([token]) or f"<token_{token}>"
 
 
+def _top_lp_by_text(tokenizer, tops: dict) -> dict:
+    """Token-id→logprob map rendered text-keyed.  Distinct ids CAN share
+    a text form (byte-fallback vocabularies); keep the BEST logprob per
+    text, never dict-insertion order — a greedy stream's chosen token
+    must always equal the max of its own top-logprobs row."""
+    out: dict[str, float] = {}
+    for t, lp in tops.items():
+        text = _piece(tokenizer, t)
+        if text not in out or lp > out[text]:
+            out[text] = lp
+    return out
+
+
 def _find_stop(text: str, stops) -> int | None:
     """Earliest index where any stop sequence begins, or None."""
     best = None
@@ -1203,8 +1216,7 @@ class EngineServer:
                 "tokens": [_piece(self.tokenizer, t) for t in tokens],
                 "token_logprobs": token_lps,
                 "top_logprobs": [
-                    {_piece(self.tokenizer, t): lp for t, lp in tops.items()}
-                    if tops else None
+                    _top_lp_by_text(self.tokenizer, tops) if tops else None
                     for tops in top_lps
                 ],
                 "text_offset": [],

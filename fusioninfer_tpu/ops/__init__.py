@@ -15,10 +15,15 @@ from fusioninfer_tpu.ops.flash_attention import (  # noqa: F401
     reference_attention,
 )
 from fusioninfer_tpu.ops.paged_attention import (  # noqa: F401
+    RAGGED_BLOCK_Q,
     paged_decode_attention,
     paged_prefill_attention,
     paged_verify_attention,
+    ragged_fits_vmem,
+    ragged_paged_attention,
+    ragged_token_rows,
     reference_paged_attention,
     reference_paged_prefill_attention,
     reference_paged_verify_attention,
+    reference_ragged_paged_attention,
 )

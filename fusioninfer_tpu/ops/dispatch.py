@@ -58,21 +58,24 @@ def kernel_interpret() -> bool:
 
 
 def decode_coalesce() -> bool:
-    """Decode-kernel variant gate: True = one program per sequence with a
-    single [KV, ps, Hd] DMA per page (KV× fewer DMA issues); False = the
-    per-(sequence, head) grid.  Both compute identical per-row math.
-    Default True: measured on the v5e chip (readback-synced, Qwen3-1.7B
-    batch 32), coalescing decodes +10% at ~200-token contexts and +28%
-    at ragged 256..1850-token contexts (full-model tok/s, rel_iqr ≤3%).
+    """Paged-kernel DMA-variant gate — now the RAGGED kernel's grid
+    knob too: True = one [KV, ps, Hd] copy per page covering every KV
+    head, with the score/value dots batched over KV (KV× fewer DMA
+    issues); False = the per-(tile, head) grid.  Both compute identical
+    per-row math.  Default True: measured on the v5e chip
+    (readback-synced, Qwen3-1.7B batch 32), coalescing decodes +10% at
+    ~200-token contexts and +28% at ragged 256..1850-token contexts
+    (full-model tok/s, rel_iqr ≤3%).
     ``FUSIONINFER_DECODE_COALESCE=0/1`` overrides.  The ENGINE resolves
-    this eagerly at every decode dispatch and passes the concrete bool
+    this eagerly at every ragged dispatch and passes the concrete bool
     into the jitted step as a static argument — flipping the env var
     mid-process therefore retraces and takes effect, instead of the jit
     cache silently serving the variant latched at first trace (the
-    pre-round-6 behavior).  The coalesced grid additionally falls back
-    to the per-head grid when its double-buffered scratch would exceed
-    the conservative VMEM budget
-    (:func:`fusioninfer_tpu.ops.paged_attention.coalesce_fits_vmem`)."""
+    pre-round-6 behavior).  The coalesced grids additionally fall back
+    to the per-head grid when their double-buffered scratch would
+    exceed the conservative VMEM budget
+    (:func:`fusioninfer_tpu.ops.paged_attention.coalesce_fits_vmem` /
+    :func:`fusioninfer_tpu.ops.paged_attention.ragged_fits_vmem`)."""
     v = os.environ.get("FUSIONINFER_DECODE_COALESCE", "")
     if not v:
         return True

@@ -1,12 +1,20 @@
-"""Paged decode attention as a Pallas TPU kernel.
+"""Paged attention as Pallas TPU kernels — one ragged kernel serves all.
 
-The decode-path attention for the continuous-batching engine: each
-sequence's KV context lives in non-contiguous cache pages
-(:mod:`fusioninfer_tpu.engine.kv_cache`); this kernel streams exactly the
-live pages HBM→VMEM per (sequence, kv-head) program with double-buffered
-DMA and an online softmax — no materialized ``cache[page_tables]``
-gather (which copies the whole context through HBM every step, the
-portable-baseline cost in :mod:`fusioninfer_tpu.engine.model_runner`).
+Each sequence's KV context lives in non-contiguous cache pages
+(:mod:`fusioninfer_tpu.engine.kv_cache`); these kernels stream exactly
+the live pages HBM→VMEM with double-buffered DMA and an online softmax
+— no materialized ``cache[page_tables]`` gather (which copies the whole
+context through HBM every step, the portable-baseline cost in
+:mod:`fusioninfer_tpu.engine.model_runner`).
+
+The engine's entire model path routes through ONE of them:
+:func:`ragged_paged_attention`, a flat ragged-concat grid whose per-row
+``(start, q_begin, q_len)`` descriptors cover decode rows, speculative
+verify windows, budgeted prefill chunks and cache-hit suffixes with no
+per-row rectangle padding and no kernel switch between row kinds (the
+Ragged Paged Attention layout, PAPERS.md).  The earlier decode /
+suffix / verify kernels below remain as standalone primitives — bench
+baselines and compat callers.
 
 Equivalent capability in the reference is vLLM's CUDA PagedAttention,
 which FusionInfer only orchestrates (SURVEY §0); here it is an in-repo
@@ -819,6 +827,442 @@ def paged_verify_attention(
         interpret=interpret,
     )(*operands)
     return out.reshape(B, C, H * Hd)
+
+
+# -- the one true ragged kernel ---------------------------------------
+#
+# ``ragged_paged_attention`` serves decode rows (q_len=1), speculative
+# verify windows (q_len=1+drafts), budgeted prefill chunks
+# (q_len=chunk) and cache-hit suffixes from ONE grid over a flat
+# ragged-concat token axis — no per-row rectangle padding and no
+# kernel switch between row kinds (the Ragged Paged Attention shape,
+# PAPERS.md).  The decode/verify/suffix kernels above remain as
+# standalone primitives (bench baselines, compat callers); the engine's
+# model path routes everything here.
+
+# q-tile length over the FLAT token axis.  Per (tile, row) the kernel
+# scores all block_q tokens of the tile against the row's pages and
+# masks the tokens outside the row, so the MXU waste per decode-heavy
+# tile is bounded by block_q; larger tiles amortize the page loop for
+# long chunk rows.  8 = one f32 sublane tile: the decode-heavy default.
+# Static per process — per-row results are independent of tile
+# composition (see _ragged_row below), so one value per process keeps
+# split and fused dispatches bit-identical.
+RAGGED_BLOCK_Q = 8
+
+
+def ragged_fits_vmem(block_q: int, page_size: int, Hd: int, kv_heads: int,
+                     group: int, q_dtype, k_dtype, v_dtype,
+                     quantized: bool, budget: int | None = None) -> bool:
+    """True when the coalesced ragged grid's VMEM footprint — the
+    double-buffered [2, KV, ps, Hd] page scratch PLUS the q and out
+    tiles [block_q, KV, G, Hd] — fits the conservative budget; callers
+    fall back to the per-head grid (page scratch KV× smaller, tiles
+    per-head) otherwise.  Same contract as :func:`coalesce_fits_vmem`,
+    extended with the tile term the flat-q layout adds."""
+    if budget is None:
+        budget = _COALESCE_VMEM_SCRATCH_BUDGET
+    pages = coalesced_scratch_bytes(page_size, Hd, kv_heads,
+                                    k_dtype, v_dtype, quantized)
+    tiles = 2 * block_q * kv_heads * group * Hd * jnp.dtype(q_dtype).itemsize
+    return pages + tiles <= budget
+
+
+def _ragged_block_rows(q_begins: jax.Array, q_lens: jax.Array,
+                       nb: int, block_q: int) -> jax.Array:
+    """Per-tile ``(first_row, n_rows)`` map [nb, 2]: the rows whose flat
+    segments ``[q_begins[r], q_begins[r] + q_lens[r])`` intersect tile
+    ``t``'s token span.  Rows must be packed in flat order (``q_begins``
+    non-decreasing, segments disjoint); zero-length rows inside the
+    range are harmless (their tile intersection is empty)."""
+    R = q_begins.shape[0]
+    ends = q_begins + q_lens
+    t0s = jnp.arange(nb, dtype=jnp.int32) * block_q
+    first = jnp.searchsorted(ends, t0s, side="right").astype(jnp.int32)
+    last = (jnp.searchsorted(q_begins, t0s + block_q, side="left")
+            .astype(jnp.int32) - 1)
+    first = jnp.minimum(first, R - 1)
+    n = jnp.clip(last - first + 1, 0, R)
+    return jnp.stack([first, n], axis=1)
+
+
+def _ragged_row(r, t0, block_q, q, row_refs, layer_ref, page_refs,
+                bufs, sem, o_ref, *, page_size, quantized, window,
+                per_head_g=None):
+    """Score one row's pages against the current q tile and merge the
+    row's live token rows into ``o_ref`` — the shared body of both
+    ragged grids (``per_head_g``: a head index for the per-head grid,
+    None for the coalesced grid whose dots batch over KV).
+
+    Per-token bit-identity across tile compositions is load-bearing
+    (split and fused engine dispatches pack the same row at different
+    flat offsets): each token row's accumulators are fresh per
+    (tile, row), fully-masked pages contribute exactly 0 (``exp``
+    underflows to +0.0 and the first real page's ``alpha`` is exactly
+    0.0), and every dot/reduction is row-wise — so a token's output
+    bits depend only on its row's content, never on tile neighbors."""
+    page_tables_ref, row_starts_ref, q_begins_ref, q_lens_ref = row_refs
+    k_pages_ref, v_pages_ref, scale_refs = page_refs
+    k_buf, v_buf, scale_bufs = bufs
+    ks_buf, vs_buf = scale_bufs if quantized else (None, None)
+    qb = q_begins_ref[r]
+    ql = q_lens_ref[r]
+    st = row_starts_ref[r]
+    G = o_ref.shape[2]
+    Hd = o_ref.shape[3]
+    R = block_q * G
+    # flat token id of each of the R q rows (G head rows per token)
+    tok = t0 + jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0) // G
+    live = (tok >= qb) & (tok < qb + ql)  # [R, ps]
+    pos = st + tok - qb
+    lo = jnp.maximum(qb, t0)
+    hi = jnp.minimum(qb + ql, t0 + block_q)
+    # causal page span of the row's tokens inside THIS tile
+    n_used = jnp.where(hi > lo, pl.cdiv(st + hi - qb, page_size), 0)
+    first = (jnp.maximum(st + lo - qb - (window - 1), 0) // page_size
+             if window is not None else 0)
+    g = slice(None) if per_head_g is None else per_head_g
+
+    def dma(slot, p):
+        return _page_dma(slot, layer_ref[0], g, page_tables_ref[r, p],
+                         k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
+                         scale_refs, scale_bufs)
+
+    @pl.when(n_used > 0)
+    def _start_first():
+        for c in dma(first % 2, first):
+            c.start()
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = p % 2
+
+        @pl.when(p + 1 < n_used)
+        def _prefetch_next():
+            for c in dma((p + 1) % 2, p + 1):
+                c.start()
+
+        copies = dma(slot, p)
+        # split waits (VERDICT #8): K (+ its scale row) lands first and
+        # the score matmul + online-softmax update run while V's copy is
+        # still in flight — including on the FINAL page, where waiting
+        # for both copies up front serialized the whole epilogue behind
+        # the last DMA
+        copies[0].wait()
+        if quantized:
+            copies[2].wait()
+        k = k_buf[slot]
+        if k.dtype != jnp.float32:
+            k = k.astype(jnp.float32)
+        if per_head_g is None:
+            # ONE batched dot over all KV heads ([KV, R, Hd] x
+            # [KV, ps, Hd] -> [KV, R, ps]) instead of the coalesced
+            # decode kernel's KV tiny per-head dots (VERDICT #8)
+            s = jax.lax.dot_general(
+                q, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            if quantized:
+                s = s * ks_buf[slot]  # [KV, 1, ps] broadcasts over R
+        else:
+            s = _scores(q, k_buf[slot],
+                        ks_buf[slot] if quantized else None)  # [R, ps]
+        ctx = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (R, page_size), 1)
+        keep = live & attend(pos, ctx, window)
+        s = jnp.where(keep if per_head_g is not None else keep[None],
+                      s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        pexp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(pexp, axis=-1, keepdims=True)
+        copies[1].wait()
+        if quantized:
+            copies[3].wait()
+        if per_head_g is None:
+            v = v_buf[slot]
+            if quantized:
+                pexp = pexp * vs_buf[slot]
+                v = v.astype(jnp.float32)
+            else:
+                pexp = pexp.astype(v.dtype)
+            pv = jax.lax.dot_general(
+                pexp, v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)  # [KV, R, Hd]
+        else:
+            pv = _weighted_values(pexp, v_buf[slot],
+                                  vs_buf[slot] if quantized else None)
+        return m_new, l_new, acc * alpha + pv
+
+    lead = () if per_head_g is not None else (q.shape[0],)
+    m0 = jnp.full((*lead, R, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((*lead, R, 1), jnp.float32)
+    a0 = jnp.zeros((*lead, R, Hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(first, n_used, body, (m0, l0, a0))
+    out = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    lt = live[:, 0].reshape(block_q, G)[:, :1]  # [bq, 1] token liveness
+    if per_head_g is None:
+        KV = q.shape[0]
+        out = jnp.moveaxis(out.reshape(KV, block_q, G, Hd), 0, 1)
+        o_ref[...] = jnp.where(lt[:, None, :, None], out, o_ref[...])
+    else:
+        out = out.reshape(block_q, G, Hd)
+        o_ref[:, 0] = jnp.where(lt[:, :, None], out, o_ref[:, 0])
+
+
+def _ragged_kernel_coalesced(
+    # scalar prefetch
+    page_tables_ref,  # [R, mp] int32 (SMEM) — per-ROW page tables
+    row_starts_ref,  # [R] int32 — global position of each row's token 0
+    q_begins_ref,  # [R] int32 — flat offset of each row's segment
+    q_lens_ref,  # [R] int32 — row token count (0 = inert row)
+    block_rows_ref,  # [nb, 2] int32 — (first_row, n_rows) per q tile
+    layer_ref,  # [1] int32
+    # inputs: q_ref [block_q, KV, G, Hd] VMEM tile of the flat axis
+    q_ref,
+    k_pages_ref,
+    v_pages_ref,
+    *rest,
+    block_q: int,
+    page_size: int,
+    sm_scale: float,
+    quantized: bool,
+    window: int | None,
+):
+    """Ragged grid ``(nb,)``: one program per flat q tile covers every
+    KV head (one ``[KV, ps, Hd]`` DMA per page, batched score/value
+    dots), looping over the rows whose segments intersect the tile."""
+    scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
+        rest, quantized)
+    t = pl.program_id(0)
+    first_row, n_rows = block_rows_ref[t, 0], block_rows_ref[t, 1]
+    KV, G, Hd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    q = jnp.moveaxis(q_ref[...].astype(jnp.float32) * sm_scale,
+                     1, 0).reshape(KV, block_q * G, Hd)
+    o_ref[...] = jnp.zeros(o_ref.shape, o_ref.dtype)
+    row_refs = (page_tables_ref, row_starts_ref, q_begins_ref, q_lens_ref)
+
+    def row_body(j, _):
+        _ragged_row(first_row + j, t * block_q, block_q, q, row_refs,
+                    layer_ref, (k_pages_ref, v_pages_ref, scale_refs),
+                    (k_buf, v_buf, scale_bufs), sem, o_ref,
+                    page_size=page_size, quantized=quantized, window=window)
+        return _
+
+    jax.lax.fori_loop(0, n_rows, row_body, 0)
+
+
+def _ragged_kernel(
+    # scalar prefetch (same layout as the coalesced grid)
+    page_tables_ref,
+    row_starts_ref,
+    q_begins_ref,
+    q_lens_ref,
+    block_rows_ref,
+    layer_ref,
+    # inputs: q_ref [block_q, 1, G, Hd] VMEM tile
+    q_ref,
+    k_pages_ref,
+    v_pages_ref,
+    *rest,
+    block_q: int,
+    page_size: int,
+    sm_scale: float,
+    quantized: bool,
+    window: int | None,
+):
+    """Ragged grid ``(nb, KV)``: the VMEM-guard escape hatch — per-head
+    ``[ps, Hd]`` page copies and per-head dots, KV× smaller scratch."""
+    scale_refs, o_ref, k_buf, v_buf, scale_bufs, sem = _split_rest(
+        rest, quantized)
+    t = pl.program_id(0)
+    g = pl.program_id(1)
+    first_row, n_rows = block_rows_ref[t, 0], block_rows_ref[t, 1]
+    G, Hd = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[:, 0].astype(jnp.float32).reshape(block_q * G, Hd) * sm_scale
+    o_ref[...] = jnp.zeros(o_ref.shape, o_ref.dtype)
+    row_refs = (page_tables_ref, row_starts_ref, q_begins_ref, q_lens_ref)
+
+    def row_body(j, _):
+        _ragged_row(first_row + j, t * block_q, block_q, q, row_refs,
+                    layer_ref, (k_pages_ref, v_pages_ref, scale_refs),
+                    (k_buf, v_buf, scale_bufs), sem, o_ref,
+                    page_size=page_size, quantized=quantized, window=window,
+                    per_head_g=g)
+        return _
+
+    jax.lax.fori_loop(0, n_rows, row_body, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret", "window", "block_q",
+                              "coalesce")
+)
+def ragged_paged_attention(
+    q: jax.Array,  # [T, H, Hd] — flat ragged-concat query tokens
+    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] or stacked [L, KV, …]
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [R, max_pages] int32 — per-ROW tables
+    row_starts: jax.Array,  # [R] int32 — global position of row's token 0
+    q_begins: jax.Array,  # [R] int32 — flat offset of each row's segment
+    q_lens: jax.Array,  # [R] int32 — row token count (0 = inert row)
+    k_scales: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] (int8)
+    v_scales: jax.Array | None = None,
+    *,
+    sm_scale: float | None = None,
+    interpret: bool = False,
+    window: int | None = None,
+    block_q: int = RAGGED_BLOCK_Q,
+    coalesce: bool | None = None,
+    layer: jax.Array | int | None = None,
+) -> jax.Array:
+    """The one true ragged paged-attention kernel → [T, H·Hd].
+
+    Token ``t`` belongs to the row ``r`` whose flat segment
+    ``[q_begins[r], q_begins[r] + q_lens[r])`` contains it, sits at
+    global position ``row_starts[r] + (t - q_begins[r])``, and attends
+    causally over row ``r``'s pages.  Decode rows (q_len=1), spec-verify
+    windows (q_len=1+drafts), budgeted prefill chunks (q_len=chunk) and
+    cache-hit suffixes all ride this one grid — no per-row rectangle
+    padding, no kernel switch between row kinds.  Rows must be packed
+    in flat order (``q_begins`` non-decreasing, segments disjoint);
+    tokens covered by no row (inter-segment padding, the tile-multiple
+    tail) produce unspecified output the caller discards.
+
+    ``coalesce``: one ``[KV, ps, Hd]`` DMA per page with batched
+    score/value dots over KV (default; ``None`` defers to
+    :func:`fusioninfer_tpu.ops.dispatch.decode_coalesce` — resolved at
+    TRACE time and latched per jit signature, so pass the resolved
+    bool explicitly when a mid-process env flip must retrace, as the
+    engine does at every dispatch) vs the per-(tile, head) grid — the
+    VMEM guard (:func:`ragged_fits_vmem`) demotes oversized
+    configurations automatically.  Per-token output
+    bits are independent of tile composition and flat offset (see
+    ``_ragged_row``), so split and fused engine dispatches scoring the
+    same row are bit-identical.
+    """
+    T, H, Hd = q.shape
+    k_pages, v_pages, k_scales, v_scales, layer_arr = _as_stacked(
+        k_pages, v_pages, k_scales, v_scales, layer)
+    KV, _, page_size, _ = k_pages.shape[1:]
+    G = H // KV
+    sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
+    quantized = k_scales is not None
+    if coalesce is None:
+        from fusioninfer_tpu.ops import dispatch
+
+        coalesce = dispatch.decode_coalesce()
+    if coalesce and not ragged_fits_vmem(
+            block_q, page_size, Hd, KV, G, q.dtype, k_pages.dtype,
+            v_pages.dtype, quantized):
+        coalesce = False
+    # pad the flat axis to a tile multiple; padding tokens belong to no
+    # row (their output is sliced off below)
+    Tp = -(-T // block_q) * block_q
+    if Tp != T:
+        q = jnp.pad(q, ((0, Tp - T), (0, 0), (0, 0)))
+    nb = Tp // block_q
+    qg = q.reshape(Tp, KV, G, Hd)
+    block_rows = _ragged_block_rows(q_begins.astype(jnp.int32),
+                                    q_lens.astype(jnp.int32), nb, block_q)
+
+    if coalesce:
+        page_specs, scratch = _page_specs_scratch(
+            page_size, Hd, k_pages.dtype, v_pages.dtype, quantized,
+            heads=KV)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec(
+                    (block_q, KV, G, Hd), lambda t, *_: (t, 0, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                *page_specs,
+            ],
+            out_specs=pl.BlockSpec(
+                (block_q, KV, G, Hd), lambda t, *_: (t, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=scratch,
+        )
+        body = _ragged_kernel_coalesced
+    else:
+        page_specs, scratch = _page_specs_scratch(
+            page_size, Hd, k_pages.dtype, v_pages.dtype, quantized)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(nb, KV),
+            in_specs=[
+                pl.BlockSpec(
+                    (block_q, 1, G, Hd), lambda t, g, *_: (t, g, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                *page_specs,
+            ],
+            out_specs=pl.BlockSpec(
+                (block_q, 1, G, Hd), lambda t, g, *_: (t, g, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=scratch,
+        )
+        body = _ragged_kernel
+    kernel = functools.partial(
+        body,
+        block_q=block_q, page_size=page_size, sm_scale=sm_scale,
+        quantized=quantized, window=window,
+    )
+    operands = [page_tables.astype(jnp.int32), row_starts.astype(jnp.int32),
+                q_begins.astype(jnp.int32), q_lens.astype(jnp.int32),
+                block_rows, layer_arr, qg, k_pages, v_pages]
+    if quantized:
+        operands += [k_scales, v_scales]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Tp, KV, G, Hd), q.dtype),
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(Tp, H * Hd)[:T]
+
+
+def ragged_token_rows(q_begins, q_lens, n_tokens: int):
+    """Per-token (row, offset, live) maps for a flat ragged layout — the
+    one definition of token→row resolution, shared by the kernel
+    wrapper's oracle, the portable gather branch and tests.  Robust to
+    zero-length rows sharing a begin with a neighbor."""
+    ends = q_begins + q_lens
+    t_idx = jnp.arange(n_tokens)
+    row_of = jnp.clip(jnp.searchsorted(ends, t_idx, side="right"),
+                      0, q_begins.shape[0] - 1)
+    off = t_idx - q_begins[row_of]
+    live = (t_idx >= q_begins[row_of]) & (t_idx < ends[row_of])
+    return row_of, off, live
+
+
+def reference_ragged_paged_attention(q, k_pages, v_pages, page_tables,
+                                     row_starts, q_begins, q_lens,
+                                     window=None):
+    """Flat gathered-context jnp oracle for the ragged kernel.  Tokens
+    covered by no row are zeroed for deterministic comparison."""
+    T, H, Hd = q.shape
+    KV, _, ps, _ = k_pages.shape
+    G = H // KV
+    mp = page_tables.shape[1]
+    row_of, off, live = ragged_token_rows(q_begins, q_lens, T)
+    pos = row_starts[row_of] + off
+    tables = page_tables[row_of]  # [T, mp]
+    k_ctx = k_pages[:, tables].reshape(KV, T, mp * ps, Hd)
+    v_ctx = v_pages[:, tables].reshape(KV, T, mp * ps, Hd)
+    qg = q.reshape(T, KV, G, Hd)
+    s = jnp.einsum("tkgd,ktsd->ktgs", qg.astype(jnp.float32),
+                   k_ctx.astype(jnp.float32)) / jnp.sqrt(Hd)
+    ctx = jnp.arange(mp * ps)
+    mask = attend(pos[:, None], ctx[None, :], window) & live[:, None]
+    s = jnp.where(mask[None, :, None, :], s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1) * live[None, :, None, None]
+    out = jnp.einsum("ktgs,ktsd->tkgd", probs, v_ctx.astype(jnp.float32))
+    return out.reshape(T, H * Hd).astype(q.dtype)
 
 
 def reference_paged_verify_attention(q, k_pages, v_pages, page_tables,

@@ -18,7 +18,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-from jax import shard_map
+from fusioninfer_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fusioninfer_tpu.ops.flash_attention import flash_attention
@@ -27,6 +27,7 @@ from fusioninfer_tpu.ops.paged_attention import (
     paged_decode_attention,
     paged_prefill_attention,
     paged_verify_attention,
+    ragged_paged_attention,
 )
 
 
@@ -109,6 +110,60 @@ def paged_decode_attention_tp(
     def run(q, kp, vp, pt, ln, l, *scales):
         ks, vs = scales if scales else (None, None)
         return paged_decode_attention(q, kp, vp, pt, ln, ks, vs,
+                                      interpret=interpret, window=window,
+                                      coalesce=coalesce, layer=l)
+
+    fn = shard_map(
+        run,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P(None, "tp"),
+        check_vma=False,
+    )
+    return fn(*args)
+
+
+def ragged_paged_attention_tp(
+    mesh: Mesh,
+    q: jax.Array,  # [T, H, Hd] flat ragged tokens — H sharded over tp
+    k_pages: jax.Array,  # [(L,) KV, n_pages, ps, Hd] — KV sharded over tp
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [R, mp] replicated
+    row_starts: jax.Array,  # [R] replicated
+    q_begins: jax.Array,  # [R] replicated
+    q_lens: jax.Array,  # [R] replicated
+    k_scale: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] — int8
+    v_scale: jax.Array | None = None,
+    *,
+    interpret: bool = False,
+    window: int | None = None,
+    coalesce: bool | None = None,  # resolved by the engine per call
+    layer: jax.Array | int | None = None,
+) -> jax.Array:
+    """Per-shard ragged paged attention → [T, H·Hd] sharded on features.
+    The row descriptors are replicated (they index tokens and pages, not
+    heads); each shard runs the one ragged kernel on its local heads."""
+    k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
+        k_pages, v_pages, k_scale, v_scale, layer)
+    in_specs = [
+        P(None, "tp", None),
+        P(None, "tp", None, None, None),
+        P(None, "tp", None, None, None),
+        P(None, None),
+        P(None),
+        P(None),
+        P(None),
+        P(None),
+    ]
+    args = [q, k_pages, v_pages, page_tables, row_starts, q_begins,
+            q_lens, layer]
+    if k_scale is not None:
+        in_specs += [_SCALE_SPEC, _SCALE_SPEC]
+        args += [k_scale, v_scale]
+
+    def run(q, kp, vp, pt, rs, qb, ql, l, *scales):
+        ks, vs = scales if scales else (None, None)
+        return ragged_paged_attention(q, kp, vp, pt, rs, qb, ql, ks, vs,
                                       interpret=interpret, window=window,
                                       coalesce=coalesce, layer=l)
 

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from fusioninfer_tpu.utils import jax_compat
+from fusioninfer_tpu.utils.jax_compat import shard_map
 
 NEG_INF = -1e30
 
@@ -76,7 +77,7 @@ def ring_attention_local(
     B, S, H, Hd = q.shape
     KV = k.shape[2]
     G = H // KV
-    n = lax.axis_size(axis_name)
+    n = jax_compat.axis_size(axis_name)
     me = lax.axis_index(axis_name)
 
     q_pos = me * S + jnp.arange(S)

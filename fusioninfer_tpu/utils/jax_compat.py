@@ -1,0 +1,47 @@
+"""jax version compatibility shims.
+
+The repo targets current jax, where ``shard_map`` is a top-level export
+and the replication-check kwarg is ``check_vma``.  Some serving images
+pin older jax releases (observed: 0.4.x) where it still lives under
+``jax.experimental.shard_map`` and the kwarg is ``check_rep`` — there
+the bare import made every tensor-parallel module (ops/sharded,
+parallel/ring) fail at IMPORT time, taking the whole TP/ring/mesh test
+surface down with it.  One shim, one place.
+"""
+
+from __future__ import annotations
+
+try:  # current jax
+    from jax import shard_map as _shard_map
+
+    _LEGACY = False
+except ImportError:  # jax < 0.6
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+# True on old-jax images.  A handful of SPMD behaviors genuinely differ
+# there (pjit donation-sharding checks, EP all-to-all numerics); tests
+# that pin current-jax semantics skip on it with a named reason instead
+# of burning tier-1 minutes on a known version gap.
+LEGACY_JAX = _LEGACY
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the current-jax kwarg surface, mapped to
+    the experimental API on older releases."""
+    if _LEGACY and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name: str) -> int:
+    """``lax.axis_size`` (current jax) with the classic
+    ``psum(1, axis)`` fallback — inside shard_map both resolve to a
+    concrete python int at trace time, so callers can build static
+    permutation tables from it."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

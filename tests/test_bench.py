@@ -34,7 +34,13 @@ class TestLockfileInspection:
             finally:
                 fcntl.flock(f, fcntl.LOCK_UN)
         info = out[str(lock)]
-        assert os.getpid() in info["holder_pids"]
+        # the flock probe is the authoritative held signal; pid NAMING
+        # additionally needs /proc/locks, which some sandboxes (this
+        # container's 4.4 kernel) do not expose — there the held lock
+        # must still survive, with no pid attribution
+        assert info["held"] is True
+        if os.path.exists("/proc/locks"):
+            assert os.getpid() in info["holder_pids"]
         assert "removed_stale" not in info
         assert lock.exists()
 
@@ -307,7 +313,13 @@ class TestBenchRecordChecker:
     serving-path-gap fields (make bench-smoke / CI)."""
 
     def _good(self):
-        return {"http": {
+        return {"kernel_microbench": {
+            "ragged": {"calls_per_s": 10.0, "rel_iqr": 0.01},
+            "gather": {"calls_per_s": 5.0, "rel_iqr": 0.01},
+            "padded_rect": {"calls_per_s": 5.0, "rel_iqr": 0.01},
+            "ragged_vs_gather": 2.0, "ragged_vs_padded": 2.0,
+            "mfu_box": 0.3,
+        }, "http": {
             "ceiling_fraction": 0.4,
             "weight_passes_per_step": 1.05,
             "queue_wait_ms": {"p50": 1.0, "p90": 2.0, "max": 3.0},
@@ -347,10 +359,31 @@ class TestBenchRecordChecker:
         assert any("scheduler.fused_steps" in p for p in problems)
         assert any("scheduler.weight_passes" in p for p in problems)
 
-    def test_decode_only_run_is_exempt(self):
-        """BENCH_SKIP_HTTP=1 records have no http leg by design — the
-        checker must not fail them; an errored bench still flags."""
+    def test_missing_kernel_microbench_flagged(self):
+        """The ragged-kernel leg (r06): dispersion + both ratio fields
+        + mfu_box must land in every record."""
         from tools.check_bench_record import check_record
 
-        assert check_record({"value": 1.0}) == []
+        rec = self._good()
+        del rec["kernel_microbench"]
+        assert any("kernel_microbench" in p for p in check_record(rec))
+        rec = self._good()
+        del rec["kernel_microbench"]["ragged_vs_padded"]
+        del rec["kernel_microbench"]["mfu_box"]
+        del rec["kernel_microbench"]["ragged"]["rel_iqr"]
+        problems = check_record(rec)
+        assert any("ragged_vs_padded" in p for p in problems)
+        assert any("mfu_box" in p for p in problems)
+        assert any("rel_iqr" in p for p in problems)
+
+    def test_decode_only_run_is_exempt(self):
+        """BENCH_SKIP_HTTP=1 records have no http leg by design — the
+        checker must not fail the http fields on them; an errored bench
+        still flags, and the kernel microbench is required regardless."""
+        from tools.check_bench_record import check_record
+
+        assert check_record(
+            {"value": 1.0,
+             "kernel_microbench": self._good()["kernel_microbench"]}) == []
         assert check_record({"error": "boom"}) == ["bench errored: boom"]
+        assert check_record({"value": 1.0}) == ["kernel_microbench leg missing"]

@@ -19,10 +19,13 @@ forward and a decode forward back to back.  The invariants under test:
 """
 
 import numpy as np
-import pytest
 
 from fusioninfer_tpu.engine.engine import NativeEngine, Request
-from fusioninfer_tpu.engine.fused import FusedBatch, pack_mixed_batch, pow2_rows
+from fusioninfer_tpu.engine.fused import (
+    RaggedBatch,
+    pack_ragged_batch,
+    pow2_rows,
+)
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
 from fusioninfer_tpu.engine.sampler import SamplingParams
 from fusioninfer_tpu.models.config import get_preset
@@ -48,14 +51,14 @@ def _run_all(engine, requests, max_steps=400):
     return tokens
 
 
-def _mixed_reqs(seed=5, max_tokens=8):
+def _mixed_reqs(seed=5, max_tokens=8, prompt_len=100):
     """A decode stream + a long chunking prompt + a short prompt — the
     mixed-load shape the fused step exists for."""
     rng = np.random.default_rng(seed)
     return [
         Request("stream", [1, 2, 3],
                 SamplingParams(max_tokens=20, temperature=0.0)),
-        Request("long", rng.integers(1, CFG.vocab_size, 100).tolist(),
+        Request("long", rng.integers(1, CFG.vocab_size, prompt_len).tolist(),
                 SamplingParams(max_tokens=max_tokens, temperature=0.8,
                                seed=77)),
         Request("short", rng.integers(1, CFG.vocab_size, 9).tolist(),
@@ -67,57 +70,93 @@ class TestPacking:
     def test_pow2_rows(self):
         assert [pow2_rows(n) for n in (1, 2, 3, 8, 9)] == [1, 2, 4, 8, 16]
 
-    def test_slot_aligned_layout(self):
+    def test_slot_aligned_flat_layout(self):
         window = np.array([[7], [0], [9], [0]], np.int32)  # B=4, W=1
         counts_w = np.array([1, 0, 1, 0], np.int32)
         positions = np.array([5, 0, 12, 0], np.int32)
         tables = np.arange(8, dtype=np.int32).reshape(4, 2)
         adapters = np.array([0, 0, 1, 0], np.int32)
         entries = [([3, 4, 5], 32, np.array([6, 7], np.int32), 2)]
-        p = pack_mixed_batch(window, counts_w, positions, tables, adapters,
-                             entries, bucket=32, trash_page=99)
-        assert isinstance(p, FusedBatch)
-        assert p.tokens.shape == (8, 32)  # pow2(4 + 1) rows
-        # decode rows are the batch SLOTS (logits row i == slot i)
-        assert p.tokens[0, 0] == 7 and p.counts[0] == 1 and p.starts[0] == 5
-        assert p.counts[1] == 0
-        assert (p.sel[:4] == 0).all()  # W=1: decode rows read position 0
-        # chunk row rides row B, reads its last real position
-        assert list(p.tokens[4, :3]) == [3, 4, 5]
-        assert p.starts[4] == 32 and p.counts[4] == 3 and p.sel[4, 0] == 2
-        assert p.adapter_ids[4] == 2
-        # padding rows are inert
-        assert p.counts[5:].sum() == 0 and (p.page_tables[5:] == 99).all()
+        p = pack_ragged_batch(window, counts_w, positions, tables, adapters,
+                              entries, trash_page=99)
+        assert isinstance(p, RaggedBatch)
+        # ONE flat token axis — 5 real tokens pad to the 16-token
+        # signature floor, never to a [rows, C] rectangle
+        assert p.tokens.shape == (16,)
+        assert p.q_begins.shape == (8,)  # pow2(4 + 1) rows
+        # live decode tokens then chunk tokens, no inter-row rectangle
+        assert list(p.tokens[:5]) == [7, 9, 3, 4, 5]
         assert p.packed_tokens == 5  # 2 live decode + 3 chunk tokens
+
+    def test_flat_segments_and_sel(self):
+        window = np.array([[7], [0], [9], [0]], np.int32)
+        counts_w = np.array([1, 0, 1, 0], np.int32)
+        positions = np.array([5, 0, 12, 0], np.int32)
+        tables = np.arange(8, dtype=np.int32).reshape(4, 2)
+        adapters = np.array([0, 0, 1, 0], np.int32)
+        entries = [([3, 4, 5], 32, np.array([6, 7], np.int32), 2)]
+        p = pack_ragged_batch(window, counts_w, positions, tables, adapters,
+                              entries, trash_page=99)
+        # decode rows are the batch SLOTS (logits row i == slot i);
+        # dead slots hold zero-length segments
+        assert list(p.q_lens[:5]) == [1, 0, 1, 0, 3]
+        assert list(p.q_begins[:5]) == [0, 1, 1, 2, 2]
+        assert p.tokens[0] == 7 and p.tokens[1] == 9
+        assert list(p.tokens[2:5]) == [3, 4, 5]
+        assert p.row_starts[0] == 5 and p.row_starts[2] == 12
+        # sel covers ONLY the decode slots, pointing at their own
+        # FLAT segments
+        assert p.sel.shape == (4, 1)
+        assert p.sel[0, 0] == 0 and p.sel[2, 0] == 1
+        # chunk row rides row B at its own start; its last real token
+        # projects through the separate shape-stable chunk_sel group
+        assert p.row_starts[4] == 32
+        assert p.chunk_sel.shape == (1,) and p.chunk_sel[0] == 4
+        assert p.adapter_ids[4] == 2
+        # padding rows are inert: zero-length segments, trash tables
+        assert p.q_lens[5:].sum() == 0 and (p.page_tables[5:] == 99).all()
+        assert p.packed_tokens == 5
 
     def test_spec_window_sel(self):
         window = np.array([[7, 8, 9], [0, 0, 0]], np.int32)  # W=3
-        p = pack_mixed_batch(window, np.array([3, 0], np.int32),
-                             np.array([4, 0], np.int32),
-                             np.full((2, 2), 0, np.int32),
-                             np.zeros(2, np.int32),
-                             [([1], 0, np.zeros(2, np.int32), 0)],
-                             bucket=32, trash_page=9)
-        assert list(p.sel[0]) == [0, 1, 2]  # decode rows: the spec window
-        assert (p.sel[2] == 0).all()  # 1-token chunk: last real position
+        p = pack_ragged_batch(window, np.array([3, 0], np.int32),
+                              np.array([4, 0], np.int32),
+                              np.full((2, 2), 0, np.int32),
+                              np.zeros(2, np.int32),
+                              [([1], 0, np.zeros(2, np.int32), 0)],
+                              trash_page=9)
+        # decode row 0's spec window is its own flat segment [0, 3)
+        assert list(p.sel[0]) == [0, 1, 2]
+        assert list(p.tokens[:4]) == [7, 8, 9, 1]
+        # 1-token chunk row: its last (only) real flat position
+        assert list(p.chunk_sel) == [3]
 
-    def test_oversized_chunk_rejected(self):
-        with pytest.raises(ValueError):
-            pack_mixed_batch(
-                np.zeros((1, 1), np.int32), np.zeros(1, np.int32),
-                np.zeros(1, np.int32), np.zeros((1, 2), np.int32),
-                np.zeros(1, np.int32),
-                [(list(range(40)), 0, np.zeros(2, np.int32), 0)],
-                bucket=32, trash_page=9)
+    def test_chunks_only_packs_without_decode_rows(self):
+        """B == 0: the chunk-advance / batched-suffix shape — chunk rows
+        are rows 0.. and the flat axis carries only their tokens."""
+        p = pack_ragged_batch(
+            np.zeros((0, 1), np.int32), np.zeros((0,), np.int32),
+            np.zeros((0,), np.int32), np.zeros((0, 2), np.int32),
+            np.zeros((0,), np.int32),
+            [([5, 6], 0, np.array([1, 2], np.int32), 0),
+             ([7], 10, np.array([3, 4], np.int32), 1)],
+            trash_page=9)
+        assert list(p.q_lens[:2]) == [2, 1]
+        assert list(p.tokens[:3]) == [5, 6, 7]
+        assert p.sel.shape == (0, 1)
+        assert list(p.chunk_sel) == [1, 2]
+        assert p.adapter_ids[1] == 1
 
 
 class TestEquivalence:
     """Bit-identity: the fused step must be invisible in the streams."""
 
-    def _ab(self, reqs_fn, **engine_kw):
-        split = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+    def _ab(self, reqs_fn, cache_cfg=None, **engine_kw):
+        split = NativeEngine(CFG, cache_cfg=cache_cfg or _cache_cfg(),
+                             max_batch_size=4,
                              token_budget=16, fused_step=False, **engine_kw)
-        fused = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4,
+        fused = NativeEngine(CFG, cache_cfg=cache_cfg or _cache_cfg(),
+                             max_batch_size=4,
                              token_budget=16, fused_step=True, **engine_kw)
         a = _run_all(split, reqs_fn())
         b = _run_all(fused, reqs_fn())
@@ -128,6 +167,18 @@ class TestEquivalence:
 
     def test_mixed_load_greedy_and_seeded_sampled(self):
         self._ab(_mixed_reqs)
+
+    def test_quantized_kv_int8(self):
+        """int8 KV pages (per-token scales folded at read time) must be
+        bit-identical fused vs split too — the scales ride the same
+        ragged descriptors as the pages, and quantization amplifies any
+        low-bit forward divergence into whole int8 buckets (this A/B
+        caught both the scale-in-dot rewrite and the solo-suffix
+        rectangle path)."""
+        self._ab(lambda: _mixed_reqs(prompt_len=72),
+                 cache_cfg=CacheConfig(n_pages=65, page_size=16,
+                                       max_pages_per_seq=16,
+                                       kv_dtype="int8"))
 
     def test_logprobs_and_bias_rows_in_the_mix(self):
         """Tail-path rows (logprobs, logit_bias) share the fused decode
@@ -294,6 +345,62 @@ class TestEquivalence:
         assert eb.cancelled_total == 1
         # every page returned (one reserved trash page stays allocator-held)
         assert eb.alloc.free_pages == ea.alloc.free_pages
+
+
+class TestRaggedIsTheOnlyLayout:
+    """Once ragged is default there must be NO path back to the padded
+    ``[rows, C]`` rectangle: the packer module exports only the flat
+    layout, the model path's sources never name the retired packer, and
+    a kernel-path engine drain never reaches the legacy padded kernels
+    (they survive only as standalone bench baselines)."""
+
+    def test_padded_rectangle_packer_is_gone(self):
+        import fusioninfer_tpu.engine.fused as fused
+
+        assert not hasattr(fused, "pack_mixed_batch")
+        assert not hasattr(fused, "FusedBatch")
+
+    def test_model_path_sources_never_name_the_rectangle(self):
+        import inspect
+
+        import fusioninfer_tpu.engine.engine as eng
+        import fusioninfer_tpu.engine.model_runner as mr
+
+        for mod in (eng, mr):
+            assert "pack_mixed_batch" not in inspect.getsource(mod)
+        src = inspect.getsource(mr)
+        # the model path's kernel branches all call the one ragged
+        # kernel; the standalone decode/verify/suffix kernels are
+        # bench/compat surface only
+        assert "paged_verify_attention(" not in src
+        assert "paged_decode_attention(" not in src
+        assert "paged_prefill_attention(" not in src
+
+    def test_kernel_path_never_calls_legacy_kernels(self, monkeypatch):
+        """A kernel-path (interpret) mixed drain with the legacy padded
+        kernels booby-trapped: decode, chunks and suffixes must all
+        score through ragged_paged_attention alone."""
+        import dataclasses
+
+        import fusioninfer_tpu.ops.paged_attention as pa
+
+        def bomb(*a, **k):
+            raise AssertionError("legacy padded kernel reached from "
+                                 "the engine model path")
+
+        for name in ("paged_verify_attention", "paged_decode_attention",
+                     "paged_prefill_attention"):
+            monkeypatch.setattr(pa, name, bomb)
+        cfg = dataclasses.replace(CFG, attn_impl="flash")
+        engine = NativeEngine(cfg, cache_cfg=_cache_cfg(), max_batch_size=2,
+                              token_budget=16, fused_step=True)
+        _run_all(engine, [
+            Request("s", [1, 2, 3],
+                    SamplingParams(max_tokens=2, temperature=0.0)),
+            Request("long", list(range(1, 28)),
+                    SamplingParams(max_tokens=1, temperature=0.0)),
+        ])
+        assert engine.sched.fused_steps_total > 0
 
 
 class TestWeightPassLedger:

@@ -387,3 +387,67 @@ class TestStackedLayerHW:
             np.testing.assert_allclose(
                 np.asarray(out, np.float32), np.asarray(ref, np.float32),
                 atol=5e-2, rtol=5e-2)
+
+
+class TestRaggedPagedAttentionHW:
+    """The one true ragged kernel (r06 tentpole) with interpret=False at
+    bench shapes: a Mosaic rejection of the flat-tile layout must fail
+    here, not at driver-bench time (the round-2 lesson)."""
+
+    def _ragged(self, q_lens, starts, seed, KV=8, G=2, Hd=128, ps=128,
+                n_pages=257, mp=8):
+        q_lens = np.asarray(q_lens, np.int32)
+        starts = np.asarray(starts, np.int32)
+        q_begins = np.concatenate([[0], np.cumsum(q_lens)[:-1]]).astype(
+            np.int32)
+        T = int(q_lens.sum())
+        H = KV * G
+        ks = jax.random.split(jax.random.key(seed), 3)
+        q = jax.random.normal(ks[0], (T, H, Hd), jnp.bfloat16)
+        kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), jnp.bfloat16)
+        rng = np.random.default_rng(seed)
+        tables = np.full((len(q_lens), mp), n_pages - 1, np.int32)
+        perm = iter(rng.permutation(n_pages - 1))
+        for r in range(len(q_lens)):
+            need = -(-int(starts[r] + q_lens[r]) // ps) if q_lens[r] else 0
+            for i in range(min(need, mp)):
+                tables[r, i] = next(perm)
+        return (q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+                jnp.asarray(q_begins), jnp.asarray(q_lens))
+
+    @pytest.mark.parametrize("coalesce", [True, False])
+    def test_mixed_bench_shapes_bf16(self, coalesce):
+        """Decode rows at ragged depths + a dead slot + a spec window +
+        a 512-token chunk — the fused-step mix — must COMPILE on the
+        chip and match the flat-gather oracle."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            ragged_paged_attention,
+            reference_ragged_paged_attention,
+        )
+
+        q, kp, vp, tables, starts, qb, ql = self._ragged(
+            q_lens=[1, 1, 0, 3, 512, 1], starts=[129, 7, 0, 500, 0, 1015],
+            seed=31)
+        out = ragged_paged_attention(q, kp, vp, tables, starts, qb, ql,
+                                     interpret=False, coalesce=coalesce)
+        out.block_until_ready()
+        ref = reference_ragged_paged_attention(q, kp, vp, tables, starts,
+                                               qb, ql)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=5e-2, rtol=5e-2)
+
+    def test_decode_only_offset_invariance_bits(self):
+        """The scorer-switch retirement contract ON HARDWARE: the same
+        row packed solo vs among neighbors is bit-identical."""
+        from fusioninfer_tpu.ops.paged_attention import ragged_paged_attention
+
+        q, kp, vp, tables, starts, qb, ql = self._ragged(
+            q_lens=[1, 1, 1, 1], starts=[129, 7, 500, 1015], seed=33)
+        mixed = np.asarray(ragged_paged_attention(
+            q, kp, vp, tables, starts, qb, ql, interpret=False))
+        solo = np.asarray(ragged_paged_attention(
+            q[2:3], kp, vp, tables[2:3], starts[2:3],
+            jnp.zeros((1,), jnp.int32), ql[2:3], interpret=False))
+        np.testing.assert_array_equal(solo[0], mixed[2])

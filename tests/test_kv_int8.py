@@ -229,6 +229,9 @@ class TestEngineInt8KV:
         got = drain(decoder)
         assert got == expected
 
+    # mesh-wide engine drains: tier-1 keeps the faster kernel-level
+    # int8 coverage; these run in the unfiltered CI pytest job
+    @pytest.mark.slow
     def test_tp_mesh_matches_single_device_int8(self):
         """tp=2 × int8 KV pages: greedy tokens identical to the
         single-device int8 engine (scales shard over tp with their
@@ -265,6 +268,7 @@ class TestEngineInt8KV:
         got = run(mesh)
         assert got == ref, f"tp2 int8-KV decode diverged: {got} != {ref}"
 
+    @pytest.mark.slow
     def test_tp_kernel_mesh_matches_single_device_int8(self):
         """tp=2 × int8 KV through the shard_map'd Pallas kernels
         (interpret off-TPU): per-shard scale folding must reproduce the

@@ -1,4 +1,11 @@
-"""Pallas paged decode + suffix-prefill attention vs gather oracles (interpret mode)."""
+"""Pallas paged attention kernels vs gather oracles (interpret mode).
+
+Covers the standalone decode/suffix kernels AND the one true ragged
+kernel (``ragged_paged_attention``) every engine forward routes
+through — including the load-bearing bit-identity property: a row's
+output bits are independent of its flat offset and tile neighbors, so
+split and fused engine dispatches score identically.
+"""
 
 import jax
 import pytest
@@ -8,8 +15,11 @@ import numpy as np
 from fusioninfer_tpu.ops.paged_attention import (
     paged_decode_attention,
     paged_prefill_attention,
+    ragged_paged_attention,
+    ragged_token_rows,
     reference_paged_attention,
     reference_paged_prefill_attention,
+    reference_ragged_paged_attention,
 )
 
 
@@ -162,6 +172,205 @@ def test_stacked_requires_layer():
     with pytest.raises(ValueError, match="only applies"):
         paged_decode_attention(q, kp, vp, tables, lengths,
                                interpret=True, layer=0)
+
+
+def _ragged_setup(q_lens, starts, KV=2, G=2, Hd=64, ps=16, n_pages=17,
+                  mp=4, seed=0, dtype=jnp.float32):
+    """Flat ragged operand set: rows with the given token counts and
+    global start positions, each over its own permuted pages."""
+    q_lens = np.asarray(q_lens, np.int32)
+    starts = np.asarray(starts, np.int32)
+    q_begins = np.concatenate([[0], np.cumsum(q_lens)[:-1]]).astype(np.int32)
+    T = int(q_lens.sum())
+    H = KV * G
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (T, H, Hd), dtype)
+    kp = jax.random.normal(ks[1], (KV, n_pages, ps, Hd), dtype)
+    vp = jax.random.normal(ks[2], (KV, n_pages, ps, Hd), dtype)
+    rng = np.random.default_rng(seed)
+    tables = np.full((len(q_lens), mp), n_pages - 1, np.int32)
+    perm = iter(rng.permutation(n_pages - 1))
+    for r in range(len(q_lens)):
+        need = -(-int(starts[r] + q_lens[r]) // ps) if q_lens[r] else 0
+        for i in range(min(need, mp)):
+            tables[r, i] = next(perm)
+    return (q, kp, vp, jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(q_begins), jnp.asarray(q_lens))
+
+
+# the mixed fused-step shape: decode rows, a dead slot, a spec window,
+# a budgeted chunk — T=15 also exercises the tile-multiple pad
+_MIXED = dict(q_lens=[1, 0, 3, 10, 1], starts=[37, 0, 20, 5, 63])
+
+
+class TestRaggedKernel:
+    @pytest.mark.parametrize("coalesce", [False, True])
+    def test_mixed_rows_match_oracle(self, coalesce):
+        q, kp, vp, tables, starts, qb, ql = _ragged_setup(**_MIXED)
+        out = ragged_paged_attention(q, kp, vp, tables, starts, qb, ql,
+                                     interpret=True, coalesce=coalesce)
+        ref = reference_ragged_paged_attention(q, kp, vp, tables, starts,
+                                               qb, ql)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("coalesce", [False, True])
+    def test_decode_only_rows(self, coalesce):
+        """Pure decode (every q_len 1, one dead row) — the split decode
+        dispatch's degenerate descriptor shape."""
+        q, kp, vp, tables, starts, qb, ql = _ragged_setup(
+            q_lens=[1, 1, 0, 1], starts=[12, 40, 0, 60], seed=3)
+        out = ragged_paged_attention(q, kp, vp, tables, starts, qb, ql,
+                                     interpret=True, coalesce=coalesce)
+        ref = reference_ragged_paged_attention(q, kp, vp, tables, starts,
+                                               qb, ql)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gqa_bf16(self):
+        q, kp, vp, tables, starts, qb, ql = _ragged_setup(
+            q_lens=[1, 6], starts=[30, 9], KV=2, G=4, dtype=jnp.bfloat16,
+            seed=7)
+        out = ragged_paged_attention(q, kp, vp, tables, starts, qb, ql,
+                                     interpret=True)
+        ref = reference_ragged_paged_attention(q, kp, vp, tables, starts,
+                                               qb, ql)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=4e-2, rtol=4e-2)
+
+    @pytest.mark.parametrize("coalesce", [False, True])
+    def test_sliding_window(self, coalesce):
+        q, kp, vp, tables, starts, qb, ql = _ragged_setup(
+            q_lens=[1, 6, 2], starts=[60, 24, 40], mp=6, seed=5,
+            n_pages=17)
+        out = ragged_paged_attention(q, kp, vp, tables, starts, qb, ql,
+                                     interpret=True, window=24,
+                                     coalesce=coalesce)
+        ref = reference_ragged_paged_attention(q, kp, vp, tables, starts,
+                                               qb, ql, window=24)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("coalesce", [False, True])
+    def test_int8_scaled_pages(self, coalesce):
+        from fusioninfer_tpu.models.quantization import kv_quantize
+
+        q, kp, vp, tables, starts, qb, ql = _ragged_setup(**_MIXED, seed=11)
+        k8, k_s = kv_quantize(kp)  # scales [KV, n_pages, ps]
+        v8, v_s = kv_quantize(vp)
+        out = ragged_paged_attention(q, k8, v8, tables, starts, qb, ql,
+                                     k_s[:, :, None, :], v_s[:, :, None, :],
+                                     interpret=True, coalesce=coalesce)
+        # oracle over the dequantized pages
+        kd = k8.astype(jnp.float32) * k_s[..., None]
+        vd = v8.astype(jnp.float32) * v_s[..., None]
+        ref = reference_ragged_paged_attention(q, kd, vd, tables, starts,
+                                               qb, ql)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)
+
+    @pytest.mark.parametrize("coalesce", [False, True])
+    def test_stacked_layer_operand(self, coalesce):
+        """The production path passes the FULL [L, KV, ...] stacked
+        pools plus a layer scalar (the in-place cache design)."""
+        L = 3
+        ops = [_ragged_setup(**_MIXED, seed=20 + layer) for layer in range(L)]
+        k_stack = jnp.stack([o[1] for o in ops])
+        v_stack = jnp.stack([o[2] for o in ops])
+        for layer in range(L):
+            q, kp, vp, tables, starts, qb, ql = ops[layer]
+            out = ragged_paged_attention(
+                q, k_stack, v_stack, tables, starts, qb, ql,
+                interpret=True, coalesce=coalesce, layer=jnp.int32(layer))
+            ref = ragged_paged_attention(
+                q, kp, vp, tables, starts, qb, ql,
+                interpret=True, coalesce=coalesce)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_offset_and_neighbor_invariance_bit_identity(self):
+        """THE property that retires the scorer switch: a row scored
+        alone, and the same row packed among neighbors at a different
+        flat offset, produce bit-identical outputs — so decode-only and
+        fused mixed dispatches can never disagree."""
+        q, kp, vp, tables, starts, qb, ql = _ragged_setup(**_MIXED)
+        mixed = np.asarray(ragged_paged_attention(
+            q, kp, vp, tables, starts, qb, ql, interpret=True))
+        qb_h = np.asarray(qb)
+        ql_h = np.asarray(ql)
+        for r in [0, 2, 3]:
+            seg = slice(int(qb_h[r]), int(qb_h[r] + ql_h[r]))
+            solo = np.asarray(ragged_paged_attention(
+                q[seg], kp, vp, tables[r: r + 1], starts[r: r + 1],
+                jnp.zeros((1,), jnp.int32), ql[r: r + 1], interpret=True))
+            np.testing.assert_array_equal(solo, mixed[seg])
+
+    def test_matches_flattened_verify_rectangle(self):
+        """The ragged kernel over a flattened [B, C] rectangle computes
+        the verify kernel's math (tolerance — different tilings)."""
+        from fusioninfer_tpu.ops.paged_attention import paged_verify_attention
+
+        B, C = 2, 8
+        q, kp, vp, tables, starts, qb, ql = _ragged_setup(
+            q_lens=[C, C], starts=[3, 17], seed=9)
+        counts = jnp.asarray([5, 8], jnp.int32)
+        rect = paged_verify_attention(
+            q.reshape(B, C, *q.shape[1:]), kp, vp, tables, starts, counts,
+            interpret=True)
+        flat = ragged_paged_attention(q, kp, vp, tables, starts, qb, counts,
+                                      interpret=True)
+        rect_np = np.asarray(rect, np.float32).reshape(B, C, -1)
+        flat_np = np.asarray(flat, np.float32).reshape(B, C, -1)
+        for b, n in enumerate([5, 8]):  # padding rows are unspecified
+            np.testing.assert_allclose(flat_np[b, :n], rect_np[b, :n],
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_token_rows_zero_length_neighbors(self):
+        """Token→row resolution must skip zero-length rows that share a
+        begin with a live neighbor (dead decode slots)."""
+        qb = jnp.asarray([0, 1, 1, 1, 4], jnp.int32)
+        ql = jnp.asarray([1, 0, 0, 3, 0], jnp.int32)
+        row_of, off, live = ragged_token_rows(qb, ql, 6)
+        assert list(np.asarray(row_of)[:4]) == [0, 3, 3, 3]
+        assert list(np.asarray(live)) == [True] * 4 + [False, False]
+        assert list(np.asarray(off)[:4]) == [0, 0, 1, 2]
+
+
+class TestRaggedVmemGuard:
+    def test_fits_vmem_adds_tile_term(self):
+        from fusioninfer_tpu.ops.paged_attention import (
+            coalesced_scratch_bytes,
+            ragged_fits_vmem,
+        )
+
+        assert ragged_fits_vmem(8, 128, 128, 8, 4, jnp.bfloat16,
+                                jnp.bfloat16, jnp.bfloat16,
+                                quantized=False)  # the serving shape
+        # the tile term matters: a budget that fits the page scratch
+        # alone must reject once q/out tiles are counted
+        pages = coalesced_scratch_bytes(16, 64, 2, jnp.float32,
+                                        jnp.float32, quantized=False)
+        assert not ragged_fits_vmem(8, 16, 64, 2, 2, jnp.float32,
+                                    jnp.float32, jnp.float32,
+                                    quantized=False, budget=pages + 1)
+
+    def test_oversized_falls_back_to_per_head_grid(self, monkeypatch):
+        from fusioninfer_tpu.ops import paged_attention as pa
+
+        def bomb(*a, **k):
+            raise AssertionError("coalesced ragged kernel entered despite "
+                                 "over-budget scratch")
+
+        monkeypatch.setattr(pa, "_ragged_kernel_coalesced", bomb)
+        monkeypatch.setattr(pa, "_COALESCE_VMEM_SCRATCH_BUDGET", 1024)
+        q, kp, vp, tables, starts, qb, ql = _ragged_setup(**_MIXED)
+        out = pa.ragged_paged_attention.__wrapped__(
+            q, kp, vp, tables, starts, qb, ql, interpret=True,
+            coalesce=True)
+        ref = reference_ragged_paged_attention(q, kp, vp, tables, starts,
+                                               qb, ql)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
 
 
 class TestCoalesceVmemGuard:

@@ -139,6 +139,10 @@ class TestEngineInt8:
         assert len(first) == 6
         assert first == run()
 
+    # mesh-wide engine drain (see test_engine.TestTensorParallelEngine):
+    # tier-1 keeps the faster kernel-level TP coverage; this runs in the
+    # unfiltered CI pytest job
+    @pytest.mark.slow
     def test_int8_weights_tp_matches_single_device(self):
         """int8 weights × tp=2 (VERDICT r3 ask #3): quantized leaves
         shard ``_q8`` like the bf16 weight and replicate the reduced

@@ -23,12 +23,29 @@ import sys
 def check_record(record: dict) -> list[str]:
     """Return the list of missing-field complaints (empty = pass)."""
     problems: list[str] = []
+    if record.get("error"):
+        problems.append(f"bench errored: {record['error']}")
+        return problems
+    # ragged-kernel microbench leg (r06): dispersion + the two ratio
+    # fields + mfu_box must land in every record, so a regression that
+    # silently drops the kernel evidence fails CI
+    micro = record.get("kernel_microbench")
+    if not isinstance(micro, dict):
+        problems.append("kernel_microbench leg missing")
+    elif micro.get("error"):
+        problems.append(f"kernel_microbench errored: {micro['error']}")
+    else:
+        for field in ("ragged_vs_gather", "ragged_vs_padded", "mfu_box"):
+            if field not in micro:
+                problems.append(f"kernel_microbench.{field} missing")
+        ragged = micro.get("ragged")
+        if not isinstance(ragged, dict) or "rel_iqr" not in ragged:
+            problems.append(
+                "kernel_microbench.ragged dispersion (rel_iqr) missing")
     http = record.get("http")
     if not isinstance(http, dict):
-        if record.get("error"):
-            problems.append(f"bench errored: {record['error']}")
-        # else: a decode-only run (BENCH_SKIP_HTTP=1) is exempt — there
-        # is no http leg to assert against
+        # a decode-only run (BENCH_SKIP_HTTP=1) is exempt from the http
+        # assertions — there is no http leg to assert against
         return problems
     if "ceiling_fraction" not in http:
         problems.append("http.ceiling_fraction missing")

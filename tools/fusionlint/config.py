@@ -30,9 +30,14 @@ WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     # latency measurement lives engine-side (calibrate_token_budget)
     # and uses perf_counter explicitly, never time()/sleep()
     "fusioninfer_tpu/engine/sched.py": ("time", "sleep"),
-    # fused-step packing is pure host-side assembly feeding the same
+    # ragged-batch packing is pure host-side assembly feeding the same
     # SPMD-replicated scheduling decision: same discipline as sched.py
     "fusioninfer_tpu/engine/fused.py": ("time", "sleep"),
+    # kernel modules trace into jit caches: a wall clock in kernel or
+    # dispatch code would latch a value per compiled signature and
+    # silently desynchronize retraces (timing belongs to bench.py)
+    "fusioninfer_tpu/ops/paged_attention.py": ("time", "sleep"),
+    "fusioninfer_tpu/ops/dispatch.py": ("time", "sleep"),
 }
 
 # -- lock-discipline pass ----------------------------------------------
@@ -56,6 +61,13 @@ LOCK_DISCIPLINE_MODULES = [
 # operator/manifests.py is the I/O shell that WRITES the rendered tree;
 # its builders stay pure and the write helpers are its whole point.
 RENDER_PURE_MODULES = [
+    # the ragged kernel + packer's bit-identity contract (split and
+    # fused dispatches score identical bits) needs the same determinism
+    # discipline as manifest renderers: no clocks/env/random/IO inside
+    # function bodies — env knobs resolve in ops/dispatch.py module
+    # scope or are passed in by the engine
+    "fusioninfer_tpu/ops/paged_attention.py",
+    "fusioninfer_tpu/engine/fused.py",
     "fusioninfer_tpu/operator/render.py",
     "fusioninfer_tpu/workload/lws.py",
     "fusioninfer_tpu/workload/labels.py",
