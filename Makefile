@@ -53,13 +53,19 @@ autoscale: ## Autoscaling suite (fake-clock control-loop + drain + chaos; docs/d
 	$(PYTHON) -m pytest tests/test_autoscale.py tests/test_metrics.py -q
 
 .PHONY: lint
-lint: ## Gating lint: fusionlint (all six passes, JSON archived to dist/lint.json) + byte-compile (CI adds ruff).
+lint: ## Gating lint: fusionlint (all ten passes incl. trace-boundary, JSON archived to dist/lint.json) + byte-compile (CI adds ruff).
 	$(PYTHON) -m tools.fusionlint --json-out dist/lint.json
 	$(PYTHON) -m compileall -q fusioninfer_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: lint-changed
 lint-changed: ## Fast pre-commit lint: fusionlint over files differing from HEAD only.
 	$(PYTHON) -m tools.fusionlint --changed
+
+.PHONY: compile-gate
+compile-gate: ## Compile-budget gate: self-test, then `make fast` under the compile ledger, then per-family signature budgets (docs/design/static-analysis.md).
+	$(PYTHON) tools/check_compile_budget.py --self-test
+	FUSIONINFER_COMPILE_LEDGER=dist/compile_ledger.json $(PYTHON) -m pytest tests/ -q -m fast
+	$(PYTHON) tools/check_compile_budget.py dist/compile_ledger.json
 
 .PHONY: verify-manifests
 verify-manifests: ## Regenerate CRDs/config from the Python sources in memory, fail on drift; validate samples against the CRD schemas.

@@ -88,7 +88,11 @@ class Request:
     request_id: str
     prompt_tokens: list[int]
     params: SamplingParams = field(default_factory=SamplingParams)
-    arrival_time: float = field(default_factory=time.monotonic)
+    # < 0 means "not stamped yet": add_request stamps it from the
+    # ENGINE's (injectable) clock so queue-wait timings never mix clock
+    # domains; an explicit value wins (the multihost broadcast carries
+    # the leader's stamp so every process orders FCFS identically)
+    arrival_time: float = -1.0
     # vLLM semantics: LOWER value schedules earlier (default 0); under KV
     # pressure the lowest-urgency (highest value) sequence is preempted
     # first.  Within one priority class scheduling stays FCFS and newer
@@ -260,6 +264,7 @@ class NativeEngine:
         decode_burst_steps: int = 1,
         pipeline_bursts: bool = True,
         fused_step: bool = True,
+        clock=time.monotonic,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -333,7 +338,10 @@ class NativeEngine:
         self._mh = (multihost.EventBroadcaster()
                     if multihost.mesh_is_multiprocess(mesh) else None)
         self._mh_shutdown = False
-        self._last_step_end = time.monotonic()
+        # injectable clock (deterministic control-loop tests drive it;
+        # the wall-clock lint bans inline time.monotonic() here)
+        self._clock = clock
+        self._last_step_end = self._clock()
         self._in_step_body = False
         self.lora_set = None
         if lora_adapters:
@@ -638,6 +646,12 @@ class NativeEngine:
             guided.SchemaByteMachine(
                 guided.compile_schema_str(request.params.guided_schema))
 
+    def stamp_arrival(self, request: Request) -> None:
+        """Stamp ``arrival_time`` from the engine clock (idempotent for
+        already-stamped requests)."""
+        if request.arrival_time < 0:
+            request.arrival_time = self._clock()
+
     def add_request(self, request: Request) -> None:
         if request.params.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
@@ -648,6 +662,11 @@ class NativeEngine:
             raise ValueError(
                 f"prompt+max_tokens exceeds engine max_len {self.cache_cfg.max_len}"
             )
+        if request.arrival_time < 0:
+            # stamp on the engine's injectable clock (one clock domain
+            # for FCFS ordering and queue-wait timing); stamped BEFORE
+            # the multihost broadcast so followers replay the leader's
+            self.stamp_arrival(request)
         if self._mh is not None:
             # multi-process mesh: route through the leader's event stream
             # so every process's scheduler replays the same admission
@@ -1003,7 +1022,7 @@ class NativeEngine:
                     n_prompt=len(request.prompt_tokens),
                     slot=slot,
                     seed=self._request_seed(request),
-                    first_token_time=time.monotonic(),
+                    first_token_time=self._clock(),
                     guided=machine,
                 )
                 self._register_slot(slot, state.tokens, state.n_prompt, request.params)
@@ -1161,7 +1180,7 @@ class NativeEngine:
         grace period."""
         if self._mh is None:
             return False
-        dt = time.monotonic() - self._last_step_end
+        dt = self._clock() - self._last_step_end
         if self._in_step_body:
             return dt > in_step_threshold_s
         return dt > threshold_s
@@ -1201,7 +1220,7 @@ class NativeEngine:
                 outputs += self._decode()
         finally:
             self._in_step_body = False
-            self._last_step_end = time.monotonic()
+            self._last_step_end = self._clock()
         return [o for o in outputs if o is not None]
 
     def _process_cancellations(self) -> None:
@@ -1276,7 +1295,7 @@ class NativeEngine:
                 if not self.waiting:
                     break
                 request = self.waiting.pop()
-            now = time.monotonic()
+            now = self._clock()
             self._admit_t[request.request_id] = (
                 now, max(0.0, now - request.arrival_time))
             prefix = request.resume_tokens or request.prompt_tokens
@@ -1885,11 +1904,17 @@ class NativeEngine:
         touched (releasing their pages would hand them to later requests
         mid-decode: cross-sequence KV corruption)."""
         B = len(items)
+        # compile discipline: the prefill batch dim rides a pow2 row
+        # bucket like every ragged dispatch — a raw group size would
+        # mint a prefill signature per distinct B (trace-dynamic-dim).
+        # Pad rows are inert: true_len 0 routes every write to the
+        # trash page and their logits rows are never read.
+        R = pow2_rows(max(B, 1))
         mp = self.cache_cfg.max_pages_per_seq
-        padded = np.zeros((B, bucket), np.int32)
-        rows = np.zeros((B, mp), np.int32)
-        lens = np.zeros((B,), np.int32)
-        ids = np.zeros((B,), np.int32)
+        padded = np.zeros((R, bucket), np.int32)
+        rows = np.full((R, mp), self.cache_cfg.trash_page, np.int32)
+        lens = np.zeros((R,), np.int32)
+        ids = np.zeros((R,), np.int32)
         for i, (request, prefix, _) in enumerate(items):
             padded[i, : len(prefix)] = prefix
             rows[i] = self.alloc.page_table_row(request.request_id)
@@ -2032,7 +2057,7 @@ class NativeEngine:
             n_prompt=n_prompt,
             slot=slot,
             seed=seq_seed,
-            first_token_time=time.monotonic(),
+            first_token_time=self._clock(),
             guided=machine,
         )
         try:
@@ -2826,7 +2851,7 @@ class NativeEngine:
         t = self._admit_t.pop(state.request.request_id, None)
         if t is not None:
             self.admission_timings.append(
-                (t[1], time.monotonic() - t[0]))
+                (t[1], self._clock() - t[0]))
         finish_reason = force_finish
         if finish_reason is None and token in params.stop_token_ids:
             finish_reason = "stop"

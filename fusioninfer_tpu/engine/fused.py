@@ -92,7 +92,7 @@ def pack_ragged_batch(
     """
     B, W = window.shape
     mp = decode_tables.shape[1] if B else (
-        len(chunk_entries[0][2]) if chunk_entries else 0)
+        np.asarray(chunk_entries[0][2]).shape[0] if chunk_entries else 0)
     n_chunks = len(chunk_entries)
     R = rows if rows is not None else pow2_rows(max(B + n_chunks, 1))
     if R < B + n_chunks:

@@ -519,6 +519,51 @@ _HTTPROUTE_SPEC_SCHEMA: dict = {
     },
 }
 
+# Gateway API v1 Gateway: not rendered by the operator (users bring
+# their own), but vendored for clusters without the upstream install —
+# pin the upstream contract for the fields a user Gateway must carry
+# instead of a schema-less stand-in (VERDICT #5: no live-cluster
+# assumptions anywhere in the validation tier)
+_GATEWAY_SPEC_SCHEMA: dict = {
+    "type": "object",
+    "required": ["gatewayClassName", "listeners"],
+    "properties": {
+        "gatewayClassName": {"type": "string"},
+        "listeners": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["name", "protocol", "port"],
+                "properties": {
+                    "name": {"type": "string"},
+                    "hostname": {"type": "string"},
+                    # upstream ProtocolType is an open set: the five
+                    # core values PLUS implementation-defined
+                    # domain-prefixed protocols ("example.io/grpc") —
+                    # an enum here would reject Gateways the real CRD
+                    # accepts
+                    "protocol": {"type": "string"},
+                    "port": {"type": "integer", "minimum": 1,
+                             "maximum": 65535},
+                    "allowedRoutes": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True},
+                    "tls": {"type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True},
+                },
+            },
+        },
+        "addresses": {
+            "type": "array",
+            "items": {"type": "object",
+                      "x-kubernetes-preserve-unknown-fields": True},
+        },
+        "infrastructure": {"type": "object",
+                           "x-kubernetes-preserve-unknown-fields": True},
+    },
+}
+
 EXTERNAL_CRDS: dict[str, dict] = {
     "lws.yaml": external_crd(
         "leaderworkerset.x-k8s.io", "v1", "LeaderWorkerSet",
@@ -540,6 +585,7 @@ EXTERNAL_CRDS: dict[str, dict] = {
     ),
     "gateway.yaml": external_crd(
         "gateway.networking.k8s.io", "v1", "Gateway", "gateways", "gateway",
+        spec_schema=_GATEWAY_SPEC_SCHEMA,
     ),
 }
 

@@ -1,0 +1,82 @@
+"""Compile ledger — the runtime twin of the jit registry.
+
+The static side (fusionlint's ``jit-registry`` / ``trace-discipline``
+passes) proves the compile-signature discipline is *written*; this
+module proves it *held* for a real run.  Every registry entry with a
+``runtime`` path is a module-level ``jax.jit`` callable whose
+``_cache_size()`` is the number of distinct compile signatures it
+served — each cache miss traced and compiled once, so the count at
+process exit IS the run's retrace footprint.
+
+Usage: ``FUSIONINFER_COMPILE_LEDGER=dist/compile_ledger.json make fast``
+(the tests/conftest.py session hook calls :func:`write` at exit), then
+``python tools/check_compile_budget.py dist/compile_ledger.json`` fails
+when any family exceeds its ``FAMILY_BUDGETS`` allocation — a stray
+signature family (a shape that skipped its bucket, a weak-type flip, an
+env knob latched at trace time) shows up as a budget breach instead of
+a bench regression three rounds later.
+
+Only modules ALREADY imported by the run are inspected (an entry point
+the run never touched has no cache and pulls in no extra deps).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+from typing import Optional
+
+from fusioninfer_tpu.utils.jit_registry import entries_with_runtime
+
+
+def _cache_size_of(obj) -> Optional[int]:
+    """Compiled-signature count of a jitted callable; None when the
+    object does not expose a cache (plain function, version drift)."""
+    probe = getattr(obj, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+def snapshot() -> dict:
+    """Per-entry and per-family compiled-signature counts for every
+    registry entry point whose module this process imported."""
+    entries: dict[str, dict] = {}
+    families: dict[str, int] = {}
+    for key, spec in entries_with_runtime().items():
+        mod_name, attr = spec["runtime"].split(":", 1)
+        mod = sys.modules.get(mod_name)
+        if mod is None:
+            entries[key] = {"family": spec["family"], "signatures": 0,
+                            "loaded": False}
+            continue
+        size = _cache_size_of(getattr(mod, attr, None))
+        entries[key] = {
+            "family": spec["family"],
+            "signatures": 0 if size is None else size,
+            "loaded": True,
+        }
+        if size is None:
+            entries[key]["no_cache_introspection"] = True
+    for rec in entries.values():
+        families[rec["family"]] = (
+            families.get(rec["family"], 0) + rec["signatures"])
+    return {
+        "version": 1,
+        "tool": "compile_ledger",
+        "entries": entries,
+        "families": families,
+    }
+
+
+def write(path: str | pathlib.Path) -> dict:
+    """Snapshot and write the ledger JSON; returns the snapshot."""
+    snap = snapshot()
+    out = pathlib.Path(path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(snap, indent=2, sort_keys=True) + "\n")
+    return snap

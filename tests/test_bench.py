@@ -11,6 +11,8 @@ import fcntl
 import json
 import os
 
+import pytest
+
 import bench
 
 
@@ -211,6 +213,8 @@ class TestSignificance:
 
 
 class TestRaggedDecode:
+    @pytest.mark.slow  # ~8 s full ragged decode drive; the bench
+    # record checks keep this surface gated in tier-1 (870 s budget)
     def test_ragged_prefix_lens_decode(self):
         """run_decode's ragged mode (the long-context TPU leg, r5): every
         batch row decodes from its own context depth; throughput must be

@@ -60,7 +60,11 @@ def _requests(seed: int = 7) -> list[Request]:
 
 
 class TestTokenIdentity:
-    @pytest.mark.parametrize("chunk", [16, 32, 100])
+    @pytest.mark.parametrize("chunk", [
+        # chunk 16 is ~19 s (most steps per prompt) — slow tier per
+        # the PR 6 precedent; 32/100 keep the identity contract in
+        # tier-1 within the 870 s verify budget
+        pytest.param(16, marks=pytest.mark.slow), 32, 100])
     def test_same_tokens_as_monolithic(self, chunk):
         base = NativeEngine(CFG, cache_cfg=_cache_cfg(), max_batch_size=4)
         chunked = NativeEngine(

@@ -416,6 +416,9 @@ class TestTensorParallelEngine:
 
 
 class TestProfileEndpoint:
+    @pytest.mark.slow  # ~22 s of real profiler trace capture — the
+    # single heaviest tier-1 test; slow tier per the PR 6 precedent
+    # (tier-1 must fit the 870 s verify budget)
     def test_profile_capture_writes_trace_and_is_opt_in(self, tmp_path):
         import glob
         import json

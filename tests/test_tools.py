@@ -106,6 +106,8 @@ class TestForcedToolCalls:
         assert call["function"]["name"] == "get_weather"
         assert "city" in json.loads(call["function"]["arguments"])
 
+    @pytest.mark.slow  # ~10 s; the single-tool forced test keeps
+    # tool_choice=required covered in tier-1 (870 s budget)
     def test_required_multi_tool_name_enum(self, srv):
         r = _chat(srv, {
             "messages": [{"role": "user", "content": "pick one"}],
@@ -357,6 +359,9 @@ class TestStreamingToolCalls:
         for c in chunks:
             assert not c["choices"][0]["delta"].get("content")
 
+    @pytest.mark.slow  # ~17 s stream-vs-nonstream drain; slow tier
+    # per the PR 6 precedent (870 s verify budget) — the other
+    # streaming tests keep the wire format covered in tier-1
     def test_stream_matches_nonstream_arguments(self, srv):
         """Same seed: the streamed fragments must reassemble to the
         same arguments the non-stream path returns."""

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Compile-budget gate (``make compile-gate``).
+
+Reads a compile-ledger JSON (written by
+``fusioninfer_tpu.utils.compile_ledger`` at the end of a
+``FUSIONINFER_COMPILE_LEDGER=…`` test run) and fails when any
+compile-signature family exceeds its checked-in budget
+(``fusioninfer_tpu/utils/jit_registry.py: FAMILY_BUDGETS``).
+
+The budgets are the measured ``make fast`` footprint plus bounded
+headroom: a retrace regression — an un-bucketed shape reaching a jitted
+entry point, a host value flipping weak-type, an env knob latched into
+a fresh static signature per call — lands as a visible budget breach
+here instead of a silent bench slowdown.
+
+``--self-test`` proves the gate can actually catch an injected retrace:
+it compiles a real jitted function against N distinct static values
+(N over a synthetic budget) and asserts the check FAILS, then asserts a
+within-budget ledger PASSES.  CI runs the self-test before trusting the
+real gate (a gate that cannot fail is decoration).
+
+Exit codes: 0 clean, 1 budget breach (or self-test failure), 2 usage.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from fusioninfer_tpu.utils.jit_registry import (  # noqa: E402
+    FAMILY_BUDGETS,
+    ENTRY_POINTS,
+)
+
+
+def check(ledger: dict,
+          budgets: dict[str, int] | None = None) -> list[str]:
+    """Problems for a ledger against the family budgets (empty = pass)."""
+    budgets = FAMILY_BUDGETS if budgets is None else budgets
+    problems: list[str] = []
+    # a loaded entry whose runtime object lost cache introspection
+    # contributes 0 signatures FOREVER — the gate must fail loudly
+    # instead of silently stopping to watch it (a gate that cannot
+    # fail is decoration)
+    for key, entry in sorted(ledger.get("entries", {}).items()):
+        if entry.get("loaded") and entry.get("no_cache_introspection"):
+            problems.append(
+                f"entry {key!r} is loaded but exposes no jit cache "
+                "(_cache_size) — its runtime path no longer points at "
+                "a jitted callable; fix the registry runtime path or "
+                "re-jit the entry, its retraces are invisible")
+    families = ledger.get("families", {})
+    for family, count in sorted(families.items()):
+        budget = budgets.get(family)
+        if budget is None:
+            problems.append(
+                f"family {family!r} has no budget in "
+                "fusioninfer_tpu/utils/jit_registry.py:FAMILY_BUDGETS — "
+                "every family must be budgeted")
+            continue
+        if count > budget:
+            offenders = sorted(
+                ((k, v["signatures"])
+                 for k, v in ledger.get("entries", {}).items()
+                 if v.get("family") == family),
+                key=lambda kv: -kv[1])
+            detail = ", ".join(f"{k.split('::', 1)[1]}={n}"
+                               for k, n in offenders[:4])
+            problems.append(
+                f"family {family!r} compiled {count} signatures "
+                f"(budget {budget}) — retrace regression; offenders: "
+                f"{detail}.  Find the un-bucketed dim or latched knob, "
+                "or justify a budget bump in jit_registry.py")
+    return problems
+
+
+def report(ledger: dict, budgets: dict[str, int] | None = None) -> None:
+    budgets = FAMILY_BUDGETS if budgets is None else budgets
+    loaded = sum(1 for v in ledger.get("entries", {}).values()
+                 if v.get("loaded"))
+    print(f"compile ledger: {loaded}/{len(ledger.get('entries', {}))} "
+          "registry entry points loaded by the run")
+    for family, count in sorted(ledger.get("families", {}).items()):
+        budget = budgets.get(family, "∅")
+        print(f"  {family:<16} {count:>4} signatures  (budget {budget})")
+
+
+def self_test() -> int:
+    """Inject a retrace storm through a REAL jit cache and prove the
+    gate trips on it (and stays quiet within budget)."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(1,))
+    def probe(x, n):
+        return x * n
+
+    x = jnp.ones((4,))
+    for n in range(5):  # 5 distinct static values = 5 signatures
+        probe(x, n)
+    size = probe._cache_size()
+    if size != 5:
+        print(f"self-test: expected 5 compile signatures, saw {size} — "
+              "jit cache introspection drifted", file=sys.stderr)
+        return 1
+    ledger = {"families": {"selftest": size},
+              "entries": {"probe.py::probe": {"family": "selftest",
+                                              "signatures": size,
+                                              "loaded": True}}}
+    if not check(ledger, {"selftest": 2}):
+        print("self-test: injected retrace (5 signatures vs budget 2) "
+              "did NOT trip the gate", file=sys.stderr)
+        return 1
+    if check(ledger, {"selftest": 8}):
+        print("self-test: within-budget ledger tripped the gate",
+              file=sys.stderr)
+        return 1
+    print("compile-gate self-test: injected retrace trips the gate; "
+          "within-budget run passes")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--self-test":
+        return self_test()
+    if not argv:
+        print("usage: check_compile_budget.py <ledger.json> | --self-test",
+              file=sys.stderr)
+        return 2
+    path = pathlib.Path(argv[0])
+    if not path.exists():
+        print(f"{path}: no compile ledger — run the test tier with "
+              "FUSIONINFER_COMPILE_LEDGER set (make compile-gate does)",
+              file=sys.stderr)
+        return 2
+    ledger = json.loads(path.read_text())
+    # sanity: the ledger must cover the registry (an empty ledger would
+    # vacuously pass — the same trap as a lint over zero files)
+    missing = set(k for k, v in ENTRY_POINTS.items() if v.get("runtime")) \
+        - set(ledger.get("entries", {}))
+    if missing:
+        print(f"ledger is missing {len(missing)} registry entries "
+              f"(e.g. {sorted(missing)[0]}) — regenerate it against the "
+              "current registry", file=sys.stderr)
+        return 1
+    report(ledger)
+    problems = check(ledger)
+    for p in problems:
+        print(f"compile-budget: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("compile-budget: every family within its signature budget")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

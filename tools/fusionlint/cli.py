@@ -5,13 +5,17 @@ Usage::
     python -m tools.fusionlint [paths...] [options]
 
 Options:
-  --select PASS[,PASS]   run only the named passes (default: all six)
+  --select PASS[,PASS]   run only the named passes (default: all ten)
   --format {text,json,sarif}
   --output FILE          write the report to FILE instead of stdout
   --json-out FILE        additionally write the JSON report to FILE
                          (``make lint`` archives it under dist/)
-  --changed              lint only files differing from HEAD (staged,
+  --changed              lint only files differing from --base (staged,
                          unstaged, or untracked) — fast pre-commit mode
+  --base REF             the ref --changed diffs against (default HEAD;
+                         CI passes the PR base sha so the gate fails on
+                         NEW findings only while the full-repo report
+                         stays advisory)
   --list-passes          print the pass catalog and exit
 
 Exit code 1 when any finding is emitted (including unused
@@ -59,7 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json-out", default="",
                    help="additionally write the JSON report here")
     p.add_argument("--changed", action="store_true",
-                   help="lint only files differing from HEAD")
+                   help="lint only files differing from --base")
+    p.add_argument("--base", default="HEAD",
+                   help="git ref --changed diffs against (default HEAD; "
+                        "CI passes the PR base so the gate covers "
+                        "exactly the diff under review)")
     p.add_argument("--list-passes", action="store_true")
     return p
 
@@ -79,7 +87,7 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     files = collect_files(args.paths or config.DEFAULT_TARGETS)
     if args.changed:
-        changed = changed_files()
+        changed = changed_files(base=args.base)
         if changed is None:
             print("fusionlint: git unavailable; linting the full set",
                   file=sys.stderr)

@@ -38,6 +38,12 @@ WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     # silently desynchronize retraces (timing belongs to bench.py)
     "fusioninfer_tpu/ops/paged_attention.py": ("time", "sleep"),
     "fusioninfer_tpu/ops/dispatch.py": ("time", "sleep"),
+    # the engine step loop runs on an injectable clock (NativeEngine
+    # clock=..., PR 7's guided-composition deflake): inline
+    # monotonic()/time()/sleep() would put scheduling state back on the
+    # wall clock.  perf_counter stays legal — calibrate_token_budget's
+    # D2H-fenced measurement is explicitly wall-time.
+    "fusioninfer_tpu/engine/engine.py": ("time", "sleep", "monotonic"),
 }
 
 # -- lock-discipline pass ----------------------------------------------
@@ -89,6 +95,83 @@ METRICS_MODULES = [
     "fusioninfer_tpu/autoscale/metrics.py",
     "fusioninfer_tpu/operator/manager.py",
 ]
+
+# -- trace-boundary passes (trace-discipline / tracer-leak / host-sync /
+# -- jit-registry) ------------------------------------------------------
+
+# the checked-in entry-point registry (pure data; no jax import) — the
+# jit-registry pass diffs the package's actual jit/shard_map sites
+# against it, and the trace-discipline pass reads each entry's
+# static/traced split to type call sites
+JIT_REGISTRY_MODULE = "fusioninfer_tpu/utils/jit_registry.py"
+
+# modules scanned for jit/shard_map sites (tests/tools/bench create
+# ad-hoc jits deliberately — only the package's entry points are the
+# compile-discipline surface)
+JIT_SCAN_MODULES = ["fusioninfer_tpu/*.py", "fusioninfer_tpu/*/*.py"]
+# the shard_map version shim re-exports shard_map by design
+JIT_SCAN_EXEMPT = ["fusioninfer_tpu/utils/jax_compat.py"]
+
+# sanctioned dynamic-dim helpers: a host int that passed through one of
+# these is SHAPE-DISCIPLINED (bounded compile-signature family); a raw
+# len()/shape-derived int reaching a shape or a static arg is TAINTED
+TRACE_DIM_HELPERS = (
+    "pow2_rows",        # engine/fused.py — pow2 row/flat-axis buckets
+    "pick_bucket",      # engine/model_runner.py — prefill buckets
+    "prefill_buckets",
+    "_payload_bucket",  # engine/multihost.py — broadcast payload floor
+    "_pow2_pad",        # engine/engine.py — pow2 list padding
+)
+
+# call sites checked by trace-discipline (where the engine drives the
+# jitted entry points)
+TRACE_CALLER_MODULES = [
+    "fusioninfer_tpu/engine/*.py",
+    "fusioninfer_tpu/ops/*.py",
+    "fusioninfer_tpu/models/*.py",
+    "fusioninfer_tpu/parallel/*.py",
+]
+
+# hot-path modules for the host-sync (and host-jnp) rules, mirroring
+# WALL_CLOCK_PACKAGES: a device→host fetch (np.asarray / .item() /
+# float()/int() / device_get / block_until_ready on a device value)
+# inside these stalls the dispatch pipeline.  Values are the SANCTIONED
+# fetch-point functions — the step loop's designed blocking points —
+# where the rules stay quiet.
+HOST_SYNC_MODULES: dict[str, tuple[str, ...]] = {
+    # the engine step loop: fetches belong in the designed consume
+    # points, never ad hoc mid-step
+    "fusioninfer_tpu/engine/engine.py": (
+        "_consume_inflight",       # THE dispatch-ahead fetch point
+        "_decode_finish",          # step tail: sampled tokens fetch
+        "_spec_draws",             # spec-decode acceptance draws fetch
+        "_sample_first_token",     # admission sampling: the non-deferred
+        #                            branch IS the fetch (guided/bias rows
+        #                            need the token host-side; group
+        #                            admission defers via defer_fetch)
+        "_activate_group",         # ONE batched fetch for a whole
+        #                            admission group (the designed
+        #                            coalesced transfer)
+        "_activate_finish",        # first-token logprobs readback —
+        #                            returned to the client, must land
+        "_embed_batch",            # embedding results are the output
+        "calibrate_token_budget",  # deliberate D2H-fenced measurement
+    ),
+    "fusioninfer_tpu/engine/sched.py": (),
+    "fusioninfer_tpu/engine/fused.py": (),
+    "fusioninfer_tpu/engine/model_runner.py": (),
+    "fusioninfer_tpu/ops/paged_attention.py": (),
+    "fusioninfer_tpu/ops/dispatch.py": (),
+    "fusioninfer_tpu/ops/sharded.py": (),
+    # the revived TP surfaces (PR 6): a stray fetch in the SPMD-lockstep
+    # broadcast or the mesh step factories stalls every process in the
+    # gang, not just one
+    "fusioninfer_tpu/engine/multihost.py": (),
+    "fusioninfer_tpu/parallel/step.py": (),
+    "fusioninfer_tpu/parallel/ring.py": (),
+    "fusioninfer_tpu/parallel/sharding.py": (),
+    "fusioninfer_tpu/parallel/mesh.py": (),
+}
 
 # -- conditions-vocabulary pass ----------------------------------------
 

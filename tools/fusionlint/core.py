@@ -98,6 +98,11 @@ class Module:
         # line -> None (blanket noqa) | frozenset of rule names
         self.noqa: dict[int, Optional[frozenset[str]]] = {}
         self.disabled_rules: set[str] = set()
+        # the tokenize scan is the expensive half of module loading and
+        # only matters when a suppression directive can exist at all —
+        # a cheap substring probe skips it for the common clean file
+        if "noqa" not in self.src and "fusionlint:" not in self.src:
+            return
         for line_no, comment in self._comments():
             m = _PRAGMA_RE.search(comment)
             if m:
@@ -187,13 +192,17 @@ def collect_files(targets: Sequence[str]) -> list[pathlib.Path]:
     return files
 
 
-def changed_files() -> Optional[set[str]]:
-    """Repo-relative paths of files differing from HEAD (tracked changes
-    plus untracked); None when git is unavailable (callers fall back to
-    the full set)."""
+def changed_files(base: str = "HEAD") -> Optional[set[str]]:
+    """Repo-relative paths of files differing from ``base`` (tracked
+    changes plus untracked); None when git is unavailable (callers fall
+    back to the full set).  ``base`` defaults to HEAD (fast pre-commit
+    mode); CI passes the PR base ref so the changed-mode gate covers
+    exactly the diff under review — the full-repo report stays
+    advisory, so a pre-existing finding never blocks an unrelated PR
+    while any finding in touched files does."""
     try:
         diff = subprocess.run(
-            ["git", "-C", str(REPO), "diff", "--name-only", "HEAD", "--"],
+            ["git", "-C", str(REPO), "diff", "--name-only", base, "--"],
             capture_output=True, text=True, timeout=30, check=True)
         untracked = subprocess.run(
             ["git", "-C", str(REPO), "ls-files", "--others",

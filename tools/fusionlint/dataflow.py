@@ -1,0 +1,363 @@
+"""Intra-procedural dataflow for the trace-boundary passes.
+
+PR 3's passes were per-statement pattern matchers; the trace-boundary
+family (trace-discipline / tracer-leak / host-sync) needs to know *where
+a value came from*, not just what a line looks like.  This module gives
+every pass the same small machinery:
+
+* **Def-use chains** (:class:`DefUse`) — for one function body, every
+  local name's assignments in statement order and every read site, so a
+  pass can ask "what expressions could ``x`` hold here?" and "is this
+  definition's value ever used outside host conversions?".
+* **A provenance lattice** (:class:`Prov`) — each expression abstracts
+  to one of five points::
+
+        DEVICE     lives on an accelerator (result of jnp.* / jax.* /
+                   a registry entry point / .at[].set chains)
+        TAINTED    a host int derived from DYNAMIC extent (len() of a
+                   host container) that never passed a sanctioned
+                   bucketing helper — feeding one to a compile
+                   signature mints signatures without bound
+                   (an EXISTING array's .shape is SHAPED: the array's
+                   own compile signature already bounds it)
+        SHAPED     a host int that went through a sanctioned helper
+                   (pow2_rows, pick_bucket, ... — config.TRACE_DIM_HELPERS)
+                   or is a literal: the bounded-signature discipline
+        HOST       a host value that is not a dynamic extent (python
+                   scalars, strings, os.environ, configs)
+        UNKNOWN    bottom — parameters, attributes, anything unproven
+
+  The join order is ``DEVICE > TAINTED > SHAPED > HOST > UNKNOWN``:
+  when control flow merges two provenances the analysis keeps the most
+  dangerous one, so every rule errs toward flagging only values it can
+  actually derive (an UNKNOWN never flags).
+
+The analysis is deliberately intra-procedural and flow-ordered without
+a full CFG: statements are walked in source order (branch bodies too),
+and a name's provenance at a use is the join of every definition that
+precedes it.  That is exactly enough to catch the bug classes PRs 4-6
+made expensive — ``int(x)`` on a fresh kernel result, an unbucketed
+``len()`` reaching a shape — without false-positive storms from
+path-sensitivity the codebase doesn't need.
+"""
+
+from __future__ import annotations
+
+import ast
+import enum
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+
+class Prov(enum.IntEnum):
+    """Provenance lattice; higher = joins win (more dangerous)."""
+
+    UNKNOWN = 0
+    HOST = 1
+    SHAPED = 2
+    TAINTED = 3
+    DEVICE = 4
+
+
+def join(*provs: Prov) -> Prov:
+    return max(provs, default=Prov.UNKNOWN)
+
+
+# modules whose call results live on device
+_DEVICE_MODULES = {"jnp", "lax"}
+# jax.* callables that RETURN host values (so jax.X default-DEVICE has
+# carve-outs); device_get is handled by the host-sync pass itself
+_JAX_HOST_RETURNS = {"device_count", "local_device_count", "devices",
+                     "local_devices", "default_backend", "process_index",
+                     "process_count"}
+# builtins that force a value back to host (the host-sync pass owns
+# flagging them; provenance-wise their RESULT is host)
+HOST_CONVERSIONS = {"int", "float", "bool", "complex"}
+# numpy namespaces: np.asarray(device_value) is a device→host fetch
+NUMPY_MODULES = {"np", "numpy"}
+# reading an EXISTING array's extent is shape-disciplined: the array's
+# own compile signature already bounds it (B, S = tokens.shape inside a
+# jitted body is static per trace).  Only len() of a host container is
+# a raw dynamic extent.
+_EXTENT_ATTRS = {"shape", "size", "ndim"}
+
+
+@dataclass
+class Definition:
+    """One assignment to a local name."""
+
+    name: str
+    node: ast.AST  # the Assign/AugAssign/For/With/arg node
+    value: Optional[ast.expr]  # rhs expression (None: no static rhs)
+    prov: Prov
+    order: int  # source order used for "defs before this use"
+
+
+@dataclass
+class Use:
+    """One read of a local name."""
+
+    name: str
+    node: ast.Name
+    order: int
+    # the innermost call this use is an argument of, if any — lets a
+    # pass ask "is every use of this def a host conversion?"
+    call: Optional[ast.Call] = None
+
+
+@dataclass
+class DefUse:
+    """Def-use chains + provenance environment for ONE function body."""
+
+    func: ast.AST
+    defs: dict[str, list[Definition]] = field(default_factory=dict)
+    uses: dict[str, list[Use]] = field(default_factory=dict)
+
+    def prov_at(self, name: str, order: int) -> Prov:
+        """Join of every definition of ``name`` preceding ``order``
+        (source order); UNKNOWN when there is none (parameter,
+        closure, global)."""
+        ds = [d.prov for d in self.defs.get(name, []) if d.order < order]
+        return join(*ds) if ds else Prov.UNKNOWN
+
+    def uses_of(self, definition: Definition) -> list[Use]:
+        """Uses of the defined name AFTER the definition and before any
+        redefinition (the def's live range, straight-line
+        approximation)."""
+        later = [d.order for d in self.defs.get(definition.name, [])
+                 if d.order > definition.order]
+        end = min(later) if later else float("inf")
+        return [u for u in self.uses.get(definition.name, [])
+                if definition.order < u.order < end]
+
+
+class ProvenanceAnalysis:
+    """Builds :class:`DefUse` for each function in a module.
+
+    ``device_callees``: terminal names whose call results are DEVICE
+    (the jit registry's entry points).  ``shape_helpers``: terminal
+    names of sanctioned dim-bucketing helpers whose results are SHAPED
+    (``config.TRACE_DIM_HELPERS``).
+    """
+
+    def __init__(self,
+                 device_callees: Iterable[str] = (),
+                 shape_helpers: Iterable[str] = ()):
+        self.device_callees = set(device_callees)
+        self.shape_helpers = set(shape_helpers)
+
+    # -- expression provenance ----------------------------------------
+
+    def prov_of(self, expr: ast.expr, du: DefUse, order: int) -> Prov:
+        """Abstract ``expr`` to a lattice point, resolving local names
+        through the def environment at ``order``."""
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, (int,)) and not isinstance(
+                    expr.value, bool):
+                return Prov.SHAPED  # literal dims are bounded by source
+            return Prov.HOST
+        if isinstance(expr, ast.Name):
+            return du.prov_at(expr.id, order)
+        if isinstance(expr, ast.Call):
+            return self._call_prov(expr, du, order)
+        if isinstance(expr, ast.Attribute):
+            # x.shape (and x.shape[0] via the Subscript case below) is
+            # SHAPED by design: an existing array's extent is already
+            # bounded by its own compile signature.  x.T / x.at keep
+            # x's provenance (device arrays stay device through .at/.T).
+            base = self.prov_of(expr.value, du, order)
+            if expr.attr in _EXTENT_ATTRS:
+                return Prov.SHAPED
+            if base is Prov.DEVICE:
+                return Prov.DEVICE
+            return Prov.UNKNOWN
+        if isinstance(expr, ast.Subscript):
+            base = self.prov_of(expr.value, du, order)
+            return base  # an element of a device array is device; of a
+            # tainted tuple (x.shape[0]) tainted
+        if isinstance(expr, (ast.BinOp,)):
+            return join(self.prov_of(expr.left, du, order),
+                        self.prov_of(expr.right, du, order))
+        if isinstance(expr, ast.UnaryOp):
+            return self.prov_of(expr.operand, du, order)
+        if isinstance(expr, ast.IfExp):
+            return join(self.prov_of(expr.body, du, order),
+                        self.prov_of(expr.orelse, du, order))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return join(*(self.prov_of(e, du, order) for e in expr.elts))
+        if isinstance(expr, ast.Compare):
+            return Prov.HOST
+        if isinstance(expr, ast.BoolOp):
+            return join(*(self.prov_of(v, du, order) for v in expr.values))
+        return Prov.UNKNOWN
+
+    def _call_prov(self, call: ast.Call, du: DefUse, order: int) -> Prov:
+        func = call.func
+        # module-attribute calls: jnp.zeros, np.asarray, lax.scan, ...
+        if isinstance(func, ast.Attribute):
+            # sanctioned helpers / entry points reachable as methods or
+            # module attributes (self._pow2_pad, model_runner.prefill)
+            if func.attr in self.shape_helpers:
+                return Prov.SHAPED
+            if func.attr in self.device_callees:
+                return Prov.DEVICE
+            root = _attr_root(func)
+            if root in _DEVICE_MODULES:
+                return Prov.DEVICE
+            if root == "jax":
+                if func.attr in _JAX_HOST_RETURNS:
+                    return Prov.HOST
+                return Prov.DEVICE
+            if root in NUMPY_MODULES:
+                return Prov.HOST  # numpy results live on host
+            # method calls: x.reshape(...), x.astype(...), x.at[...]
+            base = self.prov_of(func.value, du, order)
+            if base is Prov.DEVICE:
+                if func.attr == "item":
+                    return Prov.HOST  # the sync itself; host-sync flags it
+                return Prov.DEVICE
+            return Prov.UNKNOWN
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in self.shape_helpers:
+                return Prov.SHAPED
+            if name in self.device_callees:
+                return Prov.DEVICE
+            if name == "len":
+                return Prov.TAINTED
+            if name in HOST_CONVERSIONS:
+                # int(len(x)) stays a dynamic extent; int(flag) is host
+                inner = join(*(self.prov_of(a, du, order)
+                               for a in call.args)) if call.args else Prov.HOST
+                return Prov.TAINTED if inner is Prov.TAINTED else Prov.HOST
+            if name in ("max", "min", "sum", "abs"):
+                return join(*(self.prov_of(a, du, order)
+                              for a in call.args)) if call.args else Prov.HOST
+            if name in ("range", "sorted", "list", "tuple", "set", "dict",
+                        "zip", "enumerate", "str", "repr"):
+                return Prov.HOST
+        return Prov.UNKNOWN
+
+    # -- def-use construction -----------------------------------------
+
+    def analyze(self, func: ast.AST) -> DefUse:
+        """Build the def-use/provenance table for one FunctionDef."""
+        du = DefUse(func=func)
+        counter = 0
+
+        def record_def(name: str, node: ast.AST,
+                       value: Optional[ast.expr]) -> None:
+            nonlocal counter
+            counter += 1
+            prov = (self.prov_of(value, du, counter)
+                    if value is not None else Prov.UNKNOWN)
+            du.defs.setdefault(name, []).append(
+                Definition(name, node, value, prov, counter))
+
+        def record_targets(tgt: ast.expr, node: ast.AST,
+                           value: Optional[ast.expr]) -> None:
+            if isinstance(tgt, ast.Name):
+                record_def(tgt.id, node, value)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                # tuple unpack: provenance of the whole rhs flows into
+                # every element (cache, logits = decode_step(...) makes
+                # BOTH device — correct for the entry points we track)
+                for elt in tgt.elts:
+                    record_targets(elt, node, value)
+
+        call_stack: list[ast.Call] = []
+
+        class Walker(ast.NodeVisitor):
+            def visit_FunctionDef(self, node: ast.FunctionDef):  # noqa
+                if node is not func:
+                    return  # nested defs get their own analysis
+                self.generic_visit(node)
+
+            visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore
+
+            def visit_Lambda(self, node: ast.Lambda):  # noqa
+                return  # lambda bodies are their own scope
+
+            def visit_Assign(self, node: ast.Assign):  # noqa
+                self.visit(node.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        self.visit(tgt)
+                    record_targets(tgt, node, node.value)
+
+            def visit_AnnAssign(self, node: ast.AnnAssign):  # noqa
+                if node.value is not None:
+                    self.visit(node.value)
+                    record_targets(node.target, node, node.value)
+
+            def visit_AugAssign(self, node: ast.AugAssign):  # noqa
+                self.visit(node.value)
+                if isinstance(node.target, ast.Name):
+                    # x += y joins x's current prov with y's
+                    synth = ast.BinOp(left=ast.Name(id=node.target.id,
+                                                    ctx=ast.Load()),
+                                      op=node.op, right=node.value)
+                    ast.copy_location(synth, node)
+                    ast.fix_missing_locations(synth)
+                    record_def(node.target.id, node, synth)
+
+            def visit_For(self, node: ast.For):  # noqa
+                self.visit(node.iter)
+                record_targets(node.target, node, None)
+                for stmt in node.body + node.orelse:
+                    self.visit(stmt)
+
+            def visit_withitem(self, node: ast.withitem):  # noqa
+                self.visit(node.context_expr)
+                if node.optional_vars is not None:
+                    record_targets(node.optional_vars, node, None)
+
+            def visit_Call(self, node: ast.Call):  # noqa
+                call_stack.append(node)
+                self.generic_visit(node)
+                call_stack.pop()
+
+            def visit_Name(self, node: ast.Name):  # noqa
+                nonlocal counter
+                if isinstance(node.ctx, ast.Load):
+                    counter += 1
+                    du.uses.setdefault(node.id, []).append(Use(
+                        node.id, node, counter,
+                        call_stack[-1] if call_stack else None))
+
+        Walker().visit(func)
+        return du
+
+
+def _attr_root(attr: ast.Attribute) -> Optional[str]:
+    """``jnp.zeros`` → ``jnp``; ``jax.nn.softmax`` → ``jax``;
+    ``self.x.f`` → None (only plain module roots count)."""
+    cur: ast.expr = attr
+    while isinstance(cur, ast.Attribute):
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        return cur.id
+    return None
+
+
+def functions_of(tree: ast.Module) -> list[ast.AST]:
+    """Every (async) function definition in the module, outermost
+    first."""
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def own_nodes(func: ast.AST):
+    """Nodes of ``func``'s own body, NOT descending into nested
+    function/lambda scopes.  ``functions_of`` lists nested defs as
+    their own entries, so a pass that walked each function with
+    ``ast.walk`` would visit nested bodies twice and double-count
+    findings."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

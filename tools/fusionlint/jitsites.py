@@ -1,0 +1,200 @@
+"""AST discovery of jax.jit / shard_map sites (shared by the
+``jit-registry`` and ``tracer-leak`` passes).
+
+A *site* is anywhere a trace boundary is created:
+
+* ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs (kind
+  ``jit``),
+* ``name = partial(jax.jit, ...)(impl)`` module-level assignments
+  (kind ``jit``; ``impl`` names the traced body),
+* ``jax.jit(...)`` calls inside factory functions (kind
+  ``factory-jit``),
+* ``shard_map(...)`` calls (kind ``shard_map``).
+
+Keys match :mod:`fusioninfer_tpu.utils.jit_registry`:
+``"<rel>::<qualname>"``, with ``#shard_map`` appended when a function
+owns both a jit and a shard_map site.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tools.fusionlint.core import Module
+
+
+@dataclass
+class JitSite:
+    key: str  # "<rel>::<qualname>" (+ "#shard_map" discriminator)
+    kind: str  # "jit" | "factory-jit" | "shard_map"
+    line: int
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    impl: Optional[str] = None  # traced body name for assigned jits
+    body: Optional[ast.AST] = None  # the traced FunctionDef when known
+
+
+def _is_jax_jit(expr: ast.expr) -> bool:
+    return (isinstance(expr, ast.Attribute) and expr.attr == "jit"
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "jax")
+
+
+def _partial_jit_call(expr: ast.expr) -> Optional[ast.Call]:
+    """``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)`` →
+    the Call; else None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    is_partial = (isinstance(f, ast.Name) and f.id == "partial") or (
+        isinstance(f, ast.Attribute) and f.attr == "partial")
+    if is_partial and expr.args and _is_jax_jit(expr.args[0]):
+        return expr
+    return None
+
+
+def _static_tuple(value: ast.expr) -> tuple:
+    """Normalize a static_argnums/static_argnames value: a literal
+    tuple/list of constants, or a single constant."""
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in value.elts
+                     if isinstance(e, ast.Constant))
+    if isinstance(value, ast.Constant):
+        return (value.value,)
+    return ()
+
+
+def _split_of(call: ast.Call) -> tuple[tuple, tuple]:
+    nums: tuple = ()
+    names: tuple = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = tuple(int(v) for v in _static_tuple(kw.value)
+                         if isinstance(v, int))
+        elif kw.arg == "static_argnames":
+            names = tuple(str(v) for v in _static_tuple(kw.value))
+    return nums, names
+
+
+@dataclass
+class ModuleSites:
+    sites: dict[str, JitSite] = field(default_factory=dict)
+    # jitted body FunctionDefs (decorated defs + assigned impls), for
+    # the tracer-leak pass
+    jitted_bodies: list[ast.AST] = field(default_factory=list)
+
+
+def scan_module(mod: Module) -> ModuleSites:
+    # three passes (jit-registry, tracer-leak, host-sync) scan the same
+    # module; the sites are a pure function of the shared AST, so cache
+    # the result on the Module record
+    cached = getattr(mod, "_jit_sites", None)
+    if cached is not None:
+        return cached
+    tree = mod.tree
+    assert tree is not None
+    out = ModuleSites()
+    handled_calls: set[int] = set()
+    func_defs: dict[str, ast.AST] = {}
+
+    # enclosing-function qualnames for factory/shard_map sites
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def qualname_of(node: ast.AST) -> str:
+        chain: list[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                chain.append(cur.name)
+            cur = parents.get(cur)
+        return ".".join(reversed(chain)) or "<module>"
+
+    def add(key: str, site: JitSite) -> None:
+        out.sites.setdefault(key, site)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func_defs[node.name] = node
+            for deco in node.decorator_list:
+                if _is_jax_jit(deco):
+                    add(f"{mod.rel}::{node.name}", JitSite(
+                        f"{mod.rel}::{node.name}", "jit", node.lineno,
+                        body=node))
+                    out.jitted_bodies.append(node)
+                elif (isinstance(deco, ast.Call)
+                        and _is_jax_jit(deco.func)):
+                    # call-form decorator: @jax.jit(donate_argnums=...)
+                    # — still a jitted DEF (its body is traced), not a
+                    # factory jit
+                    nums, names = _split_of(deco)
+                    add(f"{mod.rel}::{node.name}", JitSite(
+                        f"{mod.rel}::{node.name}", "jit", node.lineno,
+                        static_argnums=nums, static_argnames=names,
+                        body=node))
+                    out.jitted_bodies.append(node)
+                    handled_calls.add(id(deco))
+                else:
+                    pcall = _partial_jit_call(deco)
+                    if pcall is not None:
+                        nums, names = _split_of(pcall)
+                        add(f"{mod.rel}::{node.name}", JitSite(
+                            f"{mod.rel}::{node.name}", "jit", node.lineno,
+                            static_argnums=nums, static_argnames=names,
+                            body=node))
+                        out.jitted_bodies.append(node)
+                        handled_calls.add(id(pcall))
+        elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            inner = node.value.func
+            pcall = _partial_jit_call(inner) if isinstance(
+                inner, ast.Call) else None
+            if pcall is not None and isinstance(node.targets[0], ast.Name):
+                nums, names = _split_of(pcall)
+                impl = None
+                if node.value.args and isinstance(node.value.args[0],
+                                                  ast.Name):
+                    impl = node.value.args[0].id
+                name = node.targets[0].id
+                add(f"{mod.rel}::{name}", JitSite(
+                    f"{mod.rel}::{name}", "jit", node.lineno,
+                    static_argnums=nums, static_argnames=names, impl=impl))
+                handled_calls.add(id(pcall))
+                handled_calls.add(id(node.value))
+
+    # second walk: factory jits, then shard_maps (jit kinds claim the
+    # plain qualname key; a shard_map sharing a function gets the
+    # "#shard_map" discriminator — make_ring_attention owns both)
+    shard_maps: list[ast.Call] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or id(node) in handled_calls:
+            continue
+        if _is_jax_jit(node.func):
+            qual = qualname_of(node)
+            nums, names = _split_of(node)
+            add(f"{mod.rel}::{qual}", JitSite(
+                f"{mod.rel}::{qual}", "factory-jit", node.lineno,
+                static_argnums=nums, static_argnames=names))
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id == "shard_map") or (
+                  isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "shard_map"):
+            shard_maps.append(node)
+    for node in shard_maps:
+        qual = qualname_of(node)
+        key = f"{mod.rel}::{qual}"
+        if key in out.sites:
+            key += "#shard_map"
+        add(key, JitSite(key, "shard_map", node.lineno))
+
+    # resolve assigned-impl bodies for the tracer-leak pass
+    for site in out.sites.values():
+        if site.impl and site.impl in func_defs:
+            site.body = func_defs[site.impl]
+            out.jitted_bodies.append(func_defs[site.impl])
+    mod._jit_sites = out
+    return out

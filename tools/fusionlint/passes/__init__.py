@@ -11,11 +11,15 @@ in ``docs/design/static-analysis.md``.
 from __future__ import annotations
 
 from tools.fusionlint.passes.conditionsvocab import ConditionsVocabularyPass
+from tools.fusionlint.passes.hostsync import HostSyncPass
 from tools.fusionlint.passes.hygiene import HygienePass
+from tools.fusionlint.passes.jitregistry import JitRegistryPass
 from tools.fusionlint.passes.lockdiscipline import LockDisciplinePass
 from tools.fusionlint.passes.metricsconv import MetricsConventionsPass
 from tools.fusionlint.passes.renderpurity import RenderPurityPass
 from tools.fusionlint.passes.resilience import ResiliencePass
+from tools.fusionlint.passes.tracediscipline import TraceDisciplinePass
+from tools.fusionlint.passes.tracerleak import TracerLeakPass
 
 ALL_PASSES = [
     HygienePass,
@@ -24,6 +28,10 @@ ALL_PASSES = [
     RenderPurityPass,
     MetricsConventionsPass,
     ConditionsVocabularyPass,
+    JitRegistryPass,
+    TraceDisciplinePass,
+    TracerLeakPass,
+    HostSyncPass,
 ]
 
 

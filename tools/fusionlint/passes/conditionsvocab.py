@@ -16,8 +16,10 @@ checks every ``set_condition``-family call site in scope:
 * a literal string argument must be one of the declared **values**;
 * a ``COND_*``/``REASON_*`` symbol must be one of the declared
   **names** (catches stale references after a rename);
-* a local variable is resolved through simple assignment/conditional
-  flow inside the enclosing function — every value it can hold must be
+* a local variable is resolved through the dataflow layer's def-use
+  chains (:mod:`tools.fusionlint.dataflow` — the PR 3 version carried
+  its own ad-hoc assignment walker; the trace-boundary passes made
+  def-use a shared primitive): every value it can hold must be
   declared; anything the resolver cannot prove is flagged (hoist the
   choice into an ``IfExp`` over declared constants, as
   ``autoscale/controller.py`` does).
@@ -33,6 +35,7 @@ import pathlib
 
 from tools.fusionlint import config
 from tools.fusionlint.core import REPO, Finding, LintPass, Module, callee_name
+from tools.fusionlint.dataflow import ProvenanceAnalysis
 
 _PREFIXES = {"type": "COND_", "reason": "REASON_"}
 
@@ -139,6 +142,7 @@ class ConditionsVocabularyPass(LintPass):
             for child in ast.iter_child_nodes(node):
                 parents[child] = node
         scope_assignments: dict[ast.AST, dict[str, list[ast.expr]]] = {}
+        dataflow = ProvenanceAnalysis()
 
         def enclosing_scope(node: ast.AST) -> ast.AST:
             cur = parents.get(node)
@@ -148,15 +152,14 @@ class ConditionsVocabularyPass(LintPass):
             return cur or tree
 
         def assignments_in(scope: ast.AST) -> dict[str, list[ast.expr]]:
+            # def-use chains from the shared dataflow layer: every
+            # static rhs a local name was assigned in this scope
             cached = scope_assignments.get(scope)
             if cached is None:
-                cached = {}
-                for node in ast.walk(scope):
-                    if isinstance(node, ast.Assign):
-                        for tgt in node.targets:
-                            if isinstance(tgt, ast.Name):
-                                cached.setdefault(tgt.id, []).append(
-                                    node.value)
+                du = dataflow.analyze(scope)
+                cached = {
+                    name: [d.value for d in defs if d.value is not None]
+                    for name, defs in du.defs.items()}
                 scope_assignments[scope] = cached
             return cached
 

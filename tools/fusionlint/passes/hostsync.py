@@ -1,0 +1,125 @@
+"""host-sync pass — device→host fetches only at the designed points.
+
+The serving loop's step time on a remote-attached TPU is round-trip
+dominated: the chip decodes in ~1 ms while one blocking device→host
+fetch costs two orders of magnitude more (the whole premise of
+decode_burst and dispatch-ahead, PR 4).  A single stray ``int(x)`` /
+``np.asarray(x)`` / ``.item()`` on a device value inside the step loop
+re-serializes the pipeline — and nothing fails; a bench just gets
+slower.
+
+This pass uses the dataflow layer to follow provenance inside each
+function of the hot-path table (``config.HOST_SYNC_MODULES``, the
+mirror of ``WALL_CLOCK_PACKAGES``): a value produced by a ``jnp.*`` /
+``jax.*`` call or a registered jit entry point is DEVICE, and any of
+
+    int(x)  float(x)  bool(x)  np.asarray(x)  x.item()  x.tolist()
+    jax.device_get(x)  x.block_until_ready()
+
+on it is a synchronization point.  The table's per-module allowlist
+names the SANCTIONED fetch functions — ``_consume_inflight`` (the one
+designed blocking point of the dispatch-ahead pipeline), the step-tail
+finishers, the calibration probe — where the rule stays quiet; a fetch
+anywhere else is a finding.  Jitted bodies are skipped (inside a trace
+these calls are either static-time or a tracer error — the
+tracer-leak/trace-discipline passes own that side).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.fusionlint import config
+from tools.fusionlint.core import REPO, Finding, LintPass, Module
+from tools.fusionlint.dataflow import (
+    Prov,
+    ProvenanceAnalysis,
+    functions_of,
+    own_nodes,
+)
+from tools.fusionlint.jitsites import scan_module
+from tools.fusionlint.passes.jitregistry import entry_name, load_registry
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+class HostSyncPass(LintPass):
+    name = "host-sync"
+    rules = ("host-sync",)
+
+    def __init__(self,
+                 hot_modules: dict[str, tuple[str, ...]] | None = None,
+                 registry_path: str | None = None):
+        self.hot_modules = (config.HOST_SYNC_MODULES
+                            if hot_modules is None else hot_modules)
+        rel = (config.JIT_REGISTRY_MODULE
+               if registry_path is None else registry_path)
+        path = pathlib.Path(rel)
+        if not path.is_absolute():
+            path = REPO / path
+        try:
+            registry = load_registry(path)
+        except (OSError, SyntaxError, KeyError):
+            registry = {}
+        self.analysis = ProvenanceAnalysis(
+            device_callees={entry_name(key) for key in registry})
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        allowed = self.hot_modules.get(mod.rel)
+        if allowed is None:
+            return []
+        jit_ids = {id(b) for b in scan_module(mod).jitted_bodies}
+        funcs = functions_of(mod.tree)
+        # a sanctioned fetch function sanctions its WHOLE subtree: a
+        # helper closure extracted inside _consume_inflight still
+        # fetches at the designed point
+        allowed_ids: set[int] = set()
+        for func in funcs:
+            if getattr(func, "name", "") in allowed:
+                for node in ast.walk(func):
+                    allowed_ids.add(id(node))
+        findings: list[Finding] = []
+        for func in funcs:
+            if id(func) in jit_ids or id(func) in allowed_ids:
+                continue
+            du = self.analysis.analyze(func)
+            # own_nodes: nested defs are their own entries — walking
+            # into them here would emit each finding twice
+            for node in own_nodes(func):
+                if isinstance(node, ast.Call):
+                    findings.extend(
+                        self._check_call(mod, func, node, du))
+        return findings
+
+    def _prov(self, expr: ast.expr, du) -> Prov:
+        return self.analysis.prov_of(expr, du, order=1 << 30)
+
+    def _check_call(self, mod: Module, func: ast.AST, call: ast.Call,
+                    du) -> list[Finding]:
+        fname = getattr(func, "name", "<fn>")
+        f = call.func
+        what = None
+        if (isinstance(f, ast.Name) and f.id in ("int", "float", "bool")
+                and call.args
+                and self._prov(call.args[0], du) is Prov.DEVICE):
+            what = f"{f.id}() on a device value"
+        elif isinstance(f, ast.Attribute):
+            if (f.attr == "asarray" and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy") and call.args
+                    and self._prov(call.args[0], du) is Prov.DEVICE):
+                what = "np.asarray() on a device value"
+            elif (f.attr == "device_get" and isinstance(f.value, ast.Name)
+                  and f.value.id == "jax"):
+                what = "jax.device_get()"
+            elif (f.attr in _SYNC_METHODS
+                  and self._prov(f.value, du) is Prov.DEVICE):
+                what = f".{f.attr}() on a device value"
+        if what is None:
+            return []
+        return [Finding(
+            "host-sync", mod.rel, call.lineno,
+            f"{what} inside hot-path function {fname}() blocks the "
+            "dispatch pipeline on a device→host fetch — move the fetch "
+            "to a sanctioned consume point (config.HOST_SYNC_MODULES "
+            "allowlist) or keep the value on device")]

@@ -1,0 +1,172 @@
+"""trace-discipline pass — bounded compile signatures at every call site.
+
+The engine's throughput premise (PRs 4-6) is that every dispatch hits a
+*bounded family* of compile signatures: descriptor rows pinned to
+pow2(2·max_batch), the flat token axis pow2-bucketed with a floor,
+prompts padded to power-of-two buckets.  One un-bucketed dynamic extent
+reaching a shape or a static argument mints a fresh XLA compilation per
+distinct value — the bench regresses and nothing says why.
+
+Built on the dataflow layer (:mod:`tools.fusionlint.dataflow`): a host
+int derived from ``len()`` / ``.shape`` / ``.size`` is TAINTED until it
+passes a sanctioned bucketing helper (``config.TRACE_DIM_HELPERS``:
+``pow2_rows``, ``pick_bucket``, ...), which makes it SHAPE-DISCIPLINED.
+
+Rules:
+
+``trace-dynamic-dim``
+    a TAINTED value used as (part of) the shape argument of an array
+    constructor (``np/jnp.zeros/ones/full/empty``), or passed to a
+    STATIC argument of a registered jit entry point (the static side is
+    the compile signature).
+
+``trace-host-arg``
+    a Python ``bool`` / ``str`` literal passed to a TRACED argument of
+    a registered entry point — bools silently become weak-typed device
+    scalars (flag semantics wanted a static), strings are a trace-time
+    ``TypeError``; both belong on the static side per the registry's
+    declared split.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.fusionlint import config
+from tools.fusionlint.core import REPO, Finding, LintPass, Module, callee_name
+from tools.fusionlint.dataflow import (
+    Prov,
+    ProvenanceAnalysis,
+    functions_of,
+    own_nodes,
+)
+from tools.fusionlint.passes.jitregistry import entry_name, load_registry
+
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty"}
+
+
+class TraceDisciplinePass(LintPass):
+    name = "trace-discipline"
+    rules = ("trace-dynamic-dim", "trace-host-arg")
+
+    def __init__(self,
+                 registry_path: str | None = None,
+                 caller_modules: list[str] | None = None,
+                 dim_helpers: tuple[str, ...] | None = None):
+        rel = (config.JIT_REGISTRY_MODULE
+               if registry_path is None else registry_path)
+        path = pathlib.Path(rel)
+        if not path.is_absolute():
+            path = REPO / path
+        try:
+            registry = load_registry(path)
+        except (OSError, SyntaxError, KeyError):
+            registry = {}
+        # terminal callable name -> (static_argnums, static_argnames);
+        # only "jit" entries have a meaningful split
+        self.entry_splits: dict[str, tuple[tuple, tuple]] = {}
+        for key, entry in registry.items():
+            if entry.get("kind") != "jit":
+                continue
+            name = entry_name(key)
+            self.entry_splits[name] = (
+                tuple(entry.get("static_argnums", ())),
+                tuple(entry.get("static_argnames", ())))
+        self.caller_modules = (config.TRACE_CALLER_MODULES
+                               if caller_modules is None else caller_modules)
+        self.dim_helpers = (config.TRACE_DIM_HELPERS
+                            if dim_helpers is None else dim_helpers)
+        self.analysis = ProvenanceAnalysis(
+            device_callees=set(self.entry_splits),
+            shape_helpers=set(self.dim_helpers))
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if not mod.matches(self.caller_modules):
+            return []
+        findings: list[Finding] = []
+        for func in functions_of(mod.tree):
+            du = self.analysis.analyze(func)
+            # own_nodes: nested defs are separate functions_of entries —
+            # descending into them here would double-count their calls
+            for node in own_nodes(func):
+                if isinstance(node, ast.Call):
+                    findings.extend(self._check_call(mod, node, du))
+        return findings
+
+    # the analysis orders defs/uses by a private counter; for call-site
+    # checks we resolve name provenance at the END of the function (the
+    # join of every def) — calls are overwhelmingly after the last def
+    # of their operands, and joining over all defs errs toward the more
+    # dangerous provenance, never toward silence.
+    @staticmethod
+    def _prov(analysis, expr, du):
+        return analysis.prov_of(expr, du, order=1 << 30)
+
+    def _check_call(self, mod: Module, call: ast.Call, du) -> list[Finding]:
+        findings: list[Finding] = []
+        name = callee_name(call.func)
+
+        # array-constructor shapes: np/jnp.zeros((T, ...)) et al.
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _SHAPE_CTORS
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in ("np", "numpy", "jnp")
+                and call.args):
+            prov = self._prov(self.analysis, call.args[0], du)
+            if prov is Prov.TAINTED:
+                findings.append(Finding(
+                    "trace-dynamic-dim", mod.rel, call.lineno,
+                    f"{call.func.value.id}.{call.func.attr} shape derives "
+                    "from a raw dynamic extent (len()/shape) — bucket it "
+                    "through a sanctioned helper "
+                    f"({', '.join(self.dim_helpers[:2])}, ...) or the "
+                    "compile-signature family grows without bound"))
+
+        split = self.entry_splits.get(name or "")
+        if split is None:
+            return findings
+        static_nums, static_names = split
+        for i, arg in enumerate(call.args):
+            prov = self._prov(self.analysis, arg, du)
+            if i in static_nums:
+                if prov is Prov.TAINTED:
+                    findings.append(Finding(
+                        "trace-dynamic-dim", mod.rel, arg.lineno,
+                        f"static argument {i} of {name}() derives from a "
+                        "raw dynamic extent — every distinct value mints "
+                        "a compile signature; bucket it through a "
+                        "sanctioned helper first"))
+            else:
+                findings.extend(self._traced_literal(
+                    mod, name, arg, f"positional argument {i}"))
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            prov = self._prov(self.analysis, kw.value, du)
+            if kw.arg in static_names:
+                if prov is Prov.TAINTED:
+                    findings.append(Finding(
+                        "trace-dynamic-dim", mod.rel, kw.value.lineno,
+                        f"static argument {kw.arg!r} of {name}() derives "
+                        "from a raw dynamic extent — every distinct value "
+                        "mints a compile signature; bucket it through a "
+                        "sanctioned helper first"))
+            else:
+                findings.extend(self._traced_literal(
+                    mod, name, kw.value, f"traced argument {kw.arg!r}"))
+        return findings
+
+    @staticmethod
+    def _traced_literal(mod: Module, entry: str, expr: ast.expr,
+                        where: str) -> list[Finding]:
+        if isinstance(expr, ast.Constant) and isinstance(
+                expr.value, (bool, str)) and expr.value is not None:
+            return [Finding(
+                "trace-host-arg", mod.rel, expr.lineno,
+                f"Python {type(expr.value).__name__} literal passed as "
+                f"{where} of {entry}() — the registry declares it traced; "
+                "bools become weak-typed device scalars and strings are a "
+                "trace-time TypeError.  Make it static (and update the "
+                "jit registry) or encode it as an array operand")]
+        return []

@@ -16,8 +16,20 @@ Two checks, both against the Python sources of truth:
    ``InferenceService.validate()`` pass for semantic rules the schema
    cannot express.  A sample that drifts from the CRD is a quickstart
    that 422s on a real cluster.
+3. **Rendered children** — render every sample ``InferenceService``'s
+   full child set (``operator/render.py: render_all``) in memory and
+   validate each LWS / Volcano PodGroup / InferencePool / HTTPRoute
+   against the PINNED vendored external CRD schemas
+   (``operator/manifests.EXTERNAL_CRDS`` — the same dicts
+   ``config/crd/external/*.yaml`` render from).  This is the envtest
+   parity VERDICT #5 asked for: a builder emitting a structurally
+   invalid child fails HERE, not on a live cluster whose upstream
+   installs happened to validate it.  External kinds the operator
+   renders must carry a real vendored schema — a schema-less stand-in
+   for a rendered kind is itself a finding (it would validate
+   anything).
 
-Exit code 1 on any drift or invalid sample.
+Exit code 1 on any drift, invalid sample, or invalid rendered child.
 """
 
 from __future__ import annotations
@@ -107,11 +119,71 @@ def check_samples(samples_dir: pathlib.Path) -> list[str]:
     return problems
 
 
+# external API groups the operator renders children into; each rendered
+# kind from one of these MUST have a real vendored schema (native kinds
+# — Deployment, Service, RBAC — are the kube-apiserver's to validate)
+_EXTERNAL_GROUPS = (
+    "leaderworkerset.x-k8s.io",
+    "scheduling.volcano.sh",
+    "inference.networking.k8s.io",
+    "gateway.networking.k8s.io",
+)
+
+
+def check_rendered_children(samples_dir: pathlib.Path,
+                            render=None) -> list[str]:
+    """Validate every sample's rendered child set against the pinned
+    vendored external CRD schemas.  ``render`` is injectable so the
+    broken-render self-test can prove the gate trips."""
+    from fusioninfer_tpu.api.types import InferenceService
+    from fusioninfer_tpu.operator.render import render_all
+    from fusioninfer_tpu.operator.schema import CRDValidator
+
+    render = render_all if render is None else render
+    validator = CRDValidator()
+    problems: list[str] = []
+    for path in sorted(samples_dir.glob("*.yaml")):
+        rel = f"config/samples/{path.name}"
+        try:
+            docs = [d for d in yaml.safe_load_all(path.read_text()) if d]
+        except yaml.YAMLError:
+            continue  # check_samples already reports unparseable files
+        for doc in docs:
+            if doc.get("kind") != "InferenceService":
+                continue
+            name = (doc.get("metadata") or {}).get("name", "?")
+            try:
+                svc = InferenceService.from_dict(doc)
+                children = render(svc)
+            except Exception as e:  # a sample that cannot render at all
+                problems.append(f"{rel}: {name!r}: render failed: {e}")
+                continue
+            for child in children:
+                api_version = child.get("apiVersion", "?")
+                kind = child.get("kind", "?")
+                cname = (child.get("metadata") or {}).get("name", "?")
+                group = api_version.split("/", 1)[0]
+                if group not in _EXTERNAL_GROUPS:
+                    continue
+                if not validator.knows(api_version, kind):
+                    problems.append(
+                        f"{rel}: {name!r} renders {kind} {cname!r} but no "
+                        f"vendored schema covers ({api_version}, {kind}) — "
+                        "pin it in operator/manifests.EXTERNAL_CRDS")
+                    continue
+                for err in validator.validate(child):
+                    problems.append(
+                        f"{rel}: {name!r} renders invalid {kind} "
+                        f"{cname!r}: {err}")
+    return problems
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     config_dir = pathlib.Path(argv[0]) if argv else REPO / "config"
     problems = check_drift(config_dir)
     problems += check_samples(config_dir / "samples")
+    problems += check_rendered_children(config_dir / "samples")
     for p in problems:
         print(p)
     if problems:
@@ -119,7 +191,8 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 1
     print("verify-manifests: config/ matches the sources; all samples "
-          "validate against the CRD schemas")
+          "validate against the CRD schemas; every rendered child "
+          "validates against the pinned external schemas")
     return 0
 
 
