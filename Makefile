@@ -40,6 +40,10 @@ KIND_CLUSTER ?= fusioninfer-tpu-e2e
 test-e2e: ## kind e2e: deploy the operator into a real cluster, reconcile a sample (needs kind/kubectl/docker).
 	FUSIONINFER_E2E=1 KIND_CLUSTER=$(KIND_CLUSTER) $(PYTHON) -m pytest test/e2e/ -v -q
 
+.PHONY: test-e2e-repro
+test-e2e-repro: ## Reproducible kind e2e from the committed bundle + script; evidence lands in test/e2e/kind/last-run/.
+	KIND_CLUSTER=$(KIND_CLUSTER) test/e2e/kind/run-kind-e2e.sh
+
 .PHONY: cleanup-test-e2e
 cleanup-test-e2e: ## Tear down the e2e kind cluster.
 	kind delete cluster --name $(KIND_CLUSTER)

@@ -982,6 +982,80 @@ def run_http(cfg, max_batch_size: int, cache_cfg, n_requests: int,
         srv.stop()
 
 
+def run_sharedprefix(cfg) -> dict:
+    """``workload_sharedprefix``: the shared-system-prompt + multi-turn
+    leg that finally drives ``prefix_cache_hit_rate`` off 0.0 (every
+    record through r05 reported 0.0 because the honest unique-prompt
+    load deliberately avoids cache hits) and exercises the full KV
+    hierarchy: a deliberately tight HBM pool forces warm system-prompt
+    chains to offload to the host-DRAM tier and restore on later hits
+    (docs/design/kv-hierarchy.md).
+
+    Two passes of the same load shape: an UNRECORDED warmup pass (seed
+    1) compiles every jit signature the measured traffic hits, then the
+    measured pass (seed 2 — different system prompts, so its cold turns
+    are truly cold while signatures stay warm).  Reports cold-vs-warm
+    TTFT, the measured-pass hit rate, and the host tier's
+    offload/restore/hit counter deltas."""
+    from fusioninfer_tpu.benchmark.loadgen import run_sharedprefix_load
+    from fusioninfer_tpu.engine.engine import NativeEngine
+    from fusioninfer_tpu.engine.kv_cache import CacheConfig
+    from fusioninfer_tpu.engine.kv_host_tier import HostKVTier
+    from fusioninfer_tpu.engine.server import EngineServer
+
+    # page_size 32 × 8 pages/seq = 256-token context; 32 usable pages
+    # cannot retain 3 × 7-page system-prompt chains beside the ~6-20
+    # pages 4 concurrent streams own — guaranteed reclaim churn, which
+    # is the point: the host tier must carry the chains HBM cannot
+    # retain, and the round-robin session interleave re-requests them
+    cache_cfg = CacheConfig(n_pages=33, page_size=32, max_pages_per_seq=8)
+    tier = HostKVTier(capacity_bytes=64 << 20)
+    engine = NativeEngine(
+        cfg, cache_cfg=cache_cfg, max_batch_size=4,
+        token_budget=256, decode_burst_steps=1, fused_step=True,
+        host_kv_tier=tier,
+    )
+    srv = EngineServer(model=cfg.name, host="127.0.0.1", port=0,
+                       engine=engine)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        run_sharedprefix_load(base, seed=1)  # warmup: compile signatures
+        tier.flush()
+        before = tier.counters()
+        sched_before = (engine.sched.kv_restores_total,
+                        engine.sched.kv_restore_tokens_total,
+                        engine.sched.kv_restore_deferred_total)
+        engine.alloc.hit_tokens_total = 0
+        engine.alloc.query_tokens_total = 0
+        out = run_sharedprefix_load(base, seed=2)
+        tier.flush()
+        after = tier.counters()
+        out["host_tier"] = {
+            k: after[k] - before[k]
+            for k in ("offloads", "restores", "host_hits",
+                      "corrupt_dropped", "evictions")
+        }
+        out["host_tier"]["resident_blocks"] = after["resident_blocks"]
+        # measured-pass deltas, same regime as host_tier above — the
+        # warmup pass restores too and must not inflate the evidence
+        out["scheduler_kv"] = {
+            "kv_restores": engine.sched.kv_restores_total - sched_before[0],
+            "kv_restore_tokens":
+                engine.sched.kv_restore_tokens_total - sched_before[1],
+            "kv_restore_deferred":
+                engine.sched.kv_restore_deferred_total - sched_before[2],
+        }
+        out["warmed"] = True
+        out["cache"] = {"n_pages": cache_cfg.n_pages,
+                        "page_size": cache_cfg.page_size,
+                        "host_tier_mb": 64}
+        return out
+    finally:
+        srv.stop()
+        tier.close()
+
+
 def main() -> None:
     record: dict = {
         "metric": "decode_throughput",
@@ -1309,6 +1383,15 @@ def main() -> None:
                     record[leg]["ceiling_fraction"] = round(
                         record[leg].get("output_tok_per_s_per_chip", 0.0)
                         / tok_s, 4)
+            # hierarchical-KV workload leg (shared system prompts +
+            # multi-turn): hit rate, warm-vs-cold TTFT, host-tier
+            # offload/restore evidence — gated by check_bench_record
+            try:
+                record["workload_sharedprefix"] = run_sharedprefix(
+                    http_cfg)
+            except Exception as e:
+                record["workload_sharedprefix"] = {
+                    "error": f"{type(e).__name__}: {str(e)[:400]}"}
     except Exception as e:  # never a traceback instead of the JSON line
         record["error"] = f"{type(e).__name__}: {e}"
     attach_tpu_evidence(record)

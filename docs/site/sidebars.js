@@ -9,7 +9,8 @@ const sidebars = {
       type: 'category',
       label: 'Design',
       items: ['design/autoscaling', 'design/crd', 'design/engine',
-              'design/parallelism', 'design/resilience', 'design/router',
+              'design/kv-hierarchy', 'design/parallelism',
+              'design/resilience', 'design/router',
               'design/scheduler', 'design/static-analysis'],
     },
   ],

@@ -203,6 +203,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--prefill-upstream", default="",
         help="PD decode role: pull prefills (KV over DCN) from this prefiller URL",
     )
+    serve.add_argument("--kv-host-tier-mb", type=int, default=0,
+                       help="host-DRAM KV tier capacity in MiB (0 = off): "
+                            "evicted prefix-cache pages offload to a "
+                            "CRC-checked host slab pool and restore on "
+                            "later hits instead of recomputing "
+                            "(docs/design/kv-hierarchy.md); requires "
+                            "prefix caching, single-process only")
     serve.add_argument("--no-prefix-caching", action="store_true",
                        help="disable automatic prefix caching (KV page reuse)")
     serve.add_argument("--prefill-chunk-size", type=int, default=0,

@@ -62,7 +62,10 @@ from fusioninfer_tpu.engine.model_runner import (
     prefill_buckets,
 )
 from fusioninfer_tpu.ops import dispatch as ops_dispatch
-from fusioninfer_tpu.engine.prefix_cache import PrefixCachingAllocator
+from fusioninfer_tpu.engine.prefix_cache import (
+    PrefixCachingAllocator,
+    block_hashes,
+)
 from fusioninfer_tpu.engine.spec import NgramProposer
 from fusioninfer_tpu.engine.sampler import (
     SamplingParams,
@@ -265,6 +268,7 @@ class NativeEngine:
         pipeline_bursts: bool = True,
         fused_step: bool = True,
         clock=time.monotonic,
+        host_kv_tier=None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` (axes from
         ``fusioninfer_tpu.parallel``). Weights shard Megatron-style over
@@ -325,7 +329,16 @@ class NativeEngine:
         Burst-enabled engines (``decode_burst_steps > 1``) keep the
         classic split dispatch either way: their span-1 fused
         decode+sample path carries the dispatch-ahead control chain the
-        mixed-batch forward cannot."""
+        mixed-batch forward cannot.
+
+        ``host_kv_tier``: an :class:`engine.kv_host_tier.HostKVTier` —
+        evictable hashed pages reclaimed from the HBM prefix cache
+        offload to this host-DRAM pool instead of vanishing, and prefix
+        misses that hit the host tier restore via an async H2D upload
+        charged against the step token budget
+        (docs/design/kv-hierarchy.md).  Requires prefix caching;
+        refused on multi-process meshes (offload/restore timing is
+        process-local and would diverge the SPMD lockstep)."""
         self.cfg = cfg.validate()
         self.cache_cfg = (cache_cfg or CacheConfig()).validate()
         self.max_batch_size = max_batch_size
@@ -413,6 +426,21 @@ class NativeEngine:
             if enable_prefix_caching
             else PageAllocator(self.cache_cfg)
         )
+        # hierarchical KV: reclaimed evictable pages offload to host
+        # DRAM; prefix misses restore from it (engine/kv_host_tier.py)
+        self._host_tier = None
+        if host_kv_tier is not None:
+            if not enable_prefix_caching:
+                raise ValueError(
+                    "host_kv_tier requires enable_prefix_caching (the "
+                    "tier is keyed by the prefix cache's block hashes)")
+            if self._mh is not None:
+                raise ValueError(
+                    "host_kv_tier is single-process only: offload/"
+                    "restore timing is process-local and would diverge "
+                    "the multi-host SPMD lockstep")
+            self._host_tier = host_kv_tier
+            self.alloc.on_reclaim = self._offload_page
         self.buckets = prefill_buckets(self.cache_cfg.max_len)
         self._key = jax.random.key(seed + 1)
         self._step_counter = itertools.count()
@@ -1105,6 +1133,167 @@ class NativeEngine:
             return 0.0
         return self.alloc.prefix_hit_rate()
 
+    # -- hierarchical KV (host tier) -----------------------------------------
+
+    @property
+    def host_kv_tier(self):
+        return self._host_tier
+
+    def _offload_page(self, page: int, h: bytes) -> None:
+        """``PrefixCachingAllocator.on_reclaim`` hook: snapshot one
+        evictable page's KV and queue it for host-tier storage.  The
+        device-side gather dispatches HERE — before the reclaiming
+        forward can overwrite the page — so the snapshot is immutable
+        even though serialization happens later on the tier's worker."""
+        from fusioninfer_tpu.engine.kv_transfer import extract_slab
+
+        if self._host_tier.contains(h):
+            # content-addressed: the tier already holds these exact
+            # bytes (restored chains stay resident through take()), so
+            # a re-gather + re-serialize would be pure waste on the
+            # restore→use→reclaim cycle of every hot chain
+            return
+        # the PD path's extractor, at one page (host-tier frames carry
+        # no prompt/first-token resume state — identity is the hash)
+        self._host_tier.offload(h, extract_slab(
+            self.cache, [page], [], 0, self.cache_cfg.page_size))
+
+    def _restore_host_blocks(self, request: Request,
+                             prefix: list[int]) -> None:
+        """Consult the host tier for the blocks HBM no longer holds and
+        restore the hit chain ahead of ``match_prefix``.
+
+        Restored pages are injected via an async H2D scatter (the
+        upload overlaps the host-side admission work that follows) and
+        adopted as EVICTABLE content, so they raise ``can_admit``'s
+        matched count without consuming admission capacity.  Budget
+        backpressure: decode was charged first (``begin_step``), so a
+        restore plan only ever spends the step's prefill remainder —
+        truncated plans count ``sched_kv_restore_deferred_total`` and
+        the un-restored tail stays host-resident for the next step.
+        Any take() failure (corrupt frame, injected fault, evicted
+        entry) just shortens the chain: the suffix recomputes from the
+        prompt, never from a bad page."""
+        tier = self._host_tier
+        if tier is None or not len(tier):
+            # empty tier (the steady state for non-shared traffic):
+            # skip the per-admission hash-chain build entirely
+            return
+        ps = self.cache_cfg.page_size
+        usable = max(0, (len(prefix) - 1) // ps)
+        if not usable:
+            return
+        hashes = block_hashes(list(prefix), ps,
+                              self._lora_ns(request))[:usable]
+        plan: list[bytes] = []
+        resident_evictable = 0
+        for h in hashes:
+            if self.alloc.has_block(h):
+                # already HBM-resident (either tier may hold any block
+                # of one chain) — MRU-bump it so the adoptions below
+                # can never LRU-reclaim the chain we are restoring
+                resident_evictable += self.alloc.touch_block(h)
+                continue
+            if not tier.contains(h):
+                break
+            plan.append(h)
+        if not plan:
+            return
+        deferred = False
+        if self.sched.tokens_per_step is not None:
+            # floored at one page, mirroring _chunk_budget's 1-token
+            # trickle: a step remainder smaller than one page (derived
+            # budgets can sit below page_size) must not pin restores at
+            # zero forever — one H2D page copy per step is negligible
+            # next to recomputing those tokens as prefill chunks
+            max_blocks = max(1, self._step_prefill_left // ps)
+            if len(plan) > max_blocks:
+                deferred = True
+                plan = plan[:max_blocks]
+        # pool-safety cap: each adopt consumes one page that was free or
+        # evictable BEFORE this plan started.  Adopting more than that
+        # would cascade _take_free_page into a page adopted earlier in
+        # this same plan — whose KV is not injected yet — and offload
+        # its stale contents to the host tier under a valid CRC, while
+        # handing inject_slab duplicate page indices.  Capped, the LRU
+        # order guarantees reclaim only ever touches pre-plan content
+        # (our adopted pages sit at the MRU end).  The chain's own
+        # HBM-resident evictable blocks (bumped to MRU above) are
+        # subtracted too: adopting into them would evict the head of
+        # the very chain this restore is completing.
+        pool_cap = max(0, self.alloc.free_pages - resident_evictable)
+        if len(plan) > pool_cap:
+            # pool truncation is backpressure too: the deferred counter
+            # must cover it or an operator sees restores lag host_hits
+            # with the counter stuck at zero
+            deferred = True
+            plan = plan[:pool_cap]
+        if deferred:
+            # one count per truncated PLAN (the metric's unit), however
+            # many caps bit
+            self.sched.kv_restore_deferred_total += 1
+        if not plan:
+            return
+        from fusioninfer_tpu.engine.kv_transfer import KVSlab, inject_slab
+
+        slabs: list = []
+        pages: list[int] = []
+        for h in plan:
+            slab = tier.take(h)
+            if slab is None:
+                break  # the restored chain must stay contiguous
+            try:
+                page = self.alloc.adopt_block(h)
+            except MemoryError:
+                break
+            slabs.append(slab)
+            pages.append(page)
+        if not pages:
+            return
+        quant = slabs[0].quantized
+        combined = KVSlab(
+            k=jnp.concatenate([s.k for s in slabs], axis=2),
+            v=jnp.concatenate([s.v for s in slabs], axis=2),
+            prompt_tokens=[],
+            first_token=0,
+            page_size=ps,
+            k_scale=(jnp.concatenate([s.k_scale for s in slabs], axis=2)
+                     if quant else None),
+            v_scale=(jnp.concatenate([s.v_scale for s in slabs], axis=2)
+                     if quant else None),
+        )
+        self.cache = inject_slab(self.cache, combined, pages)
+        n_tokens = len(pages) * ps
+        self._reserve_prefill(n_tokens)
+        self.sched.kv_restores_total += len(pages)
+        self.sched.kv_restore_tokens_total += n_tokens
+        tier.note_restored(len(pages))
+
+    def prefix_residency(self, limit: int = 128) -> dict:
+        """Per-tier prefix-cache residency: block counts plus a top-K
+        most-recent block-hash digest (hex) — the payload of the
+        server's ``/v1/prefix_residency`` endpoint, which the EPP's
+        residency-aware prefix scorer scores against
+        (docs/design/kv-hierarchy.md)."""
+        out: dict = {
+            "page_size": self.cache_cfg.page_size,
+            "tiers": {"hbm": 0, "host": 0},
+            "blocks": {"hbm": [], "host": []},
+        }
+        if self.prefix_caching:
+            out["tiers"]["hbm"] = self.alloc.resident_blocks()
+            if limit > 0:
+                out["blocks"]["hbm"] = [
+                    h.hex()
+                    for h in self.alloc.resident_block_hashes(limit)]
+        if self._host_tier is not None:
+            out["tiers"]["host"] = self._host_tier.resident_blocks()
+            if limit > 0:
+                out["blocks"]["host"] = [
+                    h.hex()
+                    for h in self._host_tier.resident_block_hashes(limit)]
+        return out
+
     def cancel(self, request_id: str) -> None:
         """Abandon a request (client gone). Thread-safe; takes effect at
         the next step so only the engine thread mutates scheduling state."""
@@ -1299,6 +1488,11 @@ class NativeEngine:
             self._admit_t[request.request_id] = (
                 now, max(0.0, now - request.arrival_time))
             prefix = request.resume_tokens or request.prompt_tokens
+            if self._host_tier is not None:
+                # host-tier consult BEFORE capacity checks: restored
+                # blocks land evictable, so they raise can_admit's
+                # matched count without consuming admission capacity
+                self._restore_host_blocks(request, prefix)
             blocked = False
             # reuse-aware: a mostly-cached prompt needs few fresh pages
             while not self.alloc.can_admit(prefix, 1,

@@ -1,0 +1,311 @@
+"""Host-DRAM KV tier: the HBM prefix cache's second level.
+
+The HBM-only prefix cache (``engine/prefix_cache.py``) evicts warm
+system prompts under production request rates — every bench record
+through r05 reports ``prefix_cache_hit_rate: 0.0``.  This module adds
+the HBM → host-DRAM level of the hierarchy:
+
+* When :class:`PrefixCachingAllocator` reclaims an evictable hashed
+  page, the engine's ``on_reclaim`` hook snapshots the page's KV
+  (a device-side gather dispatched BEFORE the reclaiming forward can
+  overwrite it) and hands it here; a background worker serializes it to
+  a pinned host slab pool.  Frames reuse the :mod:`kv_transfer` wire
+  format — CRC32-checked, int8 codes + scales when the engine cache is
+  quantized (half the host traffic of bf16) — keyed by the SAME
+  content-addressed block hash the HBM cache uses, so a chain's
+  identity never changes as it moves between tiers.
+* ``match_prefix`` misses consult this tier next
+  (:meth:`NativeEngine._restore_host_blocks`): hit chains are restored
+  via an async H2D slab upload overlapped with suffix-prefill
+  admission, charged against the step token budget so restores can
+  never starve decode.
+
+Bit-exactness: frames store the cache's native layout raw (bf16 as
+uint16, int8 codes + f32 scales), so a restored page is byte-identical
+to the evicted one and hit-via-host-restore streams match cold-prefill
+streams bit for bit — the same guarantee the HBM prefix cache already
+carries, extended one tier down.
+
+Failure semantics: every fault (injected or real) degrades to a cache
+MISS — the engine recomputes the prefix from the prompt, never serves a
+corrupt page.  ``FaultInjector`` sites: ``kv.host.offload`` (drop /
+delay / error before serialization), ``kv.host.offload.data`` (corrupt
+the stored frame), ``kv.host.restore`` (drop/delay/error before parse),
+``kv.host.restore.data`` (corrupt the frame on the way back — CRC32
+catches it, the entry is dropped, and the prefix recomputes).
+
+Single-process only: offload/restore timing is process-local and would
+diverge a multi-host SPMD lockstep group's schedulers (the engine
+refuses to wire the tier on a multi-process mesh).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue as queue_mod
+import threading
+from typing import Optional
+
+from fusioninfer_tpu.engine.kv_transfer import (
+    KVSlab,
+    KVTransferError,
+    slab_from_bytes,
+    slab_to_bytes,
+)
+from fusioninfer_tpu.resilience import FaultInjector, InjectedFault
+
+logger = logging.getLogger("fusioninfer.kv_host_tier")
+
+SITE_OFFLOAD = "kv.host.offload"
+SITE_OFFLOAD_DATA = "kv.host.offload.data"
+SITE_RESTORE = "kv.host.restore"
+SITE_RESTORE_DATA = "kv.host.restore.data"
+
+_STOP = object()  # worker shutdown sentinel
+
+
+class HostKVTier:
+    """Bounded host-memory slab pool keyed by KV block hash.
+
+    ``capacity_bytes`` is the watermark: committing a frame that pushes
+    the pool past it evicts least-recently-used entries until it fits
+    (host DRAM is big but not infinite; the pool must never grow
+    unboundedly under a hot eviction stream).  ``async_offload=True``
+    (the serving default) serializes frames on a daemon worker so the
+    engine step never blocks on a D2H fetch; tests and deterministic
+    chaos runs pass ``False`` (or call :meth:`flush`) to make offload
+    visibility synchronous.
+    """
+
+    def __init__(self, capacity_bytes: int = 256 << 20,
+                 fault_injector: Optional[FaultInjector] = None,
+                 async_offload: bool = True,
+                 max_queue_depth: int = 256):
+        if capacity_bytes < 1:
+            raise ValueError("capacity_bytes must be >= 1")
+        if max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        self.capacity_bytes = capacity_bytes
+        self.fault_injector = fault_injector
+        self.async_offload = async_offload
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[bytes, bytes]" = (
+            collections.OrderedDict())
+        self._bytes_used = 0
+        # counters (exported via engine /metrics; all reads go through
+        # counters() so exposition never sees a torn update)
+        self._offloads_total = 0        # pages committed to the pool
+        self._offload_failed_total = 0  # injected/real offload failures
+        self._evictions_total = 0       # LRU evictions at capacity
+        self._hits_total = 0            # take() calls that served a page
+        self._restores_total = 0        # pages re-injected into HBM
+        self._corrupt_dropped_total = 0  # CRC-rejected entries dropped
+        # bounded: each queued entry pins a device-array snapshot, so a
+        # reclaim storm outrunning the serializer must shed load (drop-
+        # OLDEST — the newest eviction is the most recently used chain,
+        # hence the likeliest re-request) instead of growing without
+        # bound; a dropped offload degrades safely to recompute
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max_queue_depth)
+        self._worker: Optional[threading.Thread] = None
+
+    # -- offload (HBM -> host) ----------------------------------------------
+
+    def offload(self, h: bytes, slab: KVSlab) -> None:
+        """Queue one page's KV for host storage.  ``slab`` holds the
+        page as device arrays ([L, KV, 1, ps, Hd] + scales when
+        quantized); the worker fetches and serializes it off the engine
+        thread.  Synchronous mode stores inline."""
+        if not self.async_offload:
+            self._store(h, slab)
+            return
+        self._ensure_worker()
+        while True:
+            try:
+                self._q.put_nowait((h, slab))
+                return
+            except queue_mod.Full:
+                try:
+                    dropped = self._q.get_nowait()  # drop-oldest under
+                    self._q.task_done()             # back-pressure
+                    if dropped is _STOP:
+                        # close() raced an offload storm: this frame is
+                        # shed like any other overflow (the tier is
+                        # shutting down; a shed frame degrades to
+                        # recompute) and the sentinel goes back without
+                        # blocking the engine thread — the slot just
+                        # freed cannot be refilled, this is the only
+                        # frame producer and close() enqueues its
+                        # sentinel once
+                        try:
+                            self._q.put_nowait(dropped)
+                        except queue_mod.Full:  # pragma: no cover
+                            logger.warning(
+                                "host-tier shutdown sentinel shed under "
+                                "queue pressure; worker exits with the "
+                                "process (daemon)")
+                        with self._lock:
+                            self._offload_failed_total += 1
+                        return
+                    with self._lock:
+                        self._offload_failed_total += 1
+                except queue_mod.Empty:
+                    continue  # worker drained it first — retry the put
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True,
+                name="kv-host-tier-offload")
+            self._worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is _STOP:
+                    return
+                h, slab = item
+                self._store(h, slab)
+            except Exception:
+                logger.exception("host-tier offload worker failed")
+            finally:
+                self._q.task_done()
+
+    def _store(self, h: bytes, slab: KVSlab) -> None:
+        """Serialize + commit one page frame (the tier's sanctioned
+        device→host fetch point: ``slab_to_bytes`` blocks on the page
+        gather the engine dispatched at reclaim time)."""
+        try:
+            if self.fault_injector is not None:
+                self.fault_injector.fire(SITE_OFFLOAD)
+            data = slab_to_bytes(slab)
+        except InjectedFault as e:
+            with self._lock:
+                self._offload_failed_total += 1
+            logger.info("host-tier offload dropped (%s)", e)
+            return
+        except Exception:
+            with self._lock:
+                self._offload_failed_total += 1
+            logger.exception("host-tier offload serialization failed")
+            return
+        if self.fault_injector is not None:
+            # corrupt the STORED frame: the damage sits in the pool and
+            # must be caught by CRC at restore time, not at store time
+            data = self.fault_injector.corrupt(SITE_OFFLOAD_DATA, data)
+        with self._lock:
+            old = self._entries.pop(h, None)
+            if old is not None:
+                self._bytes_used -= len(old)
+            self._entries[h] = data
+            self._bytes_used += len(data)
+            self._offloads_total += 1
+            # capacity watermark: evict LRU until the pool fits
+            while self._bytes_used > self.capacity_bytes and len(self._entries) > 1:
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes_used -= len(dropped)
+                self._evictions_total += 1
+            if self._bytes_used > self.capacity_bytes:
+                # a single frame larger than the pool can never be held
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes_used -= len(dropped)
+                self._evictions_total += 1
+
+    # -- restore (host -> HBM) ----------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def contains(self, h: bytes) -> bool:
+        with self._lock:
+            return h in self._entries
+
+    def take(self, h: bytes) -> Optional[KVSlab]:
+        """Fetch one page's slab for restore (entry stays resident, MRU-
+        bumped — several sequences may hit the same warm chain).  Every
+        failure returns ``None`` (a miss → the engine recomputes); a
+        CRC-rejected frame is also DROPPED so the poisoned entry cannot
+        fail every future hit."""
+        with self._lock:
+            data = self._entries.get(h)
+            if data is not None:
+                self._entries.move_to_end(h)
+        if data is None:
+            return None
+        try:
+            if self.fault_injector is not None:
+                self.fault_injector.fire(SITE_RESTORE)
+        except InjectedFault as e:
+            logger.info("host-tier restore dropped (%s)", e)
+            return None
+        if self.fault_injector is not None:
+            data = self.fault_injector.corrupt(SITE_RESTORE_DATA, data)
+        try:
+            slab = slab_from_bytes(data)
+        except (KVTransferError, ValueError, KeyError) as e:
+            with self._lock:
+                dropped = self._entries.pop(h, None)
+                if dropped is not None:
+                    self._bytes_used -= len(dropped)
+                self._corrupt_dropped_total += 1
+            logger.warning("host-tier frame for %s rejected (%s); entry "
+                           "dropped, prefix will recompute", h.hex(), e)
+            return None
+        with self._lock:
+            self._hits_total += 1
+        return slab
+
+    def note_restored(self, n_pages: int) -> None:
+        """The engine confirms ``n_pages`` were re-injected into HBM."""
+        with self._lock:
+            self._restores_total += n_pages
+
+    # -- introspection -------------------------------------------------------
+
+    def resident_blocks(self) -> int:
+        return len(self)
+
+    def resident_block_hashes(self, limit: int = 0) -> list[bytes]:
+        """Resident hashes, most-recently-used first (the host half of
+        the residency digest)."""
+        with self._lock:
+            hashes = list(reversed(self._entries))
+        return hashes[:limit] if limit else hashes
+
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes_used
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "offloads": self._offloads_total,
+                "offload_failed": self._offload_failed_total,
+                "evictions": self._evictions_total,
+                "host_hits": self._hits_total,
+                "restores": self._restores_total,
+                "corrupt_dropped": self._corrupt_dropped_total,
+                "resident_blocks": len(self._entries),
+                "bytes_used": self._bytes_used,
+            }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self) -> None:
+        """Block until every queued offload is committed (tests and the
+        bench's between-strata barriers; production never needs it)."""
+        with self._lock:
+            worker = self._worker
+        if worker is not None:
+            self._q.join()
+
+    def close(self) -> None:
+        with self._lock:
+            worker, self._worker = self._worker, None
+        if worker is not None and worker.is_alive():
+            self._q.put(_STOP)
+            worker.join(timeout=5.0)

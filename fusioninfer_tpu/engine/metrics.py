@@ -173,8 +173,56 @@ class EngineMetrics:
             "# TYPE vllm:e2e_request_latency_seconds histogram",
             *self.e2e_latency.render("vllm:e2e_request_latency_seconds", labels),
         ]
+        lines += self._render_kv_tiers(engine, labels)
         lines += self._render_scheduler(engine, labels)
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_kv_tiers(engine, labels: str) -> list[str]:
+        """Hierarchical-KV families (docs/design/kv-hierarchy.md):
+        per-tier prefix-block residency (the routing signal the EPP's
+        residency scorer coarse-checks before fetching the digest) and,
+        when a host tier is wired, its offload/restore/corruption
+        counters.  Engines predating the hierarchy (test stubs) simply
+        omit the families."""
+        residency = getattr(engine, "prefix_residency", None)
+        if residency is None:
+            return []
+        tiers = residency(limit=0)["tiers"]
+        lines = [
+            "# HELP fusioninfer:prefix_blocks_resident Content-addressed prefix KV blocks resident per tier.",
+            "# TYPE fusioninfer:prefix_blocks_resident gauge",
+            f'fusioninfer:prefix_blocks_resident{{{labels},tier="hbm"}} {tiers["hbm"]}',
+            f'fusioninfer:prefix_blocks_resident{{{labels},tier="host"}} {tiers["host"]}',
+        ]
+        tier = getattr(engine, "host_kv_tier", None)
+        if tier is None:
+            return lines
+        c = tier.counters()
+        lines += [
+            "# HELP fusioninfer:kv_host_offloads_total KV pages offloaded HBM -> host tier.",
+            "# TYPE fusioninfer:kv_host_offloads_total counter",
+            f"fusioninfer:kv_host_offloads_total{{{labels}}} {c['offloads']}",
+            "# HELP fusioninfer:kv_host_restores_total KV pages restored host tier -> HBM.",
+            "# TYPE fusioninfer:kv_host_restores_total counter",
+            f"fusioninfer:kv_host_restores_total{{{labels}}} {c['restores']}",
+            "# HELP fusioninfer:kv_host_hits_total Host-tier lookups that served a page.",
+            "# TYPE fusioninfer:kv_host_hits_total counter",
+            f"fusioninfer:kv_host_hits_total{{{labels}}} {c['host_hits']}",
+            "# HELP fusioninfer:kv_host_evictions_total Host-tier entries evicted at the byte-capacity watermark.",
+            "# TYPE fusioninfer:kv_host_evictions_total counter",
+            f"fusioninfer:kv_host_evictions_total{{{labels}}} {c['evictions']}",
+            "# HELP fusioninfer:kv_host_corrupt_dropped_total Host-tier frames CRC-rejected at restore and dropped (prefix recomputed).",
+            "# TYPE fusioninfer:kv_host_corrupt_dropped_total counter",
+            f"fusioninfer:kv_host_corrupt_dropped_total{{{labels}}} {c['corrupt_dropped']}",
+            "# HELP fusioninfer:kv_host_offload_failed_total Offloads dropped before commit (injected or real serialization faults).",
+            "# TYPE fusioninfer:kv_host_offload_failed_total counter",
+            f"fusioninfer:kv_host_offload_failed_total{{{labels}}} {c['offload_failed']}",
+            "# HELP fusioninfer:kv_host_tier_bytes Host-tier slab pool bytes in use.",
+            "# TYPE fusioninfer:kv_host_tier_bytes gauge",
+            f"fusioninfer:kv_host_tier_bytes{{{labels}}} {c['bytes_used']}",
+        ]
+        return lines
 
     @staticmethod
     def _render_scheduler(engine, labels: str) -> list[str]:
@@ -213,6 +261,15 @@ class EngineMetrics:
             "# HELP fusioninfer:sched_dispatch_ahead_total Successor decode bursts dispatched before the in-flight fetch.",
             "# TYPE fusioninfer:sched_dispatch_ahead_total counter",
             f"fusioninfer:sched_dispatch_ahead_total{{{labels}}} {sched.dispatch_ahead_total}",
+            "# HELP fusioninfer:sched_kv_restores_total KV pages restored from the host tier, charged against the step budget.",
+            "# TYPE fusioninfer:sched_kv_restores_total counter",
+            f"fusioninfer:sched_kv_restores_total{{{labels}}} {sched.kv_restores_total}",
+            "# HELP fusioninfer:sched_kv_restore_tokens_total Prefix tokens covered by host-tier restores (prefill work not recomputed).",
+            "# TYPE fusioninfer:sched_kv_restore_tokens_total counter",
+            f"fusioninfer:sched_kv_restore_tokens_total{{{labels}}} {sched.kv_restore_tokens_total}",
+            "# HELP fusioninfer:sched_kv_restore_deferred_total Host-tier restore plans truncated because the step's prefill budget was spent.",
+            "# TYPE fusioninfer:sched_kv_restore_deferred_total counter",
+            f"fusioninfer:sched_kv_restore_deferred_total{{{labels}}} {sched.kv_restore_deferred_total}",
             "# HELP fusioninfer:sched_fused_steps_total Steps that ran the fused mixed-batch forward (decode + prefill chunks in one weight pass).",
             "# TYPE fusioninfer:sched_fused_steps_total counter",
             f"fusioninfer:sched_fused_steps_total{{{labels}}} {sched.fused_steps_total}",

@@ -24,31 +24,20 @@ the reused prefix).
 from __future__ import annotations
 
 import collections
-import hashlib
-
-import numpy as np
+from typing import Callable, Optional
 
 from fusioninfer_tpu.engine.kv_cache import CacheConfig, PageAllocator
+from fusioninfer_tpu.utils.blockhash import block_hashes
 
+__all__ = ["block_hashes", "PrefixCachingAllocator"]
 
-def block_hashes(tokens: list[int], page_size: int,
-                 namespace: bytes = b"") -> list[bytes]:
-    """Hash chain over the FULL pages of ``tokens``.
-
-    ``namespace`` partitions the content address space: KV computed
-    under different LoRA adapters is different content for the same
-    tokens, so the engine passes the adapter name — base-model and
-    per-adapter prefixes never cross-hit."""
-    out = []
-    parent = b"root" + namespace
-    for i in range(len(tokens) // page_size):
-        block = tokens[i * page_size : (i + 1) * page_size]
-        h = hashlib.blake2b(digest_size=16)
-        h.update(parent)
-        h.update(np.asarray(block, np.int64).tobytes())
-        parent = h.digest()
-        out.append(parent)
-    return out
+# ``block_hashes`` moved to fusioninfer_tpu.utils.blockhash (shared with
+# the router's residency-aware prefix scorer and the host KV tier —
+# identical chain, identical token encoding); re-exported here so every
+# historical import site keeps working.  ``namespace`` partitions the
+# content address space: KV computed under different LoRA adapters is
+# different content for the same tokens, so the engine passes the
+# adapter name — base-model and per-adapter prefixes never cross-hit.
 
 
 class PrefixCachingAllocator(PageAllocator):
@@ -70,6 +59,12 @@ class PrefixCachingAllocator(PageAllocator):
         self._shared_of: dict[str, list[int]] = {}
         self.hit_tokens_total = 0
         self.query_tokens_total = 0
+        # hierarchical-KV hook: called as (page, block_hash) the moment
+        # an evictable hashed page is reclaimed for reuse — the LAST
+        # point its content is still addressable, so the engine can
+        # offload the page's KV to the host tier before the pool
+        # overwrites it (engine/kv_host_tier.py).  None = HBM-only.
+        self.on_reclaim: Optional[Callable[[int, bytes], None]] = None
 
     # -- capacity ------------------------------------------------------------
 
@@ -89,6 +84,11 @@ class PrefixCachingAllocator(PageAllocator):
         page, _ = self._evictable.popitem(last=False)
         h = self._page_hash.pop(page)
         del self._hash_to_page[h]
+        if self.on_reclaim is not None:
+            # offload hook BEFORE the page is handed out: the caller is
+            # about to overwrite it, and the hook's device-side gather
+            # must be dispatched first (program order on the stream)
+            self.on_reclaim(page, h)
         return page
 
     # -- prefix matching -----------------------------------------------------
@@ -106,6 +106,12 @@ class PrefixCachingAllocator(PageAllocator):
             page = self._hash_to_page.get(h)
             if page is None:
                 break
+            # recency bump (dict insertion order = the residency
+            # digest's MRU order): a hot chain that keeps HITTING must
+            # not age out of the top-K digest just because newer blocks
+            # keep REGISTERING — the scorer would read the true holder
+            # as empty and route repeat-prefix traffic away from it
+            self._hash_to_page[h] = self._hash_to_page.pop(h)
             shared.append(page)
         for page in shared:
             self._refs[page] = self._refs.get(page, 0) + 1
@@ -190,6 +196,71 @@ class PrefixCachingAllocator(PageAllocator):
             self._page_hash[page] = h
             self._hash_to_page[h] = page
             self._refs[page] = self._refs.get(page, 0) + 1
+
+    # -- hierarchical KV (host tier) -----------------------------------------
+
+    def has_block(self, h: bytes) -> bool:
+        """Is this content hash addressable in HBM right now?"""
+        return h in self._hash_to_page
+
+    def adopt_block(self, h: bytes) -> int:
+        """Claim a page for RESTORED content (host tier → HBM): takes a
+        free page (reclaiming LRU evictable content if needed — which
+        may itself cascade an offload via ``on_reclaim``), registers the
+        hash, and parks the page **evictable** so it counts as free for
+        admission until a ``match_prefix`` actually pins it.  The caller
+        uploads the page's KV immediately after; both run on the engine
+        thread, so no consumer can observe the registered-but-unwritten
+        gap.  Raises ``MemoryError`` when the pool is exhausted."""
+        if h in self._hash_to_page:
+            return self._hash_to_page[h]
+        if not self._free and not self._evictable:
+            raise MemoryError("KV cache exhausted: no page for restore")
+        page = self._take_free_page()
+        self._page_hash[page] = h
+        self._hash_to_page[h] = page
+        self._evictable[page] = None
+        self._evictable.move_to_end(page)
+        return page
+
+    def touch_block(self, h: bytes) -> bool:
+        """MRU-bump a resident hashed block — registration order (the
+        residency digest) AND, when parked evictable, reclaim order —
+        without acquiring it.  Returns whether the block was evictable:
+        the restore planner uses touch + that count to keep its own
+        adoptions from reclaiming the very chain it is restoring."""
+        page = self._hash_to_page.get(h)
+        if page is None:
+            return False
+        self._hash_to_page[h] = self._hash_to_page.pop(h)
+        if page in self._evictable:
+            self._evictable.move_to_end(page)
+            return True
+        return False
+
+    def resident_block_hashes(self, limit: int = 0) -> list[bytes]:
+        """Hashes addressable in HBM, most-recently-registered first
+        (the residency digest the engine exports to the router);
+        ``limit`` > 0 caps the list.
+
+        Called from HTTP handler threads (``/v1/prefix_residency``)
+        while the engine thread mutates the dict — the allocator is
+        engine-thread-owned and deliberately lock-free, so the snapshot
+        retries around a concurrent resize and degrades to an empty
+        digest (the router's scorer then falls back to its history
+        heuristic) rather than 500ing the scrape."""
+        hashes: list[bytes] = []
+        for _ in range(5):
+            try:
+                hashes = list(self._hash_to_page)
+                break
+            except RuntimeError:  # resized mid-iteration by the engine
+                continue
+        hashes.reverse()
+        return hashes[:limit] if limit else hashes
+
+    def resident_blocks(self) -> int:
+        return len(self._hash_to_page)
 
     # -- release -------------------------------------------------------------
 

@@ -75,6 +75,16 @@ class TokenBudget:
     dispatch_ahead_total: int = 0
     # adaptive-burst histogram: dispatched span -> dispatch count
     burst_span_steps: dict = field(default_factory=dict)
+    # hierarchical-KV restore ledger (engine/kv_host_tier.py): pages
+    # re-injected from the host tier into HBM, the tokens they covered
+    # (charged against the step's prefill remainder — a restore is
+    # prefill work the engine did NOT have to recompute, but its H2D
+    # upload still spends step bandwidth), and restore plans truncated
+    # because the step budget was already spent (the backpressure that
+    # keeps restores from starving decode)
+    kv_restores_total: int = 0
+    kv_restore_tokens_total: int = 0
+    kv_restore_deferred_total: int = 0
     # fused mixed-batch steps: decode rows + budgeted prefill chunks in
     # ONE forward (one weight pass instead of one per row-kind)
     fused_steps_total: int = 0
@@ -152,6 +162,9 @@ class TokenBudget:
             "dispatch_ahead": self.dispatch_ahead_total,
             "burst_span_steps": {str(k): v for k, v in
                                  sorted(self.burst_span_steps.items())},
+            "kv_restores": self.kv_restores_total,
+            "kv_restore_tokens": self.kv_restore_tokens_total,
+            "kv_restore_deferred": self.kv_restore_deferred_total,
             "budget_utilization": round(self.utilization(), 4),
             "fused_steps": self.fused_steps_total,
             "weight_passes": self.weight_passes_total,

@@ -1533,6 +1533,20 @@ class EngineServer:
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+                elif self.path == "/v1/prefix_residency":
+                    # residency digest for the EPP's residency-aware
+                    # prefix scorer: per-tier block counts + top-K
+                    # most-recent block hashes (hex), so the router can
+                    # score a prompt against ACTUAL cache contents
+                    # instead of request-history heuristics
+                    residency = getattr(server.engine,
+                                        "prefix_residency", None)
+                    if residency is None:
+                        self._send_json(
+                            {"error": {"message": "engine exports no "
+                                                  "residency"}}, 404)
+                    else:
+                        self._send_json(residency())
                 elif self.path == "/v1/models":
                     models = [server.model_name]
                     lora_set = getattr(server.engine, "lora_set", None)
@@ -1854,6 +1868,22 @@ def serve_from_args(args) -> int:
     logger.info("cache: %d pages of %d tokens", cache_cfg.n_pages, cache_cfg.page_size)
     no_budget = getattr(args, "no_token_budget", False)
     tokens_per_step = _nonneg_flag(args, "tokens_per_step")
+    host_tier = None
+    host_tier_mb = getattr(args, "kv_host_tier_mb", 0) or 0
+    if host_tier_mb > 0:
+        if getattr(args, "no_prefix_caching", False):
+            raise SystemExit(
+                "--kv-host-tier-mb requires prefix caching "
+                "(drop --no-prefix-caching)")
+        if jax.process_count() > 1:
+            raise SystemExit(
+                "--kv-host-tier-mb is single-process only: offload/"
+                "restore timing is process-local and would diverge the "
+                "multi-host SPMD lockstep")
+        from fusioninfer_tpu.engine.kv_host_tier import HostKVTier
+
+        host_tier = HostKVTier(capacity_bytes=host_tier_mb << 20)
+        logger.info("host KV tier: %d MiB slab pool", host_tier_mb)
     engine = NativeEngine(
         cfg, cache_cfg=cache_cfg, max_batch_size=args.max_batch_size, seed=args.seed,
         mesh=mesh, params=params,
@@ -1865,6 +1895,7 @@ def serve_from_args(args) -> int:
         decode_burst_steps=max(1, getattr(args, "decode_burst", 8) or 1),
         pipeline_bursts=not getattr(args, "no_decode_pipeline", False),
         fused_step=getattr(args, "fused_step", True),
+        host_kv_tier=host_tier,
     )
     if not no_budget and engine.token_budget is None:
         # --tokens-per-step 0 (the default): derive the budget from a

@@ -13,6 +13,12 @@ Sites in the tree today:
 ``kv.pull``                  before the decoder's prefill pull RPC
                              (:mod:`fusioninfer_tpu.engine.kv_transfer`)
 ``kv.pull.response``         corrupts the pulled slab frame (CRC32 catches)
+``kv.host.offload``          before a page frame commits to the host KV
+                             tier (:mod:`fusioninfer_tpu.engine.kv_host_tier`)
+``kv.host.offload.data``     corrupts the STORED host-tier frame
+``kv.host.restore``          before a host-tier frame is parsed for restore
+``kv.host.restore.data``     corrupts the frame on the restore path
+                             (CRC32 catches; entry dropped, prefix recomputes)
 ``router.metrics.<ep>``      a picker endpoint's metrics scrape
                              (:mod:`fusioninfer_tpu.router.picker`)
 ``operator.reconcile.<Kind>``  one reconcile invocation

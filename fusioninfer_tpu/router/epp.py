@@ -6,6 +6,12 @@ capability parity with ``pkg/router/epp.go:34-361``.  The EPP is the
 ext-proc gRPC server Envoy consults per request; it scrapes the model
 servers' metrics endpoints (vLLM-TPU / native engine / JetStream) and
 scores candidate slice leaders.
+
+Render-time metric-surface guard (VERDICT #3): the ConfigMap render
+(via ``strategy.generate_epp_config``) rejects a metric-scraping scorer
+against an engine flavor with no known metric mapping — JetStream's
+names are mapped (``router/metric_names.py``), ``custom`` fails loudly
+instead of silently scoring zero in production.
 """
 
 from __future__ import annotations

@@ -16,9 +16,18 @@ Implemented plugins (the set our strategy generator emits, validated by
   up in a bounded per-picker LRU of block→endpoint; score = fraction of
   leading blocks last served by that endpoint.  Picks record their
   blocks, so repeat prefixes stick to the engine whose KV cache holds
-  them.
-* ``kv-cache-utilization-scorer`` — 1 − ``vllm:gpu_cache_usage_perc``.
-* ``queue-scorer`` — 1 / (1 + ``vllm:num_requests_waiting``).
+  them.  **Residency mode** (pass ``residency=ResidencyProvider()``):
+  the scorer instead scores against each engine's ACTUAL reported cache
+  contents — the ``/v1/prefix_residency`` digest of content-addressed
+  block hashes per tier (HBM / host-DRAM, docs/design/kv-hierarchy.md)
+  — with the history heuristic as the fallback whenever a digest is
+  stale or absent.  The history LRU keeps recording either way, so the
+  fallback is always warm.
+* ``kv-cache-utilization-scorer`` — 1 − ``vllm:gpu_cache_usage_perc``
+  (JetStream backends score via the mapped
+  ``jetstream_slots_used_percentage``; ``router/metric_names.py``).
+* ``queue-scorer`` — 1 / (1 + ``vllm:num_requests_waiting``)
+  (JetStream: ``jetstream_prefill_backlog_size``).
 * ``lora-affinity-scorer`` — prefix-affinity over the adapter name.
 * ``by-label`` filters and scheduling profiles (the PD ``prefill`` /
   ``decode`` split on ``fusioninfer.io/component-type``).
@@ -39,6 +48,8 @@ from typing import Callable, Optional
 from fusioninfer_tpu.resilience import CircuitBreaker
 from fusioninfer_tpu.resilience.breaker import CLOSED, OPEN
 from fusioninfer_tpu.router.epp_schema import validate_epp_config
+from fusioninfer_tpu.router.metric_names import SCRAPING_SCORERS, lookup_signal
+from fusioninfer_tpu.utils.blockhash import block_hashes
 from fusioninfer_tpu.workload.labels import LABEL_DRAINING
 
 logger = logging.getLogger("fusioninfer.picker")
@@ -179,6 +190,189 @@ class _PrefixAffinity:
                 self._lru.popitem(last=False)
 
 
+def byte_tokenize(prompt: str) -> list[int]:
+    """The serving default's token stream for a prompt (ByteTokenizer:
+    BOS then bytes+3, ``engine/tokenizer.py``) — the engine hashes KV
+    blocks over TOKEN IDS, so residency scoring must tokenize the way
+    the engines it scores do.  Deployments serving a different
+    tokenizer pass their own ``tokenize`` to
+    :class:`ResidencyProvider`; when the streams diverge the residency
+    score simply never matches and the picker falls back to the history
+    heuristic — wrong-tokenizer configs degrade, never misroute."""
+    from fusioninfer_tpu.engine.tokenizer import ByteTokenizer
+
+    return ([ByteTokenizer.BOS_ID]
+            + [b + ByteTokenizer.OFFSET for b in prompt.encode("utf-8")])
+
+
+class ResidencyProvider:
+    """Fetches and caches per-engine prefix-residency digests
+    (``GET /v1/prefix_residency``) and scores prompts against them.
+
+    A digest is served from cache for ``ttl_s`` (scoring N candidates
+    for one request costs at most one fetch per endpoint); on fetch
+    failure the last-known-good digest is used up to ``max_age_s``,
+    after which :meth:`score` returns ``None`` and the caller falls
+    back to the history heuristic — stale residency must degrade to the
+    heuristic, not masquerade as fresh truth.
+
+    ``host_tier_weight`` scores a block resident in host DRAM below an
+    HBM-resident one (a restore is far cheaper than recompute but not
+    free), so of two engines holding the same chain the one holding it
+    hot wins.
+
+    Digest fetches run ON the pick path (handler thread), so
+    ``timeout_s`` bounds how long an unresponsive engine can stall
+    routing: worst case one ``timeout_s`` stall per blackholed endpoint
+    per ``ttl_s`` window (the negative cache throttles re-attempts).
+    The default is sized for an intra-cluster metrics hop; raise it
+    only with slow links, and together with ``ttl_s``.
+    """
+
+    def __init__(self, fetch: Optional[Callable[[Endpoint], Optional[dict]]] = None,
+                 ttl_s: float = 1.0, max_age_s: float = 10.0,
+                 tokenize: Callable[[str], list[int]] = byte_tokenize,
+                 host_tier_weight: float = 0.75,
+                 timeout_s: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic):
+        self._fetch = fetch or self._http_fetch
+        self.ttl_s = ttl_s
+        self.max_age_s = max_age_s
+        self.tokenize = tokenize
+        self.host_tier_weight = host_tier_weight
+        self.timeout_s = timeout_s
+        self._clock = clock
+        # name -> (checked_at, fetched_at, parsed digest | None):
+        # ``checked_at`` throttles fetch ATTEMPTS (one per ttl window,
+        # success or failure), ``fetched_at`` bounds how long a
+        # last-known-good digest may keep serving (max_age_s).  Fetch +
+        # parse run outside the lock (concurrent pick()s on handler
+        # threads), the dict mutation inside it.
+        self._lock = threading.Lock()
+        self._cache: dict[str, tuple[float, float, Optional[dict]]] = {}
+        # single-entry (prompt, page_size) -> usable hash chain: pick()
+        # scores every candidate endpoint with the SAME prompt back to
+        # back, and tokenize+blake2b over a long prompt is the scorer's
+        # dominant cost — N endpoints must not mean N chain builds.
+        # Benign race: a concurrent pick() merely recomputes.
+        self._chain_memo: Optional[tuple] = None
+
+    def _http_fetch(self, ep: Endpoint) -> Optional[dict]:
+        import json
+
+        with urllib.request.urlopen(
+                f"{ep.url}/v1/prefix_residency",
+                timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    @staticmethod
+    def _parse(raw: dict) -> Optional[dict]:
+        try:
+            page_size = int(raw["page_size"])
+            if page_size <= 0:
+                # a nonsense page size would ZeroDivisionError every
+                # score() for ttl_s — treat as no digest (heuristic
+                # fallback), per "degrade, never misroute"
+                return None
+            blocks = raw.get("blocks") or {}
+            tiers = raw.get("tiers") or {}
+            hbm = frozenset(blocks.get("hbm") or ())
+            host = frozenset(blocks.get("host") or ())
+            return {
+                "page_size": page_size,
+                "hbm": hbm,
+                "host": host,
+                # the tier counts are FULL resident counts while the
+                # block lists cap at the engine's top-K limit: when they
+                # disagree the digest is truncated, and a missing hash
+                # no longer proves non-residency
+                "truncated": (len(hbm) < int(tiers.get("hbm", 0))
+                              or len(host) < int(tiers.get("host", 0))),
+            }
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def digest(self, ep: Endpoint) -> Optional[dict]:
+        now = self._clock()
+        with self._lock:
+            cached = self._cache.get(ep.name)
+        if cached is not None and now - cached[0] <= self.ttl_s:
+            # checked recently — serve the cached verdict, which may be
+            # a last-known-good digest OR a cached failure (None): at
+            # most one fetch attempt per ttl window either way, so a
+            # dead endpoint never adds a per-pick blocking timeout to
+            # the scoring loop
+            return cached[2]
+        try:
+            raw = self._fetch(ep)
+        except Exception as e:
+            logger.debug("residency fetch for %s failed: %s", ep.name, e)
+            raw = None
+        parsed = self._parse(raw) if isinstance(raw, dict) else None
+        if parsed is not None:
+            with self._lock:
+                self._cache[ep.name] = (now, now, parsed)
+            return parsed
+        with self._lock:
+            cur = self._cache.get(ep.name)
+            if cur is not None and cur is not cached and cur[2] is not None:
+                # a concurrent pick()'s fetch landed a digest while ours
+                # failed — a failure verdict must never clobber it
+                return cur[2]
+            if (cached is not None and cached[2] is not None
+                    and now - cached[1] <= self.max_age_s):
+                # failure with a not-too-old digest on hand: keep
+                # serving it (bounded by fetched_at), but RE-STAMP
+                # checked_at so the ttl throttle covers the
+                # last-known-good window too
+                self._cache[ep.name] = (now, cached[1], cached[2])
+                return cached[2]
+            # negative cache: no digest and nothing recent enough to
+            # reuse (older build, 404, blackhole, or LKG expired)
+            self._cache[ep.name] = (now, now, None)
+            return None
+
+    def _usable_chain(self, prompt: str, page_size: int) -> list:
+        memo = self._chain_memo
+        if memo is not None and memo[0] == prompt and memo[1] == page_size:
+            return memo[2]
+        tokens = self.tokenize(prompt)
+        # mirror the engine's match cap: the last prompt token is always
+        # recomputed for its logits, so its block can never be reused
+        usable = max(0, (len(tokens) - 1) // page_size)
+        hashes = block_hashes(tokens, page_size)[:usable]
+        self._chain_memo = (prompt, page_size, hashes)
+        return hashes
+
+    def score(self, prompt: str, ep: Endpoint) -> Optional[float]:
+        """Fraction of the prompt's leading KV blocks this endpoint
+        actually holds (host-tier blocks discounted), or ``None`` when
+        residency has no information (→ heuristic fallback): no
+        fresh-enough digest, a sub-page prompt (no full block can
+        exist), or a zero match against a TRUNCATED digest (the chain
+        may have aged out of the top-K while still resident).  An empty
+        or zero-matching COMPLETE digest is REAL information — a cold
+        engine scores 0.0, it does not fall back."""
+        d = self.digest(ep)
+        if d is None:
+            return None
+        hashes = self._usable_chain(prompt, d["page_size"])
+        if not hashes:
+            return None
+        total = 0.0
+        for h in hashes:
+            hx = h.hex()
+            if hx in d["hbm"]:
+                total += 1.0
+            elif hx in d["host"]:
+                total += self.host_tier_weight
+            else:
+                break
+        if total == 0.0 and d["truncated"]:
+            return None
+        return total / len(hashes)
+
+
 class EndpointPicker:
     """Score-and-pick over live endpoints, per scheduling profile."""
 
@@ -186,9 +380,14 @@ class EndpointPicker:
                  endpoints: Callable[[], list[Endpoint]],
                  metrics: Callable[[Endpoint], dict] = None,
                  health: Optional[EndpointHealth] = None,
-                 fault_injector=None):
+                 fault_injector=None,
+                 residency: Optional[ResidencyProvider] = None):
         self.config = validate_epp_config(config_yaml)
         self._endpoints = endpoints
+        # residency mode for the prefix scorer: score against reported
+        # cache contents, history heuristic as fallback (None = pure
+        # heuristic, the pre-hierarchy behavior)
+        self._residency = residency
         self._metrics = metrics or (lambda ep: scrape_metrics(ep.url))
         # health-aware selection: callers report request outcomes via
         # report_result(); open breakers eject endpoints from pick()
@@ -239,16 +438,25 @@ class EndpointPicker:
         healthy loaded one — defaulting utilization/queue to zero would
         hand a dead endpoint the maximum score."""
         ptype = plugin["type"]
+        if ptype == "prefix-cache-scorer" and self._residency is not None:
+            s = self._residency.score(prompt, ep)
+            if s is not None:
+                return s  # actual reported cache contents
+            # digest stale/absent: history heuristic (below)
         if ptype in ("prefix-cache-scorer", "lora-affinity-scorer"):
             return self._affinity[key].score(prompt, ep)
+        # scraping scorers resolve metric names per engine flavor
+        # (vLLM-name first, JetStream alternates — metric_names.py)
         if ptype == "kv-cache-utilization-scorer":
-            if "vllm:gpu_cache_usage_perc" not in metrics:
+            usage = lookup_signal(metrics, "kv_usage")
+            if usage is None:
                 return 0.0  # unknown → assume full
-            return 1.0 - metrics["vllm:gpu_cache_usage_perc"]
+            return 1.0 - usage
         if ptype == "queue-scorer":
-            if "vllm:num_requests_waiting" not in metrics:
+            waiting = lookup_signal(metrics, "queue_len")
+            if waiting is None:
                 return 0.0  # unknown → assume unbounded queue
-            return 1.0 / (1.0 + metrics["vllm:num_requests_waiting"])
+            return 1.0 / (1.0 + waiting)
         return 0.0
 
     def pick(self, prompt: str, profile: str = "default") -> Optional[Endpoint]:
@@ -313,8 +521,7 @@ class EndpointPicker:
             selectable = candidates
             last_resort = True
         want_metrics = any(
-            p["type"] in ("kv-cache-utilization-scorer", "queue-scorer")
-            for _, p, _ in scorers
+            p["type"] in SCRAPING_SCORERS for _, p, _ in scorers
         )
         ranked: list[tuple[float, int, Endpoint]] = []
         for i, ep in enumerate(selectable):

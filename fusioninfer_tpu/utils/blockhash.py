@@ -1,0 +1,57 @@
+"""Content-addressed KV block hashing, shared across engine and router.
+
+One hash function addresses a KV page's content everywhere it matters:
+the engine's prefix cache (``engine/prefix_cache.py``), the host-DRAM
+offload tier (``engine/kv_host_tier.py``), and the EPP's residency-aware
+prefix scorer (``router/picker.py``) — which is the whole point: a block
+hash the engine reports on ``/v1/prefix_residency`` must be the hash the
+router computes for an incoming prompt, or residency routing degenerates
+back to the request-history heuristic.
+
+The chain is ``H(parent, block_tokens)`` (blake2b-128) so a block's
+identity includes its whole prefix; ``namespace`` partitions the content
+address space (per LoRA adapter — KV computed under different adapters
+is different content for the same tokens).
+
+This module imports without the accelerator stack (no jax; numpy is an
+optional fast path) so the router side can use it standalone.  Token ids
+serialize as little-endian signed 8-byte integers — byte-identical
+between the numpy and pure-Python encoders on every platform this repo
+targets, pinned by a test.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Sequence
+
+try:
+    import numpy as _np
+except ImportError:  # router-side install without the accelerator stack
+    _np = None
+
+
+def token_block_bytes(block: Iterable[int]) -> bytes:
+    """Serialize one block of token ids (int64-LE, numpy-compatible)."""
+    if _np is not None:
+        # the engine hashes every full page of every prompt on its
+        # single admission thread — vectorized encoding matters there
+        if not hasattr(block, "__len__"):
+            block = list(block)
+        return _np.asarray(block, _np.int64).tobytes()
+    return b"".join(int(t).to_bytes(8, "little", signed=True) for t in block)
+
+
+def block_hashes(tokens: Sequence[int], page_size: int,
+                 namespace: bytes = b"") -> list[bytes]:
+    """Hash chain over the FULL pages of ``tokens``."""
+    out: list[bytes] = []
+    parent = b"root" + namespace
+    for i in range(len(tokens) // page_size):
+        block = tokens[i * page_size : (i + 1) * page_size]
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent)
+        h.update(token_block_bytes(block))
+        parent = h.digest()
+        out.append(parent)
+    return out

@@ -62,7 +62,8 @@ import pytest  # noqa: E402 — after the backend bootstrap above
 # what the full tier is for.
 FAST_MODULES = {
     "test_api_types.py", "test_applyconfig.py", "test_fusionlint.py",
-    "test_hash.py", "test_informers.py", "test_leader_election.py",
+    "test_hash.py", "test_informers.py", "test_kv_host_tier.py",
+    "test_leader_election.py",
     "test_manifests.py", "test_metrics.py", "test_names.py",
     "test_paged_attention.py", "test_priority.py", "test_reconciler.py",
     "test_render_cli.py", "test_router.py", "test_schema.py",

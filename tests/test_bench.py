@@ -331,6 +331,14 @@ class TestBenchRecordChecker:
                           "burst_span_steps": {"1": 3},
                           "burst_clamped": 1,
                           "fused_steps": 7, "weight_passes": 21},
+        }, "workload_sharedprefix": {
+            "prefix_cache_hit_rate": 0.5,
+            "cold_ttft_ms": {"p50": 500.0, "p90": 520.0},
+            "warm_ttft_ms": {"p50": 120.0, "p90": 300.0},
+            "warm_faster": True,
+            "host_tier": {"offloads": 250, "restores": 90,
+                          "host_hits": 90, "corrupt_dropped": 0,
+                          "evictions": 0},
         }}
 
     def test_complete_record_passes(self):
@@ -379,6 +387,48 @@ class TestBenchRecordChecker:
         assert any("ragged_vs_padded" in p for p in problems)
         assert any("mfu_box" in p for p in problems)
         assert any("rel_iqr" in p for p in problems)
+
+    def test_sharedprefix_leg_required_with_http(self):
+        """The hierarchical-KV leg (r08): hit rate must be OFF 0.0,
+        warm turns must beat cold turns, and the host tier's
+        offload/restore/hit counters must be nonzero."""
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        del rec["workload_sharedprefix"]
+        assert any("workload_sharedprefix leg missing" in p
+                   for p in check_record(rec))
+        rec = self._good()
+        rec["workload_sharedprefix"]["error"] = "boom"
+        assert any("errored" in p for p in check_record(rec))
+
+    def test_sharedprefix_zero_hit_rate_flagged(self):
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        rec["workload_sharedprefix"]["prefix_cache_hit_rate"] = 0.0
+        assert any("prefix_cache_hit_rate" in p for p in check_record(rec))
+
+    def test_sharedprefix_warm_must_beat_cold(self):
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        rec["workload_sharedprefix"]["warm_faster"] = False
+        assert any("warm-turn" in p for p in check_record(rec))
+        rec = self._good()
+        del rec["workload_sharedprefix"]["warm_ttft_ms"]
+        assert any("warm_ttft_ms" in p for p in check_record(rec))
+
+    def test_sharedprefix_tier_counters_gated(self):
+        from tools.check_bench_record import check_record
+
+        for counter in ("offloads", "restores", "host_hits"):
+            rec = self._good()
+            rec["workload_sharedprefix"]["host_tier"][counter] = 0
+            assert any(counter in p for p in check_record(rec)), counter
+        rec = self._good()
+        del rec["workload_sharedprefix"]["host_tier"]
+        assert any("host_tier" in p for p in check_record(rec))
 
     def test_decode_only_run_is_exempt(self):
         """BENCH_SKIP_HTTP=1 records have no http leg by design — the

@@ -1,0 +1,453 @@
+"""Hierarchical KV: host-DRAM offload tier + residency-aware routing.
+
+Covers the host tier's unit semantics (LRU capacity, CRC rejection,
+fault-injection sites), the engine integration (offload on reclaim,
+restore on re-request, token-budget backpressure), the acceptance-
+critical bit-identity guarantee (hit-via-host-restore streams ==
+cold-prefill streams, greedy + seeded + int8 KV), and the residency
+export the EPP's prefix scorer consumes (docs/design/kv-hierarchy.md).
+"""
+
+import dataclasses
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.kv_host_tier import (
+    SITE_OFFLOAD,
+    SITE_OFFLOAD_DATA,
+    SITE_RESTORE,
+    SITE_RESTORE_DATA,
+    HostKVTier,
+)
+from fusioninfer_tpu.engine.kv_transfer import KVSlab
+from fusioninfer_tpu.engine.prefix_cache import block_hashes
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.models.config import get_preset
+from fusioninfer_tpu.resilience import FaultInjector
+from fusioninfer_tpu.utils import blockhash
+
+CFG = dataclasses.replace(get_preset("qwen3-tiny"), dtype="float32")
+CACHE = CacheConfig(n_pages=9, page_size=16, max_pages_per_seq=6)
+
+
+def _page_slab(fill: float, page_size: int = 16, quantized: bool = False) -> KVSlab:
+    shape = (2, 2, 1, page_size, 8)  # [L, KV, 1, ps, Hd]
+    if quantized:
+        return KVSlab(
+            k=jnp.full(shape, int(fill), jnp.int8),
+            v=jnp.full(shape, int(fill) + 1, jnp.int8),
+            prompt_tokens=[], first_token=0, page_size=page_size,
+            k_scale=jnp.full((2, 2, 1, 1, page_size), 0.5, jnp.float32),
+            v_scale=jnp.full((2, 2, 1, 1, page_size), 0.25, jnp.float32),
+        )
+    return KVSlab(
+        k=jnp.full(shape, fill, jnp.float32),
+        v=jnp.full(shape, fill + 1.0, jnp.float32),
+        prompt_tokens=[], first_token=0, page_size=page_size,
+    )
+
+
+class TestBlockHashCompat:
+    def test_matches_numpy_int64_encoding(self):
+        # the shared module's int.to_bytes encoding must stay byte-
+        # identical to the historical np.int64 tobytes form: every
+        # pre-hierarchy content address must keep resolving
+        block = [0, 1, 258, 2**31 - 1]
+        assert (blockhash.token_block_bytes(block)
+                == np.asarray(block, np.int64).tobytes())
+
+    def test_prefix_cache_reexports_shared_chain(self):
+        toks = list(range(32))
+        assert block_hashes(toks, 8) == blockhash.block_hashes(toks, 8)
+        assert (block_hashes(toks, 8, b"ns")
+                != blockhash.block_hashes(toks, 8))
+
+
+class TestHostTierUnit:
+    def test_offload_take_round_trip_sync(self):
+        tier = HostKVTier(async_offload=False)
+        slab = _page_slab(3.0)
+        tier.offload(b"h1", slab)
+        assert tier.contains(b"h1")
+        got = tier.take(b"h1")
+        assert got is not None
+        assert np.array_equal(np.asarray(got.k), np.asarray(slab.k))
+        assert np.array_equal(np.asarray(got.v), np.asarray(slab.v))
+        # entry stays resident (several sequences may hit one chain)
+        assert tier.contains(b"h1")
+        assert tier.counters()["host_hits"] == 1
+
+    def test_int8_scales_round_trip(self):
+        tier = HostKVTier(async_offload=False)
+        slab = _page_slab(7, quantized=True)
+        tier.offload(b"q", slab)
+        got = tier.take(b"q")
+        assert got.quantized
+        assert np.array_equal(np.asarray(got.k), np.asarray(slab.k))
+        assert np.array_equal(np.asarray(got.k_scale),
+                              np.asarray(slab.k_scale))
+
+    def test_async_offload_visible_after_flush(self):
+        tier = HostKVTier(async_offload=True)
+        tier.offload(b"a", _page_slab(1.0))
+        tier.flush()
+        assert tier.contains(b"a")
+        tier.close()
+
+    def test_lru_capacity_watermark_evicts(self):
+        one = len(
+            __import__("fusioninfer_tpu.engine.kv_transfer",
+                       fromlist=["slab_to_bytes"]).slab_to_bytes(
+                _page_slab(0.0)))
+        tier = HostKVTier(capacity_bytes=2 * one + one // 2,
+                          async_offload=False)
+        tier.offload(b"a", _page_slab(1.0))
+        tier.offload(b"b", _page_slab(2.0))
+        assert tier.take(b"a") is not None  # MRU-bump a
+        tier.offload(b"c", _page_slab(3.0))  # evicts LRU = b
+        assert tier.contains(b"a") and tier.contains(b"c")
+        assert not tier.contains(b"b")
+        assert tier.counters()["evictions"] == 1
+
+    def test_miss_returns_none(self):
+        tier = HostKVTier(async_offload=False)
+        assert tier.take(b"nope") is None
+        assert tier.counters()["host_hits"] == 0
+
+    @pytest.mark.chaos
+    def test_corrupt_stored_frame_rejected_and_dropped(self):
+        fi = FaultInjector(seed=3).arm(SITE_OFFLOAD_DATA, "corrupt")
+        tier = HostKVTier(fault_injector=fi, async_offload=False)
+        tier.offload(b"x", _page_slab(5.0))
+        assert tier.contains(b"x")
+        assert tier.take(b"x") is None  # CRC32 catches the flipped byte
+        assert not tier.contains(b"x")  # poisoned entry dropped
+        assert tier.counters()["corrupt_dropped"] == 1
+        assert tier.counters()["host_hits"] == 0
+
+    @pytest.mark.chaos
+    def test_corrupt_on_restore_wire(self):
+        fi = FaultInjector(seed=3).arm(SITE_RESTORE_DATA, "corrupt",
+                                       times=1)
+        tier = HostKVTier(fault_injector=fi, async_offload=False)
+        tier.offload(b"x", _page_slab(5.0))
+        assert tier.take(b"x") is None
+        assert tier.counters()["corrupt_dropped"] == 1
+
+    @pytest.mark.chaos
+    def test_restore_drop_is_a_miss_entry_kept(self):
+        fi = FaultInjector(seed=0).arm(SITE_RESTORE, "drop", times=1)
+        tier = HostKVTier(fault_injector=fi, async_offload=False)
+        tier.offload(b"x", _page_slab(5.0))
+        assert tier.take(b"x") is None  # dropped once
+        assert tier.contains(b"x")      # but the entry is intact
+        assert tier.take(b"x") is not None  # heals
+
+    @pytest.mark.chaos
+    def test_offload_drop_counts_failed(self):
+        fi = FaultInjector(seed=0).arm(SITE_OFFLOAD, "drop")
+        tier = HostKVTier(fault_injector=fi, async_offload=False)
+        tier.offload(b"x", _page_slab(5.0))
+        assert not tier.contains(b"x")
+        assert tier.counters()["offload_failed"] == 1
+
+    @pytest.mark.chaos
+    def test_offload_delay_still_commits(self):
+        fi = FaultInjector(seed=0).arm(SITE_OFFLOAD, "delay",
+                                       delay_s=0.01)
+        tier = HostKVTier(fault_injector=fi, async_offload=True)
+        tier.offload(b"x", _page_slab(5.0))
+        tier.flush()
+        assert tier.contains(b"x")
+        tier.close()
+
+
+def _drain(engine: NativeEngine, request: Request) -> list[int]:
+    engine.add_request(request)
+    toks: list[int] = []
+    while engine.has_work():
+        for out in engine.step():
+            if out.request_id == request.request_id:
+                toks.append(out.token)
+    return toks
+
+
+def _churn(engine: NativeEngine, n: int = 3, length: int = 40) -> None:
+    """Filler traffic that exhausts the free pool so evictable chains
+    get reclaimed (and, with a host tier wired, offloaded)."""
+    for j in range(n):
+        _drain(engine, Request(
+            f"churn-{j}-{np.random.default_rng(j).integers(1 << 30)}",
+            [500 + j * 41 + k for k in range(length)],
+            SamplingParams(max_tokens=2, temperature=0.0)))
+
+
+def _tier_engine(fi=None, kv_dtype="model", token_budget=None,
+                 cache_cfg=CACHE):
+    cache_cfg = dataclasses.replace(cache_cfg, kv_dtype=kv_dtype)
+    tier = HostKVTier(fault_injector=fi, async_offload=False)
+    engine = NativeEngine(CFG, cache_cfg=cache_cfg, max_batch_size=2,
+                          token_budget=token_budget, host_kv_tier=tier)
+    return engine, tier
+
+
+WARM_PROMPT = list(range(1, 40))  # 39 tokens -> 2 full 16-token pages
+
+
+class TestEngineHostTier:
+    def test_reclaim_offloads_then_restores_bit_identical_greedy(self):
+        engine, tier = _tier_engine()
+        params = SamplingParams(max_tokens=8, temperature=0.0)
+        cold = _drain(engine, Request("cold", WARM_PROMPT, params))
+        _churn(engine)
+        assert tier.counters()["offloads"] > 0
+        # the warm chain must now be host-resident, not HBM-resident
+        chain = block_hashes(WARM_PROMPT, CACHE.page_size)
+        assert any(tier.contains(h) for h in chain)
+        warm = _drain(engine, Request("warm", WARM_PROMPT, params))
+        assert tier.counters()["restores"] > 0
+        assert engine.sched.kv_restores_total > 0
+        assert warm == cold  # the acceptance bar: bit-identical streams
+
+    def test_restore_bit_identical_seeded_sampled(self):
+        params = SamplingParams(max_tokens=8, temperature=0.9, top_p=0.9,
+                                seed=1234)
+        engine, tier = _tier_engine()
+        cold = _drain(engine, Request("cold", WARM_PROMPT, params))
+        _churn(engine)
+        warm = _drain(engine, Request("warm", WARM_PROMPT, params))
+        assert tier.counters()["restores"] > 0
+        assert warm == cold
+
+    def test_restore_bit_identical_int8_kv(self):
+        for temp, seed in ((0.0, None), (0.8, 42)):
+            params = SamplingParams(max_tokens=6, temperature=temp,
+                                    seed=seed)
+            engine, tier = _tier_engine(kv_dtype="int8")
+            cold = _drain(engine, Request("cold", WARM_PROMPT, params))
+            _churn(engine)
+            warm = _drain(engine, Request("warm", WARM_PROMPT, params))
+            assert tier.counters()["restores"] > 0, f"temp={temp}"
+            assert warm == cold, f"temp={temp}"
+
+    @pytest.mark.chaos
+    def test_corrupt_host_slab_falls_back_to_recompute(self):
+        # corrupt the stored frame: the restore path must CRC-reject it,
+        # drop the entry, and recompute from the prompt — the stream is
+        # still bit-identical to the cold one (no corruption can leak)
+        fi = FaultInjector(seed=7).arm(SITE_OFFLOAD_DATA, "corrupt")
+        engine, tier = _tier_engine(fi=fi)
+        params = SamplingParams(max_tokens=8, temperature=0.0)
+        cold = _drain(engine, Request("cold", WARM_PROMPT, params))
+        _churn(engine)
+        warm = _drain(engine, Request("warm", WARM_PROMPT, params))
+        assert tier.counters()["corrupt_dropped"] > 0
+        assert tier.counters()["restores"] == 0  # nothing restorable
+        assert warm == cold
+
+    @pytest.mark.chaos
+    def test_lost_host_slab_falls_back_to_recompute(self):
+        fi = FaultInjector(seed=7).arm(SITE_RESTORE, "drop")
+        engine, tier = _tier_engine(fi=fi)
+        params = SamplingParams(max_tokens=8, temperature=0.7, seed=9)
+        cold = _drain(engine, Request("cold", WARM_PROMPT, params))
+        _churn(engine)
+        warm = _drain(engine, Request("warm", WARM_PROMPT, params))
+        assert tier.counters()["restores"] == 0
+        assert warm == cold
+
+    def test_budget_backpressure_defers_restore_tail(self):
+        # budget 16 = one page: after the multi-block chain offloads,
+        # a re-request may restore at most ONE block this step — the
+        # tail stays host-resident and the defer counter proves the
+        # backpressure path ran (restores never starve decode)
+        engine, tier = _tier_engine(token_budget=16)
+        params = SamplingParams(max_tokens=4, temperature=0.0)
+        prompt = list(range(1, 56))  # 3 full 16-token pages
+        cold = _drain(engine, Request("cold", prompt, params))
+        _churn(engine, n=6)
+        chain = block_hashes(prompt, CACHE.page_size)
+        held = [h for h in chain if tier.contains(h)]
+        assert len(held) >= 2
+        warm = _drain(engine, Request("warm", prompt, params))
+        assert engine.sched.kv_restore_deferred_total >= 1
+        assert engine.sched.kv_restores_total >= 1
+        assert warm == cold
+
+    def test_budget_below_page_size_still_restores(self):
+        # derived budgets can land below page_size (slow hosts measure
+        # tiny tokens/step): the plan must floor at ONE page per step —
+        # a sub-page remainder truncating to zero would pin restores at
+        # zero forever while the very same tokens recompute as chunks
+        engine, tier = _tier_engine(token_budget=8)  # < 16-token page
+        params = SamplingParams(max_tokens=4, temperature=0.0)
+        prompt = list(range(1, 56))  # 3 full 16-token pages
+        cold = _drain(engine, Request("cold", prompt, params))
+        _churn(engine, n=6)
+        assert any(tier.contains(h)
+                   for h in block_hashes(prompt, CACHE.page_size))
+        warm = _drain(engine, Request("warm", prompt, params))
+        assert engine.sched.kv_restores_total >= 1
+        assert warm == cold
+
+    def test_refuses_without_prefix_caching(self):
+        with pytest.raises(ValueError, match="prefix_caching"):
+            NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2,
+                         enable_prefix_caching=False,
+                         host_kv_tier=HostKVTier(async_offload=False))
+
+    def test_prefix_residency_shape(self):
+        engine, tier = _tier_engine()
+        _drain(engine, Request("a", WARM_PROMPT,
+                               SamplingParams(max_tokens=2,
+                                              temperature=0.0)))
+        res = engine.prefix_residency()
+        assert res["page_size"] == CACHE.page_size
+        assert res["tiers"]["hbm"] >= 2
+        assert len(res["blocks"]["hbm"]) == res["tiers"]["hbm"]
+        chain = block_hashes(WARM_PROMPT, CACHE.page_size)
+        assert chain[0].hex() in res["blocks"]["hbm"]
+        # counts-only form builds no digest (the /metrics path)
+        slim = engine.prefix_residency(limit=0)
+        assert slim["tiers"] == res["tiers"]
+        assert slim["blocks"] == {"hbm": [], "host": []}
+
+    def test_match_bumps_digest_recency(self):
+        # a hot chain that keeps HITTING must stay in the top-K digest
+        # even as newer blocks keep registering — otherwise the
+        # residency scorer reads the true holder as empty
+        from fusioninfer_tpu.engine.prefix_cache import (
+            PrefixCachingAllocator,
+        )
+
+        alloc = PrefixCachingAllocator(
+            CacheConfig(n_pages=65, page_size=8, max_pages_per_seq=8))
+        hot = list(range(16))  # 2 full pages
+        alloc.allocate("hot", 17)
+        alloc.register_blocks("hot", hot)
+        alloc.release("hot")
+        for j in range(5):  # churn: newer registrations
+            p = [1000 + j * 16 + k for k in range(16)]
+            alloc.allocate(f"o{j}", 17)
+            alloc.register_blocks(f"o{j}", p)
+            alloc.release(f"o{j}")
+        chain = block_hashes(hot, 8)
+        assert not set(alloc.resident_block_hashes(limit=2)) & set(chain)
+        alloc.match_prefix("probe", hot + [1])  # the hit bumps recency
+        alloc.release("probe")
+        assert set(alloc.resident_block_hashes(limit=2)) == set(chain[:2])
+
+    def test_metrics_render_tier_families(self):
+        from fusioninfer_tpu.engine.metrics import EngineMetrics
+
+        engine, tier = _tier_engine()
+        _drain(engine, Request("a", WARM_PROMPT,
+                               SamplingParams(max_tokens=2,
+                                              temperature=0.0)))
+        _churn(engine)
+        text = EngineMetrics("m").render(engine)
+        assert 'fusioninfer:prefix_blocks_resident{model_name="m",tier="hbm"}' in text
+        assert 'tier="host"' in text
+        assert "fusioninfer:kv_host_offloads_total" in text
+        assert "fusioninfer:sched_kv_restores_total" in text
+
+
+class TestResidencyRoutingE2E:
+    """The acceptance e2e: a repeat-prefix request routes to the engine
+    ACTUALLY holding the blocks, via the real ``/v1/prefix_residency``
+    endpoint over HTTP, with heuristic fallback when residency is
+    absent."""
+
+    CONFIG = """
+apiVersion: inference.networking.x-k8s.io/v1alpha1
+kind: EndpointPickerConfig
+plugins:
+- type: prefix-cache-scorer
+  parameters: {hashBlockSize: 5}
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - {pluginRef: prefix-cache-scorer, weight: 100}
+  - {pluginRef: max-score-picker}
+"""
+
+    def _servers(self, n=2):
+        from fusioninfer_tpu.engine.server import EngineServer
+
+        servers = []
+        for i in range(n):
+            engine = NativeEngine(
+                CFG,
+                cache_cfg=CacheConfig(n_pages=17, page_size=16,
+                                      max_pages_per_seq=6),
+                max_batch_size=2)
+            srv = EngineServer(model=CFG.name, host="127.0.0.1", port=0,
+                               engine=engine)
+            srv.start()
+            servers.append(srv)
+        return servers
+
+    def test_routes_repeat_prefix_to_holder(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            EndpointPicker,
+            ResidencyProvider,
+        )
+
+        servers = self._servers()
+        try:
+            eps = [Endpoint(name=f"e{i}",
+                            url=f"http://127.0.0.1:{s.port}",
+                            labels={})
+                   for i, s in enumerate(servers)]
+            prompt = "S" * 47 + " tell me"
+            # serve the prompt on endpoint 1 ONLY — its engine now holds
+            # the prefix blocks; endpoint 0 holds nothing
+            body = json.dumps({"prompt": prompt, "max_tokens": 2,
+                               "temperature": 0.0}).encode()
+            req = urllib.request.Request(
+                f"{eps[1].url}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=60).read()
+
+            picker = EndpointPicker(
+                self.CONFIG, endpoints=lambda: list(eps),
+                residency=ResidencyProvider(ttl_s=0.0))
+            # repeat prefix, fresh tail: residency must route to e1 even
+            # though the HISTORY heuristic has never seen this picker
+            # route anything
+            chosen = picker.pick(prompt[:47] + " new tail")
+            assert chosen is not None and chosen.name == "e1"
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_residency_endpoint_payload(self):
+        servers = self._servers(1)
+        try:
+            url = f"http://127.0.0.1:{servers[0].port}"
+            body = json.dumps({"prompt": "R" * 47, "max_tokens": 2,
+                               "temperature": 0.0}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"{url}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=60).read()
+            with urllib.request.urlopen(
+                    f"{url}/v1/prefix_residency", timeout=10) as resp:
+                res = json.loads(resp.read())
+            assert res["page_size"] == 16
+            assert res["tiers"]["hbm"] >= 1
+            # the digest must be the SAME hash chain the router computes
+            from fusioninfer_tpu.router.picker import byte_tokenize
+
+            chain = blockhash.block_hashes(byte_tokenize("R" * 47), 16)
+            assert chain[0].hex() in res["blocks"]["hbm"]
+        finally:
+            servers[0].stop()

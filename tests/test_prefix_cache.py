@@ -70,6 +70,30 @@ class TestAllocatorSharing:
         assert alloc.match_prefix("after", p1 + [1]) == 0
         alloc.release("big")
 
+    def test_touch_block_shields_chain_from_adoption_reclaim(self):
+        # the restore planner MRU-bumps a chain's HBM-resident blocks
+        # before adopting pages for the host-held ones: without the
+        # bump, the adoptions would LRU-reclaim the very chain being
+        # restored (its blocks are typically the oldest evictable)
+        small = CacheConfig(n_pages=5, page_size=8, max_pages_per_seq=4)
+        alloc = PrefixCachingAllocator(small)
+        pa, pb = list(range(8)), list(range(100, 108))
+        alloc.allocate("a", 8)
+        alloc.register_blocks("a", pa)
+        alloc.release("a")  # oldest evictable
+        alloc.allocate("b", 8)
+        alloc.register_blocks("b", pb)
+        alloc.release("b")  # newer evictable
+        alloc.allocate("c", 16)  # exhaust the free list
+        ha = block_hashes(pa, 8)[0]
+        hb = block_hashes(pb, 8)[0]
+        assert alloc.touch_block(ha) is True  # evictable -> bumped
+        alloc.adopt_block(b"\x99" * 16)  # reclaims LRU: now b, not a
+        assert alloc.has_block(ha)
+        assert not alloc.has_block(hb)
+        assert alloc.touch_block(b"\x77" * 16) is False  # unknown hash
+        alloc.release("c")
+
     def test_hit_rate_accounting(self):
         alloc = PrefixCachingAllocator(CACHE)
         prompt = list(range(16)) + [77]

@@ -297,3 +297,312 @@ class TestEPPImagePinning:
         monkeypatch.setenv("EPP_IMAGE", "epp@sha256:deadbeef")
         with _pytest.raises(ValueError, match="64 hex"):
             get_epp_image()
+
+
+class TestEngineMetricSurface:
+    """VERDICT #3: scraping scorers vs per-engine metric surfaces —
+    JetStream names are mapped (picker side), unknown flavors are
+    rejected at render time with a clear error."""
+
+    def _jetstream_worker(self):
+        from fusioninfer_tpu.api.types import EngineKind
+
+        return Role(name="w", component_type=ComponentType.WORKER,
+                    template=TEMPLATE, engine=EngineKind.JETSTREAM)
+
+    def _custom_worker(self):
+        from fusioninfer_tpu.api.types import EngineKind
+
+        return Role(name="w", component_type=ComponentType.WORKER,
+                    template=TEMPLATE, engine=EngineKind.CUSTOM)
+
+    def test_jetstream_with_scraping_scorer_renders(self):
+        # JetStream's names are mapped (metric_names.py), so the render
+        # proceeds — the in-process picker resolves the alternates
+        svc = svc_of(router_role(RoutingStrategy.KV_CACHE_UTILIZATION),
+                     self._jetstream_worker())
+        cfg = yaml.safe_load(generate_epp_config(svc, svc.spec.roles[0]))
+        assert cfg["plugins"][0]["type"] == "kv-cache-utilization-scorer"
+
+    def test_custom_engine_with_scraping_scorer_rejected(self):
+        import pytest as _pytest
+
+        from fusioninfer_tpu.api.types import ValidationError
+
+        for strategy in (RoutingStrategy.KV_CACHE_UTILIZATION,
+                         RoutingStrategy.QUEUE_SIZE):
+            svc = svc_of(router_role(strategy), self._custom_worker())
+            with _pytest.raises(ValidationError,
+                                match="unknown metric surface"):
+                generate_epp_config(svc, svc.spec.roles[0])
+
+    def test_custom_engine_with_prefix_cache_ok(self):
+        # affinity scorers scrape nothing: any flavor serves them
+        svc = svc_of(router_role(RoutingStrategy.PREFIX_CACHE),
+                     self._custom_worker())
+        assert generate_epp_config(svc, svc.spec.roles[0])
+
+    def test_user_supplied_config_wins_unchecked(self):
+        svc = svc_of(
+            router_role(RoutingStrategy.KV_CACHE_UTILIZATION,
+                        endpoint_picker_config="raw: config"),
+            self._custom_worker())
+        assert generate_epp_config(svc, svc.spec.roles[0]) == "raw: config"
+
+    def test_picker_scores_jetstream_metric_names(self):
+        from fusioninfer_tpu.router.picker import Endpoint, EndpointPicker
+
+        config = generate_epp_config(
+            svc_of(router_role(RoutingStrategy.KV_CACHE_UTILIZATION),
+                   self._jetstream_worker()),
+            router_role(RoutingStrategy.KV_CACHE_UTILIZATION))
+        eps = [Endpoint("full", "http://a", {}),
+               Endpoint("idle", "http://b", {})]
+        js_metrics = {
+            "full": {"jetstream_slots_used_percentage": 0.9},
+            "idle": {"jetstream_slots_used_percentage": 0.1},
+        }
+        picker = EndpointPicker(config, endpoints=lambda: list(eps),
+                                metrics=lambda ep: js_metrics[ep.name])
+        assert picker.pick("hello").name == "idle"
+
+    def test_picker_queue_scorer_jetstream(self):
+        from fusioninfer_tpu.router.picker import Endpoint, EndpointPicker
+
+        config = generate_epp_config(
+            svc_of(router_role(RoutingStrategy.QUEUE_SIZE),
+                   self._jetstream_worker()),
+            router_role(RoutingStrategy.QUEUE_SIZE))
+        eps = [Endpoint("busy", "http://a", {}),
+               Endpoint("calm", "http://b", {})]
+        js_metrics = {
+            "busy": {"jetstream_prefill_backlog_size": 40.0},
+            "calm": {"jetstream_prefill_backlog_size": 1.0},
+        }
+        picker = EndpointPicker(config, endpoints=lambda: list(eps),
+                                metrics=lambda ep: js_metrics[ep.name])
+        assert picker.pick("hello").name == "calm"
+
+
+class TestResidencyScoring:
+    """The EPP prefix scorer's residency mode: score against ACTUAL
+    reported cache contents, history heuristic as fallback."""
+
+    CONFIG = """
+apiVersion: inference.networking.x-k8s.io/v1alpha1
+kind: EndpointPickerConfig
+plugins:
+- type: prefix-cache-scorer
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - {pluginRef: prefix-cache-scorer, weight: 100}
+  - {pluginRef: max-score-picker}
+"""
+
+    def _digest_for(self, prompt: str, page_size: int = 16,
+                    n_blocks: int | None = None, tier: str = "hbm"):
+        from fusioninfer_tpu.router.picker import byte_tokenize
+        from fusioninfer_tpu.utils.blockhash import block_hashes
+
+        chain = block_hashes(byte_tokenize(prompt), page_size)
+        if n_blocks is not None:
+            chain = chain[:n_blocks]
+        other = "hbm" if tier == "host" else "host"
+        return {"page_size": page_size,
+                "tiers": {tier: len(chain), other: 0},
+                "blocks": {tier: [h.hex() for h in chain], other: []}}
+
+    def test_residency_routes_to_actual_holder(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            EndpointPicker,
+            ResidencyProvider,
+        )
+
+        prompt = "S" * 64 + "tail"
+        digests = {
+            "holder": self._digest_for(prompt),
+            "empty": {"page_size": 16, "tiers": {"hbm": 0, "host": 0},
+                      "blocks": {"hbm": [], "host": []}},
+        }
+        eps = [Endpoint("empty", "http://a", {}),
+               Endpoint("holder", "http://b", {})]
+        picker = EndpointPicker(
+            self.CONFIG, endpoints=lambda: list(eps),
+            residency=ResidencyProvider(
+                fetch=lambda ep: digests[ep.name], ttl_s=0.0))
+        # the history heuristic has seen NOTHING; residency alone routes
+        assert picker.pick(prompt).name == "holder"
+
+    def test_hbm_holder_beats_host_holder(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            EndpointPicker,
+            ResidencyProvider,
+        )
+
+        prompt = "S" * 64 + "tail"
+        digests = {
+            "hot": self._digest_for(prompt, tier="hbm"),
+            "warm": self._digest_for(prompt, tier="host"),
+        }
+        eps = [Endpoint("warm", "http://a", {}),
+               Endpoint("hot", "http://b", {})]
+        picker = EndpointPicker(
+            self.CONFIG, endpoints=lambda: list(eps),
+            residency=ResidencyProvider(
+                fetch=lambda ep: digests[ep.name], ttl_s=0.0))
+        assert picker.pick(prompt).name == "hot"
+
+    def test_fallback_to_heuristic_when_residency_absent(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            EndpointPicker,
+            ResidencyProvider,
+        )
+
+        def failing_fetch(ep):
+            raise OSError("scrape down")
+
+        eps = [Endpoint("a", "http://a", {}), Endpoint("b", "http://b", {})]
+        picker = EndpointPicker(
+            self.CONFIG, endpoints=lambda: list(eps),
+            residency=ResidencyProvider(fetch=failing_fetch, ttl_s=0.0))
+        prompt = "P" * 40
+        first = picker.pick(prompt)  # heuristic records the pick
+        assert picker.pick(prompt).name == first.name  # affinity sticks
+
+    def test_stale_digest_expires_to_heuristic(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+        )
+
+        clock = [0.0]
+        calls = [0]
+        prompt = "S" * 64
+
+        def fetch_once(ep):
+            calls[0] += 1
+            if calls[0] > 1:
+                raise OSError("down")
+            return self._digest_for(prompt)
+
+        provider = ResidencyProvider(fetch=fetch_once, ttl_s=0.5,
+                                     max_age_s=5.0,
+                                     clock=lambda: clock[0])
+        ep = Endpoint("e", "http://e", {})
+        assert provider.score(prompt, ep) == 1.0
+        clock[0] = 3.0  # past ttl, inside max_age: last known good
+        assert provider.score(prompt, ep) == 1.0
+        clock[0] = 20.0  # past max_age: no digest -> heuristic fallback
+        assert provider.score(prompt, ep) is None
+
+    def test_lkg_window_throttles_fetches(self):
+        # during the last-known-good window a dead endpoint must cost at
+        # most one fetch attempt per ttl, not one per pick
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+        )
+
+        clock = [0.0]
+        calls = [0]
+        prompt = "S" * 64
+
+        def fetch(ep):
+            calls[0] += 1
+            if calls[0] > 1:
+                raise OSError("down")
+            return self._digest_for(prompt)
+
+        provider = ResidencyProvider(fetch=fetch, ttl_s=1.0,
+                                     max_age_s=30.0,
+                                     clock=lambda: clock[0])
+        ep = Endpoint("e", "http://e", {})
+        assert provider.score(prompt, ep) == 1.0  # fetch 1: ok
+        clock[0] = 2.0
+        assert provider.score(prompt, ep) == 1.0  # fetch 2 fails -> LKG
+        n = calls[0]
+        clock[0] = 2.5  # inside the re-stamped ttl window
+        assert provider.score(prompt, ep) == 1.0
+        assert calls[0] == n  # NO extra fetch attempt
+
+    def test_truncated_digest_zero_match_falls_back(self):
+        # an engine holding more blocks than the top-K digest lists
+        # reports tier counts LARGER than its block list; a zero match
+        # against such a digest is ambiguous (the chain may have aged
+        # out of the top-K while still resident) -> heuristic fallback,
+        # never an authoritative 0 that routes traffic off the holder
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+        )
+
+        digest = self._digest_for("Z" * 64 + "t")
+        digest["tiers"]["hbm"] = 500  # truncated: count >> listed
+        provider = ResidencyProvider(fetch=lambda ep: digest, ttl_s=0.0)
+        assert provider.score("S" * 64 + "t",
+                              Endpoint("e", "http://e", {})) is None
+
+    def test_complete_digest_zero_match_is_authoritative(self):
+        # counts == listed blocks: the digest is COMPLETE, so a zero
+        # match really means cold — score 0.0, no fallback
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+        )
+
+        digest = self._digest_for("Z" * 64 + "t")
+        provider = ResidencyProvider(fetch=lambda ep: digest, ttl_s=0.0)
+        assert provider.score("S" * 64 + "t",
+                              Endpoint("e", "http://e", {})) == 0.0
+
+    def test_truncated_digest_partial_match_still_scores(self):
+        # a nonzero match against a truncated digest is real info (an
+        # underestimate at worst) — it must not fall back
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+        )
+
+        prompt = "S" * 64 + "t"  # 65 tokens w/ BOS -> 4 usable blocks
+        digest = self._digest_for(prompt, n_blocks=2)
+        digest["tiers"]["hbm"] = 500
+        provider = ResidencyProvider(fetch=lambda ep: digest, ttl_s=0.0)
+        score = provider.score(prompt, Endpoint("e", "http://e", {}))
+        assert score == pytest_approx(0.5)
+
+    def test_subpage_prompt_falls_back_to_heuristic(self):
+        # no full block can exist for a sub-page prompt: residency has
+        # NO information -> None (heuristic keeps its sticky routing),
+        # not an authoritative 0.0 for every endpoint
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+        )
+
+        provider = ResidencyProvider(
+            fetch=lambda ep: self._digest_for("S" * 64), ttl_s=0.0)
+        assert provider.score("hi", Endpoint("e", "http://e", {})) is None
+
+    def test_partial_chain_scores_fractionally(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+        )
+
+        prompt = "S" * 64 + "t"  # 65 tokens w/ BOS -> 4 usable blocks
+        provider = ResidencyProvider(
+            fetch=lambda ep: self._digest_for(prompt, n_blocks=2),
+            ttl_s=0.0)
+        score = provider.score(prompt, Endpoint("e", "http://e", {}))
+        assert score == pytest_approx(0.5)
+
+
+def pytest_approx(v, rel=1e-6):
+    import pytest as _pytest
+
+    return _pytest.approx(v, rel=rel)

@@ -63,6 +63,41 @@ def check_record(record: dict) -> list[str]:
                 problems.append(f"http.scheduler.{field} missing")
     if "queue_wait_ms" not in http:
         problems.append("http.queue_wait_ms (TTFT decomposition) missing")
+    # hierarchical-KV leg (r08): the shared-prefix workload must drive
+    # the hit rate off 0.0, warm turns must beat cold turns, and the
+    # host tier must demonstrably carry chains (offloads AND restores
+    # AND host hits nonzero) — a record without this evidence is the
+    # pre-hierarchy blind spot shipping again
+    sp = record.get("workload_sharedprefix")
+    if not isinstance(sp, dict):
+        problems.append("workload_sharedprefix leg missing")
+        return problems
+    if sp.get("error"):
+        problems.append(f"workload_sharedprefix errored: {sp['error']}")
+        return problems
+    rate = sp.get("prefix_cache_hit_rate")
+    if not isinstance(rate, (int, float)) or rate <= 0.0:
+        problems.append(
+            f"workload_sharedprefix.prefix_cache_hit_rate must be > 0, "
+            f"got {rate!r}")
+    for field in ("cold_ttft_ms", "warm_ttft_ms"):
+        if not (sp.get(field) or {}).get("p50"):
+            problems.append(f"workload_sharedprefix.{field}.p50 missing")
+    if sp.get("warm_faster") is not True:
+        problems.append(
+            "workload_sharedprefix: warm-turn TTFT p50 must beat "
+            f"cold-turn p50 (warm_faster={sp.get('warm_faster')!r}, "
+            f"warm={(sp.get('warm_ttft_ms') or {}).get('p50')}ms, "
+            f"cold={(sp.get('cold_ttft_ms') or {}).get('p50')}ms)")
+    tier = sp.get("host_tier")
+    if not isinstance(tier, dict):
+        problems.append("workload_sharedprefix.host_tier counters missing")
+    else:
+        for counter in ("offloads", "restores", "host_hits"):
+            if not tier.get(counter):
+                problems.append(
+                    f"workload_sharedprefix.host_tier.{counter} must be "
+                    f"nonzero, got {tier.get(counter)!r}")
     return problems
 
 

@@ -44,6 +44,12 @@ WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     # wall clock.  perf_counter stays legal — calibrate_token_budget's
     # D2H-fenced measurement is explicitly wall-time.
     "fusioninfer_tpu/engine/engine.py": ("time", "sleep", "monotonic"),
+    # the host KV tier's visibility ordering (offload commit → restore
+    # hit) must be driven by queue joins and locks, never wall-time
+    # pacing — a sleep here would turn the chaos suite's deterministic
+    # offload/restore schedule into timing soup
+    "fusioninfer_tpu/engine/kv_host_tier.py": ("time", "sleep",
+                                               "monotonic"),
 }
 
 # -- lock-discipline pass ----------------------------------------------
@@ -160,6 +166,13 @@ HOST_SYNC_MODULES: dict[str, tuple[str, ...]] = {
     "fusioninfer_tpu/engine/sched.py": (),
     "fusioninfer_tpu/engine/fused.py": (),
     "fusioninfer_tpu/engine/model_runner.py": (),
+    # the host KV tier: the ONLY sanctioned device→host fetch is the
+    # offload worker's serialization (_store blocks on the page gather
+    # the engine dispatched at reclaim); restore-side take() handles
+    # host bytes only, and the engine-side restore path
+    # (engine._restore_host_blocks) dispatches the H2D inject without
+    # fetching — an ad-hoc fetch anywhere else stalls the step loop
+    "fusioninfer_tpu/engine/kv_host_tier.py": ("_store",),
     "fusioninfer_tpu/ops/paged_attention.py": (),
     "fusioninfer_tpu/ops/dispatch.py": (),
     "fusioninfer_tpu/ops/sharded.py": (),
