@@ -85,6 +85,11 @@ bench-smoke: ## CPU bench smoke + assert ceiling_fraction/scheduler fields land 
 	BENCH_PLATFORM=cpu $(PYTHON) bench.py
 	$(PYTHON) tools/check_bench_record.py BENCH_OUT.json
 
+.PHONY: fleet-smoke
+fleet-smoke: ## Closed-loop fleet smoke (CPU, 3 engines + PD pair, ~90 s): real manager+engines+EPP+autoscaler under faulted load; record gated.
+	$(PYTHON) bench.py --fleet-smoke --out FLEET_OUT.json
+	$(PYTHON) tools/check_fleet_record.py FLEET_OUT.json
+
 .PHONY: dryrun
 dryrun: ## Multichip sharding dry-run on 8 virtual CPU devices.
 	$(PYTHON) __graft_entry__.py 8

@@ -1407,5 +1407,17 @@ def main() -> None:
     print(line)
 
 
+def fleet_smoke(argv: list[str]) -> int:
+    """``python bench.py --fleet-smoke [--out FLEET_OUT.json]``: the
+    closed-loop fleet harness (fusioninfer_tpu.fleetsim) as a bench
+    entry point — real manager + engines + EPP + autoscaler under
+    faulted load, evidence gated by tools/check_fleet_record.py."""
+    from fusioninfer_tpu.fleetsim.__main__ import main as fleet_main
+
+    return fleet_main([a for a in argv if a != "--fleet-smoke"])
+
+
 if __name__ == "__main__":
+    if "--fleet-smoke" in sys.argv[1:]:
+        sys.exit(fleet_smoke(sys.argv[1:]))
     main()

@@ -9,9 +9,10 @@ const sidebars = {
       type: 'category',
       label: 'Design',
       items: ['design/autoscaling', 'design/crd', 'design/engine',
-              'design/kv-hierarchy', 'design/parallelism',
-              'design/resilience', 'design/router',
-              'design/scheduler', 'design/static-analysis'],
+              'design/fleet-sim', 'design/kv-hierarchy',
+              'design/parallelism', 'design/resilience',
+              'design/router', 'design/scheduler',
+              'design/static-analysis'],
     },
   ],
 };

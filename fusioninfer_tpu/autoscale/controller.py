@@ -114,6 +114,7 @@ class AutoscaleController:
         mark_draining: Optional[Callable[[str, bool], None]] = None,
         interval_s: float = DEFAULT_INTERVAL_S,
         metrics: Optional[AutoscalerMetrics] = None,
+        on_event: Optional[Callable[[str, str, int, int], None]] = None,
     ):
         self.client = client
         self.namespace = namespace
@@ -126,6 +127,20 @@ class AutoscaleController:
         self.drainer = Drainer(clock=clock, mark_draining=mark_draining)
         self.interval_s = interval_s
         self.metrics = metrics or AutoscalerMetrics()
+        # scale-event subscriber: called as (kind, role, from, to) for
+        # "up" / "drain" / "down" — the fleet harness's event ledger
+        # (fusioninfer_tpu.fleetsim) records these instead of diffing
+        # replicas per tick.  "hold" is deliberately not published: a
+        # holding loop is the steady state, not an event.
+        self._on_event = on_event
+
+    def _publish(self, kind: str, role: str, frm: int, to: int) -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(kind, role, frm, to)
+        except Exception:
+            logger.exception("autoscale on_event subscriber raised")
 
     # -- loop --
 
@@ -297,6 +312,7 @@ class AutoscaleController:
             self.metrics.observe(
                 svc.namespace, svc.name, role.name, decision.desired,
                 role.replicas, "up", scaled_at=self._clock())
+            self._publish("up", role.name, role.replicas, decision.desired)
             logger.info(
                 "scale up %s/%s role %s: %d → %d (%s)", svc.namespace,
                 svc.name, role.name, role.replicas, decision.desired,
@@ -311,6 +327,8 @@ class AutoscaleController:
             self.metrics.observe(
                 svc.namespace, svc.name, role.name, decision.desired,
                 role.replicas, "drain")
+            self._publish("drain", role.name, role.replicas,
+                          decision.desired)
         else:
             self.metrics.observe(
                 svc.namespace, svc.name, role.name, decision.desired,
@@ -359,6 +377,8 @@ class AutoscaleController:
         self.metrics.observe(
             svc.namespace, svc.name, role.name, state.target_replicas,
             role.replicas, "down", scaled_at=self._clock())
+        self._publish("down", role.name, role.replicas,
+                      state.target_replicas)
         logger.info(
             "scale down %s/%s role %s: %d → %d (%s)", svc.namespace,
             svc.name, role.name, role.replicas, state.target_replicas, verdict)

@@ -181,15 +181,68 @@ def _timed_request(base_url: str, prompt: str, output_len: int,
     return ttft, n_chunks, None
 
 
-def _pcts(vals: list[float]) -> dict:
-    """TTFT percentiles in ms — same np.percentile convention as
-    ``LoadResult.percentile_ttft`` so the two legs never drift."""
+def pcts_ms(vals: list[float]) -> dict:
+    """Latency percentiles in ms — same np.percentile convention as
+    ``LoadResult.percentile_ttft`` so the legs never drift.  THE one
+    percentile builder: the fleet record (``fleetsim.record``) imports
+    it so bench and FLEET percentiles share a single definition."""
     if not vals:
         return {}
-    xs = np.asarray(vals)
+    xs = np.asarray(vals, dtype=float)
     return {"p50": round(float(np.percentile(xs, 50)) * 1e3, 2),
             "p90": round(float(np.percentile(xs, 90)) * 1e3, 2),
             "max": round(float(xs.max()) * 1e3, 2), "n": len(vals)}
+
+
+_pcts = pcts_ms  # the leg-local name this module's callers grew up with
+
+
+def poisson_arrivals(
+    n: int, rate_rps: float, seed: int,
+    burst_factor: float = 4.0, burst_every: int = 16, burst_len: int = 4,
+) -> list[float]:
+    """Seeded OPEN-LOOP arrival offsets (seconds from t0): exponential
+    inter-arrivals at ``rate_rps``, with every ``burst_every``-th run of
+    ``burst_len`` arrivals drawn at ``burst_factor``× the base rate — the
+    bursty arrival process production traffic actually exhibits (requests
+    fire at their scheduled time regardless of completions, unlike the
+    closed-loop strata whose concurrency self-throttles under slowdown).
+    Deterministic under ``seed``; shared by the ``workload_sharedprefix``
+    bench leg and the fleet harness (``fusioninfer_tpu.fleetsim``)."""
+    if n <= 0:
+        return []
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    rng = np.random.default_rng(seed)
+    t, out = 0.0, []
+    for i in range(n):
+        rate = rate_rps * (burst_factor if (i % burst_every) < burst_len
+                           else 1.0)
+        t += float(rng.exponential(1.0 / rate))
+        out.append(t)
+    return out
+
+
+def fire_open_loop(arrivals: list[float], fire) -> None:
+    """Run ``fire(i)`` on its own thread at each ``arrivals[i]`` offset
+    (seconds from call time) and join them all — the open-loop pump: a
+    slow server does NOT slow the arrival schedule down, so queues build
+    the way they do for real under a burst."""
+    t0 = time.perf_counter()
+    threads: list[threading.Thread] = []
+
+    def runner(i: int, at: float) -> None:
+        delay = at - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        fire(i)
+
+    for i, at in enumerate(arrivals):
+        th = threading.Thread(target=runner, args=(i, at), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
 
 
 def run_sharedprefix_load(
@@ -205,6 +258,9 @@ def run_sharedprefix_load(
     concurrency: int = 4,
     seed: int = 0,
     timeout: float = 300.0,
+    bursty_requests: int = 8,
+    bursty_rate_rps: float = 6.0,
+    bursty_burst_factor: float = 4.0,
 ) -> dict:
     """The ``workload_sharedprefix`` bench leg: the traffic millions of
     users actually generate — shared system prompts and multi-turn
@@ -228,10 +284,17 @@ def run_sharedprefix_load(
       face real eviction pressure MID-RUN (the production regime where
       the host tier earns restores) instead of resting in an otherwise
       quiet pool.
+    * **bursty** — ``bursty_requests`` unique prompts fired OPEN-LOOP at
+      seeded Poisson arrival times with a burst multiplier
+      (:func:`poisson_arrivals`), concurrent with the closed-loop
+      strata: arrivals do not wait for completions, so a burst builds
+      real queue depth the closed-loop strata structurally cannot
+      (their concurrency self-throttles when the server slows down).
 
     Reports cold-vs-warm TTFT percentiles (the hierarchy's headline:
-    warm turns must beat cold turns) plus the scraped engine hit rate.
-    Deterministic request content under ``seed``.
+    warm turns must beat cold turns) plus per-stratum TTFT percentiles
+    (``strata_ttft_ms``) and the scraped engine hit rate.  Deterministic
+    request content and arrival schedule under ``seed``.
     """
     # seed spacing: a full 10**7 stride per run seed so two passes with
     # adjacent seeds can never share prompt content (seed+i would —
@@ -281,10 +344,13 @@ def run_sharedprefix_load(
     lock = threading.Lock()
     out: dict = {
         "requests": 0, "ok": 0, "errors": {},
-        "strata": {"sharedprefix": 0, "multiturn": 0, "background": 0},
+        "strata": {"sharedprefix": 0, "multiturn": 0, "background": 0,
+                   "bursty": 0},
     }
     cold_ttfts: list[float] = []
     warm_ttfts: list[float] = []
+    stratum_ttfts: dict[str, list[float]] = {
+        "sharedprefix": [], "multiturn": [], "background": [], "bursty": []}
     t0 = time.perf_counter()
     # cold pass, CONCURRENT (one stream per system prompt — the prompts
     # are distinct, so no mislabeling race) but strictly BEFORE the warm
@@ -303,9 +369,46 @@ def run_sharedprefix_load(
                 out["ok"] += 1
                 if ttft is not None:
                     cold_ttfts.append(ttft)
+                    stratum_ttfts["sharedprefix"].append(ttft)
 
-    out["requests"] += len(cold_prompts)
-    out["strata"]["sharedprefix"] += len(cold_prompts)
+    # the open-loop bursty stratum fires CONCURRENTLY with BOTH phases
+    # (launched before the cold pass): its arrivals keep their schedule
+    # even when the engine saturates, so queue depth builds the way the
+    # closed-loop strata structurally cannot (their concurrency
+    # self-throttles when the server slows down) — and cold and warm
+    # turns still share one contention regime, so warm_faster keeps
+    # comparing like against like
+    arrivals = poisson_arrivals(bursty_requests, bursty_rate_rps,
+                                rng_base + 9 * 10**6,
+                                burst_factor=bursty_burst_factor)
+    bursty_prompts = [
+        random_prompt(system_prompt_len + tail_len,
+                      rng_base + 9 * 10**6 + 1 + i)
+        for i in range(bursty_requests)
+    ]
+
+    def bursty_fire(i: int) -> None:
+        with lock:
+            out["requests"] += 1
+            out["strata"]["bursty"] += 1
+        ttft, _, err = _timed_request(
+            base_url, bursty_prompts[i], output_len, timeout,
+            seed + 7000 + i)
+        with lock:
+            if err is not None:
+                out["errors"][err] = out["errors"].get(err, 0) + 1
+            else:
+                out["ok"] += 1
+                if ttft is not None:
+                    stratum_ttfts["bursty"].append(ttft)
+
+    bursty_thread = threading.Thread(
+        target=fire_open_loop, args=(arrivals, bursty_fire), daemon=True)
+    bursty_thread.start()
+
+    with lock:  # the bursty thread is already mutating these counters
+        out["requests"] += len(cold_prompts)
+        out["strata"]["sharedprefix"] += len(cold_prompts)
     cold_threads = [threading.Thread(target=cold_worker, args=(i, p),
                                      daemon=True)
                     for i, p in enumerate(cold_prompts)]
@@ -335,6 +438,8 @@ def run_sharedprefix_load(
                         out["errors"][err] = out["errors"].get(err, 0) + 1
                         continue
                     out["ok"] += 1
+                    if ttft is not None:
+                        stratum_ttfts[kind].append(ttft)
                     # background prompts are unique (cold by design but
                     # not a "cold turn" of a warm session) — they count
                     # toward load and hit-rate denominators, never
@@ -348,9 +453,11 @@ def run_sharedprefix_load(
         t.start()
     for t in threads:
         t.join()
+    bursty_thread.join()
     out["duration_s"] = round(time.perf_counter() - t0, 3)
     out["cold_ttft_ms"] = _pcts(cold_ttfts)
     out["warm_ttft_ms"] = _pcts(warm_ttfts)
+    out["strata_ttft_ms"] = {k: _pcts(v) for k, v in stratum_ttfts.items()}
     if cold_ttfts and warm_ttfts:
         out["warm_faster"] = (out["warm_ttft_ms"]["p50"]
                               < out["cold_ttft_ms"]["p50"])

@@ -490,6 +490,9 @@ class NativeEngine:
         self.admission_timings: collections.deque = collections.deque(
             maxlen=4096)
         self._admit_t: dict[str, tuple[float, float]] = {}
+        # request_id -> precomputed usable block-hash chain, set at
+        # admission pop and consumed at match_prefix (engine thread only)
+        self._admission_chains: dict[str, list] = {}
         self._free_slots = list(reversed(range(max_batch_size)))
         self._cancelled: set[str] = set()
         self._lock = threading.Lock()
@@ -1115,6 +1118,7 @@ class NativeEngine:
         self._pd_pending.clear()
         self._embed_pending.clear()
         self._admit_t.clear()
+        self._admission_chains.clear()
         with self._lock:
             pd_futs, self._pd_futures = list(self._pd_futures.values()), {}
             em_futs, self._embed_futures = (
@@ -1158,8 +1162,25 @@ class NativeEngine:
         self._host_tier.offload(h, extract_slab(
             self.cache, [page], [], 0, self.cache_cfg.page_size))
 
-    def _restore_host_blocks(self, request: Request,
-                             prefix: list[int]) -> None:
+    def _admission_chain(self, request: Request,
+                         prefix: list) -> Optional[list]:
+        """The prompt's FULL block-hash chain, computed ONCE per
+        admission and threaded through every consumer — the host-tier
+        restore consult, ``can_admit``'s peek, ``match_prefix`` and the
+        post-prefill ``register_blocks`` publish used to each rebuild
+        the same blake2b chain (up to 4× per request; the PR 8 review
+        follow-up).  Admission consumers cap it at the usable block
+        count themselves (the last token's block is never matchable but
+        IS publishable).  None when nothing content-addresses prompts
+        (no prefix caching, no host tier) so those configs keep paying
+        zero hash cost."""
+        if not self.prefix_caching and self._host_tier is None:
+            return None
+        return block_hashes(list(prefix), self.cache_cfg.page_size,
+                            self._lora_ns(request))
+
+    def _restore_host_blocks(self, request: Request, prefix: list[int],
+                             chain: Optional[list] = None) -> None:
         """Consult the host tier for the blocks HBM no longer holds and
         restore the hit chain ahead of ``match_prefix``.
 
@@ -1177,14 +1198,17 @@ class NativeEngine:
         tier = self._host_tier
         if tier is None or not len(tier):
             # empty tier (the steady state for non-shared traffic):
-            # skip the per-admission hash-chain build entirely
+            # nothing to consult
             return
         ps = self.cache_cfg.page_size
-        usable = max(0, (len(prefix) - 1) // ps)
-        if not usable:
+        hashes = (chain if chain is not None
+                  else self._admission_chain(request, prefix))
+        # cap at the USABLE blocks: the full chain's last block (when
+        # len(prefix) is page-aligned) can never prefix-match, so
+        # restoring it would waste a page
+        hashes = (hashes or [])[:max(0, (len(prefix) - 1) // ps)]
+        if not hashes:
             return
-        hashes = block_hashes(list(prefix), ps,
-                              self._lora_ns(request))[:usable]
         plan: list[bytes] = []
         resident_evictable = 0
         for h in hashes:
@@ -1422,6 +1446,7 @@ class NativeEngine:
                 # must not leave a timing entry behind (bounded deque,
                 # unbounded dict otherwise)
                 self._admit_t.pop(rid, None)
+                self._admission_chains.pop(rid, None)
             # mutate under the lock: add_request pushes from HTTP threads
             self.cancelled_total += self.waiting.remove_ids(cancelled)
             kept_p = collections.deque(
@@ -1488,15 +1513,19 @@ class NativeEngine:
             self._admit_t[request.request_id] = (
                 now, max(0.0, now - request.arrival_time))
             prefix = request.resume_tokens or request.prompt_tokens
+            # ONE hash-chain build per admission, threaded through the
+            # host-tier consult, can_admit's peek and match_prefix below
+            chain = self._admission_chain(request, prefix)
             if self._host_tier is not None:
                 # host-tier consult BEFORE capacity checks: restored
                 # blocks land evictable, so they raise can_admit's
                 # matched count without consuming admission capacity
-                self._restore_host_blocks(request, prefix)
+                self._restore_host_blocks(request, prefix, chain)
             blocked = False
             # reuse-aware: a mostly-cached prompt needs few fresh pages
             while not self.alloc.can_admit(prefix, 1,
-                                           namespace=self._lora_ns(request)):
+                                           namespace=self._lora_ns(request),
+                                           chain=chain):
                 # a higher-priority arrival may evict strictly less
                 # urgent running/prefilling work to get in NOW; equal or
                 # lower priority waits for capacity (classic FCFS)
@@ -1511,6 +1540,8 @@ class NativeEngine:
                 break
             resumed = request.resume_tokens is not None
             request.resume_tokens = None
+            if chain is not None:
+                self._admission_chains[request.request_id] = chain
             pending.append((request, prefix, resumed))
 
         while pending:
@@ -1528,9 +1559,12 @@ class NativeEngine:
                     continue
                 rid = request.request_id
                 try:
+                    # get, not pop: the chain survives to the
+                    # post-prefill register_blocks publish
                     reused = (
-                        self.alloc.match_prefix(rid, prefix,
-                                                namespace=self._lora_ns(request))
+                        self.alloc.match_prefix(
+                            rid, prefix, namespace=self._lora_ns(request),
+                            chain=self._admission_chains.get(rid))
                         if self.prefix_caching else 0
                     )
                     self._adapter_id(request)  # validate before any compute
@@ -1625,6 +1659,9 @@ class NativeEngine:
                     request.resume_tokens = list(prefix)
                 self.waiting.push(request)
                 self._admit_t.pop(request.request_id, None)
+                # the chain was built against THIS pop's prefix; a
+                # re-admission recomputes (resume state may differ)
+                self._admission_chains.pop(request.request_id, None)
 
     def _lora_ns(self, request: Request) -> bytes:
         return f"lora:{request.lora}".encode() if request.lora else b""
@@ -1643,6 +1680,9 @@ class NativeEngine:
         """Never lose a popped request silently: fail it to the client."""
         self.errors_total += 1
         self._admit_t.pop(request.request_id, None)
+        # a failure between match_prefix and the register_blocks publish
+        # must not strand its admission chain
+        self._admission_chains.pop(request.request_id, None)
         return StepOutput(
             request_id=request.request_id,
             token=0,
@@ -2195,8 +2235,12 @@ class NativeEngine:
         round trip per admission GROUP instead of per admission."""
         rid = request.request_id
         if self.prefix_caching:
-            self.alloc.register_blocks(rid, prefix,
-                                       namespace=self._lora_ns(request))
+            # the admission chain's LAST consumer — popped here
+            self.alloc.register_blocks(
+                rid, prefix, namespace=self._lora_ns(request),
+                chain=self._admission_chains.pop(rid, None))
+        else:
+            self._admission_chains.pop(rid, None)
         seq_seed = self._request_seed(request)
         n_prompt = len(request.prompt_tokens)
         from fusioninfer_tpu.engine.guided import machine_for

@@ -218,11 +218,13 @@ class PageAllocator:
         return need <= len(self._free) and need <= self.cache_cfg.max_pages_per_seq
 
     def can_admit(self, prompt_tokens: list, extra_tokens: int = 1,
-                  namespace: bytes = b"") -> bool:
+                  namespace: bytes = b"", chain=None) -> bool:
         """Admission check for a new request (prefix-caching subclasses
         account for reusable cached pages; ``namespace`` partitions their
-        content address space, e.g. per LoRA adapter)."""
-        del namespace  # no content addressing in the base allocator
+        content address space, e.g. per LoRA adapter, and ``chain`` lets
+        the caller pass the prompt's precomputed block-hash chain so
+        admission hashes once, not per check)."""
+        del namespace, chain  # no content addressing in the base allocator
         return self.can_allocate(len(prompt_tokens) + extra_tokens)
 
     def allocate(self, seq_id: str, n_tokens: int) -> list[int]:

@@ -195,6 +195,20 @@ class EngineMetrics:
             f'fusioninfer:prefix_blocks_resident{{{labels},tier="hbm"}} {tiers["hbm"]}',
             f'fusioninfer:prefix_blocks_resident{{{labels},tier="host"}} {tiers["host"]}',
         ]
+        alloc = getattr(engine, "alloc", None)
+        if alloc is not None and hasattr(alloc, "query_tokens_total"):
+            # raw counter pair behind vllm:gpu_prefix_cache_hit_rate —
+            # the lifetime ratio can't be windowed, so fleet-level
+            # harnesses (fusioninfer_tpu.fleetsim) diff these per phase
+            # to report a per-phase hit rate across engine generations
+            lines += [
+                "# HELP fusioninfer:prefix_query_tokens_total Prompt tokens presented to the prefix cache.",
+                "# TYPE fusioninfer:prefix_query_tokens_total counter",
+                f"fusioninfer:prefix_query_tokens_total{{{labels}}} {alloc.query_tokens_total}",
+                "# HELP fusioninfer:prefix_hit_tokens_total Prompt tokens served from cached prefix pages.",
+                "# TYPE fusioninfer:prefix_hit_tokens_total counter",
+                f"fusioninfer:prefix_hit_tokens_total{{{labels}}} {alloc.hit_tokens_total}",
+            ]
         tier = getattr(engine, "host_kv_tier", None)
         if tier is None:
             return lines

@@ -93,16 +93,35 @@ class PrefixCachingAllocator(PageAllocator):
 
     # -- prefix matching -----------------------------------------------------
 
+    def _usable_chain(self, prompt_tokens: list, namespace: bytes,
+                      chain: Optional[list]) -> list:
+        """The prompt's block-hash chain capped at the usable block count
+        (``(len(prompt) - 1) // page_size`` — the last token is always
+        recomputed for its logits, so its block can never be reused).
+        ``chain`` short-circuits the hash: admission computes the FULL
+        chain ONCE (``NativeEngine._admission_chain``) and threads it
+        through the host-tier restore consult, :meth:`can_admit`,
+        :meth:`match_prefix` and :meth:`register_blocks`, which used to
+        hash the same prefix up to four times per request; it is capped
+        here so callers can hand the full chain everywhere."""
+        ps = self.cache_cfg.page_size
+        usable_blocks = max(0, (len(prompt_tokens) - 1) // ps)
+        if chain is not None:
+            return chain[:usable_blocks]
+        return block_hashes(prompt_tokens, ps, namespace)[:usable_blocks]
+
     def match_prefix(self, seq_id: str, prompt_tokens: list[int],
-                     namespace: bytes = b"") -> int:
+                     namespace: bytes = b"",
+                     chain: Optional[list] = None) -> int:
         """Acquire the longest cached page chain for this prompt; returns
         the number of prefix TOKENS covered (multiple of page_size, capped
-        at ``len(prompt) - 1`` so the last token is always recomputed)."""
+        at ``len(prompt) - 1`` so the last token is always recomputed).
+        ``chain`` is the prompt's precomputed usable block-hash chain
+        (see :meth:`_usable_chain`)."""
         ps = self.cache_cfg.page_size
         self.query_tokens_total += len(prompt_tokens)
-        usable_blocks = max(0, (len(prompt_tokens) - 1) // ps)
         shared: list[int] = []
-        for h in block_hashes(prompt_tokens, ps, namespace)[:usable_blocks]:
+        for h in self._usable_chain(prompt_tokens, namespace, chain):
             page = self._hash_to_page.get(h)
             if page is None:
                 break
@@ -129,13 +148,12 @@ class PrefixCachingAllocator(PageAllocator):
         return need <= self.free_pages and need <= self.cache_cfg.max_pages_per_seq
 
     def _peek_match(self, prompt_tokens: list[int],
-                    namespace: bytes = b"") -> tuple[int, int]:
+                    namespace: bytes = b"",
+                    chain: Optional[list] = None) -> tuple[int, int]:
         """(matched pages, matched pages currently evictable) — a dry run
         of :meth:`match_prefix` that acquires nothing."""
-        ps = self.cache_cfg.page_size
-        usable_blocks = max(0, (len(prompt_tokens) - 1) // ps)
         matched = evictable = 0
-        for h in block_hashes(prompt_tokens, ps, namespace)[:usable_blocks]:
+        for h in self._usable_chain(prompt_tokens, namespace, chain):
             page = self._hash_to_page.get(h)
             if page is None:
                 break
@@ -144,14 +162,16 @@ class PrefixCachingAllocator(PageAllocator):
         return matched, evictable
 
     def can_admit(self, prompt_tokens: list, extra_tokens: int = 1,
-                  namespace: bytes = b"") -> bool:
+                  namespace: bytes = b"",
+                  chain: Optional[list] = None) -> bool:
         """Reuse-aware admission: a request whose prompt is mostly cached
         needs only the uncovered pages.  Matched-but-evictable pages count
         as free AND as matched, so subtract them from both sides."""
         need_total = self.pages_needed(len(prompt_tokens) + extra_tokens)
         if need_total > self.cache_cfg.max_pages_per_seq:
             return False
-        matched, evictable = self._peek_match(list(prompt_tokens), namespace)
+        matched, evictable = self._peek_match(list(prompt_tokens), namespace,
+                                              chain)
         return need_total - matched <= self.free_pages - evictable
 
     def allocate(self, seq_id: str, n_tokens: int) -> list[int]:
@@ -179,12 +199,18 @@ class PrefixCachingAllocator(PageAllocator):
     # -- publishing ----------------------------------------------------------
 
     def register_blocks(self, seq_id: str, prompt_tokens: list[int],
-                        namespace: bytes = b"") -> None:
+                        namespace: bytes = b"",
+                        chain: Optional[list] = None) -> None:
         """Content-address this sequence's full private prompt pages so
-        later requests can share them (called once after prefill)."""
+        later requests can share them (called once after prefill).
+        ``chain`` is the prompt's precomputed FULL block-hash chain
+        (uncapped — the publish covers every complete page, including
+        the one :meth:`_usable_chain` excludes from matching)."""
         ps = self.cache_cfg.page_size
         pages = self._owned.get(seq_id, [])
-        for i, h in enumerate(block_hashes(prompt_tokens, ps, namespace)):
+        hashes = (chain if chain is not None
+                  else block_hashes(prompt_tokens, ps, namespace))
+        for i, h in enumerate(hashes):
             if i >= len(pages):
                 break
             page = pages[i]

@@ -956,6 +956,14 @@ class EngineServer:
                               "top_logprobs": [out.top_logprobs or {}]}
                     choice = {"index": choice_index, "text": delta,
                               "finish_reason": finish, "logprobs": lp}
+                    if counted:
+                        # raw id riding alongside the decoded delta (a
+                        # vLLM-style additive extension): decoded text is
+                        # LOSSY under fallback tokenizers (ByteTokenizer
+                        # drops non-byte ids), so stream-integrity
+                        # checkers (fleetsim.FleetClient) compare ids,
+                        # not text
+                        choice["token_id"] = out.token
                     obj = "text_completion"
                 yield {
                     "id": completion_id,
@@ -1693,6 +1701,47 @@ class EngineServer:
         self._stop.set()
         if self._httpd is not None:
             self._httpd.shutdown()
+
+    def kill(self) -> None:
+        """Abrupt termination — the slice-loss failure mode, not a
+        shutdown path: no drain, no goodbye.  Admission closes FIRST
+        (the ``_draining`` flag, flipped under the same lock ``submit``
+        checks it under, so a request racing the kill gets a fast 503
+        instead of registering a channel nothing will ever fill), then
+        the engine thread is stopped (so nothing races the failure
+        fan-out), then every in-flight stream is failed NOW — the way a
+        dying pod's broken connections surface to clients immediately —
+        and the listener closes so new connections are refused rather
+        than accepted into a corpse.  Fleet harnesses
+        (``fusioninfer_tpu.fleetsim``,
+        ``operator/podsim.py::LWSSimulator.kill``) use this to prove
+        breaker ejection beats the client timeout."""
+        with self._lock:
+            self._draining = True
+        self._stop.set()
+        if self._engine_thread is not None:
+            self._engine_thread.join(timeout=10)
+        try:
+            outputs = self.engine.fail_all("slice lost")
+        except Exception:
+            logger.exception("fail_all during kill raised; channels may "
+                             "time out instead of failing fast")
+            outputs = []
+        covered = {out.request_id for out in outputs}
+        with self._lock:
+            for rid in self._channels:
+                if rid not in covered:
+                    outputs.append(StepOutput(
+                        request_id=rid, token=0, finished=True,
+                        finish_reason="error:slice lost"))
+        for out in outputs:
+            with self._lock:
+                chan = self._channels.get(out.request_id)
+            if chan is not None:
+                chan.put(out)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
 
     def drain(self, timeout: float = 120.0) -> bool:
         """Graceful shutdown: stop ADMITTING (new requests 503) but keep

@@ -1,0 +1,36 @@
+"""fleetsim: the closed-loop fleet harness (docs/design/fleet-sim.md).
+
+Every subsystem shipped through PR 8 is proven alone; this package is
+the proof they compose — ROADMAP item 5's "the proof the north star
+asks for."  A :class:`~fusioninfer_tpu.fleetsim.harness.FleetHarness`
+boots the REAL stack end to end inside one process:
+
+* the real :class:`~fusioninfer_tpu.operator.manager.Manager`
+  reconciles a real ``InferenceService`` against the in-repo API server,
+* :class:`~fusioninfer_tpu.operator.podsim.LWSSimulator` runs each
+  rendered LeaderWorkerSet as a real
+  :class:`~fusioninfer_tpu.engine.server.EngineServer` (tiny model,
+  prefix caching + host KV tier + per-engine fault injectors),
+* the real :class:`~fusioninfer_tpu.router.picker.EndpointPicker`
+  (residency mode) routes live HTTP from the loadgen workload strata —
+  shared-prefix, multi-turn, background, and the open-loop bursty
+  arrival process (:func:`fusioninfer_tpu.benchmark.loadgen.poisson_arrivals`),
+* the real :class:`~fusioninfer_tpu.autoscale.controller.AutoscaleController`
+  scrapes those engines' ``/metrics`` and scales the role mid-run,
+* the PR 1 :class:`~fusioninfer_tpu.resilience.FaultInjector` kills a
+  slice mid-decode, partitions the metrics relay, and corrupts a KV
+  transfer — while the harness asserts fleet-level SLOs as first-class
+  outcomes (zero lost streams, bounded TTFT during scale-up, residency
+  re-convergence after an engine death).
+
+The run emits a ``FLEET_r0N.json`` evidence record
+(:mod:`fusioninfer_tpu.fleetsim.record`) gated by
+``tools/check_fleet_record.py``, and its event ledger is deterministic
+under a fixed seed (``tests/test_fleetsim.py``).
+"""
+
+from fusioninfer_tpu.fleetsim.harness import FleetConfig, FleetHarness, run_fleet
+from fusioninfer_tpu.fleetsim.record import FLEET_SCHEMA_VERSION
+
+__all__ = ["FleetConfig", "FleetHarness", "run_fleet",
+           "FLEET_SCHEMA_VERSION"]

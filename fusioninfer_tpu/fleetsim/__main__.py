@@ -1,0 +1,48 @@
+"""CLI: ``python -m fusioninfer_tpu.fleetsim [--out FLEET_OUT.json]``.
+
+Runs the CPU-sized fleet smoke (3 engines peak, ~a minute) and writes
+the FLEET evidence record; ``make fleet-smoke`` pairs it with
+``tools/check_fleet_record.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from fusioninfer_tpu.fleetsim.harness import FleetConfig, run_fleet
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="FLEET_OUT.json",
+                        help="record path (default FLEET_OUT.json)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--pd", action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="include the PD-disaggregated service "
+                             "(smoke default: on; FleetConfig's API "
+                             "default is off — tests run the trimmed "
+                             "worker-only fleet)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    cfg = FleetConfig(seed=args.seed, pd_enabled=args.pd)
+    record = run_fleet(cfg, out_path=args.out)
+    print(json.dumps({
+        "out": args.out,
+        "duration_s": record["duration_s"],
+        "scale_events": record["scale_events"],
+        "slo": record["slo"],
+    }, indent=1))
+    slo = record["slo"]
+    return 0 if (slo["lost_streams"] == 0
+                 and slo["corrupted_streams"] == 0) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Fleet-side HTTP client: routed streaming with retry + integrity.
+
+The harness's requests go through the real
+:class:`~fusioninfer_tpu.router.picker.EndpointPicker` and then over
+real HTTP to the chosen engine — the path a gateway data plane takes.
+What a raw load generator cannot do, this client must:
+
+* **retry a broken stream on another endpoint.**  A slice dying
+  mid-decode breaks the stream; the fleet-level SLO is that the CLIENT
+  still gets its completion — the picker's circuit breaker eats the
+  corpse (``report_result(ok=False)`` per failure) and the retry lands
+  on a survivor.  A request is **lost** only when every attempt fails.
+* **verify stream integrity.**  Greedy (``temperature=0``) completions
+  of the same prompt must produce the same raw token-id stream no
+  matter which engine served them, whether the prefix came from HBM,
+  the host tier, a PD pull, or a post-fault recompute — the longest
+  completed id stream per prompt is the reference and every other run
+  must be prefix-consistent with it, so a corrupt KV frame that escaped
+  its CRC lands here as a **corrupted** stream even when the flipped
+  ids decode to identical text (fallback tokenizers decode lossily).
+* **measure fleet TTFT.**  ``ttft_s`` runs from the ORIGINAL submit to
+  the first token of the attempt that succeeded — retries are not free,
+  and hiding them would flatter every fault phase.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Optional
+
+from fusioninfer_tpu.benchmark.loadgen import _classify
+
+DEFAULT_TIMEOUT_S = 30.0
+
+
+def stream_completion(
+    url: str, prompt: str, max_tokens: int, timeout_s: float, seed: int,
+    temperature: float = 0.0,
+    on_first_chunk: Optional[Callable[[], None]] = None,
+) -> tuple[Optional[float], Optional[float], list, Optional[str],
+           Optional[str]]:
+    """One streaming completion against ``url`` →
+    ``(ttft_s, tpot_s, token_ids, finish_reason, error_kind)``.
+
+    Integrity rides the RAW ``token_id`` stream (the server's additive
+    per-chunk field), not decoded text: fallback tokenizers decode
+    lossily (ByteTokenizer drops non-byte ids), so two different token
+    streams can render identical text.
+
+    A stream that ends without a terminal ``finish_reason`` (the socket
+    closed under a dying engine) reports ``truncated_stream``; an
+    ``error:*`` finish reason (the engine failed the request explicitly)
+    reports as that error — both are FAILED attempts to the caller.
+    """
+    body = json.dumps({
+        "prompt": prompt, "max_tokens": max_tokens,
+        "temperature": temperature, "seed": seed, "stream": True,
+    }).encode()
+    req = urllib.request.Request(
+        f"{url}/v1/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    first = last = None
+    n_chunks = 0
+    ids: list = []
+    finish: Optional[str] = None
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                choice = (json.loads(payload).get("choices") or [{}])[0]
+                now = time.perf_counter()
+                if first is None:
+                    first = now
+                    if on_first_chunk is not None:
+                        on_first_chunk()
+                last = now
+                n_chunks += 1
+                if choice.get("token_id") is not None:
+                    ids.append(choice["token_id"])
+                if choice.get("finish_reason"):
+                    finish = choice["finish_reason"]
+    except Exception as e:
+        return None, None, ids, finish, _classify(e)
+    if finish is None:
+        return None, None, ids, None, "truncated_stream"
+    if finish.startswith("error"):
+        return None, None, ids, finish, finish
+    ttft = (first - t0) if first is not None else None
+    tpot = ((last - first) / (n_chunks - 1)
+            if first is not None and n_chunks > 1 else None)
+    return ttft, tpot, ids, finish, None
+
+
+class FleetClient:
+    """Routes requests through the picker, retries failures across the
+    fleet, and keeps the run's per-request result log (the record's raw
+    material).  Thread-safe: stratum drivers call :meth:`request` from
+    worker threads."""
+
+    def __init__(self, picker, profile: str = "default",
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 max_attempts: int = 4, retry_pause_s: float = 0.05):
+        self._picker = picker
+        self._profile = profile
+        self.timeout_s = timeout_s
+        self.max_attempts = max_attempts
+        self.retry_pause_s = retry_pause_s
+        # guards results/greedy refs (stratum worker threads share them)
+        self._lock = threading.Lock()
+        self.results: list[dict] = []
+        # prompt -> longest greedy token-id stream seen (the integrity
+        # reference; shorter/longer runs must be prefix-consistent)
+        self._greedy_ref: dict[str, list] = {}
+
+    # -- issuing --
+
+    def request(self, prompt: str, max_tokens: int, stratum: str,
+                phase: str, seed: int = 0, temperature: float = 0.0,
+                on_first_chunk: Optional[Callable[[], None]] = None,
+                pick=None) -> dict:
+        """One logical fleet request; returns (and logs) its result row.
+        ``pick`` overrides endpoint selection (the PD pair path passes
+        a pre-picked leg)."""
+        t_submit = time.perf_counter()
+        attempts = 0
+        endpoints: list[str] = []
+        row = {"phase": phase, "stratum": stratum, "ok": False,
+               "lost": False, "corrupted": False, "ttft_s": None,
+               "tpot_s": None, "endpoint": None, "attempts": 0}
+        while attempts < self.max_attempts:
+            attempts += 1
+            ep = pick() if pick is not None else self._picker.pick(
+                prompt, self._profile)
+            if ep is None:
+                time.sleep(self.retry_pause_s)
+                continue
+            endpoints.append(ep.name)
+            t_attempt = time.perf_counter()
+            ttft, tpot, ids, finish, err = stream_completion(
+                ep.url, prompt, max_tokens, self.timeout_s, seed,
+                temperature, on_first_chunk)
+            ok = err is None and finish in ("length", "stop")
+            if pick is None:
+                # only the picker that chose the endpoint learns the
+                # outcome — a ``pick`` override (warmups, pinned fault
+                # probes, the PD leg) must not pollute the worker
+                # picker's breakers with endpoints it never selected
+                self._picker.report_result(ep, ok)
+            if not ok:
+                time.sleep(self.retry_pause_s)
+                continue
+            row.update(ok=True, endpoint=ep.name, tpot_s=tpot)
+            if ttft is not None:
+                # fleet TTFT runs from the ORIGINAL submit: failed
+                # attempts' time is part of what the user waited
+                row["ttft_s"] = (t_attempt - t_submit) + ttft
+            if temperature == 0.0 and ids:
+                # greedy determinism is PREFIX consistency on raw ids:
+                # the same prompt at a different max_tokens must extend
+                # (or be extended by) the reference stream — so requests
+                # of different lengths compose, and a corrupt KV frame
+                # that flips a generated token lands here even when the
+                # flipped ids decode to identical text
+                with self._lock:
+                    ref = self._greedy_ref.setdefault(prompt, ids)
+                    n = min(len(ref), len(ids))
+                    if ids[:n] != ref[:n]:
+                        row["corrupted"] = True
+                    elif len(ids) > len(ref):
+                        self._greedy_ref[prompt] = ids
+            break
+        else:
+            row["lost"] = True
+        row["attempts"] = attempts
+        row["endpoints"] = endpoints
+        with self._lock:
+            self.results.append(row)
+        return row
+
+    # -- accounting --
+
+    def rows(self, phase: Optional[str] = None) -> list[dict]:
+        with self._lock:
+            rows = list(self.results)
+        return [r for r in rows if phase is None or r["phase"] == phase]
+
+    def lost_streams(self) -> int:
+        return sum(1 for r in self.rows() if r["lost"])
+
+    def corrupted_streams(self) -> int:
+        return sum(1 for r in self.rows() if r["corrupted"])

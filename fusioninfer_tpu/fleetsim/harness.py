@@ -1,0 +1,964 @@
+"""The closed-loop fleet harness (docs/design/fleet-sim.md).
+
+One :class:`FleetHarness` run is a scripted day-in-the-life of a fleet,
+executed by the REAL subsystems (manager, podsim engines, EPP picker in
+residency mode, autoscale controller, fault injectors) against live
+HTTP, in five phases:
+
+``steady``
+    Shared-prefix + multi-turn + background traffic warms the fleet;
+    the pre-fault prefix hit rate is measured here.
+``scale_up``
+    The open-loop bursty stratum (:func:`poisson_arrivals`) builds real
+    queue depth while interactive traffic continues; the autoscale
+    controller — ticked with an injected manual clock, scraping the
+    engines' real ``/metrics`` — scales the role up; interactive TTFT
+    p90 must stay under the recorded bound.
+``faults``
+    The metrics relay partitions (the controller must hold, not scale
+    on fiction); a host-tier KV frame is corrupted (CRC must catch it
+    and the stream recompute, byte-identical); a slice dies mid-decode
+    (the broken stream must complete on a survivor, breaker ejection
+    beating the client timeout), and the dead group respawns cold.
+``recover``
+    Steady-shaped traffic again; the residency-routed hit rate must
+    recover to within the configured fraction of its pre-fault value.
+``drain``
+    The manual clock leaves the scale-down stabilization window; the
+    controller begins a drain (the picker drops the victim from
+    residency routing immediately — no repeat-prefix request may chase
+    it), polls the victims idle, and applies the shrink.
+
+Determinism: all prompt content, arrival schedules and fault schedules
+are seeded; the run's **event ledger** (phase request counts, scale
+events, fault firings, kill/respawn) is identical across two runs with
+the same seed (``tests/test_fleetsim.py``).  Latency numbers are wall
+time and of course vary — they live in the record, not the ledger.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from fusioninfer_tpu.autoscale.collector import MetricsCollector, http_fetch
+from fusioninfer_tpu.autoscale.controller import (
+    AutoscaleController,
+    lws_drain_marker,
+)
+from fusioninfer_tpu.benchmark.loadgen import poisson_arrivals, random_prompt
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.fleetsim.client import FleetClient
+from fusioninfer_tpu.fleetsim.record import (
+    build_record,
+    pcts_ms,
+    phase_summary,
+    write_record,
+)
+from fusioninfer_tpu.operator.apiserver import HTTPApiServer
+from fusioninfer_tpu.operator.kubeclient import KubeClient, KubeConfig
+from fusioninfer_tpu.operator.manager import Manager
+from fusioninfer_tpu.operator.podsim import PORT_ANNOTATION, LWSSimulator
+from fusioninfer_tpu.resilience import FaultInjector
+from fusioninfer_tpu.router.picker import (
+    Endpoint,
+    EndpointHealth,
+    EndpointPicker,
+    ResidencyProvider,
+)
+from fusioninfer_tpu.workload.labels import (
+    LABEL_SERVICE,
+    LWS_WORKER_INDEX_LABEL,
+)
+from fusioninfer_tpu.workload.lws import generate_lws_name
+
+logger = logging.getLogger("fusioninfer.fleetsim")
+
+TEMPLATE = {"spec": {"containers": [{"name": "engine", "image": "native"}]}}
+
+# prefix affinity dominates (warm chains stick), queue position breaks
+# ties (cold/unique prompts spread) — the composite a production EPP
+# would run for shared-prompt traffic
+EPP_CONFIG = """
+apiVersion: inference.networking.x-k8s.io/v1alpha1
+kind: EndpointPickerConfig
+plugins:
+- type: prefix-cache-scorer
+  parameters:
+    hashBlockSize: 16
+    maxPrefixBlocksToMatch: 64
+    lruCapacityPerServer: 4096
+- type: queue-scorer
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: prefix-cache-scorer
+    weight: 70
+  - pluginRef: queue-scorer
+    weight: 30
+  - pluginRef: max-score-picker
+"""
+
+
+class ManualClock:
+    """The controller's injected clock: the harness advances it
+    explicitly, so stabilization windows and staleness are script
+    decisions, not wall-time races — the same fake-clock discipline the
+    autoscale unit suite uses, driven here around REAL engines."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self._lock = threading.Lock()
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self._now
+
+    def advance(self, dt: float) -> None:
+        with self._lock:
+            self._now += dt
+
+
+@dataclass
+class FleetConfig:
+    """Knobs for one fleet run.  The defaults are the CPU smoke shape
+    (3 engines peak, ~a minute); tests shrink the traffic, the evidence
+    run is committed as ``FLEET_r0N.json``."""
+
+    seed: int = 0
+    service_name: str = "fleet"
+    role_name: str = "worker"
+    namespace: str = "default"
+    min_replicas: int = 2
+    max_replicas: int = 3
+    target_queue_length: float = 0.5
+    scale_down_stabilization_s: float = 45.0
+    drain_deadline_s: float = 60.0
+    # engine shape (per podsim group)
+    engine_pages: int = 96
+    engine_page_size: int = 8
+    engine_max_pages_per_seq: int = 32
+    engine_batch: int = 4
+    # traffic shape
+    n_system_prompts: int = 2
+    system_prompt_len: int = 120
+    tail_len: int = 8
+    output_len: int = 4
+    warm_rounds: int = 3
+    multiturn_turns: int = 2
+    background_per_phase: int = 2
+    concurrency: int = 3
+    # open-loop burst (scale_up phase)
+    burst_requests: int = 12
+    burst_rate_rps: float = 8.0
+    burst_factor: float = 4.0
+    burst_output_len: int = 24
+    scaleup_interactive: int = 4
+    # faults
+    slice_output_len: int = 24
+    eviction_prompts: int = 5
+    eviction_prompt_len: int = 180
+    # SLO bounds (recorded in the FLEET artifact)
+    ttft_p90_bound_s: float = 15.0
+    hit_rate_recovery_frac: float = 0.8
+    # client
+    client_timeout_s: float = 30.0
+    client_max_attempts: int = 5
+    # optional PD-disaggregated service riding the same fleet
+    pd_enabled: bool = False
+    pd_requests: int = 2
+    # plumbing
+    tick_advance_s: float = 0.2
+    tick_pause_s: float = 0.1
+    max_ticks: int = 300
+    boot_timeout_s: float = 60.0
+
+
+def _wait_for(pred: Callable[[], bool], timeout: float,
+              interval: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _scrape_prefix_counters(url: str, timeout: float = 5.0) -> Optional[dict]:
+    """(query_tokens, hit_tokens) counters off one engine's /metrics."""
+    import urllib.request
+
+    out = {}
+    try:
+        with urllib.request.urlopen(f"{url}/metrics",
+                                    timeout=timeout) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                for key, prefix in (
+                        ("query", "fusioninfer:prefix_query_tokens_total"),
+                        ("hit", "fusioninfer:prefix_hit_tokens_total"),
+                        ("crc_dropped",
+                         "fusioninfer:kv_host_corrupt_dropped_total")):
+                    if line.startswith(prefix + "{"):
+                        out[key] = float(line.rsplit(" ", 1)[-1])
+    except Exception:
+        return None
+    return out or None
+
+
+class FleetHarness:
+    """Boots the fleet, runs the phases, emits the record.  Use as a
+    context manager or call :meth:`close` — engines, manager and API
+    server are real and must be torn down."""
+
+    def __init__(self, cfg: Optional[FleetConfig] = None):
+        self.cfg = cfg or FleetConfig()
+        self.ledger: list[str] = []
+        self.scale_events: list[dict] = []
+        self.fault_ledger: list[dict] = []
+        self.hit_rates: dict[str, Optional[float]] = {}
+        self.clock = ManualClock()
+        # guards injectors (factory runs on the podsim thread) and the
+        # metrics-relay partition set (collector fetch runs on the
+        # controller tick; the harness arms/heals from the main thread)
+        self._lock = threading.Lock()
+        self.injectors: dict[str, FaultInjector] = {}
+        self._partitioned_urls: set[str] = set()
+        self._counter_base: dict[str, dict] = {}
+        self._booted = False
+        self._slo_extra: dict = {}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def __enter__(self) -> "FleetHarness":
+        self.boot()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def boot(self) -> None:
+        # __exit__ never runs when __enter__ raises: a partial boot
+        # (endpoints never came up) must tear down what it started or
+        # reconciler/engine threads and bound ports outlive the failure
+        try:
+            self._boot()
+        except BaseException:
+            self.close()
+            raise
+
+    def _boot(self) -> None:
+        cfg = self.cfg
+        self.api = HTTPApiServer(token="fleet").start()
+        self.kube = KubeClient(KubeConfig(self.api.url, token="fleet"))
+        self.manager = Manager(self.kube, namespace=cfg.namespace,
+                               probe_port=0, metrics_port=0)
+        self.manager.start()
+        self.sim = LWSSimulator(self.kube, namespace=cfg.namespace,
+                                engine_factory=self._engine_factory)
+        self.sim.start()
+        self.kube.create(self._service_manifest())
+        if not _wait_for(lambda: len(self._worker_endpoints())
+                         >= cfg.min_replicas, cfg.boot_timeout_s):
+            raise RuntimeError("fleet boot: worker endpoints never came up")
+        cm = self.kube.get("ConfigMap", cfg.namespace,
+                           f"{cfg.service_name}-router-epp-config")
+        self.residency = ResidencyProvider(ttl_s=0.3, max_age_s=5.0)
+        self.picker = EndpointPicker(
+            cm["data"]["config.yaml"], self._worker_endpoints,
+            health=EndpointHealth(failure_threshold=3,
+                                  recovery_timeout_s=2.0),
+            residency=self.residency)
+        self.client = FleetClient(
+            self.picker, timeout_s=cfg.client_timeout_s,
+            max_attempts=cfg.client_max_attempts)
+        self.controller = AutoscaleController(
+            self.kube, namespace=cfg.namespace,
+            collector=MetricsCollector(fetch=self._relay_fetch,
+                                       clock=self.clock),
+            endpoints_for=self._endpoints_for, clock=self.clock,
+            mark_draining=self._mark_draining,
+            on_event=self._on_scale_event)
+        self.pd_picker = None
+        if cfg.pd_enabled:
+            self.kube.create(self._pd_manifest())
+            if not _wait_for(lambda: len(self._pd_pods()) >= 2,
+                             cfg.boot_timeout_s):
+                raise RuntimeError("fleet boot: PD endpoints never came up")
+            pd_cm = self.kube.get("ConfigMap", cfg.namespace,
+                                  f"{cfg.service_name}-pd-router-epp-config")
+            self.pd_picker = EndpointPicker(pd_cm["data"]["config.yaml"],
+                                            self._pd_pods)
+        # absorb first-request compile cost per engine OUTSIDE the
+        # measured phases (a fixed, ledgered warmup per boot)
+        self._warmup_all("boot")
+        self._note(
+            f"boot engines={len(self._worker_endpoints())}"
+            + (" pd=2" if cfg.pd_enabled else ""))
+        self._booted = True
+
+    def close(self) -> None:
+        for obj in ("sim", "manager", "api"):
+            target = getattr(self, obj, None)
+            if target is None:
+                continue
+            try:
+                target.stop()
+            except Exception:
+                logger.exception("fleet teardown of %s failed", obj)
+
+    # -- wiring --------------------------------------------------------
+
+    def _engine_factory(self, prefill_upstream: Optional[str],
+                        lws_name: str = ""):
+        """A real EngineServer per podsim group: tiny model, prefix
+        caching + host tier, a per-group seeded FaultInjector keyed by
+        the LWS name (stable across respawns, so a replacement engine's
+        chaos schedule is deterministic too)."""
+        import zlib
+
+        from fusioninfer_tpu.engine.engine import NativeEngine
+        from fusioninfer_tpu.engine.kv_host_tier import HostKVTier
+        from fusioninfer_tpu.engine.server import EngineServer
+        from fusioninfer_tpu.models.config import get_preset
+
+        cfg = self.cfg
+        inj = FaultInjector(
+            seed=cfg.seed * 1000 + zlib.crc32(lws_name.encode()) % 997)
+        with self._lock:
+            self.injectors[lws_name] = inj
+        model_cfg = dataclasses.replace(get_preset("qwen3-tiny"),
+                                        attn_impl="reference")
+        cache = CacheConfig(n_pages=cfg.engine_pages,
+                            page_size=cfg.engine_page_size,
+                            max_pages_per_seq=cfg.engine_max_pages_per_seq)
+        engine = NativeEngine(
+            model_cfg, cache_cfg=cache, max_batch_size=cfg.engine_batch,
+            host_kv_tier=HostKVTier(fault_injector=inj,
+                                    async_offload=False))
+        return EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                            engine=engine,
+                            prefill_upstream=prefill_upstream,
+                            kv_fault_injector=inj)
+
+    def _service_manifest(self) -> dict:
+        cfg = self.cfg
+        return {
+            "apiVersion": "fusioninfer.io/v1alpha1",
+            "kind": "InferenceService",
+            "metadata": {"name": cfg.service_name,
+                         "namespace": cfg.namespace, "generation": 1},
+            "spec": {"roles": [
+                {"name": "router", "componentType": "router",
+                 "endpointPickerConfig": EPP_CONFIG},
+                {"name": cfg.role_name, "componentType": "worker",
+                 "replicas": cfg.min_replicas, "template": TEMPLATE,
+                 "autoscaling": {
+                     "minReplicas": cfg.min_replicas,
+                     "maxReplicas": cfg.max_replicas,
+                     "targets": {"queueLength": cfg.target_queue_length},
+                     "scaleUpStabilizationSeconds": 0,
+                     "scaleDownStabilizationSeconds":
+                         cfg.scale_down_stabilization_s,
+                     "drainDeadlineSeconds": cfg.drain_deadline_s,
+                 }},
+            ]},
+        }
+
+    def _pd_manifest(self) -> dict:
+        cfg = self.cfg
+        return {
+            "apiVersion": "fusioninfer.io/v1alpha1",
+            "kind": "InferenceService",
+            "metadata": {"name": f"{cfg.service_name}-pd",
+                         "namespace": cfg.namespace, "generation": 1},
+            "spec": {"roles": [
+                {"name": "router", "componentType": "router",
+                 "strategy": "pd-disaggregation"},
+                {"name": "prefiller", "componentType": "prefiller",
+                 "replicas": 1, "template": TEMPLATE},
+                {"name": "decoder", "componentType": "decoder",
+                 "replicas": 1, "template": TEMPLATE},
+            ]},
+        }
+
+    def _pods(self, service: str) -> list[Endpoint]:
+        out = []
+        for pod in self.kube.list("Pod", self.cfg.namespace):
+            meta = pod["metadata"]
+            labels = meta.get("labels") or {}
+            if labels.get(LWS_WORKER_INDEX_LABEL) != "0":
+                continue
+            if labels.get(LABEL_SERVICE) != service:
+                continue
+            port = (meta.get("annotations") or {}).get(PORT_ANNOTATION)
+            if port:
+                out.append(Endpoint(meta["name"],
+                                    f"http://127.0.0.1:{port}", labels))
+        return out
+
+    def _worker_endpoints(self) -> list[Endpoint]:
+        return self._pods(self.cfg.service_name)
+
+    def _pd_pods(self) -> list[Endpoint]:
+        return self._pods(f"{self.cfg.service_name}-pd")
+
+    def _endpoints_for(self, svc, role) -> list[tuple[str, str]]:
+        """The controller's replica-index-ordered endpoint view, mapped
+        to podsim's localhost ports (production resolves LWS DNS names
+        instead; index order is the drain-victim contract)."""
+        out = []
+        for i in range(role.replicas):
+            name = generate_lws_name(svc.name, role.name, i)
+            pod = self.kube.get_or_none("Pod", self.cfg.namespace,
+                                        f"{name}-0")
+            port = ((pod or {}).get("metadata") or {}
+                    ).get("annotations", {}).get(PORT_ANNOTATION)
+            # a not-yet-provisioned replica scrapes as down (port 9 is
+            # discard): the collector's breaker carries it
+            out.append((name, f"http://127.0.0.1:{port or 9}"))
+        return out
+
+    def _relay_fetch(self, url: str) -> str:
+        """The autoscaler's metrics relay, with a partition lever: a
+        partitioned URL raises exactly the way a dropped link would."""
+        with self._lock:
+            if url in self._partitioned_urls:
+                raise OSError(f"metrics relay partitioned: {url}")
+        return http_fetch(url)
+
+    def _mark_draining(self, name: str, draining: bool) -> None:
+        """The drain protocol's routing hook: the LWS label (the
+        cross-process signal) AND the in-process picker, whose
+        set_draining also drops the victim from residency routing."""
+        lws_drain_marker(self.kube, self.cfg.namespace)(name, draining)
+        self.picker.set_draining(f"{name}-0", draining)
+
+    def _note(self, entry: str) -> None:
+        """Append one deterministic event-ledger line (locked: scale
+        events may arrive from a controller running off-thread)."""
+        with self._lock:
+            self.ledger.append(entry)
+
+    def _fault(self, entry: dict) -> None:
+        with self._lock:
+            self.fault_ledger.append(entry)
+
+    def _events(self) -> list[dict]:
+        with self._lock:
+            return list(self.scale_events)
+
+    def _on_scale_event(self, kind: str, role: str, frm: int,
+                        to: int) -> None:
+        event = {"kind": kind, "role": role, "from": frm, "to": to}
+        if kind == "drain":
+            key = (self.cfg.namespace, self.cfg.service_name, role)
+            state = self.controller.drainer.active(key)
+            if state is not None:
+                event["victims"] = [n for n, _ in state.victims]
+        with self._lock:
+            self.scale_events.append(event)
+        suffix = (f" victims={','.join(event['victims'])}"
+                  if event.get("victims") else "")
+        self._note(f"scale:{kind} {role} {frm}->{to}{suffix}")
+
+    def _tick(self) -> None:
+        self.clock.advance(self.cfg.tick_advance_s)
+        self.controller.step()
+
+    # -- traffic -------------------------------------------------------
+
+    def _prompt_base(self) -> int:
+        # far from loadgen's own seed spaces so a fleet run and a bench
+        # run with the same seed never share prompt content
+        return 11 * 10**8 + self.cfg.seed * 10**7
+
+    def _systems(self) -> list[str]:
+        return [random_prompt(self.cfg.system_prompt_len,
+                              self._prompt_base() + i)
+                for i in range(self.cfg.n_system_prompts)]
+
+    def _tail(self, slot: int) -> str:
+        return random_prompt(self.cfg.tail_len,
+                             self._prompt_base() + 5 * 10**6 + slot)
+
+    def _steady_sessions(self, tail_offset: int) -> list[tuple[str, list[str]]]:
+        """The steady/recover item set: warm repeats of each system
+        prompt, one multi-turn session per system, unique background."""
+        cfg = self.cfg
+        systems = self._systems()
+        sessions: list[tuple[str, list[str]]] = []
+        for i, sys_p in enumerate(systems):
+            base = sys_p + self._tail(i)  # the cold-round prompt, reused warm
+            for _ in range(cfg.warm_rounds):
+                sessions.append(("sharedprefix", [base]))
+            turns, p = [], sys_p
+            for t in range(cfg.multiturn_turns):
+                p = p + self._tail(100 + tail_offset + 10 * i + t)
+                turns.append(p)
+            sessions.append(("multiturn", turns))
+        for b in range(cfg.background_per_phase):
+            sessions.append(("background", [random_prompt(
+                cfg.system_prompt_len + cfg.tail_len,
+                self._prompt_base() + 8 * 10**6 + tail_offset + b)]))
+        return sessions
+
+    def _drive_sessions(self, phase: str,
+                        sessions: list[tuple[str, list[str]]],
+                        concurrency: int, seed_off: int = 0) -> None:
+        """Closed-loop: ``concurrency`` workers drain the session list;
+        a session's turns run sequentially inside one worker."""
+        it = iter(enumerate(sessions))
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    nxt = next(it, None)
+                if nxt is None:
+                    return
+                i, (stratum, prompts) = nxt
+                for turn, prompt in enumerate(prompts):
+                    self.client.request(
+                        prompt, self.cfg.output_len, stratum, phase,
+                        seed=self.cfg.seed + seed_off + 31 * i + turn)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _cold_round(self, phase: str) -> None:
+        systems = self._systems()
+        sessions = [("sharedprefix", [sys_p + self._tail(i)])
+                    for i, sys_p in enumerate(systems)]
+        self._drive_sessions(phase, sessions, len(sessions))
+
+    def _warmup_all(self, phase: str) -> None:
+        """One direct request per live engine — absorbs jit compile."""
+        for ep in sorted(self._worker_endpoints(), key=lambda e: e.name):
+            self.client.request(
+                f"warmup {ep.name}", 2, "warmup", phase,
+                pick=lambda ep=ep: ep)
+        if self.pd_picker is not None:
+            for ep in sorted(self._pd_pods(), key=lambda e: e.name):
+                self.client.request(
+                    f"warmup {ep.name}", 2, "warmup", phase,
+                    pick=lambda ep=ep: ep)
+
+    # -- hit-rate windows ---------------------------------------------
+
+    def _counter_snapshot(self) -> dict[str, dict]:
+        out = {}
+        for ep in self._worker_endpoints():
+            c = _scrape_prefix_counters(ep.url)
+            if c is not None:
+                out[ep.name] = c
+        return out
+
+    def _window_hit_rate(self, before: dict, after: dict) -> Optional[float]:
+        dq = dh = 0.0
+        for name, cur in after.items():
+            prev = before.get(name, {})
+            # a respawned engine restarts its counters: a backwards
+            # counter means fresh process — delta from zero
+            pq, ph = prev.get("query", 0.0), prev.get("hit", 0.0)
+            if cur.get("query", 0.0) < pq:
+                pq = ph = 0.0
+            dq += max(0.0, cur.get("query", 0.0) - pq)
+            dh += max(0.0, cur.get("hit", 0.0) - ph)
+        return (dh / dq) if dq > 0 else None
+
+    # -- phases --------------------------------------------------------
+
+    def run(self, out_path: Optional[str] = None) -> dict:
+        """Execute the five phases and build (optionally write) the
+        FLEET record."""
+        if not self._booted:
+            self.boot()
+        t0 = time.perf_counter()
+        self._phase_steady()
+        self._phase_scale_up()
+        self._phase_faults()
+        self._phase_recover()
+        self._phase_drain()
+        record = self._build(time.perf_counter() - t0)
+        if out_path:
+            write_record(record, out_path)
+        return record
+
+    def _phase_end(self, phase: str) -> None:
+        rows = self.client.rows(phase)
+        self._note(f"phase:{phase} requests={len(rows)}")
+
+    def _phase_steady(self) -> None:
+        base = self._counter_snapshot()
+        self._cold_round("steady")
+        self._drive_sessions("steady", self._steady_sessions(0),
+                             self.cfg.concurrency, seed_off=100)
+        if self.pd_picker is not None:
+            for i in range(self.cfg.pd_requests):
+                prompt = random_prompt(48, self._prompt_base()
+                                       + 6 * 10**6 + i)
+                self.client.request(
+                    prompt, self.cfg.output_len, "pd", "steady",
+                    pick=lambda p=prompt: self.pd_picker.pick(p, "decode"))
+        rate = self._window_hit_rate(base, self._counter_snapshot())
+        with self._lock:
+            self.hit_rates["steady"] = rate
+        self._phase_end("steady")
+
+    def _phase_scale_up(self) -> None:
+        cfg = self.cfg
+        phase = "scale_up"
+        arrivals = poisson_arrivals(cfg.burst_requests, cfg.burst_rate_rps,
+                                    cfg.seed + 900,
+                                    burst_factor=cfg.burst_factor)
+        burst_prompts = [random_prompt(
+            cfg.system_prompt_len, self._prompt_base() + 9 * 10**6 + i)
+            for i in range(cfg.burst_requests)]
+
+        def fire(i: int) -> None:
+            self.client.request(burst_prompts[i], cfg.burst_output_len,
+                                "bursty", phase, seed=cfg.seed + 900 + i)
+
+        from fusioninfer_tpu.benchmark.loadgen import fire_open_loop
+
+        burst_t = threading.Thread(target=fire_open_loop,
+                                   args=(arrivals, fire), daemon=True)
+        systems = self._systems()
+        inter = [("sharedprefix", [systems[i % len(systems)]
+                                   + self._tail(200 + i)])
+                 for i in range(cfg.scaleup_interactive)]
+        inter_t = threading.Thread(
+            target=self._drive_sessions, args=(phase, inter, 2, 900),
+            daemon=True)
+        burst_t.start()
+        inter_t.start()
+        ticks = 0
+        while ticks < cfg.max_ticks:
+            self._tick()
+            ticks += 1
+            if any(e["kind"] == "up" for e in self._events()):
+                break
+            time.sleep(cfg.tick_pause_s)
+        burst_t.join()
+        inter_t.join()
+        # the bought replica must come up before the fault phase kills
+        # things — scale-up that never materializes is a failed run
+        if any(e["kind"] == "up" for e in self._events()):
+            target = max(e["to"] for e in self._events()
+                         if e["kind"] == "up")
+            _wait_for(lambda: len(self._worker_endpoints()) >= target,
+                      cfg.boot_timeout_s)
+            self._warmup_all(phase)
+        self._phase_end(phase)
+
+    def _phase_faults(self) -> None:
+        cfg = self.cfg
+        phase = "faults"
+        # 1) metrics-relay partition: the controller must HOLD on stale
+        # + missing signals, not scale on fiction
+        svc = self.kube.get("InferenceService", cfg.namespace,
+                            cfg.service_name)
+        from fusioninfer_tpu.api.types import InferenceService
+
+        role = next(r for r in InferenceService.from_dict(
+            svc).spec.worker_roles() if r.name == cfg.role_name)
+        pairs = self._endpoints_for(
+            InferenceService.from_dict(svc), role)
+        part_name, part_url = pairs[min(1, len(pairs) - 1)]
+        with self._lock:
+            self._partitioned_urls.add(part_url)
+        n_events = len(self._events())
+        self._tick()
+        held = len(self._events()) == n_events
+        with self._lock:
+            self._partitioned_urls.discard(part_url)
+        self._fault({
+            "fault": "metrics_partition", "endpoint": part_name,
+            "controller_held": held})
+        self._note(
+            f"fault:metrics_partition endpoint={part_name} "
+            f"held={int(held)}")
+
+        # 2) KV-transfer corruption: a host-tier frame is corrupted on
+        # offload; CRC must reject it at restore and the stream must
+        # recompute byte-identically
+        self._fault_kv_corrupt(phase)
+
+        # 3) slice loss mid-decode: kill the warm engine while a stream
+        # is in flight; the stream must complete on a survivor
+        self._fault_slice_loss(phase)
+        self._phase_end(phase)
+
+    def _fault_kv_corrupt(self, phase: str) -> None:
+        cfg = self.cfg
+        eps = sorted(self._worker_endpoints(), key=lambda e: e.name)
+        target = eps[min(1, len(eps) - 1)]
+        lws = target.name[:-2]  # pod "<lws>-0" -> lws name
+        with self._lock:
+            inj = self.injectors[lws]
+        # seed the probe chain, then corrupt EVERY offload while
+        # eviction pressure pushes it (and everything older) to the
+        # host tier — the probe's own frames are guaranteed poisoned
+        probe = random_prompt(cfg.eviction_prompt_len,
+                              self._prompt_base() + 7 * 10**6)
+        self.client.request(probe, cfg.output_len, "kv_corrupt", phase,
+                            seed=cfg.seed + 700,
+                            pick=lambda: target)
+        inj.arm("kv.host.offload.data", "corrupt")
+        for i in range(cfg.eviction_prompts):
+            filler = random_prompt(cfg.eviction_prompt_len,
+                                   self._prompt_base() + 7 * 10**6 + 1 + i)
+            self.client.request(filler, cfg.output_len, "kv_corrupt",
+                                phase, seed=cfg.seed + 701 + i,
+                                pick=lambda: target)
+        snap = inj.snapshot().get("kv.host.offload.data", {})
+        inj.disarm("kv.host.offload.data")
+        # the re-request consults the host tier, CRC-rejects the
+        # poisoned frame, and recomputes — the text must match attempt 1
+        self.client.request(probe, cfg.output_len, "kv_corrupt", phase,
+                            seed=cfg.seed + 700, pick=lambda: target)
+        counters = _scrape_prefix_counters(target.url) or {}
+        self._fault({
+            "fault": "kv_transfer_corrupt", "engine": lws,
+            "site": "kv.host.offload.data",
+            "fired": snap.get("fired", 0),
+            "crc_dropped": counters.get("crc_dropped", 0.0)})
+        # fired COUNT depends on how much of the pre-fault working set
+        # was still resident (wall-time-dependent), so the deterministic
+        # ledger records only that the fault fired; exact counts live in
+        # the record's fault_ledger
+        self._note(
+            f"fault:kv_corrupt engine={lws} "
+            f"fired={int(snap.get('fired', 0) > 0)}")
+        if self.pd_picker is not None:
+            self._fault_pd_pull_corrupt(phase)
+
+    def _fault_pd_pull_corrupt(self, phase: str) -> None:
+        """PD leg: corrupt the decoder's prefill pull once — the CRC
+        rejects the slab and the retrying pull recovers the stream."""
+        cfg = self.cfg
+        dec_lws = generate_lws_name(f"{cfg.service_name}-pd", "decoder", 0)
+        with self._lock:
+            inj = self.injectors.get(dec_lws)
+        if inj is None:
+            return
+        inj.arm("kv.pull.response", "corrupt", times=1)
+        prompt = random_prompt(48, self._prompt_base() + 6 * 10**6 + 50)
+        self.client.request(
+            prompt, cfg.output_len, "pd", phase, seed=cfg.seed + 650,
+            pick=lambda: self.pd_picker.pick(prompt, "decode"))
+        snap = inj.snapshot().get("kv.pull.response", {})
+        inj.disarm("kv.pull.response")
+        self._fault({
+            "fault": "pd_pull_corrupt", "engine": dec_lws,
+            "site": "kv.pull.response", "fired": snap.get("fired", 0)})
+        self._note(
+            f"fault:pd_pull_corrupt engine={dec_lws} "
+            f"fired={snap.get('fired', 0)}")
+
+    def _fault_slice_loss(self, phase: str) -> None:
+        cfg = self.cfg
+        warm_prompt = self._systems()[0] + self._tail(0)
+        victim = self.picker.pick(warm_prompt)
+        assert victim is not None
+        victim_lws = victim.name[:-2]
+        first_chunk = threading.Event()
+        done: dict = {}
+
+        def long_stream():
+            done["row"] = self.client.request(
+                warm_prompt, cfg.slice_output_len, "slice_loss", phase,
+                seed=cfg.seed + 800,
+                on_first_chunk=first_chunk.set)
+            # stamped HERE: recovery means the broken stream finished,
+            # not that the (longer) concurrent interactive drive did
+            done["t_done"] = time.perf_counter()
+
+        t_stream = threading.Thread(target=long_stream, daemon=True)
+        t_stream.start()
+        if not first_chunk.wait(timeout=cfg.client_timeout_s):
+            raise RuntimeError("slice-loss stream never started")
+        t_kill = time.perf_counter()
+        self.sim.kill(victim_lws)
+        # the victim NAME is wall-time-dependent (live pick over racing
+        # cold-round placements), so the determinism-gated ledger records
+        # only that the fault fired; the name lives in fault_ledger
+        self._note("fault:slice_loss")
+        # concurrent interactive traffic keeps flowing while the corpse
+        # is breaker-ejected
+        systems = self._systems()
+        inter = [("sharedprefix", [systems[i % len(systems)]
+                                   + self._tail(300 + i)])
+                 for i in range(4)]
+        self._drive_sessions(phase, inter, 2, seed_off=800)
+        t_stream.join(timeout=cfg.client_timeout_s * cfg.client_max_attempts)
+        # fall back to "now" only if the stream never finished (join
+        # timed out) — then recovery_s is honestly unbounded-large
+        recovery_s = done.get("t_done", time.perf_counter()) - t_kill
+        row = done.get("row") or {}
+        breaker_state = self.picker.health.state(victim.name)
+        self._fault({
+            "fault": "slice_loss", "engine": victim_lws,
+            "stream_recovered": bool(row.get("ok")),
+            "recovery_s": round(recovery_s, 3),
+            "client_timeout_s": cfg.client_timeout_s,
+            "breaker_ejection_beat_timeout": (
+                bool(row.get("ok"))
+                and recovery_s < cfg.client_timeout_s),
+            "victim_breaker_state": breaker_state})
+        with self._lock:
+            self._slo_extra.update(
+            slice_loss_recovery_s=round(recovery_s, 3),
+            breaker_ejected_before_client_timeout=(
+                bool(row.get("ok")) and recovery_s < cfg.client_timeout_s))
+        # the cluster notices: stale pod goes, replacement boots cold
+        self.sim.revive(victim_lws)
+        old_url = victim.url
+        _wait_for(lambda: any(ep.name == victim.name and ep.url != old_url
+                              for ep in self._worker_endpoints()),
+                  cfg.boot_timeout_s)
+        self._note("respawn")
+        for ep in self._worker_endpoints():
+            if ep.name == victim.name:
+                self.client.request(f"warmup {ep.name}", 2, "warmup",
+                                    phase, pick=lambda ep=ep: ep)
+
+    def _phase_recover(self) -> None:
+        base = self._counter_snapshot()
+        self._cold_round("recover")
+        self._drive_sessions("recover", self._steady_sessions(400),
+                             self.cfg.concurrency, seed_off=400)
+        rate = self._window_hit_rate(base, self._counter_snapshot())
+        with self._lock:
+            self.hit_rates["recover"] = rate
+        self._phase_end("recover")
+
+    def _phase_drain(self) -> None:
+        cfg = self.cfg
+        phase = "drain"
+        # warm a dedicated prefix onto the expected drain victim (the
+        # highest replica index) so the drain's residency-invalidation
+        # is OBSERVABLE: repeat-prefix traffic must re-route off it
+        svc_raw = self.kube.get("InferenceService", cfg.namespace,
+                                cfg.service_name)
+        from fusioninfer_tpu.api.types import InferenceService
+
+        svc = InferenceService.from_dict(svc_raw)
+        role = next(r for r in svc.spec.worker_roles()
+                    if r.name == cfg.role_name)
+        victim_name, _ = self._endpoints_for(svc, role)[-1]
+        victim_pod = f"{victim_name}-0"
+        victim_ep = next((ep for ep in self._worker_endpoints()
+                          if ep.name == victim_pod), None)
+        drain_prefix = random_prompt(cfg.system_prompt_len,
+                                     self._prompt_base() + 4 * 10**6)
+        if victim_ep is not None:
+            for r in range(2):
+                self.client.request(drain_prefix + self._tail(500),
+                                    cfg.output_len, "drain_warm", phase,
+                                    seed=cfg.seed + 500 + r,
+                                    pick=lambda: victim_ep)
+        # leave the scale-down stabilization window, then tick the
+        # controller until the drain BEGINS (victims marked, residency
+        # digest invalidated)
+        self.clock.advance(cfg.scale_down_stabilization_s + 15.0)
+        ticks = 0
+        while ticks < cfg.max_ticks:
+            self._tick()
+            ticks += 1
+            if any(e["kind"] == "drain" for e in self._events()):
+                break
+            time.sleep(cfg.tick_pause_s)
+        # MID-DRAIN: repeat-prefix traffic must re-route off the warm
+        # victim instead of chasing its (invalidated) residency digest —
+        # the observable form of set_draining's residency invalidation
+        reroute_rows = [
+            self.client.request(drain_prefix + self._tail(500),
+                                cfg.output_len, "drain_reroute", phase,
+                                seed=cfg.seed + 510 + i)
+            for i in range(3)]
+        rerouted = all(r["ok"] and r["endpoint"] != victim_pod
+                       for r in reroute_rows)
+        # now let the drain finish: victims idle → shrink applied
+        while ticks < cfg.max_ticks:
+            self._tick()
+            ticks += 1
+            if any(e["kind"] == "down" for e in self._events()):
+                break
+            time.sleep(cfg.tick_pause_s)
+        with self._lock:
+            self._slo_extra.update(
+            drain_victim=victim_pod,
+            drain_rerouted=rerouted)
+        _wait_for(lambda: len(self._worker_endpoints())
+                  <= cfg.min_replicas, cfg.boot_timeout_s)
+        self._phase_end(phase)
+
+    # -- record --------------------------------------------------------
+
+    def _build(self, duration_s: float) -> dict:
+        cfg = self.cfg
+        phases = {
+            name: phase_summary(self.client.rows(name))
+            for name in ("steady", "scale_up", "faults", "recover",
+                         "drain")
+        }
+        scaleup_inter = [
+            r["ttft_s"] for r in self.client.rows("scale_up")
+            if r["stratum"] == "sharedprefix" and r["ttft_s"] is not None]
+        scaleup_p90 = pcts_ms(scaleup_inter).get("p90")
+        with self._lock:
+            hit_rates = dict(self.hit_rates)
+            fault_ledger = list(self.fault_ledger)
+            ledger = list(self.ledger)
+            slo_extra = dict(self._slo_extra)
+        pre = hit_rates.get("steady")
+        post = hit_rates.get("recover")
+        slo = {
+            "lost_streams": self.client.lost_streams(),
+            "corrupted_streams": self.client.corrupted_streams(),
+            "scale_ups": sum(1 for e in self._events()
+                             if e["kind"] == "up"),
+            "drain_scale_downs": sum(1 for e in self._events()
+                                     if e["kind"] == "down"),
+            "ttft_p90_bound_ms": round(cfg.ttft_p90_bound_s * 1e3, 1),
+            "scaleup_interactive_ttft_p90_ms": scaleup_p90,
+            "scaleup_ttft_bounded": (
+                scaleup_p90 is not None
+                and scaleup_p90 <= cfg.ttft_p90_bound_s * 1e3),
+            "hit_rate_prefault": pre,
+            "hit_rate_postfault": post,
+            "hit_rate_recovery_frac": cfg.hit_rate_recovery_frac,
+            "hit_rate_recovered": (
+                pre is not None and post is not None
+                and post >= cfg.hit_rate_recovery_frac * pre),
+        }
+        slo.update(slo_extra)
+        return build_record(
+            config={
+                "seed": cfg.seed, "service": cfg.service_name,
+                "min_replicas": cfg.min_replicas,
+                "max_replicas": cfg.max_replicas,
+                "pd_enabled": cfg.pd_enabled,
+                "client_timeout_s": cfg.client_timeout_s,
+            },
+            phases=phases, scale_events=self._events(),
+            fault_ledger=fault_ledger, hit_rates=hit_rates,
+            slo=slo, event_ledger=ledger, duration_s=duration_s)
+
+
+def run_fleet(cfg: Optional[FleetConfig] = None,
+              out_path: Optional[str] = None) -> dict:
+    """Boot, run, tear down; return (and optionally write) the record."""
+    with FleetHarness(cfg) as harness:
+        return harness.run(out_path)

@@ -25,6 +25,7 @@ completion — runs inside one process (``tests/test_e2e_serving.py``).
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import logging
 import threading
 from typing import Callable, Optional
@@ -69,8 +70,20 @@ class LWSSimulator:
         self.client = client
         self.namespace = namespace
         self.engine_factory = engine_factory or _default_engine_factory
+        # a factory taking a second parameter also receives the LWS name
+        # (fleet harnesses key per-engine fault injectors on it); the
+        # classic single-argument factory keeps working unchanged
+        try:
+            self._factory_takes_name = (
+                len(inspect.signature(self.engine_factory).parameters) >= 2)
+        except (TypeError, ValueError):
+            self._factory_takes_name = False
         self.poll_interval = poll_interval
         self.servers: dict[str, object] = {}  # lws name -> EngineServer
+        # guards servers + _suspended: kill()/revive() mutate them from
+        # harness threads while the simulator thread reconciles
+        self._lock = threading.Lock()
+        self._suspended: set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -86,16 +99,50 @@ class LWSSimulator:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
-        for server in self.servers.values():
+        with self._lock:
+            servers, self.servers = dict(self.servers), {}
+        for server in servers.values():
             try:
                 server.stop()
             except Exception:
                 logger.exception("podsim engine stop failed")
-        self.servers.clear()
 
     def url_of(self, lws_name: str) -> str:
-        server = self.servers[lws_name]
+        with self._lock:
+            server = self.servers[lws_name]
         return f"http://127.0.0.1:{server.port}"
+
+    # -- fault injection (the fleet harness's slice-loss lever) --
+
+    def kill(self, lws_name: str) -> None:
+        """Abrupt slice loss: the engine dies NOW (in-flight streams
+        fail immediately, listener refuses), but the Pod object stays —
+        exactly the window real fleets live in before the node
+        controller notices, when only the router's circuit breakers
+        stand between clients and the corpse.  Respawn is suspended
+        until :meth:`revive` (the "kubelet reschedules" moment)."""
+        with self._lock:
+            server = self.servers.pop(lws_name, None)
+            if server is not None:
+                # suspend only a real kill: a KeyError below must not
+                # leave a never-booted LWS permanently unspawnable
+                self._suspended.add(lws_name)
+        if server is None:
+            raise KeyError(f"no live engine for LWS {lws_name!r}")
+        server.kill()
+        logger.info("podsim: killed %s (pod object left stale)", lws_name)
+
+    def revive(self, lws_name: str) -> None:
+        """Let the 'cluster' notice the death: delete the stale Pod and
+        lift the respawn suspension — the simulator loop then boots a
+        REPLACEMENT engine (fresh process, cold caches, new port) the
+        way a rescheduled pod would come back."""
+        try:
+            self.client.delete("Pod", self.namespace, f"{lws_name}-0")
+        except Exception:
+            logger.info("stale pod %s-0 already gone", lws_name)
+        with self._lock:
+            self._suspended.discard(lws_name)
 
     # -- internals --
 
@@ -112,7 +159,9 @@ class LWSSimulator:
         if labels.get(LABEL_COMPONENT_TYPE) != "decoder":
             return None
         service = labels.get(LABEL_SERVICE, "")
-        for name, server in self.servers.items():
+        with self._lock:
+            servers = dict(self.servers)
+        for name, server in servers.items():
             pod = self.client.get_or_none("Pod", self.namespace, f"{name}-0")
             if pod is None:
                 continue
@@ -125,9 +174,12 @@ class LWSSimulator:
     def _simulate(self, lws: dict) -> None:
         name = lws["metadata"]["name"]
         labels = self._pod_labels(lws)
-        server = self.engine_factory(self._prefiller_url(labels))
+        purl = self._prefiller_url(labels)
+        server = (self.engine_factory(purl, name)
+                  if self._factory_takes_name else self.engine_factory(purl))
         server.start()
-        self.servers[name] = server  # noqa:lock-discipline — confined to the simulator thread; stop() joins it before reading
+        with self._lock:
+            self.servers[name] = server
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -153,12 +205,26 @@ class LWSSimulator:
         logger.info("podsim: %s serving on :%s", name, server.port)
 
     def _reap(self, live_names: set) -> None:
-        for name in [n for n in self.servers if n not in live_names]:
+        with self._lock:
+            dead = [n for n in self.servers if n not in live_names]
+        for name in dead:
             try:
-                self.servers.pop(name).stop()  # noqa:lock-discipline — confined to the simulator thread; stop() joins it before reading
+                with self._lock:
+                    server = self.servers.pop(name)
+                server.stop()
                 self.client.delete("Pod", self.namespace, f"{name}-0")
             except Exception:
                 logger.exception("podsim reap of %s failed", name)
+        # a killed-and-never-revived LWS that leaves the spec entirely
+        # must not stay suspended forever (its stale pod goes with it)
+        with self._lock:
+            gone = self._suspended - live_names
+            self._suspended -= gone
+        for name in gone:
+            try:
+                self.client.delete("Pod", self.namespace, f"{name}-0")
+            except Exception:
+                logger.info("stale pod %s-0 already gone", name)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -169,8 +235,10 @@ class LWSSimulator:
                     key=lambda l: self._pod_labels(l).get(
                         LABEL_COMPONENT_TYPE) != "prefiller"
                 )
+                with self._lock:
+                    running = set(self.servers) | set(self._suspended)
                 for lws in lws_list:
-                    if lws["metadata"]["name"] not in self.servers:
+                    if lws["metadata"]["name"] not in running:
                         self._simulate(lws)
                 self._reap({l["metadata"]["name"] for l in lws_list})
             except Exception:

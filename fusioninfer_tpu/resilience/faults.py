@@ -24,6 +24,12 @@ Sites in the tree today:
 ``operator.reconcile.<Kind>``  one reconcile invocation
                              (:mod:`fusioninfer_tpu.operator.manager`)
 ===========================  ================================================
+
+The fleet harness (:mod:`fusioninfer_tpu.fleetsim`) additionally
+partitions the autoscaler's metrics relay by wrapping the collector's
+``fetch`` and arms the sites above per engine (each podsim engine gets
+its own seeded injector); :meth:`FaultInjector.snapshot` serializes the
+armed state into the run's fault ledger.
 """
 
 from __future__ import annotations
@@ -103,6 +109,19 @@ class FaultInjector:
         with self._lock:
             rule = self._rules.get(site)
             return rule.fired if rule is not None else 0
+
+    def snapshot(self) -> dict[str, dict]:
+        """Every armed rule's observable state — the fault-ledger
+        payload evidence artifacts carry (``FLEET_r0N.json``'s
+        ``fault_ledger``): per site, the mode and how many calls/firings
+        it has seen.  Deterministic under a fixed seed and schedule, so
+        two runs of the same chaos plan snapshot identically."""
+        with self._lock:
+            return {
+                site: {"mode": rule.mode, "calls": rule.calls,
+                       "fired": rule.fired}
+                for site, rule in sorted(self._rules.items())
+            }
 
     # -- decision --
 

@@ -332,6 +332,27 @@ class ResidencyProvider:
             self._cache[ep.name] = (now, now, None)
             return None
 
+    def invalidate(self, name: str) -> None:
+        """Forget one endpoint's cached digest (and its negative-cache
+        verdict).  The drain/death path: a draining or dead engine's
+        last-known-good digest must not keep scoring it as the warm
+        holder for up to ``max_age_s`` — the picker calls this from
+        :meth:`EndpointPicker.set_draining` so repeat-prefix traffic
+        re-routes promptly instead of chasing a corpse."""
+        with self._lock:
+            self._cache.pop(name, None)
+
+    def retain(self, names) -> None:
+        """Drop cached digests for endpoints no longer in the fleet
+        snapshot — pod churn must not grow the cache forever, and a
+        REPLACEMENT endpoint reusing a departed name must start from a
+        fresh fetch, not its predecessor's last-known-good contents."""
+        keep = set(names)
+        with self._lock:
+            for name in list(self._cache):
+                if name not in keep:
+                    del self._cache[name]
+
     def _usable_chain(self, prompt: str, page_size: int) -> list:
         memo = self._chain_memo
         if memo is not None and memo[0] == prompt and memo[1] == page_size:
@@ -418,12 +439,20 @@ class EndpointPicker:
 
     def set_draining(self, name: str, draining: bool = True) -> None:
         """Mark/unmark an endpoint draining (the autoscaler's scale-down
-        protocol, ``fusioninfer_tpu.autoscale.drainer``)."""
+        protocol, ``fusioninfer_tpu.autoscale.drainer``).  Either
+        transition also drops the endpoint from the residency cache: a
+        draining engine is about to lose its pages (and an un-draining
+        one kept mutating them while unrouted), so its cached digest is
+        fiction either way — the scorer re-fetches or falls back to the
+        history heuristic instead of routing repeat-prefix traffic at a
+        shrinking victim."""
         with self._draining_lock:
             if draining:
                 self._draining.add(name)
             else:
                 self._draining.discard(name)
+        if self._residency is not None:
+            self._residency.invalidate(name)
 
     def is_draining(self, name: str) -> bool:
         with self._draining_lock:
@@ -466,8 +495,12 @@ class EndpointPicker:
         prof = self._profiles.get(profile) or next(iter(self._profiles.values()))
         candidates = list(self._endpoints())
         # evict breakers for endpoints that left the fleet (before
-        # profile filters: filtered-out endpoints are still alive)
+        # profile filters: filtered-out endpoints are still alive);
+        # residency digests follow the same lifecycle — a dead engine's
+        # reported cache contents must leave with its endpoint
         self.health.retain(ep.name for ep in candidates)
+        if self._residency is not None:
+            self._residency.retain(ep.name for ep in candidates)
         scorers: list[tuple[str, dict, float]] = []
         for ref in prof.get("plugins", []):
             plugin = self._plugins.get(ref["pluginRef"])

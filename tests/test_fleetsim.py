@@ -1,0 +1,228 @@
+"""fleetsim: the closed-loop fleet harness (docs/design/fleet-sim.md).
+
+One trimmed full-loop run (module fixture) backs the SLO assertions —
+real manager + podsim engines + EPP residency routing + autoscaler +
+fault injection in a single process.  The determinism test runs the
+SAME config a second time and demands event-ledger equality: scale
+events, fault firings, per-phase request counts and their order are a
+pure function of the seed.
+"""
+
+import json
+
+import pytest
+
+from fusioninfer_tpu.benchmark.loadgen import poisson_arrivals
+from fusioninfer_tpu.fleetsim.harness import (
+    FleetConfig,
+    ManualClock,
+    run_fleet,
+)
+from tools.check_fleet_record import check_record
+
+# trimmed traffic: the same five phases and all three faults, sized for
+# the test suite (the committed evidence run uses the defaults)
+SMALL = dict(
+    warm_rounds=2, multiturn_turns=1, background_per_phase=1,
+    burst_requests=10, burst_output_len=20, scaleup_interactive=3,
+    slice_output_len=20,
+)
+
+
+@pytest.fixture(scope="module")
+def fleet_record():
+    return run_fleet(FleetConfig(seed=3, **SMALL))
+
+
+class TestFleetSLOs:
+    def test_record_passes_the_gate(self, fleet_record):
+        assert check_record(fleet_record) == []
+
+    def test_scale_up_and_drain_scale_down_occurred(self, fleet_record):
+        kinds = [e["kind"] for e in fleet_record["scale_events"]]
+        assert "up" in kinds
+        assert "drain" in kinds
+        assert "down" in kinds
+        # the drain precedes the applied shrink
+        assert kinds.index("drain") < kinds.index("down")
+
+    def test_scaleup_ttft_bounded(self, fleet_record):
+        slo = fleet_record["slo"]
+        assert slo["scaleup_ttft_bounded"] is True
+        assert slo["scaleup_interactive_ttft_p90_ms"] <= slo[
+            "ttft_p90_bound_ms"]
+
+    def test_residency_hit_rate_recovers_after_engine_death(
+            self, fleet_record):
+        slo = fleet_record["slo"]
+        assert slo["hit_rate_prefault"] is not None
+        assert slo["hit_rate_recovered"] is True
+
+    def test_drain_drops_victim_from_residency_routing(self, fleet_record):
+        """The PR 9 satellite, observed at fleet level: once the drain
+        marks the victim (set_draining → residency invalidate),
+        repeat-prefix traffic warm on the victim re-routes to survivors
+        instead of chasing the corpse's digest."""
+        assert fleet_record["slo"]["drain_rerouted"] is True
+        # and nothing was lost in the shrink
+        drain = fleet_record["phases"]["drain"]
+        assert drain["lost"] == 0
+
+
+@pytest.mark.chaos
+class TestFleetChaos:
+    def test_slice_loss_mid_decode_zero_lost_streams(self, fleet_record):
+        """A slice dies while decoding; every stream still completes
+        (on a survivor), byte-identical, and the breaker ejects the
+        corpse before the client timeout."""
+        slo = fleet_record["slo"]
+        assert slo["lost_streams"] == 0
+        assert slo["corrupted_streams"] == 0
+        slice_faults = [f for f in fleet_record["fault_ledger"]
+                        if f["fault"] == "slice_loss"]
+        assert slice_faults and slice_faults[0]["stream_recovered"]
+        assert slice_faults[0]["breaker_ejection_beat_timeout"]
+        assert slice_faults[0]["recovery_s"] < slice_faults[0][
+            "client_timeout_s"]
+
+    def test_kv_corruption_crc_rejected_and_recomputed(self, fleet_record):
+        kv = [f for f in fleet_record["fault_ledger"]
+              if f["fault"] == "kv_transfer_corrupt"][0]
+        assert kv["fired"] > 0
+        assert kv["crc_dropped"] > 0
+        assert fleet_record["slo"]["corrupted_streams"] == 0
+
+    def test_metrics_partition_holds_instead_of_scaling(self, fleet_record):
+        part = [f for f in fleet_record["fault_ledger"]
+                if f["fault"] == "metrics_partition"][0]
+        assert part["controller_held"] is True
+
+
+class TestSeededDeterminism:
+    def test_same_seed_same_event_ledger(self, fleet_record):
+        """Same seed ⇒ same event ledger: phase request counts, scale
+        events, fault firings, kill/respawn — across two fully
+        independent runs (fresh API server, engines, ports)."""
+        again = run_fleet(FleetConfig(seed=3, **SMALL))
+        assert again["event_ledger"] == fleet_record["event_ledger"]
+        # and the ledger actually covers the interesting events
+        ledger = "\n".join(fleet_record["event_ledger"])
+        for needle in ("scale:up", "scale:drain", "scale:down",
+                       "fault:metrics_partition", "fault:kv_corrupt",
+                       "fault:slice_loss", "respawn"):
+            assert needle in ledger, ledger
+
+
+class TestCheckFleetRecord:
+    """Checker unit tests on synthetic records (no harness run)."""
+
+    @staticmethod
+    def _good() -> dict:
+        phase = {"requests": 4, "ok": 4, "lost": 0, "corrupted": 0,
+                 "retried": 0, "ttft_ms": {"p50": 10.0, "p90": 12.0},
+                 "strata": {}}
+        return {
+            "schema": "fleet-v1",
+            "phases": {n: dict(phase) for n in
+                       ("steady", "scale_up", "faults", "recover",
+                        "drain")},
+            "scale_events": [],
+            "fault_ledger": [
+                {"fault": "metrics_partition", "controller_held": True},
+                {"fault": "kv_transfer_corrupt", "fired": 3,
+                 "crc_dropped": 1.0},
+                {"fault": "slice_loss", "stream_recovered": True,
+                 "breaker_ejection_beat_timeout": True,
+                 "recovery_s": 1.0, "client_timeout_s": 30.0},
+            ],
+            "slo": {
+                "lost_streams": 0, "corrupted_streams": 0,
+                "scale_ups": 1, "drain_scale_downs": 1,
+                "ttft_p90_bound_ms": 15000.0,
+                "scaleup_interactive_ttft_p90_ms": 900.0,
+                "scaleup_ttft_bounded": True,
+                "hit_rate_prefault": 0.6, "hit_rate_postfault": 0.55,
+                "hit_rate_recovery_frac": 0.8,
+                "hit_rate_recovered": True, "drain_rerouted": True,
+            },
+            "event_ledger": ["boot engines=2"],
+        }
+
+    def test_good_record_passes(self):
+        assert check_record(self._good()) == []
+
+    def test_lost_stream_fails(self):
+        rec = self._good()
+        rec["slo"]["lost_streams"] = 1
+        assert any("lost streams" in p for p in check_record(rec))
+
+    def test_missing_fault_fails(self):
+        rec = self._good()
+        rec["fault_ledger"] = [f for f in rec["fault_ledger"]
+                               if f["fault"] != "slice_loss"]
+        assert any("slice_loss" in p for p in check_record(rec))
+
+    def test_unbounded_ttft_fails(self):
+        rec = self._good()
+        rec["slo"]["scaleup_ttft_bounded"] = False
+        assert any("exceeded the bound" in p for p in check_record(rec))
+
+    def test_unrecovered_hit_rate_fails(self):
+        rec = self._good()
+        rec["slo"]["hit_rate_recovered"] = False
+        assert any("hit rate" in p for p in check_record(rec))
+
+    def test_breaker_slower_than_timeout_fails(self):
+        rec = self._good()
+        rec["fault_ledger"][2]["breaker_ejection_beat_timeout"] = False
+        assert any("breaker ejection" in p for p in check_record(rec))
+
+    def test_wrong_schema_fails(self):
+        assert check_record({"schema": "bench-v1"})
+
+    def test_record_is_json_serializable(self, fleet_record):
+        json.dumps(fleet_record)
+
+
+class TestOpenLoopArrivals:
+    """The loadgen satellite: seeded Poisson with burst multiplier."""
+
+    def test_deterministic_under_seed(self):
+        a = poisson_arrivals(32, 5.0, seed=7)
+        b = poisson_arrivals(32, 5.0, seed=7)
+        assert a == b
+        assert a != poisson_arrivals(32, 5.0, seed=8)
+
+    def test_monotone_and_sized(self):
+        xs = poisson_arrivals(64, 10.0, seed=1)
+        assert len(xs) == 64
+        assert all(b > a for a, b in zip(xs, xs[1:]))
+
+    def test_burst_stretches_are_denser(self):
+        # burst arrivals (indices 0..3 of every 16) ride a 4x rate:
+        # their mean inter-arrival must be well under the base stratum's
+        xs = poisson_arrivals(256, 4.0, seed=3, burst_factor=4.0,
+                              burst_every=16, burst_len=4)
+        gaps = [b - a for a, b in zip(xs, xs[1:])]
+        burst_gaps = [g for i, g in enumerate(gaps, start=1)
+                      if (i % 16) < 4]
+        base_gaps = [g for i, g in enumerate(gaps, start=1)
+                     if (i % 16) >= 4]
+        assert sum(burst_gaps) / len(burst_gaps) < (
+            sum(base_gaps) / len(base_gaps)) / 2
+
+    def test_rejects_nonpositive_rate(self):
+        with pytest.raises(ValueError):
+            poisson_arrivals(4, 0.0, seed=0)
+
+    def test_empty(self):
+        assert poisson_arrivals(0, 1.0, seed=0) == []
+
+
+class TestManualClock:
+    def test_advance(self):
+        clk = ManualClock()
+        assert clk() == 0.0
+        clk.advance(2.5)
+        clk.advance(0.5)
+        assert clk() == 3.0

@@ -258,3 +258,65 @@ class TestBatchedSuffixPrefill:
         eng.add_request(r)
         toks = self._drain(eng, [r])
         assert len(toks["long"]) == 5
+
+
+class TestPrecomputedChain:
+    """PR 9 satellite: admission computes a prompt's block-hash chain
+    ONCE and threads it through the restore consult, can_admit and
+    match_prefix — the precomputed chain must be semantically identical
+    to the internally rebuilt one."""
+
+    def _chain(self, prompt, namespace=b""):
+        ps = CACHE.page_size
+        usable = max(0, (len(prompt) - 1) // ps)
+        return block_hashes(prompt, ps, namespace)[:usable]
+
+    def test_match_prefix_equivalent_with_and_without_chain(self):
+        prompt = list(range(24))
+        a = PrefixCachingAllocator(CACHE)
+        a.allocate("seed", len(prompt) + 1)
+        a.register_blocks("seed", prompt)
+        a.release("seed")
+        without = a.match_prefix("x", prompt)
+        a.release("x")
+        with_chain = a.match_prefix("y", prompt,
+                                    chain=self._chain(prompt))
+        assert with_chain == without == 16
+        a.release("y")
+
+    def test_can_admit_equivalent_with_and_without_chain(self):
+        prompt = list(range(24))
+        alloc = PrefixCachingAllocator(CACHE)
+        alloc.allocate("seed", len(prompt) + 1)
+        alloc.register_blocks("seed", prompt)
+        alloc.release("seed")
+        assert (alloc.can_admit(prompt, 1)
+                == alloc.can_admit(prompt, 1, chain=self._chain(prompt)))
+
+    def test_engine_admission_hashes_once_per_request(self, monkeypatch):
+        """The whole point of the satellite: one admission = one
+        block_hashes build (it used to be up to three — restore consult,
+        can_admit's peek, match_prefix)."""
+        import fusioninfer_tpu.engine.engine as engine_mod
+        import fusioninfer_tpu.engine.prefix_cache as pc_mod
+        from fusioninfer_tpu.engine.engine import block_hashes as real_bh
+
+        calls = []
+
+        def counting_bh(tokens, ps, namespace=b""):
+            calls.append(len(tokens))
+            return real_bh(tokens, ps, namespace)
+
+        # BOTH from-import bindings: if the chain= threading were
+        # dropped, the allocator would silently rebuild through its own
+        # module-level import and an engine-only count would miss it
+        monkeypatch.setattr(engine_mod, "block_hashes", counting_bh)
+        monkeypatch.setattr(pc_mod, "block_hashes", counting_bh)
+        eng = NativeEngine(CFG, cache_cfg=CACHE, max_batch_size=2)
+        eng.add_request(Request(
+            "r1", list(range(20)),
+            SamplingParams(temperature=0.0, max_tokens=2)))
+        while eng.has_work():
+            eng.step()
+        admission_builds = [n for n in calls if n == 20]
+        assert len(admission_builds) == 1, calls

@@ -606,3 +606,77 @@ def pytest_approx(v, rel=1e-6):
     import pytest as _pytest
 
     return _pytest.approx(v, rel=rel)
+
+
+class TestResidencyLifecycle:
+    """PR 9 satellite: drained/dead endpoints must drop out of
+    residency routing promptly."""
+
+    def _provider_with_counter(self, prompt):
+        from fusioninfer_tpu.router.picker import ResidencyProvider
+        from fusioninfer_tpu.utils.blockhash import block_hashes
+        from fusioninfer_tpu.router.picker import byte_tokenize
+
+        chain = block_hashes(byte_tokenize(prompt), 16)
+        digest = {"page_size": 16,
+                  "tiers": {"hbm": len(chain), "host": 0},
+                  "blocks": {"hbm": [h.hex() for h in chain], "host": []}}
+        calls = []
+
+        def fetch(ep):
+            calls.append(ep.name)
+            return digest
+
+        # huge ttl: without invalidation NOTHING would re-fetch
+        return ResidencyProvider(fetch=fetch, ttl_s=1e6,
+                                 max_age_s=1e6), calls
+
+    def test_invalidate_forces_refetch(self):
+        from fusioninfer_tpu.router.picker import Endpoint
+
+        prompt = "S" * 64 + "t"
+        provider, calls = self._provider_with_counter(prompt)
+        ep = Endpoint("victim", "http://v", {})
+        assert provider.score(prompt, ep) == 1.0
+        assert provider.score(prompt, ep) == 1.0
+        assert len(calls) == 1  # cached within ttl
+        provider.invalidate("victim")
+        assert provider.score(prompt, ep) == 1.0
+        assert len(calls) == 2  # cache dropped -> fresh fetch
+
+    def test_set_draining_invalidates_residency(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            EndpointPicker,
+            ResidencyProvider,
+        )
+
+        prompt = "S" * 64 + "t"
+        provider, calls = self._provider_with_counter(prompt)
+        eps = [Endpoint("a", "http://a", {}),
+               Endpoint("victim", "http://v", {})]
+        picker = EndpointPicker(
+            TestResidencyScoring.CONFIG, endpoints=lambda: list(eps),
+            residency=provider)
+        picker.pick(prompt)
+        n = len(calls)
+        picker.set_draining("victim")
+        # the draining victim's digest was dropped; it is also excluded
+        # from selection, so repeat-prefix traffic lands on the survivor
+        assert picker.pick(prompt).name == "a"
+        picker.set_draining("victim", False)
+        picker.pick(prompt)
+        assert len(calls) > n  # un-draining re-fetched, not reused
+
+    def test_retain_drops_departed_endpoints(self):
+        from fusioninfer_tpu.router.picker import Endpoint
+
+        prompt = "S" * 64 + "t"
+        provider, calls = self._provider_with_counter(prompt)
+        gone = Endpoint("gone", "http://g", {})
+        assert provider.score(prompt, gone) == 1.0
+        provider.retain({"other"})
+        assert provider.score(prompt, gone) == 1.0
+        # the replacement endpoint re-fetched instead of inheriting the
+        # departed pod's last-known-good digest
+        assert len(calls) == 2

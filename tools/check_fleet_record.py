@@ -1,0 +1,130 @@
+"""Assert a FLEET record carries the closed-loop fleet evidence.
+
+The fleet smoke (``make fleet-smoke``, CI's "fleet smoke" step) runs
+``python -m fusioninfer_tpu.fleetsim`` and then this checker against the
+record — the fleet-level sibling of ``check_bench_record``.  The gated
+properties ARE the acceptance criteria of the fleet harness
+(docs/design/fleet-sim.md):
+
+* ≥1 applied scale-up AND ≥1 drain-based scale-down occurred;
+* zero lost and zero corrupted streams across every injected fault
+  (slice loss mid-decode, metrics-relay partition, KV-transfer
+  corruption — each of which must actually appear in the fault ledger);
+* interactive TTFT p90 during scale-up stayed under the recorded bound;
+* the residency-routed prefix hit rate recovered to within the recorded
+  fraction of its pre-fault value after the engine death;
+* the controller HELD (did not scale on fiction) through the metrics
+  partition, and drained repeat-prefix traffic re-routed off the
+  victim.
+
+Usage: ``python tools/check_fleet_record.py [FLEET_OUT.json]``.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REQUIRED_PHASES = ("steady", "scale_up", "faults", "recover", "drain")
+REQUIRED_FAULTS = ("metrics_partition", "kv_transfer_corrupt",
+                   "slice_loss")
+
+
+def check_record(record: dict) -> list[str]:
+    """Return the list of complaints (empty = pass)."""
+    problems: list[str] = []
+    if record.get("schema") != "fleet-v1":
+        problems.append(f"schema must be fleet-v1, got "
+                        f"{record.get('schema')!r}")
+        return problems
+    phases = record.get("phases") or {}
+    for name in REQUIRED_PHASES:
+        ph = phases.get(name)
+        if not isinstance(ph, dict) or not ph.get("requests"):
+            problems.append(f"phase {name!r} missing or empty")
+            continue
+        if not (ph.get("ttft_ms") or {}).get("p50"):
+            problems.append(f"phase {name!r} has no TTFT percentiles")
+    slo = record.get("slo") or {}
+    if slo.get("lost_streams") != 0:
+        problems.append(
+            f"lost streams must be 0, got {slo.get('lost_streams')!r}")
+    if slo.get("corrupted_streams") != 0:
+        problems.append(f"corrupted streams must be 0, got "
+                        f"{slo.get('corrupted_streams')!r}")
+    if not slo.get("scale_ups"):
+        problems.append("no applied scale-up recorded")
+    if not slo.get("drain_scale_downs"):
+        problems.append("no drain-based scale-down recorded")
+    faults = {f.get("fault") for f in record.get("fault_ledger") or []}
+    for fault in REQUIRED_FAULTS:
+        if fault not in faults:
+            problems.append(f"fault ledger missing {fault!r}")
+    for f in record.get("fault_ledger") or []:
+        if f.get("fault") == "metrics_partition" and not f.get(
+                "controller_held"):
+            problems.append(
+                "controller scaled during the metrics partition "
+                "(must hold on stale/missing signals)")
+        if f.get("fault") == "kv_transfer_corrupt":
+            if not f.get("fired"):
+                problems.append("kv_transfer_corrupt armed but never fired")
+            if not f.get("crc_dropped"):
+                problems.append(
+                    "corrupt KV frame was never CRC-rejected "
+                    "(crc_dropped == 0) — the fault proved nothing")
+        if f.get("fault") == "slice_loss":
+            if not f.get("stream_recovered"):
+                problems.append(
+                    "slice-loss mid-decode stream did not recover")
+            if not f.get("breaker_ejection_beat_timeout"):
+                problems.append(
+                    "breaker ejection did not beat the client timeout "
+                    f"(recovery_s={f.get('recovery_s')!r}, "
+                    f"client_timeout_s={f.get('client_timeout_s')!r})")
+    if "ttft_p90_bound_ms" not in slo:
+        problems.append("slo.ttft_p90_bound_ms (the recorded bound) missing")
+    if not slo.get("scaleup_ttft_bounded"):
+        problems.append(
+            "interactive TTFT p90 during scale-up exceeded the bound "
+            f"(p90={slo.get('scaleup_interactive_ttft_p90_ms')!r} ms, "
+            f"bound={slo.get('ttft_p90_bound_ms')!r} ms)")
+    if not slo.get("hit_rate_recovered"):
+        problems.append(
+            "residency-routed hit rate did not recover to within "
+            f"{slo.get('hit_rate_recovery_frac')!r} of pre-fault "
+            f"(pre={slo.get('hit_rate_prefault')!r}, "
+            f"post={slo.get('hit_rate_postfault')!r})")
+    if not slo.get("drain_rerouted"):
+        problems.append(
+            "repeat-prefix traffic kept chasing the draining victim "
+            f"({slo.get('drain_victim')!r})")
+    if not record.get("event_ledger"):
+        problems.append("event_ledger missing (determinism evidence)")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    path = pathlib.Path(argv[1]) if len(argv) > 1 else (
+        pathlib.Path(__file__).resolve().parent.parent / "FLEET_OUT.json")
+    try:
+        record = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"check_fleet_record: cannot read {path}: {e}",
+              file=sys.stderr)
+        return 2
+    problems = check_record(record)
+    if problems:
+        for p in problems:
+            print(f"check_fleet_record: {p}", file=sys.stderr)
+        return 1
+    print(f"check_fleet_record: {path.name} carries the closed-loop "
+          "fleet evidence (scale-up + drain scale-down, zero "
+          "lost/corrupted streams under faults, bounded scale-up TTFT, "
+          "residency recovery)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
