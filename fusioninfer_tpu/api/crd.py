@@ -8,6 +8,10 @@ from the Python types.  Pod/HTTPRoute/Gateway passthroughs stay untyped
 (``x-kubernetes-preserve-unknown-fields``) to dodge CRD size limits, the
 same escape hatch the reference chose (RawExtension,
 ``inferenceservice_types.go:74-104``).
+
+Every spec property carries a ``description`` (``kubectl explain`` is
+the operator's first stop); ``make verify-manifests`` fails on any
+undocumented spec field so a new knob can never ship schema-only.
 """
 
 from __future__ import annotations
@@ -21,69 +25,259 @@ KIND = "InferenceService"
 LIST_KIND = "InferenceServiceList"
 SHORT_NAMES = ["isvc", "fisvc"]
 
-_RAW = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+def _raw(description: str) -> dict:
+    return {
+        "type": "object",
+        "x-kubernetes-preserve-unknown-fields": True,
+        "description": description,
+    }
+
+
+def _slo_tiers_schema() -> dict:
+    return {
+        "type": "object",
+        "description": (
+            "Service-level SLO tiers: named traffic classes "
+            "(interactive/batch) with scheduling priority, per-step "
+            "token-budget shares, admission-queue bounds and latency "
+            "targets.  Flows into the rendered EndpointPickerConfig "
+            "and the engine servers (slo_tier request field, 429 "
+            "backpressure, KV-preserving preemption)."),
+        "required": ["tiers"],
+        "properties": {
+            "tiers": {
+                "type": "array",
+                "minItems": 1,
+                "description": (
+                    "The traffic classes, one per priority class; "
+                    "requests name a tier via the slo_tier field."),
+                "items": {
+                    "type": "object",
+                    "required": ["name"],
+                    "description": "One traffic class and its SLOs.",
+                    "properties": {
+                        "name": {
+                            "type": "string", "minLength": 1,
+                            "description": (
+                                "Tier name requests carry in slo_tier "
+                                "(e.g. interactive, batch)."),
+                        },
+                        "priority": {
+                            "type": "integer", "default": 0,
+                            "description": (
+                                "Scheduling priority this tier maps "
+                                "onto (vLLM semantics: lower = more "
+                                "urgent, last to be preempted)."),
+                        },
+                        "budgetShare": {
+                            "type": "number", "minimum": 0, "maximum": 1,
+                            "description": (
+                                "Fraction of each engine step's token "
+                                "budget reserved for the tier while it "
+                                "has pending work; idle shares are "
+                                "borrowable (work-conserving)."),
+                        },
+                        "queueBound": {
+                            "type": "integer", "minimum": 1,
+                            "default": 256,
+                            "description": (
+                                "Admission-queue depth past which the "
+                                "server sheds the tier's requests with "
+                                "429 + Retry-After."),
+                        },
+                        "retryAfterSeconds": {
+                            "type": "number", "minimum": 0, "default": 1.0,
+                            "description": (
+                                "Retry-After hint returned with a 429 "
+                                "shed; the router holds the engine "
+                                "softly for this long."),
+                        },
+                        "ttftP90Seconds": {
+                            "type": "number", "minimum": 0,
+                            "description": (
+                                "Recorded p90 time-to-first-token "
+                                "target for the tier (gated by the "
+                                "fleet record checker)."),
+                        },
+                        "tpotP90Seconds": {
+                            "type": "number", "minimum": 0,
+                            "description": (
+                                "Recorded p90 time-per-output-token "
+                                "target for the tier."),
+                        },
+                    },
+                },
+            },
+        },
+    }
 
 
 def _role_schema() -> dict:
     return {
         "type": "object",
         "required": ["name", "componentType"],
+        "description": (
+            "One component of the service: a router (gateway + endpoint "
+            "picker) or a worker-like engine role (prefiller, decoder, "
+            "or aggregated worker)."),
         "properties": {
-            "name": {"type": "string", "minLength": 1},
+            "name": {
+                "type": "string", "minLength": 1,
+                "description": "Role name, unique within the service.",
+            },
             "componentType": {
                 "type": "string",
                 "enum": [c.value for c in ComponentType],
+                "description": (
+                    "What this role is: router, prefiller, decoder, or "
+                    "worker (prefiller/decoder must be declared "
+                    "together for PD disaggregation)."),
             },
-            "replicas": {"type": "integer", "minimum": 0, "default": 1},
+            "replicas": {
+                "type": "integer", "minimum": 0, "default": 1,
+                "description": (
+                    "Desired replicas; one replica occupies one whole "
+                    "TPU slice of the role's tpu shape."),
+            },
             "engine": {
                 "type": "string",
                 "enum": [e.value for e in EngineKind],
                 "default": EngineKind.VLLM_TPU.value,
+                "description": (
+                    "Inference engine inside the role's pods; selects "
+                    "the multi-host bootstrap wrap (Ray for vllm-tpu, "
+                    "JAX coordinator for jetstream/native, none for "
+                    "custom)."),
             },
-            "template": _RAW,
+            "template": _raw(
+                "Raw PodTemplateSpec passthrough merged into the "
+                "rendered workload (image, env, volumes)."),
             "tpu": {
                 "type": "object",
                 "required": ["type", "topology"],
+                "description": (
+                    "Declarative TPU slice request; host count, node "
+                    "selectors and chip limits derive from it."),
                 "properties": {
-                    "type": {"type": "string"},
-                    "topology": {"type": "string", "pattern": r"^\d+x\d+(x\d+)?$"},
-                    "chipsPerHost": {"type": "integer", "minimum": 1},
+                    "type": {
+                        "type": "string",
+                        "description": "TPU generation (e.g. v5e, v5p).",
+                    },
+                    "topology": {
+                        "type": "string",
+                        "pattern": r"^\d+x\d+(x\d+)?$",
+                        "description": (
+                            "Slice topology, e.g. 2x4 or 2x2x2 — one "
+                            "replica occupies one slice of this shape."),
+                    },
+                    "chipsPerHost": {
+                        "type": "integer", "minimum": 1,
+                        "description": (
+                            "Chips per host override when the "
+                            "generation default does not apply."),
+                    },
                 },
             },
             "multinode": {
                 "type": "object",
-                "properties": {"nodeCount": {"type": "integer", "minimum": 1}},
+                "description": (
+                    "Legacy free-form host count (reference parity); "
+                    "prefer tpu."),
+                "properties": {
+                    "nodeCount": {
+                        "type": "integer", "minimum": 1,
+                        "description": "Hosts per replica.",
+                    },
+                },
             },
             "autoscaling": {
                 "type": "object",
+                "description": (
+                    "Slice-granular PD-aware autoscaling for this "
+                    "worker-like role (docs/design/autoscaling.md)."),
                 "properties": {
-                    "enabled": {"type": "boolean", "default": True},
-                    "minReplicas": {"type": "integer", "minimum": 1, "default": 1},
-                    "maxReplicas": {"type": "integer", "minimum": 1, "default": 4},
+                    "enabled": {
+                        "type": "boolean", "default": True,
+                        "description": (
+                            "Master switch; disabled keeps replicas "
+                            "operator-managed."),
+                    },
+                    "minReplicas": {
+                        "type": "integer", "minimum": 1, "default": 1,
+                        "description": (
+                            "Lower bound (scale-to-zero is refused: "
+                            "the router needs a drain target)."),
+                    },
+                    "maxReplicas": {
+                        "type": "integer", "minimum": 1, "default": 4,
+                        "description": "Upper bound in whole slices.",
+                    },
                     "targets": {
                         "type": "object",
+                        "description": (
+                            "HPA-style target values; at least one is "
+                            "required while enabled."),
                         "properties": {
-                            "queueLength": {"type": "number", "minimum": 0},
+                            "queueLength": {
+                                "type": "number", "minimum": 0,
+                                "description": (
+                                    "Waiting requests per replica "
+                                    "(prefill-pressure signal)."),
+                            },
                             "kvCacheUtilization": {
                                 "type": "number",
-                                "minimum": 0,
-                                "maximum": 1,
+                                "minimum": 0, "maximum": 1,
+                                "description": (
+                                    "Mean KV-cache usage fraction "
+                                    "(decode-pressure signal)."),
                             },
-                            "ttftP90Seconds": {"type": "number", "minimum": 0},
+                            "ttftP90Seconds": {
+                                "type": "number", "minimum": 0,
+                                "description": (
+                                    "Windowed p90 TTFT target "
+                                    "(prefill-pressure signal)."),
+                            },
                         },
                     },
-                    "scaleUpStabilizationSeconds": {"type": "number", "minimum": 0},
-                    "scaleDownStabilizationSeconds": {"type": "number", "minimum": 0},
-                    "drainDeadlineSeconds": {"type": "number", "minimum": 0},
+                    "scaleUpStabilizationSeconds": {
+                        "type": "number", "minimum": 0,
+                        "description": (
+                            "Window a scale-up recommendation must "
+                            "hold before applying (0 = immediate)."),
+                    },
+                    "scaleDownStabilizationSeconds": {
+                        "type": "number", "minimum": 0,
+                        "description": (
+                            "Window holding the MAX recommendation "
+                            "before shrinking (HPA semantics)."),
+                    },
+                    "drainDeadlineSeconds": {
+                        "type": "number", "minimum": 0,
+                        "description": (
+                            "How long a shrink victim may drain "
+                            "in-flight work before the scale-down is "
+                            "abandoned."),
+                    },
                 },
             },
             "strategy": {
                 "type": "string",
                 "enum": [s.value for s in RoutingStrategy],
+                "description": (
+                    "Routing strategy the rendered EndpointPickerConfig "
+                    "implements (router roles only)."),
             },
-            "httproute": _RAW,
-            "gateway": _RAW,
-            "endpointPickerConfig": {"type": "string"},
+            "httproute": _raw(
+                "Raw HTTPRouteSpec passthrough for the rendered route."),
+            "gateway": _raw(
+                "Raw Gateway passthrough; rendered verbatim when set."),
+            "endpointPickerConfig": {
+                "type": "string",
+                "description": (
+                    "Literal EndpointPickerConfig YAML; wins outright "
+                    "over strategy when set."),
+            },
         },
     }
 
@@ -91,34 +285,84 @@ def _role_schema() -> dict:
 def _status_schema() -> dict:
     return {
         "type": "object",
+        "description": "Observed state, written by the controller only.",
         "properties": {
             "conditions": {
                 "type": "array",
+                "description": (
+                    "Standard condition list (Active/Degraded/"
+                    "ScalingActive/ScalingLimited vocabulary)."),
                 "items": {
                     "type": "object",
                     "required": ["type", "status"],
+                    "description": "One observed condition.",
                     "properties": {
-                        "type": {"type": "string"},
-                        "status": {"type": "string"},
-                        "reason": {"type": "string"},
-                        "message": {"type": "string"},
-                        "observedGeneration": {"type": "integer"},
-                        "lastTransitionTime": {"type": "string"},
+                        "type": {
+                            "type": "string",
+                            "description": "Condition type.",
+                        },
+                        "status": {
+                            "type": "string",
+                            "description": "True/False/Unknown.",
+                        },
+                        "reason": {
+                            "type": "string",
+                            "description": "CamelCase reason code.",
+                        },
+                        "message": {
+                            "type": "string",
+                            "description": "Human-readable detail.",
+                        },
+                        "observedGeneration": {
+                            "type": "integer",
+                            "description": (
+                                "Spec generation this condition "
+                                "reflects."),
+                        },
+                        "lastTransitionTime": {
+                            "type": "string",
+                            "description": "RFC3339 transition stamp.",
+                        },
                     },
                 },
             },
             "componentStatus": {
                 "type": "object",
+                "description": "Per-role readiness rollup, keyed by role.",
                 "additionalProperties": {
                     "type": "object",
+                    "description": "One role's rollup.",
                     "properties": {
-                        "desiredReplicas": {"type": "integer"},
-                        "readyReplicas": {"type": "integer"},
-                        "nodesPerReplica": {"type": "integer"},
-                        "totalPods": {"type": "integer"},
-                        "readyPods": {"type": "integer"},
-                        "phase": {"type": "string"},
-                        "lastUpdateTime": {"type": "string"},
+                        "desiredReplicas": {
+                            "type": "integer",
+                            "description": "Replicas the spec asks for.",
+                        },
+                        "readyReplicas": {
+                            "type": "integer",
+                            "description": (
+                                "Replicas whose every host is ready."),
+                        },
+                        "nodesPerReplica": {
+                            "type": "integer",
+                            "description": "Hosts per replica (slice).",
+                        },
+                        "totalPods": {
+                            "type": "integer",
+                            "description": "Pods across all replicas.",
+                        },
+                        "readyPods": {
+                            "type": "integer",
+                            "description": "Ready pods across replicas.",
+                        },
+                        "phase": {
+                            "type": "string",
+                            "description": (
+                                "Pending/Deploying/Running/Failed."),
+                        },
+                        "lastUpdateTime": {
+                            "type": "string",
+                            "description": "RFC3339 update stamp.",
+                        },
                     },
                 },
             },
@@ -158,19 +402,40 @@ def build_crd() -> dict:
                     "schema": {
                         "openAPIV3Schema": {
                             "type": "object",
+                            "description": (
+                                "A deployed inference service: engine "
+                                "roles on TPU slices plus the routing "
+                                "layer in front of them."),
                             "properties": {
-                                "apiVersion": {"type": "string"},
-                                "kind": {"type": "string"},
-                                "metadata": {"type": "object"},
+                                "apiVersion": {
+                                    "type": "string",
+                                    "description": (
+                                        "API schema version of this "
+                                        "object."),
+                                },
+                                "kind": {
+                                    "type": "string",
+                                    "description": "Always InferenceService.",
+                                },
+                                "metadata": {
+                                    "type": "object",
+                                    "description": "Standard object metadata.",
+                                },
                                 "spec": {
                                     "type": "object",
                                     "required": ["roles"],
+                                    "description": "Desired service shape.",
                                     "properties": {
                                         "roles": {
                                             "type": "array",
                                             "minItems": 1,
+                                            "description": (
+                                                "The service's components "
+                                                "(router + worker-like "
+                                                "roles)."),
                                             "items": _role_schema(),
-                                        }
+                                        },
+                                        "sloTiers": _slo_tiers_schema(),
                                     },
                                 },
                                 "status": _status_schema(),

@@ -127,16 +127,25 @@ def build_loader_crd() -> dict:
                                 "spec": {
                                     "type": "object",
                                     "required": ["source", "destination"],
+                                    "description": "Desired download/convert job.",
                                     "properties": {
                                         "source": {
                                             "type": "object",
+                                            "description": "Where the weights come from.",
                                             "properties": {
                                                 "hf": {
                                                     "type": "object",
                                                     "required": ["repo"],
+                                                    "description": "HuggingFace Hub source.",
                                                     "properties": {
-                                                        "repo": {"type": "string"},
-                                                        "revision": {"type": "string"},
+                                                        "repo": {
+                                                            "type": "string",
+                                                            "description": "Hub repo id (org/name).",
+                                                        },
+                                                        "revision": {
+                                                            "type": "string",
+                                                            "description": "Branch, tag, or commit (default main).",
+                                                        },
                                                     },
                                                 }
                                             },
@@ -144,13 +153,26 @@ def build_loader_crd() -> dict:
                                         "destination": {
                                             "type": "object",
                                             "required": ["pvc"],
+                                            "description": "Where the weights land.",
                                             "properties": {
-                                                "pvc": {"type": "string"},
-                                                "path": {"type": "string"},
+                                                "pvc": {
+                                                    "type": "string",
+                                                    "description": "PersistentVolumeClaim the job mounts.",
+                                                },
+                                                "path": {
+                                                    "type": "string",
+                                                    "description": "Absolute path inside the PVC (default /models).",
+                                                },
                                             },
                                         },
-                                        "convert": {"type": "boolean"},
-                                        "image": {"type": "string"},
+                                        "convert": {
+                                            "type": "boolean",
+                                            "description": "Also convert to the native orbax format TPU serving restores fastest.",
+                                        },
+                                        "image": {
+                                            "type": "string",
+                                            "description": "Loader job image (must carry the loader deps).",
+                                        },
                                     },
                                 },
                                 "status": raw,

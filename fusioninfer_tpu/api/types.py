@@ -220,6 +220,121 @@ class AutoscalingSpec:
 
 
 @dataclass
+class SLOTierSpec:
+    """One service-level traffic class (``spec.sloTiers.tiers[*]``).
+
+    ``priority`` is the scheduling key requests of this tier carry
+    (vLLM semantics: lower value = more urgent, last to be preempted);
+    ``budgetShare`` is the fraction of every engine step's token budget
+    reserved for the tier while it has pending work (work-conserving:
+    an idle tier's share is borrowable); ``queueBound`` is the
+    admission-queue depth past which the server sheds the tier's
+    requests with 429 + Retry-After instead of letting them time out
+    mid-stream.  TTFT/TPOT targets are recorded SLOs — the fleet
+    harness and record checkers gate against them."""
+
+    name: str
+    priority: int = 0
+    budget_share: float = 0.0
+    queue_bound: int = 256
+    retry_after_s: float = 1.0
+    ttft_p90_s: Optional[float] = None
+    tpot_p90_s: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SLOTierSpec":
+        return cls(
+            name=d.get("name", ""),
+            priority=int(d.get("priority", 0)),
+            budget_share=float(d.get("budgetShare", 0.0)),
+            queue_bound=int(d.get("queueBound", 256)),
+            retry_after_s=float(d.get("retryAfterSeconds", 1.0)),
+            ttft_p90_s=(float(d["ttftP90Seconds"])
+                        if "ttftP90Seconds" in d else None),
+            tpot_p90_s=(float(d["tpotP90Seconds"])
+                        if "tpotP90Seconds" in d else None),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"name": self.name, "priority": self.priority}
+        if self.budget_share:
+            out["budgetShare"] = self.budget_share
+        if self.queue_bound != 256:
+            out["queueBound"] = self.queue_bound
+        if self.retry_after_s != 1.0:
+            out["retryAfterSeconds"] = self.retry_after_s
+        if self.ttft_p90_s is not None:
+            out["ttftP90Seconds"] = self.ttft_p90_s
+        if self.tpot_p90_s is not None:
+            out["tpotP90Seconds"] = self.tpot_p90_s
+        return out
+
+
+@dataclass
+class SLOTiersSpec:
+    """Service-level SLO tiers (``spec.sloTiers``): named traffic
+    classes (interactive / batch / ...) with scheduling priority,
+    per-step token-budget shares, admission-queue bounds, and latency
+    targets.  Flows into the rendered EndpointPickerConfig (the picker
+    holds saturated engines softly per tier) and the engine servers
+    (``slo_tier`` request field → ``Request.priority``, per-tier
+    metrics, tier-share budget enforcement with KV-preserving
+    preemption — docs/design/scheduler.md)."""
+
+    tiers: list[SLOTierSpec] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SLOTiersSpec":
+        return cls(tiers=[SLOTierSpec.from_dict(t)
+                          for t in d.get("tiers", [])])
+
+    def to_dict(self) -> dict:
+        return {"tiers": [t.to_dict() for t in self.tiers]}
+
+    def validate(self) -> None:
+        if not self.tiers:
+            raise ValidationError("sloTiers.tiers must not be empty")
+        names: set[str] = set()
+        prios: set[int] = set()
+        for t in self.tiers:
+            if not t.name:
+                raise ValidationError("every SLO tier needs a name")
+            if t.name in names:
+                raise ValidationError(f"duplicate SLO tier name {t.name!r}")
+            names.add(t.name)
+            if t.priority in prios:
+                raise ValidationError(
+                    f"SLO tier {t.name!r}: duplicate priority "
+                    f"{t.priority} (tiers map 1:1 onto priority classes)")
+            prios.add(t.priority)
+            if not 0.0 <= t.budget_share <= 1.0:
+                raise ValidationError(
+                    f"SLO tier {t.name!r}: budgetShare must be in [0, 1]")
+            if t.queue_bound < 1:
+                raise ValidationError(
+                    f"SLO tier {t.name!r}: queueBound must be >= 1")
+            if t.retry_after_s < 0:
+                # 0 is legal (retry immediately) and matches the CRD
+                # schema's minimum — a schema-valid manifest must never
+                # fail typed validation at reconcile time
+                raise ValidationError(
+                    f"SLO tier {t.name!r}: retryAfterSeconds must be >= 0")
+            for label, v in (("ttftP90Seconds", t.ttft_p90_s),
+                             ("tpotP90Seconds", t.tpot_p90_s)):
+                # negatives only: the CRD schema's minimum is inclusive
+                # 0, and a schema-valid manifest must never fail typed
+                # validation at reconcile time
+                if v is not None and v < 0:
+                    raise ValidationError(
+                        f"SLO tier {t.name!r}: {label} must be >= 0")
+        total = sum(t.budget_share for t in self.tiers)
+        if total > 1.0 + 1e-9:
+            raise ValidationError(
+                f"sloTiers budget shares sum to {total:.3f} > 1.0 "
+                "(shares are fractions of one step budget)")
+
+
+@dataclass
 class Role:
     name: str
     component_type: ComponentType
@@ -353,6 +468,9 @@ class ComponentStatus:
 @dataclass
 class InferenceServiceSpec:
     roles: list[Role] = field(default_factory=list)
+    # service-level SLO tiers (interactive/batch traffic classes); None
+    # keeps the single-class behavior every release before it shipped
+    slo_tiers: Optional[SLOTiersSpec] = None
 
     def worker_roles(self) -> list[Role]:
         return [r for r in self.roles if r.component_type.is_worker_like]
@@ -362,10 +480,17 @@ class InferenceServiceSpec:
 
     @classmethod
     def from_dict(cls, d: dict) -> "InferenceServiceSpec":
-        return cls(roles=[Role.from_dict(r) for r in d.get("roles", [])])
+        return cls(
+            roles=[Role.from_dict(r) for r in d.get("roles", [])],
+            slo_tiers=(SLOTiersSpec.from_dict(d["sloTiers"])
+                       if d.get("sloTiers") else None),
+        )
 
     def to_dict(self) -> dict:
-        return {"roles": [r.to_dict() for r in self.roles]}
+        out: dict[str, Any] = {"roles": [r.to_dict() for r in self.roles]}
+        if self.slo_tiers is not None:
+            out["sloTiers"] = self.slo_tiers.to_dict()
+        return out
 
 
 @dataclass
@@ -453,3 +578,5 @@ class InferenceService:
             raise ValidationError(
                 "prefiller and decoder roles must be declared together for PD disaggregation"
             )
+        if self.spec.slo_tiers is not None:
+            self.spec.slo_tiers.validate()

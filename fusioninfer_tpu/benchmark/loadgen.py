@@ -148,16 +148,27 @@ def scrape_prefix_hit_rate(base_url: str, timeout: float = 10.0) -> float | None
 
 
 def _timed_request(base_url: str, prompt: str, output_len: int,
-                   timeout: float, seed: int) -> tuple[float | None, int,
-                                                       str | None]:
-    """One streaming completion → (ttft_s, chunks, error_kind)."""
-    body = json.dumps({
+                   timeout: float, seed: int,
+                   slo_tier: str = "",
+                   deadline_s: float | None = None) -> tuple[float | None,
+                                                             int,
+                                                             str | None]:
+    """One streaming completion → (ttft_s, chunks, error_kind).
+    ``slo_tier`` / ``deadline_s`` ride as the server's extension fields
+    (tier-aware scheduling + admission-time deadline shed); a 429 shed
+    classifies as ``http_429`` like any other HTTP error."""
+    payload = {
         "prompt": prompt,
         "max_tokens": output_len,
         "temperature": 0.8,
         "seed": seed,
         "stream": True,
-    }).encode()
+    }
+    if slo_tier:
+        payload["slo_tier"] = slo_tier
+    if deadline_s is not None:
+        payload["deadline_s"] = deadline_s
+    body = json.dumps(payload).encode()
     req = urllib.request.Request(
         f"{base_url}/v1/completions", data=body,
         headers={"Content-Type": "application/json"},
@@ -221,6 +232,28 @@ def poisson_arrivals(
         t += float(rng.exponential(1.0 / rate))
         out.append(t)
     return out
+
+
+def mixed_slo_arrivals(
+    strata: dict[str, tuple[int, float]], seed: int,
+    burst_factor: float = 4.0,
+) -> list[tuple[float, str, int]]:
+    """Deterministic mixed-SLO OPEN-LOOP plan: per-tier seeded Poisson
+    arrival schedules merged into one time-ordered list of
+    ``(at_s, tier, index_within_tier)``.  ``strata`` maps a tier name
+    to ``(n_requests, rate_rps)``; summing the rates past the fleet's
+    serving ceiling is how the overload phase offers more load than
+    the fleet can absorb (fusioninfer_tpu.fleetsim) — arrivals never
+    wait for completions, so queues build, 429 backpressure sheds, and
+    the tier ledger preempts, exactly like production saturation."""
+    plan: list[tuple[float, str, int]] = []
+    for k, name in enumerate(sorted(strata)):
+        n, rate = strata[name]
+        offsets = poisson_arrivals(n, rate, seed + 7919 * (k + 1),
+                                   burst_factor=burst_factor)
+        plan.extend((at, name, i) for i, at in enumerate(offsets))
+    plan.sort()
+    return plan
 
 
 def fire_open_loop(arrivals: list[float], fire) -> None:

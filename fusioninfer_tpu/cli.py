@@ -269,6 +269,13 @@ def build_parser() -> argparse.ArgumentParser:
                        help="int8: quantized KV pages — half the decode "
                             "attention HBM traffic, ~2x the page pool "
                             "(single-device; PD roles need bf16 pages)")
+    serve.add_argument("--slo-tiers", default="",
+                       help="SLO tiers as JSON (the spec.sloTiers object "
+                            "or its bare tiers list): requests may then "
+                            "carry slo_tier, the server enforces per-tier "
+                            "queue bounds with 429 + Retry-After, and the "
+                            "scheduler reserves per-tier token-budget "
+                            "shares (docs/design/scheduler.md)")
     serve.add_argument("--enable-profiling", action="store_true",
                        help="expose /debug/profile (writes to FUSIONINFER_PROFILE_DIR)")
     serve.add_argument("--lora", action="append", default=[],

@@ -108,8 +108,24 @@ class Request:
     lora: str = ""
     # Set on preemption: prompt + tokens generated so far.  On re-admission
     # the whole prefix is re-prefilled so generation continues exactly where
-    # the client stream left off (no token splicing, RNG-safe).
+    # the client stream left off (no token splicing, RNG-safe).  With
+    # prefix caching the re-prefill hits the pages the preemption PARKED
+    # (HBM-evictable, or host-tier-restored), so resume costs at most
+    # one page of recompute instead of the whole prefix.
     resume_tokens: Optional[list[int]] = None
+    # set by every preemption path (mid-decode AND mid-prefill, where
+    # resume_tokens stays None because no tokens were emitted yet);
+    # cleared when the re-admission is counted in the preempt-resume
+    # ledger so one preemption counts one resume
+    was_preempted: bool = False
+    # wall budget: relative seconds (the request's deadline_s field);
+    # add_request stamps the absolute ``deadline`` on the engine clock.
+    # A queued request whose deadline already passed is shed at
+    # admission pop (sched_deadline_shed_total) instead of burning
+    # prefill budget it can only fail mid-stream with.  Single-process
+    # only — a clock read in the scheduler would diverge SPMD lockstep.
+    deadline_s: Optional[float] = None
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -228,6 +244,19 @@ class _WaitQueue:
             self._heap = kept
             heapq.heapify(self._heap)
         return removed
+
+    def priorities(self) -> set[int]:
+        """Priority classes with waiting work (the SLO-tier ledger's
+        pending set; caller holds the engine lock)."""
+        return {e[0] for e in self._heap}
+
+    def counts_by_priority(self) -> dict[int, int]:
+        """Waiting requests per priority class (the server's tier-aware
+        429 backpressure signal; caller holds the engine lock)."""
+        out: dict[int, int] = {}
+        for e in self._heap:
+            out[e[0]] = out.get(e[0], 0) + 1
+        return out
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -524,6 +553,14 @@ class NativeEngine:
             # chunking threshold (any longer prompt streams in chunks)
             self.prefill_chunk = token_budget
         self._step_prefill_left = 0  # set by step(); spent by _admit
+        # SLO-tier budget ledger (docs/design/scheduler.md "Overload and
+        # SLO tiers"): {priority: budget_share} installed by the server
+        # from the service's sloTiers stanza.  Empty = single-class
+        # serving, zero behavior change.  Per-step reserve/spent maps
+        # are rebuilt by _begin_tier_step.
+        self._tier_shares: dict[int, float] = {}
+        self._step_tier_reserve: dict[int, int] = {}
+        self._step_tier_spent: dict[int, int] = {}
         self.prefilling: list[_PrefillingState] = []  # FCFS chunk queue
         if speculative_k is not None and speculative_k < 1:
             raise ValueError("speculative_k must be >= 1")
@@ -623,6 +660,20 @@ class NativeEngine:
         if self.prefill_chunk is None:
             self.prefill_chunk = tokens_per_step
 
+    def set_slo_tiers(self, shares: dict[int, float]) -> None:
+        """Install per-priority-class budget shares ({priority: share},
+        fractions of one step budget summing to <= 1).  While a tier
+        has pending work its reserve is untouchable by other tiers;
+        idle reserves are borrowable (work-conserving) — so batch can
+        never starve interactive admission, and interactive never
+        wastes batch's idle share.  Requires a token budget to mean
+        anything (shares partition the per-step prefill remainder)."""
+        total = sum(shares.values())
+        if any(s < 0 for s in shares.values()) or total > 1.0 + 1e-9:
+            raise ValueError(
+                f"tier shares must be >= 0 and sum to <= 1, got {shares}")
+        self._tier_shares = dict(shares)
+
     def calibrate_token_budget(self, target_step_s: float = 0.05,
                                floor: int = 32, cap: int = 4096) -> int:
         """Derive the token budget from MEASURED step latency: time one
@@ -698,6 +749,10 @@ class NativeEngine:
             # for FCFS ordering and queue-wait timing); stamped BEFORE
             # the multihost broadcast so followers replay the leader's
             self.stamp_arrival(request)
+        if request.deadline is None and request.deadline_s is not None:
+            # absolute deadline on the same clock domain as arrival so
+            # the admission-time shed compares like against like
+            request.deadline = request.arrival_time + request.deadline_s
         if self._mh is not None:
             # multi-process mesh: route through the leader's event stream
             # so every process's scheduler replays the same admission
@@ -1229,8 +1284,11 @@ class NativeEngine:
             # trickle: a step remainder smaller than one page (derived
             # budgets can sit below page_size) must not pin restores at
             # zero forever — one H2D page copy per step is negligible
-            # next to recomputing those tokens as prefill chunks
-            max_blocks = max(1, self._step_prefill_left // ps)
+            # next to recomputing those tokens as prefill chunks.
+            # Tier-aware: a restore is prefill work and spends the
+            # requesting tier's allowance, not another tier's reserve.
+            max_blocks = max(
+                1, self._tier_prefill_left(request.priority) // ps)
             if len(plan) > max_blocks:
                 deferred = True
                 plan = plan[:max_blocks]
@@ -1288,7 +1346,7 @@ class NativeEngine:
         )
         self.cache = inject_slab(self.cache, combined, pages)
         n_tokens = len(pages) * ps
-        self._reserve_prefill(n_tokens)
+        self._reserve_prefill(n_tokens, prio=request.priority)
         self.sched.kv_restores_total += len(pages)
         self.sched.kv_restore_tokens_total += n_tokens
         tier.note_restored(len(pages))
@@ -1417,12 +1475,17 @@ class NativeEngine:
             # speculative rows verify up to spec_k drafts + 1 token per
             # step: charge the worst case so the prefill remainder can
             # never let a step blow the budget (conservative — shrunken
-            # drafts just leave some budget unspent)
+            # drafts just leave some budget unspent).  Tier enforcement
+            # runs FIRST: a batch-saturated batch yields rows (KV
+            # parked) before the decode charge is struck, so the freed
+            # budget is visible to this very step's admission.
+            self._tier_budget_evict()
             per_row = 1 + (self.spec_k or 0)
             self._step_prefill_left = self.sched.begin_step(
                 per_row * sum(1 for st in self.running.values()
                               if st.n_generated
                               < st.request.params.max_tokens))
+            self._begin_tier_step()
             outputs += self._admit()
             if self._use_fused_step():
                 # both row kinds exist: ONE weight pass covers this
@@ -1472,6 +1535,106 @@ class NativeEngine:
 
     # -- scheduling ----------------------------------------------------------
 
+    def waiting_by_priority(self) -> dict[int, int]:
+        """Queued pre-first-token requests per priority class — the
+        server's tier-aware 429 backpressure signal: the wait queue
+        PLUS mid-chunked-prefill admissions (they hold a reserved slot
+        and step budget but have produced nothing a client can see, so
+        they are admission backlog for shed purposes — without them a
+        budgeted engine's queue depth reads near-zero under exactly the
+        overload the bound exists for).  PD decode engines queue in
+        ``waiting_prefilled`` instead of the wait heap, so that deque
+        counts too (mirroring ``num_waiting``).  The prefilling list is
+        engine-thread-owned; the lock-free snapshot tolerates a tick of
+        staleness like every other gauge read."""
+        with self._lock:
+            out = self.waiting.counts_by_priority()
+            for request, _slab in self.waiting_prefilled:
+                out[request.priority] = out.get(request.priority, 0) + 1
+        for st in list(self.prefilling):
+            p = st.request.priority
+            out[p] = out.get(p, 0) + 1
+        return out
+
+    def _tier_pending_priorities(self) -> set[int]:
+        """Priority classes with prefill work still pending this step
+        (waiting or mid-chunked-prefill) — the set whose reserves are
+        NOT borrowable right now."""
+        out = {st.request.priority for st in self.prefilling}
+        with self._lock:
+            out |= self.waiting.priorities()
+        return out
+
+    def _begin_tier_step(self) -> None:
+        """Partition the step's prefill remainder into per-tier
+        reserves (floor(share × remainder)); the unreserved slack is
+        first-come within urgency order."""
+        self._step_tier_spent = {}
+        if not self._tier_shares or self.sched.tokens_per_step is None:
+            self._step_tier_reserve = {}
+            return
+        left = self._step_prefill_left
+        self._step_tier_reserve = {
+            p: int(s * left) for p, s in self._tier_shares.items()}
+
+    def _tier_prefill_left(self, prio: int) -> int:
+        """Prefill tokens tier ``prio`` may still spend this step: the
+        global remainder minus the unspent reserves of OTHER tiers that
+        still have pending work (work-conserving borrowing: an idle
+        tier's reserve is fair game, a busy tier's is untouchable)."""
+        left = self._step_prefill_left
+        if not self._step_tier_reserve:
+            return left
+        pending = self._tier_pending_priorities()
+        for p, res in self._step_tier_reserve.items():
+            if p == prio or p not in pending:
+                continue
+            left -= max(0, res - self._step_tier_spent.get(p, 0))
+        return max(0, left)
+
+    def _note_tier_spend(self, prio: int, n: int) -> None:
+        if self._step_tier_reserve:
+            self._step_tier_spent[prio] = (
+                self._step_tier_spent.get(prio, 0) + n)
+
+    def _tier_budget_evict(self) -> None:
+        """Mid-stream tier enforcement: while a MORE urgent tier has
+        waiting work and the running batch's decode charge squeezes the
+        step's prefill remainder below that tier's guaranteed share,
+        preempt the least urgent strictly-less-urgent running sequence
+        (its KV parks — ``_park_preempted`` — so the yield costs a
+        restore, not a recompute).  This is how batch yields token
+        budget AND KV pages to interactive traffic mid-stream instead
+        of at request boundaries."""
+        if not self._tier_shares or self.sched.tokens_per_step is None:
+            return
+        with self._lock:
+            pending = self.waiting.priorities()
+        if not pending:
+            return
+        p_min = min(pending)
+        share = self._tier_shares.get(p_min, 0.0)
+        if share <= 0.0:
+            return
+        budget = self.sched.tokens_per_step
+        guaranteed = int(share * budget)
+        per_row = 1 + (self.spec_k or 0)
+
+        def prefill_avail() -> int:
+            live = sum(1 for st in self.running.values()
+                       if st.n_generated < st.request.params.max_tokens)
+            return budget - per_row * live
+
+        while prefill_avail() < guaranteed:
+            cands = [s for s, st in self.running.items()
+                     if st.request.priority > p_min]
+            if not cands:
+                return
+            slot = max(cands,
+                       key=lambda s: _urgency(self.running[s].request))
+            self._preempt_running_slot(slot)
+            self.sched.tier_preemptions_total += 1
+
     def _admit(self) -> list[StepOutput]:
         """Admit waiting requests in urgency order (priority class, then
         FCFS) while slots and pages allow.
@@ -1510,6 +1673,19 @@ class NativeEngine:
                     break
                 request = self.waiting.pop()
             now = self._clock()
+            if (request.deadline is not None and self._mh is None
+                    and now > request.deadline):
+                # dead on arrival at the head of the queue: prefilling
+                # it would burn budget on a stream that can only fail
+                # mid-flight (the server watchdog would abort it) —
+                # shed NOW and spend the budget on live work instead.
+                # Single-process only: the clock read would diverge a
+                # multi-host SPMD lockstep group's schedulers.
+                self.sched.deadline_shed_total += 1
+                outputs.append(self._fail_admission(
+                    request,
+                    ValueError("deadline expired before admission")))
+                continue
             self._admit_t[request.request_id] = (
                 now, max(0.0, now - request.arrival_time))
             prefix = request.resume_tokens or request.prompt_tokens
@@ -1583,14 +1759,31 @@ class NativeEngine:
                     self.alloc.release(rid)
                     outputs.append(self._fail_admission(request, e))
                     continue
+                if resumed or request.was_preempted:
+                    # KV-preserving preemption closes its loop here: the
+                    # re-admission's match_prefix just re-acquired the
+                    # pages the preemption parked (or the host-tier
+                    # consult restored), so only the unparked tail
+                    # recomputes — the ledger proves what resume reused.
+                    # Mid-prefill victims carry no resume_tokens (no
+                    # token ever reached the client) but their parked
+                    # chunk progress re-acquires the same way, so the
+                    # was_preempted flag counts them too.
+                    request.was_preempted = False
+                    self.sched.preempt_resumes_total += 1
+                    self.sched.preempt_resume_reused_tokens_total += reused
                 suffix_len = len(prefix) - reused
                 # budget gate: even a SHORT suffix defers to the chunked
                 # queue once this step's prefill remainder is spent —
                 # admission work never exceeds the budget in one step
                 # (the Sarathi stall-free property; the deferred request
-                # starts chunking this same step in _advance_prefilling)
-                over_budget = (self.sched.tokens_per_step is not None
-                               and suffix_len > self._step_prefill_left)
+                # starts chunking this same step in _advance_prefilling).
+                # Tier-aware: another tier's unspent reserve is off
+                # limits while that tier has pending work of its own.
+                over_budget = (
+                    self.sched.tokens_per_step is not None
+                    and suffix_len > self._tier_prefill_left(
+                        request.priority))
                 if (self.prefill_chunk is not None
                         and (suffix_len > self.prefill_chunk or over_budget)):
                     # long fresh prompt or long cache-miss suffix: write it
@@ -1604,7 +1797,8 @@ class NativeEngine:
                         pos=reused,
                     ))
                 elif reused:
-                    self._reserve_prefill(suffix_len)
+                    self._reserve_prefill(suffix_len,
+                                          prio=request.priority)
                     if suffix_len <= _SUFFIX_BATCH_WINDOW:
                         # short suffix: batch with other hits through one
                         # verify_step forward (the common prefix-cache
@@ -1620,7 +1814,8 @@ class NativeEngine:
                         self.alloc.release(rid)
                         outputs.append(self._fail_admission(request, e))
                 else:
-                    self._reserve_prefill(suffix_len)
+                    self._reserve_prefill(suffix_len,
+                                          prio=request.priority)
                     seen_prompts.add(key)
                     fresh.append((request, prefix, resumed))
 
@@ -1726,10 +1921,13 @@ class NativeEngine:
             return False
         if pick_prefilling:
             st = self.prefilling.pop(pf_idx)
+            # park the chunk progress: the written pages register as
+            # content so the re-admission's match_prefix picks the
+            # prefill back up where it stopped instead of restarting
+            self._park_preempted(st.request, st.prefix, st.pos)
             self.alloc.release(st.request.request_id)
             self.preemptions_total += 1
-            # chunk progress is discarded; on re-admission the prefix
-            # re-prefills from scratch (resume state preserved verbatim)
+            st.request.was_preempted = True
             if st.resumed:
                 st.request.resume_tokens = list(st.prefix)
             with self._lock:
@@ -1740,14 +1938,59 @@ class NativeEngine:
         self._preempt_running_slot(slot)
         return True
 
+    def _park_preempted(self, request: Request, tokens: list[int],
+                        written: int) -> None:
+        """KV-preserving preemption: before a victim's pages are
+        released, register its complete written pages as
+        content-addressed blocks (the same chain its RESUME will look
+        up), and — when a host tier is wired — offload them now.  The
+        pages then survive release as evictable content: resume hits
+        them via the ordinary match_prefix / host-restore path and
+        recomputes at most the last partial page, bit-identically
+        (restored pages hold the exact bytes decode wrote).  Every
+        fault on the park path degrades to today's behavior — a full
+        recompute from the resume prefix.
+
+        ``written`` is the count of positions whose KV is actually in
+        the pages (a running victim's last sampled token has NOT been
+        forwarded yet; a mid-prefill victim has written ``pos``).
+        Sliding-window engines skip parking: trimmed page tables break
+        the page↔block alignment the chain registration needs."""
+        if not self.prefix_caching or self.cfg.sliding_window is not None:
+            return
+        ps = self.cache_cfg.page_size
+        pages = self.alloc.pages_of(request.request_id)
+        usable = min(written // ps, len(pages))
+        if usable <= 0:
+            return
+        ns = self._lora_ns(request)
+        chain = block_hashes(list(tokens), ps, ns)[:usable]
+        self.alloc.register_blocks(request.request_id, list(tokens), ns,
+                                   chain=chain)
+        if self._host_tier is not None:
+            # offload-on-preempt: under the very capacity pressure that
+            # caused the preemption, the parked pages are first in line
+            # for reclaim — snapshot them to the host tier NOW (the
+            # content-dedupe in _offload_page skips blocks the tier
+            # already holds)
+            for page, h in zip(pages[:usable], chain):
+                self._offload_page(page, h)
+        self.sched.preempt_parks_total += 1
+        self.sched.preempt_parked_pages_total += usable
+
     def _preempt_running_slot(self, slot: int) -> None:
-        """Evict one running sequence: pages released, request re-queued
-        with resume state — the client's stream continues seamlessly
-        after re-prefilling the full prefix (prompt + generated)."""
+        """Evict one running sequence: pages parked then released,
+        request re-queued with resume state — the client's stream
+        continues seamlessly after a resume prefill that re-acquires
+        the parked pages (full recompute only when parking was off or
+        the parked content was lost)."""
         state = self.running.pop(slot)
+        self._park_preempted(state.request, state.tokens,
+                             len(state.tokens) - 1)
         self.alloc.release(state.request.request_id)
         self._free_slots.append(slot)
         self.preemptions_total += 1
+        state.request.was_preempted = True
         state.request.resume_tokens = list(state.tokens)
         with self._lock:
             self.waiting.push(state.request)
@@ -2037,20 +2280,24 @@ class NativeEngine:
         n = min(len(self.prefilling), self.max_batch_size)
         return max(self._step_prefill_left, n)
 
-    def _reserve_prefill(self, n: int) -> None:
+    def _reserve_prefill(self, n: int, prio: Optional[int] = None) -> None:
         """Reserve ``n`` tokens of this STEP's prefill remainder at
         classification time, so later pops in the same admission round
         see the budget already claimed.  The lifetime ledger
         (``sched.charge_prefill``) is charged separately, AFTER the
         forward succeeds — a failed forward spends the step's reservation
         (the step did attempt the work) but must never inflate the
-        lifetime spent-token counters."""
+        lifetime spent-token counters.  ``prio`` attributes the spend to
+        its SLO tier's per-step ledger."""
         self._step_prefill_left = max(0, self._step_prefill_left - n)
+        if prio is not None:
+            self._note_tier_spend(prio, n)
 
-    def _spend_prefill(self, n: int, chunks: int = 0) -> None:
+    def _spend_prefill(self, n: int, chunks: int = 0,
+                       prio: Optional[int] = None) -> None:
         """Reserve + charge in one call — the chunk-advance paths, where
         the forward has already succeeded when this runs."""
-        self._reserve_prefill(n)
+        self._reserve_prefill(n, prio=prio)
         self.sched.charge_prefill(n, chunks=chunks)
 
     def _advance_prefilling(self) -> list[StepOutput]:
@@ -2072,13 +2319,19 @@ class NativeEngine:
         if len(self.prefilling) == 1:
             st = self.prefilling[0]
             rid = st.request.request_id
+            prio = st.request.priority
             try:
-                chunk = min(budget, len(st.prefix) - st.pos)
+                # tier cap, floored at the 1-token trickle: another
+                # tier's pending reserve bounds this chunk, but a
+                # zero-allowance tier must still inch forward (the
+                # stall-free property tiers must not break)
+                chunk = max(1, min(budget, len(st.prefix) - st.pos,
+                                   max(1, self._tier_prefill_left(prio))))
                 logits = self._suffix_forward(st.request, st.prefix,
                                               st.pos, chunk)
                 # charged after the forward: a failed chunk must not
                 # count as spent work
-                self._spend_prefill(chunk, chunks=1)
+                self._spend_prefill(chunk, chunks=1, prio=prio)
                 st.pos += chunk
                 if st.pos == len(st.prefix):
                     self.prefilling.pop(0)
@@ -2097,10 +2350,19 @@ class NativeEngine:
 
     def _advance_prefilling_batch(self, budget: int) -> list[StepOutput]:
         """One batched chunk forward for all prefilling sequences; the
-        step's prefill budget splits evenly across them (≥ 1 each)."""
+        step's prefill budget splits evenly across them (≥ 1 each),
+        then caps per SLO tier: a tier's entries split what the tier
+        ledger still allows it, floored at the 1-token trickle."""
         take = list(self.prefilling[: self.max_batch_size])
         share = max(1, budget // len(take))
-        chunks = [min(share, len(st.prefix) - st.pos) for st in take]
+        tier_n: dict[int, int] = {}
+        for st in take:
+            p = st.request.priority
+            tier_n[p] = tier_n.get(p, 0) + 1
+        tier_cap = {p: max(1, self._tier_prefill_left(p) // n)
+                    for p, n in tier_n.items()}
+        chunks = [min(share, len(st.prefix) - st.pos,
+                      tier_cap[st.request.priority]) for st in take]
         try:
             logits = self._batched_window_forward(
                 [(st.request, st.prefix[st.pos : st.pos + chunks[i]], st.pos)
@@ -2118,6 +2380,8 @@ class NativeEngine:
         # charged after the forward: a failed batch must not count as
         # spent work
         self._spend_prefill(sum(chunks), chunks=len(take))
+        for i, st in enumerate(take):
+            self._note_tier_spend(st.request.priority, chunks[i])
         done = []
         for i, st in enumerate(take):
             st.pos += chunks[i]
@@ -2590,7 +2854,18 @@ class NativeEngine:
             return failures + self._advance_prefilling() + self._decode()
         budget = self._chunk_budget()
         share = max(1, budget // len(take))
-        chunks = [min(share, len(st.prefix) - st.pos) for st in take]
+        # same tier discipline as _advance_prefilling_batch: a tier's
+        # entries split what the tier ledger still allows it, floored
+        # at the 1-token trickle (the fused path is the DEFAULT mixed
+        # interactive+batch path — tier enforcement must ride it too)
+        tier_n: dict[int, int] = {}
+        for st in take:
+            p = st.request.priority
+            tier_n[p] = tier_n.get(p, 0) + 1
+        tier_cap = {p: max(1, self._tier_prefill_left(p) // n)
+                    for p, n in tier_n.items()}
+        chunks = [min(share, len(st.prefix) - st.pos,
+                      tier_cap[st.request.priority]) for st in take]
         ctl = self._decode_controls(live)
         lora = ctl["lora"]
         spec_drafts = self._propose_drafts(live, ctl) if self.spec_k else {}
@@ -2624,6 +2899,8 @@ class NativeEngine:
         # after the forward, completed prefills activate into their
         # reserved slots off their chunk row's last-token logits
         self._spend_prefill(sum(chunks), chunks=len(take))
+        for i, st in enumerate(take):
+            self._note_tier_spend(st.request.priority, chunks[i])
         done = []
         for i, st in enumerate(take):
             st.pos += chunks[i]

@@ -110,6 +110,23 @@ class EngineMetrics:
         # local re-prefill, and watchdog deadline/stall aborts
         self.kv_transfer_fallbacks = 0
         self.watchdog_aborts = 0
+        # per-SLO-tier families, keyed by tier name.  register_tiers
+        # pre-seeds every dict at server construction so the /metrics
+        # exposition (HTTP thread) never iterates a dict a handler
+        # thread is resizing.
+        self.tier_ttft: dict[str, Histogram] = {}
+        self.tier_tpot: dict[str, Histogram] = {}
+        self.tier_requests: dict[str, int] = {}
+        self.tier_shed: dict[str, int] = {}
+
+    def register_tiers(self, names) -> None:
+        """Install the per-tier metric families for the server's SLO
+        tiers (fixed at construction — tiers never churn mid-serve)."""
+        for name in names:
+            self.tier_ttft[name] = Histogram(TTFT_BUCKETS)
+            self.tier_tpot[name] = Histogram(TPOT_BUCKETS)
+            self.tier_requests[name] = 0
+            self.tier_shed[name] = 0
 
     def render(self, engine) -> str:
         """Text exposition from live engine state + accumulated histograms."""
@@ -173,9 +190,51 @@ class EngineMetrics:
             "# TYPE vllm:e2e_request_latency_seconds histogram",
             *self.e2e_latency.render("vllm:e2e_request_latency_seconds", labels),
         ]
+        lines += self._render_slo_tiers(labels)
         lines += self._render_kv_tiers(engine, labels)
         lines += self._render_scheduler(engine, labels)
         return "\n".join(lines) + "\n"
+
+    def _render_slo_tiers(self, labels: str) -> list[str]:
+        """Per-SLO-tier families (docs/design/scheduler.md "Overload
+        and SLO tiers"): TTFT/TPOT histograms, admission counts, and
+        the 429 backpressure sheds, labeled by tier name.  Servers
+        without tiers configured simply omit the families."""
+        if not self.tier_ttft:
+            return []
+        lines = [
+            "# HELP fusioninfer:tier_requests_total Requests admitted per SLO tier.",
+            "# TYPE fusioninfer:tier_requests_total counter",
+        ]
+        for name in sorted(self.tier_requests):
+            lines.append(
+                f'fusioninfer:tier_requests_total{{{labels},slo_tier="{name}"}} '
+                f"{self.tier_requests[name]}")
+        lines += [
+            "# HELP fusioninfer:tier_shed_total Requests shed with 429 + Retry-After per SLO tier (queue past its bound).",
+            "# TYPE fusioninfer:tier_shed_total counter",
+        ]
+        for name in sorted(self.tier_shed):
+            lines.append(
+                f'fusioninfer:tier_shed_total{{{labels},slo_tier="{name}"}} '
+                f"{self.tier_shed[name]}")
+        lines += [
+            "# HELP fusioninfer:tier_ttft_seconds Time to first token per SLO tier.",
+            "# TYPE fusioninfer:tier_ttft_seconds histogram",
+        ]
+        for name in sorted(self.tier_ttft):
+            lines += self.tier_ttft[name].render(
+                "fusioninfer:tier_ttft_seconds",
+                f'{labels},slo_tier="{name}"')
+        lines += [
+            "# HELP fusioninfer:tier_tpot_seconds Per-token decode latency per SLO tier.",
+            "# TYPE fusioninfer:tier_tpot_seconds histogram",
+        ]
+        for name in sorted(self.tier_tpot):
+            lines += self.tier_tpot[name].render(
+                "fusioninfer:tier_tpot_seconds",
+                f'{labels},slo_tier="{name}"')
+        return lines
 
     @staticmethod
     def _render_kv_tiers(engine, labels: str) -> list[str]:
@@ -284,6 +343,24 @@ class EngineMetrics:
             "# HELP fusioninfer:sched_kv_restore_deferred_total Host-tier restore plans truncated because the step's prefill budget was spent.",
             "# TYPE fusioninfer:sched_kv_restore_deferred_total counter",
             f"fusioninfer:sched_kv_restore_deferred_total{{{labels}}} {sched.kv_restore_deferred_total}",
+            "# HELP fusioninfer:sched_deadline_shed_total Queued requests shed at admission because their deadline had already expired.",
+            "# TYPE fusioninfer:sched_deadline_shed_total counter",
+            f"fusioninfer:sched_deadline_shed_total{{{labels}}} {sched.deadline_shed_total}",
+            "# HELP fusioninfer:sched_tier_preemptions_total Running sequences preempted because their tier squeezed a more urgent tier's budget share.",
+            "# TYPE fusioninfer:sched_tier_preemptions_total counter",
+            f"fusioninfer:sched_tier_preemptions_total{{{labels}}} {sched.tier_preemptions_total}",
+            "# HELP fusioninfer:sched_preempt_parks_total Preemption victims whose computed KV pages were parked (content-registered, host-offloaded) instead of dropped.",
+            "# TYPE fusioninfer:sched_preempt_parks_total counter",
+            f"fusioninfer:sched_preempt_parks_total{{{labels}}} {sched.preempt_parks_total}",
+            "# HELP fusioninfer:sched_preempt_parked_pages_total KV pages parked by preemption victims.",
+            "# TYPE fusioninfer:sched_preempt_parked_pages_total counter",
+            f"fusioninfer:sched_preempt_parked_pages_total{{{labels}}} {sched.preempt_parked_pages_total}",
+            "# HELP fusioninfer:sched_preempt_resumes_total Preempted requests re-admitted to continue their stream.",
+            "# TYPE fusioninfer:sched_preempt_resumes_total counter",
+            f"fusioninfer:sched_preempt_resumes_total{{{labels}}} {sched.preempt_resumes_total}",
+            "# HELP fusioninfer:sched_preempt_resume_reused_tokens_total Resume prefix tokens served from parked/restored pages instead of recompute.",
+            "# TYPE fusioninfer:sched_preempt_resume_reused_tokens_total counter",
+            f"fusioninfer:sched_preempt_resume_reused_tokens_total{{{labels}}} {sched.preempt_resume_reused_tokens_total}",
             "# HELP fusioninfer:sched_fused_steps_total Steps that ran the fused mixed-batch forward (decode + prefill chunks in one weight pass).",
             "# TYPE fusioninfer:sched_fused_steps_total counter",
             f"fusioninfer:sched_fused_steps_total{{{labels}}} {sched.fused_steps_total}",

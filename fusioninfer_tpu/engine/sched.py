@@ -85,6 +85,25 @@ class TokenBudget:
     kv_restores_total: int = 0
     kv_restore_tokens_total: int = 0
     kv_restore_deferred_total: int = 0
+    # overload robustness (docs/design/scheduler.md "Overload and SLO
+    # tiers"): queued requests shed because their deadline expired
+    # before admission (they would only have burned prefill budget and
+    # then failed mid-stream)
+    deadline_shed_total: int = 0
+    # running sequences preempted because their tier's decode load was
+    # squeezing a more urgent tier's reserved budget share (the
+    # mid-stream yield the SLO-tier ledger exists for)
+    tier_preemptions_total: int = 0
+    # KV-preserving preemption ledger: victims whose computed pages were
+    # parked (registered content-addressed + offloaded to the host tier
+    # when one is wired) instead of dropped for full recompute, the
+    # pages parked, and preempted requests re-admitted (with the KV
+    # tokens their resume re-used from parked pages instead of
+    # recomputing)
+    preempt_parks_total: int = 0
+    preempt_parked_pages_total: int = 0
+    preempt_resumes_total: int = 0
+    preempt_resume_reused_tokens_total: int = 0
     # fused mixed-batch steps: decode rows + budgeted prefill chunks in
     # ONE forward (one weight pass instead of one per row-kind)
     fused_steps_total: int = 0
@@ -165,6 +184,13 @@ class TokenBudget:
             "kv_restores": self.kv_restores_total,
             "kv_restore_tokens": self.kv_restore_tokens_total,
             "kv_restore_deferred": self.kv_restore_deferred_total,
+            "deadline_shed": self.deadline_shed_total,
+            "tier_preemptions": self.tier_preemptions_total,
+            "preempt_parks": self.preempt_parks_total,
+            "preempt_parked_pages": self.preempt_parked_pages_total,
+            "preempt_resumes": self.preempt_resumes_total,
+            "preempt_resume_reused_tokens":
+                self.preempt_resume_reused_tokens_total,
             "budget_utilization": round(self.utilization(), 4),
             "fused_steps": self.fused_steps_total,
             "weight_passes": self.weight_passes_total,

@@ -88,6 +88,19 @@ class Draining(Exception):
     balancer retries another replica."""
 
 
+class Overloaded(Exception):
+    """Tier-aware backpressure: the request's SLO tier is past its
+    admission-queue bound, so the server sheds it with 429 +
+    Retry-After instead of queueing it into a guaranteed timeout.  The
+    EPP treats the 429 as a SOFT hold (honor Retry-After, route around
+    the saturated engine) — never a breaker failure."""
+
+    def __init__(self, message: str, retry_after_s: float, tier: str):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.tier = tier
+
+
 class _MultiChannel:
     """Composite of one request's n per-choice channels, so the HTTP
     layer's single ``abort(chan)`` tears every choice down."""
@@ -162,6 +175,7 @@ class EngineServer:
         default_deadline_s: float | None = None,
         watchdog_stall_s: float | None = None,
         watchdog_interval_s: float = 0.05,
+        slo_tiers=None,
     ):
         """``prefill_upstream``: PD-disaggregated decode mode — completions
         pull their prefill (KV slab + first token) from the prefiller
@@ -182,7 +196,16 @@ class EngineServer:
         count toward it — size it well above worst-case TTFT under
         load, or leave it None and rely on deadlines.  Both are enforced
         by a watchdog thread that cancels the request engine-side and
-        fails its channel with an ``error:`` finish."""
+        fails its channel with an ``error:`` finish.
+
+        ``slo_tiers``: the service's SLO tiers (a ``TierTable``, an
+        ``api.types.SLOTiersSpec``, or the raw list of tier dicts from
+        ``spec.sloTiers.tiers``).  Requests may then carry an
+        ``slo_tier`` field that maps onto ``Request.priority``; each
+        tier gets its own TTFT/TPOT metric families, a tier-aware
+        admission-queue bound (past it the server sheds with 429 +
+        Retry-After), and a per-step token-budget share enforced by
+        the engine's tier ledger (docs/design/scheduler.md)."""
         self.model_name = model
         self.prefill_upstream = prefill_upstream
         self.default_deadline_s = default_deadline_s
@@ -212,6 +235,20 @@ class EngineServer:
             if tb is not None:
                 engine.set_guided_vocab(tb)
         self.metrics = EngineMetrics(model)
+        self.slo_tiers = None
+        if slo_tiers is not None:
+            from fusioninfer_tpu.engine.slo import TierTable
+
+            if isinstance(slo_tiers, TierTable):
+                table = slo_tiers
+            else:
+                table = TierTable.from_config(slo_tiers)
+            self.slo_tiers = table
+            if table is not None:
+                self.metrics.register_tiers(table.names())
+                shares = table.shares()
+                if shares and hasattr(engine, "set_slo_tiers"):
+                    engine.set_slo_tiers(shares)
         self.host, self.port = host, port
         self._channels: dict[str, _RequestChannel] = {}
         self._req_meta: dict[str, dict] = {}
@@ -308,10 +345,17 @@ class EngineServer:
                     chan = self._channels.get(out.request_id)
                     meta = self._req_meta.get(out.request_id)
                 if meta is not None:
+                    tname = meta.get("tier")
                     if out.is_first_token:
                         self.metrics.ttft.observe(now - meta["arrival"])
+                        if tname is not None:
+                            self.metrics.tier_ttft[tname].observe(
+                                now - meta["arrival"])
                     else:
                         self.metrics.tpot.observe(now - meta["last_token_time"])
+                        if tname is not None:
+                            self.metrics.tier_tpot[tname].observe(
+                                now - meta["last_token_time"])
                     meta["last_token_time"] = now
                     if out.finished:
                         self.metrics.e2e_latency.observe(now - meta["arrival"])
@@ -405,12 +449,30 @@ class EngineServer:
 
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
                lora: str = "", priority: int = 0,
-               deadline_s: float | None = None) -> _RequestChannel:
+               deadline_s: float | None = None,
+               tier=None) -> _RequestChannel:
         request_id = uuid.uuid4().hex[:16]
         chan = _RequestChannel()
         deadline_s = deadline_s if deadline_s is not None else self.default_deadline_s
         if deadline_s is not None:
             self._ensure_watchdog()
+        if tier is not None:
+            # tier-aware backpressure BEFORE anything registers: a
+            # request whose tier is past its admission-queue bound
+            # sheds with 429 + Retry-After — an actionable signal the
+            # router can hold on — instead of queueing into a timeout
+            waiting = getattr(self.engine, "waiting_by_priority", None)
+            counts = waiting() if callable(waiting) else {}
+            if self.slo_tiers.should_shed(tier, counts):
+                with self._lock:
+                    self.metrics.tier_shed[tier.name] += 1
+                raise Overloaded(
+                    f"tier {tier.name!r} queue is at its bound "
+                    f"({tier.queue_bound}); retry after "
+                    f"{tier.retry_after_s:g}s",
+                    retry_after_s=tier.retry_after_s, tier=tier.name)
+            with self._lock:
+                self.metrics.tier_requests[tier.name] += 1
         now = time.monotonic()
         with self._lock:
             # checked under the SAME lock drain() flips the flag under:
@@ -422,10 +484,11 @@ class EngineServer:
                 "arrival": now,
                 "last_token_time": now,
                 "deadline": (now + deadline_s) if deadline_s else None,
+                "tier": tier.name if tier is not None else None,
             }
         try:
             request = Request(request_id, prompt_tokens, params, lora=lora,
-                              priority=priority)
+                              priority=priority, deadline_s=deadline_s)
             if self.prefill_upstream:
                 # reject BEFORE the remote prefill RPC anything local
                 # admission would refuse (unknown adapter, guided with
@@ -749,7 +812,8 @@ class EngineServer:
         n = self._n_of(body)
         prompt_tokens = self.tokenizer.encode(prompt)
         lora = self._lora_of(body)  # ValueError on rejection
-        priority = self._priority_of(body)
+        tier = self._tier_of(body)
+        priority = self._tier_priority(body, tier)
         deadline_s = self._deadline_of(body)
         served = lora or self.model_name
         echo_prefix = prompt if (body.get("echo") and not chat) else ""
@@ -769,7 +833,8 @@ class EngineServer:
             forced or not (params.guided_json or params.guided_schema))
         if n == 1:
             chan = self.submit(prompt_tokens, params, lora=lora,
-                               priority=priority, deadline_s=deadline_s)
+                               priority=priority, deadline_s=deadline_s,
+                               tier=tier)
             gen = self._stream_chunks(chan, chat, params.stop_strings,
                                       served_model=served,
                                       completion_id=completion_id,
@@ -783,7 +848,7 @@ class EngineServer:
                                              completion_id, created)
             return chan, gen
         chans = self._submit_n(prompt_tokens, params, lora, n, priority,
-                               deadline_s=deadline_s)
+                               deadline_s=deadline_s, tier=tier)
         gens = [
             self._stream_chunks(c, chat, params.stop_strings,
                                 served_model=served, choice_index=i,
@@ -800,7 +865,8 @@ class EngineServer:
         return _MultiChannel(chans), merged
 
     def _submit_n(self, prompt_tokens, params, lora: str, n: int,
-                  priority: int = 0, deadline_s: float | None = None):
+                  priority: int = 0, deadline_s: float | None = None,
+                  tier=None):
         """Submit n per-choice requests; on any failure, abort the ones
         already submitted (they would otherwise decode to max_tokens with
         no consumer and leak their channel registrations)."""
@@ -809,7 +875,7 @@ class EngineServer:
             for i in range(n):
                 chans.append(self.submit(
                     prompt_tokens, self._choice_params(params, i), lora=lora,
-                    priority=priority, deadline_s=deadline_s))
+                    priority=priority, deadline_s=deadline_s, tier=tier))
         except Exception:
             for c in chans:
                 self.abort(c)
@@ -1111,6 +1177,27 @@ class EngineServer:
         and last to be preempted; default 0."""
         return int(body.get("priority", 0) or 0)
 
+    def _tier_of(self, body: dict):
+        """Resolve the request's SLO tier (``slo_tier`` extension
+        field).  Unknown names are a 400 — a typo must never silently
+        serve at the wrong class — and naming a tier on a server with
+        none configured is equally loud (a misrouted deploy, not a
+        default)."""
+        name = body.get("slo_tier")
+        if not name:
+            return None
+        if self.slo_tiers is None:
+            raise ValueError(
+                f"request names slo_tier {name!r} but this server has "
+                "no SLO tiers configured")
+        return self.slo_tiers.get(str(name))  # UnknownTier -> 400
+
+    def _tier_priority(self, body: dict, tier) -> int:
+        """The scheduling priority a request carries: its tier's class
+        when an ``slo_tier`` is named, else the raw ``priority``
+        extension (the lower-level knob kept for tier-less servers)."""
+        return tier.priority if tier is not None else self._priority_of(body)
+
     def _n_of(self, body: dict) -> int:
         """OpenAI ``n``: parallel samples per request.  ``best_of`` is
         accepted only when equal to ``n`` (its legacy default)."""
@@ -1141,12 +1228,14 @@ class EngineServer:
         n = self._n_of(body)
         prompt_tokens = self.tokenizer.encode(prompt)
         lora = self._lora_of(body)
+        tier = self._tier_of(body)
         # submit all n first: they decode concurrently as one batch, and
         # the engine's same-prompt dedup turns samples 2..n into
         # prefix-cache hits against sample 1's pages
         chans = self._submit_n(prompt_tokens, params, lora, n,
-                               self._priority_of(body),
-                               deadline_s=self._deadline_of(body))
+                               self._tier_priority(body, tier),
+                               deadline_s=self._deadline_of(body),
+                               tier=tier)
         echo = bool(body.get("echo"))
         choices = []
         total_completion = 0
@@ -1510,11 +1599,14 @@ class EngineServer:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def _send_json(self, obj: dict, code: int = 200) -> None:
+            def _send_json(self, obj: dict, code: int = 200,
+                           headers: dict | None = None) -> None:
                 data = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -1622,6 +1714,16 @@ class EngineServer:
                         self._send_json({"error": {"message": f"not found: {self.path}"}}, 404)
                 except Draining as e:
                     self._send_json({"error": {"message": str(e)}}, 503)
+                except Overloaded as e:
+                    # 429 + Retry-After: tier-aware shed, an actionable
+                    # backpressure signal (the EPP holds the endpoint
+                    # softly for Retry-After — never a breaker trip)
+                    self._send_json(
+                        {"error": {"message": str(e),
+                                   "type": "overloaded",
+                                   "slo_tier": e.tier}},
+                        429,
+                        headers={"Retry-After": f"{e.retry_after_s:g}"})
                 except ValueError as e:
                     self._send_json({"error": {"message": str(e)}}, 400)
                 except Exception as e:
@@ -1958,12 +2060,18 @@ def serve_from_args(args) -> int:
             budget = engine.calibrate_token_budget()
             logger.info("token budget derived from measured step latency: "
                         "%d tokens/step", budget)
+    slo_tiers = None
+    slo_tiers_raw = getattr(args, "slo_tiers", "") or ""
+    if slo_tiers_raw:
+        # JSON, either the spec.sloTiers object or the bare tier list
+        slo_tiers = json.loads(slo_tiers_raw)
     server = EngineServer(
         model=model_name,
         host=args.host,
         port=args.port,
         engine=engine,
         prefill_upstream=getattr(args, "prefill_upstream", None) or None,
+        slo_tiers=slo_tiers,
     )
     if getattr(args, "enable_profiling", False):
         server.enable_profiling = True

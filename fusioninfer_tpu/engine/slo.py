@@ -1,0 +1,106 @@
+"""SLO tier table: the serving-side runtime of ``spec.sloTiers``.
+
+The API layer declares AND validates tiers (``api/types.SLOTierSpec`` /
+``SLOTiersSpec`` — one source of truth for field names, defaults, and
+the duplicate/share rules); this module is the lookup table the ENGINE
+SERVER consults per request — pure bookkeeping (no clocks, no device
+work, no I/O) so admission decisions stay a deterministic function of
+queue state:
+
+* ``slo_tier`` request field → ``Request.priority`` (vLLM semantics:
+  lower value = more urgent, last to be preempted);
+* tier-aware 429 backpressure: a tier's request sheds when the queued
+  pre-first-token requests **at its urgency or better** exceed its
+  ``queue_bound`` — batch counts interactive backlog against itself
+  (so batch sheds first under mixed overload) while interactive never
+  sheds on batch backlog;
+* per-step token-budget shares (``{priority: share}``) feeding the
+  engine's tier ledger (work-conserving borrowing,
+  docs/design/scheduler.md).
+
+The same table parses the ``sloTiers`` block the strategy generator
+emits into the rendered EndpointPickerConfig, so the router-side picker
+and the engine servers read one shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from fusioninfer_tpu.api.types import SLOTierSpec, SLOTiersSpec
+
+
+class UnknownTier(ValueError):
+    """Request named an slo_tier the server does not serve."""
+
+
+class TierTable:
+    """Ordered tier lookup (most urgent first) shared by the engine
+    server and the in-process picker.  Construction validates through
+    :meth:`SLOTiersSpec.validate` — the exact rules a manifest passes."""
+
+    def __init__(self, tiers: list[Union[SLOTierSpec, dict]]):
+        spec = SLOTiersSpec(tiers=[
+            t if isinstance(t, SLOTierSpec) else SLOTierSpec.from_dict(t)
+            for t in tiers])
+        spec.validate()  # ValidationError is a ValueError
+        self.tiers = sorted(spec.tiers, key=lambda t: t.priority)
+        self._by_name = {t.name: t for t in self.tiers}
+        self._by_priority = {t.priority: t for t in self.tiers}
+
+    @classmethod
+    def from_dicts(cls, tiers: list[dict]) -> "TierTable":
+        return cls(list(tiers))
+
+    @classmethod
+    def from_config(cls, obj) -> Optional["TierTable"]:
+        """Best-effort parse of an ``sloTiers`` stanza as it appears in
+        an InferenceService spec / rendered EPP config: an
+        ``SLOTiersSpec``, ``{"tiers": [...]}``, or a bare tier list.
+        ``None`` for absent/empty input (single-class serving)."""
+        if obj is None:
+            return None
+        if isinstance(obj, SLOTiersSpec):
+            tiers: list = obj.tiers
+        else:
+            tiers = obj.get("tiers") if isinstance(obj, dict) else obj
+        if not tiers:
+            return None
+        return cls(list(tiers))
+
+    def __iter__(self):
+        return iter(self.tiers)
+
+    def __len__(self) -> int:
+        return len(self.tiers)
+
+    def get(self, name: str) -> SLOTierSpec:
+        tier = self._by_name.get(name)
+        if tier is None:
+            raise UnknownTier(
+                f"unknown slo_tier {name!r}; served tiers: "
+                f"{sorted(self._by_name)}")
+        return tier
+
+    def by_priority(self, priority: int) -> Optional[SLOTierSpec]:
+        return self._by_priority.get(priority)
+
+    def names(self) -> list[str]:
+        return [t.name for t in self.tiers]
+
+    def shares(self) -> dict[int, float]:
+        """{priority: budget_share} — the engine tier ledger's input."""
+        return {t.priority: t.budget_share for t in self.tiers
+                if t.budget_share > 0.0}
+
+    def should_shed(self, tier: SLOTierSpec,
+                    waiting_by_priority: dict[int, int]) -> bool:
+        """Tier-aware backpressure decision: shed when the queued
+        pre-first-token requests (waiting + mid-chunked-prefill) at
+        this tier's urgency OR BETTER reach its queue bound.  Counting
+        better-urgency backlog against a worse tier makes batch shed
+        first under mixed overload; interactive never sheds because
+        batch queued up behind it."""
+        ahead = sum(n for p, n in waiting_by_priority.items()
+                    if p <= tier.priority)
+        return ahead >= tier.queue_bound

@@ -40,10 +40,12 @@ def stream_completion(
     url: str, prompt: str, max_tokens: int, timeout_s: float, seed: int,
     temperature: float = 0.0,
     on_first_chunk: Optional[Callable[[], None]] = None,
+    slo_tier: str = "",
 ) -> tuple[Optional[float], Optional[float], list, Optional[str],
-           Optional[str]]:
+           Optional[str], Optional[float]]:
     """One streaming completion against ``url`` →
-    ``(ttft_s, tpot_s, token_ids, finish_reason, error_kind)``.
+    ``(ttft_s, tpot_s, token_ids, finish_reason, error_kind,
+    retry_after_s)``.
 
     Integrity rides the RAW ``token_id`` stream (the server's additive
     per-chunk field), not decoded text: fallback tokenizers decode
@@ -53,12 +55,18 @@ def stream_completion(
     A stream that ends without a terminal ``finish_reason`` (the socket
     closed under a dying engine) reports ``truncated_stream``; an
     ``error:*`` finish reason (the engine failed the request explicitly)
-    reports as that error — both are FAILED attempts to the caller.
+    reports as that error — both are FAILED attempts to the caller.  A
+    429 shed reports ``http_429`` with the server's Retry-After parsed
+    into ``retry_after_s`` — backpressure, not failure: the caller
+    holds the endpoint softly instead of tripping its breaker.
     """
-    body = json.dumps({
+    payload_body = {
         "prompt": prompt, "max_tokens": max_tokens,
         "temperature": temperature, "seed": seed, "stream": True,
-    }).encode()
+    }
+    if slo_tier:
+        payload_body["slo_tier"] = slo_tier
+    body = json.dumps(payload_body).encode()
     req = urllib.request.Request(
         f"{url}/v1/completions", data=body,
         headers={"Content-Type": "application/json"})
@@ -88,16 +96,24 @@ def stream_completion(
                     ids.append(choice["token_id"])
                 if choice.get("finish_reason"):
                     finish = choice["finish_reason"]
+    except urllib.error.HTTPError as e:
+        retry_after = None
+        if e.code == 429:
+            try:
+                retry_after = float(e.headers.get("Retry-After") or "")
+            except ValueError:
+                retry_after = None
+        return None, None, ids, finish, _classify(e), retry_after
     except Exception as e:
-        return None, None, ids, finish, _classify(e)
+        return None, None, ids, finish, _classify(e), None
     if finish is None:
-        return None, None, ids, None, "truncated_stream"
+        return None, None, ids, None, "truncated_stream", None
     if finish.startswith("error"):
-        return None, None, ids, finish, finish
+        return None, None, ids, finish, finish, None
     ttft = (first - t0) if first is not None else None
     tpot = ((last - first) / (n_chunks - 1)
             if first is not None and n_chunks > 1 else None)
-    return ttft, tpot, ids, finish, None
+    return ttft, tpot, ids, finish, None, None
 
 
 class FleetClient:
@@ -126,17 +142,26 @@ class FleetClient:
     def request(self, prompt: str, max_tokens: int, stratum: str,
                 phase: str, seed: int = 0, temperature: float = 0.0,
                 on_first_chunk: Optional[Callable[[], None]] = None,
-                pick=None) -> dict:
+                pick=None, slo_tier: str = "") -> dict:
         """One logical fleet request; returns (and logs) its result row.
         ``pick`` overrides endpoint selection (the PD pair path passes
-        a pre-picked leg)."""
+        a pre-picked leg).  ``slo_tier`` tags the request's traffic
+        class; a 429 shed is a SOFT hold — the picker routes the next
+        attempt around the saturated engine, no attempt is consumed
+        (the shed is the protocol working), and only the overall
+        wall-clock bound ``timeout_s × max_attempts`` turns an
+        eternally-shed request into a lost one."""
         t_submit = time.perf_counter()
+        wall_deadline = t_submit + self.timeout_s * self.max_attempts
         attempts = 0
+        held = 0
         endpoints: list[str] = []
         row = {"phase": phase, "stratum": stratum, "ok": False,
                "lost": False, "corrupted": False, "ttft_s": None,
-               "tpot_s": None, "endpoint": None, "attempts": 0}
-        while attempts < self.max_attempts:
+               "tpot_s": None, "endpoint": None, "attempts": 0,
+               "held_429": 0}
+        while (attempts < self.max_attempts
+               and time.perf_counter() < wall_deadline):
             attempts += 1
             ep = pick() if pick is not None else self._picker.pick(
                 prompt, self._profile)
@@ -145,10 +170,19 @@ class FleetClient:
                 continue
             endpoints.append(ep.name)
             t_attempt = time.perf_counter()
-            ttft, tpot, ids, finish, err = stream_completion(
+            ttft, tpot, ids, finish, err, retry_after = stream_completion(
                 ep.url, prompt, max_tokens, self.timeout_s, seed,
-                temperature, on_first_chunk)
+                temperature, on_first_chunk, slo_tier=slo_tier)
             ok = err is None and finish in ("length", "stop")
+            if err == "http_429":
+                # backpressure, not failure: hold the engine softly for
+                # its Retry-After and retry elsewhere WITHOUT burning
+                # an attempt or the breaker
+                held += 1
+                attempts -= 1
+                self._picker.note_saturated(ep.name, retry_after)
+                time.sleep(min(retry_after or self.retry_pause_s, 1.0))
+                continue
             if pick is None:
                 # only the picker that chose the endpoint learns the
                 # outcome — a ``pick`` override (warmups, pinned fault
@@ -179,8 +213,11 @@ class FleetClient:
                         self._greedy_ref[prompt] = ids
             break
         else:
+            # condition exit (attempts exhausted OR the wall deadline
+            # closed a perpetually-shed request): the stream is lost
             row["lost"] = True
         row["attempts"] = attempts
+        row["held_429"] = held
         row["endpoints"] = endpoints
         with self._lock:
             self.results.append(row)

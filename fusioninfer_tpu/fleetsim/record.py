@@ -34,6 +34,7 @@ def phase_summary(rows: list[dict]) -> dict:
         "lost": sum(1 for r in rows if r["lost"]),
         "corrupted": sum(1 for r in rows if r["corrupted"]),
         "retried": sum(1 for r in rows if r["attempts"] > 1),
+        "held_429": sum(r.get("held_429", 0) for r in rows),
         "ttft_ms": pcts_ms([r["ttft_s"] for r in rows
                             if r["ttft_s"] is not None]),
         "tpot_ms": pcts_ms([r["tpot_s"] for r in rows
@@ -42,8 +43,12 @@ def phase_summary(rows: list[dict]) -> dict:
             name: {
                 "requests": len(rs),
                 "ok": sum(1 for r in rs if r["ok"]),
+                "lost": sum(1 for r in rs if r["lost"]),
+                "held_429": sum(r.get("held_429", 0) for r in rs),
                 "ttft_ms": pcts_ms([r["ttft_s"] for r in rs
                                     if r["ttft_s"] is not None]),
+                "tpot_ms": pcts_ms([r["tpot_s"] for r in rs
+                                    if r["tpot_s"] is not None]),
             }
             for name, rs in sorted(strata.items())
         },

@@ -55,6 +55,34 @@ class EPPSchemaError(ValueError):
     pass
 
 
+# extension block the IN-PROCESS picker consumes (the upstream EPP
+# image ignores unknown top-level keys, and this one is deliberately
+# informational there: tier enforcement lives in the ENGINES' 429
+# backpressure, which any router observes; the in-process picker
+# additionally reads the tiers for its saturation-hold defaults).
+# Keys are pinned so a typo'd tier knob fails at render, same as the
+# plugin parameters above.
+SLO_TIER_KEYS = frozenset({
+    "name", "priority", "budgetShare", "queueBound", "retryAfterSeconds",
+    "ttftP90Seconds", "tpotP90Seconds",
+})
+
+
+def _validate_slo_tiers(block) -> None:
+    if not isinstance(block, dict) or not isinstance(
+            block.get("tiers"), list) or not block["tiers"]:
+        raise EPPSchemaError(
+            "sloTiers must be a mapping with a non-empty 'tiers' list")
+    for tier in block["tiers"]:
+        if not isinstance(tier, dict) or not tier.get("name"):
+            raise EPPSchemaError("every sloTiers entry needs a 'name'")
+        for key in tier:
+            if key not in SLO_TIER_KEYS:
+                raise EPPSchemaError(
+                    f"sloTiers tier {tier.get('name')!r}: unknown key "
+                    f"{key!r} (allowed: {sorted(SLO_TIER_KEYS)})")
+
+
 def validate_epp_config(config_yaml: str) -> dict:
     """Parse + validate a generated EndpointPickerConfig; returns the
     parsed dict or raises :class:`EPPSchemaError` naming the offending
@@ -62,6 +90,8 @@ def validate_epp_config(config_yaml: str) -> dict:
     cfg = yaml.safe_load(config_yaml)
     if not isinstance(cfg, dict):
         raise EPPSchemaError("config is not a mapping")
+    if "sloTiers" in cfg:
+        _validate_slo_tiers(cfg["sloTiers"])
     declared: set[str] = set()
     for plugin in cfg.get("plugins") or []:
         ptype = plugin.get("type")

@@ -402,9 +402,23 @@ class EndpointPicker:
                  metrics: Callable[[Endpoint], dict] = None,
                  health: Optional[EndpointHealth] = None,
                  fault_injector=None,
-                 residency: Optional[ResidencyProvider] = None):
+                 residency: Optional[ResidencyProvider] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self.config = validate_epp_config(config_yaml)
         self._endpoints = endpoints
+        self._clock = clock
+        # the service's SLO tiers as rendered into the EPP config
+        # (strategy.generate_epp_config): tier names/priorities and the
+        # default Retry-After used for saturation holds
+        from fusioninfer_tpu.engine.slo import TierTable
+
+        self.slo_tiers = TierTable.from_config(self.config.get("sloTiers"))
+        # saturation holds (tier-aware backpressure): an engine that
+        # answered 429 is held SOFTLY until its Retry-After elapses —
+        # routed around while any unsaturated candidate exists, never
+        # breaker-tripped (overload is a state, not a failure)
+        self._hold_lock = threading.Lock()
+        self._saturated: dict[str, float] = {}
         # residency mode for the prefix scorer: score against reported
         # cache contents, history heuristic as fallback (None = pure
         # heuristic, the pre-hierarchy behavior)
@@ -458,6 +472,41 @@ class EndpointPicker:
         with self._draining_lock:
             return name in self._draining
 
+    # -- saturation (429 soft holds) --
+
+    def note_saturated(self, name: str,
+                       retry_after_s: Optional[float] = None) -> None:
+        """An engine shed a request with 429: hold it softly for its
+        Retry-After (falling back to the config's first tier default,
+        then 1s).  Extends an existing hold, never shortens it — two
+        tiers' sheds compose to the longer hold."""
+        if retry_after_s is None:
+            retry_after_s = (self.slo_tiers.tiers[0].retry_after_s
+                             if self.slo_tiers is not None else 1.0)
+        until = self._clock() + max(0.0, retry_after_s)
+        with self._hold_lock:
+            self._saturated[name] = max(
+                self._saturated.get(name, 0.0), until)
+
+    def is_saturated(self, name: str) -> bool:
+        with self._hold_lock:
+            return self._saturated.get(name, 0.0) > self._clock()
+
+    def _saturated_now(self, retain=None) -> set[str]:
+        """Expire stale holds, drop departed endpoints, return the
+        names currently held."""
+        now = self._clock()
+        with self._hold_lock:
+            if retain is not None:
+                keep = set(retain)
+                for name in list(self._saturated):
+                    if name not in keep:
+                        del self._saturated[name]
+            for name, until in list(self._saturated.items()):
+                if until <= now:
+                    del self._saturated[name]
+            return set(self._saturated)
+
     # -- scoring --
 
     def _score(self, key: str, plugin: dict, prompt: str,
@@ -496,11 +545,15 @@ class EndpointPicker:
         candidates = list(self._endpoints())
         # evict breakers for endpoints that left the fleet (before
         # profile filters: filtered-out endpoints are still alive);
-        # residency digests follow the same lifecycle — a dead engine's
-        # reported cache contents must leave with its endpoint
+        # residency digests and saturation holds follow the same
+        # lifecycle — a dead engine's reported cache contents (and 429
+        # hold) must leave with its endpoint, while an endpoint merely
+        # outside THIS profile's filter keeps its state
         self.health.retain(ep.name for ep in candidates)
         if self._residency is not None:
             self._residency.retain(ep.name for ep in candidates)
+        saturated = self._saturated_now(
+            retain=(ep.name for ep in candidates))
         scorers: list[tuple[str, dict, float]] = []
         for ref in prof.get("plugins", []):
             plugin = self._plugins.get(ref["pluginRef"])
@@ -541,6 +594,16 @@ class EndpointPicker:
         states = {ep.name: self.health.state(ep.name) for ep in candidates}
         live = [ep for ep in candidates if states[ep.name] != OPEN]
         selectable = [ep for ep in live if ep.name not in draining]
+        # saturation holds sit ABOVE the drain/outage fallbacks: route
+        # around engines inside a 429 Retry-After window while any
+        # unheld candidate exists (interactive traffic flows around
+        # saturation), but a fully saturated fleet still routes — a
+        # held engine beats a guaranteed no-pick, and its queue bound
+        # will shed again if it must (``saturated`` was snapshotted
+        # before the profile filters, alongside the breaker retain)
+        unheld = [ep for ep in selectable if ep.name not in saturated]
+        if unheld:
+            selectable = unheld
         last_resort = False
         if not selectable and live:
             logger.warning(

@@ -150,6 +150,12 @@ def generate_epp_config(svc: InferenceService, role: Role) -> str:
             cfg = _pd_config()
     else:
         cfg = _single_scorer_config(*_SCORER_FOR[strategy])
+    if svc.spec.slo_tiers is not None:
+        # the service's SLO tiers ride the rendered config so the
+        # picker's saturation holds share one source of truth with the
+        # engines' 429 backpressure (the upstream EPP image ignores the
+        # block — enforcement lives in the engines either way)
+        cfg["sloTiers"] = svc.spec.slo_tiers.to_dict()
     _check_scorer_metric_surface(svc, cfg)
     out = yaml.safe_dump(cfg, sort_keys=False)
     # a key the EPP image would silently ignore must fail at render time,

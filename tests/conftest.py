@@ -67,7 +67,8 @@ FAST_MODULES = {
     "test_manifests.py", "test_metrics.py", "test_names.py",
     "test_paged_attention.py", "test_priority.py", "test_reconciler.py",
     "test_render_cli.py", "test_router.py", "test_schema.py",
-    "test_scheduling_podgroup.py", "test_tokenizer.py",
+    "test_scheduling_podgroup.py", "test_slo_overload.py",
+    "test_tokenizer.py",
     "test_topology.py", "test_workload_lws.py",
 }
 

@@ -113,8 +113,11 @@ def test_crd_manifest_shape():
     role_schema = ver["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]["roles"]["items"]
     assert set(role_schema["required"]) == {"name", "componentType"}
     assert "tpu" in role_schema["properties"]
-    # raw passthroughs stay untyped to dodge CRD size limits
-    assert role_schema["properties"]["template"] == {
+    # raw passthroughs stay untyped to dodge CRD size limits (but are
+    # documented like every other spec field)
+    template = dict(role_schema["properties"]["template"])
+    assert template.pop("description")
+    assert template == {
         "type": "object",
         "x-kubernetes-preserve-unknown-fields": True,
     }

@@ -330,7 +330,9 @@ class TestBenchRecordChecker:
             "scheduler": {"token_budget": 64, "budget_utilization": 0.5,
                           "burst_span_steps": {"1": 3},
                           "burst_clamped": 1,
-                          "fused_steps": 7, "weight_passes": 21},
+                          "fused_steps": 7, "weight_passes": 21,
+                          "deadline_shed": 0, "tier_preemptions": 0,
+                          "preempt_parks": 0, "preempt_resumes": 0},
         }, "workload_sharedprefix": {
             "prefix_cache_hit_rate": 0.5,
             "cold_ttft_ms": {"p50": 500.0, "p90": 520.0},
@@ -352,9 +354,11 @@ class TestBenchRecordChecker:
         rec = self._good()
         del rec["http"]["ceiling_fraction"]
         del rec["http"]["scheduler"]["token_budget"]
+        del rec["http"]["scheduler"]["preempt_parks"]
         problems = check_record(rec)
         assert any("ceiling_fraction" in p for p in problems)
         assert any("token_budget" in p for p in problems)
+        assert any("preempt_parks" in p for p in problems)
 
     def test_missing_fused_evidence_flagged(self):
         """The fused-step evidence fields (weight_passes_per_step +

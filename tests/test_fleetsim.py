@@ -121,11 +121,17 @@ class TestCheckFleetRecord:
         phase = {"requests": 4, "ok": 4, "lost": 0, "corrupted": 0,
                  "retried": 0, "ttft_ms": {"p50": 10.0, "p90": 12.0},
                  "strata": {}}
+        overload_phase = dict(
+            phase,
+            strata={t: {"requests": 2, "ok": 2, "lost": 0,
+                        "ttft_ms": {"p50": 9.0, "p90": 11.0}}
+                    for t in ("interactive", "batch")})
+        phases = {n: dict(phase) for n in
+                  ("steady", "scale_up", "faults", "recover", "drain")}
+        phases["overload"] = overload_phase
         return {
             "schema": "fleet-v1",
-            "phases": {n: dict(phase) for n in
-                       ("steady", "scale_up", "faults", "recover",
-                        "drain")},
+            "phases": phases,
             "scale_events": [],
             "fault_ledger": [
                 {"fault": "metrics_partition", "controller_held": True},
@@ -144,6 +150,14 @@ class TestCheckFleetRecord:
                 "hit_rate_prefault": 0.6, "hit_rate_postfault": 0.55,
                 "hit_rate_recovery_frac": 0.8,
                 "hit_rate_recovered": True, "drain_rerouted": True,
+                "overload": {
+                    "interactive_ttft_p90_ms": 800.0,
+                    "ttft_p90_bound_ms": 15000.0,
+                    "interactive_ttft_bounded": True,
+                    "lost_interactive": 0, "held_429_client": 3,
+                    "shed_429": 2, "preempted": 3, "parked": 3,
+                    "resumed": 3,
+                },
             },
             "event_ledger": ["boot engines=2"],
         }
@@ -179,6 +193,39 @@ class TestCheckFleetRecord:
 
     def test_wrong_schema_fails(self):
         assert check_record({"schema": "bench-v1"})
+
+    def test_missing_overload_block_fails(self):
+        rec = self._good()
+        del rec["slo"]["overload"]
+        assert any("slo.overload" in p for p in check_record(rec))
+
+    def test_zero_park_counter_fails(self):
+        rec = self._good()
+        rec["slo"]["overload"]["parked"] = 0
+        assert any("parked is zero" in p for p in check_record(rec))
+
+    def test_zero_shed_counter_fails(self):
+        rec = self._good()
+        rec["slo"]["overload"]["shed_429"] = 0
+        assert any("shed_429 is zero" in p for p in check_record(rec))
+
+    def test_lost_interactive_fails(self):
+        rec = self._good()
+        rec["slo"]["overload"]["lost_interactive"] = 1
+        assert any("interactive streams were lost" in p
+                   for p in check_record(rec))
+
+    def test_unbounded_overload_ttft_fails(self):
+        rec = self._good()
+        rec["slo"]["overload"]["interactive_ttft_bounded"] = False
+        assert any("overload: interactive TTFT" in p
+                   for p in check_record(rec))
+
+    def test_missing_tier_percentiles_fail(self):
+        rec = self._good()
+        del rec["phases"]["overload"]["strata"]["batch"]
+        assert any("per-tier percentiles missing for 'batch'" in p
+                   for p in check_record(rec))
 
     def test_record_is_json_serializable(self, fleet_record):
         json.dumps(fleet_record)

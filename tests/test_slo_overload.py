@@ -1,0 +1,678 @@
+"""Overload robustness: SLO tiers, backpressure, KV-preserving preemption.
+
+Covers the PR's three legs end to end (docs/design/scheduler.md
+"Overload and SLO tiers"):
+
+* **SLO tiers** — the ``sloTiers`` API stanza + CRD schema, the
+  server's ``slo_tier`` → ``Request.priority`` mapping with per-tier
+  metric families, and the engine's per-step tier-share budget ledger
+  with work-conserving borrowing and mid-stream tier eviction.
+* **KV-preserving preemption** — a victim's computed pages park
+  (content-registered + host-offloaded) instead of dropping; resumed
+  streams are bit-identical to uninterrupted ones for greedy, seeded
+  sampled, and int8-KV decoding; every park-path fault degrades to
+  today's full recompute (chaos tier).
+* **Backpressure** — tier-aware 429 + Retry-After sheds at the queue
+  bound, the picker holds saturated engines softly (no breaker trip),
+  and expired-deadline requests shed before admission.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fusioninfer_tpu.api.types import SLOTiersSpec, ValidationError
+from fusioninfer_tpu.engine.engine import NativeEngine, Request
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.kv_host_tier import (
+    SITE_OFFLOAD,
+    SITE_OFFLOAD_DATA,
+    SITE_RESTORE,
+    SITE_RESTORE_DATA,
+    HostKVTier,
+)
+from fusioninfer_tpu.engine.sampler import SamplingParams
+from fusioninfer_tpu.engine.slo import TierTable, UnknownTier
+from fusioninfer_tpu.models.config import get_preset
+from fusioninfer_tpu.resilience import FaultInjector
+
+CFG = dataclasses.replace(get_preset("qwen3-tiny"), attn_impl="reference")
+
+TIERS = [
+    {"name": "interactive", "priority": 0, "budgetShare": 0.7,
+     "queueBound": 3, "retryAfterSeconds": 0.5, "ttftP90Seconds": 0.5},
+    {"name": "batch", "priority": 10, "budgetShare": 0.3,
+     "queueBound": 2, "retryAfterSeconds": 2.0},
+]
+
+
+# -- API types + CRD ----------------------------------------------------
+
+
+class TestSLOTiersSpec:
+    def test_round_trip(self):
+        spec = SLOTiersSpec.from_dict({"tiers": TIERS})
+        spec.validate()
+        again = SLOTiersSpec.from_dict(spec.to_dict())
+        assert [t.name for t in again.tiers] == ["interactive", "batch"]
+        assert again.tiers[0].budget_share == 0.7
+        assert again.tiers[1].queue_bound == 2
+
+    def test_duplicate_priority_rejected(self):
+        spec = SLOTiersSpec.from_dict({"tiers": [
+            {"name": "a", "priority": 1}, {"name": "b", "priority": 1}]})
+        with pytest.raises(ValidationError, match="duplicate priority"):
+            spec.validate()
+
+    def test_share_sum_over_one_rejected(self):
+        spec = SLOTiersSpec.from_dict({"tiers": [
+            {"name": "a", "priority": 0, "budgetShare": 0.7},
+            {"name": "b", "priority": 1, "budgetShare": 0.6}]})
+        with pytest.raises(ValidationError, match="sum"):
+            spec.validate()
+
+    def test_service_validate_covers_tiers(self):
+        from fusioninfer_tpu.api.types import InferenceService
+
+        svc = InferenceService.from_dict({
+            "metadata": {"name": "x"},
+            "spec": {
+                "roles": [{"name": "r", "componentType": "router",
+                           "strategy": "queue-size"}],
+                "sloTiers": {"tiers": [{"name": "", "priority": 0}]},
+            }})
+        with pytest.raises(ValidationError, match="needs a name"):
+            svc.validate()
+
+    def test_crd_has_slo_tiers_with_descriptions(self):
+        from fusioninfer_tpu.api.crd import build_crd
+        from tools.verify_manifests import _walk_undocumented
+
+        schema = build_crd()["spec"]["versions"][0]["schema"][
+            "openAPIV3Schema"]
+        spec = schema["properties"]["spec"]
+        assert "sloTiers" in spec["properties"]
+        missing: list[str] = []
+        _walk_undocumented(spec, "spec", missing)
+        assert missing == []
+
+    def test_description_gate_trips_on_undocumented_field(self):
+        """The verify-manifests satellite's self-test: drop one
+        description and the walker must name the exact path."""
+        from tools.verify_manifests import _walk_undocumented
+
+        schema = {"description": "d", "properties": {
+            "good": {"type": "string", "description": "ok"},
+            "bad": {"type": "object", "properties": {
+                "inner": {"type": "integer", "description": "ok"}}},
+        }}
+        missing: list[str] = []
+        _walk_undocumented(schema, "spec", missing)
+        assert missing == ["spec.bad"]
+
+
+# -- tier table ---------------------------------------------------------
+
+
+class TestTierTable:
+    def test_shed_counts_better_urgency_against_worse_tier(self):
+        table = TierTable.from_dicts(TIERS)
+        batch = table.get("batch")
+        inter = table.get("interactive")
+        # 2 interactive waiting: batch (bound 2) sheds, interactive
+        # (bound 3) does not — batch counts the urgent backlog, the
+        # urgent tier never counts batch's
+        assert table.should_shed(batch, {0: 2})
+        assert not table.should_shed(inter, {0: 2})
+        assert not table.should_shed(batch, {10: 1})
+        assert table.should_shed(batch, {10: 2})
+        assert not table.should_shed(inter, {10: 50})
+
+    def test_unknown_tier_raises(self):
+        table = TierTable.from_dicts(TIERS)
+        with pytest.raises(UnknownTier, match="premium"):
+            table.get("premium")
+
+    def test_shares_and_config_forms(self):
+        assert TierTable.from_config({"tiers": TIERS}).shares() == {
+            0: 0.7, 10: 0.3}
+        assert TierTable.from_config(TIERS).shares() == {0: 0.7, 10: 0.3}
+        assert TierTable.from_config(None) is None
+        assert TierTable.from_config({"tiers": []}) is None
+
+
+# -- engine: deadline shed + tier ledger --------------------------------
+
+
+def _drain(engine, request, outputs=None):
+    engine.add_request(request)
+    toks = []
+    while engine.has_work():
+        for out in engine.step():
+            if outputs is not None:
+                outputs.append(out)
+            if out.request_id == request.request_id:
+                toks.append(out.token)
+    return toks
+
+
+class TestDeadlineShed:
+    def test_expired_deadline_sheds_before_admission(self):
+        clock = {"now": 100.0}
+        engine = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=16, page_size=16,
+                                       max_pages_per_seq=8),
+            max_batch_size=2, clock=lambda: clock["now"])
+        req = Request("late", list(range(1, 9)),
+                      SamplingParams(max_tokens=4, temperature=0.0),
+                      deadline_s=5.0)
+        engine.add_request(req)
+        assert req.deadline == 105.0  # stamped on the engine clock
+        clock["now"] = 120.0  # the deadline passed while queued
+        outs = engine.step()
+        assert engine.sched.deadline_shed_total == 1
+        assert [o for o in outs if o.request_id == "late"][0].finish_reason \
+            == "error:deadline expired before admission"
+        # nothing admitted, no budget spent on the corpse
+        assert engine.num_running == 0
+        assert engine.sched.prefill_tokens_total == 0
+
+    def test_live_deadline_still_serves(self):
+        engine = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=16, page_size=16,
+                                       max_pages_per_seq=8),
+            max_batch_size=2)
+        toks = _drain(engine, Request(
+            "ok", list(range(1, 9)),
+            SamplingParams(max_tokens=3, temperature=0.0),
+            deadline_s=3600.0))
+        assert len(toks) == 3
+        assert engine.sched.deadline_shed_total == 0
+
+
+class TestTierLedger:
+    def _engine(self, budget=32):
+        engine = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=64, page_size=16,
+                                       max_pages_per_seq=16),
+            max_batch_size=4, token_budget=budget)
+        engine.set_slo_tiers({0: 0.7, 10: 0.3})
+        return engine
+
+    def test_rejects_overcommitted_shares(self):
+        engine = self._engine()
+        with pytest.raises(ValueError, match="sum"):
+            engine.set_slo_tiers({0: 0.8, 1: 0.4})
+
+    def test_idle_tier_share_is_borrowable(self):
+        """Work-conserving: with no interactive work pending, a batch
+        prompt may spend the WHOLE step budget (it admits monolithic
+        instead of deferring to chunks)."""
+        engine = self._engine(budget=32)
+        toks = _drain(engine, Request(
+            "batch", list(range(1, 30)),
+            SamplingParams(max_tokens=2, temperature=0.0), priority=10))
+        assert len(toks) == 2
+        # 29-token prompt < 32 budget: admitted whole, never chunked
+        assert engine.sched.chunks_total == 0
+
+    def test_busy_tier_reserve_is_untouchable(self):
+        """With interactive work waiting, the same batch prompt must
+        NOT spend interactive's reserve: 29 > 32 - floor(0.7*32) → the
+        batch suffix defers to the chunked queue."""
+        engine = self._engine(budget=32)
+        engine.add_request(Request(
+            "inter", list(range(100, 110)),
+            SamplingParams(max_tokens=2, temperature=0.0), priority=0))
+        engine.add_request(Request(
+            "batch", list(range(1, 30)),
+            SamplingParams(max_tokens=2, temperature=0.0), priority=10))
+        engine.step()
+        assert engine.sched.chunks_total > 0  # batch went chunked
+
+    def test_tier_eviction_yields_budget_to_interactive(self):
+        """Four batch rows saturate a tiny budget; an interactive
+        arrival forces a batch row to yield mid-stream (KV parked) and
+        every stream still completes."""
+        engine = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=64, page_size=16,
+                                       max_pages_per_seq=16),
+            max_batch_size=4, token_budget=8)
+        engine.set_slo_tiers({0: 0.7, 10: 0.3})
+        for i in range(4):
+            engine.add_request(Request(
+                f"b{i}", list(range(1 + i * 50, 9 + i * 50)),
+                SamplingParams(max_tokens=40, temperature=0.0),
+                priority=10))
+        for _ in range(30):
+            engine.step()
+        assert engine.num_running == 4
+        engine.add_request(Request(
+            "inter", list(range(300, 316)),
+            SamplingParams(max_tokens=4, temperature=0.0), priority=0))
+        outs = []
+        for _ in range(200):
+            outs += engine.step()
+            if not engine.has_work():
+                break
+        assert engine.sched.tier_preemptions_total >= 1
+        assert engine.sched.preempt_parks_total >= 1
+        finished_ok = {o.request_id for o in outs
+                       if o.finished
+                       and not (o.finish_reason or "").startswith("error")}
+        assert finished_ok == {"b0", "b1", "b2", "b3", "inter"}
+        assert engine.sched.preempt_resumes_total >= 1
+
+
+# -- KV-preserving preemption: bit-identity -----------------------------
+
+PARK_CACHE = CacheConfig(n_pages=14, page_size=16, max_pages_per_seq=12)
+
+
+def _interrupted_run(params, kv_dtype="model", fi=None, churn=0,
+                     host_tier=True, interrupt_at=12):
+    """One 40-token 'batch' stream, preempted mid-decode by an urgent
+    arrival (plus optional churn traffic while it waits) → its token
+    stream and the engine/tier handles."""
+    cache = dataclasses.replace(PARK_CACHE, kv_dtype=kv_dtype)
+    tier = HostKVTier(fault_injector=fi, async_offload=False) \
+        if host_tier else None
+    engine = NativeEngine(CFG, cache_cfg=cache, max_batch_size=2,
+                          host_kv_tier=tier)
+    victim = Request("victim", list(range(1, 40)), params, priority=10)
+    engine.add_request(victim)
+    toks, steps, fired = [], 0, False
+    while engine.has_work():
+        steps += 1
+        for out in engine.step():
+            if out.request_id == "victim":
+                toks.append(out.token)
+        if interrupt_at is not None and steps == interrupt_at and not fired:
+            fired = True
+            engine.add_request(Request(
+                "urgent", list(range(200, 340)),
+                SamplingParams(max_tokens=20, temperature=0.0),
+                priority=0))
+            for j in range(churn):
+                engine.add_request(Request(
+                    f"churn{j}", list(range(400 + 97 * j, 440 + 97 * j)),
+                    SamplingParams(max_tokens=2, temperature=0.0),
+                    priority=0))
+    return toks, engine, tier
+
+
+PARAM_GRID = [
+    ("greedy", SamplingParams(max_tokens=40, temperature=0.0), "model"),
+    ("seeded", SamplingParams(max_tokens=40, temperature=0.9, top_p=0.9,
+                              seed=1234), "model"),
+    ("int8kv", SamplingParams(max_tokens=40, temperature=0.8, seed=42),
+     "int8"),
+]
+
+
+class TestPreemptParkResumeBitIdentity:
+    @pytest.mark.parametrize("name,params,kv_dtype",
+                             PARAM_GRID, ids=[p[0] for p in PARAM_GRID])
+    def test_interrupted_equals_uninterrupted(self, name, params, kv_dtype):
+        cold, _, _ = _interrupted_run(params, kv_dtype, interrupt_at=None)
+        warm, engine, tier = _interrupted_run(params, kv_dtype)
+        assert engine.preemptions_total >= 1
+        assert engine.sched.preempt_parks_total >= 1
+        assert engine.sched.preempt_resumes_total >= 1
+        assert engine.sched.preempt_resume_reused_tokens_total > 0
+        assert tier.counters()["offloads"] > 0  # offload-on-preempt
+        assert warm == cold, name  # byte-for-byte stream identity
+
+    def test_resume_through_host_restore(self):
+        """Churn between preempt and resume reclaims the parked pages
+        from HBM: the resume must pull them back through the host tier
+        (restores > 0) and STILL match the uninterrupted stream."""
+        params = SamplingParams(max_tokens=40, temperature=0.0)
+        cold, _, _ = _interrupted_run(params, interrupt_at=None)
+        warm, engine, tier = _interrupted_run(params, churn=3)
+        assert engine.sched.preempt_parks_total >= 1
+        assert tier.counters()["restores"] > 0
+        assert warm == cold
+
+    def test_parking_off_without_prefix_caching(self):
+        """No prefix cache → no park machinery, plain recompute resume
+        (the pre-PR behavior, still bit-identical)."""
+        cache = dataclasses.replace(PARK_CACHE)
+        engine = NativeEngine(CFG, cache_cfg=cache, max_batch_size=2,
+                              enable_prefix_caching=False)
+        params = SamplingParams(max_tokens=40, temperature=0.0)
+        victim = Request("victim", list(range(1, 40)), params, priority=10)
+        engine.add_request(victim)
+        toks, steps, fired = [], 0, False
+        while engine.has_work():
+            steps += 1
+            for out in engine.step():
+                if out.request_id == "victim":
+                    toks.append(out.token)
+            if steps == 12 and not fired:
+                fired = True
+                engine.add_request(Request(
+                    "urgent", list(range(200, 340)),
+                    SamplingParams(max_tokens=20, temperature=0.0),
+                    priority=0))
+        assert engine.preemptions_total >= 1
+        assert engine.sched.preempt_parks_total == 0
+        assert engine.sched.preempt_resumes_total >= 1
+        assert len(toks) == 40
+
+
+@pytest.mark.chaos
+class TestParkPathChaos:
+    """Every fault on the park path degrades to recompute — the stream
+    stays bit-identical, nothing is lost, no corrupt page is served."""
+
+    PARAMS = SamplingParams(max_tokens=40, temperature=0.7, seed=9)
+    _cold_memo: list = []
+
+    def _cold(self):
+        # ONE uninterrupted reference run shared by all five fault
+        # scenarios (they assert against the same seeded stream)
+        if not self._cold_memo:
+            toks, _, _ = _interrupted_run(self.PARAMS, interrupt_at=None)
+            type(self)._cold_memo = toks
+        return self._cold_memo
+
+    def test_offload_drop_degrades_to_recompute(self):
+        fi = FaultInjector(seed=7).arm(SITE_OFFLOAD, "drop")
+        warm, engine, tier = _interrupted_run(self.PARAMS, fi=fi, churn=3)
+        assert engine.sched.preempt_parks_total >= 1
+        assert tier.counters()["offload_failed"] > 0
+        assert warm == self._cold()
+
+    def test_offload_corrupt_crc_rejected_on_restore(self):
+        fi = FaultInjector(seed=7).arm(SITE_OFFLOAD_DATA, "corrupt")
+        warm, engine, tier = _interrupted_run(self.PARAMS, fi=fi, churn=3)
+        assert tier.counters()["corrupt_dropped"] > 0
+        assert tier.counters()["restores"] == 0
+        assert warm == self._cold()
+
+    def test_restore_drop_degrades_to_recompute(self):
+        fi = FaultInjector(seed=7).arm(SITE_RESTORE, "drop")
+        warm, engine, tier = _interrupted_run(self.PARAMS, fi=fi, churn=3)
+        assert tier.counters()["restores"] == 0
+        assert warm == self._cold()
+
+    def test_restore_wire_corrupt_crc_rejected(self):
+        fi = FaultInjector(seed=7).arm(SITE_RESTORE_DATA, "corrupt")
+        warm, engine, tier = _interrupted_run(self.PARAMS, fi=fi, churn=3)
+        assert tier.counters()["corrupt_dropped"] > 0
+        assert warm == self._cold()
+
+    def test_tier_full_evicts_and_recomputes(self):
+        """A tier too small for the parked chain LRU-evicts it; the
+        resume recomputes from the prompt."""
+        tiny = HostKVTier(capacity_bytes=1, async_offload=False)
+        cache = dataclasses.replace(PARK_CACHE)
+        engine = NativeEngine(CFG, cache_cfg=cache, max_batch_size=2,
+                              host_kv_tier=tiny)
+        victim = Request("victim", list(range(1, 40)), self.PARAMS,
+                         priority=10)
+        engine.add_request(victim)
+        toks, steps, fired = [], 0, False
+        while engine.has_work():
+            steps += 1
+            for out in engine.step():
+                if out.request_id == "victim":
+                    toks.append(out.token)
+            if steps == 12 and not fired:
+                fired = True
+                engine.add_request(Request(
+                    "urgent", list(range(200, 340)),
+                    SamplingParams(max_tokens=20, temperature=0.0),
+                    priority=0))
+                for j in range(3):
+                    engine.add_request(Request(
+                        f"churn{j}",
+                        list(range(400 + 97 * j, 440 + 97 * j)),
+                        SamplingParams(max_tokens=2, temperature=0.0),
+                        priority=0))
+        assert tiny.counters()["evictions"] > 0
+        assert toks == self._cold()
+
+
+# -- server: slo_tier mapping, 429 + Retry-After, tier metrics ----------
+
+
+class TestServerTiers:
+    def _server(self, **kw):
+        from fusioninfer_tpu.engine.server import EngineServer
+
+        eng = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=33, page_size=16,
+                                       max_pages_per_seq=8),
+            max_batch_size=2, token_budget=64)
+        return EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                            engine=eng, slo_tiers={"tiers": TIERS}, **kw)
+
+    def test_tier_maps_to_priority_and_installs_shares(self):
+        srv = self._server()
+        assert srv.engine._tier_shares == {0: 0.7, 10: 0.3}
+        assert srv._tier_of({"slo_tier": "batch"}).priority == 10
+        assert srv._tier_priority({"slo_tier": "batch"}, srv._tier_of(
+            {"slo_tier": "batch"})) == 10
+        # no tier named → the raw priority knob still works
+        assert srv._tier_priority({"priority": -2}, None) == -2
+
+    def test_unknown_tier_is_client_error(self):
+        srv = self._server()
+        with pytest.raises(UnknownTier):
+            srv._tier_of({"slo_tier": "premium"})
+
+    def test_tierless_server_rejects_tier_field(self):
+        from fusioninfer_tpu.engine.server import EngineServer
+
+        eng = NativeEngine(
+            CFG, cache_cfg=CacheConfig(n_pages=17, page_size=16,
+                                       max_pages_per_seq=8),
+            max_batch_size=2)
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=eng)
+        with pytest.raises(ValueError, match="no SLO tiers"):
+            srv._tier_of({"slo_tier": "interactive"})
+
+    def test_queue_bound_sheds_with_retry_after(self):
+        """Engine not stepping: the 3rd batch submit crosses batch's
+        bound (2) and sheds Overloaded with the tier's Retry-After."""
+        from fusioninfer_tpu.engine.server import Overloaded
+
+        srv = self._server()
+        batch = srv.slo_tiers.get("batch")
+        params = SamplingParams(max_tokens=2, temperature=0.0)
+        for _ in range(2):
+            srv.submit([1, 2, 3], params, priority=batch.priority,
+                       tier=batch)
+        with pytest.raises(Overloaded) as exc:
+            srv.submit([1, 2, 3], params, priority=batch.priority,
+                       tier=batch)
+        assert exc.value.retry_after_s == 2.0
+        assert exc.value.tier == "batch"
+        assert srv.metrics.tier_shed["batch"] == 1
+        # interactive (bound 3) counts the same backlog but at its own
+        # bound: one more interactive still admits
+        inter = srv.slo_tiers.get("interactive")
+        srv.submit([1, 2, 3], params, priority=inter.priority, tier=inter)
+
+    def test_http_429_with_retry_after_header(self):
+        srv = self._server()
+        srv.start()
+        try:
+            def post(body):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/completions",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                return urllib.request.urlopen(req, timeout=120)
+
+            def background(b):
+                try:
+                    post(b).read()
+                except urllib.error.HTTPError:
+                    pass  # a shed background stream is part of the point
+
+            # two LONG batch streams occupy both slots for many steps,
+            # then two more batch requests sit in the wait queue — the
+            # observed depth (not a race) is what the probe sheds on
+            bodies = (
+                [{"prompt": "x" * (40 + i), "max_tokens": 80,
+                  "slo_tier": "batch", "stream": True} for i in range(2)]
+                + [{"prompt": "q" * (30 + i), "max_tokens": 4,
+                    "slo_tier": "batch", "stream": True} for i in range(2)])
+            threads = []
+            for i, b in enumerate(bodies):
+                t = threading.Thread(target=background, args=(b,),
+                                     daemon=True)
+                threads.append(t)
+                t.start()
+                if i == 1:  # both slot-occupiers in before the queuers
+                    deadline = time.monotonic() + 60
+                    while (srv.engine.num_running < 2
+                           and time.monotonic() < deadline):
+                        time.sleep(0.01)
+            deadline = time.monotonic() + 60
+            while (sum(srv.engine.waiting_by_priority().values()) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert sum(srv.engine.waiting_by_priority().values()) >= 2
+            saw_429 = None
+            try:
+                post({"prompt": "y", "max_tokens": 2,
+                      "slo_tier": "batch"}).read()
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                saw_429 = e
+            for t in threads:
+                t.join(timeout=120)
+            assert saw_429 is not None, "queue bound never shed"
+            assert float(saw_429.headers["Retry-After"]) == 2.0
+            payload = json.loads(saw_429.read())
+            assert payload["error"]["slo_tier"] == "batch"
+            # the shed landed in the per-tier metrics families
+            metrics = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=30).read().decode()
+            assert 'fusioninfer:tier_shed_total{' in metrics
+            assert 'slo_tier="batch"' in metrics
+            assert "fusioninfer:tier_ttft_seconds_bucket" in metrics
+            assert "fusioninfer:sched_preempt_parks_total" in metrics
+        finally:
+            srv.stop()
+
+
+# -- router: EPP config render + saturation holds -----------------------
+
+
+class TestEPPTierSurface:
+    def test_strategy_renders_slo_tiers(self):
+        from fusioninfer_tpu.api.types import InferenceService
+        from fusioninfer_tpu.router.strategy import generate_epp_config
+        import yaml
+
+        svc = InferenceService.from_dict({
+            "metadata": {"name": "t"},
+            "spec": {
+                "roles": [
+                    {"name": "router", "componentType": "router",
+                     "strategy": "queue-size"},
+                    {"name": "w", "componentType": "worker",
+                     "engine": "native",
+                     "template": {"spec": {"containers": []}}},
+                ],
+                "sloTiers": {"tiers": TIERS},
+            }})
+        svc.validate()
+        cfg = yaml.safe_load(generate_epp_config(
+            svc, svc.spec.router_roles()[0]))
+        names = [t["name"] for t in cfg["sloTiers"]["tiers"]]
+        assert names == ["interactive", "batch"]
+
+    def test_epp_schema_rejects_typoed_tier_key(self):
+        from fusioninfer_tpu.router.epp_schema import (
+            EPPSchemaError,
+            validate_epp_config,
+        )
+        import yaml
+
+        cfg = {"sloTiers": {"tiers": [
+            {"name": "a", "priority": 0, "queueBond": 4}]}, "plugins": []}
+        with pytest.raises(EPPSchemaError, match="queueBond"):
+            validate_epp_config(yaml.safe_dump(cfg))
+
+    def _picker(self, clock):
+        from fusioninfer_tpu.router.picker import Endpoint, EndpointPicker
+        import yaml
+
+        eps = [Endpoint("a", "http://a", {}), Endpoint("b", "http://b", {})]
+        config = yaml.safe_dump({
+            "apiVersion": "inference.networking.x-k8s.io/v1alpha1",
+            "kind": "EndpointPickerConfig",
+            "sloTiers": {"tiers": TIERS},
+            "plugins": [{"type": "queue-scorer"},
+                        {"type": "max-score-picker"}],
+            "schedulingProfiles": [{"name": "default", "plugins": [
+                {"pluginRef": "queue-scorer"},
+                {"pluginRef": "max-score-picker"}]}],
+        })
+        picker = EndpointPicker(config, lambda: eps,
+                                metrics=lambda ep: {
+                                    "vllm:num_requests_waiting": 0.0},
+                                clock=clock)
+        return picker, eps
+
+    def test_saturated_endpoint_routed_around_until_hold_expires(self):
+        now = {"t": 0.0}
+        picker, eps = self._picker(lambda: now["t"])
+        assert picker.slo_tiers is not None  # parsed from the config
+        picker.note_saturated("a", 5.0)
+        assert picker.is_saturated("a")
+        for _ in range(4):
+            assert picker.pick("p").name == "b"
+        # breaker untouched: saturation is a state, not a failure
+        assert picker.health.state("a") == "closed"
+        now["t"] = 6.0
+        assert not picker.is_saturated("a")
+        assert picker.pick("p") is not None
+
+    def test_fully_saturated_fleet_still_routes(self):
+        now = {"t": 0.0}
+        picker, eps = self._picker(lambda: now["t"])
+        picker.note_saturated("a", 5.0)
+        picker.note_saturated("b", 5.0)
+        assert picker.pick("p") is not None  # held beats no-pick
+
+    def test_hold_extends_never_shortens(self):
+        now = {"t": 0.0}
+        picker, _ = self._picker(lambda: now["t"])
+        picker.note_saturated("a", 5.0)
+        picker.note_saturated("a", 1.0)
+        now["t"] = 3.0
+        assert picker.is_saturated("a")
+
+
+# -- loadgen: mixed-SLO plan -------------------------------------------
+
+
+class TestMixedSLOPlan:
+    def test_deterministic_and_time_ordered(self):
+        from fusioninfer_tpu.benchmark.loadgen import mixed_slo_arrivals
+
+        a = mixed_slo_arrivals({"batch": (8, 10.0),
+                                "interactive": (4, 2.0)}, seed=5)
+        b = mixed_slo_arrivals({"batch": (8, 10.0),
+                                "interactive": (4, 2.0)}, seed=5)
+        assert a == b
+        assert len(a) == 12
+        assert all(x[0] <= y[0] for x, y in zip(a, a[1:]))
+        tiers = {t for _, t, _ in a}
+        assert tiers == {"batch", "interactive"}
+        # per-tier indices each count their own stratum
+        assert sorted(i for _, t, i in a if t == "batch") == list(range(8))

@@ -58,7 +58,13 @@ def check_record(record: dict) -> list[str]:
     else:
         for field in ("token_budget", "budget_utilization",
                       "burst_span_steps", "burst_clamped",
-                      "fused_steps", "weight_passes"):
+                      "fused_steps", "weight_passes",
+                      # overload-robustness ledger (r10): the
+                      # deadline-shed and KV-preserving-preemption
+                      # counters must land in every record so a
+                      # regression that silently drops them fails CI
+                      "deadline_shed", "preempt_parks",
+                      "preempt_resumes", "tier_preemptions"):
             if field not in sched:
                 problems.append(f"http.scheduler.{field} missing")
     if "queue_wait_ms" not in http:

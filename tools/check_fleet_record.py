@@ -15,7 +15,12 @@ properties ARE the acceptance criteria of the fleet harness
   fraction of its pre-fault value after the engine death;
 * the controller HELD (did not scale on fiction) through the metrics
   partition, and drained repeat-prefix traffic re-routed off the
-  victim.
+  victim;
+* the OVERLOAD phase degraded gracefully: interactive TTFT p90 held its
+  bound with zero lost interactive streams while batch was 429-shed,
+  preempted mid-stream, parked to the host KV tier, and resumed
+  bit-identically (nonzero shed/preempt/park/resume counters, per-tier
+  percentiles present).
 
 Usage: ``python tools/check_fleet_record.py [FLEET_OUT.json]``.
 """
@@ -26,9 +31,22 @@ import json
 import pathlib
 import sys
 
-REQUIRED_PHASES = ("steady", "scale_up", "faults", "recover", "drain")
+REQUIRED_PHASES = ("steady", "scale_up", "overload", "faults", "recover",
+                   "drain")
 REQUIRED_FAULTS = ("metrics_partition", "kv_transfer_corrupt",
                    "slice_loss")
+# overload ledger counters that must be NONZERO: the phase proves
+# nothing unless batch streams were actually shed (429), preempted
+# mid-stream, parked to the host tier, and resumed.  The harness sizes
+# the offered load to GUARANTEE these on the smoke box: the open-loop
+# batch stratum's KV footprint (4 concurrent 140-token prompts per
+# engine ≈ 84 of 95 pages, plus interactive) forces capacity
+# preemption geometrically, and the queue bound (2) sits below the
+# backlog the 4x Poisson bursts build — if a future machine absorbs
+# the load without ever shedding or preempting, raise the harness's
+# overload_batch_* knobs rather than weakening this gate (the phase
+# exists to exercise the degradation path, not to pass vacuously).
+OVERLOAD_NONZERO = ("shed_429", "preempted", "parked", "resumed")
 
 
 def check_record(record: dict) -> list[str]:
@@ -100,8 +118,46 @@ def check_record(record: dict) -> list[str]:
         problems.append(
             "repeat-prefix traffic kept chasing the draining victim "
             f"({slo.get('drain_victim')!r})")
+    problems += check_overload(record)
     if not record.get("event_ledger"):
         problems.append("event_ledger missing (determinism evidence)")
+    return problems
+
+
+def check_overload(record: dict) -> list[str]:
+    """Gate the overload phase: with offered load above the fleet
+    ceiling, interactive TTFT p90 holds its recorded bound with ZERO
+    lost interactive streams while batch degrades gracefully —
+    429-shed, preempted, parked to the host tier, and resumed
+    bit-identically (corruption is covered by the record-wide
+    corrupted_streams == 0 gate, whose greedy reference compares
+    resumed batch streams against uninterrupted twins)."""
+    problems: list[str] = []
+    slo = record.get("slo") or {}
+    ov = slo.get("overload")
+    if not isinstance(ov, dict):
+        return ["slo.overload block missing (the overload phase never "
+                "ran or recorded nothing)"]
+    if not ov.get("interactive_ttft_bounded"):
+        problems.append(
+            "overload: interactive TTFT p90 exceeded its bound "
+            f"(p90={ov.get('interactive_ttft_p90_ms')!r} ms, "
+            f"bound={ov.get('ttft_p90_bound_ms')!r} ms)")
+    if ov.get("lost_interactive") != 0:
+        problems.append(
+            "overload: interactive streams were lost "
+            f"({ov.get('lost_interactive')!r} != 0)")
+    for key in OVERLOAD_NONZERO:
+        if not ov.get(key):
+            problems.append(
+                f"overload: {key} is zero/missing — the phase never "
+                "exercised the degradation path it gates")
+    phases = record.get("phases") or {}
+    strata = (phases.get("overload") or {}).get("strata") or {}
+    for tier in ("interactive", "batch"):
+        if not ((strata.get(tier) or {}).get("ttft_ms") or {}).get("p50"):
+            problems.append(
+                f"overload: per-tier percentiles missing for {tier!r}")
     return problems
 
 
@@ -122,7 +178,8 @@ def main(argv: list[str]) -> int:
     print(f"check_fleet_record: {path.name} carries the closed-loop "
           "fleet evidence (scale-up + drain scale-down, zero "
           "lost/corrupted streams under faults, bounded scale-up TTFT, "
-          "residency recovery)")
+          "residency recovery, overload: bounded interactive TTFT with "
+          "batch shed/preempted/parked/resumed)")
     return 0
 
 

@@ -50,6 +50,10 @@ WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     # offload/restore schedule into timing soup
     "fusioninfer_tpu/engine/kv_host_tier.py": ("time", "sleep",
                                                "monotonic"),
+    # the SLO tier table feeds admission/shed decisions that must be a
+    # pure function of queue state (and replay identically in tests):
+    # deadlines are stamped on the ENGINE's injectable clock, never here
+    "fusioninfer_tpu/engine/slo.py": ("time", "sleep", "monotonic"),
 }
 
 # -- lock-discipline pass ----------------------------------------------
@@ -173,6 +177,9 @@ HOST_SYNC_MODULES: dict[str, tuple[str, ...]] = {
     # (engine._restore_host_blocks) dispatches the H2D inject without
     # fetching — an ad-hoc fetch anywhere else stalls the step loop
     "fusioninfer_tpu/engine/kv_host_tier.py": ("_store",),
+    # the tier table is pure queue-state bookkeeping: no device values
+    # exist here, so no fetch point is sanctioned
+    "fusioninfer_tpu/engine/slo.py": (),
     "fusioninfer_tpu/ops/paged_attention.py": (),
     "fusioninfer_tpu/ops/dispatch.py": (),
     "fusioninfer_tpu/ops/sharded.py": (),

@@ -82,6 +82,61 @@ def check_drift(config_dir: pathlib.Path) -> list[str]:
     return problems
 
 
+def _walk_undocumented(schema: dict, path: str, out: list[str]) -> None:
+    """Recursively require a ``description`` on every property of a CRD
+    spec subtree.  Raw passthroughs
+    (``x-kubernetes-preserve-unknown-fields``) terminate the walk — they
+    deliberately have no child schema — but must themselves be
+    documented like any other field."""
+    for name, prop in (schema.get("properties") or {}).items():
+        ppath = f"{path}.{name}"
+        if not (prop.get("description") or "").strip():
+            out.append(ppath)
+        if prop.get("x-kubernetes-preserve-unknown-fields"):
+            continue
+        _walk_undocumented(prop, ppath, out)
+        if isinstance(prop.get("items"), dict):
+            _walk_undocumented(prop["items"], f"{ppath}[*]", out)
+    extra = schema.get("additionalProperties")
+    if isinstance(extra, dict):
+        _walk_undocumented(extra, f"{path}.*", out)
+    if isinstance(schema.get("items"), dict) and "properties" not in schema:
+        _walk_undocumented(schema["items"], f"{path}[*]", out)
+
+
+def check_crd_descriptions(rendered: dict[str, str] | None = None) -> list[str]:
+    """Every spec property of every rendered CRD must carry a
+    ``description`` (VERDICT #10: the InferenceService CRD shipped with
+    zero) — ``kubectl explain`` is the operator's first stop, and an
+    undocumented knob is a knob nobody can safely turn."""
+    rendered = render_tree() if rendered is None else rendered
+    problems: list[str] = []
+    for rel in sorted(rendered):
+        for doc in yaml.safe_load_all(rendered[rel]):
+            if not doc or doc.get("kind") != "CustomResourceDefinition":
+                continue
+            name = (doc.get("metadata") or {}).get("name", "?")
+            if not name.endswith(".fusioninfer.io"):
+                # vendored external schemas (LWS/Volcano/Gateway) are
+                # upstream's text verbatim — fabricating descriptions
+                # there would misrepresent the pinned contract
+                continue
+            for version in (doc.get("spec") or {}).get("versions", []):
+                root = ((version.get("schema") or {})
+                        .get("openAPIV3Schema") or {})
+                spec = (root.get("properties") or {}).get("spec")
+                if not isinstance(spec, dict):
+                    continue
+                missing: list[str] = []
+                _walk_undocumented(spec, "spec", missing)
+                for p in missing:
+                    problems.append(
+                        f"config/{rel}: CRD {name} "
+                        f"{version.get('name')}: {p} has no description "
+                        "(every spec field must document itself)")
+    return problems
+
+
 def check_samples(samples_dir: pathlib.Path) -> list[str]:
     from fusioninfer_tpu.api.types import InferenceService
     from fusioninfer_tpu.operator.schema import CRDValidator
@@ -182,6 +237,7 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     config_dir = pathlib.Path(argv[0]) if argv else REPO / "config"
     problems = check_drift(config_dir)
+    problems += check_crd_descriptions()
     problems += check_samples(config_dir / "samples")
     problems += check_rendered_children(config_dir / "samples")
     for p in problems:
@@ -191,8 +247,9 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 1
     print("verify-manifests: config/ matches the sources; all samples "
-          "validate against the CRD schemas; every rendered child "
-          "validates against the pinned external schemas")
+          "validate against the CRD schemas; every spec field is "
+          "documented; every rendered child validates against the "
+          "pinned external schemas")
     return 0
 
 
