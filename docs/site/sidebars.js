@@ -12,7 +12,7 @@ const sidebars = {
               'design/fleet-sim', 'design/kv-hierarchy',
               'design/parallelism', 'design/resilience',
               'design/router', 'design/scheduler',
-              'design/static-analysis'],
+              'design/spot-revocation', 'design/static-analysis'],
     },
   ],
 };

@@ -261,6 +261,54 @@ def _role_schema() -> dict:
                     },
                 },
             },
+            "spot": {
+                "type": "object",
+                "description": (
+                    "Preemptible (spot) capacity posture for this "
+                    "worker-like role: spot toleration + termination "
+                    "grace rendered into the workload, revocation "
+                    "surge headroom for the autoscaler "
+                    "(docs/design/spot-revocation.md)."),
+                "properties": {
+                    "enabled": {
+                        "type": "boolean", "default": True,
+                        "description": (
+                            "Master switch; disabled keeps the stanza "
+                            "inert without deleting it."),
+                    },
+                    "tolerationKey": {
+                        "type": "string", "minLength": 1,
+                        "default": "cloud.google.com/gke-spot",
+                        "description": (
+                            "Provider's spot taint/label key the "
+                            "rendered pods tolerate (GKE default)."),
+                    },
+                    "terminationGracePeriodSeconds": {
+                        "type": "integer", "minimum": 1, "default": 30,
+                        "description": (
+                            "Revocation notice rendered as the pods' "
+                            "terminationGracePeriodSeconds — the "
+                            "engine's SIGTERM evacuation (park "
+                            "in-flight KV, export frames to a "
+                            "survivor) must fit inside it."),
+                    },
+                    "replacementSurge": {
+                        "type": "integer", "minimum": 0, "default": 1,
+                        "description": (
+                            "Replicas ABOVE autoscaling.maxReplicas a "
+                            "revocation event may temporarily buy as "
+                            "immediate replacement capacity."),
+                    },
+                    "requireSpotNodes": {
+                        "type": "boolean", "default": False,
+                        "description": (
+                            "Also pin the role to spot nodes via a "
+                            "nodeSelector on tolerationKey (tolerating "
+                            "spot does not otherwise forbid "
+                            "on-demand)."),
+                    },
+                },
+            },
             "strategy": {
                 "type": "string",
                 "enum": [s.value for s in RoutingStrategy],

@@ -220,6 +220,69 @@ class AutoscalingSpec:
 
 
 @dataclass
+class SpotSpec:
+    """Preemptible (spot) capacity posture for one worker-like role
+    (``spec.roles[*].spot`` — docs/design/spot-revocation.md).
+
+    A spot slice is reclaimed with a short hard notice, so the rendered
+    workload must (a) land on spot nodes — the toleration (and,
+    opt-in, the node selector) for the provider's spot taint — and
+    (b) get the WHOLE notice as ``terminationGracePeriodSeconds`` so
+    the engine's SIGTERM evacuation (park in-flight KV, export frames
+    to a survivor) runs inside it instead of being SIGKILLed mid-park.
+    ``replacement_surge`` is the autoscaler's revocation headroom: a
+    revocation event may scale the role up past
+    ``autoscaling.maxReplicas`` by this many replicas while the
+    reclaimed slice reschedules."""
+
+    enabled: bool = True
+    # GKE's spot taint/label key; other providers override
+    toleration_key: str = "cloud.google.com/gke-spot"
+    termination_grace_period_s: int = 30
+    replacement_surge: int = 1
+    # also PIN the role to spot nodes (nodeSelector on the same key) —
+    # off by default: tolerating spot does not forbid on-demand
+    require_spot_nodes: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SpotSpec":
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            toleration_key=str(
+                d.get("tolerationKey", "cloud.google.com/gke-spot")),
+            termination_grace_period_s=int(
+                d.get("terminationGracePeriodSeconds", 30)),
+            replacement_surge=int(d.get("replacementSurge", 1)),
+            require_spot_nodes=bool(d.get("requireSpotNodes", False)),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"enabled": self.enabled}
+        if self.toleration_key != "cloud.google.com/gke-spot":
+            out["tolerationKey"] = self.toleration_key
+        if self.termination_grace_period_s != 30:
+            out["terminationGracePeriodSeconds"] = (
+                self.termination_grace_period_s)
+        if self.replacement_surge != 1:
+            out["replacementSurge"] = self.replacement_surge
+        if self.require_spot_nodes:
+            out["requireSpotNodes"] = True
+        return out
+
+    def validate(self, role_name: str) -> None:
+        if not self.toleration_key:
+            raise ValidationError(
+                f"role {role_name!r}: spot.tolerationKey must not be empty")
+        if self.termination_grace_period_s < 1:
+            raise ValidationError(
+                f"role {role_name!r}: spot.terminationGracePeriodSeconds "
+                "must be >= 1 (the evacuation needs SOME notice)")
+        if self.replacement_surge < 0:
+            raise ValidationError(
+                f"role {role_name!r}: spot.replacementSurge must be >= 0")
+
+
+@dataclass
 class SLOTierSpec:
     """One service-level traffic class (``spec.sloTiers.tiers[*]``).
 
@@ -345,6 +408,7 @@ class Role:
     multinode: Optional[Multinode] = None
     engine: EngineKind = EngineKind.VLLM_TPU
     autoscaling: Optional[AutoscalingSpec] = None
+    spot: Optional[SpotSpec] = None  # preemptible-capacity posture
     # router fields
     strategy: Optional[RoutingStrategy] = None
     httproute: Optional[dict] = None  # raw HTTPRouteSpec passthrough
@@ -390,6 +454,7 @@ class Role:
                 AutoscalingSpec.from_dict(d["autoscaling"])
                 if d.get("autoscaling") else None
             ),
+            spot=SpotSpec.from_dict(d["spot"]) if d.get("spot") else None,
             strategy=strategy,
             httproute=d.get("httproute"),
             gateway=d.get("gateway"),
@@ -410,6 +475,8 @@ class Role:
                 out["multinode"] = self.multinode.to_dict()
             if self.autoscaling is not None:
                 out["autoscaling"] = self.autoscaling.to_dict()
+            if self.spot is not None:
+                out["spot"] = self.spot.to_dict()
         if self.template is not None:
             out["template"] = self.template
         if self.strategy is not None:
@@ -563,11 +630,19 @@ class InferenceService:
                     role.tpu.resolve()  # raises TopologyError on bad shapes
                 if role.autoscaling is not None:
                     role.autoscaling.validate(role.name)
+                if role.spot is not None:
+                    role.spot.validate(role.name)
             else:
                 if role.autoscaling is not None:
                     raise ValidationError(
                         f"role {role.name!r}: only worker-like roles can "
                         "carry an autoscaling stanza"
+                    )
+                if role.spot is not None:
+                    raise ValidationError(
+                        f"role {role.name!r}: only worker-like roles can "
+                        "carry a spot stanza (routers are not placed on "
+                        "preemptible slices)"
                     )
                 if role.strategy is None and role.endpoint_picker_config is None:
                     raise ValidationError(

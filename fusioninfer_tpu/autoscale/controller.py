@@ -142,6 +142,70 @@ class AutoscaleController:
         except Exception:
             logger.exception("autoscale on_event subscriber raised")
 
+    # -- revocation events (spot slices) --------------------------------
+
+    def note_revocation(self, role_name: str,
+                        service: Optional[str] = None) -> bool:
+        """Revocation-event subscription (docs/design/spot-revocation.md):
+        apply replacement scale-up IMMEDIATELY, ahead of the metrics
+        loop.  A revoked slice's capacity is gone NOW; waiting for the
+        queue/TTFT signals to notice costs a full collect window plus
+        the scale-up stabilization — exactly the window the revocation
+        notice exists to beat.  The replacement may exceed
+        ``autoscaling.maxReplicas`` by the role's
+        ``spot.replacementSurge`` headroom (temporary over-provision
+        while the reclaimed slice reschedules; the normal loop drains
+        back below max once signals quiet down).  Returns True when a
+        replacement scale-up was applied."""
+        for raw in self.client.list("InferenceService", self.namespace):
+            try:
+                svc = InferenceService.from_dict(raw)
+                svc.validate()
+            except ValueError:
+                continue
+            if service is not None and svc.name != service:
+                continue
+            for role in svc.spec.worker_roles():
+                if role.name != role_name:
+                    continue
+                spec = role.autoscaling
+                if spec is None or not spec.enabled:
+                    logger.info(
+                        "revocation of %s/%s role %s noted but "
+                        "autoscaling is off; reconciler will respawn "
+                        "the declared replicas", svc.namespace,
+                        svc.name, role.name)
+                    return False
+                spot = getattr(role, "spot", None)
+                surge = (spot.replacement_surge
+                         if spot is not None and spot.enabled else 0)
+                cap = spec.max_replicas + surge
+                desired = min(role.replicas + 1, cap)
+                if desired <= role.replicas:
+                    logger.info(
+                        "revocation replacement for %s/%s role %s "
+                        "limited: already at %d (max %d + surge %d)",
+                        svc.namespace, svc.name, role.name,
+                        role.replicas, spec.max_replicas, surge)
+                    self.metrics.observe(svc.namespace, svc.name,
+                                         role.name, desired,
+                                         role.replicas, "hold")
+                    return False
+                if not self._apply_replicas(raw, role.name, desired):
+                    return False  # conflicted; the metrics loop catches up
+                self.metrics.observe(svc.namespace, svc.name, role.name,
+                                     desired, role.replicas, "up",
+                                     scaled_at=self._clock())
+                self._publish("up", role.name, role.replicas, desired)
+                logger.info(
+                    "revocation replacement: scale up %s/%s role %s "
+                    "%d → %d (ahead of the metrics loop)",
+                    svc.namespace, svc.name, role.name, role.replicas,
+                    desired)
+                return True
+        logger.warning("revocation noted for unknown role %r", role_name)
+        return False
+
     # -- loop --
 
     def run(self, stop: threading.Event) -> None:

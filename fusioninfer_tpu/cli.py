@@ -276,6 +276,18 @@ def build_parser() -> argparse.ArgumentParser:
                             "queue bounds with 429 + Retry-After, and the "
                             "scheduler reserves per-tier token-budget "
                             "shares (docs/design/scheduler.md)")
+    serve.add_argument("--evacuate-grace-s", type=float, default=0.0,
+                       help="spot posture: treat SIGTERM as a revocation "
+                            "notice of this many seconds — park in-flight "
+                            "streams to the host KV tier and export the "
+                            "frames to --evacuate-peer survivors instead "
+                            "of draining (0 = off, drain on SIGTERM; "
+                            "docs/design/spot-revocation.md)")
+    serve.add_argument("--evacuate-peer", action="append", default=[],
+                       metavar="URL",
+                       help="survivor base URL the evacuation exports "
+                            "parked KV frames to (repeatable; first "
+                            "reachable peer wins)")
     serve.add_argument("--enable-profiling", action="store_true",
                        help="expose /debug/profile (writes to FUSIONINFER_PROFILE_DIR)")
     serve.add_argument("--lora", action="append", default=[],

@@ -1,0 +1,123 @@
+"""Slice evacuation: the planning half of graceful spot revocation.
+
+Production TPU capacity is largely preemptible: a slice gets an
+N-second revocation notice, then dies for real.  The serving stack
+treats that as a NORMAL operating regime, not an outage
+(docs/design/spot-revocation.md):
+
+1. the engine flips into an EVACUATING state — admission closes with
+   503 + Retry-After so the router holds the endpoint softly and
+   retries land on survivors;
+2. within the notice window every in-flight stream is parked via the
+   KV-preserving preemption path (complete written pages registered as
+   content-addressed blocks and offloaded to the host KV tier),
+   **most-urgent-tier-first** so interactive work is guaranteed to park
+   before the deadline;
+3. streams that cannot park in time degrade to recompute-on-survivor —
+   their clients get a structured retriable abort, never silent loss;
+4. the parked frames are exported to a surviving engine's host tier
+   over the kv_transfer wire format (CRC-checked), and the parked
+   chains' digest is pushed to the EPP so retried requests route to
+   the engine that can restore the parked prefix.
+
+This module is the PURE half: victim ordering and the notice-budget
+arithmetic are deterministic functions of scheduler state (no clocks,
+no device work, no I/O — the same discipline as ``engine/slo.py``), so
+the evacuation schedule replays identically under an injected clock.
+The engine (``NativeEngine._evacuate_step``) owns the device-side park
+work; the server (``EngineServer.evacuate``) owns the export RPC.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Fraction of the revocation notice reserved for work AFTER parking:
+# exporting the parked frames to a survivor and tearing the listener
+# down.  The park deadline is therefore notice * (1 - reserve) — a park
+# that would eat the export window is worth less than the export of the
+# pages already parked (survivors can always recompute an unparked
+# stream from its prompt; they cannot conjure the exported frames).
+EXPORT_RESERVE_FRAC = 0.25
+
+
+def park_deadline(now: float, notice_s: float,
+                  export_reserve_frac: float = EXPORT_RESERVE_FRAC) -> float:
+    """Absolute deadline (on the caller's clock) by which parking must
+    finish: the notice window minus the export/teardown reserve.  A
+    non-positive notice means the deadline is already past — every
+    victim degrades to recompute-on-survivor."""
+    if not 0.0 <= export_reserve_frac < 1.0:
+        raise ValueError("export_reserve_frac must be in [0, 1)")
+    return now + max(0.0, notice_s) * (1.0 - export_reserve_frac)
+
+
+@dataclass
+class EvacuationVictim:
+    """One in-flight stream the evacuation must dispose of.
+
+    ``tokens`` is the full prefix whose KV the pages hold (prompt +
+    generated for running victims, the prompt for mid-prefill ones);
+    ``written`` is the count of positions actually written to pages —
+    the same contract as ``NativeEngine._park_preempted``."""
+
+    request: object  # engine.Request (duck-typed: priority/arrival_time)
+    tokens: list
+    written: int
+
+
+def evacuation_order(running: list[tuple], prefilling: list[tuple]
+                     ) -> list[EvacuationVictim]:
+    """Park order for the notice window: most urgent tier first
+    (ascending priority value, then FCFS by arrival) — under a notice
+    too short to park everything, interactive streams park before
+    batch, so the guaranteed-latency tier is also the guaranteed-park
+    tier.  Ties between a running and a mid-prefill victim of equal
+    urgency park the running one first: its pages carry generated
+    tokens a recompute would have to re-decode, while a mid-prefill
+    victim's pages are pure prompt prefix any survivor can rebuild from
+    the retried request alone."""
+    decorated = [
+        (r.priority, r.arrival_time, 0, i, EvacuationVictim(r, list(t), w))
+        for i, (r, t, w) in enumerate(running)
+    ] + [
+        (r.priority, r.arrival_time, 1, i, EvacuationVictim(r, list(t), w))
+        for i, (r, t, w) in enumerate(prefilling)
+    ]
+    decorated.sort(key=lambda e: e[:4])
+    return [e[4] for e in decorated]
+
+
+@dataclass
+class EvacuationReport:
+    """The evacuation's ledger, returned by ``EngineServer.evacuate``
+    (and surfaced by podsim's ``revoke``): what was parked, what
+    degraded, and where the frames went.  ``hashes`` is the parked
+    chains' digest (hex) the EPP is primed with so retried requests
+    route to the importing survivor."""
+
+    evacuated_streams: int = 0
+    parked_streams: int = 0
+    parked_pages: int = 0
+    unparked_streams: int = 0
+    exported_frames: int = 0
+    imported_frames: int = 0
+    import_rejected: int = 0
+    peer: Optional[str] = None
+    page_size: int = 0
+    hashes: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "evacuated_streams": self.evacuated_streams,
+            "parked_streams": self.parked_streams,
+            "parked_pages": self.parked_pages,
+            "unparked_streams": self.unparked_streams,
+            "exported_frames": self.exported_frames,
+            "imported_frames": self.imported_frames,
+            "import_rejected": self.import_rejected,
+            "peer": self.peer,
+            "page_size": self.page_size,
+            "hashes": list(self.hashes),
+        }

@@ -101,6 +101,8 @@ class HostKVTier:
         self._hits_total = 0            # take() calls that served a page
         self._restores_total = 0        # pages re-injected into HBM
         self._corrupt_dropped_total = 0  # CRC-rejected entries dropped
+        self._imported_total = 0         # frames adopted from a peer
+        self._import_rejected_total = 0  # peer frames failing CRC/parse
         # bounded: each queued entry pins a device-array snapshot, so a
         # reclaim storm outrunning the serializer must shed load (drop-
         # OLDEST — the newest eviction is the most recently used chain,
@@ -197,22 +199,27 @@ class HostKVTier:
             # must be caught by CRC at restore time, not at store time
             data = self.fault_injector.corrupt(SITE_OFFLOAD_DATA, data)
         with self._lock:
-            old = self._entries.pop(h, None)
-            if old is not None:
-                self._bytes_used -= len(old)
-            self._entries[h] = data
-            self._bytes_used += len(data)
+            self._commit_locked(h, data)
             self._offloads_total += 1
-            # capacity watermark: evict LRU until the pool fits
-            while self._bytes_used > self.capacity_bytes and len(self._entries) > 1:
-                _, dropped = self._entries.popitem(last=False)
-                self._bytes_used -= len(dropped)
-                self._evictions_total += 1
-            if self._bytes_used > self.capacity_bytes:
-                # a single frame larger than the pool can never be held
-                _, dropped = self._entries.popitem(last=False)
-                self._bytes_used -= len(dropped)
-                self._evictions_total += 1
+
+    def _commit_locked(self, h: bytes, data: bytes) -> None:
+        """Insert one serialized frame at the MRU end and enforce the
+        capacity watermark (caller holds the lock)."""
+        old = self._entries.pop(h, None)
+        if old is not None:
+            self._bytes_used -= len(old)
+        self._entries[h] = data
+        self._bytes_used += len(data)
+        # capacity watermark: evict LRU until the pool fits
+        while self._bytes_used > self.capacity_bytes and len(self._entries) > 1:
+            _, dropped = self._entries.popitem(last=False)
+            self._bytes_used -= len(dropped)
+            self._evictions_total += 1
+        if self._bytes_used > self.capacity_bytes:
+            # a single frame larger than the pool can never be held
+            _, dropped = self._entries.popitem(last=False)
+            self._bytes_used -= len(dropped)
+            self._evictions_total += 1
 
     # -- restore (host -> HBM) ----------------------------------------------
 
@@ -264,6 +271,44 @@ class HostKVTier:
         with self._lock:
             self._restores_total += n_pages
 
+    # -- evacuation export/import (host -> host, cross-engine) ---------------
+
+    def export_frames(self, limit: int = 0) -> list[tuple[bytes, bytes]]:
+        """Serialized frames for evacuation export, most-recently-used
+        first (hash, frame bytes).  Frames are already on the
+        kv_transfer wire format (CRC32 inside), so the importer can
+        validate without this tier re-serializing anything."""
+        with self._lock:
+            hashes = list(reversed(self._entries))
+            if limit:
+                hashes = hashes[:limit]
+            return [(h, self._entries[h]) for h in hashes]
+
+    def import_frame(self, h: bytes, data: bytes) -> bool:
+        """Adopt one exported frame from an evacuating peer.  The frame
+        is parsed FIRST (CRC32 and layout checked by ``slab_from_bytes``)
+        so a frame corrupted in flight — or poisoned before export — is
+        rejected at the door instead of failing every future hit;
+        accepted frames land at the MRU end under the same capacity
+        watermark as local offloads.  ``h`` is the CALLER'S claim: the
+        content address hashes token ids, not KV bytes, so this tier
+        cannot verify the binding itself — the server's import handler
+        guards the wire pairing with a (hash‖data) CRC, and the
+        endpoint sits in the same service trust domain as
+        ``/v1/prefill``'s slab pulls."""
+        try:
+            slab_from_bytes(data)
+        except (KVTransferError, ValueError, KeyError) as e:
+            with self._lock:
+                self._import_rejected_total += 1
+            logger.warning("imported frame for %s rejected (%s); dropped",
+                           h.hex(), e)
+            return False
+        with self._lock:
+            self._commit_locked(h, data)
+            self._imported_total += 1
+        return True
+
     # -- introspection -------------------------------------------------------
 
     def resident_blocks(self) -> int:
@@ -289,6 +334,8 @@ class HostKVTier:
                 "host_hits": self._hits_total,
                 "restores": self._restores_total,
                 "corrupt_dropped": self._corrupt_dropped_total,
+                "imported": self._imported_total,
+                "import_rejected": self._import_rejected_total,
                 "resident_blocks": len(self._entries),
                 "bytes_used": self._bytes_used,
             }

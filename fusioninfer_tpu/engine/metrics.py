@@ -192,6 +192,7 @@ class EngineMetrics:
         ]
         lines += self._render_slo_tiers(labels)
         lines += self._render_kv_tiers(engine, labels)
+        lines += self._render_evacuation(engine, labels)
         lines += self._render_scheduler(engine, labels)
         return "\n".join(lines) + "\n"
 
@@ -294,8 +295,35 @@ class EngineMetrics:
             "# HELP fusioninfer:kv_host_tier_bytes Host-tier slab pool bytes in use.",
             "# TYPE fusioninfer:kv_host_tier_bytes gauge",
             f"fusioninfer:kv_host_tier_bytes{{{labels}}} {c['bytes_used']}",
+            "# HELP fusioninfer:kv_host_imported_total Frames adopted from an evacuating peer's host tier.",
+            "# TYPE fusioninfer:kv_host_imported_total counter",
+            f"fusioninfer:kv_host_imported_total{{{labels}}} {c['imported']}",
+            "# HELP fusioninfer:kv_host_import_rejected_total Peer frames rejected at import (CRC/parse failure).",
+            "# TYPE fusioninfer:kv_host_import_rejected_total counter",
+            f"fusioninfer:kv_host_import_rejected_total{{{labels}}} {c['import_rejected']}",
         ]
         return lines
+
+    @staticmethod
+    def _render_evacuation(engine, labels: str) -> list[str]:
+        """Graceful-evacuation families (docs/design/spot-revocation.md).
+        Engines predating evacuation (test stubs) omit them."""
+        if not hasattr(engine, "evac_streams_total"):
+            return []
+        return [
+            "# HELP fusioninfer:evac_streams_total In-flight streams failed with a retriable abort by graceful evacuation.",
+            "# TYPE fusioninfer:evac_streams_total counter",
+            f"fusioninfer:evac_streams_total{{{labels}}} {engine.evac_streams_total}",
+            "# HELP fusioninfer:evac_parked_streams_total Evacuation victims whose KV pages were parked before the notice deadline.",
+            "# TYPE fusioninfer:evac_parked_streams_total counter",
+            f"fusioninfer:evac_parked_streams_total{{{labels}}} {engine.evac_parked_streams_total}",
+            "# HELP fusioninfer:evac_parked_pages_total KV pages parked by evacuation victims.",
+            "# TYPE fusioninfer:evac_parked_pages_total counter",
+            f"fusioninfer:evac_parked_pages_total{{{labels}}} {engine.evac_parked_pages_total}",
+            "# HELP fusioninfer:evac_unparked_total Evacuation victims degraded to recompute-on-survivor (notice expired mid-park).",
+            "# TYPE fusioninfer:evac_unparked_total counter",
+            f"fusioninfer:evac_unparked_total{{{labels}}} {engine.evac_unparked_total}",
+        ]
 
     @staticmethod
     def _render_scheduler(engine, labels: str) -> list[str]:

@@ -58,7 +58,11 @@ def stream_completion(
     reports as that error — both are FAILED attempts to the caller.  A
     429 shed reports ``http_429`` with the server's Retry-After parsed
     into ``retry_after_s`` — backpressure, not failure: the caller
-    holds the endpoint softly instead of tripping its breaker.
+    holds the endpoint softly instead of tripping its breaker.  An
+    evacuation 503 (admission closed under a revocation notice) parses
+    the same way, and a RETRIABLE mid-stream abort (the final error
+    chunk carries ``retry_after_s``) returns its hint so the caller
+    holds the dying endpoint while retrying a survivor.
     """
     payload_body = {
         "prompt": prompt, "max_tokens": max_tokens,
@@ -75,6 +79,7 @@ def stream_completion(
     n_chunks = 0
     ids: list = []
     finish: Optional[str] = None
+    chunk_retry_after: Optional[float] = None
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             for raw in resp:
@@ -96,9 +101,14 @@ def stream_completion(
                     ids.append(choice["token_id"])
                 if choice.get("finish_reason"):
                     finish = choice["finish_reason"]
+                    if choice.get("retry_after_s") is not None:
+                        chunk_retry_after = float(choice["retry_after_s"])
     except urllib.error.HTTPError as e:
         retry_after = None
-        if e.code == 429:
+        if e.code in (429, 503):
+            # 429 = tier shed, 503 = evacuation notice — both carry a
+            # Retry-After the caller holds the endpoint on (a plain
+            # drain 503 carries none and stays a failed attempt)
             try:
                 retry_after = float(e.headers.get("Retry-After") or "")
             except ValueError:
@@ -109,7 +119,7 @@ def stream_completion(
     if finish is None:
         return None, None, ids, None, "truncated_stream", None
     if finish.startswith("error"):
-        return None, None, ids, finish, finish, None
+        return None, None, ids, finish, finish, chunk_retry_after
     ttft = (first - t0) if first is not None else None
     tpot = ((last - first) / (n_chunks - 1)
             if first is not None and n_chunks > 1 else None)
@@ -174,15 +184,29 @@ class FleetClient:
                 ep.url, prompt, max_tokens, self.timeout_s, seed,
                 temperature, on_first_chunk, slo_tier=slo_tier)
             ok = err is None and finish in ("length", "stop")
-            if err == "http_429":
+            if err == "http_429" or (err == "http_503"
+                                     and retry_after is not None):
                 # backpressure, not failure: hold the engine softly for
                 # its Retry-After and retry elsewhere WITHOUT burning
-                # an attempt or the breaker
+                # an attempt or the breaker.  A 503 WITH Retry-After is
+                # an evacuation notice — same protocol-working shape as
+                # the 429 shed (a plain drain 503 has no Retry-After
+                # and stays a failed attempt below).  Holds install
+                # only for picker-chosen endpoints: a ``pick`` override
+                # (warmups, pinned fault probes) must not pollute the
+                # worker picker's holds, mirroring report_result below.
                 held += 1
                 attempts -= 1
-                self._picker.note_saturated(ep.name, retry_after)
+                if pick is None:
+                    self._picker.note_saturated(ep.name, retry_after)
                 time.sleep(min(retry_after or self.retry_pause_s, 1.0))
                 continue
+            if pick is None and not ok and retry_after is not None:
+                # retriable mid-stream abort (evacuation/slice loss):
+                # the attempt failed, but the engine told us to route
+                # around it — hold it so the immediate retry lands on a
+                # survivor instead of re-picking the dying endpoint
+                self._picker.note_saturated(ep.name, retry_after)
             if pick is None:
                 # only the picker that chose the endpoint learns the
                 # outcome — a ``pick`` override (warmups, pinned fault

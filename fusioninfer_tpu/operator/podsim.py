@@ -132,6 +132,59 @@ class LWSSimulator:
         server.kill()
         logger.info("podsim: killed %s (pod object left stale)", lws_name)
 
+    def revoke(self, lws_name: str, notice_s: float = 2.0) -> dict:
+        """Spot-slice revocation: an N-second notice, then the slice
+        dies for real — distinct from :meth:`kill` (which is the
+        no-notice failure mode).  The engine gets the notice via
+        :meth:`EngineServer.evacuate` — admission closes with 503 +
+        Retry-After, in-flight streams park to the host KV tier
+        most-urgent-first, and the parked frames export to a surviving
+        same-service engine — and then the notice expires: the server
+        is killed exactly like a reclaimed slice.  Respawn stays
+        suspended until :meth:`revive` (capacity returning).  Returns
+        the evacuation report (``engine/evacuate.py``)."""
+        with self._lock:
+            server = self.servers.pop(lws_name, None)
+            if server is not None:
+                self._suspended.add(lws_name)
+        if server is None:
+            raise KeyError(f"no live engine for LWS {lws_name!r}")
+        peers = self._peer_urls(lws_name)
+        try:
+            report = server.evacuate(notice_s, peers=peers)
+        except Exception:
+            logger.exception("evacuation of %s failed; the slice dies "
+                             "unevacuated (clients retry survivors)",
+                             lws_name)
+            report = {}
+        server.kill()
+        logger.info(
+            "podsim: revoked %s after %gs notice (%s parked stream(s), "
+            "%s frame(s) -> %s)", lws_name, notice_s,
+            report.get("parked_streams", 0),
+            report.get("imported_frames", 0), report.get("peer"))
+        return report
+
+    def _peer_urls(self, lws_name: str) -> list[str]:
+        """Survivor engines of the victim's service (matched by the
+        pod service label) — the evacuation's export targets."""
+        victim = self.client.get_or_none("Pod", self.namespace,
+                                         f"{lws_name}-0")
+        service = (((victim or {}).get("metadata") or {})
+                   .get("labels") or {}).get(LABEL_SERVICE, "")
+        with self._lock:
+            servers = dict(self.servers)
+        out = []
+        for name, server in servers.items():
+            if name == lws_name:
+                continue
+            pod = self.client.get_or_none("Pod", self.namespace,
+                                          f"{name}-0")
+            labels = ((pod or {}).get("metadata") or {}).get("labels") or {}
+            if labels.get(LABEL_SERVICE) == service:
+                out.append(f"http://127.0.0.1:{server.port}")
+        return out
+
     def revive(self, lws_name: str) -> None:
         """Let the 'cluster' notice the death: delete the stale Pod and
         lift the respawn suspension — the simulator loop then boots a

@@ -83,6 +83,31 @@ def _validate_slo_tiers(block) -> None:
                     f"{key!r} (allowed: {sorted(SLO_TIER_KEYS)})")
 
 
+# spot passthrough block (strategy.generate_epp_config): which roles
+# serve on preemptible slices.  Keys pinned like the tier keys above so
+# a typo'd spot knob fails at render instead of silently no-opping.
+SPOT_ROLE_KEYS = frozenset({
+    "enabled", "tolerationKey", "terminationGracePeriodSeconds",
+    "replacementSurge", "requireSpotNodes",
+})
+
+
+def _validate_spot(block) -> None:
+    roles = block.get("roles") if isinstance(block, dict) else None
+    if not isinstance(roles, dict) or not roles:
+        raise EPPSchemaError(
+            "spot must be a mapping with a non-empty 'roles' mapping")
+    for name, entry in roles.items():
+        if not isinstance(entry, dict):
+            raise EPPSchemaError(
+                f"spot role {name!r}: entry must be a mapping")
+        for key in entry:
+            if key not in SPOT_ROLE_KEYS:
+                raise EPPSchemaError(
+                    f"spot role {name!r}: unknown key {key!r} "
+                    f"(allowed: {sorted(SPOT_ROLE_KEYS)})")
+
+
 def validate_epp_config(config_yaml: str) -> dict:
     """Parse + validate a generated EndpointPickerConfig; returns the
     parsed dict or raises :class:`EPPSchemaError` naming the offending
@@ -92,6 +117,8 @@ def validate_epp_config(config_yaml: str) -> dict:
         raise EPPSchemaError("config is not a mapping")
     if "sloTiers" in cfg:
         _validate_slo_tiers(cfg["sloTiers"])
+    if "spot" in cfg:
+        _validate_spot(cfg["spot"])
     declared: set[str] = set()
     for plugin in cfg.get("plugins") or []:
         ptype = plugin.get("type")

@@ -342,6 +342,43 @@ class ResidencyProvider:
         with self._lock:
             self._cache.pop(name, None)
 
+    def add_host_blocks(self, name: str, hashes, page_size: int) -> None:
+        """Merge PUSHED block hashes (hex) into ``name``'s cached digest
+        as host-tier residents — the evacuation path: an evacuating
+        slice exported its parked frames to this endpoint's host tier,
+        and the retried streams land NOW, before any ttl-paced
+        re-fetch would discover the import.  A digest created from a
+        push alone is marked truncated (it asserts the pushed chains'
+        presence, not a full view of the engine's caches), so a zero
+        match still falls back to the history heuristic; merging into
+        an existing fresh digest keeps its truncation verdict."""
+        pushed = frozenset(str(h) for h in hashes or ())
+        if not pushed or page_size <= 0:
+            return
+        now = self._clock()
+        with self._lock:
+            cached = self._cache.get(name)
+            d = cached[2] if cached is not None else None
+            # "still servable" matches digest()'s own last-known-good
+            # bound: a digest score() would still serve gets the push
+            # MERGED in (keeping its ORIGINAL fetched_at, so the merge
+            # never extends the fetched contents' LKG life) — while a
+            # digest past max_age must not be revived as a fresh
+            # authoritative view (score() would hard-0 prompts the
+            # engine actually holds)
+            servable = cached is not None and now - cached[1] <= self.max_age_s
+            if d is not None and servable and d["page_size"] == page_size:
+                d = {**d, "host": d["host"] | pushed}
+                self._cache[name] = (now, cached[1], d)
+            else:
+                # no digest (or an expired one): a push-only digest
+                # carries just the pushed chains and is marked
+                # truncated, so a zero match still falls back to the
+                # heuristic instead of reading an authoritative miss
+                d = {"page_size": page_size, "hbm": frozenset(),
+                     "host": pushed, "truncated": True}
+                self._cache[name] = (now, now, d)
+
     def retain(self, names) -> None:
         """Drop cached digests for endpoints no longer in the fleet
         snapshot — pod churn must not grow the cache forever, and a
@@ -471,6 +508,29 @@ class EndpointPicker:
     def is_draining(self, name: str) -> bool:
         with self._draining_lock:
             return name in self._draining
+
+    # -- evacuation (spot revocation) --
+
+    def note_evacuated(self, victim: str, survivor: Optional[str] = None,
+                       hashes=None, page_size: int = 0,
+                       retry_after_s: Optional[float] = None) -> None:
+        """Revocation push (docs/design/spot-revocation.md): the fleet
+        harness — or a sidecar watching evacuation events — tells the
+        picker a slice is evacuating.  The victim stops receiving new
+        assignments immediately (drain semantics, residency
+        invalidated, plus a soft hold for its remaining notice), and
+        the SURVIVOR that imported the parked frames is primed with the
+        parked chains' digest so the very retries the evacuation
+        created route to the engine that can restore them — extending
+        the PR 8 residency surface with a push path next to its poll
+        path.  A replacement endpoint reusing the victim's name clears
+        the drain mark via ``set_draining(victim, False)``."""
+        self.set_draining(victim, True)
+        if retry_after_s:
+            self.note_saturated(victim, retry_after_s)
+        if (self._residency is not None and survivor
+                and hashes and page_size > 0):
+            self._residency.add_host_blocks(survivor, hashes, page_size)
 
     # -- saturation (429 soft holds) --
 

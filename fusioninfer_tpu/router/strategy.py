@@ -156,6 +156,16 @@ def generate_epp_config(svc: InferenceService, role: Role) -> str:
         # engines' 429 backpressure (the upstream EPP image ignores the
         # block — enforcement lives in the engines either way)
         cfg["sloTiers"] = svc.spec.slo_tiers.to_dict()
+    spot_roles = {r.name: r.spot.to_dict()
+                  for r in svc.spec.worker_roles() if r.spot is not None}
+    if spot_roles:
+        # spot passthrough: which roles serve on preemptible slices
+        # (and their notice windows) ride the rendered config so the
+        # router layer knows evacuation 503s + revocation pushes are
+        # expected operating events on these endpoints, not outages
+        # (the upstream EPP image ignores the block; the in-process
+        # picker's note_evacuated path is its consumer)
+        cfg["spot"] = {"roles": spot_roles}
     _check_scorer_metric_surface(svc, cfg)
     out = yaml.safe_dump(cfg, sort_keys=False)
     # a key the EPP image would silently ignore must fail at render time,

@@ -43,17 +43,20 @@ from __future__ import annotations
 # footprint plus bounded headroom — small enough that one stray
 # signature family (a shape that skipped its bucket, a weak-type flip,
 # an env knob resolved at trace time) trips the gate.  Measured on this
-# round's fast tier: kernels 32, sampler 14, engine-helpers 6, fused 4,
-# prefill 3, decode/verify/model 0 (the fast tier runs the kernel and
-# admission suites; the engine-forward-heavy suites live in full
-# tier-1).  A breach means find the retrace, or grow the budget HERE in
-# the same diff that grows the tier — never silently.
+# round's fast tier: kernels 32, sampler 24, fused 21, prefill 17,
+# engine-helpers ~8, decode/verify/model 0 — the evacuation suite
+# (tests/test_evacuation.py, mandated into the fast tier by the
+# spot-revocation PR) drives real victim/survivor engine forwards and
+# grew fused/prefill/sampler accordingly, even with its cache/batch
+# shapes aligned to the pre-existing fast suites' signatures.  A breach
+# means find the retrace, or grow the budget HERE in the same diff that
+# grows the tier — never silently.
 FAMILY_BUDGETS: dict[str, int] = {
     "decode": 16,
-    "prefill": 12,
+    "prefill": 24,
     "verify": 12,
-    "fused": 12,
-    "sampler": 24,
+    "fused": 28,
+    "sampler": 30,
     "engine-helpers": 12,
     "kernels": 48,
     "model": 12,

@@ -68,6 +68,28 @@ def _render_tpu(pod_spec: dict, shape: SliceShape) -> None:
     # requests must equal limits for extended resources; let k8s default it.
 
 
+def _render_spot(pod_spec: dict, role: Role) -> None:
+    """Spot posture (``spec.roles[*].spot``): tolerate the provider's
+    spot taint, give the pod the WHOLE revocation notice as
+    ``terminationGracePeriodSeconds`` (the engine's SIGTERM evacuation
+    must park + export inside it), and optionally pin to spot nodes.
+    User-supplied template values win — the stanza fills gaps, it
+    never overrides an explicit pod spec."""
+    spot = role.spot
+    if spot is None or not spot.enabled:
+        return
+    pod_spec.setdefault("terminationGracePeriodSeconds",
+                        spot.termination_grace_period_s)
+    tolerations = pod_spec.setdefault("tolerations", [])
+    toleration = {"key": spot.toleration_key, "operator": "Exists",
+                  "effect": "NoSchedule"}
+    if not any(t.get("key") == spot.toleration_key for t in tolerations):
+        tolerations.append(toleration)
+    if spot.require_spot_nodes:
+        pod_spec.setdefault("nodeSelector", {}).setdefault(
+            spot.toleration_key, "true")
+
+
 def _base_pod_spec(role: Role, cfg: LWSConfig) -> dict:
     template = copy.deepcopy(role.template or {})
     pod_spec = copy.deepcopy(template.get("spec") or {})
@@ -76,6 +98,7 @@ def _base_pod_spec(role: Role, cfg: LWSConfig) -> dict:
     shape = role.slice_shape()
     if shape is not None:
         _render_tpu(pod_spec, shape)
+    _render_spot(pod_spec, role)
     return pod_spec
 
 

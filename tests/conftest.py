@@ -61,7 +61,8 @@ import pytest  # noqa: E402 — after the backend bootstrap above
 # heavy suites (fused step, token budget, e2e serving) OUT: they are
 # what the full tier is for.
 FAST_MODULES = {
-    "test_api_types.py", "test_applyconfig.py", "test_fusionlint.py",
+    "test_api_types.py", "test_applyconfig.py", "test_evacuation.py",
+    "test_fusionlint.py",
     "test_hash.py", "test_informers.py", "test_kv_host_tier.py",
     "test_leader_election.py",
     "test_manifests.py", "test_metrics.py", "test_names.py",

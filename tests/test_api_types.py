@@ -121,3 +121,76 @@ def test_crd_manifest_shape():
         "type": "object",
         "x-kubernetes-preserve-unknown-fields": True,
     }
+
+
+class TestSpotSpec:
+    """spec.roles[*].spot: preemptible-capacity posture
+    (docs/design/spot-revocation.md)."""
+
+    def _svc(self, spot):
+        m = sample_manifest()
+        worker = next(r for r in m["spec"]["roles"]
+                      if r["componentType"] != "router")
+        worker["spot"] = spot
+        return m
+
+    def test_round_trip(self):
+        spot = {"enabled": True, "tolerationKey": "custom/spot",
+                "terminationGracePeriodSeconds": 45,
+                "replacementSurge": 2, "requireSpotNodes": True}
+        svc = InferenceService.from_dict(self._svc(spot))
+        svc.validate()
+        role = next(r for r in svc.spec.roles
+                    if r.component_type != ComponentType.ROUTER)
+        assert role.spot.toleration_key == "custom/spot"
+        assert role.spot.termination_grace_period_s == 45
+        assert role.spot.replacement_surge == 2
+        assert role.spot.require_spot_nodes is True
+        assert svc.to_dict()["spec"]["roles"][1]["spot"] == spot
+
+    def test_defaults(self):
+        # ({} is falsy and ignored, like an empty autoscaling stanza)
+        svc = InferenceService.from_dict(self._svc({"enabled": True}))
+        svc.validate()
+        role = svc.spec.roles[1]
+        assert role.spot.enabled is True
+        assert role.spot.toleration_key == "cloud.google.com/gke-spot"
+        assert role.spot.termination_grace_period_s == 30
+        assert role.spot.replacement_surge == 1
+        assert role.spot.require_spot_nodes is False
+        # defaults serialize minimally
+        assert svc.to_dict()["spec"]["roles"][1]["spot"] == {
+            "enabled": True}
+
+    def test_router_spot_refused(self):
+        m = sample_manifest()
+        m["spec"]["roles"][0]["spot"] = {"enabled": True}
+        with pytest.raises(ValidationError, match="spot"):
+            InferenceService.from_dict(m).validate()
+
+    def test_zero_grace_refused(self):
+        m = self._svc({"terminationGracePeriodSeconds": 0})
+        with pytest.raises(ValidationError, match="Grace"):
+            InferenceService.from_dict(m).validate()
+
+    def test_negative_surge_refused(self):
+        m = self._svc({"replacementSurge": -1})
+        with pytest.raises(ValidationError, match="Surge"):
+            InferenceService.from_dict(m).validate()
+
+    def test_empty_toleration_key_refused(self):
+        m = self._svc({"tolerationKey": ""})
+        with pytest.raises(ValidationError, match="tolerationKey"):
+            InferenceService.from_dict(m).validate()
+
+    def test_crd_documents_spot(self):
+        crd = build_crd()
+        role_schema = crd["spec"]["versions"][0]["schema"][
+            "openAPIV3Schema"]["properties"]["spec"]["properties"][
+            "roles"]["items"]
+        spot = role_schema["properties"]["spot"]
+        assert spot["description"]
+        for key in ("enabled", "tolerationKey",
+                    "terminationGracePeriodSeconds", "replacementSurge",
+                    "requireSpotNodes"):
+            assert spot["properties"][key]["description"], key

@@ -1112,3 +1112,81 @@ class TestSelfMetrics:
         assert ('fusioninfer:autoscaler_last_scale_clock_seconds'
                 '{namespace="default",service="qwen",role="prefiller"} 15')\
             in text
+
+
+class TestRevocationSubscription:
+    """note_revocation: replacement scale-up applied IMMEDIATELY on a
+    spot revocation event, ahead of the metrics loop, bounded by
+    maxReplicas + spot.replacementSurge
+    (docs/design/spot-revocation.md)."""
+
+    def _controller(self, manifest):
+        fake = FakeK8s()
+        fake.create(manifest)
+        events: list = []
+        controller = AutoscaleController(
+            fake, collector=make_collector(FleetSim(), FakeClock()),
+            clock=FakeClock(),
+            on_event=lambda *e: events.append(e))
+        return fake, controller, events
+
+    def _spot_manifest(self, replicas=2, max_replicas=3, surge=1,
+                       spot=True):
+        m = pd_manifest()
+        role = m["spec"]["roles"][0]
+        role["replicas"] = replicas
+        role["autoscaling"]["maxReplicas"] = max_replicas
+        if spot:
+            role["spot"] = {"enabled": True,
+                            "terminationGracePeriodSeconds": 10,
+                            "replacementSurge": surge}
+        return m
+
+    def test_replacement_applied_immediately(self):
+        fake, controller, events = self._controller(self._spot_manifest())
+        assert controller.note_revocation("prefiller") is True
+        svc = fake.get("InferenceService", "default", "qwen")
+        assert svc["spec"]["roles"][0]["replicas"] == 3
+        assert ("up", "prefiller", 2, 3) in events
+
+    def test_surge_allows_exceeding_max_replicas(self):
+        fake, controller, events = self._controller(
+            self._spot_manifest(replicas=3))
+        assert controller.note_revocation("prefiller") is True
+        svc = fake.get("InferenceService", "default", "qwen")
+        assert svc["spec"]["roles"][0]["replicas"] == 4  # max 3 + surge 1
+
+    def test_clamped_at_max_plus_surge(self):
+        fake, controller, events = self._controller(
+            self._spot_manifest(replicas=4))
+        assert controller.note_revocation("prefiller") is False
+        svc = fake.get("InferenceService", "default", "qwen")
+        assert svc["spec"]["roles"][0]["replicas"] == 4
+        assert not events
+
+    def test_no_spot_stanza_no_surge(self):
+        fake, controller, events = self._controller(
+            self._spot_manifest(replicas=3, spot=False))
+        assert controller.note_revocation("prefiller") is False
+        assert fake.get("InferenceService", "default", "qwen"
+                        )["spec"]["roles"][0]["replicas"] == 3
+
+    def test_unknown_role_is_a_noop(self):
+        fake, controller, events = self._controller(self._spot_manifest())
+        assert controller.note_revocation("nope") is False
+        assert not events
+
+    def test_autoscaling_disabled_defers_to_reconciler(self):
+        m = self._spot_manifest()
+        m["spec"]["roles"][0]["autoscaling"]["enabled"] = False
+        fake, controller, events = self._controller(m)
+        assert controller.note_revocation("prefiller") is False
+        assert fake.get("InferenceService", "default", "qwen"
+                        )["spec"]["roles"][0]["replicas"] == 2
+
+    def test_service_filter(self):
+        fake, controller, events = self._controller(self._spot_manifest())
+        assert controller.note_revocation(
+            "prefiller", service="other") is False
+        assert controller.note_revocation(
+            "prefiller", service="qwen") is True

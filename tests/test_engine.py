@@ -886,9 +886,18 @@ class TestEngineFailureRecovery:
             req = urllib.request.Request(
                 f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
                 headers={"Content-Type": "application/json"})
-            # the request must come back as an error, not hang forever
-            r = json.loads(urllib.request.urlopen(req, timeout=120).read())
-            assert r["choices"][0]["finish_reason"].startswith("error:")
+            # the request must come back as a STRUCTURED retriable
+            # error, not hang forever: the persistent failure is this
+            # engine's fault, so the buffered non-streaming path maps
+            # it to 503 + Retry-After (the client retries a sibling)
+            import pytest as _pytest
+
+            with _pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=120)
+            assert ei.value.code == 503
+            assert float(ei.value.headers["Retry-After"]) > 0
+            body_err = json.loads(ei.value.read())
+            assert "persistently" in body_err["error"]["message"]
             # recovery: later requests succeed once the failure clears
             state["boom"] = False
             r2 = json.loads(urllib.request.urlopen(req, timeout=120).read())

@@ -98,6 +98,59 @@ class TestFleetChaos:
         assert part["controller_held"] is True
 
 
+@pytest.mark.chaos
+class TestFleetRevocation:
+    """Spot-slice revocation waves at fleet level: graceful evacuation,
+    survivor resume, proactive replacement (docs/design/
+    spot-revocation.md), observed through the module fixture's run."""
+
+    def test_waves_evacuated_and_parked(self, fleet_record):
+        rv = fleet_record["slo"]["revocation"]
+        assert rv["n_waves"] >= 2
+        assert rv["evacuated_streams"] > 0
+        assert rv["parked_streams"] > 0
+        assert rv["parked_pages"] > 0
+
+    def test_parked_frames_exported_to_a_survivor(self, fleet_record):
+        rv = fleet_record["slo"]["revocation"]
+        assert rv["exported_frames"] > 0
+        assert rv["imported_frames"] > 0
+        waves = [f for f in fleet_record["fault_ledger"]
+                 if f["fault"] == "revocation"]
+        assert len(waves) >= 2
+        assert all(w["peer"] for w in waves)
+
+    def test_every_revoked_stream_resumed_on_a_survivor(
+            self, fleet_record):
+        rv = fleet_record["slo"]["revocation"]
+        assert rv["resumed_on_survivor"] > 0
+        assert rv["lost_interactive"] == 0
+        for w in [f for f in fleet_record["fault_ledger"]
+                  if f["fault"] == "revocation"]:
+            assert w["stream_recovered"], w
+        # bit-identity rides the record-wide corruption gate: evacuated
+        # pool prompts byte-check against uninterrupted instances
+        assert fleet_record["slo"]["corrupted_streams"] == 0
+
+    def test_replacement_scale_up_applied_ahead_of_metrics_loop(
+            self, fleet_record):
+        rv = fleet_record["slo"]["revocation"]
+        assert rv["replacement_scale_ups"] >= 1
+        # wave 0 buys the surge replica (3 -> 4); wave 1 is at the cap
+        waves = [f for f in fleet_record["fault_ledger"]
+                 if f["fault"] == "revocation"]
+        assert waves[0]["replacement_applied"] is True
+        # and the surge unwinds back to maxReplicas before the faults
+        # phase (fast-forwarded spec patch; the drain protocol itself
+        # is the drain phase's gated surface)
+        assert "surge unwound" in fleet_record["event_ledger"]
+
+    def test_interactive_ttft_bounded_through_the_waves(
+            self, fleet_record):
+        rv = fleet_record["slo"]["revocation"]
+        assert rv["interactive_ttft_bounded"] is True
+
+
 class TestSeededDeterminism:
     def test_same_seed_same_event_ledger(self, fleet_record):
         """Same seed ⇒ same event ledger: phase request counts, scale
@@ -109,7 +162,9 @@ class TestSeededDeterminism:
         ledger = "\n".join(fleet_record["event_ledger"])
         for needle in ("scale:up", "scale:drain", "scale:down",
                        "fault:metrics_partition", "fault:kv_corrupt",
-                       "fault:slice_loss", "respawn"):
+                       "fault:slice_loss", "fault:revocation wave=0",
+                       "fault:revocation wave=1", "surge unwound",
+                       "respawn"):
             assert needle in ledger, ledger
 
 
@@ -121,14 +176,15 @@ class TestCheckFleetRecord:
         phase = {"requests": 4, "ok": 4, "lost": 0, "corrupted": 0,
                  "retried": 0, "ttft_ms": {"p50": 10.0, "p90": 12.0},
                  "strata": {}}
-        overload_phase = dict(
+        tiered = dict(
             phase,
             strata={t: {"requests": 2, "ok": 2, "lost": 0,
                         "ttft_ms": {"p50": 9.0, "p90": 11.0}}
                     for t in ("interactive", "batch")})
         phases = {n: dict(phase) for n in
                   ("steady", "scale_up", "faults", "recover", "drain")}
-        phases["overload"] = overload_phase
+        phases["overload"] = tiered
+        phases["revocation"] = dict(tiered)
         return {
             "schema": "fleet-v1",
             "phases": phases,
@@ -140,6 +196,10 @@ class TestCheckFleetRecord:
                 {"fault": "slice_loss", "stream_recovered": True,
                  "breaker_ejection_beat_timeout": True,
                  "recovery_s": 1.0, "client_timeout_s": 30.0},
+                {"fault": "revocation", "wave": 0,
+                 "stream_recovered": True, "replacement_applied": True},
+                {"fault": "revocation", "wave": 1,
+                 "stream_recovered": True, "replacement_applied": False},
             ],
             "slo": {
                 "lost_streams": 0, "corrupted_streams": 0,
@@ -157,6 +217,18 @@ class TestCheckFleetRecord:
                     "lost_interactive": 0, "held_429_client": 3,
                     "shed_429": 2, "preempted": 3, "parked": 3,
                     "resumed": 3,
+                },
+                "revocation": {
+                    "n_waves": 2, "evacuated_streams": 4,
+                    "parked_streams": 3, "parked_pages": 40,
+                    "unparked_streams": 0, "exported_frames": 40,
+                    "imported_frames": 40, "import_rejected": 0,
+                    "resumed_on_survivor": 3,
+                    "replacement_scale_ups": 1,
+                    "lost_interactive": 0,
+                    "interactive_ttft_p90_ms": 900.0,
+                    "ttft_p90_bound_ms": 15000.0,
+                    "interactive_ttft_bounded": True,
                 },
             },
             "event_ledger": ["boot engines=2"],
@@ -223,8 +295,53 @@ class TestCheckFleetRecord:
 
     def test_missing_tier_percentiles_fail(self):
         rec = self._good()
-        del rec["phases"]["overload"]["strata"]["batch"]
+        rec["phases"]["overload"] = dict(
+            rec["phases"]["overload"],
+            strata={"interactive":
+                    rec["phases"]["overload"]["strata"]["interactive"]})
         assert any("per-tier percentiles missing for 'batch'" in p
+                   for p in check_record(rec))
+
+    def test_missing_revocation_block_fails(self):
+        rec = self._good()
+        del rec["slo"]["revocation"]
+        assert any("slo.revocation" in p for p in check_record(rec))
+
+    def test_too_few_revocation_waves_fail(self):
+        rec = self._good()
+        rec["slo"]["revocation"]["n_waves"] = 1
+        assert any(">= 2 waves" in p for p in check_record(rec))
+
+    def test_zero_evacuation_counters_fail(self):
+        for key in ("evacuated_streams", "parked_streams",
+                    "exported_frames", "imported_frames",
+                    "resumed_on_survivor"):
+            rec = self._good()
+            rec["slo"]["revocation"][key] = 0
+            assert any(f"revocation: {key} is zero" in p
+                       for p in check_record(rec)), key
+
+    def test_lost_interactive_during_revocation_fails(self):
+        rec = self._good()
+        rec["slo"]["revocation"]["lost_interactive"] = 2
+        assert any("revocation: interactive streams were lost" in p
+                   for p in check_record(rec))
+
+    def test_no_replacement_scale_up_fails(self):
+        rec = self._good()
+        rec["slo"]["revocation"]["replacement_scale_ups"] = 0
+        assert any("replacement scale-up" in p for p in check_record(rec))
+
+    def test_unrecovered_revoked_stream_fails(self):
+        rec = self._good()
+        rec["fault_ledger"][3]["stream_recovered"] = False
+        assert any("never completed on a survivor" in p
+                   for p in check_record(rec))
+
+    def test_unbounded_revocation_ttft_fails(self):
+        rec = self._good()
+        rec["slo"]["revocation"]["interactive_ttft_bounded"] = False
+        assert any("revocation: interactive TTFT" in p
                    for p in check_record(rec))
 
     def test_record_is_json_serializable(self, fleet_record):

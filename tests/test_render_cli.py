@@ -16,7 +16,7 @@ SAMPLES = sorted(glob.glob(os.path.join(os.path.dirname(__file__), "..", "config
 
 
 def test_samples_exist():
-    assert len(SAMPLES) == 11
+    assert len(SAMPLES) == 12
 
 
 @pytest.mark.parametrize("path", SAMPLES, ids=[os.path.basename(p) for p in SAMPLES])

@@ -680,3 +680,231 @@ class TestResidencyLifecycle:
         # the replacement endpoint re-fetched instead of inheriting the
         # departed pod's last-known-good digest
         assert len(calls) == 2
+
+
+class TestEvacuationPush:
+    """The revocation push path (docs/design/spot-revocation.md): the
+    victim stops taking assignments, and the survivor that imported the
+    parked frames is primed with the parked chains' digest so retries
+    route to the engine that can restore them — no ttl wait."""
+
+    def _chain_hex(self, prompt: str, page_size: int = 16):
+        from fusioninfer_tpu.router.picker import byte_tokenize
+        from fusioninfer_tpu.utils.blockhash import block_hashes
+
+        return [h.hex() for h in
+                block_hashes(byte_tokenize(prompt), page_size)]
+
+    def test_note_evacuated_routes_retries_to_the_importer(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            EndpointPicker,
+            ResidencyProvider,
+        )
+
+        prompt = "S" * 64 + "tail"
+        empty = {"page_size": 16, "tiers": {"hbm": 0, "host": 0},
+                 "blocks": {"hbm": [], "host": []}}
+        eps = [Endpoint("victim", "http://v", {}),
+               Endpoint("survivor", "http://s", {}),
+               Endpoint("other", "http://o", {})]
+        provider = ResidencyProvider(fetch=lambda ep: dict(empty),
+                                     ttl_s=60.0)
+        picker = EndpointPicker(
+            TestResidencyScoring.CONFIG, endpoints=lambda: list(eps),
+            residency=provider)
+        picker.pick(prompt)  # caches every endpoint's EMPTY digest
+        picker.note_evacuated(
+            "victim", survivor="survivor",
+            hashes=self._chain_hex(prompt), page_size=16,
+            retry_after_s=3.0)
+        assert picker.is_draining("victim")
+        assert picker.is_saturated("victim")
+        # the pushed digest routes the retry to the importer — without
+        # waiting out the 60 s ttl on its cached empty digest
+        assert picker.pick(prompt).name == "survivor"
+        # a replacement reusing the name rejoins the rotation
+        picker.set_draining("victim", False)
+        assert not picker.is_draining("victim")
+
+    def test_pushed_digest_is_truncated_not_authoritative(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+        )
+
+        provider = ResidencyProvider(fetch=lambda ep: None, ttl_s=60.0)
+        provider.add_host_blocks("s", self._chain_hex("A" * 64), 16)
+        ep = Endpoint("s", "http://s", {})
+        # a prompt the push did NOT cover must fall back to the
+        # heuristic (None), not read an authoritative miss off the
+        # partial pushed view
+        assert provider.score("B" * 64, ep) is None
+        assert provider.score("A" * 64 + "xx", ep) is not None
+
+    def test_push_without_residency_mode_is_inert(self):
+        from fusioninfer_tpu.router.picker import Endpoint, EndpointPicker
+
+        eps = [Endpoint("victim", "http://v", {}),
+               Endpoint("other", "http://o", {})]
+        picker = EndpointPicker(TestResidencyScoring.CONFIG,
+                                endpoints=lambda: list(eps))
+        picker.note_evacuated("victim", survivor="other",
+                              hashes=["ab"], page_size=16)
+        assert picker.is_draining("victim")
+        assert picker.pick("hello").name == "other"
+
+
+class TestSpotPassthrough:
+    """spec.spot rides the rendered EPP config (informational for the
+    upstream image, consumed by the in-process picker's revocation
+    path) and its keys are schema-pinned."""
+
+    def test_spot_roles_render_into_epp_config(self):
+        from fusioninfer_tpu.api.types import SpotSpec
+
+        worker = worker_role()
+        worker.spot = SpotSpec(termination_grace_period_s=25,
+                               require_spot_nodes=True)
+        svc = svc_of(router_role(), worker)
+        cfg = yaml.safe_load(generate_epp_config(svc, svc.spec.roles[0]))
+        assert cfg["spot"]["roles"]["worker"][
+            "terminationGracePeriodSeconds"] == 25
+        assert cfg["spot"]["roles"]["worker"]["requireSpotNodes"] is True
+
+    def test_no_spot_no_block(self):
+        svc = svc_of(router_role(), worker_role())
+        cfg = yaml.safe_load(generate_epp_config(svc, svc.spec.roles[0]))
+        assert "spot" not in cfg
+
+    def test_unknown_spot_key_fails_validation(self):
+        import pytest
+
+        from fusioninfer_tpu.router.epp_schema import (
+            EPPSchemaError,
+            validate_epp_config,
+        )
+
+        bad = """
+apiVersion: inference.networking.x-k8s.io/v1alpha1
+kind: EndpointPickerConfig
+spot:
+  roles:
+    worker:
+      gracePeriod: 30
+plugins:
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - {pluginRef: max-score-picker}
+"""
+        with pytest.raises(EPPSchemaError, match="gracePeriod"):
+            validate_epp_config(bad)
+
+    def test_empty_spot_roles_fails_validation(self):
+        import pytest
+
+        from fusioninfer_tpu.router.epp_schema import (
+            EPPSchemaError,
+            validate_epp_config,
+        )
+
+        with pytest.raises(EPPSchemaError, match="spot"):
+            validate_epp_config(
+                "spot: {roles: {}}\nplugins: []\n")
+
+
+class TestStalePushMerge:
+    def test_push_never_revives_a_stale_digest(self):
+        """add_host_blocks onto a digest fetched long ago must NOT
+        re-stamp the stale hbm/host sets as a fresh authoritative view
+        (score() would hard-0 prompts the engine actually holds); the
+        push-only digest carries just the pushed chains, truncated."""
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+            byte_tokenize,
+        )
+        from fusioninfer_tpu.utils.blockhash import block_hashes
+
+        held = "H" * 64
+        pushed_prompt = "P" * 64
+        digest = {"page_size": 16,
+                  "tiers": {"hbm": 3, "host": 0},
+                  "blocks": {"hbm": [h.hex() for h in block_hashes(
+                      byte_tokenize(held), 16)], "host": []}}
+        clock = [0.0]
+        fetches = [0]
+
+        def fetch(ep):
+            fetches[0] += 1
+            if fetches[0] > 1:
+                raise OSError("down")
+            return digest
+
+        provider = ResidencyProvider(fetch=fetch, ttl_s=0.5, max_age_s=5.0,
+                                     clock=lambda: clock[0])
+        ep = Endpoint("s", "http://s", {})
+        assert provider.score(held, ep) == 1.0
+        clock[0] = 10.0  # past ttl AND max_age: the digest is history
+        pushed = [h.hex() for h in block_hashes(
+            byte_tokenize(pushed_prompt), 16)]
+        provider.add_host_blocks("s", pushed, 16)
+        # the pushed chains score; the STALE hbm view is gone — the
+        # held prompt falls back to the heuristic instead of reading an
+        # authoritative miss (or a revived stale hit)
+        assert provider.score(pushed_prompt, ep) is not None
+        assert provider.score(held, ep) is None
+
+    def test_push_merges_into_a_fresh_digest(self):
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+            byte_tokenize,
+        )
+        from fusioninfer_tpu.utils.blockhash import block_hashes
+
+        held = "H" * 64
+        digest = {"page_size": 16, "tiers": {"hbm": 3, "host": 0},
+                  "blocks": {"hbm": [h.hex() for h in block_hashes(
+                      byte_tokenize(held), 16)], "host": []}}
+        provider = ResidencyProvider(fetch=lambda ep: digest, ttl_s=60.0)
+        ep = Endpoint("s", "http://s", {})
+        assert provider.score(held, ep) == 1.0
+        pushed_prompt = "P" * 64
+        provider.add_host_blocks("s", [h.hex() for h in block_hashes(
+            byte_tokenize(pushed_prompt), 16)], 16)
+        # both the fresh fetched view and the pushed chains score
+        assert provider.score(held, ep) == 1.0
+        assert provider.score(pushed_prompt, ep) == provider.host_tier_weight
+
+    def test_push_merges_within_the_lkg_window(self):
+        """A digest past its ttl but inside max_age is one digest()
+        still SERVES — the push must merge into it (not blank the
+        survivor's authoritative HBM view), without extending the
+        fetched contents' last-known-good life."""
+        from fusioninfer_tpu.router.picker import (
+            Endpoint,
+            ResidencyProvider,
+            byte_tokenize,
+        )
+        from fusioninfer_tpu.utils.blockhash import block_hashes
+
+        held = "H" * 64
+        digest = {"page_size": 16, "tiers": {"hbm": 3, "host": 0},
+                  "blocks": {"hbm": [h.hex() for h in block_hashes(
+                      byte_tokenize(held), 16)], "host": []}}
+        clock = [0.0]
+        provider = ResidencyProvider(fetch=lambda ep: digest, ttl_s=0.5,
+                                     max_age_s=10.0,
+                                     clock=lambda: clock[0])
+        ep = Endpoint("s", "http://s", {})
+        assert provider.score(held, ep) == 1.0
+        clock[0] = 2.0  # past ttl, inside the LKG window
+        pushed_prompt = "P" * 64
+        provider.add_host_blocks("s", [h.hex() for h in block_hashes(
+            byte_tokenize(pushed_prompt), 16)], 16)
+        assert provider.score(held, ep) == 1.0  # HBM view survives
+        assert provider.score(pushed_prompt, ep) == \
+            provider.host_tier_weight

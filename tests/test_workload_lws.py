@@ -212,3 +212,62 @@ def test_native_probe_honors_custom_port():
     lws = build_lws(role, CFG)
     c = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]["containers"][0]
     assert c["readinessProbe"]["httpGet"]["port"] == 9000
+
+
+class TestSpotRendering:
+    """spec.spot → rendered pod spec: toleration + termination grace
+    (the revocation notice) + optional spot-node pinning; explicit
+    template values always win."""
+
+    def _spot_role(self, **spot_over):
+        from fusioninfer_tpu.api.types import SpotSpec
+
+        role = make_role()
+        role.spot = SpotSpec(**spot_over)
+        return role
+
+    def test_toleration_and_grace_rendered(self):
+        lws = build_lws(self._spot_role(), CFG)
+        spec = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]
+        assert spec["terminationGracePeriodSeconds"] == 30
+        assert {"key": "cloud.google.com/gke-spot", "operator": "Exists",
+                "effect": "NoSchedule"} in spec["tolerations"]
+        assert "nodeSelector" not in spec  # pinning is opt-in
+
+    def test_spot_node_pinning_opt_in(self):
+        lws = build_lws(self._spot_role(require_spot_nodes=True,
+                                        toleration_key="custom/spot",
+                                        termination_grace_period_s=45),
+                        CFG)
+        spec = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]
+        assert spec["terminationGracePeriodSeconds"] == 45
+        assert spec["nodeSelector"]["custom/spot"] == "true"
+        assert spec["tolerations"][0]["key"] == "custom/spot"
+
+    def test_template_values_win(self):
+        role = self._spot_role()
+        role.template["spec"]["terminationGracePeriodSeconds"] = 120
+        role.template["spec"]["tolerations"] = [
+            {"key": "cloud.google.com/gke-spot", "operator": "Equal",
+             "value": "true", "effect": "NoSchedule"}]
+        lws = build_lws(role, CFG)
+        spec = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]
+        assert spec["terminationGracePeriodSeconds"] == 120
+        assert len(spec["tolerations"]) == 1  # no duplicate appended
+        assert spec["tolerations"][0]["operator"] == "Equal"
+
+    def test_disabled_stanza_is_inert(self):
+        lws = build_lws(self._spot_role(enabled=False), CFG)
+        spec = lws["spec"]["leaderWorkerTemplate"]["workerTemplate"]["spec"]
+        assert "terminationGracePeriodSeconds" not in spec
+        assert "tolerations" not in spec
+
+    def test_multi_host_both_templates_carry_spot(self):
+        role = self._spot_role()
+        role.engine = EngineKind.NATIVE
+        role.tpu = TPUSlice(type="v5e", topology="4x4")
+        lws = build_lws(role, CFG)
+        for which in ("leaderTemplate", "workerTemplate"):
+            spec = lws["spec"]["leaderWorkerTemplate"][which]["spec"]
+            assert spec["terminationGracePeriodSeconds"] == 30, which
+            assert spec["tolerations"], which

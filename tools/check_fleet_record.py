@@ -20,7 +20,14 @@ properties ARE the acceptance criteria of the fleet harness
   bound with zero lost interactive streams while batch was 429-shed,
   preempted mid-stream, parked to the host KV tier, and resumed
   bit-identically (nonzero shed/preempt/park/resume counters, per-tier
-  percentiles present).
+  percentiles present);
+* the REVOCATION phase absorbed ≥2 spot-slice revocation waves under
+  live mixed-SLO load: zero lost interactive streams, nonzero
+  evacuated/parked/resumed-on-survivor counters, parked frames
+  actually exported to AND imported by a survivor, at least one
+  replacement scale-up applied ahead of the metrics loop, and
+  interactive TTFT p90 bounded through the waves
+  (docs/design/spot-revocation.md).
 
 Usage: ``python tools/check_fleet_record.py [FLEET_OUT.json]``.
 """
@@ -31,10 +38,10 @@ import json
 import pathlib
 import sys
 
-REQUIRED_PHASES = ("steady", "scale_up", "overload", "faults", "recover",
-                   "drain")
+REQUIRED_PHASES = ("steady", "scale_up", "overload", "revocation",
+                   "faults", "recover", "drain")
 REQUIRED_FAULTS = ("metrics_partition", "kv_transfer_corrupt",
-                   "slice_loss")
+                   "slice_loss", "revocation")
 # overload ledger counters that must be NONZERO: the phase proves
 # nothing unless batch streams were actually shed (429), preempted
 # mid-stream, parked to the host tier, and resumed.  The harness sizes
@@ -119,8 +126,71 @@ def check_record(record: dict) -> list[str]:
             "repeat-prefix traffic kept chasing the draining victim "
             f"({slo.get('drain_victim')!r})")
     problems += check_overload(record)
+    problems += check_revocation(record)
     if not record.get("event_ledger"):
         problems.append("event_ledger missing (determinism evidence)")
+    return problems
+
+
+# revocation counters that must be NONZERO: the phase proves nothing
+# unless streams were actually evacuated mid-flight, their KV parked,
+# the parked frames exported to (and imported by) a survivor, and the
+# broken streams completed on a different endpoint.  The per-wave
+# pinned live stream guarantees these by construction — a wave with all
+# zeros means the evacuation path silently stopped running.
+# ``resumed_on_survivor`` counts completion-on-another-endpoint, which
+# covers BOTH the parked-prefix restore path and the sanctioned
+# recompute-on-survivor degrade (streams that couldn't park) — the
+# restore path specifically is pinned by imported_frames here plus the
+# bit-identity suite (tests/test_evacuation.py) and the record-wide
+# corrupted_streams gate.
+REVOCATION_NONZERO = ("evacuated_streams", "parked_streams",
+                      "parked_pages", "exported_frames",
+                      "imported_frames", "resumed_on_survivor")
+
+
+def check_revocation(record: dict) -> list[str]:
+    """Gate the revocation phase: ≥2 waves, graceful evacuation with
+    zero lost interactive streams, survivor resume observed, and
+    proactive replacement applied at least once."""
+    problems: list[str] = []
+    slo = record.get("slo") or {}
+    rv = slo.get("revocation")
+    if not isinstance(rv, dict):
+        return ["slo.revocation block missing (the revocation phase "
+                "never ran or recorded nothing)"]
+    if (rv.get("n_waves") or 0) < 2:
+        problems.append(
+            f"revocation: need >= 2 waves, got {rv.get('n_waves')!r}")
+    if rv.get("lost_interactive") != 0:
+        problems.append(
+            "revocation: interactive streams were lost "
+            f"({rv.get('lost_interactive')!r} != 0)")
+    if not rv.get("interactive_ttft_bounded"):
+        problems.append(
+            "revocation: interactive TTFT p90 exceeded its bound "
+            f"(p90={rv.get('interactive_ttft_p90_ms')!r} ms, "
+            f"bound={rv.get('ttft_p90_bound_ms')!r} ms)")
+    for key in REVOCATION_NONZERO:
+        if not rv.get(key):
+            problems.append(
+                f"revocation: {key} is zero/missing — the evacuation "
+                "path it gates never ran")
+    if not rv.get("replacement_scale_ups"):
+        problems.append(
+            "revocation: no replacement scale-up was applied (the "
+            "autoscaler's revocation subscription never fired)")
+    for f in record.get("fault_ledger") or []:
+        if f.get("fault") == "revocation" and not f.get("stream_recovered"):
+            problems.append(
+                f"revocation wave {f.get('wave')!r}: the evacuated "
+                "live stream never completed on a survivor")
+    phases = record.get("phases") or {}
+    strata = (phases.get("revocation") or {}).get("strata") or {}
+    for tier in ("interactive", "batch"):
+        if not ((strata.get(tier) or {}).get("ttft_ms") or {}).get("p50"):
+            problems.append(
+                f"revocation: per-tier percentiles missing for {tier!r}")
     return problems
 
 
@@ -179,7 +249,9 @@ def main(argv: list[str]) -> int:
           "fleet evidence (scale-up + drain scale-down, zero "
           "lost/corrupted streams under faults, bounded scale-up TTFT, "
           "residency recovery, overload: bounded interactive TTFT with "
-          "batch shed/preempted/parked/resumed)")
+          "batch shed/preempted/parked/resumed, revocation: >=2 waves "
+          "evacuated/parked/exported with survivor resume and "
+          "replacement scale-up)")
     return 0
 
 

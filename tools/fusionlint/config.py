@@ -54,6 +54,11 @@ WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     # pure function of queue state (and replay identically in tests):
     # deadlines are stamped on the ENGINE's injectable clock, never here
     "fusioninfer_tpu/engine/slo.py": ("time", "sleep", "monotonic"),
+    # evacuation planning (victim order, notice-budget math) must be a
+    # pure function of scheduler state under the engine's injected
+    # clock — the revocation chaos suite replays park schedules
+    # deterministically (docs/design/spot-revocation.md)
+    "fusioninfer_tpu/engine/evacuate.py": ("time", "sleep", "monotonic"),
 }
 
 # -- lock-discipline pass ----------------------------------------------
@@ -180,6 +185,9 @@ HOST_SYNC_MODULES: dict[str, tuple[str, ...]] = {
     # the tier table is pure queue-state bookkeeping: no device values
     # exist here, so no fetch point is sanctioned
     "fusioninfer_tpu/engine/slo.py": (),
+    # evacuation planning is equally pure — the park path's device
+    # work lives in engine.py (_park_preempted → the tier's _store)
+    "fusioninfer_tpu/engine/evacuate.py": (),
     "fusioninfer_tpu/ops/paged_attention.py": (),
     "fusioninfer_tpu/ops/dispatch.py": (),
     "fusioninfer_tpu/ops/sharded.py": (),
