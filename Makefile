@@ -81,7 +81,7 @@ bench: ## One-line JSON decode-throughput benchmark (real chip if present).
 	$(PYTHON) tools/check_bench_record.py BENCH_OUT.json
 
 .PHONY: bench-smoke
-bench-smoke: ## CPU bench smoke + assert ceiling_fraction/scheduler fields land in the record.
+bench-smoke: ## CPU bench smoke + record gates: ceiling_fraction/scheduler fields, tp=2 sharedprefix leg, AOT warm start (warm >= 3x cold, cache hits).
 	BENCH_PLATFORM=cpu $(PYTHON) bench.py
 	$(PYTHON) tools/check_bench_record.py BENCH_OUT.json
 

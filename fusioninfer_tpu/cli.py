@@ -109,6 +109,12 @@ def _cmd_engine_serve(args: argparse.Namespace) -> int:
     return serve_from_args(args)
 
 
+def _cmd_engine_warmup(args: argparse.Namespace) -> int:
+    from fusioninfer_tpu.engine.server import warmup_from_args
+
+    return warmup_from_args(args)
+
+
 def _cmd_loader_convert(args: argparse.Namespace) -> int:
     from fusioninfer_tpu.models.loader import load_hf_checkpoint, save_checkpoint
 
@@ -140,6 +146,105 @@ def _cmd_loader_fetch(args: argparse.Namespace) -> int:
         save_checkpoint(native, cfg, params)
         print(f"converted -> {native}")
     return 0
+
+
+def _add_engine_config_flags(p: argparse.ArgumentParser) -> None:
+    """Engine/model configuration flags shared by ``engine serve`` and
+    ``engine warmup`` — both must build the SAME engine (the AOT cache
+    fingerprint covers model + mesh + engine knobs, so a warmup built
+    with different flags would never be a hit for the serving pod)."""
+    p.add_argument("model", nargs="?", default="qwen3-tiny",
+                   help="model name or preset")
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--max-model-len", type=int, default=4096)
+    p.add_argument("--page-size", type=int, default=128)
+    p.add_argument("--hbm-utilization", type=float, default=0.85)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--quantization", choices=("none", "int8"), default="none",
+                   help="weight-only int8: the 8B-on-one-chip fit "
+                        "(single-device; tp shards bf16)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kv-host-tier-mb", type=int, default=0,
+                   help="host-DRAM KV tier capacity in MiB (0 = off): "
+                        "evicted prefix-cache pages offload to a "
+                        "CRC-checked host slab pool and restore on "
+                        "later hits instead of recomputing "
+                        "(docs/design/kv-hierarchy.md); requires "
+                        "prefix caching, single-process only")
+    p.add_argument("--no-prefix-caching", action="store_true",
+                   help="disable automatic prefix caching (KV page reuse)")
+    p.add_argument("--prefill-chunk-size", type=int, default=0,
+                   help="chunked prefill: prompts longer than this many "
+                        "tokens prefill in bounded chunks interleaved "
+                        "with decode steps (0 = monolithic prefill). "
+                        "Compat alias: when set it also seeds the "
+                        "per-step token budget (--tokens-per-step)")
+    p.add_argument("--tokens-per-step", type=int, default=0,
+                   help="token-budgeted scheduling: each engine step "
+                        "processes at most this many tokens — the "
+                        "running batch's decode tokens first, the "
+                        "remainder as adaptively-sized prefill chunks "
+                        "that shrink under decode load instead of "
+                        "stalling streams (docs/design/scheduler.md). "
+                        "0 = derive from a measured prefill forward at "
+                        "startup (multi-host slices fall back to 512)")
+    p.add_argument("--no-token-budget", action="store_true",
+                   help="skip the startup-derived token budget "
+                        "(monolithic prefill). An explicit "
+                        "--prefill-chunk-size still seeds a budget of "
+                        "chunk tokens/step — chunked prefill is "
+                        "budget-scheduled in this engine; there is no "
+                        "fixed-chunk legacy mode")
+    p.add_argument("--speculative-ngram", type=int, default=0,
+                   help="speculative decoding: propose up to K draft "
+                        "tokens per greedy request by n-gram prompt "
+                        "lookup, verified in one forward (0 = off)")
+    p.add_argument("--decode-burst", type=int, default=8,
+                   help="multi-step decode: fuse up to N decode+sample "
+                        "steps into one device call with on-device "
+                        "token feedback — one host round trip per N "
+                        "tokens (0 or 1 = classic per-token stepping). "
+                        "Fallback is per-request: a request needing "
+                        "per-token host work (logprobs, logit_bias, "
+                        "guided decoding) single-steps while the rest "
+                        "of the batch keeps bursting")
+    p.add_argument("--no-decode-pipeline", action="store_true",
+                   help="disable double-buffered burst pipelining "
+                        "(dispatching the next burst before the "
+                        "current one's fetch, hiding the host-device "
+                        "round trip in steady state)")
+    p.add_argument("--fused-step", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="fuse each step's decode rows and budgeted "
+                        "prefill-chunk rows into ONE forward so the "
+                        "weights stream from HBM once per step "
+                        "(--no-fused-step restores the split "
+                        "prefill-then-decode dispatch).  Burst engines "
+                        "(--decode-burst > 1) keep the split "
+                        "dispatch-ahead path either way")
+    p.add_argument("--dtype", default="",
+                   help="override the model compute dtype (e.g. float32 "
+                        "for exact cross-sharding equivalence checks)")
+    p.add_argument("--kv-cache-dtype", choices=("auto", "int8"),
+                   default="auto",
+                   help="int8: quantized KV pages — half the decode "
+                        "attention HBM traffic, ~2x the page pool "
+                        "(single-device; PD roles need bf16 pages)")
+    p.add_argument("--lora", action="append", default=[],
+                   metavar="NAME=PATH",
+                   help="load a LoRA adapter (.npz, models.lora format); "
+                        "repeatable; requests select it via model=NAME")
+    p.add_argument("--load-hf", default="",
+                   help="HF checkpoint dir (safetensors)")
+    p.add_argument("--load-checkpoint", default="",
+                   help="native orbax checkpoint dir")
+    p.add_argument("--aot-cache", default="",
+                   help="AOT warm-start cache directory (default: the "
+                        "FUSIONINFER_AOT_CACHE env knob, then "
+                        "/tmp/fusioninfer-xla-cache) — persisted "
+                        "compiled executables keyed on (model config, "
+                        "mesh + axis rules, jit-registry signature); "
+                        "docs/design/parallelism.md")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -187,88 +292,24 @@ def build_parser() -> argparse.ArgumentParser:
     engine = sub.add_parser("engine", help="in-repo TPU inference engine")
     esub = engine.add_subparsers(dest="subcommand", required=True)
     serve = esub.add_parser("serve", help="serve an OpenAI-compatible API")
-    serve.add_argument("model", nargs="?", default="qwen3-tiny", help="model name or preset")
+    _add_engine_config_flags(serve)
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8000)
-    serve.add_argument("--max-batch-size", type=int, default=8)
-    serve.add_argument("--max-model-len", type=int, default=4096)
-    serve.add_argument("--page-size", type=int, default=128)
-    serve.add_argument("--hbm-utilization", type=float, default=0.85)
-    serve.add_argument("--tensor-parallel-size", type=int, default=1)
-    serve.add_argument("--quantization", choices=("none", "int8"), default="none",
-                       help="weight-only int8: the 8B-on-one-chip fit "
-                            "(single-device; tp shards bf16)")
-    serve.add_argument("--seed", type=int, default=0)
     serve.add_argument(
         "--prefill-upstream", default="",
         help="PD decode role: pull prefills (KV over DCN) from this prefiller URL",
     )
-    serve.add_argument("--kv-host-tier-mb", type=int, default=0,
-                       help="host-DRAM KV tier capacity in MiB (0 = off): "
-                            "evicted prefix-cache pages offload to a "
-                            "CRC-checked host slab pool and restore on "
-                            "later hits instead of recomputing "
-                            "(docs/design/kv-hierarchy.md); requires "
-                            "prefix caching, single-process only")
-    serve.add_argument("--no-prefix-caching", action="store_true",
-                       help="disable automatic prefix caching (KV page reuse)")
-    serve.add_argument("--prefill-chunk-size", type=int, default=0,
-                       help="chunked prefill: prompts longer than this many "
-                            "tokens prefill in bounded chunks interleaved "
-                            "with decode steps (0 = monolithic prefill). "
-                            "Compat alias: when set it also seeds the "
-                            "per-step token budget (--tokens-per-step)")
-    serve.add_argument("--tokens-per-step", type=int, default=0,
-                       help="token-budgeted scheduling: each engine step "
-                            "processes at most this many tokens — the "
-                            "running batch's decode tokens first, the "
-                            "remainder as adaptively-sized prefill chunks "
-                            "that shrink under decode load instead of "
-                            "stalling streams (docs/design/scheduler.md). "
-                            "0 = derive from a measured prefill forward at "
-                            "startup (multi-host slices fall back to 512)")
-    serve.add_argument("--no-token-budget", action="store_true",
-                       help="skip the startup-derived token budget "
-                            "(monolithic prefill). An explicit "
-                            "--prefill-chunk-size still seeds a budget of "
-                            "chunk tokens/step — chunked prefill is "
-                            "budget-scheduled in this engine; there is no "
-                            "fixed-chunk legacy mode")
-    serve.add_argument("--speculative-ngram", type=int, default=0,
-                       help="speculative decoding: propose up to K draft "
-                            "tokens per greedy request by n-gram prompt "
-                            "lookup, verified in one forward (0 = off)")
-    serve.add_argument("--decode-burst", type=int, default=8,
-                       help="multi-step decode: fuse up to N decode+sample "
-                            "steps into one device call with on-device "
-                            "token feedback — one host round trip per N "
-                            "tokens (0 or 1 = classic per-token stepping). "
-                            "Fallback is per-request: a request needing "
-                            "per-token host work (logprobs, logit_bias, "
-                            "guided decoding) single-steps while the rest "
-                            "of the batch keeps bursting")
-    serve.add_argument("--no-decode-pipeline", action="store_true",
-                       help="disable double-buffered burst pipelining "
-                            "(dispatching the next burst before the "
-                            "current one's fetch, hiding the host-device "
-                            "round trip in steady state)")
-    serve.add_argument("--fused-step", action=argparse.BooleanOptionalAction,
+    serve.add_argument("--aot-warmup", action=argparse.BooleanOptionalAction,
                        default=True,
-                       help="fuse each step's decode rows and budgeted "
-                            "prefill-chunk rows into ONE forward so the "
-                            "weights stream from HBM once per step "
-                            "(--no-fused-step restores the split "
-                            "prefill-then-decode dispatch).  Burst engines "
-                            "(--decode-burst > 1) keep the split "
-                            "dispatch-ahead path either way")
-    serve.add_argument("--dtype", default="",
-                       help="override the model compute dtype (e.g. float32 "
-                            "for exact cross-sharding equivalence checks)")
-    serve.add_argument("--kv-cache-dtype", choices=("auto", "int8"),
-                       default="auto",
-                       help="int8: quantized KV pages — half the decode "
-                            "attention HBM traffic, ~2x the page pool "
-                            "(single-device; PD roles need bf16 pages)")
+                       help="AOT-build (or load) the compiled-executable "
+                            "cache for every serving entry point BEFORE "
+                            "admission opens, so a warm pod's first "
+                            "request never waits on XLA (--no-aot-warmup "
+                            "restores lazy first-request compiles).  "
+                            "Single-process only: multi-host slices skip "
+                            "the build — their first boot compiles "
+                            "lazily and populates the persistent cache, "
+                            "restarts reload from it")
     serve.add_argument("--slo-tiers", default="",
                        help="SLO tiers as JSON (the spec.sloTiers object "
                             "or its bare tiers list): requests may then "
@@ -290,13 +331,17 @@ def build_parser() -> argparse.ArgumentParser:
                             "reachable peer wins)")
     serve.add_argument("--enable-profiling", action="store_true",
                        help="expose /debug/profile (writes to FUSIONINFER_PROFILE_DIR)")
-    serve.add_argument("--lora", action="append", default=[],
-                       metavar="NAME=PATH",
-                       help="load a LoRA adapter (.npz, models.lora format); "
-                            "repeatable; requests select it via model=NAME")
-    serve.add_argument("--load-hf", default="", help="HF checkpoint dir (safetensors)")
-    serve.add_argument("--load-checkpoint", default="", help="native orbax checkpoint dir")
     serve.set_defaults(func=_cmd_engine_serve)
+
+    warmup = esub.add_parser(
+        "warmup",
+        help="AOT-build the warm-start compile cache for a config, then "
+             "exit (docs/design/parallelism.md): run from an init "
+             "container or node-warming job so every pod with the same "
+             "(model, mesh, axis-rules, jit-registry) fingerprint boots "
+             "warm and serves its first token in seconds")
+    _add_engine_config_flags(warmup)
+    warmup.set_defaults(func=_cmd_engine_warmup)
 
     loader = sub.add_parser("loader", help="model weight loading / conversion")
     lsub = loader.add_subparsers(dest="subcommand", required=True)

@@ -55,6 +55,8 @@ from fusioninfer_tpu.engine.kv_cache import (
 )
 from fusioninfer_tpu.engine.fused import pack_ragged_batch, pow2_rows
 from fusioninfer_tpu.engine.model_runner import (
+    CTL_F_COLS,
+    CTL_I_COLS,
     decode_burst,
     fused_step,
     pick_bucket,
@@ -602,6 +604,9 @@ class NativeEngine:
         # fused mixed-batch stepping (decode + prefill chunks in one
         # weight pass); burst engines keep the split dispatch-ahead path
         self.fused_step_enabled = fused_step
+        # AOT warm-start report (engine/aot.py::warmup stamps it; the
+        # server renders it as fusioninfer:aot_cache_* metrics)
+        self.aot_stats: dict = {}
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
         # guided decoding (response_format json_object/json_schema):
@@ -730,6 +735,124 @@ class NativeEngine:
                                      floor=floor, cap=cap)
         self.set_token_budget(budget)
         return budget
+
+    def aot_signatures(self):
+        """The engine's serving entry points at ITS exact compile
+        discipline, as ``(name, lower-and-compile thunk)`` pairs —
+        what :func:`fusioninfer_tpu.engine.aot.warmup` AOT-builds
+        before admission opens.
+
+        The shape set mirrors the dispatch paths, not a guess: batched
+        fresh prefill mints (bucket × pow2-group-rows) signatures;
+        every other forward — decode on burst-1 engines, chunk
+        advances, cache-hit suffixes, the fused mixed-batch step —
+        rides the ONE ragged ``fused_step``, whose live signatures are
+        the pow2 flat-token buckets × the three selector shapes the
+        engine actually packs (R is pinned per engine): mixed
+        (``sel [B, W]`` + ``chunk_sel [NC]``, the fused step),
+        decode-only (``chunk_rows=0`` — the split decode), and
+        chunk-only (``window [0, 1]`` — batched suffix / chunk
+        advances); burst engines add ``decode_burst`` at the two spans
+        the scheduler uses ({1, k}) per sampling mode; the first-token
+        sampler chain completes the admission path.  Lowering uses the
+        engine's REAL param/cache trees so in-sharding inference
+        matches live dispatch exactly; nothing executes and nothing is
+        donated (AOT lower/compile only)."""
+        cfg, cc = self.cfg, self.cache_cfg
+        mp = cc.max_pages_per_seq
+        mesh = self._kernel_mesh
+        coalesce = ops_dispatch.decode_coalesce()
+        lora = self.lora_set.stacked if self.lora_set is not None else None
+        B = self.max_batch_size
+        V = cfg.vocab_size
+        W = 1 + (self.spec_k or 0)
+        i32 = jnp.int32
+
+        def ids(n):
+            return jnp.zeros((n,), i32) if lora is not None else None
+
+        sigs = []
+        groups = sorted({pow2_rows(n) for n in range(1, B + 1)})
+        for bucket in self.buckets:
+            for R in groups:
+                def lower_prefill(bucket=bucket, R=R):
+                    return prefill.lower(
+                        cfg, cc, self.params, self.cache,
+                        jnp.zeros((R, bucket), i32), jnp.zeros((R,), i32),
+                        jnp.full((R, mp), cc.trash_page, i32),
+                        mesh=mesh, lora=lora, adapter_ids=ids(R))
+                sigs.append((f"prefill/b{bucket}r{R}", lower_prefill))
+
+        # the one ragged forward, at its LIVE selector shapes: the flat
+        # token axis is pow2-bucketed from the 16-token floor, and each
+        # dispatch path packs a distinct (sel, chunk_sel) shape —
+        # decode-only steps at chunk_rows=0, chunk-only (batched
+        # suffix / chunk advance) at window [0, 1], the fused mixed
+        # step at [B, W] + [NC] (pack_ragged_batch call sites)
+        R, NC = self._ragged_rows, self._ragged_chunk_rows
+        t_max = pow2_rows(max(16, (self.token_budget or 64) + B * W))
+
+        def pow2_range(hi):
+            t, out = 16, []
+            while t <= hi:
+                out.append(t)
+                t *= 2
+            return out
+
+        def lower_fused(T, sel_rows, sel_w, nc):
+            return fused_step.lower(
+                cfg, cc, self.params, self.cache,
+                jnp.zeros((T,), i32), jnp.zeros((R,), i32),
+                jnp.zeros((R,), i32), jnp.zeros((R,), i32),
+                jnp.full((R, mp), cc.trash_page, i32),
+                jnp.zeros((sel_rows, sel_w), i32), jnp.zeros((nc,), i32),
+                mesh=mesh, lora=lora, adapter_ids=ids(R),
+                coalesce=coalesce)
+
+        for T in pow2_range(pow2_rows(max(16, B * W))):
+            sigs.append((f"fused/decode-t{T}",
+                         partial(lower_fused, T, B, W, 0)))
+        for T in pow2_range(t_max):
+            sigs.append((f"fused/chunk-t{T}",
+                         partial(lower_fused, T, 0, 1, NC)))
+            if self.fused_step_enabled and self.burst_steps == 1:
+                sigs.append((f"fused/mixed-t{T}",
+                             partial(lower_fused, T, B, W, NC)))
+
+        if self.burst_steps > 1:
+            for span in sorted({1, self.burst_steps}):
+                for mode in ("plain", "greedy"):
+                    def lower_burst(span=span, mode=mode):
+                        return decode_burst.lower(
+                            cfg, cc, self.params, self.cache,
+                            # CTL_*_COLS are frozen layout constants
+                            # (model_runner), not data-dependent extents
+                            jnp.zeros((B, len(CTL_I_COLS)), i32),  # noqa:trace-dynamic-dim — fixed control-array layout
+                            jnp.zeros((B, len(CTL_F_COLS)), jnp.float32),  # noqa:trace-dynamic-dim — fixed control-array layout
+                            self._token_counts, self._output_counts,
+                            self._suppress,
+                            jnp.full((B, mp), cc.trash_page, i32),
+                            n_steps=span, sample_mode=mode, mesh=mesh,
+                            lora=lora, coalesce=coalesce)
+                    sigs.append((f"burst/s{span}-{mode}", lower_burst))
+
+        # the first-token sampling chain (admission's host-side tail)
+        logits1 = jnp.zeros((1, V), jnp.float32)
+        row1 = jnp.zeros((1,), jnp.float32)
+        for mode in ("greedy", "plain", "filtered"):
+            def lower_sample(mode=mode):
+                return sample.lower(
+                    logits1, make_row_keys(jnp.zeros((1,), jnp.uint32),
+                                           jnp.zeros((1,), i32)),
+                    row1, jnp.zeros((1,), i32), row1, row1, mode=mode)
+            sigs.append((f"sample/{mode}", lower_sample))
+
+        def lower_penalties():
+            return apply_penalties.lower(
+                logits1, jnp.zeros((1, V), i32), jnp.zeros((1, V), i32),
+                row1, row1, row1)
+        sigs.append(("penalties/b1", lower_penalties))
+        return sigs
 
     def _validate_guided(self, request: Request) -> None:
         """Admission-time guided checks shared by every entry path

@@ -110,6 +110,11 @@ class EngineMetrics:
         # local re-prefill, and watchdog deadline/stall aborts
         self.kv_transfer_fallbacks = 0
         self.watchdog_aborts = 0
+        # AOT warm start: time from process/engine boot to the FIRST
+        # token this server ever streamed (None until it happens; the
+        # server stamps it once when boot_t0 was provided) — the scale-up
+        # latency the warm-start cache exists to shrink
+        self.cold_start_ttft_s: float | None = None
         # per-SLO-tier families, keyed by tier name.  register_tiers
         # pre-seeds every dict at server construction so the /metrics
         # exposition (HTTP thread) never iterates a dict a handler
@@ -194,7 +199,34 @@ class EngineMetrics:
         lines += self._render_kv_tiers(engine, labels)
         lines += self._render_evacuation(engine, labels)
         lines += self._render_scheduler(engine, labels)
+        lines += self._render_aot(engine, labels)
         return "\n".join(lines) + "\n"
+
+    def _render_aot(self, engine, labels: str) -> list[str]:
+        """AOT warm-start families (docs/design/parallelism.md): the
+        warmup's cache accounting plus the boot→first-token gauge.
+        Engines that never ran a warmup simply omit the families."""
+        stats = getattr(engine, "aot_stats", None) or {}
+        lines: list[str] = []
+        if stats:
+            lines += [
+                "# HELP fusioninfer:aot_cache_hits Warmup entry points whose compiled executable was persisted by a prior same-fingerprint build.",
+                "# TYPE fusioninfer:aot_cache_hits gauge",
+                f"fusioninfer:aot_cache_hits{{{labels}}} {stats.get('hits', 0)}",
+                "# HELP fusioninfer:aot_cache_misses Warmup entry points compiled fresh (no persisted twin).",
+                "# TYPE fusioninfer:aot_cache_misses gauge",
+                f"fusioninfer:aot_cache_misses{{{labels}}} {stats.get('misses', 0)}",
+                "# HELP fusioninfer:aot_cache_build_seconds Wall time the pre-admission warmup spent lowering + compiling (small when warm).",
+                "# TYPE fusioninfer:aot_cache_build_seconds gauge",
+                f"fusioninfer:aot_cache_build_seconds{{{labels}}} {stats.get('build_seconds', 0.0)}",
+            ]
+        if self.cold_start_ttft_s is not None:
+            lines += [
+                "# HELP fusioninfer:cold_start_to_first_token_s Seconds from engine boot to the first token this server ever streamed.",
+                "# TYPE fusioninfer:cold_start_to_first_token_s gauge",
+                f"fusioninfer:cold_start_to_first_token_s{{{labels}}} {self.cold_start_ttft_s:.3f}",
+            ]
+        return lines
 
     def _render_slo_tiers(self, labels: str) -> list[str]:
         """Per-SLO-tier families (docs/design/scheduler.md "Overload

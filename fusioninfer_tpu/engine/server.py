@@ -198,6 +198,7 @@ class EngineServer:
         slo_tiers=None,
         evacuate_grace_s: float | None = None,
         evacuate_peers=None,
+        boot_t0: float | None = None,
     ):
         """``prefill_upstream``: PD-disaggregated decode mode — completions
         pull their prefill (KV slab + first token) from the prefiller
@@ -228,6 +229,13 @@ class EngineServer:
         admission-queue bound (past it the server sheds with 429 +
         Retry-After), and a per-step token-budget share enforced by
         the engine's tier ledger (docs/design/scheduler.md).
+
+        ``boot_t0``: ``time.monotonic()`` stamp from the moment the
+        process began booting this engine (before model init and the
+        AOT warmup).  When provided, the server records
+        ``fusioninfer:cold_start_to_first_token_s`` — boot to the FIRST
+        token it ever streams — the scale-up latency the AOT warm-start
+        cache exists to shrink (docs/design/parallelism.md).
 
         ``evacuate_grace_s``: treat SIGTERM as a spot revocation notice
         of this many seconds — :meth:`evacuate` instead of
@@ -279,6 +287,7 @@ class EngineServer:
                 if shares and hasattr(engine, "set_slo_tiers"):
                     engine.set_slo_tiers(shares)
         self.host, self.port = host, port
+        self.boot_t0 = boot_t0
         self._channels: dict[str, _RequestChannel] = {}
         self._req_meta: dict[str, dict] = {}
         self._lock = threading.Lock()
@@ -389,6 +398,12 @@ class EngineServer:
                     tname = meta.get("tier")
                     if out.is_first_token:
                         self.metrics.ttft.observe(now - meta["arrival"])
+                        if (self.boot_t0 is not None
+                                and self.metrics.cold_start_ttft_s is None):
+                            # the server's FIRST first-token: boot →
+                            # serving, the AOT warm-start gauge
+                            self.metrics.cold_start_ttft_s = (
+                                now - self.boot_t0)
                         if tname is not None:
                             self.metrics.tier_ttft[tname].observe(
                                 now - meta["arrival"])
@@ -2244,7 +2259,63 @@ def _nonneg_flag(args, name: str):
 
 def serve_from_args(args) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    boot_t0 = time.monotonic()
+    # persistent-executable cache: MUST be configured before the first
+    # compile of the process (jax latches the cache decision there), so
+    # this precedes model init — engine/aot.py owns the resolution
+    from fusioninfer_tpu.engine import aot
+
+    aot_warm = getattr(args, "aot_warmup", True)
+    aot_cache = getattr(args, "aot_cache", "") or None
+    if aot_warm:
+        # 0.0: every warmup build persists (this process owns the knob)
+        aot.configure_cache(aot_cache, min_compile_seconds=0.0)
     maybe_init_distributed()
+    import jax
+
+    engine, model_name = _engine_from_args(args)
+    slo_tiers = None
+    slo_tiers_raw = getattr(args, "slo_tiers", "") or ""
+    if slo_tiers_raw:
+        # JSON, either the spec.sloTiers object or the bare tier list
+        slo_tiers = json.loads(slo_tiers_raw)
+    if aot_warm:
+        if jax.process_count() > 1:
+            # the AOT build is single-process for now: every process of
+            # a multi-host slice skips it (a per-process build would
+            # skew the SPMD boot barrier, and `engine warmup` refuses
+            # multi-host).  First boot therefore compiles lazily and
+            # POPULATES the persistent cache; later restarts of the
+            # same slice on the same machines reload from it.
+            logger.info("AOT warmup skipped on multi-host: first boot "
+                        "compiles lazily and populates the persistent "
+                        "cache; restarts reload from it")
+        else:
+            # build (or load) the compiled-executable cache BEFORE
+            # admission opens: a warm pod's first request never waits
+            # on XLA (docs/design/parallelism.md)
+            aot.warmup(engine, cache_dir=aot_cache)
+    server = EngineServer(
+        model=model_name,
+        host=args.host,
+        port=args.port,
+        engine=engine,
+        prefill_upstream=getattr(args, "prefill_upstream", None) or None,
+        slo_tiers=slo_tiers,
+        evacuate_grace_s=_nonneg_flag(args, "evacuate_grace_s"),
+        evacuate_peers=getattr(args, "evacuate_peer", None) or [],
+        boot_t0=boot_t0,
+    )
+    if getattr(args, "enable_profiling", False):
+        server.enable_profiling = True
+    server.serve_forever()
+    return 0
+
+
+def _engine_from_args(args) -> tuple[NativeEngine, str]:
+    """Build the engine exactly as ``engine serve`` would (checkpoint
+    loading, mesh, cache sizing, token-budget calibration) — shared by
+    the serve path and ``engine warmup``."""
     import jax
 
     from fusioninfer_tpu.engine.kv_cache import auto_cache_config
@@ -2399,22 +2470,28 @@ def serve_from_args(args) -> int:
             budget = engine.calibrate_token_budget()
             logger.info("token budget derived from measured step latency: "
                         "%d tokens/step", budget)
-    slo_tiers = None
-    slo_tiers_raw = getattr(args, "slo_tiers", "") or ""
-    if slo_tiers_raw:
-        # JSON, either the spec.sloTiers object or the bare tier list
-        slo_tiers = json.loads(slo_tiers_raw)
-    server = EngineServer(
-        model=model_name,
-        host=args.host,
-        port=args.port,
-        engine=engine,
-        prefill_upstream=getattr(args, "prefill_upstream", None) or None,
-        slo_tiers=slo_tiers,
-        evacuate_grace_s=_nonneg_flag(args, "evacuate_grace_s"),
-        evacuate_peers=getattr(args, "evacuate_peer", None) or [],
-    )
-    if getattr(args, "enable_profiling", False):
-        server.enable_profiling = True
-    server.serve_forever()
-    return 0
+    return engine, model_name
+
+
+def warmup_from_args(args) -> int:
+    """``fusioninfer-tpu engine warmup``: build (or refresh) the AOT
+    warm-start cache for this model/mesh/config and exit — the
+    pre-provisioning face of the serve-path warmup (run it from an
+    init container or a node-warming job, then every pod with the same
+    fingerprint boots warm).  Prints the warmup report as JSON."""
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    from fusioninfer_tpu.engine import aot
+
+    aot_cache = getattr(args, "aot_cache", "") or None
+    aot.configure_cache(aot_cache, min_compile_seconds=0.0)
+    maybe_init_distributed()
+    import jax
+
+    if jax.process_count() > 1:
+        raise SystemExit("engine warmup is single-process (run it on "
+                         "the leader's image before scaling)")
+    engine, _ = _engine_from_args(args)
+    report = aot.warmup(engine, cache_dir=aot_cache)
+    print(json.dumps(report, sort_keys=True))
+    return 0 if not report["errors"] else 1

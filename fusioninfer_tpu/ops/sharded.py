@@ -11,6 +11,14 @@ collective.  These wrappers express exactly that: kernel inside
 
 The serving mesh must be tp-only (dp=sp=ep=1) — the engine falls back to
 the jnp reference path otherwise.
+
+Every in/out spec here is DERIVED from the canonical logical-axis table
+(:mod:`fusioninfer_tpu.parallel.axes`): the head axes name ``heads`` /
+``kv`` (→ ``tp`` under the Megatron rules) and everything else —
+descriptor rows, page tables, flat token axes — is replicated by
+construction on the tp-only mesh this module serves, so those axes are
+spelled ``None`` / ``rows`` / ``tokens`` (all replicated).  No raw
+``PartitionSpec`` literals live here (fusionlint ``sharding-discipline``).
 """
 
 from __future__ import annotations
@@ -19,7 +27,7 @@ from functools import partial
 
 import jax
 from fusioninfer_tpu.utils.jax_compat import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from fusioninfer_tpu.ops.flash_attention import flash_attention
 from fusioninfer_tpu.ops.paged_attention import (
@@ -29,6 +37,18 @@ from fusioninfer_tpu.ops.paged_attention import (
     paged_verify_attention,
     ragged_paged_attention,
 )
+from fusioninfer_tpu.parallel import sharding as _sharding
+from fusioninfer_tpu.parallel.axes import default_rules
+
+_RULES = default_rules()
+# [(L,) KV, n_pages, ps, Hd] stacked pools / [(L,) KV, n_pages, 1, ps]
+# int8 per-token scale planes: KV heads over tp, like the cache itself
+_KV_SPEC = _sharding.kv_cache_spec(_RULES)
+_SCALE_SPEC = _sharding.kv_scale_spec(_RULES)
+# replicated descriptor shapes (each shard sees every row/token id)
+_ROW_SPEC = _RULES.spec("rows")  # [R] starts / lengths / counts
+_TABLE_SPEC = _RULES.spec("rows", "pages")  # [R, mp] page tables
+_SCALAR_SPEC = _RULES.spec()  # scalar operands
 
 
 def tp_compatible(mesh: Mesh, n_heads: int, n_kv_heads: int) -> bool:
@@ -56,24 +76,16 @@ def flash_attention_tp(
     window: int | None = None,
 ) -> jax.Array:
     """Per-shard flash attention → [B, S, H·Hd] sharded on the feature axis."""
-    head_spec = P(None, None, "tp", None)
+    head_spec = _RULES.spec(None, None, "heads", "head_dim")
     fn = shard_map(
         partial(flash_attention, causal=causal, interpret=interpret,
                 window=window),
         mesh=mesh,
         in_specs=(head_spec, head_spec, head_spec),
-        out_specs=P(None, None, "tp"),
+        out_specs=_RULES.spec(None, None, "heads"),
         check_vma=False,
     )
     return fn(q, k, v)
-
-
-# int8 KV pages carry per-(kv-head, page, token) scale arrays — stacked
-# [L, KV, n_pages, 1, ps]; the KV axis shards over tp exactly like the
-# pages, so each shard's kernel folds its own heads' scales.
-_SCALE_SPEC = P(None, "tp", None, None, None)
-
-
 
 
 def paged_decode_attention_tp(
@@ -95,12 +107,12 @@ def paged_decode_attention_tp(
     k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
         k_pages, v_pages, k_scale, v_scale, layer)
     in_specs = [
-        P(None, "tp", None),
-        P(None, "tp", None, None, None),
-        P(None, "tp", None, None, None),
-        P(None, None),
-        P(None),
-        P(None),
+        _RULES.spec("rows", "heads", "head_dim"),
+        _KV_SPEC,
+        _KV_SPEC,
+        _TABLE_SPEC,
+        _ROW_SPEC,
+        _ROW_SPEC,
     ]
     args = [q, k_pages, v_pages, page_tables, lengths, layer]
     if k_scale is not None:
@@ -117,7 +129,7 @@ def paged_decode_attention_tp(
         run,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=P(None, "tp"),
+        out_specs=_RULES.spec("rows", "heads"),
         check_vma=False,
     )
     return fn(*args)
@@ -146,14 +158,14 @@ def ragged_paged_attention_tp(
     k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
         k_pages, v_pages, k_scale, v_scale, layer)
     in_specs = [
-        P(None, "tp", None),
-        P(None, "tp", None, None, None),
-        P(None, "tp", None, None, None),
-        P(None, None),
-        P(None),
-        P(None),
-        P(None),
-        P(None),
+        _RULES.spec("tokens", "heads", "head_dim"),
+        _KV_SPEC,
+        _KV_SPEC,
+        _TABLE_SPEC,
+        _ROW_SPEC,
+        _ROW_SPEC,
+        _ROW_SPEC,
+        _ROW_SPEC,
     ]
     args = [q, k_pages, v_pages, page_tables, row_starts, q_begins,
             q_lens, layer]
@@ -171,7 +183,7 @@ def ragged_paged_attention_tp(
         run,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=P(None, "tp"),
+        out_specs=_RULES.spec("tokens", "heads"),
         check_vma=False,
     )
     return fn(*args)
@@ -196,13 +208,13 @@ def paged_prefill_attention_tp(
     k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
         k_pages, v_pages, k_scale, v_scale, layer)
     in_specs = [
-        P(None, "tp", None),
-        P(None, "tp", None, None, None),
-        P(None, "tp", None, None, None),
-        P(None),
-        P(),
-        P(),
-        P(None),
+        _RULES.spec("tokens", "heads", "head_dim"),
+        _KV_SPEC,
+        _KV_SPEC,
+        _RULES.spec("pages"),
+        _SCALAR_SPEC,
+        _SCALAR_SPEC,
+        _ROW_SPEC,
     ]
     args = [q, k_pages, v_pages, page_row, start, true_len, layer]
     if k_scale is not None:
@@ -219,7 +231,7 @@ def paged_prefill_attention_tp(
         run,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=P(None, "tp"),
+        out_specs=_RULES.spec("tokens", "heads"),
         check_vma=False,
     )
     return fn(*args)
@@ -244,13 +256,15 @@ def paged_verify_attention_tp(
     k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
         k_pages, v_pages, k_scale, v_scale, layer)
     in_specs = [
-        P(None, None, "tp", None),
-        P(None, "tp", None, None, None),
-        P(None, "tp", None, None, None),
-        P(None, None),
-        P(None),
-        P(None),
-        P(None),
+        # the C verify-window axis is replicated by construction (None),
+        # like the rows: only the head axes shard on the tp-only mesh
+        _RULES.spec("rows", None, "heads", "head_dim"),
+        _KV_SPEC,
+        _KV_SPEC,
+        _TABLE_SPEC,
+        _ROW_SPEC,
+        _ROW_SPEC,
+        _ROW_SPEC,
     ]
     args = [q, k_pages, v_pages, page_tables, starts, counts, layer]
     if k_scale is not None:
@@ -267,7 +281,7 @@ def paged_verify_attention_tp(
         run,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=P(None, None, "tp"),
+        out_specs=_RULES.spec("rows", None, "heads"),
         check_vma=False,
     )
     return fn(*args)

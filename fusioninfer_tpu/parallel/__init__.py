@@ -7,6 +7,12 @@ tp), NamedSharding rules over the weight pytree, XLA-inserted ICI
 collectives, and explicit ``ppermute`` ring attention for long context.
 """
 
+from fusioninfer_tpu.parallel.axes import (
+    LOGICAL_AXES,
+    MEGATRON_RULES,
+    AxisRules,
+    default_rules,
+)
 from fusioninfer_tpu.parallel.mesh import (
     AXES,
     MeshConfig,
@@ -25,6 +31,10 @@ from fusioninfer_tpu.parallel.step import make_forward, make_train_step
 
 __all__ = [
     "AXES",
+    "LOGICAL_AXES",
+    "MEGATRON_RULES",
+    "AxisRules",
+    "default_rules",
     "MeshConfig",
     "build_mesh",
     "infer_mesh_config",

@@ -1,0 +1,157 @@
+"""Logical-axis sharding: ONE axis vocabulary, ONE logical→mesh table.
+
+The T5X recipe (SNIPPETS.md [2]) applied to serving: every parameter and
+activation axis in the system is named ONCE from the canonical logical
+vocabulary below (``('batch', 'length', 'embed', 'heads', ...)``), and
+every ``PartitionSpec`` in the package is *derived* by mapping those
+names through an :class:`AxisRules` table — the single place that knows
+which logical axis rides which mesh axis.  Before this module each call
+site owned its own hand-wired Megatron spec (``parallel/sharding.py``,
+``ops/sharded.py``, ``parallel/ring.py``, ...); retargeting a new mesh
+shape meant auditing every one of them.  Now a topology is one rules
+table: the same :data:`MEGATRON_RULES` serves the 1-chip mesh (every
+axis size 1 ⇒ replication), a v5e-4/8 tp slice, and tp×ep / tp×sp
+composites, because a rule naming a size-1 mesh axis degenerates to
+replication — proven leaf-for-leaf against the frozen hand-written
+layout in ``tests/test_axis_rules.py``.
+
+Raw ``PartitionSpec(...)`` literals outside this module are a lint
+error (``tools/fusionlint`` ``sharding-discipline`` pass): specs are
+derived, never owned per call site.
+
+The table also feeds the AOT warm-start cache key
+(:mod:`fusioninfer_tpu.engine.aot`): :meth:`AxisRules.fingerprint`
+stamps the logical→mesh mapping into the compiled-executable key, so a
+rules change invalidates persisted executables instead of silently
+serving ones partitioned for a different layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical logical axis names.  Every array axis in the package maps to
+# one of these (or to ``None`` — replicated by construction, e.g. the
+# per-shard descriptor rows of a tp-only shard_map wrapper).
+LOGICAL_AXES = (
+    "batch",     # independent requests / sequences
+    "length",    # sequence positions
+    "embed",     # model hidden dim D
+    "heads",     # attention query heads — and the fused H*Hd feature axis
+    "head_dim",  # per-head feature Hd
+    "kv",        # KV heads (GQA groups live whole on a shard: tp | KV)
+    "mlp",       # FFN hidden width F
+    "vocab",     # vocabulary V
+    "expert",    # MoE expert axis E
+    "layers",    # stacked layer axis L
+    "pages",     # KV page-pool axis
+    "page",      # in-page slot axis
+    "rows",      # batch-like descriptor rows (page tables, lengths)
+    "tokens",    # flat ragged-concat token axis
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisRules:
+    """One logical→mesh mapping: the ONLY owner of ``PartitionSpec``s.
+
+    ``rules`` maps each logical axis name to a mesh axis name or
+    ``None`` (replicated).  Axis sizes of 1 are legal mesh axes, so a
+    single table serves every mesh shape built from the ``AXES``
+    vocabulary (:mod:`fusioninfer_tpu.parallel.mesh`): on a 1-chip mesh
+    every rule degenerates to replication; on a tp-only slice only the
+    ``tp``-mapped axes shard; a tp×ep mesh additionally shards
+    ``expert``.
+    """
+
+    name: str
+    rules: tuple[tuple[str, Optional[str]], ...]
+
+    def __post_init__(self):
+        unknown = [k for k, _ in self.rules if k not in LOGICAL_AXES]
+        if unknown:
+            raise ValueError(
+                f"axis rules {self.name!r} name unknown logical axes "
+                f"{unknown}; the vocabulary is {LOGICAL_AXES}")
+
+    def _table(self) -> dict:
+        return dict(self.rules)
+
+    def mesh_axis(self, logical: Optional[str]) -> Optional[str]:
+        """Mesh axis for one logical axis (None = replicated)."""
+        if logical is None:
+            return None
+        table = self._table()
+        if logical not in table:
+            raise KeyError(
+                f"logical axis {logical!r} has no rule in {self.name!r} "
+                f"(known: {sorted(table)})")
+        return table[logical]
+
+    def spec(self, *logical: Optional[str]) -> PartitionSpec:
+        """Derive a ``PartitionSpec``: one logical name (or None) per
+        array axis, mapped through the table.  This function — not the
+        call sites — is where ``PartitionSpec`` objects are minted."""
+        return PartitionSpec(*(self.mesh_axis(ax) for ax in logical))
+
+    def sharding(self, mesh: Mesh, *logical: Optional[str]) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(*logical))
+
+    def with_overrides(self, **overrides: Optional[str]) -> "AxisRules":
+        """A derived table with some logical axes remapped (e.g. ring
+        attention over a non-default sequence axis)."""
+        table = self._table()
+        for k, v in overrides.items():
+            if k not in LOGICAL_AXES:
+                raise KeyError(f"unknown logical axis {k!r}")
+            table[k] = v
+        return AxisRules(
+            name=f"{self.name}+{','.join(sorted(overrides))}",
+            rules=tuple(sorted(table.items())))
+
+    def fingerprint(self) -> str:
+        """Stable text form for the AOT warm-start cache key: a rules
+        change must invalidate persisted executables."""
+        body = ";".join(f"{k}->{v or '-'}" for k, v in sorted(self.rules))
+        return f"axis-rules/{self.name}({body})"
+
+
+# THE table: the current Megatron-style serving layout, expressed once.
+#
+# * ``heads``/``kv``/``mlp``/``vocab`` ride ``tp`` — column-parallel
+#   qkv/gate/up, row-parallel wo/down, vocab-parallel embedding + lm
+#   head (the psums XLA inserts from these are the only collectives).
+# * ``expert`` rides ``ep`` — MoE expert weights shard the expert axis
+#   on tp×ep meshes and replicate it (ep=1) everywhere else.
+# * ``batch`` rides ``dp``, ``length`` rides ``sp`` (ring attention,
+#   long-context prefill); both degenerate to replication on the
+#   serving meshes where dp=sp=1.
+# * ``embed`` stays unsharded so layernorms need no collectives.
+MEGATRON_RULES = AxisRules(
+    name="megatron",
+    rules=(
+        ("batch", "dp"),
+        ("length", "sp"),
+        ("embed", None),
+        ("heads", "tp"),
+        ("head_dim", None),
+        ("kv", "tp"),
+        ("mlp", "tp"),
+        ("vocab", "tp"),
+        ("expert", "ep"),
+        ("layers", None),
+        ("pages", None),
+        ("page", None),
+        ("rows", None),
+        ("tokens", None),
+    ),
+)
+
+
+def default_rules() -> AxisRules:
+    """The process-wide default table (one table serves every mesh —
+    1-chip, tp, tp×ep, tp×sp — because size-1 mesh axes replicate)."""
+    return MEGATRON_RULES

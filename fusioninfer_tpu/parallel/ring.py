@@ -20,7 +20,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
+from fusioninfer_tpu.parallel.axes import default_rules
 from fusioninfer_tpu.utils import jax_compat
 from fusioninfer_tpu.utils.jax_compat import shard_map
 
@@ -120,10 +121,14 @@ def make_ring_attention(
 
     Takes globally-shaped q [B, S, H, Hd], k/v [B, S, KV, Hd] whose S axis
     is sharded over ``axis_name`` (batch over dp); returns [B, S, H·Hd]
-    sharded the same way.
+    sharded the same way.  Specs derive from the logical-axis table with
+    the ``length`` axis remapped onto ``axis_name`` — the head axes stay
+    replicated here (each device owns EVERY head for its sequence chunk;
+    the ring rotates K/V chunks, not heads).
     """
-    qkv_spec = P("dp", axis_name, None, None)
-    out_spec = P("dp", axis_name, None)
+    rules = default_rules().with_overrides(length=axis_name)
+    qkv_spec = rules.spec("batch", "length", None, None)
+    out_spec = rules.spec("batch", "length", None)
     fn = shard_map(
         partial(ring_attention_local, axis_name=axis_name, causal=causal),
         mesh=mesh,

@@ -31,25 +31,26 @@ import jax
 
 if not _ON_TPU_TIER:
     jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if not _ON_TPU_TIER:
     # Persistent XLA compilation cache: the tier-1 suite compiles
     # hundreds of jit signatures and compile time dominates its wall
     # clock (engine-heavy suites run ~2.3x faster warm).  Identical
     # binaries come back from the cache, so bit-identity tests are
     # unaffected; subprocess tests bootstrap their own jax and are
-    # untouched.  This is the test-tier face of ROADMAP item 4's
-    # AOT/persistent-compile-cache direction.  An explicit
-    # JAX_COMPILATION_CACHE_DIR wins; the TPU tier is left alone.
-    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        _cache_dir = "/tmp/fusioninfer-xla-cache"
-        try:
-            os.makedirs(_cache_dir, exist_ok=True)
-            jax.config.update("jax_compilation_cache_dir", _cache_dir)
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.5)
-        except Exception:
-            pass  # read-only /tmp or old jax: run uncached
+    # untouched.  ONE code path and ONE keying scheme with the
+    # production AOT warm start (fusioninfer_tpu.engine.aot): the same
+    # resolution order — FUSIONINFER_AOT_CACHE, then an explicit
+    # JAX_COMPILATION_CACHE_DIR, then /tmp/fusioninfer-xla-cache — so
+    # warm test runs and warm pods exercise the same machinery.  The
+    # 0.5s min-compile threshold keeps trivial signatures out of the
+    # test-tier cache; the serve-path warmup persists everything (it
+    # builds a bounded, curated entry set).  TPU tier left alone.
+    from fusioninfer_tpu.engine.aot import configure_cache
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    configure_cache(min_compile_seconds=0.5)
 
 import pytest  # noqa: E402 — after the backend bootstrap above
 
@@ -61,7 +62,8 @@ import pytest  # noqa: E402 — after the backend bootstrap above
 # heavy suites (fused step, token budget, e2e serving) OUT: they are
 # what the full tier is for.
 FAST_MODULES = {
-    "test_api_types.py", "test_applyconfig.py", "test_evacuation.py",
+    "test_api_types.py", "test_applyconfig.py", "test_axis_rules.py",
+    "test_evacuation.py",
     "test_fusionlint.py",
     "test_hash.py", "test_informers.py", "test_kv_host_tier.py",
     "test_leader_election.py",

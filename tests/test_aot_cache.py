@@ -1,0 +1,309 @@
+"""AOT warm start: cache keying, warmup accounting, metrics surface.
+
+The warm-start contract (docs/design/parallelism.md): one env knob and
+one resolution order shared with the test tier's persistent cache, a
+fingerprint covering everything that changes the compiled executables,
+a warmup whose manifest turns a twin pod's build into a load (hits,
+~zero build seconds), and the ``fusioninfer:aot_cache_*`` /
+``cold_start_to_first_token_s`` metrics the bench and fleetsim gates
+read.  The cold-vs-warm WALL-CLOCK proof lives in the bench
+(``run_warm_start``: two subprocesses against one fresh cache dir,
+gated >= 3x by check_bench_record) — subprocess spawns are too heavy
+for tier-1."""
+
+import json
+
+import pytest
+
+from fusioninfer_tpu.engine import aot
+from fusioninfer_tpu.engine.engine import NativeEngine
+from fusioninfer_tpu.engine.kv_cache import CacheConfig
+from fusioninfer_tpu.engine.metrics import EngineMetrics
+from fusioninfer_tpu.models.config import get_preset
+
+
+def tiny_engine(**kw):
+    kw.setdefault("cache_cfg", CacheConfig(n_pages=17, page_size=32,
+                                           max_pages_per_seq=2))
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("token_budget", 32)
+    kw.setdefault("decode_burst_steps", 1)
+    kw.setdefault("fused_step", True)
+    return NativeEngine(get_preset("qwen3-tiny"), **kw)
+
+
+class TestCacheResolution:
+    def test_resolution_order(self, monkeypatch):
+        monkeypatch.setenv(aot.ENV_CACHE_DIR, "/tmp/from-env")
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/from-jax")
+        assert aot.resolve_cache_dir("/tmp/explicit") == "/tmp/explicit"
+        assert aot.resolve_cache_dir() == "/tmp/from-env"
+        monkeypatch.delenv(aot.ENV_CACHE_DIR)
+        assert aot.resolve_cache_dir() == "/tmp/from-jax"
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+        assert aot.resolve_cache_dir() == aot.DEFAULT_CACHE_DIR
+
+    def test_zero_disables(self, monkeypatch):
+        monkeypatch.setenv(aot.ENV_CACHE_DIR, "0")
+        assert aot.resolve_cache_dir() is None
+        assert aot.configure_cache() is None
+
+    def test_conftest_and_warmup_share_the_knob(self):
+        """ONE keying scheme, ONE env knob: the test tier's persistent
+        cache (tests/conftest.py) and the production warmup resolve
+        through the same function and land on the same default dir."""
+        import inspect
+
+        import tests.conftest as c
+
+        src = inspect.getsource(c)
+        assert "configure_cache" in src
+        assert aot.DEFAULT_CACHE_DIR == "/tmp/fusioninfer-xla-cache"
+
+
+class TestFingerprint:
+    def test_registry_signature_is_stable(self):
+        a, b = aot.registry_signature(), aot.registry_signature()
+        assert a == b and len(a) == 16
+
+    def test_fingerprint_covers_engine_knobs(self):
+        e1 = tiny_engine()
+        e2 = tiny_engine(max_batch_size=4)
+        assert aot.fingerprint(e1) == aot.fingerprint(e1)
+        assert aot.fingerprint(e1) != aot.fingerprint(e2)
+
+    def test_fingerprint_covers_axis_rules(self, monkeypatch):
+        """An axis-rules change must invalidate persisted executables
+        — the rules fingerprint rides the cache key."""
+        from fusioninfer_tpu.parallel import axes
+
+        e = tiny_engine()
+        before = aot.fingerprint(e)
+        monkeypatch.setattr(
+            axes, "MEGATRON_RULES",
+            axes.MEGATRON_RULES.with_overrides(heads=None))
+        monkeypatch.setattr(axes, "default_rules",
+                            lambda: axes.MEGATRON_RULES)
+        assert aot.fingerprint(e) != before
+
+
+class TestSignatures:
+    def test_signature_names_cover_the_serving_paths(self):
+        e = tiny_engine()
+        names = [n for n, _ in e.aot_signatures()]
+        assert any(n.startswith("prefill/") for n in names)
+        # the one ragged forward at its three LIVE selector shapes:
+        # split decode (chunk_rows=0), chunk-only (batched suffix /
+        # chunk advance), and — on this fused burst-1 engine — mixed
+        assert any(n.startswith("fused/decode-") for n in names)
+        assert any(n.startswith("fused/chunk-") for n in names)
+        assert any(n.startswith("fused/mixed-") for n in names)
+        assert any(n.startswith("sample/") for n in names)
+        # burst-1 engine: no burst entries
+        assert not any(n.startswith("burst/") for n in names)
+
+    def test_burst_engine_skips_mixed_fused(self):
+        # burst engines never run the fused mixed step (split
+        # dispatch-ahead path) — but chunk advances still ride the
+        # ragged forward, so the chunk-only shapes stay covered
+        e = tiny_engine(decode_burst_steps=4, fused_step=False)
+        names = [n for n, _ in e.aot_signatures()]
+        assert not any(n.startswith("fused/mixed-") for n in names)
+        assert any(n.startswith("fused/chunk-") for n in names)
+
+    def test_burst_engine_adds_burst_spans(self):
+        e = tiny_engine(decode_burst_steps=4, fused_step=False)
+        names = [n for n, _ in e.aot_signatures()]
+        assert "burst/s1-plain" in names and "burst/s4-plain" in names
+        assert "burst/s1-greedy" in names and "burst/s4-greedy" in names
+
+    def test_prefill_entries_follow_bucket_and_group_discipline(self):
+        e = tiny_engine()
+        names = {n for n, _ in e.aot_signatures()}
+        # buckets [32, 64] x pow2 groups {1, 2}
+        for bucket in (32, 64):
+            for rows in (1, 2):
+                assert f"prefill/b{bucket}r{rows}" in names
+
+
+class TestWarmup:
+    def test_cold_build_then_twin_hits(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        e = tiny_engine()
+        cold = aot.warmup(e, cache_dir=cache)
+        assert cold["misses"] == cold["entries"] > 0
+        assert cold["hits"] == 0 and cold["errors"] == []
+        assert e.aot_stats is cold
+        manifest = json.loads(
+            (tmp_path / "aot" /
+             f"aot-manifest-{cold['fingerprint'][:16]}.json").read_text())
+        assert manifest["fingerprint"] == cold["fingerprint"]
+        assert len(manifest["entries"]) == cold["entries"]
+        # a twin engine (same fingerprint) loads instead of building
+        twin = tiny_engine()
+        warm = aot.warmup(twin, cache_dir=cache)
+        assert warm["hits"] == cold["entries"] and warm["misses"] == 0
+        # the load is not a rebuild: orders of magnitude cheaper
+        assert warm["build_seconds"] < max(1.0, cold["build_seconds"] / 3)
+
+    def test_fingerprint_drift_misses(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        aot.warmup(tiny_engine(), cache_dir=cache)
+        drifted = aot.warmup(tiny_engine(max_batch_size=4),
+                             cache_dir=cache)
+        assert drifted["hits"] == 0 and drifted["misses"] > 0
+
+    def test_force_rebuilds_hits(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        aot.warmup(tiny_engine(), cache_dir=cache)
+        forced = aot.warmup(tiny_engine(), cache_dir=cache, force=True)
+        assert forced["hits"] == 0 and forced["misses"] == forced["entries"]
+
+    def test_one_bad_signature_does_not_abort(self, tmp_path):
+        def boom():
+            raise RuntimeError("lowering exploded")
+
+        e = tiny_engine()
+        report = aot.warmup(
+            e, cache_dir=str(tmp_path / "aot"),
+            signatures=[("ok/trivial", lambda: None), ("bad/boom", boom)])
+        assert report["entries"] == 1
+        assert len(report["errors"]) == 1
+        assert "bad/boom" in report["errors"][0]
+
+    def test_warmed_engine_streams_identically(self, tmp_path):
+        """Warmup must be invisible to outputs: greedy tokens from a
+        warmed engine match an unwarmed twin bit-for-bit (AOT lowering
+        executes nothing and donates nothing)."""
+        from fusioninfer_tpu.engine.engine import Request
+        from fusioninfer_tpu.engine.sampler import SamplingParams
+
+        def drain(e):
+            e.add_request(Request("r", [3, 1, 4, 1, 5],
+                                  SamplingParams(max_tokens=6,
+                                                 temperature=0.0)))
+            toks = []
+            while e.has_work():
+                toks += [o.token for o in e.step()]
+            return toks
+
+        warmed = tiny_engine()
+        aot.warmup(warmed, cache_dir=str(tmp_path / "aot"))
+        assert drain(warmed) == drain(tiny_engine())
+
+
+class TestMetricsSurface:
+    def test_aot_families_render_after_warmup(self, tmp_path):
+        e = tiny_engine()
+        aot.warmup(e, cache_dir=str(tmp_path / "aot"),
+                   signatures=[("ok/one", lambda: None)])
+        m = EngineMetrics("tiny")
+        text = m.render(e)
+        assert "fusioninfer:aot_cache_hits{" in text
+        assert "fusioninfer:aot_cache_misses{" in text
+        assert "fusioninfer:aot_cache_build_seconds{" in text
+        # no first token served yet: the cold-start gauge is absent
+        assert "cold_start_to_first_token_s" not in text
+        m.cold_start_ttft_s = 3.25
+        text = m.render(e)
+        assert ("fusioninfer:cold_start_to_first_token_s"
+                '{model_name="tiny"} 3.250') in text
+
+    def test_unwarmed_engine_omits_families(self):
+        m = EngineMetrics("tiny")
+        text = m.render(tiny_engine())
+        assert "aot_cache" not in text
+
+
+class TestServerColdStartGauge:
+    def test_first_token_stamps_the_gauge_once(self):
+        from fusioninfer_tpu.engine.server import EngineServer
+
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=tiny_engine(), boot_t0=0.0)
+        srv.start()
+        try:
+            import urllib.request
+
+            body = json.dumps({"model": "qwen3-tiny", "prompt": "hi",
+                               "max_tokens": 2}).encode()
+            for _ in range(2):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/completions", body,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=120).read()
+            first = srv.metrics.cold_start_ttft_s
+            assert first is not None and first > 0
+            # a later request must NOT move it (boot -> FIRST token)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions", body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=120).read()
+            assert srv.metrics.cold_start_ttft_s == first
+        finally:
+            srv.stop()
+
+    def test_no_boot_t0_no_gauge(self):
+        from fusioninfer_tpu.engine.server import EngineServer
+
+        srv = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
+                           engine=tiny_engine())
+        assert srv.boot_t0 is None
+
+
+class TestBenchChecker:
+    """check_bench_record's warm-start gate (tools side, no jax)."""
+
+    def _ws(self, **kw):
+        ws = {
+            "cold": {"cold_start_to_first_token_s": 15.0},
+            "warm": {"cold_start_to_first_token_s": 3.0,
+                     "aot": {"hits": 12, "misses": 0}},
+            "warm_speedup": 5.0,
+            "ceiling_fraction": 0.4,
+        }
+        ws.update(kw)
+        return ws
+
+    def test_good_record_passes(self):
+        from tools.check_bench_record import check_warm_start
+
+        assert check_warm_start({"warm_start": self._ws()}) == []
+
+    def test_missing_leg_flags(self):
+        from tools.check_bench_record import check_warm_start
+
+        assert check_warm_start({}) == ["warm_start leg missing"]
+
+    @pytest.mark.parametrize("mut,needle", [
+        ({"warm_speedup": 2.4}, ">= 3x"),
+        ({"warm": {"cold_start_to_first_token_s": 3.0,
+                   "aot": {"hits": 0, "misses": 0}}}, "hits"),
+        ({"warm": {"cold_start_to_first_token_s": 3.0,
+                   "aot": {"hits": 5, "misses": 2}}}, "misses"),
+        ({"ceiling_fraction": None}, "ceiling_fraction"),
+    ])
+    def test_degraded_records_flag(self, mut, needle):
+        from tools.check_bench_record import check_warm_start
+
+        ws = self._ws(**mut)
+        if mut.get("ceiling_fraction", 0) is None:
+            ws.pop("ceiling_fraction")
+        problems = check_warm_start({"warm_start": ws})
+        assert any(needle in p for p in problems), problems
+
+    def test_fleet_checker_gates_warm_start(self):
+        from tools.check_fleet_record import check_record
+
+        # minimal record that reaches the warm-start check: assert the
+        # new complaints appear when the block is absent vs unbounded
+        problems = check_record({"schema": "fleet-v1"})
+        assert any("scale_up_warm_start" in p for p in problems)
+        rec = {"schema": "fleet-v1",
+               "slo": {"scale_up_warm_start": {
+                   "pods": {"p": {"ttfst_s": 99.0, "aot_hits": 0}},
+                   "ttfst_bound_s": 30.0, "bounded": False,
+                   "aot_cache_hits": 0}}}
+        problems = check_record(rec)
+        assert any("exceeded the bound" in p for p in problems)
+        assert any("aot_cache_hits is zero" in p for p in problems)

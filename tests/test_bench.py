@@ -341,6 +341,21 @@ class TestBenchRecordChecker:
             "host_tier": {"offloads": 250, "restores": 90,
                           "host_hits": 90, "corrupt_dropped": 0,
                           "evictions": 0},
+        }, "workload_sharedprefix_tp": {
+            "tensor_parallel": 2,
+            "prefix_cache_hit_rate": 0.5,
+            "cold_ttft_ms": {"p50": 700.0, "p90": 900.0},
+            "warm_ttft_ms": {"p50": 200.0, "p90": 400.0},
+            "warm_faster": True,
+            "host_tier": {"offloads": 200, "restores": 80,
+                          "host_hits": 80, "corrupt_dropped": 0,
+                          "evictions": 0},
+        }, "warm_start": {
+            "cold": {"cold_start_to_first_token_s": 16.0},
+            "warm": {"cold_start_to_first_token_s": 3.5,
+                     "aot": {"hits": 20, "misses": 0}},
+            "warm_speedup": 4.571,
+            "ceiling_fraction": 0.35,
         }}
 
     def test_complete_record_passes(self):
@@ -433,6 +448,37 @@ class TestBenchRecordChecker:
         rec = self._good()
         del rec["workload_sharedprefix"]["host_tier"]
         assert any("host_tier" in p for p in check_record(rec))
+
+    def test_tp_sharedprefix_leg_gated(self):
+        """The tp=2 leg carries the same sharedprefix contract plus the
+        tensor_parallel tag — MULTICHIP evidence past the smoke dryrun."""
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        del rec["workload_sharedprefix_tp"]
+        assert any("workload_sharedprefix_tp leg missing" in p
+                   for p in check_record(rec))
+        rec = self._good()
+        rec["workload_sharedprefix_tp"]["prefix_cache_hit_rate"] = 0.0
+        assert any("workload_sharedprefix_tp.prefix_cache_hit_rate" in p
+                   for p in check_record(rec))
+        rec = self._good()
+        rec["workload_sharedprefix_tp"]["tensor_parallel"] = 1
+        assert any("tensor_parallel must be 2" in p
+                   for p in check_record(rec))
+
+    def test_warm_start_leg_gated(self):
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        del rec["warm_start"]
+        assert any("warm_start leg missing" in p for p in check_record(rec))
+        rec = self._good()
+        rec["warm_start"]["warm_speedup"] = 2.0
+        assert any(">= 3x" in p for p in check_record(rec))
+        rec = self._good()
+        rec["warm_start"]["warm"]["aot"]["hits"] = 0
+        assert any("aot.hits" in p for p in check_record(rec))
 
     def test_decode_only_run_is_exempt(self):
         """BENCH_SKIP_HTTP=1 records have no http leg by design — the

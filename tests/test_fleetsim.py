@@ -52,6 +52,19 @@ class TestFleetSLOs:
         assert slo["scaleup_interactive_ttft_p90_ms"] <= slo[
             "ttft_p90_bound_ms"]
 
+    def test_scaleup_pods_come_up_warm(self, fleet_record):
+        """AOT warm start at fleet level (ISSUE 14): the pod the
+        scale-up bought served its first token inside the bound, with
+        its executables loaded from the persisted manifest (hits > 0 —
+        the boot engines built it; the new pod rode it)."""
+        ws = fleet_record["slo"]["scale_up_warm_start"]
+        assert ws["pods"], "scale-up recorded no new pod"
+        assert ws["bounded"] is True
+        assert ws["aot_cache_hits"] > 0
+        for name, pod in ws["pods"].items():
+            assert 0 < pod["ttfst_s"] <= ws["ttfst_bound_s"], (name, pod)
+            assert pod["aot_misses"] == 0, (name, pod)
+
     def test_residency_hit_rate_recovers_after_engine_death(
             self, fleet_record):
         slo = fleet_record["slo"]
@@ -210,6 +223,13 @@ class TestCheckFleetRecord:
                 "hit_rate_prefault": 0.6, "hit_rate_postfault": 0.55,
                 "hit_rate_recovery_frac": 0.8,
                 "hit_rate_recovered": True, "drain_rerouted": True,
+                "scale_up_warm_start": {
+                    "pods": {"svc-worker-1": {
+                        "ttfst_s": 4.2, "aot_hits": 12,
+                        "aot_misses": 0, "build_seconds": 0.1}},
+                    "ttfst_bound_s": 30.0, "bounded": True,
+                    "aot_cache_hits": 12,
+                },
                 "overload": {
                     "interactive_ttft_p90_ms": 800.0,
                     "ttft_p90_bound_ms": 15000.0,
@@ -311,6 +331,28 @@ class TestCheckFleetRecord:
         rec = self._good()
         rec["slo"]["revocation"]["n_waves"] = 1
         assert any(">= 2 waves" in p for p in check_record(rec))
+
+    def test_missing_warm_start_block_fails(self):
+        rec = self._good()
+        del rec["slo"]["scale_up_warm_start"]
+        assert any("scale_up_warm_start block missing" in p
+                   for p in check_record(rec))
+
+    def test_unbounded_warm_start_fails(self):
+        rec = self._good()
+        rec["slo"]["scale_up_warm_start"]["bounded"] = False
+        assert any("exceeded the bound" in p for p in check_record(rec))
+
+    def test_cold_scale_up_pod_fails(self):
+        rec = self._good()
+        rec["slo"]["scale_up_warm_start"]["aot_cache_hits"] = 0
+        assert any("aot_cache_hits is zero" in p
+                   for p in check_record(rec))
+
+    def test_podless_warm_start_fails(self):
+        rec = self._good()
+        rec["slo"]["scale_up_warm_start"]["pods"] = {}
+        assert any("no new pod" in p for p in check_record(rec))
 
     def test_zero_evacuation_counters_fail(self):
         for key in ("evacuated_streams", "parked_streams",

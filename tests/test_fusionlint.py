@@ -1311,6 +1311,119 @@ class TestFramework:
             f.line for f in result.findings)
 
 
+# ------------------------------------------------- sharding-discipline
+
+
+def _shardingpass(**kw):
+    from tools.fusionlint.passes.shardingdiscipline import (
+        ShardingDisciplinePass,
+    )
+
+    kw.setdefault("scope", ["*"])
+    return ShardingDisciplinePass(**kw)
+
+
+class TestShardingDisciplinePass:
+    def test_raw_partition_spec_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            from jax.sharding import PartitionSpec
+
+            SPEC = PartitionSpec(None, "tp")
+        """, [_shardingpass()])
+        assert rules_of(result) == ["sharding-discipline"]
+
+    def test_conventional_p_alias_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            from jax.sharding import PartitionSpec as P
+
+            def specs():
+                return {"wq": P(None, None, "tp")}
+        """, [_shardingpass()])
+        assert rules_of(result) == ["sharding-discipline"]
+
+    def test_attribute_construction_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            import jax
+
+            def spec():
+                return jax.sharding.PartitionSpec("dp")
+        """, [_shardingpass()])
+        assert rules_of(result) == ["sharding-discipline"]
+
+    def test_derived_specs_are_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            from fusioninfer_tpu.parallel.axes import default_rules
+
+            def spec():
+                return default_rules().spec("batch", "length")
+        """, [_shardingpass()])
+        assert result.findings == []
+
+    def test_import_for_isinstance_is_clean(self, tmp_path):
+        # importing the class (isinstance checks, is_leaf predicates)
+        # is fine; CONSTRUCTING it is the finding
+        result = lint(tmp_path, """\
+            from jax.sharding import PartitionSpec
+
+            def is_spec(x):
+                return isinstance(x, PartitionSpec)
+        """, [_shardingpass()])
+        assert result.findings == []
+
+    def test_axis_rules_module_is_exempt(self, tmp_path):
+        result = lint(tmp_path, """\
+            from jax.sharding import PartitionSpec
+
+            def spec(*axes):
+                return PartitionSpec(*axes)
+        """, [_shardingpass(axis_rules_module="fixture.py")])
+        assert result.findings == []
+
+    def test_noqa_suppresses_with_justification(self, tmp_path):
+        result = lint(tmp_path, """\
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("tp")  # noqa:sharding-discipline — interop fixture
+        """, [_shardingpass()])
+        assert result.findings == []
+
+    def test_aot_lower_of_registry_entry_is_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            def aot_signatures(self):
+                def thunk():
+                    return prefill.lower(1)
+                return [("prefill", thunk)]
+        """, [_shardingpass(aot_module="fixture.py")])
+        assert result.findings == []
+
+    def test_aot_lower_of_unregistered_callable_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            def aot_signatures(self):
+                def thunk():
+                    return mystery_fn.lower(1)
+                return [("mystery", thunk)]
+        """, [_shardingpass(aot_module="fixture.py")])
+        assert rules_of(result) == ["aot-registry"]
+
+    def test_lower_outside_aot_signatures_not_checked(self, tmp_path):
+        result = lint(tmp_path, """\
+            def other():
+                return mystery_fn.lower(1)
+        """, [_shardingpass(aot_module="fixture.py")])
+        assert result.findings == []
+
+    def test_engine_aot_signatures_covered_by_registry(self):
+        """The REAL aot_signatures lowers only registry entry points
+        (the repo-clean gate also covers this; this pins the module)."""
+        from tools.fusionlint import config as fl_cfg
+
+        path = REPO / fl_cfg.AOT_SIGNATURES_MODULE
+        result = run_passes([_shardingpass(
+            scope=[fl_cfg.AOT_SIGNATURES_MODULE])], [path])
+        assert [f for f in result.findings
+                if f.rule == "aot-registry"] == []
+
+
 # ------------------------------------------------------- repo-level gates
 
 
@@ -1325,12 +1438,12 @@ class TestRepoIsClean:
         assert repo_result.findings == [], "\n".join(
             f.render() for f in repo_result.findings)
 
-    def test_all_ten_passes_ran(self, repo_result):
+    def test_all_eleven_passes_ran(self, repo_result):
         assert repo_result.passes == [
             "hygiene", "resilience", "lock-discipline", "render-purity",
             "metrics-conventions", "conditions-vocabulary",
             "jit-registry", "trace-discipline", "tracer-leak",
-            "host-sync"]
+            "host-sync", "sharding-discipline"]
 
     def test_repo_coverage_is_real(self, repo_result):
         # the walk must actually see the codebase (a broken DEFAULT_TARGETS

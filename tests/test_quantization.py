@@ -197,8 +197,10 @@ class TestEngineInt8:
         assert wq["_q8"].spec == P(None, None, "tp")
         emb = sh["embed"]
         assert emb["_q8"].spec == P("tp", None)
-        # norms stay plain specs
-        assert sh["final_norm"].spec == P()
+        # norms stay replicated (derived specs are full-rank: one
+        # logical name per array axis, so rank-1 norms get P(None) —
+        # the same sharding the old hand-written P() expressed)
+        assert sh["final_norm"].spec == P(None)
 
 
 class TestMoEScalePreset:

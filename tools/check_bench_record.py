@@ -74,36 +74,93 @@ def check_record(record: dict) -> list[str]:
     # host tier must demonstrably carry chains (offloads AND restores
     # AND host hits nonzero) — a record without this evidence is the
     # pre-hierarchy blind spot shipping again
-    sp = record.get("workload_sharedprefix")
+    problems += check_sharedprefix_leg(record, "workload_sharedprefix")
+    # r12: the SAME workload through a tp=2 tensor-parallel engine —
+    # MULTICHIP evidence past the smoke-only dryrun (ROADMAP gap)
+    problems += check_sharedprefix_leg(record, "workload_sharedprefix_tp")
+    tp_leg = record.get("workload_sharedprefix_tp")
+    if isinstance(tp_leg, dict) and not tp_leg.get("error") and \
+            tp_leg.get("tensor_parallel") != 2:
+        problems.append(
+            "workload_sharedprefix_tp.tensor_parallel must be 2, got "
+            f"{tp_leg.get('tensor_parallel')!r}")
+    problems += check_warm_start(record)
+    return problems
+
+
+def check_sharedprefix_leg(record: dict, leg: str) -> list[str]:
+    """The sharedprefix evidence contract, shared by the single-chip
+    and tensor-parallel legs."""
+    problems: list[str] = []
+    sp = record.get(leg)
     if not isinstance(sp, dict):
-        problems.append("workload_sharedprefix leg missing")
-        return problems
+        return [f"{leg} leg missing"]
     if sp.get("error"):
-        problems.append(f"workload_sharedprefix errored: {sp['error']}")
-        return problems
+        return [f"{leg} errored: {sp['error']}"]
     rate = sp.get("prefix_cache_hit_rate")
     if not isinstance(rate, (int, float)) or rate <= 0.0:
         problems.append(
-            f"workload_sharedprefix.prefix_cache_hit_rate must be > 0, "
-            f"got {rate!r}")
+            f"{leg}.prefix_cache_hit_rate must be > 0, got {rate!r}")
     for field in ("cold_ttft_ms", "warm_ttft_ms"):
         if not (sp.get(field) or {}).get("p50"):
-            problems.append(f"workload_sharedprefix.{field}.p50 missing")
+            problems.append(f"{leg}.{field}.p50 missing")
     if sp.get("warm_faster") is not True:
         problems.append(
-            "workload_sharedprefix: warm-turn TTFT p50 must beat "
+            f"{leg}: warm-turn TTFT p50 must beat "
             f"cold-turn p50 (warm_faster={sp.get('warm_faster')!r}, "
             f"warm={(sp.get('warm_ttft_ms') or {}).get('p50')}ms, "
             f"cold={(sp.get('cold_ttft_ms') or {}).get('p50')}ms)")
     tier = sp.get("host_tier")
     if not isinstance(tier, dict):
-        problems.append("workload_sharedprefix.host_tier counters missing")
+        problems.append(f"{leg}.host_tier counters missing")
     else:
         for counter in ("offloads", "restores", "host_hits"):
             if not tier.get(counter):
                 problems.append(
-                    f"workload_sharedprefix.host_tier.{counter} must be "
+                    f"{leg}.host_tier.{counter} must be "
                     f"nonzero, got {tier.get(counter)!r}")
+    return problems
+
+
+def check_warm_start(record: dict) -> list[str]:
+    """AOT warm-start gate (r12): cold vs warm start-to-first-token
+    through the real warmup path — the warm pod must be >= 3x faster
+    to its first token on the smoke box, with its executables
+    demonstrably loaded from the persisted cache (aot hits > 0,
+    misses == 0) and the warm-path ceiling_fraction re-measured."""
+    problems: list[str] = []
+    ws = record.get("warm_start")
+    if not isinstance(ws, dict):
+        return ["warm_start leg missing"]
+    if ws.get("error"):
+        return [f"warm_start errored: {ws['error']}"]
+    for pass_name in ("cold", "warm"):
+        val = (ws.get(pass_name) or {}).get("cold_start_to_first_token_s")
+        if not isinstance(val, (int, float)) or val <= 0:
+            problems.append(
+                f"warm_start.{pass_name}.cold_start_to_first_token_s "
+                f"missing or non-positive ({val!r})")
+    speedup = ws.get("warm_speedup")
+    if not isinstance(speedup, (int, float)) or speedup < 3.0:
+        problems.append(
+            "warm_start: warm start-to-first-token must be >= 3x faster "
+            f"than cold on the smoke box (warm_speedup={speedup!r}, "
+            f"cold={(ws.get('cold') or {}).get('cold_start_to_first_token_s')!r}s, "
+            f"warm={(ws.get('warm') or {}).get('cold_start_to_first_token_s')!r}s)")
+    aot = (ws.get("warm") or {}).get("aot") or {}
+    if not aot.get("hits"):
+        problems.append(
+            f"warm_start.warm.aot.hits must be nonzero, got "
+            f"{aot.get('hits')!r} — the warm pod never loaded the "
+            "persisted executables")
+    if aot.get("misses"):
+        problems.append(
+            f"warm_start.warm.aot.misses must be 0, got "
+            f"{aot.get('misses')!r} — the fingerprint drifted between "
+            "the cold build and the warm boot")
+    if "ceiling_fraction" not in ws:
+        problems.append("warm_start.ceiling_fraction (warm-path "
+                        "serving-gap re-measure) missing")
     return problems
 
 
@@ -122,7 +179,8 @@ def main(argv: list[str]) -> int:
             print(f"check_bench_record: {p}", file=sys.stderr)
         return 1
     print(f"check_bench_record: {path.name} carries ceiling_fraction + "
-          "scheduler budget fields")
+          "scheduler budget fields, the tp sharedprefix leg, and the "
+          "AOT warm-start evidence (warm >= 3x cold, hits > 0)")
     return 0
 
 

@@ -11,6 +11,10 @@ properties ARE the acceptance criteria of the fleet harness
   (slice loss mid-decode, metrics-relay partition, KV-transfer
   corruption — each of which must actually appear in the fault ledger);
 * interactive TTFT p90 during scale-up stayed under the recorded bound;
+* every pod the scale-up bought came up through the AOT warmup
+  (``engine/aot.py``) and served its first token inside the recorded
+  warm-start bound with ``aot_cache_hits > 0`` — scale-up latency is
+  model init, never an XLA compile storm;
 * the residency-routed prefix hit rate recovered to within the recorded
   fraction of its pre-fault value after the engine death;
 * the controller HELD (did not scale on fiction) through the metrics
@@ -115,6 +119,29 @@ def check_record(record: dict) -> list[str]:
             "interactive TTFT p90 during scale-up exceeded the bound "
             f"(p90={slo.get('scaleup_interactive_ttft_p90_ms')!r} ms, "
             f"bound={slo.get('ttft_p90_bound_ms')!r} ms)")
+    # AOT warm start (r12): every pod the scale-up bought must serve
+    # its first token inside the recorded bound, having come up through
+    # the warmup with its executables loaded from the persisted cache
+    ws = slo.get("scale_up_warm_start")
+    if not isinstance(ws, dict):
+        problems.append("slo.scale_up_warm_start block missing (the "
+                        "scale-up pods never recorded warm-start "
+                        "evidence)")
+    else:
+        if not ws.get("pods"):
+            problems.append("scale_up_warm_start: no new pod recorded "
+                            "warm-start gauges")
+        if not ws.get("bounded"):
+            problems.append(
+                "scale_up_warm_start: a freshly scaled pod's first "
+                "served token exceeded the bound "
+                f"(pods={ws.get('pods')!r}, "
+                f"bound={ws.get('ttfst_bound_s')!r}s)")
+        if not ws.get("aot_cache_hits"):
+            problems.append(
+                "scale_up_warm_start: aot_cache_hits is zero — the "
+                "scale-up pods compiled from scratch instead of "
+                "loading the persisted executables")
     if not slo.get("hit_rate_recovered"):
         problems.append(
             "residency-routed hit rate did not recover to within "
@@ -248,6 +275,7 @@ def main(argv: list[str]) -> int:
     print(f"check_fleet_record: {path.name} carries the closed-loop "
           "fleet evidence (scale-up + drain scale-down, zero "
           "lost/corrupted streams under faults, bounded scale-up TTFT, "
+          "warm-start pods inside the bound with aot_cache_hits > 0, "
           "residency recovery, overload: bounded interactive TTFT with "
           "batch shed/preempted/parked/resumed, revocation: >=2 waves "
           "evacuated/parked/exported with survivor resume and "

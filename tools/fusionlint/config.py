@@ -120,6 +120,17 @@ METRICS_MODULES = [
 # static/traced split to type call sites
 JIT_REGISTRY_MODULE = "fusioninfer_tpu/utils/jit_registry.py"
 
+# sharding-discipline pass: the ONE module allowed to construct
+# PartitionSpec objects (the logical-axis rules table); everywhere
+# else in the package, specs are DERIVED via AxisRules.spec(...) —
+# a raw PartitionSpec literal is the refactor's drift vector
+AXIS_RULES_MODULE = "fusioninfer_tpu/parallel/axes.py"
+SHARDING_SCOPE = ["fusioninfer_tpu/*.py", "fusioninfer_tpu/*/*.py"]
+# the module whose aot_signatures() enumerates the AOT warmup's
+# lower-and-compile thunks — each lowered callable must be a
+# jit_registry entry point (warm start covers the reviewed contract)
+AOT_SIGNATURES_MODULE = "fusioninfer_tpu/engine/engine.py"
+
 # modules scanned for jit/shard_map sites (tests/tools/bench create
 # ad-hoc jits deliberately — only the package's entry points are the
 # compile-discipline surface)

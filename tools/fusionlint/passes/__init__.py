@@ -18,6 +18,7 @@ from tools.fusionlint.passes.lockdiscipline import LockDisciplinePass
 from tools.fusionlint.passes.metricsconv import MetricsConventionsPass
 from tools.fusionlint.passes.renderpurity import RenderPurityPass
 from tools.fusionlint.passes.resilience import ResiliencePass
+from tools.fusionlint.passes.shardingdiscipline import ShardingDisciplinePass
 from tools.fusionlint.passes.tracediscipline import TraceDisciplinePass
 from tools.fusionlint.passes.tracerleak import TracerLeakPass
 
@@ -32,6 +33,7 @@ ALL_PASSES = [
     TraceDisciplinePass,
     TracerLeakPass,
     HostSyncPass,
+    ShardingDisciplinePass,
 ]
 
 

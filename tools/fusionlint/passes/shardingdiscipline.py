@@ -1,0 +1,142 @@
+"""sharding-discipline pass — specs are derived, never owned per site.
+
+The logical-axis refactor's contract: every ``PartitionSpec`` in the
+package is minted by ``AxisRules.spec(...)`` in the ONE rules module
+(``fusioninfer_tpu/parallel/axes.py``), derived from canonical logical
+axis names.  A raw ``PartitionSpec(...)`` constructed anywhere else is
+the drift this pass exists to catch — a call site quietly re-owning its
+layout, which is exactly what made retargeting new mesh shapes a
+whole-package audit before the refactor.
+
+Two rules:
+
+* ``sharding-discipline`` — a ``PartitionSpec`` construction (any
+  import alias, including the conventional ``as P``, or an attribute
+  reference ending in ``.PartitionSpec``) outside the axis-rules
+  module.  Merely importing the class for ``isinstance`` checks or
+  type annotations is fine; *calling* it is the finding.
+* ``aot-registry`` — the AOT warmup's signature builder
+  (``NativeEngine.aot_signatures``) AOT-lowers serving entry points via
+  ``<callee>.lower(...)``; every such callee must be an entry in the
+  checked-in jit registry, so the warm-start cache covers the reviewed
+  compile contract and nothing else (an unregistered lower target is a
+  trace boundary the registry discipline never saw).
+
+Suppress a deliberate exception with ``# noqa:sharding-discipline —
+<why this spec cannot derive from the table>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.fusionlint import config
+from tools.fusionlint.core import REPO, Finding, LintPass, Module
+from tools.fusionlint.passes.jitregistry import entry_name, load_registry
+
+
+def _is_module(mod: Module, rel: str) -> bool:
+    """Path match tolerant of out-of-repo fixture files (their ``rel``
+    is absolute)."""
+    return mod.rel == rel or mod.rel.endswith("/" + rel)
+
+
+def _spec_aliases(tree: ast.Module) -> set[str]:
+    """Local names bound to jax.sharding.PartitionSpec by imports
+    (``from jax.sharding import PartitionSpec [as P]``)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax.sharding"
+                or node.module.endswith(".sharding")):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+class ShardingDisciplinePass(LintPass):
+    name = "sharding-discipline"
+    rules = ("sharding-discipline", "aot-registry")
+
+    def __init__(self,
+                 scope: list[str] | None = None,
+                 axis_rules_module: str | None = None,
+                 aot_module: str | None = None,
+                 registry_path: str | None = None):
+        self.scope = config.SHARDING_SCOPE if scope is None else scope
+        self.axis_rules_module = (config.AXIS_RULES_MODULE
+                                  if axis_rules_module is None
+                                  else axis_rules_module)
+        self.aot_module = (config.AOT_SIGNATURES_MODULE
+                           if aot_module is None else aot_module)
+        rel = (config.JIT_REGISTRY_MODULE
+               if registry_path is None else registry_path)
+        path = pathlib.Path(rel)
+        if not path.is_absolute():
+            path = REPO / path
+        try:
+            self.registry_names = {entry_name(k)
+                                   for k in load_registry(path)}
+        except (OSError, SyntaxError, KeyError):
+            self.registry_names = None
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if not mod.matches(self.scope) or _is_module(
+                mod, self.axis_rules_module):
+            return []
+        tree = mod.tree
+        assert tree is not None
+        findings: list[Finding] = []
+        aliases = _spec_aliases(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            is_spec = (isinstance(func, ast.Name) and func.id in aliases) \
+                or (isinstance(func, ast.Attribute)
+                    and func.attr == "PartitionSpec")
+            if is_spec:
+                findings.append(Finding(
+                    "sharding-discipline", mod.rel, node.lineno,
+                    "raw PartitionSpec construction outside the "
+                    f"axis-rules module ({self.axis_rules_module}) — "
+                    "derive the spec from the logical-axis table "
+                    "(AxisRules.spec) so one rules change retargets "
+                    "every mesh shape"))
+        if _is_module(mod, self.aot_module):
+            findings += self._check_aot(mod, tree)
+        return findings
+
+    def _check_aot(self, mod: Module, tree: ast.Module) -> list[Finding]:
+        """Every ``X.lower(...)`` inside ``aot_signatures`` must lower a
+        jit-registry entry point."""
+        if self.registry_names is None:
+            return [Finding(
+                "aot-registry", mod.rel, 1,
+                "jit registry module is missing or unparseable — the "
+                "AOT warmup's coverage cannot be checked")]
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef) or \
+                    node.name != "aot_signatures":
+                continue
+            for inner in ast.walk(node):
+                if not (isinstance(inner, ast.Call)
+                        and isinstance(inner.func, ast.Attribute)
+                        and inner.func.attr == "lower"):
+                    continue
+                target = inner.func.value
+                tname = target.attr if isinstance(target, ast.Attribute) \
+                    else (target.id if isinstance(target, ast.Name)
+                          else None)
+                if tname is None or tname not in self.registry_names:
+                    findings.append(Finding(
+                        "aot-registry", mod.rel, inner.lineno,
+                        f"aot_signatures lowers {tname!r}, which is not "
+                        "a jit_registry entry point — the AOT warm "
+                        "start must cover the reviewed compile "
+                        "contract (register the entry or drop the "
+                        "lower)"))
+        return findings
