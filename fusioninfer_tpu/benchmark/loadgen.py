@@ -118,10 +118,11 @@ def _classify(exc: Exception) -> str:
 def _one_request(
     base_url: str, prompt_len: int, output_len: int, result: LoadResult,
     lock: threading.Lock, timeout: float, seed: int, prefix: str = "",
+    top_k: int = 0,
 ) -> None:
     prompt = prefix + random_prompt(prompt_len, seed)
     ttft, n_chunks, err = _timed_request(
-        base_url, prompt, output_len, timeout, seed)
+        base_url, prompt, output_len, timeout, seed, top_k=top_k)
     if err is not None:
         with lock:
             result.errors[err] = result.errors.get(err, 0) + 1
@@ -150,13 +151,17 @@ def scrape_prefix_hit_rate(base_url: str, timeout: float = 10.0) -> float | None
 def _timed_request(base_url: str, prompt: str, output_len: int,
                    timeout: float, seed: int,
                    slo_tier: str = "",
-                   deadline_s: float | None = None) -> tuple[float | None,
-                                                             int,
-                                                             str | None]:
+                   deadline_s: float | None = None,
+                   top_k: int = 0) -> tuple[float | None,
+                                            int,
+                                            str | None]:
     """One streaming completion → (ttft_s, chunks, error_kind).
     ``slo_tier`` / ``deadline_s`` ride as the server's extension fields
     (tier-aware scheduling + admission-time deadline shed); a 429 shed
-    classifies as ``http_429`` like any other HTTP error."""
+    classifies as ``http_429`` like any other HTTP error.  ``top_k`` > 0
+    adds bounded top-k sampling — the fused lm_head→top-k serving shape
+    (bench legs measuring that path pass it; 0 keeps the historical
+    plain-sampling payload)."""
     payload = {
         "prompt": prompt,
         "max_tokens": output_len,
@@ -164,6 +169,8 @@ def _timed_request(base_url: str, prompt: str, output_len: int,
         "seed": seed,
         "stream": True,
     }
+    if top_k > 0:
+        payload["top_k"] = top_k
     if slo_tier:
         payload["slo_tier"] = slo_tier
     if deadline_s is not None:
@@ -509,6 +516,7 @@ def run_http_load(
     max_prompt: int = 1024,
     max_output: int = 256,
     shared_prefix_len: int = 0,
+    top_k: int = 0,
 ) -> LoadResult:
     """Closed-loop load: ``concurrency`` worker threads drain a shared
     queue of ShareGPT-style requests against a running server.
@@ -516,7 +524,9 @@ def run_http_load(
     ``shared_prefix_len`` > 0 prepends the SAME ``shared_prefix_len``-token
     prefix to every request — the prefix-cache-hit mix (system-prompt
     style traffic), reported via ``shared_prefix_len`` in the summary so
-    a cache-skewed TTFT is always labeled as such."""
+    a cache-skewed TTFT is always labeled as such.  ``top_k`` > 0 sends
+    bounded top-k sampling on every request (the fused lm_head→top-k
+    eligible shape)."""
     pairs = sharegpt_lengths(
         n_requests, seed, median_prompt=median_prompt,
         median_output=median_output,
@@ -542,7 +552,7 @@ def run_http_load(
                 return
             i, (p_len, o_len) = nxt
             _one_request(base_url, p_len, o_len, result, lock, timeout,
-                         seed + i, prefix)
+                         seed + i, prefix, top_k=top_k)
 
     threads = [threading.Thread(target=worker, daemon=True) for _ in range(concurrency)]
     t0 = time.perf_counter()

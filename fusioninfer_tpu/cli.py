@@ -222,6 +222,24 @@ def _add_engine_config_flags(p: argparse.ArgumentParser) -> None:
                         "prefill-then-decode dispatch).  Burst engines "
                         "(--decode-burst > 1) keep the split "
                         "dispatch-ahead path either way")
+    p.add_argument("--fused-sampling", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="fuse sampling into the lm_head: eligible decode "
+                        "batches (greedy / bounded top-k) project through "
+                        "a vocab-blocked running top-k and sample from the "
+                        "candidates, never materializing the [rows, vocab] "
+                        "logits tensor; logprobs / guided / logit_bias / "
+                        "min_p batches take the unfused path automatically. "
+                        "Streams are bit-identical either way "
+                        "(--no-fused-sampling is a perf/debug switch)")
+    p.add_argument("--kv-splits", type=int, default=-1,
+                   help="flash-decode KV-split grid for long-context "
+                        "decode: each row's page walk parallelizes over "
+                        "this many kernel programs with a log-sum-exp "
+                        "combine (0 = single walk; -1 = auto, engaged "
+                        "when max context >= KV_SPLIT_MIN_CTX_TOKENS = "
+                        "4096 tokens).  Split counts 1/2/4/8 are "
+                        "bit-identical by construction")
     p.add_argument("--dtype", default="",
                    help="override the model compute dtype (e.g. float32 "
                         "for exact cross-sharding equivalence checks)")

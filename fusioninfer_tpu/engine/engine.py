@@ -64,6 +64,8 @@ from fusioninfer_tpu.engine.model_runner import (
     prefill_buckets,
 )
 from fusioninfer_tpu.ops import dispatch as ops_dispatch
+from fusioninfer_tpu.ops import pick_kv_splits as ops_pick_kv_splits
+from fusioninfer_tpu.ops.lm_head_topk import LM_HEAD_TOPK, lm_head_topk
 from fusioninfer_tpu.engine.prefix_cache import (
     PrefixCachingAllocator,
     block_hashes,
@@ -75,10 +77,11 @@ from fusioninfer_tpu.engine.sampler import (
     make_row_keys,
     sample,
     sample_first,
+    sample_topk,
     spec_window_draws,
 )
 from fusioninfer_tpu.models.config import ModelConfig
-from fusioninfer_tpu.models.transformer import init_params
+from fusioninfer_tpu.models.transformer import init_params, lm_head_operands
 
 logger = logging.getLogger("fusioninfer.engine")
 
@@ -306,6 +309,8 @@ class NativeEngine:
         decode_burst_steps: int = 1,
         pipeline_bursts: bool = True,
         fused_step: bool = True,
+        fused_sampling: bool = True,
+        kv_splits: Optional[int] = None,
         clock=time.monotonic,
         host_kv_tier=None,
     ):
@@ -604,6 +609,26 @@ class NativeEngine:
         # fused mixed-batch stepping (decode + prefill chunks in one
         # weight pass); burst engines keep the split dispatch-ahead path
         self.fused_step_enabled = fused_step
+        # fused lm_head→top-k sampling (ops/lm_head_topk.py): eligible
+        # decode batches — every row greedy or 0 < top_k <= LM_HEAD_TOPK
+        # with min_p off, no logprobs/guided/logit_bias/spec — sample
+        # from blocked candidates and never materialize [B, V] logits;
+        # ineligible batches fall back to the unfused path explicitly.
+        # Streams are bit-identical either way (both paths feed the same
+        # candidate arrays to sampler.sample_topk), so the flag is a
+        # perf/debug switch, not a semantics switch.
+        self.fused_sampling_enabled = fused_sampling
+        self.fused_sampling_steps_total = 0
+        # flash-decode KV-split grid (ops/paged_attention.py): resolved
+        # ONCE from STATIC cache config so every dispatch of this engine
+        # — and every process of a multi-host lockstep group — takes the
+        # same kernel path (a per-batch choice would make a short row's
+        # bits depend on its neighbors' context depths).  Long-context
+        # engines parallelize each row's page walk over the split grid;
+        # short-context engines keep the single walk untouched.
+        self._kv_splits = (ops_pick_kv_splits(
+            self.cache_cfg.max_pages_per_seq, self.cache_cfg.page_size)
+            if kv_splits is None else kv_splits)
         # AOT warm-start report (engine/aot.py::warmup stamps it; the
         # server renders it as fusioninfer:aot_cache_* metrics)
         self.aot_stats: dict = {}
@@ -799,7 +824,7 @@ class NativeEngine:
                 t *= 2
             return out
 
-        def lower_fused(T, sel_rows, sel_w, nc):
+        def lower_fused(T, sel_rows, sel_w, nc, decode_hidden=False):
             return fused_step.lower(
                 cfg, cc, self.params, self.cache,
                 jnp.zeros((T,), i32), jnp.zeros((R,), i32),
@@ -807,17 +832,28 @@ class NativeEngine:
                 jnp.full((R, mp), cc.trash_page, i32),
                 jnp.zeros((sel_rows, sel_w), i32), jnp.zeros((nc,), i32),
                 mesh=mesh, lora=lora, adapter_ids=ids(R),
-                coalesce=coalesce)
+                coalesce=coalesce, kv_splits=self._kv_splits,
+                decode_hidden=decode_hidden)
 
+        # fused-sampling engines run the decode/mixed selectors in the
+        # decode_hidden variant (no spec windows by eligibility, W=1);
+        # the unfused variant stays warmed for the fallback batches
+        fs = self.fused_sampling_enabled and not self.spec_k
         for T in pow2_range(pow2_rows(max(16, B * W))):
             sigs.append((f"fused/decode-t{T}",
                          partial(lower_fused, T, B, W, 0)))
+            if fs:
+                sigs.append((f"fused/decode-hidden-t{T}",
+                             partial(lower_fused, T, B, W, 0, True)))
         for T in pow2_range(t_max):
             sigs.append((f"fused/chunk-t{T}",
                          partial(lower_fused, T, 0, 1, NC)))
             if self.fused_step_enabled and self.burst_steps == 1:
                 sigs.append((f"fused/mixed-t{T}",
                              partial(lower_fused, T, B, W, NC)))
+                if fs:
+                    sigs.append((f"fused/mixed-hidden-t{T}",
+                                 partial(lower_fused, T, B, W, NC, True)))
 
         if self.burst_steps > 1:
             for span in sorted({1, self.burst_steps}):
@@ -833,19 +869,52 @@ class NativeEngine:
                             self._suppress,
                             jnp.full((B, mp), cc.trash_page, i32),
                             n_steps=span, sample_mode=mode, mesh=mesh,
-                            lora=lora, coalesce=coalesce)
+                            lora=lora, coalesce=coalesce,
+                            kv_splits=self._kv_splits)
                     sigs.append((f"burst/s{span}-{mode}", lower_burst))
 
         # the first-token sampling chain (admission's host-side tail)
         logits1 = jnp.zeros((1, V), jnp.float32)
         row1 = jnp.zeros((1,), jnp.float32)
-        for mode in ("greedy", "plain", "filtered"):
+        for mode in ("greedy", "plain", "filtered", "topk"):
             def lower_sample(mode=mode):
                 return sample.lower(
                     logits1, make_row_keys(jnp.zeros((1,), jnp.uint32),
                                            jnp.zeros((1,), i32)),
                     row1, jnp.zeros((1,), i32), row1, row1, mode=mode)
             sigs.append((f"sample/{mode}", lower_sample))
+
+        if fs:
+            # the fused-sampling tail: blocked lm_head→top-k over the
+            # decode rows + the candidate draw, at the engine's exact
+            # [B, D] / [B, K] shapes.  Under a tp kernel mesh the live
+            # projection runs inside lm_head_topk_tp's shard_map (no
+            # top-level jit cache of its own), so only the single-shard
+            # engine lowers the jit entry here.
+            K = min(LM_HEAD_TOPK, V)
+            if mesh is None:
+                head, tied = lm_head_operands(cfg, self.params)
+
+                def lower_topk():
+                    return lm_head_topk.lower(
+                        jnp.zeros((B, cfg.d_model), cfg.jax_dtype), head,
+                        self._token_counts, self._output_counts,
+                        jnp.zeros((B,), jnp.float32),
+                        jnp.zeros((B,), jnp.float32),
+                        jnp.ones((B,), jnp.float32), jnp.zeros((B,), bool),
+                        self._suppress, tied=tied)
+                sigs.append(("lm_head_topk/b%d" % B, lower_topk))
+            for mode in ("greedy", "topk"):
+                def lower_sample_topk(mode=mode):
+                    return sample_topk.lower(
+                        jnp.zeros((B, K), jnp.float32),
+                        jnp.zeros((B, K), i32),
+                        make_row_keys(jnp.zeros((B,), jnp.uint32),
+                                      jnp.zeros((B,), i32)),
+                        jnp.zeros((B,), jnp.float32),
+                        jnp.zeros((B,), i32), jnp.ones((B,), jnp.float32),
+                        mode=mode)
+                sigs.append((f"sample_topk/{mode}", lower_sample_topk))
 
         def lower_penalties():
             return apply_penalties.lower(
@@ -2417,14 +2486,17 @@ class NativeEngine:
         self.sched.charge_prefill(len(prefix) - reused_tokens)
         return self._activate(request, prefix, resumed, logits)
 
-    def _ragged_forward(self, packed, lora):
+    def _ragged_forward(self, packed, lora, decode_hidden: bool = False):
         """Dispatch ONE flat ragged forward (the one kernel, the one
         signature family) and charge its weight pass →
-        ``(logits [B, W, V], chunk_logits [NC, V])``.  Every engine
-        forward that reads paged context — decode rows, spec windows,
-        chunk advances, batched cache-hit suffixes, mixed fused steps —
-        assembles a :class:`RaggedBatch` and lands here, so no path can
-        reacquire a private scorer."""
+        ``(logits [B, W, V], chunk_logits [NC, V])`` — or, with
+        ``decode_hidden`` (the fused-sampling path), the decode group's
+        hidden states ``[B, W, D]`` in the first slot so the engine's
+        blocked lm_head→top-k never sees a [B·W, V] tensor.  Every
+        engine forward that reads paged context — decode rows, spec
+        windows, chunk advances, batched cache-hit suffixes, mixed
+        fused steps — assembles a :class:`RaggedBatch` and lands here,
+        so no path can reacquire a private scorer."""
         self.cache, logits, chunk_logits = fused_step(
             self.cfg, self.cache_cfg, self.params, self.cache,
             jnp.asarray(packed.tokens), jnp.asarray(packed.row_starts),
@@ -2438,6 +2510,8 @@ class NativeEngine:
             # FUSIONINFER_DECODE_COALESCE must retrace, not silently
             # reuse the latched variant (ops/dispatch.py)
             coalesce=ops_dispatch.decode_coalesce(),
+            kv_splits=self._kv_splits,
+            decode_hidden=decode_hidden,
         )
         self.sched.charge_weight_pass()
         return logits, chunk_logits
@@ -2842,15 +2916,101 @@ class NativeEngine:
         host-side from the batch's sampling params: "greedy" when every
         row is temperature<=0, "plain" when no sampled row filters
         (skips the two [B, V] sorts that otherwise dominate a TPU
-        decode step), else the general "filtered"."""
+        decode step), "topk" when every sampled row draws from a
+        bounded candidate set (0 < top_k <= LM_HEAD_TOPK, min_p off —
+        the candidate-space draw the fused lm_head path reproduces
+        without [B, V] logits), else the general "filtered".  A mix of
+        plain and topk rows is "filtered": a top_k=0 row needs the full
+        support, a top_k row in the same batch still needs candidate
+        semantics — only the general path serves both."""
         mode = "greedy"
         for p in params_iter:
             if p.temperature <= 0.0:
                 continue
-            if p.top_k > 0 or p.top_p < 1.0 or p.min_p > 0.0:
+            if p.min_p > 0.0:
                 return "filtered"
-            mode = "plain"
+            if 0 < p.top_k <= LM_HEAD_TOPK:
+                row = "topk"
+            elif p.top_k == 0 and p.top_p >= 1.0:
+                row = "plain"
+            else:
+                return "filtered"
+            if mode == "greedy":
+                mode = row
+            elif mode != row:
+                return "filtered"
         return mode
+
+    def _fused_sampling_mode(self, live: dict) -> Optional[str]:
+        """The fused lm_head→top-k eligibility gate, decided per decode
+        batch from host-known request params (the `_burst_span` /
+        `_sample_mode` precedent): returns the candidate sample mode
+        ("greedy" or "topk") when EVERY live row can sample from a
+        bounded candidate set, else None → the unfused [B, V] path.
+        Carve-outs are explicit: logprobs need the full distribution,
+        guided masks and logit_bias scatter into [B, V], min_p needs the
+        full-vocab softmax, spec windows feed spec_window_draws — all
+        fall back whole-batch (the fallback IS the existing path, and
+        eligible batches are bit-identical on either path, so the
+        boundary is invisible in the streams)."""
+        if not self.fused_sampling_enabled or self.spec_k or not live:
+            return None
+        for st in live.values():
+            p = st.request.params
+            if (st.guided is not None or p.logprobs is not None
+                    or p.logit_bias):
+                return None
+        mode = self._sample_mode(st.request.params for st in live.values())
+        return mode if mode in ("greedy", "topk") else None
+
+    def _decode_finish_fused(self, live: dict, hidden, ctl: dict,
+                             failures: list, mode: str) -> list[StepOutput]:
+        """The fused-sampling decode tail: blocked lm_head→top-k over
+        the decode rows' hidden states [B, D] (penalties + min-tokens
+        suppression applied per vocab block inside the jit), then the
+        candidate draw — no [B, V] logits tensor anywhere.  Emission
+        matches `_decode_finish`'s plain branch exactly; eligibility
+        (`_fused_sampling_mode`) already excluded every row kind that
+        branch special-cases."""
+        head, tied = lm_head_operands(self.cfg, self.params)
+        early = jnp.asarray(ctl["gen_counts"] < ctl["min_toks"])
+        if self._kernel_mesh is not None:
+            from fusioninfer_tpu.ops.sharded import lm_head_topk_tp
+
+            vals, idx = lm_head_topk_tp(
+                self._kernel_mesh, hidden, head, self._token_counts,
+                self._output_counts, jnp.asarray(ctl["presence"]),
+                jnp.asarray(ctl["frequency"]),
+                jnp.asarray(ctl["repetition"]), early, self._suppress,
+                tied=tied)
+        else:
+            vals, idx = lm_head_topk(
+                hidden, head, self._token_counts, self._output_counts,
+                jnp.asarray(ctl["presence"]), jnp.asarray(ctl["frequency"]),
+                jnp.asarray(ctl["repetition"]), early, self._suppress,
+                tied=tied)
+        keys = make_row_keys(jnp.asarray(ctl["seeds"]),
+                             jnp.asarray(ctl["gen_counts"]))
+        sampled_dev = sample_topk(vals, idx, keys,
+                                  jnp.asarray(ctl["temps"]),
+                                  jnp.asarray(ctl["top_ks"]),
+                                  jnp.asarray(ctl["top_ps"]), mode=mode)
+        B = self.max_batch_size
+        live_mask = np.zeros(B, bool)
+        live_mask[list(live)] = True
+        self._token_counts, self._output_counts = _bump_count_rows(
+            self._token_counts, self._output_counts, sampled_dev,
+            jnp.asarray(live_mask))
+        sampled = np.asarray(sampled_dev)
+        self.sched.charge_decode(len(live))
+        self.fused_sampling_steps_total += 1
+        outputs = list(failures)
+        for slot, st in live.items():
+            token = int(sampled[slot])
+            st.tokens.append(token)
+            self.generation_tokens_total += 1
+            outputs.append(self._emit(st, token))
+        return outputs
 
     def _decode_need(self, st: "_SeqState", span: int) -> int:
         """Tokens of page coverage this row needs from the next decode
@@ -2946,6 +3106,7 @@ class NativeEngine:
                 # mid-process retraces instead of silently serving the
                 # stale latched variant (ops/dispatch.py)
                 coalesce=dispatch.decode_coalesce(),
+                kv_splits=self._kv_splits,
             )
         return sampled_dev, next_ctl
 
@@ -3113,8 +3274,10 @@ class NativeEngine:
             window, counts_w, ctl["positions"], ctl["page_tables"],
             ctl["adapter_ids"], entries, self.cache_cfg.trash_page,
             rows=self._ragged_rows, chunk_rows=self._ragged_chunk_rows)
+        fs_mode = self._fused_sampling_mode(live)
         try:
-            logits_f, chunk_logits = self._ragged_forward(packed, lora)
+            logits_f, chunk_logits = self._ragged_forward(
+                packed, lora, decode_hidden=fs_mode is not None)
         except Exception as e:
             logger.exception("fused mixed-batch step of %d chunks failed",
                              len(take))
@@ -3144,7 +3307,12 @@ class NativeEngine:
         outputs = list(failures)
         if done:
             outputs += self._activate_group(done)
-        # decode sampling/spec-verify off the slot-aligned decode rows
+        # decode sampling/spec-verify off the slot-aligned decode rows;
+        # on the fused-sampling path logits_f carries HIDDEN states and
+        # the candidate tail samples without [B, V] logits
+        if fs_mode is not None:
+            return outputs + self._decode_finish_fused(
+                live, logits_f[:, 0], ctl, [], fs_mode)
         spec = (self._spec_draws(logits_f, window, ctl, spec_drafts)
                 if self.spec_k else None)
         return outputs + self._decode_finish(live, logits_f[:, 0], ctl,
@@ -3276,6 +3444,12 @@ class NativeEngine:
             # chunk_rows=0: an empty chunk group, not the padded one — a
             # decode-only step must not pay NC dead lm_head rows
             rows=self._ragged_rows, chunk_rows=0)
+        fs_mode = self._fused_sampling_mode(live)
+        if fs_mode is not None:
+            hidden_f, _ = self._ragged_forward(packed, lora,
+                                               decode_hidden=True)
+            return self._decode_finish_fused(live, hidden_f[:, 0], ctl,
+                                             failures, fs_mode)
         logits_f, _ = self._ragged_forward(packed, lora)
         spec = None
         if self.spec_k:

@@ -164,6 +164,9 @@ class EngineMetrics:
             "# HELP vllm:spec_decode_num_accepted_tokens_total Draft tokens accepted by verification.",
             "# TYPE vllm:spec_decode_num_accepted_tokens_total counter",
             f"vllm:spec_decode_num_accepted_tokens_total{{{labels}}} {engine.spec_accepted_total}",
+            "# HELP fusioninfer:fused_sampling_steps_total Decode steps sampled through the fused lm_head top-k path (no [rows, vocab] logits materialized).",
+            "# TYPE fusioninfer:fused_sampling_steps_total counter",
+            f"fusioninfer:fused_sampling_steps_total{{{labels}}} {getattr(engine, 'fused_sampling_steps_total', 0)}",
             "# HELP vllm:num_preemptions_total Requests preempted to reclaim KV-cache pages.",
             "# TYPE vllm:num_preemptions_total counter",
             f"vllm:num_preemptions_total{{{labels}}} {engine.preemptions_total}",

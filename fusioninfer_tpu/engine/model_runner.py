@@ -121,6 +121,39 @@ def _dequant_gather(ctx, scale_l, pages, flat_shape):
     return ctx.astype(jnp.float32) * sc[..., None]
 
 
+def _ragged_attn(mesh, q, cache, page_tables, row_starts, q_begins, q_lens,
+                 k_scales, v_scales, *, layer, window, coalesce,
+                 kv_splits, interpret):
+    """The ONE ragged-kernel dispatch every model-path forward routes
+    through: tp shard_map when a serving mesh is given, the flash-decode
+    KV-split grid when the engine's static heuristic engaged it
+    (``kv_splits > 0``, :func:`ops.paged_attention.pick_kv_splits`),
+    else the single-walk grid — so no forward can reacquire a private
+    kernel-selection policy."""
+    from fusioninfer_tpu.ops import (
+        ragged_paged_attention,
+        ragged_paged_attention_kvsplit,
+    )
+
+    if mesh is not None:
+        from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
+
+        return ragged_paged_attention_tp(
+            mesh, q, cache["k"], cache["v"], page_tables, row_starts,
+            q_begins, q_lens, k_scales, v_scales, layer=layer,
+            interpret=interpret, window=window, coalesce=coalesce,
+            kv_splits=kv_splits)
+    if kv_splits > 0:
+        return ragged_paged_attention_kvsplit(
+            q, cache["k"], cache["v"], page_tables, row_starts,
+            q_begins, q_lens, k_scales, v_scales, layer=layer,
+            kv_splits=kv_splits, interpret=interpret, window=window)
+    return ragged_paged_attention(
+        q, cache["k"], cache["v"], page_tables, row_starts, q_begins,
+        q_lens, k_scales, v_scales, layer=layer, interpret=interpret,
+        window=window, coalesce=coalesce)
+
+
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh",), donate_argnums=(3,))
 def prefill(
     cfg: ModelConfig,
@@ -174,7 +207,8 @@ def prefill(
     return cache, lm_head(cfg, params, last)
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "coalesce"),
+@partial(jax.jit, static_argnums=(0, 1),
+         static_argnames=("mesh", "coalesce", "kv_splits"),
          donate_argnums=(3,))
 def prefill_suffix(
     cfg: ModelConfig,
@@ -191,6 +225,8 @@ def prefill_suffix(
     # engine namespaces the prefix cache per adapter)
     coalesce: bool = None,  # ragged-grid variant (ops/dispatch.py);
     # the engine resolves the env var eagerly per call
+    kv_splits: int = 0,  # flash-decode KV-split grid (0 = single walk);
+    # static per engine (pick_kv_splits over the cache config)
 ):
     """Prefill a prompt SUFFIX against cached prefix pages (the automatic
     prefix-caching path): token i sits at global position ``start + i``,
@@ -208,7 +244,7 @@ def prefill_suffix(
     strategy (reference ``pkg/router/strategy.go:51-77`` routes for cache
     hits; the hit's compute happens here).
     """
-    from fusioninfer_tpu.ops import dispatch, ragged_paged_attention
+    from fusioninfer_tpu.ops import dispatch
 
     B, C = tokens.shape
     ps = cache_cfg.page_size
@@ -249,28 +285,16 @@ def prefill_suffix(
         if use_kernel:
             # the ONE ragged kernel, degenerate descriptors: a single
             # row of true_len tokens starting mid-sequence
-            if mesh is not None:
-                from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
-
-                attn = ragged_paged_attention_tp(
-                    mesh, q[0], cache["k"], cache["v"], page_row[None],
-                    jnp.reshape(start, (1,)).astype(jnp.int32),
-                    jnp.zeros((1,), jnp.int32),
-                    jnp.reshape(true_len, (1,)).astype(jnp.int32),
-                    ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window, coalesce=coalesce,
-                )[None]  # [1, C, H*Hd]
-            else:
-                attn = ragged_paged_attention(
-                    q[0], cache["k"], cache["v"], page_row[None],
-                    jnp.reshape(start, (1,)).astype(jnp.int32),
-                    jnp.zeros((1,), jnp.int32),
-                    jnp.reshape(true_len, (1,)).astype(jnp.int32),
-                    ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window, coalesce=coalesce,
-                )[None]
+            attn = _ragged_attn(
+                mesh, q[0], cache, page_row[None],
+                jnp.reshape(start, (1,)).astype(jnp.int32),
+                jnp.zeros((1,), jnp.int32),
+                jnp.reshape(true_len, (1,)).astype(jnp.int32),
+                ks_s, vs_s, layer=l,
+                window=cfg.sliding_window, coalesce=coalesce,
+                kv_splits=kv_splits,
+                interpret=dispatch.kernel_interpret(),
+            )[None]  # [1, C, H*Hd]
         else:
             k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
             k_ctx = k_cache_l[:, page_row].reshape(KV, mp * ps, Hd)
@@ -318,9 +342,10 @@ def _decode_step_impl(
     coalesce: bool = None,  # decode-kernel grid; the ENGINE resolves the
     # FUSIONINFER_DECODE_COALESCE env var eagerly per call so a
     # mid-process flip retraces instead of reusing the latched variant
+    kv_splits: int = 0,  # flash-decode KV-split grid (0 = single walk)
 ):
     """One decode step for the whole running batch → (cache, logits [B, V])."""
-    from fusioninfer_tpu.ops import dispatch, ragged_paged_attention
+    from fusioninfer_tpu.ops import dispatch
 
     B = tokens.shape[0]
     ps = cache_cfg.page_size
@@ -363,24 +388,14 @@ def _decode_step_impl(
             # the ONE ragged kernel, degenerate descriptors: B rows of
             # one token each (q_len = active) — the same kernel (and
             # bits) the fused mixed-batch path scores decode rows with
-            if mesh is not None:
-                from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
-
-                attn = ragged_paged_attention_tp(
-                    mesh, q[:, 0], cache["k"], cache["v"], page_tables,
-                    positions, jnp.arange(B_, dtype=jnp.int32),
-                    active.astype(jnp.int32), ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window, coalesce=coalesce,
-                )[:, None, :]
-            else:
-                attn = ragged_paged_attention(
-                    q[:, 0], cache["k"], cache["v"], page_tables,
-                    positions, jnp.arange(B_, dtype=jnp.int32),
-                    active.astype(jnp.int32), ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window, coalesce=coalesce,
-                )[:, None, :]  # [B, 1, H*Hd]
+            attn = _ragged_attn(
+                mesh, q[:, 0], cache, page_tables, positions,
+                jnp.arange(B_, dtype=jnp.int32),
+                active.astype(jnp.int32), ks_s, vs_s, layer=l,
+                window=cfg.sliding_window, coalesce=coalesce,
+                kv_splits=kv_splits,
+                interpret=dispatch.kernel_interpret(),
+            )[:, None, :]  # [B, 1, H*Hd]
         else:
             # portable path: gather pages [KV, B, mp, ps, Hd] -> [KV, B, T, Hd]
             k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
@@ -414,7 +429,8 @@ def _decode_step_impl(
 
 
 decode_step = partial(
-    jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "coalesce"),
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("mesh", "coalesce", "kv_splits"),
     donate_argnums=(3,))(_decode_step_impl)
 
 
@@ -430,7 +446,8 @@ CTL_F_COLS = ("temperature", "top_p", "min_p", "presence", "frequency",
 
 
 @partial(jax.jit, static_argnums=(0, 1),
-         static_argnames=("mesh", "n_steps", "sample_mode", "coalesce"),
+         static_argnames=("mesh", "n_steps", "sample_mode", "coalesce",
+                          "kv_splits"),
          donate_argnums=(3, 6, 7))
 def decode_burst(
     cfg: ModelConfig,
@@ -448,6 +465,7 @@ def decode_burst(
     mesh=None,
     lora=None,
     coalesce: bool = None,  # decode-kernel grid, resolved by the caller
+    kv_splits: int = 0,  # flash-decode KV-split grid (0 = single walk)
 ):
     """``n_steps`` fused decode+sample steps with on-device token
     feedback → ``(cache, sampled [n_steps, B], token_counts,
@@ -513,7 +531,7 @@ def decode_burst(
         cache, logits = _decode_step_impl(
             cfg, cache_cfg, params, cache, toks, pos, page_tables, act,
             mesh=mesh, lora=lora, adapter_ids=adapter_ids,
-            coalesce=coalesce)
+            coalesce=coalesce, kv_splits=kv_splits)
         logits = apply_penalties(logits, tcounts, ocounts,
                                  presence, frequency, repetition)
         logits = jnp.where((gcounts < min_toks)[:, None] & suppress,
@@ -560,6 +578,7 @@ def _window_forward_impl(
     last_only: bool = False,  # logits at counts-1 only → [B, V]
     sel: jax.Array = None,  # [B, W] per-row positions to project → [B, W, V]
     coalesce: bool = None,  # ragged-grid variant, resolved by the engine
+    kv_splits: int = 0,  # flash-decode KV-split grid (0 = single walk)
 ):
     """Speculative-verification forward: score a C-token window per
     sequence in ONE pass → (cache, logits [B, C, V]); with ``last_only``
@@ -588,7 +607,7 @@ def _window_forward_impl(
     the ONE ragged kernel (:func:`fusioninfer_tpu.ops.
     ragged_paged_attention`) on the head-major page layout.
     """
-    from fusioninfer_tpu.ops import dispatch, ragged_paged_attention
+    from fusioninfer_tpu.ops import dispatch
 
     B, C = tokens.shape
     ps = cache_cfg.page_size
@@ -634,22 +653,12 @@ def _window_forward_impl(
             # count — padding columns belong to no row
             qf = q.reshape(B * C, H, Hd)
             q_begins = jnp.arange(B, dtype=jnp.int32) * C
-            if mesh is not None:
-                from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
-
-                attn = ragged_paged_attention_tp(
-                    mesh, qf, cache["k"], cache["v"], page_tables, starts,
-                    q_begins, counts, ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window, coalesce=coalesce,
-                ).reshape(B, C, H * Hd)
-            else:
-                attn = ragged_paged_attention(
-                    qf, cache["k"], cache["v"], page_tables, starts,
-                    q_begins, counts, ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window, coalesce=coalesce,
-                ).reshape(B, C, H * Hd)
+            attn = _ragged_attn(
+                mesh, qf, cache, page_tables, starts, q_begins, counts,
+                ks_s, vs_s, layer=l, window=cfg.sliding_window,
+                coalesce=coalesce, kv_splits=kv_splits,
+                interpret=dispatch.kernel_interpret(),
+            ).reshape(B, C, H * Hd)
         else:
             k_cache_l, v_cache_l, ks_l, vs_l = _cache_layer(cache, l)
             k_ctx = k_cache_l[:, page_tables].reshape(KV, B, mp * ps, Hd)
@@ -692,11 +701,12 @@ def _window_forward_impl(
 
 verify_step = partial(
     jax.jit, static_argnums=(0, 1),
-    static_argnames=("mesh", "last_only", "coalesce"),
+    static_argnames=("mesh", "last_only", "coalesce", "kv_splits"),
     donate_argnums=(3,))(_window_forward_impl)
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("mesh", "coalesce"),
+@partial(jax.jit, static_argnums=(0, 1),
+         static_argnames=("mesh", "coalesce", "kv_splits", "decode_hidden"),
          donate_argnums=(3,))
 def fused_step(
     cfg: ModelConfig,
@@ -714,6 +724,11 @@ def fused_step(
     lora=None,  # stacked AdapterSet tree ([L, N, ...] per projection)
     adapter_ids: jax.Array = None,  # [R] int32 per ROW; 0 = base model
     coalesce: bool = None,  # ragged-grid variant, resolved by the engine
+    kv_splits: int = 0,  # flash-decode KV-split grid (0 = single walk);
+    # static per engine (pick_kv_splits over the cache config)
+    decode_hidden: bool = False,  # fused-sampling path: return the decode
+    # group's HIDDEN states [B, W, D] instead of its logits, so the
+    # engine's lm_head→top-k never materializes [B·W, V]
 ):
     """ONE weight pass over a flat ragged-concat token axis →
     (cache, logits [B, W, V], chunk_logits [NC, V]).
@@ -749,7 +764,7 @@ def fused_step(
     and the fused step that absorbs it), so a stream's logits bits
     never depend on which dispatch computed them.
     """
-    from fusioninfer_tpu.ops import dispatch, ragged_paged_attention
+    from fusioninfer_tpu.ops import dispatch
     from fusioninfer_tpu.ops.paged_attention import ragged_token_rows
 
     T = tokens.shape[0]
@@ -793,22 +808,12 @@ def fused_step(
         ks_s, vs_s = cache.get("k_scale"), cache.get("v_scale")
 
         if use_kernel:
-            if mesh is not None:
-                from fusioninfer_tpu.ops.sharded import ragged_paged_attention_tp
-
-                attn = ragged_paged_attention_tp(
-                    mesh, q[:, 0], cache["k"], cache["v"], page_tables,
-                    row_starts, q_begins, q_lens, ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window, coalesce=coalesce,
-                )[:, None, :]
-            else:
-                attn = ragged_paged_attention(
-                    q[:, 0], cache["k"], cache["v"], page_tables,
-                    row_starts, q_begins, q_lens, ks_s, vs_s, layer=l,
-                    interpret=dispatch.kernel_interpret(),
-                    window=cfg.sliding_window, coalesce=coalesce,
-                )[:, None, :]  # [T, 1, H*Hd]
+            attn = _ragged_attn(
+                mesh, q[:, 0], cache, page_tables, row_starts, q_begins,
+                q_lens, ks_s, vs_s, layer=l, window=cfg.sliding_window,
+                coalesce=coalesce, kv_splits=kv_splits,
+                interpret=dispatch.kernel_interpret(),
+            )[:, None, :]  # [T, 1, H*Hd]
         else:
             # portable flat gather: decode_step's einsum with the flat
             # tokens on the batch axis — per-token bits independent of
@@ -858,13 +863,21 @@ def fused_step(
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     h = x[:, 0]  # [T, D]
     idx = jnp.clip(sel.astype(jnp.int32), 0, T - 1)  # [B, W]
+    cidx = jnp.clip(chunk_sel.astype(jnp.int32), 0, T - 1)  # [NC]
+    chunk_logits = lm_head(cfg, params, h[cidx])  # [NC, V]
+    if decode_hidden:
+        # fused-sampling path: hand the decode group's hidden states to
+        # the engine's blocked lm_head→top-k (ops/lm_head_topk.py) —
+        # the SAME [B·W, D] gather the logits path projects, so the
+        # candidates it produces are bit-identical to top-k over the
+        # unfused logits below
+        picked = h[idx.reshape(idx.size)]  # [B·W, D]
+        return cache, picked.reshape(*idx.shape, h.shape[-1]), chunk_logits
     # FLAT [B·W, D] through lm_head — the same [N, D] @ [D, V] shape
     # decode_step projects, so a decode row's logits bits match the
     # classic/burst path's exactly
     logits = lm_head(cfg, params, h[idx.reshape(idx.size)])  # [B·W, V]
     logits = logits.reshape(*idx.shape, logits.shape[-1])  # [B, W, V]
-    cidx = jnp.clip(chunk_sel.astype(jnp.int32), 0, T - 1)  # [NC]
-    chunk_logits = lm_head(cfg, params, h[cidx])  # [NC, V]
     return cache, logits, chunk_logits
 
 

@@ -145,23 +145,112 @@ def sample(
       :func:`filter_logits` — whose two full [B, V] sorts cost ~30 ms
       per step at a 150k vocab on TPU and dominate the decode loop if
       run unconditionally.
-    * ``"filtered"`` — the general path (default; always correct).
+    * ``"topk"``     — every sampled row has 0 < top_k <= the candidate
+      cap (:data:`ops.lm_head_topk.LM_HEAD_TOPK`) and min_p off: the
+      draw is DEFINED over the row's top-k candidate set
+      (:func:`sample_topk`), which is the whole point — the fused
+      lm_head path computes the same candidates WITHOUT ever
+      materializing [B, V] logits, and because both paths feed the
+      identical candidate array to the identical sampler, fused and
+      unfused seeded streams are bit-identical by construction.
+    * ``"filtered"`` — the general path (default; always correct —
+      logprobs / guided / logit_bias / min_p / unbounded-top_k rows).
 
     A static argument (one small compiled variant each) rather than a
     runtime ``lax.cond``: a cond nested inside the decode-burst scan
     sent XLA:TPU compile time through the roof, and the host already
-    knows the batch composition exactly.  Fast paths are bit-identical
-    to the filtered math: with top_k=0 and top_p=1 the filter masks
-    nothing, so its categorical draw sees the very same scaled
-    logits."""
+    knows the batch composition exactly.  The greedy/plain fast paths
+    are bit-identical to the filtered math: with top_k=0 and top_p=1
+    the filter masks nothing, so its categorical draw sees the very
+    same scaled logits.
+
+    Candidate-row determinism is PER ROW, never per batch: a row that
+    qualifies for the candidate draw (0 < top_k <= the cap, min_p off)
+    takes it in EVERY mode that can see such a row — "topk" draws only
+    candidates, and "filtered" routes its candidate-eligible rows
+    through the very same :func:`sample_topk` while the rest of the
+    batch draws from the full filtered distribution — so a seeded
+    request's tokens never depend on which neighbors share its batch
+    (the batch-composition independence this module has promised since
+    round 1; the mode merely picks how much work the OTHER rows cost)."""
     greedy_tok = jnp.argmax(logits, axis=-1)
     if mode == "greedy":
         return greedy_tok
+    if mode == "topk":
+        vals, idx = jax.lax.top_k(logits,
+                                  min(_topk_cap(), logits.shape[-1]))
+        return sample_topk(vals, idx, keys, temperature, top_k, top_p,
+                           mode=mode)
     if mode == "plain":
         scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-    else:
-        scaled = filter_logits(logits, temperature, top_k, top_p, min_p)
+        sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+        return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+    scaled = filter_logits(logits, temperature, top_k, top_p, min_p)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    # per-row candidate routing: rows the "topk" mode would serve draw
+    # from the SAME candidate sampler here, so admitting (or finishing)
+    # a filtered neighbor mid-stream cannot flip a seeded top-k row's
+    # bits between the candidate and full-vocab draws
+    cap = min(_topk_cap(), logits.shape[-1])
+    vals, idx = jax.lax.top_k(logits, cap)
+    cand = sample_topk(vals, idx, keys, temperature, top_k, top_p,
+                       mode="topk")
+    eligible = (top_k > 0) & (top_k <= cap)
+    if min_p is not None:
+        eligible = eligible & (min_p <= 0.0)
+    sampled = jnp.where(eligible, cand, sampled)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
+def _topk_cap() -> int:
+    """The candidate-set width (ops/lm_head_topk.py), imported lazily —
+    sampler must stay importable without the ops stack."""
+    from fusioninfer_tpu.ops.lm_head_topk import LM_HEAD_TOPK
+
+    return LM_HEAD_TOPK
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def sample_topk(
+    vals: jax.Array,  # [B, K] penalized UNSCALED logits, value-desc,
+    #                   ties vocab-index-asc (lax.top_k's contract)
+    idx: jax.Array,  # [B, K] their vocab ids
+    keys: jax.Array,  # [B] PRNG keys
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32 — 0 < top_k <= K for sampled rows
+    top_p: jax.Array,  # [B]
+    mode: str = "topk",
+) -> jax.Array:
+    """The ONE candidate-set sampler — both the fused lm_head path and
+    the unfused ``sample(mode="topk")`` land here with byte-identical
+    candidate arrays, so their streams cannot diverge.
+
+    Mirrors :func:`filter_logits` + categorical restricted to the
+    candidates: temperature scaling, a RANK-based top-k mask (the
+    candidates are already value-sorted, so rank < top_k IS the top-k
+    set; exact value ties at the boundary resolve by vocab index
+    instead of the filtered path's keep-all-ties — a deliberate,
+    documented tightening), then the nucleus mask over the candidate
+    distribution, then one categorical over [B, K].  Greedy rows read
+    candidate 0 — ``lax.top_k``'s tie rule makes that exactly
+    ``argmax``."""
+    greedy_tok = idx[:, 0]
+    if mode == "greedy":
+        return greedy_tok
+    K = vals.shape[1]
+    scaled = vals / jnp.maximum(temperature, 1e-6)[:, None]
+    ranks = jnp.arange(K)[None, :]
+    scaled = jnp.where(ranks < jnp.maximum(top_k, 1)[:, None],
+                       scaled, -jnp.inf)
+    # nucleus over the (sorted) candidates: keep the smallest prefix
+    # whose cumulative mass covers top_p — filter_logits' rule, with
+    # the sort already done
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    scaled = jnp.where((cumulative - probs) < top_p[:, None],
+                       scaled, -jnp.inf)
+    j = jax.vmap(jax.random.categorical)(keys, scaled)
+    sampled = jnp.take_along_axis(idx, j[:, None], axis=1)[:, 0]
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)
 
 

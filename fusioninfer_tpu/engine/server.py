@@ -2456,6 +2456,11 @@ def _engine_from_args(args) -> tuple[NativeEngine, str]:
         decode_burst_steps=max(1, getattr(args, "decode_burst", 8) or 1),
         pipeline_bursts=not getattr(args, "no_decode_pipeline", False),
         fused_step=getattr(args, "fused_step", True),
+        fused_sampling=getattr(args, "fused_sampling", True),
+        # -1 = auto (pick_kv_splits over the cache config); explicit
+        # values pin the KV-split grid for A/Bs and tests
+        kv_splits=(None if getattr(args, "kv_splits", -1) < 0
+                   else args.kv_splits),
         host_kv_tier=host_tier,
     )
     if not no_budget and engine.token_budget is None:

@@ -341,17 +341,29 @@ def causal_mask(S: int, dtype=jnp.bool_, window: int | None = None) -> jax.Array
     return m.astype(dtype)[None, None, :, :]
 
 
+def lm_head_operands(cfg: ModelConfig, params: Params):
+    """``(head, tied)``: the raw (possibly quantized) lm_head operand —
+    the ``[D, V]`` projection, or the ``[V, D]`` embedding table when
+    weights are tied (transposed on use).  The ONE head-resolution rule,
+    shared by :func:`lm_head` and the blocked fused-sampling projection
+    (:mod:`fusioninfer_tpu.ops.lm_head_topk`) so the two paths can never
+    read different weights."""
+    head = params.get("lm_head")
+    if head is not None:
+        return head, False
+    return params["embed"], True
+
+
 def lm_head(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     """Project hidden states to fp32 logits; tied embeddings fall back to
     the transposed embedding table."""
     from fusioninfer_tpu.models.quantization import dequantize, is_quantized
 
-    head = params.get("lm_head")
-    if head is None:
-        embed = params["embed"]
-        head = (dequantize(embed, cfg.jax_dtype) if is_quantized(embed) else embed).T
-    elif is_quantized(head):
+    head, tied = lm_head_operands(cfg, params)
+    if is_quantized(head):
         head = dequantize(head, cfg.jax_dtype)
+    if tied:
+        head = head.T
     return (x @ head).astype(jnp.float32)
 
 

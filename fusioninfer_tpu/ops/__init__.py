@@ -15,12 +15,16 @@ from fusioninfer_tpu.ops.flash_attention import (  # noqa: F401
     reference_attention,
 )
 from fusioninfer_tpu.ops.paged_attention import (  # noqa: F401
+    KV_SPLIT_CHUNKS,
     RAGGED_BLOCK_Q,
+    kvsplit_fits_vmem,
     paged_decode_attention,
     paged_prefill_attention,
     paged_verify_attention,
+    pick_kv_splits,
     ragged_fits_vmem,
     ragged_paged_attention,
+    ragged_paged_attention_kvsplit,
     ragged_token_rows,
     reference_paged_attention,
     reference_paged_prefill_attention,

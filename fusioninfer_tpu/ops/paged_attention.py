@@ -888,7 +888,7 @@ def _ragged_block_rows(q_begins: jax.Array, q_lens: jax.Array,
 
 def _ragged_row(r, t0, block_q, q, row_refs, layer_ref, page_refs,
                 bufs, sem, o_ref, *, page_size, quantized, window,
-                per_head_g=None):
+                per_head_g=None, page_lo=None, page_hi=None, partial=None):
     """Score one row's pages against the current q tile and merge the
     row's live token rows into ``o_ref`` — the shared body of both
     ragged grids (``per_head_g``: a head index for the per-head grid,
@@ -900,7 +900,14 @@ def _ragged_row(r, t0, block_q, q, row_refs, layer_ref, page_refs,
     (tile, row), fully-masked pages contribute exactly 0 (``exp``
     underflows to +0.0 and the first real page's ``alpha`` is exactly
     0.0), and every dot/reduction is row-wise — so a token's output
-    bits depend only on its row's content, never on tile neighbors."""
+    bits depend only on its row's content, never on tile neighbors.
+
+    ``page_lo``/``page_hi`` restrict the walk to a virtual-chunk page
+    window and ``partial=(slot, m_ref, l_ref, acc_ref)`` redirects the
+    epilogue to emit the walk's raw ``(m, l, unnormalized acc)`` at
+    chunk ``slot`` instead of the normalized output — the KV-split
+    grid's flash-decode partials (coalesced layout only).  With the
+    defaults the traced operations are exactly the single-walk path's."""
     page_tables_ref, row_starts_ref, q_begins_ref, q_lens_ref = row_refs
     k_pages_ref, v_pages_ref, scale_refs = page_refs
     k_buf, v_buf, scale_bufs = bufs
@@ -908,8 +915,12 @@ def _ragged_row(r, t0, block_q, q, row_refs, layer_ref, page_refs,
     qb = q_begins_ref[r]
     ql = q_lens_ref[r]
     st = row_starts_ref[r]
-    G = o_ref.shape[2]
-    Hd = o_ref.shape[3]
+    if partial is None:
+        G = o_ref.shape[2]
+        Hd = o_ref.shape[3]
+    else:
+        G = partial[3].shape[3]
+        Hd = partial[3].shape[4]
     R = block_q * G
     # flat token id of each of the R q rows (G head rows per token)
     tok = t0 + jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0) // G
@@ -921,6 +932,9 @@ def _ragged_row(r, t0, block_q, q, row_refs, layer_ref, page_refs,
     n_used = jnp.where(hi > lo, pl.cdiv(st + hi - qb, page_size), 0)
     first = (jnp.maximum(st + lo - qb - (window - 1), 0) // page_size
              if window is not None else 0)
+    if page_lo is not None:
+        first = jnp.maximum(first, page_lo)
+        n_used = jnp.minimum(n_used, page_hi)
     g = slice(None) if per_head_g is None else per_head_g
 
     def dma(slot, p):
@@ -928,7 +942,10 @@ def _ragged_row(r, t0, block_q, q, row_refs, layer_ref, page_refs,
                          k_pages_ref, v_pages_ref, k_buf, v_buf, sem,
                          scale_refs, scale_bufs)
 
-    @pl.when(n_used > 0)
+    # `n_used > first` (not `> 0`): a KV-split chunk window can sit
+    # entirely past the row's live pages, and page `first` would then
+    # index beyond the row's table
+    @pl.when(n_used > first)
     def _start_first():
         for c in dma(first % 2, first):
             c.start()
@@ -999,8 +1016,21 @@ def _ragged_row(r, t0, block_q, q, row_refs, layer_ref, page_refs,
     l0 = jnp.zeros((*lead, R, 1), jnp.float32)
     a0 = jnp.zeros((*lead, R, Hd), jnp.float32)
     m, l, acc = jax.lax.fori_loop(first, n_used, body, (m0, l0, a0))
-    out = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
     lt = live[:, 0].reshape(block_q, G)[:, :1]  # [bq, 1] token liveness
+    if partial is not None:
+        # KV-split partials: the walk's raw (m, l, unnormalized acc) at
+        # chunk slot `c` — normalization happens after the cross-chunk
+        # log-sum-exp combine in the wrapper (coalesced layout only)
+        c, m_ref, l_ref, acc_ref = partial
+        KV = q.shape[0]
+        accw = jnp.moveaxis(acc.reshape(KV, block_q, G, Hd), 0, 1)
+        mw = jnp.moveaxis(m.reshape(KV, block_q, G), 0, 1)  # [bq, KV, G]
+        lw = jnp.moveaxis(l.reshape(KV, block_q, G), 0, 1)
+        acc_ref[c] = jnp.where(lt[:, None, :, None], accw, acc_ref[c])
+        m_ref[c] = jnp.where(lt[:, :, None], mw, m_ref[c])
+        l_ref[c] = jnp.where(lt[:, :, None], lw, l_ref[c])
+        return
+    out = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
     if per_head_g is None:
         KV = q.shape[0]
         out = jnp.moveaxis(out.reshape(KV, block_q, G, Hd), 0, 1)
@@ -1223,6 +1253,283 @@ def ragged_paged_attention(
         out_shape=jax.ShapeDtypeStruct((Tp, KV, G, Hd), q.dtype),
         interpret=interpret,
     )(*operands)
+    return out.reshape(Tp, H * Hd)[:T]
+
+
+# -- flash-decode KV-split grid ---------------------------------------
+#
+# A 32k-context decode row through the single-walk grid above is one
+# sequential chain of ~256 page tiles on ONE grid program while the
+# rest of the chip idles — the "one-page-walk wall" (ROADMAP item 3).
+# ``ragged_paged_attention_kvsplit`` parallelizes over the KV axis: a
+# second grid dimension of ``kv_splits`` programs each walks a slice of
+# the page range and emits flash-decode partials ``(m, l, unnormalized
+# acc)``; a cross-split log-sum-exp combine reduces them to the
+# attention output.
+#
+# Bit-identity across split counts is BY CONSTRUCTION, not luck: float
+# online-softmax is not associative, so partials are always emitted at
+# a FIXED virtual-chunk granularity (``KV_SPLIT_CHUNKS`` page windows,
+# boundaries a static function of the table width alone) and the
+# combine always folds the chunk partials left-to-right.  ``kv_splits``
+# only chooses how many grid programs share the chunks — every chunk
+# partial is a fresh walk over the same pages with the same ops
+# whichever program computes it, so splits 1, 2, 4 and 8 produce the
+# same bits (pinned by the split-axis extension of
+# ``test_offset_and_neighbor_invariance_bit_identity``).  Empty chunks
+# keep the exact +0.0 masked-page algebra: their (m=-inf, l=0, acc=0)
+# partial merges as an exact identity (alpha = exp(0) = 1.0, beta =
+# exp(-inf) = +0.0), so a short row — whose pages all land in chunk 0 —
+# costs one walk plus exact no-op merges, and a token's output bits
+# never depend on its tile neighbors or flat offset.
+
+# fixed virtual-chunk count: the page range always partitions into this
+# many accumulation windows whatever ``kv_splits`` is (the bit-identity
+# construction above).  8 matches the deepest useful split on a v5e
+# core's compute units without inflating short-row combine overhead.
+KV_SPLIT_CHUNKS = 8
+
+# the dispatch heuristic's context floor: engines whose max context
+# (max_pages_per_seq × page_size) is below this keep the single-walk
+# grid — its compile-signature families and decode latency untouched.
+# The threshold is STATIC engine config, never runtime batch content:
+# a per-batch choice would make a short row's bits depend on whether a
+# long neighbor shares its dispatch, re-breaking the neighbor
+# invariance PR 6 established.
+KV_SPLIT_MIN_CTX_TOKENS = 4096
+
+
+def pick_kv_splits(max_pages_per_seq: int, page_size: int) -> int:
+    """The ragged_fits_vmem-style dispatch heuristic: 0 (single-walk
+    grid, existing signature families) below the long-context floor,
+    else the full ``KV_SPLIT_CHUNKS`` split fan-out.  A pure function
+    of static cache config so every process of a multi-host lockstep
+    group — and every dispatch of one engine — resolves identically."""
+    if max_pages_per_seq * page_size < KV_SPLIT_MIN_CTX_TOKENS:
+        return 0
+    return KV_SPLIT_CHUNKS
+
+
+def kvsplit_fits_vmem(block_q: int, page_size: int, Hd: int, kv_heads: int,
+                      group: int, q_dtype, k_dtype, v_dtype,
+                      quantized: bool, kv_splits: int,
+                      budget: int | None = None) -> bool:
+    """True when one KV-split program's VMEM footprint — the coalesced
+    page scratch, the q tile, and its ``chunks_per_program`` f32 partial
+    blocks (acc + m + l) — fits the conservative budget; the wrapper
+    demotes to the single-walk grid otherwise."""
+    if budget is None:
+        budget = _COALESCE_VMEM_SCRATCH_BUDGET
+    pages = coalesced_scratch_bytes(page_size, Hd, kv_heads,
+                                    k_dtype, v_dtype, quantized)
+    q_tile = block_q * kv_heads * group * Hd * jnp.dtype(q_dtype).itemsize
+    cpp = KV_SPLIT_CHUNKS // max(1, kv_splits)
+    partials = cpp * block_q * kv_heads * group * (Hd + 2) * 4
+    return pages + q_tile + partials <= budget
+
+
+def _ragged_kernel_kvsplit(
+    # scalar prefetch (the single-walk ragged layout)
+    page_tables_ref,  # [R, mp] int32 (SMEM)
+    row_starts_ref,  # [R] int32
+    q_begins_ref,  # [R] int32
+    q_lens_ref,  # [R] int32
+    block_rows_ref,  # [nb, 2] int32
+    layer_ref,  # [1] int32
+    # inputs: q_ref [block_q, KV, G, Hd] VMEM tile of the flat axis
+    q_ref,
+    k_pages_ref,
+    v_pages_ref,
+    *rest,
+    block_q: int,
+    page_size: int,
+    sm_scale: float,
+    quantized: bool,
+    window: int | None,
+    chunk_pages: int,
+    chunks_per_prog: int,
+):
+    """KV-split grid ``(S, nb)``: program ``(s, t)`` walks its
+    ``chunks_per_prog`` virtual page-chunks for every row intersecting
+    tile ``t`` and emits per-chunk ``(m, l, acc)`` partials — the same
+    coalesced page streaming and per-page math as the single walk,
+    restricted to each chunk's page window with fresh accumulators."""
+    if quantized:
+        (ks_ref, vs_ref, acc_ref, m_ref, l_ref,
+         k_buf, v_buf, ks_buf, vs_buf, sem) = rest
+        scale_refs, scale_bufs = (ks_ref, vs_ref), (ks_buf, vs_buf)
+    else:
+        acc_ref, m_ref, l_ref, k_buf, v_buf, sem = rest
+        scale_refs = scale_bufs = None
+    s = pl.program_id(0)
+    t = pl.program_id(1)
+    first_row, n_rows = block_rows_ref[t, 0], block_rows_ref[t, 1]
+    KV, G, Hd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    q = jnp.moveaxis(q_ref[...].astype(jnp.float32) * sm_scale,
+                     1, 0).reshape(KV, block_q * G, Hd)
+    acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+    m_ref[...] = jnp.full(m_ref.shape, -jnp.inf, m_ref.dtype)
+    l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+    row_refs = (page_tables_ref, row_starts_ref, q_begins_ref, q_lens_ref)
+
+    def row_body(j, _):
+        for c in range(chunks_per_prog):  # static unroll: ref slots
+            chunk = s * chunks_per_prog + c
+            _ragged_row(first_row + j, t * block_q, block_q, q, row_refs,
+                        layer_ref, (k_pages_ref, v_pages_ref, scale_refs),
+                        (k_buf, v_buf, scale_bufs), sem, None,
+                        page_size=page_size, quantized=quantized,
+                        window=window,
+                        page_lo=chunk * chunk_pages,
+                        page_hi=(chunk + 1) * chunk_pages,
+                        partial=(c, m_ref, l_ref, acc_ref))
+        return _
+
+    jax.lax.fori_loop(0, n_rows, row_body, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret", "window", "block_q",
+                              "kv_splits")
+)
+def ragged_paged_attention_kvsplit(
+    q: jax.Array,  # [T, H, Hd] — flat ragged-concat query tokens
+    k_pages: jax.Array,  # [KV, n_pages, ps, Hd] or stacked [L, KV, …]
+    v_pages: jax.Array,
+    page_tables: jax.Array,  # [R, max_pages] int32 — per-ROW tables
+    row_starts: jax.Array,  # [R] int32
+    q_begins: jax.Array,  # [R] int32
+    q_lens: jax.Array,  # [R] int32 (0 = inert row)
+    k_scales: jax.Array | None = None,  # [(L,) KV, n_pages, 1, ps] (int8)
+    v_scales: jax.Array | None = None,
+    *,
+    kv_splits: int = KV_SPLIT_CHUNKS,
+    sm_scale: float | None = None,
+    interpret: bool = False,
+    window: int | None = None,
+    block_q: int = RAGGED_BLOCK_Q,
+    layer: jax.Array | int | None = None,
+) -> jax.Array:
+    """Flash-decode ragged paged attention → [T, H·Hd]: the one true
+    ragged kernel's descriptor contract with the serial page walk
+    replaced by ``kv_splits`` parallel walks over fixed virtual page
+    chunks plus a cross-chunk log-sum-exp combine (module comment above
+    for the bit-identity construction).  ``kv_splits`` must divide
+    ``KV_SPLIT_CHUNKS``; oversized VMEM configurations (and per-head
+    fallback shapes) demote to the single-walk grid — a static,
+    config-level decision so every dispatch of one engine takes the
+    same path."""
+    T, H, Hd = q.shape
+    k_pages, v_pages, k_scales, v_scales, layer_arr = _as_stacked(
+        k_pages, v_pages, k_scales, v_scales, layer)
+    KV, _, page_size, _ = k_pages.shape[1:]
+    G = H // KV
+    sm_scale = sm_scale if sm_scale is not None else Hd ** -0.5
+    quantized = k_scales is not None
+    S = max(1, min(int(kv_splits), KV_SPLIT_CHUNKS))
+    while KV_SPLIT_CHUNKS % S:
+        S -= 1
+    if not kvsplit_fits_vmem(block_q, page_size, Hd, KV, G, q.dtype,
+                             k_pages.dtype, v_pages.dtype, quantized, S):
+        # the KV-split grid is coalesced-only; configurations its
+        # scratch + partials would blow demote to the single-walk grid
+        # (whose own guard may further demote to per-head)
+        return ragged_paged_attention(
+            q, k_pages, v_pages, page_tables, row_starts, q_begins,
+            q_lens, k_scales, v_scales, sm_scale=sm_scale,
+            interpret=interpret, window=window, block_q=block_q,
+            coalesce=True, layer=layer_arr)
+    mp = page_tables.shape[1]
+    chunks = KV_SPLIT_CHUNKS
+    chunk_pages = -(-mp // chunks)
+    cpp = chunks // S
+
+    Tp = -(-T // block_q) * block_q
+    if Tp != T:
+        q = jnp.pad(q, ((0, Tp - T), (0, 0), (0, 0)))
+    nb = Tp // block_q
+    qg = q.reshape(Tp, KV, G, Hd)
+    block_rows = _ragged_block_rows(q_begins.astype(jnp.int32),
+                                    q_lens.astype(jnp.int32), nb, block_q)
+
+    page_specs, scratch = _page_specs_scratch(
+        page_size, Hd, k_pages.dtype, v_pages.dtype, quantized, heads=KV)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(S, nb),
+        in_specs=[
+            pl.BlockSpec(
+                (block_q, KV, G, Hd), lambda s, t, *_: (t, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            *page_specs,
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (cpp, block_q, KV, G, Hd),
+                lambda s, t, *_: (s, t, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (cpp, block_q, KV, G), lambda s, t, *_: (s, t, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (cpp, block_q, KV, G), lambda s, t, *_: (s, t, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _ragged_kernel_kvsplit,
+        block_q=block_q, page_size=page_size, sm_scale=sm_scale,
+        quantized=quantized, window=window,
+        chunk_pages=chunk_pages, chunks_per_prog=cpp,
+    )
+    operands = [page_tables.astype(jnp.int32), row_starts.astype(jnp.int32),
+                q_begins.astype(jnp.int32), q_lens.astype(jnp.int32),
+                block_rows, layer_arr, qg, k_pages, v_pages]
+    if quantized:
+        operands += [k_scales, v_scales]
+    # the split axis carries no cross-program dependency (each program
+    # owns distinct chunk blocks): declare it parallel so Mosaic may
+    # partition it across cores where the part exposes more than one
+    # (megacore generations); ignored in interpret mode, harmless on a
+    # single-TensorCore v5e, where the win is the per-program page
+    # chains pipelining instead of one serial chain
+    extra = {}
+    if hasattr(pltpu, "TPUCompilerParams"):
+        extra["compiler_params"] = pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    acc_p, m_p, l_p = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((chunks, Tp, KV, G, Hd), jnp.float32),
+            jax.ShapeDtypeStruct((chunks, Tp, KV, G), jnp.float32),
+            jax.ShapeDtypeStruct((chunks, Tp, KV, G), jnp.float32),
+        ),
+        interpret=interpret,
+        **extra,
+    )(*operands)
+    # the cross-chunk combine: a strict left-to-right fold at the fixed
+    # chunk granularity (bit-identical whatever kv_splits computed the
+    # partials).  Empty chunks merge as exact identities — alpha =
+    # exp(0.0) = 1.0 and beta = exp(-inf) = +0.0 — preserving the
+    # masked-page algebra; the double--inf lane (no live pages at all)
+    # is the only case needing the `dead` guard (-inf minus -inf is
+    # NaN), and it reduces to the single-walk epilogue's 0 / 1e-20.
+    m, l, acc = m_p[0], l_p[0], acc_p[0]
+    for c in range(1, chunks):
+        m_new = jnp.maximum(m, m_p[c])
+        dead = m_new == -jnp.inf
+        alpha = jnp.where(dead, 0.0, jnp.exp(m - m_new))
+        beta = jnp.where(dead, 0.0, jnp.exp(m_p[c] - m_new))
+        l = alpha * l + beta * l_p[c]
+        acc = alpha[..., None] * acc + beta[..., None] * acc_p[c]
+        m = m_new
+    out = (acc / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
     return out.reshape(Tp, H * Hd)[:T]
 
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 from fusioninfer_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh
 
@@ -36,6 +37,7 @@ from fusioninfer_tpu.ops.paged_attention import (
     paged_prefill_attention,
     paged_verify_attention,
     ragged_paged_attention,
+    ragged_paged_attention_kvsplit,
 )
 from fusioninfer_tpu.parallel import sharding as _sharding
 from fusioninfer_tpu.parallel.axes import default_rules
@@ -150,11 +152,14 @@ def ragged_paged_attention_tp(
     interpret: bool = False,
     window: int | None = None,
     coalesce: bool | None = None,  # resolved by the engine per call
+    kv_splits: int = 0,  # flash-decode KV-split grid; 0 = single walk
     layer: jax.Array | int | None = None,
 ) -> jax.Array:
     """Per-shard ragged paged attention → [T, H·Hd] sharded on features.
     The row descriptors are replicated (they index tokens and pages, not
-    heads); each shard runs the one ragged kernel on its local heads."""
+    heads); each shard runs the one ragged kernel on its local heads —
+    the KV-split grid included, whose split axis is page-parallel and
+    therefore orthogonal to the head sharding."""
     k_pages, v_pages, k_scale, v_scale, layer = _as_stacked(
         k_pages, v_pages, k_scale, v_scale, layer)
     in_specs = [
@@ -175,6 +180,10 @@ def ragged_paged_attention_tp(
 
     def run(q, kp, vp, pt, rs, qb, ql, l, *scales):
         ks, vs = scales if scales else (None, None)
+        if kv_splits > 0:
+            return ragged_paged_attention_kvsplit(
+                q, kp, vp, pt, rs, qb, ql, ks, vs, kv_splits=kv_splits,
+                interpret=interpret, window=window, layer=l)
         return ragged_paged_attention(q, kp, vp, pt, rs, qb, ql, ks, vs,
                                       interpret=interpret, window=window,
                                       coalesce=coalesce, layer=l)
@@ -187,6 +196,75 @@ def ragged_paged_attention_tp(
         check_vma=False,
     )
     return fn(*args)
+
+
+def lm_head_topk_tp(
+    mesh: Mesh,
+    h: jax.Array,  # [N, D] hidden states — replicated
+    head,  # vocab-sharded head operand: lm_head [D, V] (vocab over tp)
+    #        or the tied [V, D] embed table (vocab rows over tp); either
+    #        may be the quantized {"_q8", "_scale"} dict
+    token_counts: jax.Array,  # [N, V] — vocab axis sharded over tp
+    output_counts: jax.Array,
+    presence: jax.Array,  # [N] replicated
+    frequency: jax.Array,
+    repetition: jax.Array,
+    early: jax.Array,  # [N] bool replicated
+    suppress: jax.Array,  # [N, V] — vocab axis sharded over tp
+    *,
+    tied: bool,
+    k: int | None = None,
+    block_v: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Vocab-parallel fused lm_head→top-k → replicated ``(vals [N, k],
+    idx [N, k])``.  Each shard runs :func:`ops.lm_head_topk.lm_head_topk`
+    over its local vocab columns, rebases its candidate ids to global,
+    and the shards merge with a collective top-k: the all_gather
+    concatenates candidate lists in shard order — lower vocab indices
+    first, preserving the lower-index tie contract — so the merged set
+    is bit-identical to the single-device candidates (selection under a
+    strict total order is merge-tree independent)."""
+    from fusioninfer_tpu.ops.lm_head_topk import (
+        LM_HEAD_BLOCK_V,
+        LM_HEAD_TOPK,
+        lm_head_topk,
+    )
+
+    k = LM_HEAD_TOPK if k is None else k
+    block_v = LM_HEAD_BLOCK_V if block_v is None else block_v
+    row = _RULES.spec("rows")
+    hidden_spec = _RULES.spec("rows", "embed")  # replicated (embed unsharded)
+    vocab_cols = _RULES.spec("rows", "vocab")  # [N, V] vocab over tp
+    w_axes = ("vocab", "embed") if tied else ("embed", "vocab")
+    s_axes = ("vocab", None) if tied else (None, "vocab")
+    if isinstance(head, dict):
+        head_spec = {"_q8": _RULES.spec(*w_axes), "_scale": _RULES.spec(*s_axes)}
+    else:
+        head_spec = _RULES.spec(*w_axes)
+    tp = mesh.shape["tp"]
+
+    def run(h, head, tc, oc, pres, freq, rep, early, sup):
+        vals, idx = lm_head_topk(h, head, tc, oc, pres, freq, rep, early,
+                                 sup, tied=tied, k=k, block_v=block_v)
+        idx = idx + jax.lax.axis_index("tp") * tc.shape[1]
+        allv = jax.lax.all_gather(vals, "tp")  # [tp, N, k] shard order
+        alli = jax.lax.all_gather(idx, "tp")
+        n = vals.shape[0]
+        mv = jnp.moveaxis(allv, 0, 1).reshape(n, tp * vals.shape[1])
+        mi = jnp.moveaxis(alli, 0, 1).reshape(n, tp * vals.shape[1])
+        sv, si = jax.lax.top_k(mv, min(k, mv.shape[1]))
+        return sv, jnp.take_along_axis(mi, si, axis=1)
+
+    fn = shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(hidden_spec, head_spec, vocab_cols, vocab_cols, row,
+                  row, row, row, vocab_cols),
+        out_specs=(_RULES.spec("rows", None), _RULES.spec("rows", None)),
+        check_vma=False,
+    )
+    return fn(h, head, token_counts, output_counts, presence, frequency,
+              repetition, early, suppress)
 
 
 def paged_prefill_attention_tp(

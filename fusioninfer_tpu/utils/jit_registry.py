@@ -43,22 +43,27 @@ from __future__ import annotations
 # footprint plus bounded headroom — small enough that one stray
 # signature family (a shape that skipped its bucket, a weak-type flip,
 # an env knob resolved at trace time) trips the gate.  Measured on this
-# round's fast tier: kernels 32, sampler 24, fused 21, prefill 17,
-# engine-helpers ~8, decode/verify/model 0 — the evacuation suite
-# (tests/test_evacuation.py, mandated into the fast tier by the
-# spot-revocation PR) drives real victim/survivor engine forwards and
-# grew fused/prefill/sampler accordingly, even with its cache/batch
-# shapes aligned to the pre-existing fast suites' signatures.  A breach
-# means find the retrace, or grow the budget HERE in the same diff that
-# grows the tier — never silently.
+# round's fast tier: kernels 32, sampler 26, fused 26, prefill 17,
+# kvsplit 12, engine-helpers 7, decode/verify 0 — the flash-decode PR
+# grew fused (the decode_hidden fused-sampling variants beside the
+# logits variants), sampler (sample_topk + lm_head_topk + the "topk"
+# sample mode) and added the kvsplit family (the split-count axis of
+# test_paged_attention's invariance grid).  A breach means find the
+# retrace, or grow the budget HERE in the same diff that grows the
+# tier — never silently.
 FAMILY_BUDGETS: dict[str, int] = {
     "decode": 16,
     "prefill": 24,
     "verify": 12,
-    "fused": 28,
-    "sampler": 30,
+    "fused": 36,
+    "sampler": 40,
     "engine-helpers": 12,
     "kernels": 48,
+    # the flash-decode KV-split kernel (r15): split-count × shape
+    # signatures from the kernel/engine bit-identity grids; measured 12
+    # on this round's fast tier (the header's per-family line is the
+    # same measurement)
+    "kvsplit": 20,
     "model": 12,
 }
 
@@ -75,7 +80,7 @@ ENTRY_POINTS: dict[str, dict] = {
         "kind": "jit",
         "family": "prefill",
         "static_argnums": (0, 1),
-        "static_argnames": ("mesh", "coalesce"),
+        "static_argnames": ("mesh", "coalesce", "kv_splits"),
         "runtime": "fusioninfer_tpu.engine.model_runner:prefill_suffix",
     },
     "fusioninfer_tpu/engine/model_runner.py::decode_step": {
@@ -83,14 +88,15 @@ ENTRY_POINTS: dict[str, dict] = {
         "family": "decode",
         "impl": "_decode_step_impl",
         "static_argnums": (0, 1),
-        "static_argnames": ("mesh", "coalesce"),
+        "static_argnames": ("mesh", "coalesce", "kv_splits"),
         "runtime": "fusioninfer_tpu.engine.model_runner:decode_step",
     },
     "fusioninfer_tpu/engine/model_runner.py::decode_burst": {
         "kind": "jit",
         "family": "decode",
         "static_argnums": (0, 1),
-        "static_argnames": ("mesh", "n_steps", "sample_mode", "coalesce"),
+        "static_argnames": ("mesh", "n_steps", "sample_mode", "coalesce",
+                            "kv_splits"),
         "runtime": "fusioninfer_tpu.engine.model_runner:decode_burst",
     },
     "fusioninfer_tpu/engine/model_runner.py::verify_step": {
@@ -98,14 +104,14 @@ ENTRY_POINTS: dict[str, dict] = {
         "family": "verify",
         "impl": "_window_forward_impl",
         "static_argnums": (0, 1),
-        "static_argnames": ("mesh", "last_only", "coalesce"),
+        "static_argnames": ("mesh", "last_only", "coalesce", "kv_splits"),
         "runtime": "fusioninfer_tpu.engine.model_runner:verify_step",
     },
     "fusioninfer_tpu/engine/model_runner.py::fused_step": {
         "kind": "jit",
         "family": "fused",
         "static_argnums": (0, 1),
-        "static_argnames": ("mesh", "coalesce"),
+        "static_argnames": ("mesh", "coalesce", "kv_splits", "decode_hidden"),
         "runtime": "fusioninfer_tpu.engine.model_runner:fused_step",
     },
     # -- engine/sampler.py: the device sampling chain -------------------
@@ -122,6 +128,13 @@ ENTRY_POINTS: dict[str, dict] = {
         "static_argnums": (),
         "static_argnames": ("mode",),
         "runtime": "fusioninfer_tpu.engine.sampler:sample",
+    },
+    "fusioninfer_tpu/engine/sampler.py::sample_topk": {
+        "kind": "jit",
+        "family": "sampler",
+        "static_argnums": (),
+        "static_argnames": ("mode",),
+        "runtime": "fusioninfer_tpu.engine.sampler:sample_topk",
     },
     "fusioninfer_tpu/engine/sampler.py::spec_window_draws": {
         "kind": "jit",
@@ -236,6 +249,22 @@ ENTRY_POINTS: dict[str, dict] = {
         "runtime": "fusioninfer_tpu.ops.paged_attention:"
                    "ragged_paged_attention",
     },
+    "fusioninfer_tpu/ops/paged_attention.py::ragged_paged_attention_kvsplit": {
+        "kind": "jit",
+        "family": "kvsplit",
+        "static_argnums": (),
+        "static_argnames": ("sm_scale", "interpret", "window", "block_q",
+                            "kv_splits"),
+        "runtime": "fusioninfer_tpu.ops.paged_attention:"
+                   "ragged_paged_attention_kvsplit",
+    },
+    "fusioninfer_tpu/ops/lm_head_topk.py::lm_head_topk": {
+        "kind": "jit",
+        "family": "sampler",
+        "static_argnums": (),
+        "static_argnames": ("tied", "k", "block_v"),
+        "runtime": "fusioninfer_tpu.ops.lm_head_topk:lm_head_topk",
+    },
     "fusioninfer_tpu/ops/flash_attention.py::flash_attention": {
         "kind": "jit",
         "family": "kernels",
@@ -259,6 +288,11 @@ ENTRY_POINTS: dict[str, dict] = {
     "fusioninfer_tpu/ops/sharded.py::ragged_paged_attention_tp": {
         "kind": "shard_map",
         "family": "kernels",
+        "runtime": None,
+    },
+    "fusioninfer_tpu/ops/sharded.py::lm_head_topk_tp": {
+        "kind": "shard_map",
+        "family": "sampler",
         "runtime": None,
     },
     "fusioninfer_tpu/ops/sharded.py::paged_prefill_attention_tp": {

@@ -323,9 +323,34 @@ class TestBenchRecordChecker:
             "padded_rect": {"calls_per_s": 5.0, "rel_iqr": 0.01},
             "ragged_vs_gather": 2.0, "ragged_vs_padded": 2.0,
             "mfu_box": 0.3,
-        }, "http": {
+            "longctx": {
+                "kvsplit_vs_singlewalk": 2.1,
+                "kvsplit_kernel_ok": True,
+                "contexts": {
+                    "4096": {"singlewalk": {"calls_per_s": 9.0,
+                                            "rel_iqr": 0.02},
+                             "kvsplit": {"calls_per_s": 19.0,
+                                         "rel_iqr": 0.02},
+                             "kvsplit_vs_singlewalk": 2.1},
+                    "32768": {"singlewalk": {"calls_per_s": 1.0,
+                                             "rel_iqr": 0.02},
+                              "kvsplit": {"calls_per_s": 2.1,
+                                          "rel_iqr": 0.02},
+                              "kvsplit_vs_singlewalk": 2.1},
+                },
+            },
+        }, "config_ladder": [
+            {"model": "qwen3-1.7b", "quantization": "none",
+             "fits_v5e_16gib": True, "dry_run": True},
+            {"model": "qwen3-8b", "quantization": "int8",
+             "weights_gib": 7.63, "fits_v5e_16gib": True,
+             "dry_run": True},
+        ], "http": {
             "ceiling_fraction": 0.4,
             "weight_passes_per_step": 1.05,
+            "fused_sampling": {"enabled": True, "steps": 120,
+                               "load_top_k": 40, "rides_burst": False},
+            "decode_burst": 1,
             "queue_wait_ms": {"p50": 1.0, "p90": 2.0, "max": 3.0},
             "scheduler": {"token_budget": 64, "budget_utilization": 0.5,
                           "burst_span_steps": {"1": 3},
@@ -483,11 +508,68 @@ class TestBenchRecordChecker:
     def test_decode_only_run_is_exempt(self):
         """BENCH_SKIP_HTTP=1 records have no http leg by design — the
         checker must not fail the http fields on them; an errored bench
-        still flags, and the kernel microbench is required regardless."""
+        still flags, and the kernel microbench + config ladder are
+        required regardless (both run before the http legs)."""
         from tools.check_bench_record import check_record
 
         assert check_record(
             {"value": 1.0,
-             "kernel_microbench": self._good()["kernel_microbench"]}) == []
+             "kernel_microbench": self._good()["kernel_microbench"],
+             "config_ladder": self._good()["config_ladder"]}) == []
         assert check_record({"error": "boom"}) == ["bench errored: boom"]
-        assert check_record({"value": 1.0}) == ["kernel_microbench leg missing"]
+        assert check_record({"value": 1.0}) == [
+            "kernel_microbench leg missing", "config_ladder missing"]
+
+    def test_longctx_stratum_gated(self):
+        """The flash-decode leg (r15): the longctx stratum must be
+        present with the 32k shape, a >= 1 speedup, dispersion on both
+        legs, and the kernel-agreement probe green."""
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        del rec["kernel_microbench"]["longctx"]
+        assert any("longctx stratum missing" in p for p in
+                   check_record(rec))
+        rec = self._good()
+        rec["kernel_microbench"]["longctx"]["kvsplit_vs_singlewalk"] = 0.9
+        assert any("kvsplit_vs_singlewalk" in p for p in
+                   check_record(rec))
+        rec = self._good()
+        del rec["kernel_microbench"]["longctx"]["contexts"]["32768"]
+        assert any("32768" in p for p in check_record(rec))
+        rec = self._good()
+        del rec["kernel_microbench"]["longctx"]["contexts"]["4096"][
+            "kvsplit"]["rel_iqr"]
+        assert any("dispersion" in p for p in check_record(rec))
+        rec = self._good()
+        rec["kernel_microbench"]["longctx"]["kvsplit_kernel_ok"] = False
+        assert any("kvsplit_kernel_ok" in p for p in check_record(rec))
+
+    def test_config_ladder_gated(self):
+        """The README's Qwen3-8B-int8 rung must exist and fit a v5e."""
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        rec["config_ladder"] = [rec["config_ladder"][0]]
+        assert any("qwen3-8b int8 rung" in p for p in check_record(rec))
+        rec = self._good()
+        rec["config_ladder"][1]["fits_v5e_16gib"] = False
+        assert any("fit a 16 GiB" in p for p in check_record(rec))
+
+    def test_fused_sampling_evidence_gated(self):
+        """A burst-1 engine with fused sampling enabled must have
+        sampled through the fused path; burst engines are exempt (their
+        in-scan sampler is a different animal)."""
+        from tools.check_bench_record import check_record
+
+        rec = self._good()
+        del rec["http"]["fused_sampling"]
+        assert any("fused_sampling evidence missing" in p
+                   for p in check_record(rec))
+        rec = self._good()
+        rec["http"]["fused_sampling"]["steps"] = 0
+        assert any("fused_sampling.steps" in p for p in check_record(rec))
+        rec = self._good()
+        rec["http"]["fused_sampling"]["steps"] = 0
+        rec["http"]["decode_burst"] = 8
+        assert not any("fused_sampling" in p for p in check_record(rec))

@@ -451,3 +451,57 @@ class TestRaggedPagedAttentionHW:
             q[2:3], kp, vp, tables[2:3], starts[2:3],
             jnp.zeros((1,), jnp.int32), ql[2:3], interpret=False))
         np.testing.assert_array_equal(solo[0], mixed[2])
+
+
+class TestKVSplitHW:
+    """The flash-decode KV-split grid (r15 tentpole) with
+    interpret=False: the split grid's multi-output partial blocks must
+    COMPILE under Mosaic, agree with the single walk numerically, and
+    keep the split-count bit-identity + offset invariance the CPU tier
+    pins in interpret mode."""
+
+    def test_split_grid_bench_shapes_bf16(self):
+        from fusioninfer_tpu.ops.paged_attention import (
+            ragged_paged_attention,
+            ragged_paged_attention_kvsplit,
+        )
+
+        helper = TestRaggedPagedAttentionHW()
+        q, kp, vp, tables, starts, qb, ql = helper._ragged(
+            q_lens=[1, 1, 0, 1, 1], starts=[1015, 129, 0, 500, 7],
+            seed=41)
+        outs = {}
+        for s in (1, 2, 8):
+            o = ragged_paged_attention_kvsplit(
+                q, kp, vp, tables, starts, qb, ql, kv_splits=s,
+                interpret=False)
+            o.block_until_ready()
+            outs[s] = np.asarray(o, np.float32)
+        # split-count bit-identity holds on hardware, not just in
+        # interpret mode (the fixed-chunk construction is dtype- and
+        # backend-agnostic, but Mosaic lowering must prove it)
+        np.testing.assert_array_equal(outs[2], outs[1])
+        np.testing.assert_array_equal(outs[8], outs[1])
+        base = np.asarray(ragged_paged_attention(
+            q, kp, vp, tables, starts, qb, ql, interpret=False),
+            np.float32)
+        np.testing.assert_allclose(outs[1], base, atol=5e-2, rtol=5e-2)
+
+    def test_offset_invariance_bits_kvsplit(self):
+        """The interpret=False twin of the split-axis extension of
+        test_offset_and_neighbor_invariance_bit_identity."""
+        from fusioninfer_tpu.ops.paged_attention import (
+            ragged_paged_attention_kvsplit,
+        )
+
+        helper = TestRaggedPagedAttentionHW()
+        q, kp, vp, tables, starts, qb, ql = helper._ragged(
+            q_lens=[1, 1, 1, 1], starts=[129, 7, 500, 1015], seed=43)
+        mixed = np.asarray(ragged_paged_attention_kvsplit(
+            q, kp, vp, tables, starts, qb, ql, kv_splits=4,
+            interpret=False))
+        solo = np.asarray(ragged_paged_attention_kvsplit(
+            q[2:3], kp, vp, tables[2:3], starts[2:3],
+            jnp.zeros((1,), jnp.int32), ql[2:3], kv_splits=4,
+            interpret=False))
+        np.testing.assert_array_equal(solo[0], mixed[2])

@@ -84,6 +84,7 @@ class _EngineStub:
     generation_tokens_total = 20
     spec_proposed_total = 0
     spec_accepted_total = 0
+    fused_sampling_steps_total = 0
     preemptions_total = 0
     finished_total = 3
     errors_total = 1

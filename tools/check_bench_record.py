@@ -42,6 +42,57 @@ def check_record(record: dict) -> list[str]:
         if not isinstance(ragged, dict) or "rel_iqr" not in ragged:
             problems.append(
                 "kernel_microbench.ragged dispersion (rel_iqr) missing")
+        # flash-decode longctx stratum (r15): the KV-split grid's
+        # evidence leg must be present at every context depth with
+        # dispersion, and the kvsplit schedule must never LOSE to the
+        # single walk (the acceptance target is >= 2x at 32k; the gate
+        # floors at >= 1 so a regressed-but-plausible record still
+        # fails loudly rather than hiding the leg)
+        lc = micro.get("longctx")
+        if not isinstance(lc, dict):
+            problems.append("kernel_microbench.longctx stratum missing")
+        elif lc.get("error"):
+            problems.append(f"kernel_microbench.longctx errored: "
+                            f"{lc['error']}")
+        else:
+            ratio = lc.get("kvsplit_vs_singlewalk")
+            if not isinstance(ratio, (int, float)) or ratio < 1.0:
+                problems.append(
+                    "kernel_microbench.longctx.kvsplit_vs_singlewalk "
+                    f"must be >= 1, got {ratio!r}")
+            ctxs = lc.get("contexts")
+            if not isinstance(ctxs, dict) or "32768" not in ctxs:
+                problems.append(
+                    "kernel_microbench.longctx.contexts must include "
+                    "the 32768 decode shape")
+            else:
+                for depth, entry in ctxs.items():
+                    for leg_name in ("singlewalk", "kvsplit"):
+                        if "rel_iqr" not in (entry.get(leg_name) or {}):
+                            problems.append(
+                                f"kernel_microbench.longctx.contexts."
+                                f"{depth}.{leg_name} dispersion missing")
+            if lc.get("kvsplit_kernel_ok") is not True:
+                problems.append(
+                    "kernel_microbench.longctx.kvsplit_kernel_ok must "
+                    f"be true, got {lc.get('kvsplit_kernel_ok')!r}")
+    # serving config ladder (r15): the README's Qwen3-8B-int8 rung must
+    # exist with its memory-fit arithmetic asserted (VERDICT weak #3/#4:
+    # the claim had never been measured NOR sized in-record)
+    ladder = record.get("config_ladder")
+    if not isinstance(ladder, list):
+        problems.append("config_ladder missing")
+    else:
+        rung8b = [r for r in ladder
+                  if r.get("model") == "qwen3-8b"
+                  and r.get("quantization") == "int8"]
+        if not rung8b:
+            problems.append("config_ladder lacks the qwen3-8b int8 rung")
+        elif rung8b[0].get("fits_v5e_16gib") is not True:
+            problems.append(
+                "config_ladder qwen3-8b int8 rung must fit a 16 GiB "
+                f"v5e (fits_v5e_16gib={rung8b[0].get('fits_v5e_16gib')!r}, "
+                f"weights={rung8b[0].get('weights_gib')!r} GiB)")
     http = record.get("http")
     if not isinstance(http, dict):
         # a decode-only run (BENCH_SKIP_HTTP=1) is exempt from the http
@@ -52,6 +103,18 @@ def check_record(record: dict) -> list[str]:
     if "weight_passes_per_step" not in http:
         problems.append(
             "http.weight_passes_per_step (fused-step evidence) missing")
+    # fused-sampling evidence (r15): the http leg's load rides bounded
+    # top-k, so ceiling_fraction is measured ON the fused lm_head→top-k
+    # path — the leg must say so, and a burst-1 engine with the path
+    # enabled must demonstrably have sampled through it
+    fs = http.get("fused_sampling")
+    if not isinstance(fs, dict):
+        problems.append("http.fused_sampling evidence missing")
+    elif (fs.get("enabled") and http.get("decode_burst") == 1
+          and not fs.get("steps")):
+        problems.append(
+            "http.fused_sampling.steps must be nonzero on a burst-1 "
+            f"engine with the path enabled, got {fs.get('steps')!r}")
     sched = http.get("scheduler")
     if not isinstance(sched, dict):
         problems.append("http.scheduler missing")

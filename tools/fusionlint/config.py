@@ -37,6 +37,7 @@ WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     # dispatch code would latch a value per compiled signature and
     # silently desynchronize retraces (timing belongs to bench.py)
     "fusioninfer_tpu/ops/paged_attention.py": ("time", "sleep"),
+    "fusioninfer_tpu/ops/lm_head_topk.py": ("time", "sleep"),
     "fusioninfer_tpu/ops/dispatch.py": ("time", "sleep"),
     # the engine step loop runs on an injectable clock (NativeEngine
     # clock=..., PR 7's guided-composition deflake): inline
@@ -88,6 +89,9 @@ RENDER_PURE_MODULES = [
     # function bodies — env knobs resolve in ops/dispatch.py module
     # scope or are passed in by the engine
     "fusioninfer_tpu/ops/paged_attention.py",
+    # the fused-sampling projection's bit-identity contract (blocked
+    # candidates == full top_k) rides the same determinism discipline
+    "fusioninfer_tpu/ops/lm_head_topk.py",
     "fusioninfer_tpu/engine/fused.py",
     "fusioninfer_tpu/operator/render.py",
     "fusioninfer_tpu/workload/lws.py",
@@ -170,6 +174,9 @@ HOST_SYNC_MODULES: dict[str, tuple[str, ...]] = {
     "fusioninfer_tpu/engine/engine.py": (
         "_consume_inflight",       # THE dispatch-ahead fetch point
         "_decode_finish",          # step tail: sampled tokens fetch
+        "_decode_finish_fused",    # fused-sampling step tail: the
+        #                            candidate draw's token fetch (same
+        #                            designed blocking point)
         "_spec_draws",             # spec-decode acceptance draws fetch
         "_sample_first_token",     # admission sampling: the non-deferred
         #                            branch IS the fetch (guided/bias rows
@@ -200,6 +207,7 @@ HOST_SYNC_MODULES: dict[str, tuple[str, ...]] = {
     # work lives in engine.py (_park_preempted → the tier's _store)
     "fusioninfer_tpu/engine/evacuate.py": (),
     "fusioninfer_tpu/ops/paged_attention.py": (),
+    "fusioninfer_tpu/ops/lm_head_topk.py": (),
     "fusioninfer_tpu/ops/dispatch.py": (),
     "fusioninfer_tpu/ops/sharded.py": (),
     # the revived TP surfaces (PR 6): a stray fetch in the SPMD-lockstep
