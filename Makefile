@@ -86,7 +86,7 @@ bench-smoke: ## CPU bench smoke + record gates: ceiling_fraction/scheduler field
 	$(PYTHON) tools/check_bench_record.py BENCH_OUT.json
 
 .PHONY: fleet-smoke
-fleet-smoke: ## Closed-loop fleet smoke (CPU, 3 engines + PD pair): real manager+engines+EPP+autoscaler through steady/scale-up/OVERLOAD/REVOCATION/faults/recover/drain; record gated (SLO-tier shed + preempt/park/resume, spot revocation waves w/ evacuation + survivor resume).
+fleet-smoke: ## Closed-loop fleet smoke (CPU, 3 engines + PD pair): real manager+engines+EPP+autoscaler through steady/PD-fabric/scale-up/OVERLOAD/REVOCATION/faults/recover/drain; record gated (SLO-tier shed + preempt/park/resume, spot revocation waves w/ evacuation + survivor resume, layer-streamed PD overlap >= 0.5 + cross-engine prefix pull).
 	$(PYTHON) bench.py --fleet-smoke --out FLEET_OUT.json
 	$(PYTHON) tools/check_fleet_record.py FLEET_OUT.json
 

@@ -317,6 +317,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--prefill-upstream", default="",
         help="PD decode role: pull prefills (KV over DCN) from this prefiller URL",
     )
+    serve.add_argument("--kv-stream", action=argparse.BooleanOptionalAction,
+                       default=True,
+                       help="layer-streamed PD transfer: adopt KV pages "
+                            "frame-by-frame WHILE the prefiller computes "
+                            "later chunks (--no-kv-stream restores the "
+                            "whole-slab transfer; "
+                            "docs/design/pd-disaggregation.md)")
+    serve.add_argument("--kv-peer", action="append", default=[],
+                       metavar="URL",
+                       help="peer base URL whose host KV tier this engine "
+                            "may pull missing prefix blocks from "
+                            "(repeatable) — the fleet's host tiers act as "
+                            "one distributed prefix cache "
+                            "(docs/design/kv-hierarchy.md)")
     serve.add_argument("--aot-warmup", action=argparse.BooleanOptionalAction,
                        default=True,
                        help="AOT-build (or load) the compiled-executable "

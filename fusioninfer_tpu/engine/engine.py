@@ -42,7 +42,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -165,6 +165,18 @@ class _SeqState:
     @property
     def n_generated(self) -> int:
         return len(self.tokens) - self.n_prompt
+
+
+@dataclass
+class _StreamAdmitState:
+    """Engine-thread bookkeeping for one in-flight streamed PD
+    admission: pages are allocated at first KV frame (before the meta
+    frame lands), the assembler tracks coverage/overlap, and frames
+    that arrive under page pressure buffer for the next step."""
+
+    pages: Optional[list[int]] = None
+    assembler: Optional[object] = None  # kv_fabric.SlabAssembler
+    pending: list = field(default_factory=list)
 
 
 # -- jitted decode-loop helpers ----------------------------------------------
@@ -479,12 +491,21 @@ class NativeEngine:
                     "host_kv_tier requires enable_prefix_caching (the "
                     "tier is keyed by the prefix cache's block hashes)")
             if self._mh is not None:
-                raise ValueError(
-                    "host_kv_tier is single-process only: offload/"
-                    "restore timing is process-local and would diverge "
-                    "the multi-host SPMD lockstep")
+                # leader-coordinated multi-process mode (PR 17, was a
+                # refusal): offloads fire at replicated reclaim points
+                # with the page slab host-gathered via a mesh collective
+                # (every process's tier stores the same bytes), restore
+                # PLANS are computed on the leader and broadcast with
+                # the frame bytes attached, so every process executes
+                # the same H2D schedule and SPMD lockstep survives.
+                # Tier visibility must not ride a process-local worker's
+                # timing, so offload commits go synchronous.
+                host_kv_tier.make_synchronous()
             self._host_tier = host_kv_tier
             self.alloc.on_reclaim = self._offload_page
+        # cross-engine prefix pull (engine/kv_fabric.py): wired by the
+        # server when peers/resolver are configured
+        self._kv_fabric = None
         self.buckets = prefill_buckets(self.cache_cfg.max_len)
         self._key = jax.random.key(seed + 1)
         self._step_counter = itertools.count()
@@ -505,11 +526,25 @@ class NativeEngine:
         self.waiting_prefilled: collections.deque[tuple[Request, "KVSlab"]] = (
             collections.deque()
         )
-        # PD prefill side: slab requests served inside step() so only the
-        # engine thread ever touches the cache
-        self._slab_q: "queue_mod.Queue[tuple[Request, concurrent.futures.Future]]" = (
+        # PD prefill side: slab/stream requests served inside step() so
+        # only the engine thread ever touches the cache; entries are
+        # (request, future, sink) — sink None for whole-slab service,
+        # else the per-frame byte sink of a layer-streamed prefill
+        self._slab_q: "queue_mod.Queue[tuple[Request, concurrent.futures.Future, Optional[Callable]]]" = (
             queue_mod.Queue()
         )
+        # PD decode side, streamed: request_id -> (request, intake,
+        # admission state); frames drain inside step() and pages are
+        # adopted as they land (engine/kv_fabric.py)
+        self._stream_intakes: dict[str, tuple] = {}
+        self._stream_order: list[str] = []
+        # fabric stream/pull observability (rendered via /metrics)
+        self.kv_stream_frames_total = 0
+        self.kv_stream_bytes_total = 0
+        self.kv_stream_overlapped_bytes_total = 0
+        self.kv_stream_admissions_total = 0
+        self.kv_stream_fallbacks_total = 0
+        self.kv_fabric_restored_blocks_total = 0
         # PD × multi-process: slab prefills ride the admission event
         # broadcast so every process runs the SAME jitted prefill +
         # gather collectives; the deque is replayed identically
@@ -997,7 +1032,7 @@ class NativeEngine:
             self.waiting or self.waiting_prefilled or self.running
             or self.prefilling or not self._slab_q.empty()
             or self._pd_pending or self._embed_pending
-            or not self._embed_q.empty()
+            or not self._embed_q.empty() or self._stream_intakes
         )
 
     def request_embedding(self, prompt_tokens: list[int]) -> concurrent.futures.Future:
@@ -1132,8 +1167,68 @@ class NativeEngine:
             ev["type"] = "prefill_slab"
             self._mh.queue(ev)
             return fut
-        self._slab_q.put((request, fut))
+        self._slab_q.put((request, fut, None))
         return fut
+
+    def set_kv_fabric(self, fabric) -> None:
+        """Wire the cross-engine pull client
+        (:class:`fusioninfer_tpu.engine.kv_fabric.KVFabric`): host-tier
+        misses in ``_restore_host_blocks`` then consult the fleet before
+        falling back to recompute."""
+        self._kv_fabric = fabric
+
+    def request_prefill_stream(self, request: Request,
+                               sink: Callable[[bytes], None]
+                               ) -> concurrent.futures.Future:
+        """Prefill-worker side, layer-streamed: like
+        :meth:`request_prefill_slab`, but completed KV leaves as
+        per-(layer, page-range) fabric frames pushed through ``sink``
+        DURING the chunked forward — the transfer overlaps the
+        remaining prefill compute instead of serializing after it.
+        ``sink`` is called on the engine thread with serialized frame
+        bytes; the future resolves to the frame count.
+
+        Single-process only: a multi-process mesh's slab is sharded
+        across hosts and must host-gather via a collective before any
+        byte leaves, which serializes exactly what streaming hides —
+        those meshes keep the slab path (the server falls back)."""
+        if self._mh is not None:
+            raise ValueError(
+                "streamed prefill is single-process; multi-process "
+                "meshes serve whole slabs (the KV is host-gathered via "
+                "a mesh collective)")
+        if request.lora:
+            self._adapter_id(request)  # unknown adapter: client error NOW
+        self._validate_guided(request)
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._slab_q.put((request, fut, sink))
+        return fut
+
+    def add_prefilled_stream(self, request: Request, intake) -> None:
+        """Decode-worker side, layer-streamed: register an intake whose
+        frames a server thread feeds as they leave the socket; the
+        engine adopts pages frame-by-frame inside :meth:`step` and
+        activates the sequence when the stream assembles complete.  Any
+        stream fault falls back to a local re-prefill of the same
+        request — bit-identical output, only the TTFT differs."""
+        if self._mh is not None:
+            raise ValueError(
+                "streamed PD admission is single-process; multi-process "
+                "decode meshes admit whole slabs over the event broadcast")
+        if request.lora:
+            self._adapter_id(request)
+        self._validate_guided(request)
+        if (len(request.prompt_tokens) + request.params.max_tokens
+                > self.cache_cfg.max_len):
+            raise ValueError("prompt+max_tokens exceeds engine max_len")
+        with self._lock:
+            if request.request_id in self._stream_intakes:
+                raise ValueError(
+                    f"stream for request_id {request.request_id!r} "
+                    "is already registered")
+            self._stream_intakes[request.request_id] = (
+                request, intake, _StreamAdmitState())
+            self._stream_order.append(request.request_id)
 
     def add_prefilled_request(self, request: Request, slab) -> None:
         """Decode-worker side: admit a request whose prefill (KV + first
@@ -1224,12 +1319,95 @@ class NativeEngine:
         self.prompt_tokens_total += len(prefix)
         return slab_to_host(slab, multiprocess=self._mh is not None)
 
+    def _stream_chunk_tokens(self) -> int:
+        """Streamed-prefill chunk size, page-aligned: completed pages
+        flush after every chunk, so the chunk IS the streaming grain.
+        Derived from the engine's prefill chunking when configured
+        (rounded to whole pages), else two pages — small enough that
+        most of a multi-page prompt's KV leaves during the forward."""
+        ps = self.cache_cfg.page_size
+        chunk = self.prefill_chunk if self.prefill_chunk else 2 * ps
+        return max(ps, (chunk // ps) * ps)
+
+    def _compute_slab_streamed(self, request: Request, sink) -> int:
+        """Prefill ``request`` in page-aligned chunks, pushing each
+        chunk's completed pages through ``sink`` as fabric frames WHILE
+        later chunks still run — the layer-streamed half of the KV
+        fabric.  Chunks ride ``_batched_window_forward`` (the one ragged
+        dispatch family; no new jit signatures).  Chunked windows can
+        reduce in a different order than the monolithic slab path's
+        single padded window, so the streamed KV may differ by an odd
+        bf16 ulp — the decoded outputs are verified identical either
+        way (greedy and seeded-sampled; ``tests/test_kv_fabric.py``).
+        Returns the number of frames pushed (KV frames + trailing meta)."""
+        from fusioninfer_tpu.engine import kv_fabric
+        from fusioninfer_tpu.engine.guided import machine_for
+        from fusioninfer_tpu.engine.kv_transfer import extract_slab
+
+        prefix = request.prompt_tokens
+        rid = request.request_id
+        ps = self.cache_cfg.page_size
+        self.alloc.allocate(rid, len(prefix))
+        seq = 0
+        try:
+            all_pages = self.alloc.pages_of(rid)
+            n_pages = len(all_pages)
+            chunk = self._stream_chunk_tokens()
+            sent_pages = 0
+            logits = None
+            start = 0
+            while start < len(prefix):
+                end = min(len(prefix), start + chunk)
+                logits = self._suffix_forward(
+                    request, prefix, start, end - start)
+                final = end >= len(prefix)
+                # frames for the pages this chunk completed; the final
+                # chunk's flush (and the possibly-partial last page)
+                # waits for the first-token sample below so the meta
+                # frame always trails
+                done_pages = n_pages if final else end // ps
+                if not final and done_pages > sent_pages:
+                    slab = extract_slab(
+                        self.cache, all_pages[sent_pages:done_pages],
+                        [], 0, ps)
+                    for frame in kv_fabric.split_slab(
+                            slab, rid, page_start=sent_pages,
+                            n_pages_total=n_pages, prompt_len=len(prefix),
+                            during_prefill=True, start_seq=seq):
+                        sink(kv_fabric.frame_to_bytes(frame))
+                        seq += 1
+                    sent_pages = done_pages
+                start = end
+            token = self._sample_first_token(
+                logits, request, prefix, self._request_seed(request),
+                machine=machine_for(request.params),
+            )
+            if sent_pages < n_pages:
+                slab = extract_slab(
+                    self.cache, all_pages[sent_pages:], [], 0, ps)
+                for frame in kv_fabric.split_slab(
+                        slab, rid, page_start=sent_pages,
+                        n_pages_total=n_pages, prompt_len=len(prefix),
+                        during_prefill=False, start_seq=seq):
+                    sink(kv_fabric.frame_to_bytes(frame))
+                    seq += 1
+            sink(kv_fabric.frame_to_bytes(kv_fabric.StreamFrame(
+                request_id=rid, seq=seq, n_layers=int(self.cache["k"].shape[0]),
+                n_pages=n_pages, page_size=ps, prompt_len=len(prefix),
+                meta=True, prompt_tokens=list(prefix), first_token=token,
+                n_frames=seq + 1)))
+            seq += 1
+        finally:
+            self.alloc.release(rid)
+        self.prompt_tokens_total += len(prefix)
+        return seq
+
     def _serve_slab_requests(self) -> None:
         if self._mh is not None:
             return self._serve_slab_requests_multihost()
         while True:
             try:
-                request, fut = self._slab_q.get_nowait()
+                request, fut, sink = self._slab_q.get_nowait()
             except queue_mod.Empty:
                 return
             prefix = request.prompt_tokens
@@ -1243,12 +1421,15 @@ class NativeEngine:
                 # transient pressure (pages held by running work): retry on
                 # the next step instead of failing the decoder's client.
                 # (The future stays pending, so the retry can still run it.)
-                self._slab_q.put((request, fut))
+                self._slab_q.put((request, fut, sink))
                 return
             if not fut.set_running_or_notify_cancel():
                 continue
             try:
-                fut.set_result(self._compute_slab(request))
+                if sink is not None:
+                    fut.set_result(self._compute_slab_streamed(request, sink))
+                else:
+                    fut.set_result(self._compute_slab(request))
             except Exception as e:
                 self.errors_total += 1
                 fut.set_exception(e)
@@ -1286,6 +1467,133 @@ class NativeEngine:
                 continue
             if fut is not None and not fut.done():
                 fut.set_result(slab)
+
+    def _admit_streamed(self) -> list[StepOutput]:
+        """Advance every in-flight streamed PD admission: drain parsed
+        frames from each intake, allocate pages at the FIRST frame,
+        inject each (layer, page-range) slice as it lands — page
+        adoption overlaps the remaining transfer — and activate the
+        sequence once the stream assembles complete.  Any fault
+        (transport error, corrupt frame, incomplete stream, protocol
+        violation) releases the pages and falls back to a local
+        re-prefill of the same request: bit-identical tokens, degraded
+        TTFT, never a corrupt page."""
+        if not self._stream_intakes:
+            return []
+        from fusioninfer_tpu.engine import kv_fabric
+        from fusioninfer_tpu.engine.guided import machine_for
+
+        outputs: list[StepOutput] = []
+        for rid in list(self._stream_order):
+            with self._lock:
+                entry = self._stream_intakes.get(rid)
+            if entry is None:
+                self._stream_order.remove(rid)
+                continue
+            request, intake, st = entry
+            if intake.cancelled:
+                # the server withdrew the stream before it usefully
+                # started (e.g. the peer speaks no stream endpoint and
+                # the slab path takes over) — just forget it
+                self._drop_stream(rid, release=True)
+                continue
+            try:
+                frames = st.pending + intake.drain()
+                st.pending = []
+                deferred = False
+                for i, frame in enumerate(frames):
+                    if st.assembler is None:
+                        st.assembler = kv_fabric.SlabAssembler(
+                            keep_frames=False)
+                    if not frame.meta and frame.page_size != self.cache_cfg.page_size:
+                        raise kv_fabric.KVFabricError(
+                            f"stream page_size {frame.page_size} != engine "
+                            f"page_size {self.cache_cfg.page_size}")
+                    if not frame.meta and st.pages is None:
+                        if not self.alloc.can_allocate(frame.prompt_len + 1):
+                            # transient page pressure: buffer and retry
+                            # next step (the feeder keeps streaming)
+                            st.pending = frames[i:]
+                            deferred = True
+                            break
+                        self.alloc.allocate(rid, frame.prompt_len + 1)
+                        st.pages = self.alloc.pages_of(rid)
+                    st.assembler.feed(frame)
+                    if not frame.meta:
+                        self.cache = kv_fabric.inject_frame(
+                            self.cache, frame, st.pages)
+                        self.kv_stream_frames_total += 1
+                        self.kv_stream_bytes_total += frame.payload_bytes
+                        if frame.during_prefill:
+                            self.kv_stream_overlapped_bytes_total += (
+                                frame.payload_bytes)
+                if deferred:
+                    continue
+                err = intake.error
+                if err is not None:
+                    raise err
+                if not intake.finished:
+                    continue  # mid-stream; more frames next step
+                if st.assembler is None or not st.assembler.complete:
+                    raise kv_fabric.KVFabricError(
+                        "stream ended incomplete: "
+                        + (st.assembler.missing() if st.assembler
+                           else "no frames received"))
+                meta = st.assembler.meta
+                if list(meta.prompt_tokens) != list(request.prompt_tokens):
+                    raise kv_fabric.KVFabricError(
+                        "stream prompt does not match the request's")
+                if self._avail_slots() <= 0:
+                    continue  # assembled; wait for a batch slot
+                machine = machine_for(request.params)
+                force_finish = None
+                if machine is not None:
+                    # replay the prefiller's (grammar-masked) first
+                    # token BEFORE claiming a slot — mirrors
+                    # _admit_prefilled's ordering
+                    self._masker.advance_token(machine, meta.first_token)
+                    force_finish = "stop" if machine.done else None
+                slot = self._free_slots.pop()
+                state = _SeqState(
+                    request=request,
+                    tokens=list(meta.prompt_tokens) + [meta.first_token],
+                    n_prompt=len(request.prompt_tokens),
+                    slot=slot,
+                    seed=self._request_seed(request),
+                    first_token_time=self._clock(),
+                    guided=machine,
+                )
+                self._register_slot(slot, state.tokens, state.n_prompt,
+                                    request.params)
+                self.running[slot] = state
+                self.generation_tokens_total += 1
+                self.kv_stream_admissions_total += 1
+                self._drop_stream(rid, release=False)
+                outputs.append(self._emit(state, meta.first_token,
+                                          first=True,
+                                          force_finish=force_finish))
+            except Exception as e:
+                logger.warning(
+                    "streamed KV admission of %s failed (%s); falling "
+                    "back to local re-prefill", rid, e)
+                self._drop_stream(rid, release=True)
+                self.kv_stream_fallbacks_total += 1
+                try:
+                    self.add_request(request)
+                except Exception as e2:
+                    self.errors_total += 1
+                    outputs.append(StepOutput(
+                        request_id=rid, token=0, finished=True,
+                        finish_reason=f"error:{e2}"))
+        return outputs
+
+    def _drop_stream(self, rid: str, release: bool) -> None:
+        with self._lock:
+            entry = self._stream_intakes.pop(rid, None)
+        if rid in self._stream_order:
+            self._stream_order.remove(rid)
+        if release and entry is not None and entry[2].pages is not None:
+            self.alloc.release(rid)
 
     def _admit_prefilled(self) -> list[StepOutput]:
         from fusioninfer_tpu.engine.kv_transfer import inject_slab
@@ -1428,7 +1736,7 @@ class NativeEngine:
         device-side gather dispatches HERE — before the reclaiming
         forward can overwrite the page — so the snapshot is immutable
         even though serialization happens later on the tier's worker."""
-        from fusioninfer_tpu.engine.kv_transfer import extract_slab
+        from fusioninfer_tpu.engine.kv_transfer import extract_slab, slab_to_host
 
         if self._host_tier.contains(h):
             # content-addressed: the tier already holds these exact
@@ -1438,8 +1746,16 @@ class NativeEngine:
             return
         # the PD path's extractor, at one page (host-tier frames carry
         # no prompt/first-token resume state — identity is the hash)
-        self._host_tier.offload(h, extract_slab(
-            self.cache, [page], [], 0, self.cache_cfg.page_size))
+        slab = extract_slab(
+            self.cache, [page], [], 0, self.cache_cfg.page_size)
+        if self._mh is not None:
+            # leader-coordinated mode: reclaim fires at a replicated
+            # allocator decision point, so EVERY process reaches this
+            # collective at the same step; afterwards each process's
+            # tier commits the same full (unsharded) page bytes —
+            # contains() above is replicated for the same reason
+            slab = slab_to_host(slab, multiprocess=True)
+        self._host_tier.offload(h, slab)
 
     def _admission_chain(self, request: Request,
                          prefix: list) -> Optional[list]:
@@ -1475,9 +1791,11 @@ class NativeEngine:
         entry) just shortens the chain: the suffix recomputes from the
         prompt, never from a bad page."""
         tier = self._host_tier
-        if tier is None or not len(tier):
-            # empty tier (the steady state for non-shared traffic):
-            # nothing to consult
+        if tier is None:
+            return
+        if not len(tier) and self._kv_fabric is None and self._mh is None:
+            # empty tier (the steady state for non-shared traffic) and
+            # no fleet to consult: nothing to do
             return
         ps = self.cache_cfg.page_size
         hashes = (chain if chain is not None
@@ -1488,9 +1806,12 @@ class NativeEngine:
         hashes = (hashes or [])[:max(0, (len(prefix) - 1) // ps)]
         if not hashes:
             return
+        if self._mh is not None:
+            return self._restore_host_blocks_multihost(request, hashes)
         plan: list[bytes] = []
         resident_evictable = 0
-        for h in hashes:
+        break_at: Optional[int] = None
+        for i, h in enumerate(hashes):
             if self.alloc.has_block(h):
                 # already HBM-resident (either tier may hold any block
                 # of one chain) — MRU-bump it so the adoptions below
@@ -1498,8 +1819,37 @@ class NativeEngine:
                 resident_evictable += self.alloc.touch_block(h)
                 continue
             if not tier.contains(h):
+                break_at = i
                 break
             plan.append(h)
+        if break_at is not None and self._kv_fabric is not None:
+            # the prefill fleet as one distributed prefix cache: ask
+            # the fleet residency view which peer holds the rest of the
+            # chain and import its frames into OUR host tier — the
+            # tier's parse+CRC door stays the single trust boundary,
+            # and the walk resumes only while the chain stays
+            # contiguous.  Any pull fault just ends the plan here: the
+            # suffix recomputes from the prompt (local fallback).
+            missing = [h for h in hashes[break_at:]
+                       if not self.alloc.has_block(h)
+                       and not tier.contains(h)]
+            pulled: set = set()
+            try:
+                for h, data in self._kv_fabric.pull_blocks(missing):
+                    if tier.import_frame(h, data):
+                        pulled.add(h)
+            except Exception:
+                logger.exception("fabric pull failed; chain suffix will "
+                                 "recompute")
+            for h in hashes[break_at:]:
+                if self.alloc.has_block(h):
+                    resident_evictable += self.alloc.touch_block(h)
+                    continue
+                if not tier.contains(h):
+                    break
+                plan.append(h)
+                if h in pulled:
+                    self.kv_fabric_restored_blocks_total += 1
         if not plan:
             return
         deferred = False
@@ -1574,6 +1924,142 @@ class NativeEngine:
         self.sched.kv_restores_total += len(pages)
         self.sched.kv_restore_tokens_total += n_tokens
         tier.note_restored(len(pages))
+
+    def _restore_host_blocks_multihost(self, request: Request,
+                                       hashes: list) -> None:
+        """Leader-coordinated host-tier restore on a multi-process mesh.
+
+        The refusal this replaces argued offload/restore timing is
+        process-local; the coordination contract here removes that:
+        entry is gated on REPLICATED state only (tier wiring, the
+        admission chain), every process MRU-bumps the same HBM-resident
+        blocks, and then the leader alone decides the plan — including
+        any cross-engine fabric pull — and broadcasts it WITH the frame
+        bytes attached (``multihost.broadcast_json``; the same idiom
+        ``add_prefilled_request`` uses for slabs).  Followers parse the
+        leader's bytes, so a follower tier that diverged (dropped an
+        offload, evicted early) can never fork the H2D schedule: all
+        processes adopt the same pages, inject the same values, and
+        fail identically if a frame is corrupt.  Budget/pool caps read
+        replicated scheduler/allocator state but are applied leader-side
+        so the broadcast plan is final."""
+        from fusioninfer_tpu.engine import multihost
+        from fusioninfer_tpu.engine.kv_transfer import (
+            KVSlab,
+            inject_slab,
+            slab_from_bytes,
+        )
+
+        tier = self._host_tier
+        ps = self.cache_cfg.page_size
+        # replicated pre-pass: bump the chain's HBM-resident blocks on
+        # EVERY process (skipping it on followers would fork LRU order)
+        resident_evictable = 0
+        candidates: list[bytes] = []
+        for h in hashes:
+            if self.alloc.has_block(h):
+                resident_evictable += self.alloc.touch_block(h)
+                continue
+            candidates.append(h)
+        obj = None
+        if self._mh.is_leader:
+            pulled: set = set()
+            missing = [h for h in candidates if not tier.contains(h)]
+            if missing and self._kv_fabric is not None:
+                try:
+                    for h, data in self._kv_fabric.pull_blocks(missing):
+                        if tier.import_frame(h, data):
+                            pulled.add(h)
+                except Exception:
+                    logger.exception("fabric pull failed; chain suffix "
+                                     "will recompute")
+            plan: list[bytes] = []
+            for h in candidates:
+                if not tier.contains(h):
+                    break  # the restored chain must stay contiguous
+                plan.append(h)
+            deferred = False
+            if self.sched.tokens_per_step is not None:
+                max_blocks = max(
+                    1, self._tier_prefill_left(request.priority) // ps)
+                if len(plan) > max_blocks:
+                    deferred = True
+                    plan = plan[:max_blocks]
+            pool_cap = max(0, self.alloc.free_pages - resident_evictable)
+            if len(plan) > pool_cap:
+                deferred = True
+                plan = plan[:pool_cap]
+            plan_hex: list[str] = []
+            frames_b64: list[str] = []
+            for h in plan:
+                data = tier.peek_frame(h)
+                if data is None:
+                    break
+                plan_hex.append(h.hex())
+                frames_b64.append(base64.b64encode(data).decode())
+            obj = {"plan": plan_hex, "frames": frames_b64,
+                   "deferred": deferred,
+                   "pulled": [h.hex() for h in pulled]}
+        msg = multihost.broadcast_json(obj, self._mh.is_leader)
+        if not msg:
+            return
+        if msg.get("deferred"):
+            self.sched.kv_restore_deferred_total += 1
+        pulled_hex = set(msg.get("pulled", ()))
+        slabs: list = []
+        pages: list[int] = []
+        for hex_h, b64 in zip(msg.get("plan", ()), msg.get("frames", ())):
+            h = bytes.fromhex(hex_h)
+            data = base64.b64decode(b64)
+            try:
+                slab = slab_from_bytes(data)
+            except Exception:
+                # same bytes on every process → the failure (and the
+                # shortened chain) is identical everywhere
+                break
+            try:
+                page = self.alloc.adopt_block(h)
+            except MemoryError:
+                break
+            slabs.append(slab)
+            pages.append(page)
+            if not tier.contains(h):
+                # follower convergence: the restored chain lands in
+                # every process's tier under the leader's exact bytes
+                tier.import_frame(h, data)
+            if hex_h in pulled_hex:
+                self.kv_fabric_restored_blocks_total += 1
+        if not pages:
+            return
+        quant = slabs[0].quantized
+        combined = KVSlab(
+            k=jnp.concatenate([s.k for s in slabs], axis=2),
+            v=jnp.concatenate([s.v for s in slabs], axis=2),
+            prompt_tokens=[],
+            first_token=0,
+            page_size=ps,
+            k_scale=(jnp.concatenate([s.k_scale for s in slabs], axis=2)
+                     if quant else None),
+            v_scale=(jnp.concatenate([s.v_scale for s in slabs], axis=2)
+                     if quant else None),
+        )
+        self.cache = inject_slab(self.cache, combined, pages)
+        n_tokens = len(pages) * ps
+        self._reserve_prefill(n_tokens, prio=request.priority)
+        self.sched.kv_restores_total += len(pages)
+        self.sched.kv_restore_tokens_total += n_tokens
+        tier.note_restored(len(pages))
+
+    def export_host_frames(self, hashes: list[bytes],
+                           limit: int = 0) -> list[tuple[bytes, bytes]]:
+        """Serve a peer's demand pull (``GET /v1/kv_export``): resident
+        host-tier frames for ``hashes``, raw bytes (the frame's own
+        CRC32 rides inside; the server adds the pairing CRC).  Safe
+        from HTTP threads — the tier carries its own lock and the
+        engine thread is never entered."""
+        if self._host_tier is None:
+            return []
+        return self._host_tier.get_frames(hashes, limit)
 
     def prefix_residency(self, limit: int = 128) -> dict:
         """Per-tier prefix-cache residency: block counts plus a top-K
@@ -1766,6 +2252,7 @@ class NativeEngine:
             self._serve_slab_requests()
             self._serve_embedding_requests()
             outputs: list[StepOutput] = []
+            outputs += self._admit_streamed()
             outputs += self._admit_prefilled()
             # open the step's token ledger AFTER prefilled admissions
             # (they decode this step too): the budget is charged with
@@ -1818,6 +2305,10 @@ class NativeEngine:
             )
             self.cancelled_total += len(self.waiting_prefilled) - len(kept_p)
             self.waiting_prefilled = kept_p
+        for rid in [r for r in self._stream_order if r in cancelled]:
+            self._drop_stream(rid, release=True)
+            self.cancelled_total += 1
+            logger.info("cancelled %s mid-stream", rid)
         for state in [s for s in self.running.values()
                       if s.request.request_id in cancelled]:
             self._finish(state, outcome="cancelled")

@@ -1,0 +1,638 @@
+"""The KV fabric: layer-streamed PD transfer + cross-engine prefix pull.
+
+Two capabilities compose here (docs/design/pd-disaggregation.md):
+
+* **Layer-streamed prefill→decode transfer.**  The prefill engine
+  pushes completed KV as per-(layer-range, page-range)
+  :class:`StreamFrame` slices *during* its chunked forward — frame N of
+  chunk K crosses DCN while chunk K+1 is still on the MXU — and the
+  decode engine adopts pages as frames land (:class:`StreamIntake` is
+  the thread-safe hand-off, :class:`SlabAssembler` the out-of-order
+  sequencing/coverage check, :func:`inject_frame` the per-slice
+  scatter).  TTFT hides the transfer behind remaining prefill compute
+  instead of serializing after it; the assembler's
+  ``overlap_fraction`` measures exactly how much payload crossed while
+  prefill was still running.
+* **Steady-state cross-engine prefix pull.**  :class:`KVFabric` turns
+  every engine's host tier into one distributed prefix cache: when
+  ``_restore_host_blocks`` misses locally, the fabric asks the fleet
+  residency view (``router.picker.ResidencyProvider.block_holders``)
+  which peer holds the missing chain and pulls the frames over
+  ``GET /v1/kv_export?hashes=`` — PR 11's evacuation-time export
+  generalized to demand.  Pulled frames carry the same (hash‖data)
+  pairing CRC the import door already checks.
+
+Failure semantics are the repo invariant: every fault — dropped frame,
+corrupt payload, version skew, vanished peer — degrades to recompute
+(the decode engine re-prefills locally, bit-identical; a pull miss just
+shortens the restore chain), never to a corrupt page.  Chaos sites:
+``kv.fabric.stream`` / ``kv.fabric.stream.data`` on the stream path
+(armed in the connector, caught at :meth:`StreamIntake.feed_bytes`),
+``kv.fabric.pull`` / ``kv.fabric.pull.data`` on the pull path.
+
+Host-sync discipline: :func:`frame_to_bytes` is this module's ONE
+sanctioned device→host fetch point — the prefiller's engine thread
+serializes each frame there (the gather was dispatched at extract
+time); everything on the decode side parses to host numpy arrays and
+never touches a device value.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fusioninfer_tpu.engine.kv_transfer import (
+    FLAG_META,
+    FLAG_QUANTIZED,
+    KVSlab,
+    KVTransferError,
+    _arr_bytes,
+    _dequant_pages,
+    _quant_pages,
+    pack_frame,
+    unpack_frame,
+)
+from fusioninfer_tpu.resilience import FaultInjector, InjectedFault
+
+logger = logging.getLogger("fusioninfer.kv_fabric")
+
+SITE_STREAM = "kv.fabric.stream"
+SITE_STREAM_DATA = "kv.fabric.stream.data"
+SITE_PULL = "kv.fabric.pull"
+SITE_PULL_DATA = "kv.fabric.pull.data"
+
+
+class KVFabricError(Exception):
+    """A stream violated its own sequencing contract (wrong request id,
+    overlapping coverage, ended incomplete).  Callers degrade to local
+    recompute — this is a protocol fault, never a corrupt page."""
+
+
+# -- stream frames -----------------------------------------------------------
+
+
+@dataclass
+class StreamFrame:
+    """One slice of a streamed prefill: KV for layers
+    [layer_start, layer_start+Lf) × pages [page_start, page_start+Pf),
+    or (``meta=True``) the stream's resume metadata.
+
+    Every KV frame is self-describing enough for the decode side to act
+    on FIRST arrival: totals (``n_layers``/``n_pages``/``prompt_len``)
+    ride every frame so pages can be allocated before the meta frame
+    lands, and ``during_prefill`` marks frames that left the prefiller
+    while later chunks were still computing (the overlap numerator)."""
+
+    request_id: str
+    seq: int
+    n_layers: int = 0  # stream totals, not this frame's extent
+    n_pages: int = 0
+    page_size: int = 0
+    prompt_len: int = 0
+    layer_start: int = 0
+    page_start: int = 0
+    during_prefill: bool = False
+    k: Optional[np.ndarray] = None  # [Lf, KV, Pf, ps, Hd]
+    v: Optional[np.ndarray] = None
+    k_scale: Optional[np.ndarray] = None  # [Lf, KV, Pf, 1, ps]
+    v_scale: Optional[np.ndarray] = None
+    meta: bool = False
+    prompt_tokens: Optional[list[int]] = None
+    first_token: int = 0
+    n_frames: int = 0  # meta only: total frames including itself
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def payload_bytes(self) -> int:
+        return sum(a.nbytes for a in
+                   (self.k, self.v, self.k_scale, self.v_scale)
+                   if a is not None)
+
+
+def _np_from(meta: dict, raw: bytes) -> np.ndarray:
+    """Host-side array parse (the decode path must never create device
+    values): bf16 rides the wire as uint16, viewed back via ml_dtypes."""
+    dtype = meta["dtype"]
+    shape = tuple(meta["shape"])
+    if dtype == "bfloat16":
+        return np.frombuffer(raw, np.uint16).reshape(shape).view(jnp.bfloat16)
+    return np.frombuffer(raw, np.dtype(dtype)).reshape(shape)
+
+
+def frame_to_bytes(frame: StreamFrame) -> bytes:
+    """Serialize one frame onto the versioned fabric envelope.  This is
+    the module's sanctioned device→host fetch point: ``_arr_bytes``
+    blocks on the page gather the extractor dispatched."""
+    header: dict = {
+        "request_id": frame.request_id,
+        "seq": frame.seq,
+        "n_layers": frame.n_layers,
+        "n_pages": frame.n_pages,
+        "page_size": frame.page_size,
+        "prompt_len": frame.prompt_len,
+    }
+    if frame.meta:
+        header.update({
+            "prompt_tokens": list(frame.prompt_tokens or []),
+            "first_token": frame.first_token,
+            "n_frames": frame.n_frames,
+        })
+        return pack_frame(header, b"", flags=FLAG_META)
+    header.update({
+        "layer_start": frame.layer_start,
+        "page_start": frame.page_start,
+        "during_prefill": frame.during_prefill,
+    })
+    sections = [("k", frame.k), ("v", frame.v)]
+    if frame.quantized:
+        sections += [("k_scale", frame.k_scale), ("v_scale", frame.v_scale)]
+    header["sections"] = [name for name, _ in sections]
+    raws = []
+    for name, arr in sections:
+        meta, raw = _arr_bytes(arr)
+        header[name] = meta
+        header[f"{name}_len"] = len(raw)
+        raws.append(raw)
+    flags = FLAG_QUANTIZED if frame.quantized else 0
+    return pack_frame(header, b"".join(raws), flags=flags)
+
+
+def frame_from_bytes(data: bytes) -> StreamFrame:
+    """Parse one fabric envelope into a host-side frame.  Raises
+    :class:`KVSlabCorrupt` / :class:`KVWireVersionError` via
+    ``unpack_frame`` — corruption and version skew fail at the door."""
+    flags, header, payload = unpack_frame(data)
+    common = dict(
+        request_id=header["request_id"],
+        seq=int(header["seq"]),
+        n_layers=int(header["n_layers"]),
+        n_pages=int(header["n_pages"]),
+        page_size=int(header["page_size"]),
+        prompt_len=int(header["prompt_len"]),
+    )
+    if flags & FLAG_META:
+        return StreamFrame(
+            meta=True,
+            prompt_tokens=list(header["prompt_tokens"]),
+            first_token=int(header["first_token"]),
+            n_frames=int(header["n_frames"]),
+            **common,
+        )
+    arrays: dict[str, np.ndarray] = {}
+    off = 0
+    for name in header["sections"]:
+        raw = payload[off : off + header[f"{name}_len"]]
+        off += header[f"{name}_len"]
+        arrays[name] = _np_from(header[name], raw)
+    return StreamFrame(
+        layer_start=int(header["layer_start"]),
+        page_start=int(header["page_start"]),
+        during_prefill=bool(header["during_prefill"]),
+        k=arrays["k"],
+        v=arrays["v"],
+        k_scale=arrays.get("k_scale"),
+        v_scale=arrays.get("v_scale"),
+        **common,
+    )
+
+
+def split_slab(slab: KVSlab, request_id: str, *, page_start: int,
+               n_pages_total: int, prompt_len: int, during_prefill: bool,
+               start_seq: int, layer_groups: int = 2) -> list[StreamFrame]:
+    """Slice one extracted slab (pages [page_start, page_start+n)) into
+    ``layer_groups`` layer-range frames — the granularity that lets the
+    first layers of a chunk cross DCN while its last layers serialize."""
+    L = int(slab.k.shape[0])
+    groups = max(1, min(layer_groups, L))
+    per = -(-L // groups)  # ceil
+    frames = []
+    seq = start_seq
+    for l0 in range(0, L, per):
+        l1 = min(L, l0 + per)
+        frames.append(StreamFrame(
+            request_id=request_id,
+            seq=seq,
+            n_layers=L,
+            n_pages=n_pages_total,
+            page_size=slab.page_size,
+            prompt_len=prompt_len,
+            layer_start=l0,
+            page_start=page_start,
+            during_prefill=during_prefill,
+            k=slab.k[l0:l1],
+            v=slab.v[l0:l1],
+            k_scale=slab.k_scale[l0:l1] if slab.quantized else None,
+            v_scale=slab.v_scale[l0:l1] if slab.quantized else None,
+        ))
+        seq += 1
+    return frames
+
+
+def slab_to_frames(slab: KVSlab, request_id: str,
+                   layer_groups: int = 2) -> list[StreamFrame]:
+    """Whole-slab → stream shim (tests and the slab-vs-streamed A/B):
+    every KV frame plus the trailing meta frame, none overlapped."""
+    n = int(slab.k.shape[2])
+    frames = split_slab(
+        slab, request_id, page_start=0, n_pages_total=n,
+        prompt_len=slab.n_tokens, during_prefill=False, start_seq=0,
+        layer_groups=layer_groups)
+    frames.append(StreamFrame(
+        request_id=request_id,
+        seq=len(frames),
+        n_layers=int(slab.k.shape[0]),
+        n_pages=n,
+        page_size=slab.page_size,
+        prompt_len=slab.n_tokens,
+        meta=True,
+        prompt_tokens=list(slab.prompt_tokens),
+        first_token=slab.first_token,
+        n_frames=len(frames) + 1,
+    ))
+    return frames
+
+
+# -- out-of-order assembly ---------------------------------------------------
+
+
+class SlabAssembler:
+    """Sequence-checked reassembly of an out-of-order frame stream.
+
+    Frames may arrive in any order (DCN reorders, layer groups race);
+    coverage is tracked per (layer, page) cell, duplicates and overlaps
+    are protocol faults, and ``complete`` only once every cell of the
+    [n_layers × n_pages] grid is covered AND the meta frame landed.
+    With ``keep_frames`` the assembled :class:`KVSlab` is materialized
+    (tests, slab-path shims); the decode engine injects frames
+    incrementally instead and uses this purely as the sequencing/
+    coverage/overlap ledger."""
+
+    def __init__(self, keep_frames: bool = True):
+        self._keep = keep_frames
+        self._frames: list[StreamFrame] = []
+        self._grid: Optional[np.ndarray] = None  # [L, P] coverage
+        self._totals: Optional[tuple[int, int, int, int]] = None
+        self.meta: Optional[StreamFrame] = None
+        self.payload_bytes = 0
+        self.overlapped_bytes = 0
+        self._seqs: set[int] = set()
+        self._request_id: Optional[str] = None
+
+    def _check_common(self, frame: StreamFrame) -> None:
+        if self._request_id is None:
+            self._request_id = frame.request_id
+        elif frame.request_id != self._request_id:
+            raise KVFabricError(
+                f"frame for {frame.request_id!r} on a "
+                f"{self._request_id!r} stream")
+        totals = (frame.n_layers, frame.n_pages, frame.page_size,
+                  frame.prompt_len)
+        if self._totals is None:
+            self._totals = totals
+            self._grid = np.zeros((frame.n_layers, frame.n_pages), bool)
+        elif totals != self._totals:
+            raise KVFabricError(
+                f"frame totals {totals} contradict stream {self._totals}")
+        if frame.seq in self._seqs:
+            raise KVFabricError(f"duplicate frame seq {frame.seq}")
+        self._seqs.add(frame.seq)
+
+    def feed(self, frame: StreamFrame) -> None:
+        self._check_common(frame)
+        if frame.meta:
+            if self.meta is not None:
+                raise KVFabricError("duplicate meta frame")
+            self.meta = frame
+            return
+        l0, p0 = frame.layer_start, frame.page_start
+        lf, pf = frame.k.shape[0], frame.k.shape[2]
+        if (l0 < 0 or p0 < 0 or l0 + lf > frame.n_layers
+                or p0 + pf > frame.n_pages):
+            raise KVFabricError(
+                f"frame [{l0}:{l0+lf})×[{p0}:{p0+pf}) outside "
+                f"{frame.n_layers}×{frame.n_pages} grid")
+        cell = self._grid[l0 : l0 + lf, p0 : p0 + pf]
+        if cell.any():
+            raise KVFabricError(
+                f"frame [{l0}:{l0+lf})×[{p0}:{p0+pf}) overlaps "
+                "already-covered cells")
+        cell[:] = True
+        self.payload_bytes += frame.payload_bytes
+        if frame.during_prefill:
+            self.overlapped_bytes += frame.payload_bytes
+        if self._keep:
+            self._frames.append(frame)
+
+    @property
+    def complete(self) -> bool:
+        if self.meta is None or self._grid is None:
+            return False
+        if self.meta.n_frames and len(self._seqs) != self.meta.n_frames:
+            return False
+        return bool(self._grid.all())
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Fraction of KV payload that crossed the wire while the
+        prefiller was still computing — the streamed-vs-slab A/B's
+        figure of merit (slab transfers score 0.0)."""
+        if not self.payload_bytes:
+            return 0.0
+        return self.overlapped_bytes / self.payload_bytes
+
+    def missing(self) -> str:
+        if self._grid is None:
+            return "no frames received"
+        if self.meta is None:
+            return "meta frame never arrived"
+        uncovered = int((~self._grid).sum())
+        return (f"{uncovered} uncovered (layer, page) cells"
+                if uncovered else "complete")
+
+    def slab(self) -> KVSlab:
+        """Materialize the assembled whole-sequence slab (host arrays).
+        Requires ``keep_frames`` and a complete stream."""
+        if not self._keep:
+            raise KVFabricError("assembler built with keep_frames=False")
+        if not self.complete:
+            raise KVFabricError(f"stream incomplete: {self.missing()}")
+        first = self._frames[0]
+        L, P = first.n_layers, first.n_pages
+        KV = first.k.shape[1]
+        ps, Hd = first.k.shape[3], first.k.shape[4]
+        k = np.zeros((L, KV, P, ps, Hd), first.k.dtype)
+        v = np.zeros_like(k)
+        quant = first.quantized
+        k_scale = (np.zeros((L, KV, P, 1, ps), first.k_scale.dtype)
+                   if quant else None)
+        v_scale = np.zeros_like(k_scale) if quant else None
+        for f in self._frames:
+            ls = slice(f.layer_start, f.layer_start + f.k.shape[0])
+            pg = slice(f.page_start, f.page_start + f.k.shape[2])
+            k[ls, :, pg] = f.k
+            v[ls, :, pg] = f.v
+            if quant:
+                k_scale[ls, :, pg] = f.k_scale
+                v_scale[ls, :, pg] = f.v_scale
+        return KVSlab(
+            k=k, v=v,
+            prompt_tokens=list(self.meta.prompt_tokens or []),
+            first_token=self.meta.first_token,
+            page_size=self.meta.page_size,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+
+
+class StreamIntake:
+    """Thread-safe frame hand-off: a server feeder thread pushes raw
+    frame bytes as they leave the socket; the decode engine drains
+    parsed frames inside its own step (only the engine thread ever
+    touches the cache).  Terminal states: ``close`` (stream ended
+    cleanly), ``fail`` (transport/protocol error → the engine falls
+    back to local re-prefill), ``cancel`` (the server decided the
+    stream never usefully started → the engine just forgets it)."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._lock = threading.Lock()
+        self._frames: list[StreamFrame] = []
+        self.frames_fed = 0
+        self._closed = False
+        self._error: Optional[Exception] = None
+        self._cancelled = False
+
+    def feed_bytes(self, data: bytes) -> None:
+        """Parse + enqueue one frame.  A corrupt/foreign frame raises to
+        the feeder (which fails the intake); nothing corrupt is ever
+        visible to the engine side."""
+        frame = frame_from_bytes(data)
+        if frame.request_id != self.request_id:
+            raise KVFabricError(
+                f"stream frame for {frame.request_id!r} on intake "
+                f"{self.request_id!r}")
+        with self._lock:
+            if self._closed or self._error or self._cancelled:
+                return
+            self._frames.append(frame)
+            self.frames_fed += 1
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+    def fail(self, exc: Exception) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._cancelled = True
+
+    def drain(self) -> list[StreamFrame]:
+        with self._lock:
+            frames, self._frames = self._frames, []
+            return frames
+
+    @property
+    def finished(self) -> bool:
+        with self._lock:
+            return self._closed and not self._frames
+
+    @property
+    def error(self) -> Optional[Exception]:
+        with self._lock:
+            return self._error
+
+    @property
+    def cancelled(self) -> bool:
+        with self._lock:
+            return self._cancelled
+
+
+# -- per-frame injection -----------------------------------------------------
+
+
+def inject_frame(cache: dict, frame: StreamFrame, pages: list[int]) -> dict:
+    """Scatter one frame's (layer-range × page-range) slice into the
+    decode engine's cache at its OWN page allocation — the page-adoption
+    step that runs as each frame lands, long before the stream is
+    complete.  Precision converts at the boundary exactly like
+    ``inject_slab`` (int8 frames dequantize into bf16 caches and vice
+    versa), so cross-precision PD composes with streaming."""
+    lf = frame.k.shape[0]
+    pf = frame.k.shape[2]
+    if frame.page_start + pf > len(pages):
+        raise KVFabricError(
+            f"frame pages [{frame.page_start}:{frame.page_start+pf}) "
+            f"exceed the {len(pages)}-page allocation")
+    cache_quant = "k_scale" in cache
+    k, v = jnp.asarray(frame.k), jnp.asarray(frame.v)
+    k_scale = jnp.asarray(frame.k_scale) if frame.quantized else None
+    v_scale = jnp.asarray(frame.v_scale) if frame.quantized else None
+    if frame.quantized and not cache_quant:
+        k = _dequant_pages(k, k_scale, cache["k"].dtype)
+        v = _dequant_pages(v, v_scale, cache["v"].dtype)
+    elif cache_quant and not frame.quantized:
+        k, k_scale = _quant_pages(k)
+        v, v_scale = _quant_pages(v)
+    KV = cache["k"].shape[1]
+    # broadcasting advanced-index scatter (basic-slice-before-advanced
+    # would make XLA copy the whole pool per frame; see inject_slab)
+    li = jnp.arange(frame.layer_start, frame.layer_start + lf)[:, None, None]
+    kvi = jnp.arange(KV)[None, :, None]
+    idx = jnp.asarray(
+        pages[frame.page_start : frame.page_start + pf], jnp.int32)
+    pi = idx[None, None, :]
+    out = {
+        "k": cache["k"].at[li, kvi, pi].set(k.astype(cache["k"].dtype)),
+        "v": cache["v"].at[li, kvi, pi].set(v.astype(cache["v"].dtype)),
+    }
+    if cache_quant:
+        out["k_scale"] = cache["k_scale"].at[li, kvi, pi].set(
+            k_scale.astype(cache["k_scale"].dtype))
+        out["v_scale"] = cache["v_scale"].at[li, kvi, pi].set(
+            v_scale.astype(cache["v_scale"].dtype))
+    return out
+
+
+# -- cross-engine prefix pull ------------------------------------------------
+
+
+def pairing_crc(h: bytes, data: bytes) -> int:
+    """The (hash‖data) binding CRC the kv_import door already checks —
+    pull responses carry the same field so a frame can never be adopted
+    under a hash it was not exported for."""
+    return zlib.crc32(h + data)
+
+
+@dataclass
+class KVFabric:
+    """The pull half of the fabric: one engine restoring prefix blocks
+    from ANY peer's host tier.
+
+    ``resolver`` maps block-hash hex → peer base URL — in the fleet it
+    closes over the EPP's :class:`ResidencyProvider` digests
+    (``block_holders``), so the same residency view that routes requests
+    also tells an engine which peer holds a missing chain.  ``peers``
+    is the static fallback (probe in order).  Every fault degrades:
+    a vanished peer, a version-skewed frame, or a pairing-CRC mismatch
+    just shortens what the caller restores (the suffix recomputes)."""
+
+    peers: tuple = ()
+    resolver: Optional[Callable[[list[str]], dict]] = None
+    fault_injector: Optional[FaultInjector] = None
+    timeout_s: float = 5.0
+    max_blocks_per_pull: int = 16
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    pull_requests_total: int = 0
+    pulled_blocks_total: int = 0
+    pull_rejected_total: int = 0
+    pull_faults_total: int = 0
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "pull_requests": self.pull_requests_total,
+                "pulled_blocks": self.pulled_blocks_total,
+                "pull_rejected": self.pull_rejected_total,
+                "pull_faults": self.pull_faults_total,
+            }
+
+    def _candidates(self, hashes: list[bytes]) -> list[str]:
+        """Peer URLs to try, residency-routed first, then the static
+        peer list — dedup preserves order."""
+        urls: list[str] = []
+        if self.resolver is not None:
+            try:
+                holders = self.resolver([h.hex() for h in hashes]) or {}
+            except Exception:
+                logger.exception("fabric residency resolver failed")
+                holders = {}
+            for h in hashes:
+                ep = holders.get(h.hex())
+                if ep and ep not in urls:
+                    urls.append(ep)
+        for ep in self.peers:
+            if ep and ep not in urls:
+                urls.append(ep)
+        return urls
+
+    def _pull_from(self, url: str,
+                   hashes: list[bytes]) -> list[tuple[bytes, bytes]]:
+        qs = urllib.parse.urlencode({
+            "hashes": ",".join(h.hex() for h in hashes),
+            "limit": len(hashes),
+        })
+        req = url.rstrip("/") + "/v1/kv_export?" + qs
+        fi = self.fault_injector
+        if fi is not None:
+            fi.fire(SITE_PULL)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            payload = json.loads(resp.read())
+        out: list[tuple[bytes, bytes]] = []
+        rejected = 0
+        for fr in payload.get("frames", []):
+            try:
+                h = bytes.fromhex(fr["hash"])
+                data = base64.b64decode(fr["data"])
+                crc = int(fr["crc"])
+            except (KeyError, ValueError, TypeError):
+                rejected += 1
+                continue
+            if fi is not None:
+                data = fi.corrupt(SITE_PULL_DATA, data)
+            if pairing_crc(h, data) != crc:
+                rejected += 1
+                continue
+            out.append((h, data))
+        if rejected:
+            with self._lock:
+                self.pull_rejected_total += rejected
+            logger.warning("fabric pull from %s rejected %d frames "
+                           "(pairing CRC / shape)", url, rejected)
+        return out
+
+    def pull_blocks(self, hashes: list[bytes]) -> list[tuple[bytes, bytes]]:
+        """Fetch as many of ``hashes`` as the fleet holds, as (hash,
+        frame-bytes) pairs.  Frames still face the host tier's own parse
+        + CRC at import, so a byte-level fault here can at worst shorten
+        the restored chain."""
+        if not hashes:
+            return []
+        want = hashes[: self.max_blocks_per_pull]
+        with self._lock:
+            self.pull_requests_total += 1
+        got: dict[bytes, bytes] = {}
+        for url in self._candidates(want):
+            missing = [h for h in want if h not in got]
+            if not missing:
+                break
+            try:
+                for h, data in self._pull_from(url, missing):
+                    if h in want:
+                        got.setdefault(h, data)
+            except (InjectedFault, KVTransferError, urllib.error.URLError,
+                    OSError, TimeoutError, ValueError) as e:
+                with self._lock:
+                    self.pull_faults_total += 1
+                logger.warning("fabric pull from %s failed (%s); trying "
+                               "next holder", url, e)
+        with self._lock:
+            self.pulled_blocks_total += len(got)
+        return [(h, got[h]) for h in want if h in got]

@@ -34,9 +34,13 @@ the stored frame), ``kv.host.restore`` (drop/delay/error before parse),
 ``kv.host.restore.data`` (corrupt the frame on the way back — CRC32
 catches it, the entry is dropped, and the prefix recomputes).
 
-Single-process only: offload/restore timing is process-local and would
-diverge a multi-host SPMD lockstep group's schedulers (the engine
-refuses to wire the tier on a multi-process mesh).
+Multi-process meshes wire the tier through the engine's
+leader-coordinated path (docs/design/pd-disaggregation.md): offloads
+fire at replicated reclaim points with the page slab host-gathered via
+a mesh collective, restores are planned on the leader and the frame
+bytes ride the admission broadcast — the engine calls
+:meth:`make_synchronous` so tier visibility can never depend on a
+process-local worker's timing.
 """
 
 from __future__ import annotations
@@ -270,6 +274,42 @@ class HostKVTier:
         """The engine confirms ``n_pages`` were re-injected into HBM."""
         with self._lock:
             self._restores_total += n_pages
+
+    def peek_frame(self, h: bytes) -> Optional[bytes]:
+        """One entry's serialized frame bytes, MRU-bumped but NOT parsed
+        — the leader-coordinated restore broadcasts these raw (every
+        process parses the same bytes, so a corrupt frame fails
+        identically everywhere) and the fabric's ``/v1/kv_export``
+        serves them as-is (the frame already carries its CRC32)."""
+        with self._lock:
+            data = self._entries.get(h)
+            if data is not None:
+                self._entries.move_to_end(h)
+            return data
+
+    def get_frames(self, hashes: list[bytes],
+                   limit: int = 0) -> list[tuple[bytes, bytes]]:
+        """Serialized frames for a demand pull (``GET /v1/kv_export``):
+        the requested hashes that are resident, in request order.
+        Read-mostly (MRU bumps aside) — a peer pulling a chain must not
+        perturb this tier's eviction behavior beyond marking the chain
+        warm."""
+        if limit:
+            hashes = hashes[:limit]
+        out = []
+        for h in hashes:
+            data = self.peek_frame(h)
+            if data is not None:
+                out.append((h, data))
+        return out
+
+    def make_synchronous(self) -> None:
+        """Switch to inline offload commits.  The multi-process engine
+        calls this at wiring time: every process must observe identical
+        tier contents at identical steps, and an async worker's commit
+        timing is process-local by construction."""
+        self.flush()
+        self.async_offload = False
 
     # -- evacuation export/import (host -> host, cross-engine) ---------------
 

@@ -62,6 +62,17 @@ class KVSlabCorrupt(KVTransferError):
     the prefill and re-serializes a fresh frame."""
 
 
+class KVWireVersionError(KVTransferError):
+    """The peer speaks a fabric wire version this build does not.  NOT
+    retryable: the version is deterministic per peer build — re-pulling
+    the same frame burns backoff budget on a doomed call; the caller
+    must degrade (slab pull or local recompute) instead."""
+
+    @property
+    def retryable(self) -> bool:
+        return False
+
+
 @dataclass
 class KVSlab:
     """One sequence's KV context plus what decode needs to resume.
@@ -288,6 +299,83 @@ def slab_from_bytes(data: bytes) -> KVSlab:
     )
 
 
+# -- versioned fabric envelope (layer-streamed frames) -----------------------
+#
+# The slab magics above are whole-slab, version-free frames: a peer either
+# parses the entire sequence's KV or rejects the magic.  The KV fabric
+# (engine/kv_fabric.py) streams PARTIAL frames — per-(layer-range,
+# page-range) slices sequenced for out-of-order assembly — so its wire
+# needs room to evolve without minting a new magic per change.  The
+# envelope therefore carries an explicit version byte (unknown versions
+# fail loudly as KVWireVersionError, never parse-as-garbage) and a flags
+# byte (payload traits a reader can branch on without JSON-decoding the
+# header first).  Legacy whole-slab frames coexist on the same wire: the
+# magics differ in the first 4 bytes, so sniffing is one prefix compare.
+
+_MAGIC_FABRIC = b"FIKF"
+WIRE_VERSION = 1
+FLAG_QUANTIZED = 0x01  # payload carries int8 codes + scale sections
+FLAG_META = 0x02  # header-only frame (stream metadata, empty payload)
+
+
+def is_fabric_frame(data: bytes) -> bool:
+    return data[: len(_MAGIC_FABRIC)] == _MAGIC_FABRIC
+
+
+def pack_frame(header: dict, payload: bytes = b"", flags: int = 0,
+               version: int = WIRE_VERSION) -> bytes:
+    """``magic | version | flags | >I header_len | JSON header | payload``.
+    The payload CRC32 rides inside the JSON header, so corruption in
+    either region is caught (header damage breaks the JSON/declared
+    lengths; payload damage breaks the CRC)."""
+    h = dict(header)
+    h["crc32"] = zlib.crc32(payload)
+    h["payload_len"] = len(payload)
+    hb = json.dumps(h).encode()
+    return b"".join([
+        _MAGIC_FABRIC, bytes([version & 0xFF, flags & 0xFF]),
+        struct.pack(">I", len(hb)), hb, payload,
+    ])
+
+
+def unpack_frame(data: bytes) -> tuple[int, dict, bytes]:
+    """Parse one fabric envelope → ``(flags, header, payload)``.
+
+    Raises :class:`KVWireVersionError` on an unknown version (loud, not
+    retryable) and :class:`KVSlabCorrupt` on truncation or CRC mismatch
+    — every fault degrades at the door, nothing half-parses."""
+    if not is_fabric_frame(data):
+        raise ValueError("not a KV fabric frame")
+    if len(data) < len(_MAGIC_FABRIC) + 6:
+        raise KVSlabCorrupt("fabric frame shorter than its fixed header")
+    off = len(_MAGIC_FABRIC)
+    version, flags = data[off], data[off + 1]
+    if version != WIRE_VERSION:
+        raise KVWireVersionError(
+            f"fabric wire version {version} unsupported "
+            f"(this build speaks {WIRE_VERSION})")
+    off += 2
+    (hlen,) = struct.unpack(">I", data[off : off + 4])
+    off += 4
+    try:
+        header = json.loads(data[off : off + hlen])
+    except ValueError as e:
+        raise KVSlabCorrupt(f"fabric header unparseable: {e}") from e
+    off += hlen
+    plen = int(header.get("payload_len", len(data) - off))
+    if len(data) - off < plen:
+        raise KVSlabCorrupt(
+            f"truncated fabric frame: {len(data) - off} payload bytes, "
+            f"header declares {plen}")
+    payload = data[off : off + plen]
+    crc = zlib.crc32(payload)
+    if crc != header.get("crc32"):
+        raise KVSlabCorrupt(
+            f"fabric crc32 mismatch: frame says "
+            f"{header.get('crc32', 0):#010x}, payload hashes to {crc:#010x}")
+    return flags, header, payload
+
+
 # -- connectors --------------------------------------------------------------
 
 
@@ -393,5 +481,82 @@ class HTTPPullConnector:
             retry_if=lambda e: e.retryable,
         )
 
+    def pull_prefill_stream(self, request_id: str,
+                            prompt_tokens: list[int],
+                            sink, sampling: Optional[dict] = None,
+                            lora: str = "",
+                            timeout: float = 120.0) -> int:
+        """Layer-streamed pull: POST ``/v1/prefill_stream`` and feed each
+        length-prefixed fabric frame to ``sink`` AS IT ARRIVES — the
+        decode engine adopts pages while the prefiller is still
+        computing later chunks (engine/kv_fabric.py assembles them).
+
+        No retry wrapper: a mid-stream re-pull would restart the whole
+        prefill, and the decode side already owns the degrade path (an
+        incomplete stream falls back to local re-prefill, bit-identical).
+        Chaos sites: ``kv.fabric.stream`` fires before the connect and
+        before each frame read (``after=N`` arms mid-stream faults);
+        ``kv.fabric.stream.data`` corrupts frame payloads (the fabric
+        CRC catches them at the feed door).  Returns frames delivered."""
+        body = json.dumps({
+            "request_id": request_id,
+            "prompt_tokens": prompt_tokens,
+            "sampling": sampling or self.sampling or {},
+            "lora": lora,
+        }).encode()
+        req = urllib.request.Request(
+            self.prefill_url.rstrip("/") + "/v1/prefill_stream",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        fi = self.fault_injector
+        n = 0
+        try:
+            if fi is not None:
+                fi.fire("kv.fabric.stream")
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                while True:
+                    if fi is not None:
+                        fi.fire("kv.fabric.stream")
+                    hdr = _read_exact(resp, 4)
+                    if not hdr:
+                        break  # clean end of stream
+                    if len(hdr) < 4:
+                        raise KVSlabCorrupt("truncated stream length prefix")
+                    (flen,) = struct.unpack(">I", hdr)
+                    data = _read_exact(resp, flen)
+                    if len(data) < flen:
+                        raise KVSlabCorrupt(
+                            f"truncated stream frame: {len(data)}/{flen} "
+                            "bytes before EOF")
+                    if fi is not None:
+                        data = fi.corrupt("kv.fabric.stream.data", data)
+                    sink(data)
+                    n += 1
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise KVTransferError(detail or e.reason, status=e.code,
+                                  body=detail) from None
+        except InjectedFault as e:
+            raise KVTransferError(str(e), status=500 if e.mode == "error"
+                                  else None) from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise KVTransferError(str(e)) from e
+        return n
+
     def get(self, request_id: str, timeout: float = 30.0) -> KVSlab:
         raise NotImplementedError("use request_prefill (needs the prompt)")
+
+
+def _read_exact(resp, n: int) -> bytes:
+    """Read exactly ``n`` bytes from an HTTP response body (``read(n)``
+    may return short on chunked transfers); short only at EOF."""
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = resp.read(remaining)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)

@@ -200,6 +200,7 @@ class EngineMetrics:
         ]
         lines += self._render_slo_tiers(labels)
         lines += self._render_kv_tiers(engine, labels)
+        lines += self._render_kv_fabric(engine, labels)
         lines += self._render_evacuation(engine, labels)
         lines += self._render_scheduler(engine, labels)
         lines += self._render_aot(engine, labels)
@@ -337,6 +338,62 @@ class EngineMetrics:
             "# TYPE fusioninfer:kv_host_import_rejected_total counter",
             f"fusioninfer:kv_host_import_rejected_total{{{labels}}} {c['import_rejected']}",
         ]
+        return lines
+
+    @staticmethod
+    def _render_kv_fabric(engine, labels: str) -> list[str]:
+        """KV-fabric families (docs/design/pd-disaggregation.md): the
+        layer-streamed PD transfer's frame/byte/overlap accounting and
+        the cross-engine prefix-pull counters.  The overlap gauge is the
+        streamed-vs-slab A/B's figure of merit — payload bytes that
+        crossed the wire while the prefiller was still computing,
+        divided by all streamed payload bytes (slab transfers read 0).
+        Engines predating the fabric (test stubs) omit the families."""
+        if not hasattr(engine, "kv_stream_frames_total"):
+            return []
+        total = engine.kv_stream_bytes_total
+        overlap = (engine.kv_stream_overlapped_bytes_total / total
+                   if total else 0.0)
+        lines = [
+            "# HELP fusioninfer:kv_stream_frames_total Layer-streamed PD frames adopted by this decode engine.",
+            "# TYPE fusioninfer:kv_stream_frames_total counter",
+            f"fusioninfer:kv_stream_frames_total{{{labels}}} {engine.kv_stream_frames_total}",
+            "# HELP fusioninfer:kv_stream_bytes_total KV payload bytes received over streamed PD transfers.",
+            "# TYPE fusioninfer:kv_stream_bytes_total counter",
+            f"fusioninfer:kv_stream_bytes_total{{{labels}}} {engine.kv_stream_bytes_total}",
+            "# HELP fusioninfer:kv_stream_overlapped_bytes_total Streamed KV payload bytes that arrived while the prefiller was still computing.",
+            "# TYPE fusioninfer:kv_stream_overlapped_bytes_total counter",
+            f"fusioninfer:kv_stream_overlapped_bytes_total{{{labels}}} {engine.kv_stream_overlapped_bytes_total}",
+            "# HELP fusioninfer:kv_stream_transfer_overlap_fraction Lifetime fraction of streamed KV payload hidden behind prefill compute.",
+            "# TYPE fusioninfer:kv_stream_transfer_overlap_fraction gauge",
+            f"fusioninfer:kv_stream_transfer_overlap_fraction{{{labels}}} {overlap:.6f}",
+            "# HELP fusioninfer:kv_stream_admissions_total Requests admitted from a complete PD frame stream.",
+            "# TYPE fusioninfer:kv_stream_admissions_total counter",
+            f"fusioninfer:kv_stream_admissions_total{{{labels}}} {engine.kv_stream_admissions_total}",
+            "# HELP fusioninfer:kv_stream_fallbacks_total Stream faults degraded to a local re-prefill (bit-identical output).",
+            "# TYPE fusioninfer:kv_stream_fallbacks_total counter",
+            f"fusioninfer:kv_stream_fallbacks_total{{{labels}}} {engine.kv_stream_fallbacks_total}",
+            "# HELP fusioninfer:kv_fabric_restored_blocks_total Prefix blocks restored from a PEER engine's host tier via the fabric pull path.",
+            "# TYPE fusioninfer:kv_fabric_restored_blocks_total counter",
+            f"fusioninfer:kv_fabric_restored_blocks_total{{{labels}}} {engine.kv_fabric_restored_blocks_total}",
+        ]
+        fabric = getattr(engine, "_kv_fabric", None)
+        if fabric is not None:
+            c = fabric.counters()
+            lines += [
+                "# HELP fusioninfer:kv_fabric_pull_requests_total Cross-engine kv_export pull round-trips attempted.",
+                "# TYPE fusioninfer:kv_fabric_pull_requests_total counter",
+                f"fusioninfer:kv_fabric_pull_requests_total{{{labels}}} {c['pull_requests']}",
+                "# HELP fusioninfer:kv_fabric_pulled_blocks_total Frames fetched from peer host tiers (pre-import).",
+                "# TYPE fusioninfer:kv_fabric_pulled_blocks_total counter",
+                f"fusioninfer:kv_fabric_pulled_blocks_total{{{labels}}} {c['pulled_blocks']}",
+                "# HELP fusioninfer:kv_fabric_pull_rejected_total Pulled frames rejected at the pairing-CRC door.",
+                "# TYPE fusioninfer:kv_fabric_pull_rejected_total counter",
+                f"fusioninfer:kv_fabric_pull_rejected_total{{{labels}}} {c['pull_rejected']}",
+                "# HELP fusioninfer:kv_fabric_pull_faults_total Pull transport faults (peer vanished, timeout, injected).",
+                "# TYPE fusioninfer:kv_fabric_pull_faults_total counter",
+                f"fusioninfer:kv_fabric_pull_faults_total{{{labels}}} {c['pull_faults']}",
+            ]
         return lines
 
     @staticmethod

@@ -95,6 +95,29 @@ class EventBroadcaster:
         return json.loads(bytes(out[:n].tobytes()))
 
 
+def broadcast_json(obj: Optional[dict], is_leader: bool) -> dict:
+    """One leader→all JSON broadcast outside the event stream — the
+    leader-coordinated host-tier restore ships its (plan, frame-bytes)
+    decision through here at a replicated call point.  Two-phase like
+    :meth:`EventBroadcaster.exchange` (int32 length, then a
+    pow2-bucketed uint8 payload so long-lived servers never grow the
+    collective compile cache); EVERY process must reach this call at
+    the same step or the mesh deadlocks — callers gate entry on
+    replicated state only.  Followers pass ``obj=None``."""
+    from jax.experimental import multihost_utils as mu
+
+    payload = json.dumps(obj).encode() if is_leader and obj else b""
+    n = int(mu.broadcast_one_to_all(np.int32(len(payload))))
+    if n == 0:
+        return {}
+    bucket = _payload_bucket(n)
+    buf = np.zeros(bucket, np.uint8)
+    if is_leader:
+        buf[:n] = np.frombuffer(payload, np.uint8)
+    out = np.asarray(mu.broadcast_one_to_all(buf))
+    return json.loads(bytes(out[:n].tobytes()))
+
+
 def _payload_bucket(n: int, floor: int = 256) -> int:
     """Smallest power-of-two >= max(n, floor) — bounds the number of
     distinct broadcast shapes (and thus compiles) at log2(max payload)."""

@@ -192,6 +192,9 @@ class EngineServer:
         prefill_upstream: str | None = None,
         kv_retry: RetryPolicy | None = None,
         kv_fault_injector=None,
+        kv_stream: bool = True,
+        kv_peers=None,
+        kv_peer_resolver=None,
         default_deadline_s: float | None = None,
         watchdog_stall_s: float | None = None,
         watchdog_interval_s: float = 0.05,
@@ -210,6 +213,23 @@ class EngineServer:
         when the budget is exhausted the request re-prefills LOCALLY —
         slower, but it completes (graceful degradation over DCN).
         ``kv_fault_injector`` arms the connector's chaos sites.
+
+        ``kv_stream`` (default on): prefer the LAYER-STREAMED transfer
+        — ``POST /v1/prefill_stream`` pushes per-(layer, page-range)
+        fabric frames while the prefiller is still computing later
+        chunks, and the decode engine adopts pages as frames land
+        (docs/design/pd-disaggregation.md).  Requests may override per
+        call with a ``kv_stream`` body field (the bench/fleet A/B).  A
+        peer that 404s the endpoint (older build) silently demotes this
+        server to the slab path; any mid-stream fault falls back to a
+        local re-prefill — bit-identical output either way.
+
+        ``kv_peers`` / ``kv_peer_resolver`` wire the engine's KV fabric
+        pull client (``engine/kv_fabric.py``): prefix blocks missing
+        from the local host tier are pulled from whichever peer's host
+        tier holds them (resolver maps block-hash hex → base URL —
+        in the fleet it closes over the EPP's residency digests) before
+        degrading to recompute; ``kv_peers`` is the static probe list.
 
         ``default_deadline_s`` bounds every request's wall time unless
         the request carries its own ``deadline_s``; ``watchdog_stall_s``
@@ -249,6 +269,11 @@ class EngineServer:
         self.watchdog_stall_s = watchdog_stall_s
         self.watchdog_interval_s = watchdog_interval_s
         self._pull_connector = None
+        self.kv_stream = kv_stream
+        # flipped sticky when the upstream 404s /v1/prefill_stream (an
+        # older build): later requests go straight to the slab path
+        # instead of re-probing per request
+        self._peer_stream_unsupported = False
         if prefill_upstream:
             self._pull_connector = HTTPPullConnector(
                 prefill_upstream,
@@ -264,6 +289,15 @@ class EngineServer:
                 seed=seed,
             )
         self.engine = engine
+        if (kv_peers or kv_peer_resolver is not None) \
+                and hasattr(engine, "set_kv_fabric"):
+            from fusioninfer_tpu.engine.kv_fabric import KVFabric
+
+            engine.set_kv_fabric(KVFabric(
+                peers=tuple(kv_peers or ()),
+                resolver=kv_peer_resolver,
+                fault_injector=kv_fault_injector,
+            ))
         self.tokenizer = tokenizer or load_tokenizer()
         if not getattr(engine, "guided_enabled", False):
             from fusioninfer_tpu.engine.token_mask import token_byte_strings
@@ -506,7 +540,7 @@ class EngineServer:
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
                lora: str = "", priority: int = 0,
                deadline_s: float | None = None,
-               tier=None) -> _RequestChannel:
+               tier=None, kv_stream: bool | None = None) -> _RequestChannel:
         request_id = uuid.uuid4().hex[:16]
         chan = _RequestChannel()
         deadline_s = deadline_s if deadline_s is not None else self.default_deadline_s
@@ -582,6 +616,13 @@ class EngineServer:
                     "guided_json": params.guided_json,
                     "guided_schema": params.guided_schema,
                 }
+                use_stream = self.kv_stream if kv_stream is None \
+                    else bool(kv_stream)
+                if (use_stream and not self._peer_stream_unsupported
+                        and not getattr(self.engine, "is_multihost",
+                                        False)):
+                    if self._submit_streamed(request, sampling):
+                        return chan
                 try:
                     slab = self._pull_connector.request_prefill(
                         request_id, prompt_tokens, sampling=sampling,
@@ -638,6 +679,62 @@ class EngineServer:
             raise
         return chan
 
+    def _submit_streamed(self, request: Request, sampling: dict) -> bool:
+        """PD decode over the layer-streamed fabric: register a
+        :class:`StreamIntake` with the engine FIRST (pages adopt
+        frame-by-frame inside ``step`` while this thread is still
+        reading the socket), then pull ``/v1/prefill_stream`` feeding
+        frames straight into it.  Returns True when the stream path now
+        owns the request — including mid-stream faults, which the
+        ENGINE degrades (local re-prefill, bit-identical).  Returns
+        False only when the stream never usefully started (the peer
+        404s the endpoint — an older build): the intake is cancelled
+        and the caller's slab path takes over untouched."""
+        from fusioninfer_tpu.engine.kv_fabric import (
+            KVFabricError,
+            StreamIntake,
+        )
+
+        intake = StreamIntake(request.request_id)
+        # ValueError (unknown adapter, bad grammar, prompt too long)
+        # propagates: client error, same as the slab path's eager checks
+        self.engine.add_prefilled_stream(request, intake)
+        # the watchdog may have aborted this request between channel
+        # registration and engine registration — its cancel() saw
+        # nothing admitted.  Re-issue now that the stream is registered
+        # so the next step reaps it instead of admitting an orphan.
+        with self._lock:
+            aborted = self._req_meta.get(
+                request.request_id, {}).get("aborted")
+        if aborted:
+            self.engine.cancel(request.request_id)
+        try:
+            self._pull_connector.pull_prefill_stream(
+                request.request_id, request.prompt_tokens,
+                sink=intake.feed_bytes, sampling=sampling,
+                lora=request.lora)
+            intake.close()
+        except (KVTransferError, KVFabricError) as e:
+            status = getattr(e, "status", None)
+            if intake.frames_fed == 0 and status == 404:
+                # the peer predates the endpoint: withdraw the stream
+                # silently (no fallback churn) and demote this server
+                # to the slab path for all later requests
+                intake.cancel()
+                self._peer_stream_unsupported = True
+                logger.info(
+                    "prefill upstream has no /v1/prefill_stream; "
+                    "using the slab transfer path")
+                return False
+            # mid-stream fault (transport, corrupt frame, truncation):
+            # the engine owns the degrade — it releases the adopted
+            # pages and re-prefills locally, bit-identical
+            logger.warning(
+                "KV stream for %s failed (%s); engine falls back to "
+                "local prefill", request.request_id, e)
+            intake.fail(e)
+        return True
+
     def handle_profile(self, body: dict) -> dict:
         """On-demand device profiling (aux subsystem the reference lacks —
         its only observability is controller-runtime metrics, SURVEY §5):
@@ -692,6 +789,15 @@ class EngineServer:
                 raise Draining("server is draining; retry another replica")
         from fusioninfer_tpu.engine.kv_transfer import slab_to_bytes
 
+        fut = self.engine.request_prefill_slab(self._prefill_request(body))
+        slab = fut.result(timeout=120.0)
+        return slab_to_bytes(slab)
+
+    @staticmethod
+    def _prefill_request(body: dict) -> Request:
+        """Parse a prefill-role body (``/v1/prefill`` and
+        ``/v1/prefill_stream`` share the schema) into the one-token
+        request both transfer shapes run."""
         prompt_tokens = [int(t) for t in body.get("prompt_tokens", [])]
         if not prompt_tokens:
             raise ValueError("prompt_tokens required")
@@ -715,11 +821,102 @@ class EngineServer:
             guided_schema=str(sampling.get("guided_schema", "") or ""),
         )
         rid = body.get("request_id") or uuid.uuid4().hex[:16]
-        fut = self.engine.request_prefill_slab(
-            Request(rid, prompt_tokens, params,
-                    lora=str(body.get("lora") or "")))
-        slab = fut.result(timeout=120.0)
-        return slab_to_bytes(slab)
+        return Request(rid, prompt_tokens, params,
+                       lora=str(body.get("lora") or ""))
+
+    def handle_prefill_stream(self, body: dict):
+        """Prefiller role, layer-streamed: run one chunked prefill and
+        yield serialized fabric frames AS PAGES COMPLETE — the HTTP
+        handler writes each onto the chunked response while the engine
+        is still computing later chunks.  Validation happens eagerly
+        (a bad request still gets a clean JSON 400 before any byte of
+        the 200 streams); a mid-prefill engine fault truncates the
+        stream, which the decoder detects (incomplete coverage) and
+        degrades to local re-prefill."""
+        with self._lock:
+            if self._evacuating:
+                raise Evacuating(
+                    "server is evacuating (slice revoked); retry "
+                    "another replica", self._evac_retry_after_locked())
+            if self._draining:
+                raise Draining("server is draining; retry another replica")
+        if getattr(self.engine, "is_multihost", False):
+            # sharded KV must host-gather via a collective before any
+            # byte leaves — the slab endpoint owns that shape
+            raise ValueError(
+                "streamed prefill is single-process; POST /v1/prefill "
+                "for the slab transfer")
+        request = self._prefill_request(body)
+        frames_q: queue.Queue = queue.Queue()
+        # ValueError (unknown adapter, bad grammar) raises HERE, before
+        # the handler commits to a 200
+        fut = self.engine.request_prefill_stream(request, frames_q.put)
+        deadline = time.monotonic() + 120.0
+
+        def frames():
+            while time.monotonic() < deadline:
+                try:
+                    yield frames_q.get(timeout=0.05)
+                    continue
+                except queue.Empty:
+                    pass
+                if fut.done():
+                    # the sink runs on the engine thread BEFORE the
+                    # future resolves, so the queue now holds the tail
+                    while True:
+                        try:
+                            yield frames_q.get_nowait()
+                        except queue.Empty:
+                            break
+                    exc = fut.exception()
+                    if exc is not None:
+                        logger.warning(
+                            "streamed prefill %s failed (%s); stream "
+                            "truncates and the decoder falls back",
+                            request.request_id, exc)
+                    return
+            logger.warning(
+                "streamed prefill %s timed out; stream truncates and "
+                "the decoder falls back", request.request_id)
+
+        return frames()
+
+    def handle_kv_export(self, query: dict) -> dict:
+        """``GET /v1/kv_export?hashes=<hex,hex,...>[&limit=N]`` — the
+        demand-pull door of the fleet's distributed prefix cache: serve
+        resident host-tier frames for the requested block hashes.  The
+        response mirrors the ``/v1/kv_import`` push schema — each frame
+        rides with the (hash‖data) pairing CRC so the puller can never
+        adopt KV under a hash it was not exported for.  Misses and
+        malformed hashes just shorten the response (the puller
+        recomputes); an engine with no host tier serves nobody."""
+        import base64
+
+        from fusioninfer_tpu.engine.kv_fabric import pairing_crc
+
+        raw = query.get("hashes", "")
+        raw = raw[0] if isinstance(raw, list) else raw
+        hashes: list[bytes] = []
+        for part in str(raw or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                hashes.append(bytes.fromhex(part))
+            except ValueError:
+                continue  # malformed address: a miss, not an error
+        lim = query.get("limit")
+        lim = lim[0] if isinstance(lim, list) else lim
+        try:
+            limit = int(lim) if lim else 0
+        except ValueError:
+            limit = 0
+        export = getattr(self.engine, "export_host_frames", None)
+        frames = export(hashes, limit) if callable(export) else []
+        return {"frames": [
+            {"hash": h.hex(), "data": base64.b64encode(data).decode(),
+             "crc": pairing_crc(h, data)}
+            for h, data in frames]}
 
     def _release(self, chan: _RequestChannel) -> None:
         with self._lock:
@@ -908,7 +1105,7 @@ class EngineServer:
         if n == 1:
             chan = self.submit(prompt_tokens, params, lora=lora,
                                priority=priority, deadline_s=deadline_s,
-                               tier=tier)
+                               tier=tier, kv_stream=self._kv_stream_of(body))
             gen = self._stream_chunks(chan, chat, params.stop_strings,
                                       served_model=served,
                                       completion_id=completion_id,
@@ -922,7 +1119,8 @@ class EngineServer:
                                              completion_id, created)
             return chan, gen
         chans = self._submit_n(prompt_tokens, params, lora, n, priority,
-                               deadline_s=deadline_s, tier=tier)
+                               deadline_s=deadline_s, tier=tier,
+                               kv_stream=self._kv_stream_of(body))
         gens = [
             self._stream_chunks(c, chat, params.stop_strings,
                                 served_model=served, choice_index=i,
@@ -938,9 +1136,17 @@ class EngineServer:
                                             completion_id, created)
         return _MultiChannel(chans), merged
 
+    @staticmethod
+    def _kv_stream_of(body: dict) -> bool | None:
+        """Per-request transfer-shape override (the streamed-vs-slab
+        A/B rides this): absent → server default."""
+        if "kv_stream" not in body:
+            return None
+        return bool(body.get("kv_stream"))
+
     def _submit_n(self, prompt_tokens, params, lora: str, n: int,
                   priority: int = 0, deadline_s: float | None = None,
-                  tier=None):
+                  tier=None, kv_stream: bool | None = None):
         """Submit n per-choice requests; on any failure, abort the ones
         already submitted (they would otherwise decode to max_tokens with
         no consumer and leak their channel registrations)."""
@@ -949,7 +1155,8 @@ class EngineServer:
             for i in range(n):
                 chans.append(self.submit(
                     prompt_tokens, self._choice_params(params, i), lora=lora,
-                    priority=priority, deadline_s=deadline_s, tier=tier))
+                    priority=priority, deadline_s=deadline_s, tier=tier,
+                    kv_stream=kv_stream))
         except Exception:
             for c in chans:
                 self.abort(c)
@@ -1315,7 +1522,8 @@ class EngineServer:
         chans = self._submit_n(prompt_tokens, params, lora, n,
                                self._tier_priority(body, tier),
                                deadline_s=self._deadline_of(body),
-                               tier=tier)
+                               tier=tier,
+                               kv_stream=self._kv_stream_of(body))
         echo = bool(body.get("echo"))
         choices = []
         total_completion = 0
@@ -1760,6 +1968,14 @@ class EngineServer:
                                                   "residency"}}, 404)
                     else:
                         self._send_json(residency())
+                elif self.path.split("?", 1)[0] == "/v1/kv_export":
+                    # demand pull of resident host-tier frames — the
+                    # serving side of the fleet's distributed prefix
+                    # cache (engine/kv_fabric.py pulls here)
+                    from urllib.parse import parse_qs, urlsplit
+
+                    self._send_json(server.handle_kv_export(
+                        parse_qs(urlsplit(self.path).query)))
                 elif self.path == "/v1/models":
                     models = [server.model_name]
                     lora_set = getattr(server.engine, "lora_set", None)
@@ -1830,6 +2046,24 @@ class EngineServer:
                         self.send_header("Content-Length", str(len(frame)))
                         self.end_headers()
                         self.wfile.write(frame)
+                    elif self.path == "/v1/prefill_stream":
+                        # validate + submit BEFORE the 200: a rejected
+                        # request still gets a clean JSON error
+                        frames = server.handle_prefill_stream(body)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        import struct
+
+                        for data in frames:
+                            payload = struct.pack(">I", len(data)) + data
+                            self.wfile.write(
+                                f"{len(payload):X}\r\n".encode()
+                                + payload + b"\r\n")
+                            self.wfile.flush()  # frames must not batch
+                        self.wfile.write(b"0\r\n\r\n")  # chunked EOF
                     else:
                         self._send_json({"error": {"message": f"not found: {self.path}"}}, 404)
                 except Retriable as e:
@@ -2301,6 +2535,8 @@ def serve_from_args(args) -> int:
         port=args.port,
         engine=engine,
         prefill_upstream=getattr(args, "prefill_upstream", None) or None,
+        kv_stream=getattr(args, "kv_stream", True),
+        kv_peers=getattr(args, "kv_peer", None) or [],
         slo_tiers=slo_tiers,
         evacuate_grace_s=_nonneg_flag(args, "evacuate_grace_s"),
         evacuate_peers=getattr(args, "evacuate_peer", None) or [],

@@ -40,7 +40,7 @@ def stream_completion(
     url: str, prompt: str, max_tokens: int, timeout_s: float, seed: int,
     temperature: float = 0.0,
     on_first_chunk: Optional[Callable[[], None]] = None,
-    slo_tier: str = "",
+    slo_tier: str = "", extra_body: Optional[dict] = None,
 ) -> tuple[Optional[float], Optional[float], list, Optional[str],
            Optional[str], Optional[float]]:
     """One streaming completion against ``url`` →
@@ -70,6 +70,10 @@ def stream_completion(
     }
     if slo_tier:
         payload_body["slo_tier"] = slo_tier
+    if extra_body:
+        # per-request server knobs (the PD phase's streamed-vs-slab A/B
+        # passes ``{"kv_stream": false}`` here)
+        payload_body.update(extra_body)
     body = json.dumps(payload_body).encode()
     req = urllib.request.Request(
         f"{url}/v1/completions", data=body,
@@ -152,7 +156,8 @@ class FleetClient:
     def request(self, prompt: str, max_tokens: int, stratum: str,
                 phase: str, seed: int = 0, temperature: float = 0.0,
                 on_first_chunk: Optional[Callable[[], None]] = None,
-                pick=None, slo_tier: str = "") -> dict:
+                pick=None, slo_tier: str = "",
+                extra_body: Optional[dict] = None) -> dict:
         """One logical fleet request; returns (and logs) its result row.
         ``pick`` overrides endpoint selection (the PD pair path passes
         a pre-picked leg).  ``slo_tier`` tags the request's traffic
@@ -182,7 +187,8 @@ class FleetClient:
             t_attempt = time.perf_counter()
             ttft, tpot, ids, finish, err, retry_after = stream_completion(
                 ep.url, prompt, max_tokens, self.timeout_s, seed,
-                temperature, on_first_chunk, slo_tier=slo_tier)
+                temperature, on_first_chunk, slo_tier=slo_tier,
+                extra_body=extra_body)
             ok = err is None and finish in ("length", "stop")
             if err == "http_429" or (err == "http_503"
                                      and retry_after is not None):

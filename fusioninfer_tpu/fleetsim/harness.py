@@ -66,7 +66,7 @@ from fusioninfer_tpu.autoscale.controller import (
 )
 from fusioninfer_tpu.benchmark.loadgen import poisson_arrivals, random_prompt
 from fusioninfer_tpu.engine.kv_cache import CacheConfig
-from fusioninfer_tpu.fleetsim.client import FleetClient
+from fusioninfer_tpu.fleetsim.client import FleetClient, stream_completion
 from fusioninfer_tpu.fleetsim.record import (
     build_record,
     pcts_ms,
@@ -261,6 +261,14 @@ class FleetConfig:
     # optional PD-disaggregated service riding the same fleet
     pd_enabled: bool = False
     pd_requests: int = 2
+    # the KV-fabric pd phase: streamed-vs-slab A/B prompts must span
+    # several prefill chunks (token_budget=96 → 96-token chunks) so
+    # most pages leave the prefiller DURING its forward — 200 chars is
+    # ~25 pages against a 12-page chunk, overlap ~0.9; the cross-engine
+    # leg reuses the eviction shape to push the warm chain into worker
+    # A's host tier before worker B pulls it
+    pd_ab_prompts: int = 2
+    pd_stream_prompt_len: int = 200
     # plumbing
     tick_advance_s: float = 0.2
     tick_pause_s: float = 0.1
@@ -333,6 +341,18 @@ def _scrape_overload_counters(url: str,
                               timeout: float = 5.0) -> Optional[dict]:
     """The overload ledger's engine-side counters off one /metrics."""
     return _scrape_counters(url, _OVERLOAD_COUNTERS, timeout)
+
+
+# KV-fabric counters the pd phase diffs off the decoder (streamed
+# overlap accounting) and off worker B (cross-engine pull ledger)
+_PD_COUNTERS = {
+    "stream_bytes": "fusioninfer:kv_stream_bytes_total",
+    "stream_overlapped": "fusioninfer:kv_stream_overlapped_bytes_total",
+    "stream_admissions": "fusioninfer:kv_stream_admissions_total",
+    "stream_fallbacks": "fusioninfer:kv_stream_fallbacks_total",
+    "fabric_restored": "fusioninfer:kv_fabric_restored_blocks_total",
+    "fabric_pull_rejected": "fusioninfer:kv_fabric_pull_rejected_total",
+}
 
 
 # AOT warm-start evidence off a freshly scaled pod's /metrics: the
@@ -495,10 +515,35 @@ class FleetHarness:
         aot.warmup(engine)
         import yaml as _yaml
 
+        # main-fleet workers join the KV fabric: a resolver closing
+        # over the EPP's ResidencyProvider maps a missing block chain
+        # to the peer whose HOST tier holds it (the engine pulls it
+        # over /v1/kv_export instead of recomputing) — the prefill
+        # fleet as one distributed prefix cache.  Best-effort by
+        # construction: before boot finishes (or on any scrape fault)
+        # the resolver answers "nobody", which is a miss, never an
+        # error.  The PD pods stay out — their cross-engine story is
+        # the streamed prefill transfer itself.
+        kv_resolver = None
+        if not lws_name.startswith(f"{cfg.service_name}-pd"):
+            self_pod = f"{lws_name}-0"
+
+            def kv_resolver(hashes_hex, _self=self_pod):
+                residency = getattr(self, "residency", None)
+                if residency is None:
+                    return {}
+                try:
+                    return residency.block_holders(
+                        hashes_hex, self._worker_endpoints(),
+                        exclude=_self)
+                except Exception:
+                    return {}
+
         return EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,
                             engine=engine,
                             prefill_upstream=prefill_upstream,
                             kv_fault_injector=inj,
+                            kv_peer_resolver=kv_resolver,
                             slo_tiers=_yaml.safe_load(EPP_CONFIG)["sloTiers"],
                             boot_t0=boot_t0)
 
@@ -753,6 +798,7 @@ class FleetHarness:
             self.boot()
         t0 = time.perf_counter()
         self._phase_steady()
+        self._phase_pd()
         self._phase_scale_up()
         self._phase_overload()
         self._phase_revocation()
@@ -784,6 +830,124 @@ class FleetHarness:
         with self._lock:
             self.hit_rates["steady"] = rate
         self._phase_end("steady")
+
+    def _phase_pd(self) -> None:
+        """The KV-fabric phase (docs/design/pd-disaggregation.md).
+
+        Three legs, each byte-verified through the client's greedy
+        reference machinery: (1) streamed-vs-slab A/B through the PD
+        pair — the same prompts run on the main fleet (the monolithic
+        reference), then streamed through the decoder, then again with
+        the per-request ``kv_stream: false`` override riding the slab
+        path; the decoder's counter deltas prove the streamed leg hid
+        ≥50% of its KV payload behind prefill compute and the slab leg
+        moved zero streamed bytes.  (2) a seeded-sampled A/B pair whose
+        raw id streams must match exactly.  (3) the cross-engine
+        steady-state pull: a warm chain is evicted into worker A's host
+        tier, then the same prompt pinned to worker B restores it over
+        ``/v1/kv_export`` via the fleet-residency resolver instead of
+        recomputing."""
+        if self.pd_picker is None:
+            return
+        cfg = self.cfg
+        phase = "pd"
+        dec = next(ep for ep in self._pd_pods() if "decoder" in ep.name)
+
+        def pd_pick(prompt):
+            return lambda: self.pd_picker.pick(prompt, "decode")
+
+        prompts = [random_prompt(cfg.pd_stream_prompt_len,
+                                 self._prompt_base() + 7 * 10**6 + i)
+                   for i in range(cfg.pd_ab_prompts)]
+        for i, prompt in enumerate(prompts):
+            # the monolithic reference leg seeds the greedy id ref
+            self.client.request(prompt, cfg.output_len, "pd_ref", phase,
+                                seed=cfg.seed + 700 + i)
+        base = _scrape_counters(dec.url, _PD_COUNTERS)
+        for i, prompt in enumerate(prompts):
+            self.client.request(prompt, cfg.output_len, "pd_stream",
+                                phase, seed=cfg.seed + 710 + i,
+                                pick=pd_pick(prompt))
+        mid = _scrape_counters(dec.url, _PD_COUNTERS)
+        for i, prompt in enumerate(prompts):
+            self.client.request(prompt, cfg.output_len, "pd_slab",
+                                phase, seed=cfg.seed + 720 + i,
+                                pick=pd_pick(prompt),
+                                extra_body={"kv_stream": False})
+        after = _scrape_counters(dec.url, _PD_COUNTERS)
+
+        def delta(key):
+            if base is None or mid is None or after is None:
+                return -1.0  # unobservable decoder: fail loudly, not 0
+            return {"stream": mid[key] - base[key],
+                    "slab": after[key] - mid[key]}
+
+        stream_bytes = delta("stream_bytes")
+        overlapped = delta("stream_overlapped")
+        overlap = (overlapped["stream"] / stream_bytes["stream"]
+                   if isinstance(stream_bytes, dict)
+                   and stream_bytes["stream"] > 0 else 0.0)
+
+        # seeded-sampled A/B: same prompt + seed through both transfer
+        # paths must yield the same raw id stream (the first token is
+        # sampled ON the prefiller either way; later tokens ride the
+        # request seed on the decoder)
+        sampled = random_prompt(cfg.pd_stream_prompt_len,
+                                self._prompt_base() + 7 * 10**6 + 90)
+        sp = self.pd_picker.pick(sampled, "decode")
+        ab_ids = []
+        for extra in (None, {"kv_stream": False}):
+            _, _, ids, _, err, _ = stream_completion(
+                sp.url, sampled, cfg.output_len, cfg.client_timeout_s,
+                cfg.seed + 730, temperature=0.9, extra_body=extra)
+            ab_ids.append(ids if err is None else None)
+        sampled_match = (ab_ids[0] is not None and bool(ab_ids[0])
+                         and ab_ids[0] == ab_ids[1])
+
+        # cross-engine pull: warm A, evict the chain into A's host
+        # tier under churn, then pin the warm prompt to B — the fabric
+        # restores from A instead of recomputing, byte-verified against
+        # A's greedy reference
+        workers = sorted(self._worker_endpoints(), key=lambda e: e.name)
+        a, b = workers[0], workers[1]
+        warm = random_prompt(cfg.eviction_prompt_len,
+                             self._prompt_base() + 7 * 10**6 + 95)
+        self.client.request(warm, cfg.output_len, "pd_xengine", phase,
+                            seed=cfg.seed + 740, pick=lambda: a)
+        for j in range(cfg.eviction_prompts):
+            churn = random_prompt(
+                cfg.eviction_prompt_len,
+                self._prompt_base() + 7 * 10**6 + 100 + j)
+            self.client.request(churn, 2, "pd_churn", phase,
+                                seed=cfg.seed + 750 + j, pick=lambda: a)
+        b_base = _scrape_counters(b.url, _PD_COUNTERS)
+        self.client.request(warm, cfg.output_len, "pd_xengine", phase,
+                            seed=cfg.seed + 741, pick=lambda: b)
+        b_after = _scrape_counters(b.url, _PD_COUNTERS)
+        pulled = (b_after["fabric_restored"] - b_base["fabric_restored"]
+                  if b_base is not None and b_after is not None else -1)
+
+        with self._lock:
+            self._slo_extra["pd_fabric"] = {
+                "transfer_overlap_fraction": round(max(overlap, 0.0), 4),
+                "stream_admissions": (
+                    delta("stream_admissions")["stream"]
+                    if isinstance(delta("stream_admissions"), dict)
+                    else -1),
+                "slab_stream_bytes": (
+                    stream_bytes["slab"]
+                    if isinstance(stream_bytes, dict) else -1),
+                "stream_fallbacks": (
+                    delta("stream_fallbacks")["stream"]
+                    + delta("stream_fallbacks")["slab"]
+                    if isinstance(stream_bytes, dict) else -1),
+                "sampled_ab_match": sampled_match,
+                "cross_engine_pulled_blocks": pulled,
+            }
+        self._note(
+            f"pd:fabric overlap={overlap:.2f} "
+            f"sampled_ab={int(sampled_match)} pulled={int(pulled)}")
+        self._phase_end(phase)
 
     def _record_warm_start(self, pre_names: set) -> None:
         """AOT warm-start evidence off every pod the scale-up bought:
@@ -1424,10 +1588,13 @@ class FleetHarness:
 
     def _build(self, duration_s: float) -> dict:
         cfg = self.cfg
+        phase_names = ["steady", "scale_up", "overload", "revocation",
+                       "faults", "recover", "drain"]
+        if cfg.pd_enabled:
+            phase_names.insert(1, "pd")
         phases = {
             name: phase_summary(self.client.rows(name))
-            for name in ("steady", "scale_up", "overload", "revocation",
-                         "faults", "recover", "drain")
+            for name in phase_names
         }
         scaleup_inter = [
             r["ttft_s"] for r in self.client.rows("scale_up")

@@ -19,6 +19,17 @@ Sites in the tree today:
 ``kv.host.restore``          before a host-tier frame is parsed for restore
 ``kv.host.restore.data``     corrupts the frame on the restore path
                              (CRC32 catches; entry dropped, prefix recomputes)
+``kv.fabric.stream``         before the streamed-prefill connect and before
+                             each frame read (``after=N`` arms mid-stream;
+                             :mod:`fusioninfer_tpu.engine.kv_fabric` — decode
+                             falls back to local re-prefill, bit-identical)
+``kv.fabric.stream.data``    corrupts a streamed fabric frame (envelope CRC
+                             catches at the intake door; same fallback)
+``kv.fabric.pull``           before a cross-engine ``/v1/kv_export`` pull
+                             (a fault shortens the restored chain: the
+                             missing suffix recomputes)
+``kv.fabric.pull.data``      corrupts a pulled frame (pairing CRC rejects
+                             it; that block recomputes)
 ``router.metrics.<ep>``      a picker endpoint's metrics scrape
                              (:mod:`fusioninfer_tpu.router.picker`)
 ``operator.reconcile.<Kind>``  one reconcile invocation

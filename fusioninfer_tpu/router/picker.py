@@ -390,6 +390,33 @@ class ResidencyProvider:
                 if name not in keep:
                     del self._cache[name]
 
+    def block_holders(self, hashes, endpoints,
+                      exclude: str = "") -> dict[str, str]:
+        """Which peer's HOST tier holds each block: hash hex → base URL,
+        from the same cached digests that score routing.  This is the
+        KV fabric's resolver view (``engine/kv_fabric.py``): an engine
+        missing a prefix chain asks the fleet residency map who to pull
+        from, so the residency digests route requests AND frames.
+
+        Only host-tier residency counts — ``/v1/kv_export`` serves from
+        the host tier, so an HBM-only holder cannot satisfy a pull.
+        ``exclude`` drops the asking engine itself (its own miss is why
+        it is asking).  Best-effort by construction: a stale or absent
+        digest just yields fewer holders and the puller's static peer
+        list (or recompute) covers the rest."""
+        want = [str(h) for h in hashes or ()]
+        out: dict[str, str] = {}
+        for ep in endpoints or ():
+            if exclude and exclude in (ep.name, ep.url):
+                continue
+            d = self.digest(ep)
+            if d is None:
+                continue
+            for hh in want:
+                if hh not in out and hh in d["host"]:
+                    out[hh] = ep.url
+        return out
+
     def _usable_chain(self, prompt: str, page_size: int) -> list:
         memo = self._chain_memo
         if memo is not None and memo[0] == prompt and memo[1] == page_size:

@@ -444,6 +444,10 @@ def pd_rig():
         kv_retry=RetryPolicy(max_attempts=3, base_delay_s=0.01,
                              max_delay_s=0.05, seed=1),
         kv_fault_injector=injector,
+        # Pin the legacy whole-slab pull: this class chaoses the kv.pull
+        # sites.  The layer-streamed path has its own chaos coverage
+        # (tests/test_kv_fabric.py::TestStreamChaos).
+        kv_stream=False,
     )
     decode.start()
     mono = EngineServer(model="qwen3-tiny", host="127.0.0.1", port=0,

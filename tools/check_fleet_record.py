@@ -31,7 +31,17 @@ properties ARE the acceptance criteria of the fleet harness
   actually exported to AND imported by a survivor, at least one
   replacement scale-up applied ahead of the metrics loop, and
   interactive TTFT p90 bounded through the waves
-  (docs/design/spot-revocation.md).
+  (docs/design/spot-revocation.md);
+* the PD phase (when the PD pair rode the run) proved the KV fabric:
+  the layer-streamed transfer hid ≥50% of its KV payload behind
+  prefill compute (``transfer_overlap_fraction >= 0.5``) while the
+  slab A/B leg moved zero streamed bytes, the seeded-sampled A/B pair
+  matched id-for-id across both transfer paths, and a cross-engine
+  steady-state restore actually pulled blocks from a peer's host tier
+  (``cross_engine_pulled_blocks >= 1``) — byte-verification of every
+  PD stream against the monolithic reference rides the record-wide
+  ``corrupted_streams == 0`` gate
+  (docs/design/pd-disaggregation.md).
 
 Usage: ``python tools/check_fleet_record.py [FLEET_OUT.json]``.
 """
@@ -154,6 +164,7 @@ def check_record(record: dict) -> list[str]:
             f"({slo.get('drain_victim')!r})")
     problems += check_overload(record)
     problems += check_revocation(record)
+    problems += check_pd(record)
     if not record.get("event_ledger"):
         problems.append("event_ledger missing (determinism evidence)")
     return problems
@@ -221,6 +232,53 @@ def check_revocation(record: dict) -> list[str]:
     return problems
 
 
+def check_pd(record: dict) -> list[str]:
+    """Gate the KV-fabric pd phase (runs only when the record's config
+    says the PD pair rode the fleet): streamed transfer overlapped
+    ≥50% with prefill compute, the slab A/B leg moved zero streamed
+    bytes, the seeded-sampled pair matched across both paths, and at
+    least one block was restored from a PEER's host tier.  Negative
+    counter values mean the decoder/worker was unobservable when the
+    harness scraped it — also a failure."""
+    if not (record.get("config") or {}).get("pd_enabled"):
+        return []
+    problems: list[str] = []
+    phases = record.get("phases") or {}
+    ph = phases.get("pd")
+    if not isinstance(ph, dict) or not ph.get("requests"):
+        problems.append("phase 'pd' missing or empty (pd_enabled runs "
+                        "must carry the KV-fabric phase)")
+    pf = (record.get("slo") or {}).get("pd_fabric")
+    if not isinstance(pf, dict):
+        problems.append("slo.pd_fabric block missing (the pd phase "
+                        "never recorded its fabric evidence)")
+        return problems
+    if (pf.get("transfer_overlap_fraction") or 0.0) < 0.5:
+        problems.append(
+            "pd: layer streaming hid too little of the KV transfer "
+            f"(transfer_overlap_fraction="
+            f"{pf.get('transfer_overlap_fraction')!r}, need >= 0.5)")
+    if pf.get("slab_stream_bytes") != 0:
+        problems.append(
+            "pd: the kv_stream=false A/B leg moved streamed bytes "
+            f"({pf.get('slab_stream_bytes')!r} != 0) — the per-request "
+            "override did not actually ride the slab path")
+    if not pf.get("stream_admissions") or pf.get("stream_admissions", 0) < 0:
+        problems.append(
+            "pd: no request was admitted from a streamed frame set "
+            f"(stream_admissions={pf.get('stream_admissions')!r})")
+    if not pf.get("sampled_ab_match"):
+        problems.append(
+            "pd: the seeded-sampled streamed-vs-slab pair diverged "
+            "(the two transfer paths must be id-identical)")
+    if (pf.get("cross_engine_pulled_blocks") or 0) < 1:
+        problems.append(
+            "pd: no cross-engine steady-state restore pulled blocks "
+            "from a peer's host tier (cross_engine_pulled_blocks="
+            f"{pf.get('cross_engine_pulled_blocks')!r})")
+    return problems
+
+
 def check_overload(record: dict) -> list[str]:
     """Gate the overload phase: with offered load above the fleet
     ceiling, interactive TTFT p90 holds its recorded bound with ZERO
@@ -279,7 +337,8 @@ def main(argv: list[str]) -> int:
           "residency recovery, overload: bounded interactive TTFT with "
           "batch shed/preempted/parked/resumed, revocation: >=2 waves "
           "evacuated/parked/exported with survivor resume and "
-          "replacement scale-up)")
+          "replacement scale-up, pd: streamed transfer overlap >= 0.5 "
+          "with slab A/B + seeded-sampled match + cross-engine pull)")
     return 0
 
 

@@ -60,6 +60,11 @@ WALL_CLOCK_PACKAGES: dict[str, tuple[str, ...]] = {
     # clock — the revocation chaos suite replays park schedules
     # deterministically (docs/design/spot-revocation.md)
     "fusioninfer_tpu/engine/evacuate.py": ("time", "sleep", "monotonic"),
+    # the KV fabric's assembly/coverage ledger and pull planning are
+    # pure functions of the frames that arrived — pacing lives in the
+    # server/connector threads (timeouts), never in fabric state, so
+    # the chaos suite replays stream schedules deterministically
+    "fusioninfer_tpu/engine/kv_fabric.py": ("time", "sleep", "monotonic"),
 }
 
 # -- lock-discipline pass ----------------------------------------------
@@ -206,6 +211,11 @@ HOST_SYNC_MODULES: dict[str, tuple[str, ...]] = {
     # evacuation planning is equally pure — the park path's device
     # work lives in engine.py (_park_preempted → the tier's _store)
     "fusioninfer_tpu/engine/evacuate.py": (),
+    # the KV fabric: the ONLY sanctioned fetch is frame serialization
+    # (frame_to_bytes blocks on the page gather the streamed-prefill
+    # extractor dispatched); the decode side parses to host numpy and
+    # inject_frame dispatches the H2D scatter without fetching
+    "fusioninfer_tpu/engine/kv_fabric.py": ("frame_to_bytes",),
     "fusioninfer_tpu/ops/paged_attention.py": (),
     "fusioninfer_tpu/ops/lm_head_topk.py": (),
     "fusioninfer_tpu/ops/dispatch.py": (),
