@@ -57,8 +57,9 @@ autoscale: ## Autoscaling suite (fake-clock control-loop + drain + chaos; docs/d
 	$(PYTHON) -m pytest tests/test_autoscale.py tests/test_metrics.py -q
 
 .PHONY: lint
-lint: ## Gating lint: fusionlint (all ten passes incl. trace-boundary, JSON archived to dist/lint.json) + byte-compile (CI adds ruff).
+lint: ## Gating lint: fusionlint (all thirteen passes incl. trace-boundary + thread-safety, JSON archived to dist/lint.json) + fault-site coverage + byte-compile (CI adds ruff).
 	$(PYTHON) -m tools.fusionlint --json-out dist/lint.json
+	$(PYTHON) tools/check_fault_sites.py
 	$(PYTHON) -m compileall -q fusioninfer_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: lint-changed
@@ -70,6 +71,12 @@ compile-gate: ## Compile-budget gate: self-test, then `make fast` under the comp
 	$(PYTHON) tools/check_compile_budget.py --self-test
 	FUSIONINFER_COMPILE_LEDGER=dist/compile_ledger.json $(PYTHON) -m pytest tests/ -q -m fast
 	$(PYTHON) tools/check_compile_budget.py dist/compile_ledger.json
+
+.PHONY: lock-gate
+lock-gate: ## Lock-order gate: self-test, then `make fast` under the lock trace, then cycle-check the merged static+runtime graph (docs/design/static-analysis.md).
+	$(PYTHON) tools/check_lock_order.py --self-test
+	FUSIONINFER_LOCKTRACE=dist/lock_trace.json $(PYTHON) -m pytest tests/ -q -m fast
+	$(PYTHON) tools/check_lock_order.py dist/lock_trace.json
 
 .PHONY: verify-manifests
 verify-manifests: ## Regenerate CRDs/config from the Python sources in memory, fail on drift; validate samples against the CRD schemas.

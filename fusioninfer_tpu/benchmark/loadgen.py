@@ -35,6 +35,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from fusioninfer_tpu.utils.threads import join_all
+
 _PROMPT_CHARS = np.frombuffer(
     (string.ascii_letters + string.digits + " .,;:!?").encode(), np.uint8
 )
@@ -263,11 +265,14 @@ def mixed_slo_arrivals(
     return plan
 
 
-def fire_open_loop(arrivals: list[float], fire) -> None:
+def fire_open_loop(arrivals: list[float], fire,
+                   drain_timeout_s: float = 300.0) -> None:
     """Run ``fire(i)`` on its own thread at each ``arrivals[i]`` offset
     (seconds from call time) and join them all — the open-loop pump: a
     slow server does NOT slow the arrival schedule down, so queues build
-    the way they do for real under a burst."""
+    the way they do for real under a burst.  The drain join is bounded
+    by the schedule's end plus ``drain_timeout_s``: a fire that never
+    returns fails the run by name instead of hanging it."""
     t0 = time.perf_counter()
     threads: list[threading.Thread] = []
 
@@ -281,8 +286,8 @@ def fire_open_loop(arrivals: list[float], fire) -> None:
         th = threading.Thread(target=runner, args=(i, at), daemon=True)
         th.start()
         threads.append(th)
-    for th in threads:
-        th.join()
+    join_all(threads, (arrivals[-1] if arrivals else 0.0) + drain_timeout_s,
+             what="open-loop fire")
 
 
 def run_sharedprefix_load(
@@ -454,8 +459,8 @@ def run_sharedprefix_load(
                     for i, p in enumerate(cold_prompts)]
     for t in cold_threads:
         t.start()
-    for t in cold_threads:
-        t.join()
+    # one request per thread: bounded by the request timeout + slack
+    join_all(cold_threads, timeout + 30.0, what="cold-prefill")
 
     it = iter(enumerate(sessions))
 
@@ -491,9 +496,11 @@ def run_sharedprefix_load(
                for _ in range(concurrency)]
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
-    bursty_thread.join()
+    # worst case: every session turn lands on one worker, serial,
+    # each eating the full request timeout — generous but finite
+    turns = sum(len(p) for _k, p in sessions)
+    join_all(threads + [bursty_thread],
+             timeout * max(1, turns) + 60.0, what="session")
     out["duration_s"] = round(time.perf_counter() - t0, 3)
     out["cold_ttft_ms"] = _pcts(cold_ttfts)
     out["warm_ttft_ms"] = _pcts(warm_ttfts)
@@ -558,8 +565,8 @@ def run_http_load(
     t0 = time.perf_counter()
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
+    # worst case: one worker drains every request serially
+    join_all(threads, timeout * max(1, n_requests) + 60.0, what="load")
     result.duration_s = time.perf_counter() - t0
     result.prefix_cache_hit_rate = scrape_prefix_hit_rate(base_url)
     return result

@@ -68,6 +68,17 @@ SITE_RESTORE_DATA = "kv.host.restore.data"
 
 _STOP = object()  # worker shutdown sentinel
 
+_WORKER_POLL_S = 1.0  # worker wakes at least this often (bounded wait)
+
+
+class _FlushBarrier:
+    """FIFO marker for :meth:`HostKVTier.flush`: once the worker (or
+    the drop-oldest shedder) reaches it, every offload queued before it
+    has been committed or shed."""
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+
 
 class HostKVTier:
     """Bounded host-memory slab pool keyed by KV block hash.
@@ -153,6 +164,12 @@ class HostKVTier:
                         with self._lock:
                             self._offload_failed_total += 1
                         return
+                    if isinstance(dropped, _FlushBarrier):
+                        # everything queued before the barrier is
+                        # already out of the queue — the flush it
+                        # signals is trivially complete
+                        dropped.done.set()
+                        continue
                     with self._lock:
                         self._offload_failed_total += 1
                 except queue_mod.Empty:
@@ -169,10 +186,19 @@ class HostKVTier:
 
     def _worker_loop(self) -> None:
         while True:
-            item = self._q.get()
+            try:
+                # bounded wait: the worker wakes periodically instead
+                # of blocking forever, so a wedged producer can never
+                # leave an unjoinable thread behind
+                item = self._q.get(timeout=_WORKER_POLL_S)
+            except queue_mod.Empty:
+                continue
             try:
                 if item is _STOP:
                     return
+                if isinstance(item, _FlushBarrier):
+                    item.done.set()
+                    continue
                 h, slab = item
                 self._store(h, slab)
             except Exception:
@@ -382,13 +408,23 @@ class HostKVTier:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def flush(self) -> None:
-        """Block until every queued offload is committed (tests and the
-        bench's between-strata barriers; production never needs it)."""
+    def flush(self, timeout_s: float = 60.0) -> None:
+        """Block until every offload queued before this call is
+        committed or shed (tests and the bench's between-strata
+        barriers; production never needs it).  Bounded: a worker that
+        stopped making progress surfaces as a ``TimeoutError`` naming
+        the backlog instead of wedging the caller forever."""
         with self._lock:
             worker = self._worker
-        if worker is not None:
-            self._q.join()
+        if worker is None or not worker.is_alive():
+            return
+        barrier = _FlushBarrier()
+        self._q.put(barrier)
+        if not barrier.done.wait(timeout_s):
+            raise TimeoutError(
+                f"host-tier flush timed out after {timeout_s:.0f}s "
+                f"with ~{self._q.qsize()} offloads still queued — the "
+                "offload worker is stuck or dead")
 
     def close(self) -> None:
         with self._lock:

@@ -66,6 +66,15 @@ def maybe_init_distributed() -> None:
     logger.info("collective context established across %s processes", n_proc)
 
 
+# An engine that produces neither a chunk nor a terminal sentinel for
+# this long is stuck or dead; the handler thread must fail loudly (the
+# stream truncates without [DONE], which clients detect) instead of
+# holding the connection — and its thread — forever.  Generous on
+# purpose: a long prefill legitimately stalls the first chunk for tens
+# of seconds on big contexts.
+_STREAM_IDLE_TIMEOUT_S = 300.0
+
+
 class _RequestChannel:
     """Blocking bridge from engine thread to an HTTP handler thread."""
 
@@ -77,7 +86,13 @@ class _RequestChannel:
 
     def stream(self):
         while True:
-            item = self.q.get()
+            try:
+                item = self.q.get(timeout=_STREAM_IDLE_TIMEOUT_S)
+            except queue.Empty:
+                raise TimeoutError(
+                    "engine produced no stream output for "
+                    f"{_STREAM_IDLE_TIMEOUT_S:.0f}s — aborting the "
+                    "handler instead of holding it forever")
             yield item
             if item is None or item.finished:
                 return
@@ -1184,7 +1199,13 @@ class EngineServer:
         done = 0
         aborted = False
         while done < len(gens):
-            item = out_q.get()
+            try:
+                item = out_q.get(timeout=_STREAM_IDLE_TIMEOUT_S)
+            except queue.Empty:
+                # a pump stopped feeding without its DONE/ABORT marker:
+                # treat as abort — no [DONE], clients see truncation
+                aborted = True
+                break
             if item is _PUMP_DONE or item is _PUMP_ABORT:
                 done += 1
                 aborted = aborted or item is _PUMP_ABORT

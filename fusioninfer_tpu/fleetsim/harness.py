@@ -74,6 +74,7 @@ from fusioninfer_tpu.fleetsim.record import (
     write_record,
 )
 from fusioninfer_tpu.operator.apiserver import HTTPApiServer
+from fusioninfer_tpu.utils.threads import join_all
 from fusioninfer_tpu.operator.kubeclient import KubeClient, KubeConfig
 from fusioninfer_tpu.operator.manager import Manager
 from fusioninfer_tpu.operator.podsim import PORT_ANNOTATION, LWSSimulator
@@ -745,8 +746,16 @@ class FleetHarness:
                    for _ in range(concurrency)]
         for t in threads:
             t.start()
-        for t in threads:
-            t.join()
+        self._bounded_join(threads, sum(len(p) for _s, p in sessions),
+                           what=f"{phase} session")
+
+    def _bounded_join(self, threads, turns: int, what: str) -> None:
+        """Join workers under the workload's own worst case: every turn
+        serial on one thread, each eating the client's full retry
+        budget — generous but finite, so a wedged phase fails naming
+        its threads instead of hanging the whole record run."""
+        per_req = self.cfg.client_timeout_s * self.cfg.client_max_attempts
+        join_all(threads, per_req * max(1, turns) + 60.0, what=what)
 
     def _cold_round(self, phase: str) -> None:
         systems = self._systems()
@@ -1016,8 +1025,10 @@ class FleetHarness:
             if any(e["kind"] == "up" for e in self._events()):
                 break
             time.sleep(cfg.tick_pause_s)
-        burst_t.join()
-        inter_t.join()
+        self._bounded_join(
+            [burst_t, inter_t],
+            cfg.burst_requests + cfg.scaleup_interactive,
+            what="scale-up driver")
         # the bought replica must come up before the fault phase kills
         # things — scale-up that never materializes is a failed run
         if any(e["kind"] == "up" for e in self._events()):
@@ -1102,8 +1113,10 @@ class FleetHarness:
             daemon=True)
         batch_t.start()
         inter_t.start()
-        batch_t.join()
-        inter_t.join()
+        self._bounded_join(
+            [batch_t, inter_t],
+            len(plan) + cfg.overload_interactive,
+            what="overload driver")
         delta = self._overload_delta(base, self._overload_snapshot())
         rows = self.client.rows(phase)
         inter_rows = [r for r in rows if r["stratum"] == "interactive"]
@@ -1284,8 +1297,9 @@ class FleetHarness:
         # applies 3→4, wave 1 is deterministically at the cap)
         applied = self.controller.note_revocation(
             cfg.role_name, service=cfg.service_name)
-        batch_t.join()
-        inter_t.join()
+        self._bounded_join([batch_t, inter_t],
+                           len(plan) + cfg.revocation_interactive,
+                           what="revocation driver")
         t_stream.join(timeout=cfg.client_timeout_s * cfg.client_max_attempts)
         row = done.get("row") or {}
         self._fault({

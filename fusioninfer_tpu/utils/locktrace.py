@@ -1,0 +1,263 @@
+"""Runtime lock-acquisition tracing — the dynamic twin of
+``tools/fusionlint/lockgraph.py``.
+
+The static graph proves what the *source* can acquire; this module
+records what a real run *did* acquire: under ``FUSIONINFER_LOCKTRACE``
+the test bootstrap calls :func:`install`, which patches the
+``threading.Lock`` / ``threading.RLock`` factories so constructions
+from covered packages return a traced proxy.  Each proxy keeps a
+thread-local held stack and reports, per acquisition, an ordered pair
+``(held, acquired)`` for every lock already held — exactly the edge
+relation of the static graph — plus the maximum time each lock was
+held.  ``tools/check_lock_order.py`` merges the recorded pairs into the
+static graph and fails on any cycle, so an inversion the linter's
+one-level call resolution cannot see (through a callback, a dynamic
+dispatch, a thread handoff) still lands in the gate as long as some
+test drives it.
+
+Labels are derived from the construction site's frame so they merge
+with the static nodes by plain string equality:
+
+* ``self._lock = threading.Lock()`` inside ``Engine.__init__`` →
+  ``pkg.module.Engine._lock`` (class name from ``type(self)``, attr
+  from the assignment text — the same ``(owner, attr)`` identity the
+  static indexer assigns);
+* module-scope ``_REGISTRY_LOCK = threading.Lock()`` →
+  ``pkg.module._REGISTRY_LOCK``;
+* function-scope ``lock = threading.Lock()`` →
+  ``pkg.module.func.lock``.
+
+Known blind spot, by design: ``threading.Condition`` wrapping a traced
+*RLock* bypasses the proxy inside ``wait()`` (it uses the inner lock's
+``_release_save``), so the recorded hold time of such a lock includes
+the wait.  Conditions wrapping a plain ``Lock`` release through the
+proxy and are tracked precisely; bare ``Condition()`` allocates its
+RLock from ``threading``'s own namespace and is never traced.
+
+Tracing costs one dict update per acquisition while enabled and
+exactly nothing when not installed; production never sets the env var.
+"""
+
+# fusionlint: disable=lock-discipline — Recorder._mu is allocated from
+# the PRE-patch Lock factory (the recorder must never trace itself), so
+# the pass cannot recognize it as a lock; every mutation of
+# Recorder.{locks,pairs,holds} is nonetheless under `with self._mu`.
+
+from __future__ import annotations
+
+import json
+import linecache
+import os
+import re
+import sys
+import threading
+import time
+from typing import Optional
+
+ENV_VAR = "FUSIONINFER_LOCKTRACE"
+
+#: packages whose lock constructions are traced (caller-frame filter)
+COVERED_PACKAGES = ("fusioninfer_tpu",)
+
+_SELF_ATTR_RE = re.compile(r"self\.(\w+)\s*=")
+_SETATTR_RE = re.compile(r"__setattr__\(\s*self\s*,\s*['\"](\w+)['\"]")
+_LOCAL_RE = re.compile(r"^\s*(\w+)(?:\s*:\s*[^=]+)?\s*=")
+
+
+def _label_from_frame(frame) -> str:
+    """The static node label for a lock constructed at ``frame``."""
+    mod = frame.f_globals.get("__name__", "<unknown>")
+    text = linecache.getline(frame.f_code.co_filename, frame.f_lineno)
+    m = _SELF_ATTR_RE.search(text) or _SETATTR_RE.search(text)
+    if m is not None and "self" in frame.f_locals:
+        cls = type(frame.f_locals["self"]).__name__
+        return f"{mod}.{cls}.{m.group(1)}"
+    m = _LOCAL_RE.match(text)
+    name = m.group(1) if m is not None else f"line{frame.f_lineno}"
+    if frame.f_code.co_name == "<module>":
+        return f"{mod}.{name}"
+    return f"{mod}.{frame.f_code.co_name}.{name}"
+
+
+class Recorder:
+    """Accumulates acquisition-order pairs and per-lock max hold times.
+
+    Guarded by an UNtraced lock (constructed from the real factory
+    before patching) so the recorder never traces itself.
+    """
+
+    def __init__(self, real_lock_factory=None):
+        factory = real_lock_factory or threading.Lock
+        self._mu = factory()
+        self._tls = threading.local()
+        self.locks: set[str] = set()
+        # (src_label, dst_label) -> {"count": n, "thread": name}
+        self.pairs: dict[tuple[str, str], dict] = {}
+        self.holds: dict[str, float] = {}  # label -> max hold seconds
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def register(self, label: str) -> None:
+        with self._mu:
+            self.locks.add(label)
+
+    def acquired(self, label: str) -> None:
+        st = self._stack()
+        if st:
+            with self._mu:
+                for held, _t0 in st:
+                    ent = self.pairs.get((held, label))
+                    if ent is None:
+                        self.pairs[(held, label)] = {
+                            "count": 1,
+                            "thread": threading.current_thread().name,
+                        }
+                    else:
+                        ent["count"] += 1
+        st.append((label, time.monotonic()))
+
+    def released(self, label: str) -> None:
+        st = self._stack()
+        # pop the most recent entry for this label — out-of-order
+        # release (lock A released before later-acquired B) is legal
+        # threading and must not corrupt the stack
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == label:
+                _, t0 = st.pop(i)
+                dt = time.monotonic() - t0
+                with self._mu:
+                    if dt > self.holds.get(label, 0.0):
+                        self.holds[label] = dt
+                return
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "locks": sorted(self.locks),
+                "pairs": [
+                    {"src": s, "dst": d, "count": ent["count"],
+                     "thread": ent["thread"]}
+                    for (s, d), ent in sorted(self.pairs.items())
+                ],
+                "holds": {k: round(v, 6)
+                          for k, v in sorted(self.holds.items())},
+            }
+
+    def write(self, path: str) -> dict:
+        snap = self.snapshot()
+        with open(path, "w") as fh:
+            json.dump(snap, fh, indent=1, sort_keys=True)
+        return snap
+
+
+class _TracedLock:
+    """Proxy around a real lock that reports to the recorder.  Only the
+    outermost acquire/release of a reentrant lock is recorded, so RLock
+    recursion never shows up as a self-pair."""
+
+    __slots__ = ("_inner", "_label", "_reentrant", "_rec", "_tls")
+
+    def __init__(self, inner, label: str, reentrant: bool,
+                 rec: Recorder):
+        self._inner = inner
+        self._label = label
+        self._reentrant = reentrant
+        self._rec = rec
+        self._tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "d", 0)
+
+    def acquire(self, *args, **kwargs) -> bool:
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            d = self._depth()
+            if d == 0 or not self._reentrant:
+                self._rec.acquired(self._label)
+            self._tls.d = d + 1
+        return got
+
+    def release(self) -> None:
+        d = self._depth()
+        self._inner.release()
+        self._tls.d = max(0, d - 1)
+        if d <= 1 or not self._reentrant:
+            self._rec.released(self._label)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        # Condition() compatibility: _is_owned/_release_save/
+        # _acquire_restore resolve against the inner lock (absent on a
+        # plain Lock, so Condition falls back to acquire/release —
+        # which ARE tracked)
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"<TracedLock {self._label} of {self._inner!r}>"
+
+
+_recorder: Optional[Recorder] = None
+_saved: Optional[tuple] = None
+
+
+def recorder() -> Optional[Recorder]:
+    return _recorder
+
+
+def install(covered: tuple[str, ...] = COVERED_PACKAGES) -> Recorder:
+    """Patch the ``threading`` lock factories; idempotent."""
+    global _recorder, _saved
+    if _saved is not None:
+        assert _recorder is not None
+        return _recorder
+    real_lock, real_rlock = threading.Lock, threading.RLock
+    rec = Recorder(real_lock)
+
+    def traced_factory(factory, reentrant: bool):
+        def make(*args, **kwargs):
+            inner = factory(*args, **kwargs)
+            frame = sys._getframe(1)
+            mod = frame.f_globals.get("__name__", "")
+            if not mod.startswith(covered):
+                return inner
+            label = _label_from_frame(frame)
+            rec.register(label)
+            return _TracedLock(inner, label, reentrant, rec)
+        return make
+
+    threading.Lock = traced_factory(real_lock, False)
+    threading.RLock = traced_factory(real_rlock, True)
+    _recorder = rec
+    _saved = (real_lock, real_rlock)
+    return rec
+
+
+def uninstall() -> None:
+    """Restore the real factories (already-traced locks keep tracing)."""
+    global _recorder, _saved
+    if _saved is None:
+        return
+    threading.Lock, threading.RLock = _saved
+    _saved = None
+    _recorder = None
+
+
+def write_if_enabled() -> Optional[dict]:
+    """Dump the trace to ``$FUSIONINFER_LOCKTRACE`` if tracing is on."""
+    path = os.environ.get(ENV_VAR, "")
+    if not path or _recorder is None:
+        return None
+    return _recorder.write(path)

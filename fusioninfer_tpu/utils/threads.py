@@ -1,0 +1,30 @@
+"""Bounded thread-join: the project's answer to bare ``t.join()``.
+
+An unbounded join on a worker that never exits is a hang with no
+stack trace at the call site — the reaper's notice budget applied to
+our own threads.  ``join_all`` drains a whole worker pool under ONE
+deadline (joining each thread with the time remaining, not a fresh
+budget per thread) and raises naming the stragglers, so a stuck run
+fails loudly with the thread names instead of wedging the caller.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable
+
+
+def join_all(threads: Iterable[threading.Thread], timeout_s: float,
+             what: str = "worker") -> None:
+    """Join every thread within one shared ``timeout_s`` deadline;
+    raise ``RuntimeError`` naming any still alive."""
+    threads = list(threads)
+    deadline = time.monotonic() + timeout_s
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+    alive = [t.name for t in threads if t.is_alive()]
+    if alive:
+        raise RuntimeError(
+            f"{len(alive)} {what} thread(s) still running after "
+            f"{timeout_s:.0f}s: {', '.join(alive)}")

@@ -52,6 +52,16 @@ if not _ON_TPU_TIER:
 
     configure_cache(min_compile_seconds=0.5)
 
+if os.environ.get("FUSIONINFER_LOCKTRACE", ""):
+    # Runtime half of the lock-order gate (``make lock-gate``): trace
+    # every lock the covered package constructs during this run; the
+    # acquisition-order pairs merge into the static graph in
+    # tools/check_lock_order.py.  Installed before any test module
+    # imports so no engine lock predates the patch.
+    from fusioninfer_tpu.utils import locktrace
+
+    locktrace.install()
+
 import pytest  # noqa: E402 — after the backend bootstrap above
 
 # The sub-2-minute smoke tier (``make fast`` / ``pytest -m fast``, the
@@ -71,7 +81,7 @@ FAST_MODULES = {
     "test_paged_attention.py", "test_priority.py", "test_reconciler.py",
     "test_render_cli.py", "test_router.py", "test_schema.py",
     "test_scheduling_podgroup.py", "test_slo_overload.py",
-    "test_tokenizer.py",
+    "test_threads.py", "test_tokenizer.py",
     "test_topology.py", "test_workload_lws.py",
 }
 
@@ -83,19 +93,27 @@ def pytest_collection_modifyitems(config, items):
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """Write the compile ledger when the run asked for one
+    """Write the run's gate artifacts when asked: the compile ledger
     (``FUSIONINFER_COMPILE_LEDGER=path make fast`` — the runtime half
-    of the jit-registry discipline; ``make compile-gate`` checks the
-    per-family signature counts against their budgets)."""
+    of the jit-registry discipline, checked by ``make compile-gate``)
+    and the lock trace (``FUSIONINFER_LOCKTRACE=path`` — the runtime
+    half of the lock-order discipline, merged into the static graph by
+    ``make lock-gate``)."""
     path = os.environ.get("FUSIONINFER_COMPILE_LEDGER", "")
-    if not path:
-        return
-    from fusioninfer_tpu.utils.compile_ledger import write
+    if path:
+        from fusioninfer_tpu.utils.compile_ledger import write
 
-    snap = write(path)
-    totals = ", ".join(f"{fam}={n}" for fam, n in
-                       sorted(snap["families"].items()))
-    print(f"\ncompile ledger -> {path} ({totals})")
+        snap = write(path)
+        totals = ", ".join(f"{fam}={n}" for fam, n in
+                           sorted(snap["families"].items()))
+        print(f"\ncompile ledger -> {path} ({totals})")
+    from fusioninfer_tpu.utils import locktrace
+
+    snap = locktrace.write_if_enabled()
+    if snap is not None:
+        print(f"\nlock trace -> {os.environ['FUSIONINFER_LOCKTRACE']} "
+              f"({len(snap['locks'])} locks, {len(snap['pairs'])} "
+              "ordered pairs)")
 
 
 def nonzero_adapter(cfg, rank=4, seed=7, scale=2.0):

@@ -6,8 +6,11 @@ snippets that MUST flag, snippets that MUST NOT flag, and snippets whose
 ``# noqa:<rule>`` suppression must hold (plus unused-suppression
 detection).  The dataflow layer (def-use chains + provenance lattice)
 gets its own unit tier, and the compile-budget gate proves it trips on
-an injected retrace.  The suite closes with the self-check: the repo
-itself is clean under all ten passes, the checked-in jit registry
+an injected retrace.  The thread-safety layer (ISSUE 18) adds the lock
+graph's own unit tier (node resolution, nested-with and cross-object
+call edges, cycle witnesses), the runtime locktrace twin, and the
+merged-gate units.  The suite closes with the self-check: the repo
+itself is clean under all thirteen passes, the checked-in jit registry
 matches the package's actual trace boundaries, the legacy shims still
 gate, and ``make verify-manifests``' checks (including rendered-children
 validation against the pinned external CRD schemas) hold — the
@@ -16,6 +19,7 @@ acceptance criteria of the issues, executable.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import subprocess
 import sys
@@ -1424,6 +1428,532 @@ class TestShardingDisciplinePass:
                 if f.rule == "aot-registry"] == []
 
 
+# --------------------------------------------------- lock graph (core)
+
+
+def _index(tmp_path, source: str, name: str = "fixture.py"):
+    from tools.fusionlint.core import Module
+    from tools.fusionlint.lockgraph import index_module
+
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return index_module(Module(path))
+
+
+def _graph(tmp_path, source: str, name: str = "fixture.py"):
+    from tools.fusionlint.core import Module
+    from tools.fusionlint.lockgraph import build_graph
+
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return build_graph([Module(path)])
+
+
+class TestLockGraph:
+    """The analysis core: allocation-site node resolution, nested-with
+    and cross-object call edges, cycle witnesses."""
+
+    def test_self_attr_lock_resolves_to_class_node(self, tmp_path):
+        ix = _index(tmp_path, """\
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+        """)
+        node = ix.classes["Engine"].locks["_lock"]
+        assert node.label.endswith("fixture.Engine._lock")
+        assert not node.reentrant
+
+    def test_lock_through_local_and_setattr_forms(self, tmp_path):
+        ix = _index(tmp_path, """\
+            import threading
+
+            class Frozen:
+                def __init__(self):
+                    lock = threading.RLock()
+                    self._lock = lock
+                    object.__setattr__(self, "_mu", threading.Lock())
+        """)
+        locks = ix.classes["Frozen"].locks
+        assert locks["_lock"].reentrant  # resolved through the local
+        assert locks["_mu"].label.endswith("Frozen._mu")
+
+    def test_condition_aliases_its_wrapped_lock(self, tmp_path):
+        ix = _index(tmp_path, """\
+            import threading
+
+            class Waiter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+        """)
+        locks = ix.classes["Waiter"].locks
+        assert locks["_cv"] == locks["_lock"]  # same node, not a peer
+
+    def test_module_and_function_scope_nodes(self, tmp_path):
+        ix = _index(tmp_path, """\
+            import threading
+
+            _REGISTRY_LOCK = threading.Lock()
+
+            def pump():
+                lock = threading.Lock()
+                with lock:
+                    pass
+        """)
+        assert "_REGISTRY_LOCK" in ix.module_locks
+        acq = ix.functions["pump"].acquires
+        assert len(acq) == 1
+        assert acq[0][0].label.endswith("fixture.pump.lock")
+
+    def test_nested_with_emits_edge_with_witness(self, tmp_path):
+        g = _graph(tmp_path, """\
+            import threading
+
+            class Two:
+                def __init__(self):
+                    self.la = threading.Lock()
+                    self.lb = threading.Lock()
+
+                def step(self):
+                    with self.la:
+                        with self.lb:
+                            pass
+        """)
+        edges = [e for e in g.edges if e.kind == "nested"]
+        assert len(edges) == 1
+        assert edges[0].src.label.endswith("Two.la")
+        assert edges[0].dst.label.endswith("Two.lb")
+        assert "Two.step()" in edges[0].via
+
+    def test_call_under_lock_resolves_cross_object_edge(self, tmp_path):
+        g = _graph(tmp_path, """\
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def put(self, k):
+                    with self._lock:
+                        pass
+
+            class Informer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._store = Store()
+
+                def sync(self):
+                    with self._lock:
+                        self._store.put(1)
+        """)
+        calls = [e for e in g.edges if e.kind == "call"]
+        assert len(calls) == 1
+        assert calls[0].src.label.endswith("Informer._lock")
+        assert calls[0].dst.label.endswith("Store._lock")
+
+    def test_locked_suffix_method_not_a_reacquisition(self, tmp_path):
+        g = _graph(tmp_path, """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _flush_locked(self):
+                    pass  # caller holds the lock by convention
+
+                def flush(self):
+                    with self._lock:
+                        self._flush_locked()
+        """)
+        from tools.fusionlint.lockgraph import find_cycles
+
+        assert find_cycles(g) == []
+
+    def test_abba_cycle_reports_both_witness_paths(self, tmp_path):
+        g = _graph(tmp_path, """\
+            import threading
+
+            class Two:
+                def __init__(self):
+                    self.la = threading.Lock()
+                    self.lb = threading.Lock()
+
+                def one(self):
+                    with self.la:
+                        with self.lb:
+                            pass
+
+                def two(self):
+                    with self.lb:
+                        with self.la:
+                            pass
+        """)
+        from tools.fusionlint.lockgraph import find_cycles
+
+        cycles = find_cycles(g)
+        assert len(cycles) == 1
+        text = cycles[0].describe()
+        assert "Two.one()" in text and "Two.two()" in text  # both paths
+
+    def test_rlock_self_reacquire_is_not_a_cycle(self, tmp_path):
+        g = _graph(tmp_path, """\
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+        """)
+        from tools.fusionlint.lockgraph import find_cycles
+
+        assert find_cycles(g) == []
+
+    def test_plain_lock_self_reacquire_is_self_deadlock(self, tmp_path):
+        g = _graph(tmp_path, """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+        """)
+        from tools.fusionlint.lockgraph import find_cycles
+
+        cycles = find_cycles(g)
+        assert len(cycles) == 1 and len(cycles[0].nodes) == 1
+
+
+# -------------------------------------------------- lock-order (pass)
+
+
+def _orderpass():
+    from tools.fusionlint.passes.lockorder import LockOrderPass
+
+    return LockOrderPass(scope=[])
+
+
+class TestLockOrderPass:
+    ABBA = """\
+        import threading
+
+        class Two:
+            def __init__(self):
+                self.la = threading.Lock()
+                self.lb = threading.Lock()
+
+            def one(self):
+                with self.la:
+                    with self.lb:{noqa}
+                        pass
+
+            def two(self):
+                with self.lb:
+                    with self.la:
+                        pass
+    """
+
+    def test_abba_flags_with_both_witnesses(self, tmp_path):
+        result = lint(tmp_path, self.ABBA.format(noqa=""), [_orderpass()])
+        assert rules_of(result) == ["lock-order"]
+        msg = result.findings[0].message
+        assert "Two.one()" in msg and "Two.two()" in msg
+
+    def test_consistent_global_order_is_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+
+            class Two:
+                def __init__(self):
+                    self.la = threading.Lock()
+                    self.lb = threading.Lock()
+
+                def one(self):
+                    with self.la:
+                        with self.lb:
+                            pass
+
+                def two(self):
+                    with self.la:
+                        with self.lb:
+                            pass
+        """, [_orderpass()])
+        assert result.findings == []
+
+    def test_noqa_on_witness_line_suppresses(self, tmp_path):
+        result = lint(tmp_path, self.ABBA.format(
+            noqa="  # noqa:lock-order — fixture exercises suppression"),
+            [_orderpass()])
+        assert result.findings == []
+
+
+# ----------------------------------------------- lock-blocking (pass)
+
+
+def _blockpass():
+    from tools.fusionlint.passes.lockblocking import LockBlockingPass
+
+    return LockBlockingPass(modules=["*"])
+
+
+class TestLockBlockingPass:
+    def test_unbounded_get_and_sleep_under_lock_flag(self, tmp_path):
+        result = lint(tmp_path, """\
+            import queue
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get()
+
+                def nap(self):
+                    with self._lock:
+                        time.sleep(0.5)
+        """, [_blockpass()])
+        assert rules_of(result) == ["lock-blocking"] * 2
+        assert "unbounded .get()" in result.findings[0].message
+        assert "sleep()" in result.findings[1].message
+
+    def test_network_io_under_lock_flags(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+            import urllib.request
+
+            class Scraper:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def scrape(self, url):
+                    with self._lock:
+                        return urllib.request.urlopen(url, timeout=5)
+        """, [_blockpass()])
+        assert rules_of(result) == ["lock-blocking"]
+        assert "network I/O" in result.findings[0].message
+
+    def test_bounded_and_outside_lock_are_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            import queue
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def ok_bounded(self):
+                    with self._lock:
+                        return self._q.get(timeout=1.0)
+
+                def ok_outside(self):
+                    with self._lock:
+                        q = self._q
+                    time.sleep(0.5)
+                    return q.get()
+        """, [_blockpass()])
+        assert result.findings == []
+
+    def test_condition_wait_on_sole_held_cv_is_clean(self, tmp_path):
+        result = lint(tmp_path, """\
+            import threading
+
+            class Waiter:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def park(self):
+                    with self._cv:
+                        self._cv.wait()
+        """, [_blockpass()])
+        assert result.findings == []
+
+    def test_noqa_with_justification_suppresses(self, tmp_path):
+        result = lint(tmp_path, """\
+            import queue
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get()  # noqa:lock-blocking — single-threaded fixture
+        """, [_blockpass()])
+        assert result.findings == []
+
+
+# ------------------------------------------- locktrace (runtime twin)
+
+
+@contextlib.contextmanager
+def _traced(covered: tuple[str, ...]):
+    """Install locktrace over ``covered`` for the block, then restore
+    whatever install was active before — this module is in the fast
+    tier, so under ``make lock-gate`` a session-wide install owned by
+    conftest is live and must survive these tests untouched."""
+    import threading
+
+    from fusioninfer_tpu.utils import locktrace
+
+    saved = (threading.Lock, threading.RLock,
+             locktrace._recorder, locktrace._saved)
+    locktrace.uninstall()  # restores the real factories if patched
+    try:
+        yield locktrace, locktrace.install(covered=covered)
+    finally:
+        locktrace.uninstall()
+        (threading.Lock, threading.RLock,
+         locktrace._recorder, locktrace._saved) = saved
+
+
+class TestLockTrace:
+    def test_traced_labels_match_static_node_identity(self):
+        with _traced((__name__,)) as (locktrace, rec):
+            import threading
+
+            class Twin:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+            Twin()
+            assert f"{__name__}.Twin._lock" in rec.locks
+
+    def test_nested_acquisition_records_ordered_pair(self):
+        with _traced((__name__,)) as (locktrace, rec):
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self.la = threading.Lock()
+                    self.lb = threading.Lock()
+
+            p = Pair()
+            with p.la:
+                with p.lb:
+                    pass
+            pairs = set(rec.pairs)
+            assert (f"{__name__}.Pair.la", f"{__name__}.Pair.lb") in pairs
+            assert (f"{__name__}.Pair.lb",
+                    f"{__name__}.Pair.la") not in pairs
+
+    def test_rlock_recursion_records_no_self_pair(self):
+        with _traced((__name__,)) as (locktrace, rec):
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+            r = R()
+            with r._lock:
+                with r._lock:
+                    pass
+            label = f"{__name__}.R._lock"
+            assert label in rec.locks
+            assert (label, label) not in rec.pairs
+
+    def test_hold_times_and_snapshot_round_trip(self, tmp_path):
+        with _traced((__name__,)) as (locktrace, rec):
+            import threading
+
+            mu = threading.Lock()
+            with mu:
+                pass
+            snap = rec.write(str(tmp_path / "trace.json"))
+            assert snap["locks"]  # the local lock was traced
+            assert all(v >= 0.0 for v in snap["holds"].values())
+            on_disk = json.loads((tmp_path / "trace.json").read_text())
+            assert on_disk == snap
+
+    def test_uncovered_package_constructions_untouched(self):
+        with _traced(("no_such_package",)) as (locktrace, rec):
+            import threading
+
+            mu = threading.Lock()
+            assert type(mu).__name__ != "_TracedLock"
+            assert rec.locks == set()
+
+
+class TestLockOrderGate:
+    """tools/check_lock_order.py: static+runtime merge + self-test."""
+
+    def test_self_test_proves_the_gate_can_fail(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools/check_lock_order.py"),
+             "--self-test"],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 0, proc.stderr
+        assert "trips the gate" in proc.stdout
+
+    def test_merge_inverted_runtime_pair_creates_cycle(self):
+        from tools.check_lock_order import check, merge_trace
+        from tools.fusionlint.lockgraph import Edge, LockGraph, LockNode
+
+        graph = LockGraph()
+        graph.add(Edge(LockNode("m.A", "la"), LockNode("m.B", "lb"),
+                       "m.py", 3, "A holds la, takes lb", "nested"))
+        added = merge_trace(graph, {"pairs": [
+            {"src": "m.B.lb", "dst": "m.A.la", "count": 1,
+             "thread": "t"}]})
+        assert added == 1
+        assert check(graph)  # ABBA across the two halves
+
+    def test_merge_aligned_runtime_pair_stays_clean(self):
+        from tools.check_lock_order import check, merge_trace
+        from tools.fusionlint.lockgraph import Edge, LockGraph, LockNode
+
+        graph = LockGraph()
+        graph.add(Edge(LockNode("m.A", "la"), LockNode("m.B", "lb"),
+                       "m.py", 3, "A holds la, takes lb", "nested"))
+        added = merge_trace(graph, {"pairs": [
+            {"src": "m.A.la", "dst": "m.B.lb", "count": 9,
+             "thread": "t"}]})
+        assert added == 0  # the run confirmed a statically-known edge
+        assert check(graph) == []
+
+    def test_empty_trace_is_vacuous_not_green(self):
+        from tools.check_lock_order import _vacuous
+
+        assert _vacuous({"locks": [], "pairs": [], "holds": {}})
+        assert _vacuous({"locks": ["m.A.la"], "pairs": []}) is None
+
+
+class TestFaultSiteCoverage:
+    """tools/check_fault_sites.py (make lint): every FaultInjector
+    site armed by at least one test."""
+
+    def test_repo_sites_all_armed(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools/check_fault_sites.py")],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "every injection site is armed" in proc.stdout
+
+
 # ------------------------------------------------------- repo-level gates
 
 
@@ -1438,9 +1968,10 @@ class TestRepoIsClean:
         assert repo_result.findings == [], "\n".join(
             f.render() for f in repo_result.findings)
 
-    def test_all_eleven_passes_ran(self, repo_result):
+    def test_all_thirteen_passes_ran(self, repo_result):
         assert repo_result.passes == [
-            "hygiene", "resilience", "lock-discipline", "render-purity",
+            "hygiene", "resilience", "lock-discipline", "lock-order",
+            "lock-blocking", "render-purity",
             "metrics-conventions", "conditions-vocabulary",
             "jit-registry", "trace-discipline", "tracer-leak",
             "host-sync", "sharding-discipline"]

@@ -10,6 +10,7 @@ export the EPP's prefix scorer consumes (docs/design/kv-hierarchy.md).
 
 import dataclasses
 import json
+import threading
 import urllib.request
 
 import jax.numpy as jnp
@@ -98,6 +99,23 @@ class TestHostTierUnit:
         tier.offload(b"a", _page_slab(1.0))
         tier.flush()
         assert tier.contains(b"a")
+        tier.close()
+
+    def test_flush_before_any_offload_returns_immediately(self):
+        tier = HostKVTier(async_offload=True)
+        tier.flush(timeout_s=0.1)  # no worker started — nothing queued
+
+    def test_flush_on_stuck_worker_raises_instead_of_hanging(self):
+        # regression for the unbounded Queue.join() flush: a worker that
+        # stops making progress must surface as a TimeoutError naming
+        # the backlog, not wedge the caller forever
+        tier = HostKVTier(async_offload=True)
+        release = threading.Event()
+        tier._store = lambda h, slab: release.wait(30.0)
+        tier.offload(b"x", _page_slab(1.0))
+        with pytest.raises(TimeoutError, match="flush timed out"):
+            tier.flush(timeout_s=0.2)
+        release.set()  # unstick so close() can join the worker
         tier.close()
 
     def test_lru_capacity_watermark_evicts(self):

@@ -1,0 +1,97 @@
+"""Bounded-wait discipline (the ISSUE 18 timeout audit): ``join_all``
+raises naming stragglers under ONE shared deadline, and the server's
+stream paths abort loudly instead of parking a handler thread forever
+when the engine stops producing.
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+from fusioninfer_tpu.utils.threads import join_all
+
+
+class TestJoinAll:
+    def test_finished_pool_joins_clean(self):
+        threads = [threading.Thread(target=lambda: None)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        join_all(threads, 5.0)
+        assert not any(t.is_alive() for t in threads)
+
+    def test_raises_naming_the_stragglers(self):
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, args=(30.0,),
+                             name="straggler-0", daemon=True)
+        t.start()
+        with pytest.raises(RuntimeError, match="straggler-0"):
+            join_all([t], 0.2, what="fixture")
+        release.set()
+        t.join(timeout=5.0)
+
+    def test_deadline_is_shared_not_per_thread(self):
+        release = threading.Event()
+        threads = [threading.Thread(target=release.wait, args=(30.0,),
+                                    daemon=True) for _ in range(8)]
+        for t in threads:
+            t.start()
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match=r"8 fixture thread\(s\)"):
+            join_all(threads, 0.5, what="fixture")
+        # one shared 0.5s budget, not 8 x 0.5s fresh budgets
+        assert time.monotonic() - t0 < 3.0
+        release.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+
+class TestStreamIdleTimeout:
+    """Regressions for the unbounded ``queue.get()`` stream waits."""
+
+    def test_request_channel_streams_until_sentinel(self):
+        from fusioninfer_tpu.engine import server
+
+        ch = server._RequestChannel()
+        chunk = types.SimpleNamespace(finished=False)
+        ch.put(chunk)
+        ch.put(None)
+        assert list(ch.stream()) == [chunk, None]
+
+    def test_request_channel_idle_timeout_raises(self, monkeypatch):
+        from fusioninfer_tpu.engine import server
+
+        monkeypatch.setattr(server, "_STREAM_IDLE_TIMEOUT_S", 0.1)
+        ch = server._RequestChannel()  # engine never produces
+        with pytest.raises(TimeoutError, match="no stream output"):
+            next(ch.stream())
+
+    def test_merge_streams_clean_end_yields_done_sentinel(self):
+        from fusioninfer_tpu.engine import server
+
+        def one():
+            yield "chunk"
+            yield None
+
+        items = list(server.EngineServer._merge_streams(None, [one()]))
+        assert items == ["chunk", None]
+
+    def test_merge_streams_stuck_pump_aborts_without_done(
+            self, monkeypatch):
+        from fusioninfer_tpu.engine import server
+
+        monkeypatch.setattr(server, "_STREAM_IDLE_TIMEOUT_S", 0.2)
+        release = threading.Event()
+
+        def stuck():
+            release.wait(10.0)  # engine wedged: first chunk never lands
+            yield None
+
+        items = list(server.EngineServer._merge_streams(
+            None, [stuck()]))
+        # no chunks and, crucially, NO None sentinel: clients detect
+        # truncation by the absence of [DONE]
+        assert items == []
+        release.set()

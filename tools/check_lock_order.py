@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Lock-order gate (``make lock-gate``).
+
+Builds the whole-package static lock-acquisition graph
+(``tools/fusionlint/lockgraph.py``), merges in the runtime
+acquisition-order pairs recorded by a ``FUSIONINFER_LOCKTRACE=…`` test
+run (``fusioninfer_tpu.utils.locktrace``), and fails on any cycle in
+the merged graph.  The static half sees every lexical ordering in the
+source; the runtime half sees orderings the linter's one-level call
+resolution cannot — through callbacks, dynamic dispatch, thread
+handoffs — as long as some test drives them.  Either half alone can
+miss an inversion; merged, an ABBA pair needs to hide from *both* to
+ship.
+
+The report also lists the top hold-time offenders from the trace: a
+lock held for hundreds of milliseconds on a serving path is the
+latency twin of a deadlock and usually the next bug.
+
+``--self-test`` proves the gate can actually fail: it injects a
+runtime trace whose pairs invert a static edge (the classic ABBA) and
+asserts the check trips, then asserts the same trace aligned with the
+static order passes, and that an EMPTY trace fails loudly (a traced
+tier that constructed zero locks means the hook is broken — a gate
+that cannot fail is decoration).
+
+Exit codes: 0 clean, 1 cycle / vacuous trace / self-test failure,
+2 usage.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.fusionlint.core import Module, collect_files  # noqa: E402
+from tools.fusionlint.lockgraph import (  # noqa: E402
+    Edge,
+    LockGraph,
+    LockNode,
+    build_graph,
+    find_cycles,
+)
+
+
+def static_graph() -> LockGraph:
+    mods = [Module(f) for f in collect_files(["fusioninfer_tpu"])]
+    return build_graph([m for m in mods if m.tree is not None])
+
+
+def _node_for(label: str, by_label: dict[str, LockNode]) -> LockNode:
+    node = by_label.get(label)
+    if node is None:
+        owner, _, attr = label.rpartition(".")
+        node = LockNode(owner or "<runtime>", attr or label)
+        by_label[label] = node
+    return node
+
+
+def merge_trace(graph: LockGraph, trace: dict) -> int:
+    """Add the trace's ordered pairs as runtime edges; returns the
+    number of NEW edges (pairs the static graph had not already
+    proven)."""
+    by_label = {n.label: n for n in graph.nodes}
+    known = {(e.src.label, e.dst.label) for e in graph.edges}
+    added = 0
+    for pair in trace.get("pairs", []):
+        src, dst = pair["src"], pair["dst"]
+        if src == dst:
+            continue  # reentrant re-acquire; locktrace filters these,
+            # but an old trace file must not fabricate a self-cycle
+        edge = Edge(
+            _node_for(src, by_label), _node_for(dst, by_label),
+            "<runtime>", 0,
+            f"thread {pair.get('thread', '?')!r} held {src} while "
+            f"acquiring {dst} ({pair.get('count', 1)}x in the traced "
+            "run)",
+            "runtime")
+        if (src, dst) not in known:
+            added += 1
+        graph.add(edge)
+    return added
+
+
+def check(graph: LockGraph) -> list[str]:
+    """Problems (one per cycle) for the merged graph; empty = pass."""
+    problems = []
+    for cycle in find_cycles(graph):
+        problems.append(cycle.describe())
+    return problems
+
+
+def report(graph: LockGraph, trace: dict | None, added: int) -> None:
+    kinds: dict[str, int] = {}
+    for e in graph.edges:
+        kinds[e.kind] = kinds.get(e.kind, 0) + 1
+    edge_s = ", ".join(f"{k}={n}" for k, n in sorted(kinds.items()))
+    print(f"lock graph: {len(graph.nodes)} locks, {len(graph.edges)} "
+          f"ordered edges ({edge_s or 'none'})")
+    if trace is None:
+        return
+    print(f"runtime trace: {len(trace.get('locks', []))} locks "
+          f"constructed, {len(trace.get('pairs', []))} ordered pairs "
+          f"({added} beyond the static graph)")
+    holds = sorted(trace.get("holds", {}).items(),
+                   key=lambda kv: -kv[1])[:5]
+    if holds:
+        print("longest holds:")
+        for label, secs in holds:
+            print(f"  {secs * 1e3:9.1f} ms  {label}")
+
+
+def self_test() -> int:
+    ab = Edge(LockNode("pkg.mod.A", "la"), LockNode("pkg.mod.B", "lb"),
+              "pkg/mod.py", 10, "A.step() acquires lb while holding la",
+              "nested")
+    inverted = {"locks": ["pkg.mod.A.la", "pkg.mod.B.lb"],
+                "pairs": [{"src": "pkg.mod.B.lb", "dst": "pkg.mod.A.la",
+                           "count": 3, "thread": "worker-1"}],
+                "holds": {"pkg.mod.A.la": 0.002}}
+    graph = LockGraph()
+    graph.add(ab)
+    merge_trace(graph, inverted)
+    if not check(graph):
+        print("self-test: injected ABBA (static la->lb + runtime "
+              "lb->la) did NOT trip the gate", file=sys.stderr)
+        return 1
+    aligned = {"locks": inverted["locks"],
+               "pairs": [{"src": "pkg.mod.A.la", "dst": "pkg.mod.B.lb",
+                          "count": 3, "thread": "worker-1"}],
+               "holds": {}}
+    graph = LockGraph()
+    graph.add(ab)
+    merge_trace(graph, aligned)
+    if check(graph):
+        print("self-test: order-aligned trace tripped the gate",
+              file=sys.stderr)
+        return 1
+    if _vacuous({"locks": [], "pairs": [], "holds": {}}) is None:
+        print("self-test: empty trace (zero locks constructed) was "
+              "accepted", file=sys.stderr)
+        return 1
+    print("lock-gate self-test: injected ABBA trips the gate; aligned "
+          "trace passes; empty trace fails loudly")
+    return 0
+
+
+def _vacuous(trace: dict) -> str | None:
+    if not trace.get("locks"):
+        return ("trace recorded zero lock constructions — the "
+                "locktrace hook did not install (a gate that cannot "
+                "fail is decoration); check FUSIONINFER_LOCKTRACE "
+                "wiring in tests/conftest.py")
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--self-test":
+        return self_test()
+    if len(argv) > 1:
+        print("usage: check_lock_order.py [trace.json] | --self-test",
+              file=sys.stderr)
+        return 2
+    trace = None
+    added = 0
+    graph = static_graph()
+    if argv:
+        path = pathlib.Path(argv[0])
+        if not path.exists():
+            print(f"{path}: no lock trace — run the test tier with "
+                  "FUSIONINFER_LOCKTRACE set (make lock-gate does)",
+                  file=sys.stderr)
+            return 2
+        trace = json.loads(path.read_text())
+        problem = _vacuous(trace)
+        if problem is not None:
+            print(f"lock-order: {problem}", file=sys.stderr)
+            return 1
+        added = merge_trace(graph, trace)
+    report(graph, trace, added)
+    problems = check(graph)
+    for p in problems:
+        print(f"lock-order: deadlock-capable cycle:\n{p}",
+              file=sys.stderr)
+    if problems:
+        return 1
+    half = "static+runtime" if trace is not None else "static"
+    print(f"lock-order: merged {half} graph is cycle-free")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

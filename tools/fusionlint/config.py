@@ -76,6 +76,35 @@ LOCK_DISCIPLINE_MODULES = [
     "fusioninfer_tpu/*/*.py",
 ]
 
+# -- thread-safety passes (lock-order / lock-blocking) -----------------
+
+# the whole-program lock-acquisition graph's input (the package; tests
+# and tools spin up throwaway locks constantly and would drown the
+# graph in dead nodes — same scoping rationale as lock-discipline)
+LOCK_ORDER_MODULES = [
+    "fusioninfer_tpu/*.py",
+    "fusioninfer_tpu/*/*.py",
+]
+
+# serving-path modules where a blocking call under a held lock stalls
+# handler threads / the step loop / the control loop behind one peer —
+# the critical-section promotion of the missing-timeout rule
+LOCK_BLOCKING_MODULES = [
+    "fusioninfer_tpu/engine/*.py",
+    "fusioninfer_tpu/router/*.py",
+    "fusioninfer_tpu/autoscale/*.py",
+    "fusioninfer_tpu/operator/manager.py",
+    "fusioninfer_tpu/informers.py",
+    "fusioninfer_tpu/fleetsim/*.py",
+]
+
+# network-blocking callables never sanctioned under a lock (timeout or
+# not — a critical section must not wait on a peer)
+LOCK_BLOCKING_NETWORK = (
+    "urlopen", "create_connection", "getresponse", "recv", "sendall",
+    "accept", "connect",
+)
+
 # -- render-purity pass ------------------------------------------------
 
 # manifest-producing modules: the reconciler's idempotency contract is

@@ -1,0 +1,725 @@
+"""Whole-program lock-acquisition graph — the analysis core of the
+thread-safety passes (``lock-order`` / ``lock-blocking``) and of
+``tools/check_lock_order.py``'s static half.
+
+The ``lock-discipline`` pass (PR 3) answers "is this shared attribute
+touched without its lock?" *within one class*.  Nothing answered the
+question that actually hangs a pod: "can two threads acquire these
+locks in opposite orders?"  A deadlock is a silent revocation with no
+notice budget — the whole serving loop stops and monitoring sees an
+idle, healthy process.  This module makes lock *ordering* a checkable
+artifact:
+
+* **Nodes** are lock allocation sites resolved to a stable identity
+  ``(owning scope, attr)`` — ``fusioninfer_tpu.engine.kv_host_tier.
+  KVHostTier._lock`` — via the same def-use layer the trace-boundary
+  passes use (``lock = threading.Lock(); self._lock = lock`` resolves
+  through the local; ``object.__setattr__(self, "_lock", …)`` in frozen
+  dataclasses resolves through the constant; ``threading.Condition(
+  self._lock)`` aliases to the lock it wraps).  Module-level and
+  function-scope locks get the module / function qualname as owner, so
+  the runtime twin (:mod:`fusioninfer_tpu.utils.locktrace`) derives the
+  SAME labels from frames and the two graphs merge by string equality.
+* **Edges** mean "held src while acquiring dst", from two sources:
+  lexically nested ``with`` acquisitions, and **one level of
+  interprocedural resolution** — a call made while a lock is held,
+  resolved through the shared per-module index (receiver ``self``, a
+  ``self.<attr>`` whose class is known from constructor assignments or
+  parameter annotations, a local constructed from a class, or a
+  module-level function), contributing the callee's own lexical
+  acquisitions.  Methods named ``*_locked`` follow the project
+  convention (caller holds the lock): they are never treated as
+  re-acquiring their own class lock.
+* **Cycles** — every strongly connected component yields one
+  representative cycle with a witness per edge (file:line plus the
+  holding/acquiring functions), so an ABBA report shows *both* paths.
+  A self-edge on a non-reentrant lock (acquiring a ``Lock`` you already
+  hold) is a cycle of length one: self-deadlock.
+
+The index is cached per :class:`~tools.fusionlint.core.Module` (the
+jitsites pattern), so the two passes and the gate share one scan.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tools.fusionlint.core import Module, callee_name
+from tools.fusionlint.dataflow import DefUse, ProvenanceAnalysis
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
+_REENTRANT_FACTORIES = {"RLock"}
+_CONDITION_FACTORY = "Condition"
+
+
+@dataclass(frozen=True)
+class LockNode:
+    """One lock allocation site.  ``owner`` is the dotted scope that
+    owns it (``pkg.module.Class`` for attributes, ``pkg.module`` or
+    ``pkg.module.func`` for module/function-scope locks); ``attr`` is
+    the attribute or local name.  Equality is (owner, attr) — the
+    stable identity the runtime twin reconstructs from frames."""
+
+    owner: str
+    attr: str
+    reentrant: bool = field(default=False, compare=False)
+
+    @property
+    def label(self) -> str:
+        return f"{self.owner}.{self.attr}"
+
+
+@dataclass(frozen=True)
+class Edge:
+    """``src`` held while ``dst`` acquired.  ``via`` is the witness
+    sentence; ``path``/``line`` anchor it (the acquisition site for
+    static edges)."""
+
+    src: LockNode
+    dst: LockNode
+    path: str
+    line: int
+    via: str
+    kind: str  # "nested" | "call" | "runtime"
+
+
+@dataclass
+class CallSite:
+    """A call made while >= 1 lock is held (also feeds lock-blocking)."""
+
+    call: ast.Call
+    held: tuple[tuple[LockNode, int], ...]  # (node, acquired-at line)
+    line: int
+    # resolution hint: ("self", meth) | ("attr", attr, meth) |
+    # ("class", ClassName, meth) | ("func", name) | None
+    target: Optional[tuple]
+
+
+@dataclass
+class FuncScan:
+    """Scan result for one function/method body."""
+
+    qualname: str  # Class.meth or func (dotted for nested defs)
+    name: str
+    rel: str
+    line: int
+    acquires: list[tuple[LockNode, int]] = field(default_factory=list)
+    calls_under: list[CallSite] = field(default_factory=list)
+    du: Optional[DefUse] = None
+    params: dict[str, str] = field(default_factory=dict)  # arg -> class
+
+
+@dataclass
+class ClassIndex:
+    module: str  # dotted
+    rel: str
+    name: str
+    line: int
+    locks: dict[str, LockNode] = field(default_factory=dict)
+    attr_classes: dict[str, str] = field(default_factory=dict)
+    methods: dict[str, FuncScan] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleLockIndex:
+    rel: str
+    dotted: str
+    imports: dict[str, str] = field(default_factory=dict)  # name -> module
+    classes: dict[str, ClassIndex] = field(default_factory=dict)
+    module_locks: dict[str, LockNode] = field(default_factory=dict)
+    functions: dict[str, FuncScan] = field(default_factory=dict)
+    edges: list[Edge] = field(default_factory=list)  # nested-with edges
+
+
+def dotted_of(rel: str) -> str:
+    """``fusioninfer_tpu/engine/server.py`` →
+    ``fusioninfer_tpu.engine.server`` (``__init__`` collapses to the
+    package)."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _lock_factory_of(expr: ast.expr) -> Optional[str]:
+    """``threading.Lock()`` / ``Lock()`` → ``"Lock"``; Condition and
+    friends included; None for anything else."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = callee_name(expr.func)
+    if name in _LOCK_FACTORIES or name == _CONDITION_FACTORY:
+        return name
+    return None
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _setattr_target(call: ast.Call) -> Optional[str]:
+    """``object.__setattr__(self, "_lock", …)`` → ``"_lock"`` (the
+    frozen-dataclass assignment form, resilience/retry.py)."""
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "__setattr__"
+            and len(call.args) == 3
+            and isinstance(call.args[0], ast.Name)
+            and call.args[0].id == "self"
+            and isinstance(call.args[1], ast.Constant)
+            and isinstance(call.args[1].value, str)):
+        return call.args[1].value
+    return None
+
+
+def _ann_name(ann: Optional[ast.expr]) -> Optional[str]:
+    """Terminal class name of a parameter annotation (``KVHostTier``,
+    ``Optional[KVHostTier]`` → ``KVHostTier``)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.Subscript):
+        # Optional[X] / "X | None" style — first Name inside
+        for sub in ast.walk(ann):
+            if isinstance(sub, ast.Name) and sub.id[:1].isupper():
+                return sub.id
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split(".")[-1] or None
+    return None
+
+
+_ANALYSIS = ProvenanceAnalysis()
+
+
+def _resolve_local(du: Optional[DefUse], name: str) -> Optional[ast.expr]:
+    """Latest static rhs bound to ``name`` in this body (def-use layer;
+    flow-insensitive last-def is enough for alias resolution)."""
+    if du is None:
+        return None
+    defs = du.defs.get(name, [])
+    for d in reversed(defs):
+        if d.value is not None:
+            return d.value
+    return None
+
+
+class _BodyScanner:
+    """Held-stack walk of one function/method body: records lexical
+    acquisitions, nested-with edges, and every call made under a held
+    lock (with a receiver-resolution hint for the interprocedural
+    phase)."""
+
+    def __init__(self, scan: FuncScan, index: ModuleLockIndex,
+                 cls: Optional[ClassIndex],
+                 local_locks: dict[str, LockNode]):
+        self.scan = scan
+        self.index = index
+        self.cls = cls
+        self.local_locks = local_locks  # incl. enclosing function scopes
+
+    # -- lock resolution --
+
+    def _lock_of(self, expr: ast.expr) -> Optional[LockNode]:
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            return self.cls.locks.get(attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return self.local_locks[expr.id]
+            if expr.id in self.index.module_locks:
+                return self.index.module_locks[expr.id]
+            rhs = _resolve_local(self.scan.du, expr.id)
+            if rhs is not None and rhs is not expr:
+                return self._lock_of(rhs)
+        return None
+
+    # -- target hint for calls --
+
+    def _target_of(self, func: ast.expr) -> Optional[tuple]:
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    return ("self", func.attr)
+                rhs = _resolve_local(self.scan.du, base.id)
+                if rhs is not None:
+                    a = _self_attr(rhs)
+                    if a is not None:
+                        return ("attr", a, func.attr)
+                    if isinstance(rhs, ast.Call):
+                        c = callee_name(rhs.func)
+                        if c and c[:1].isupper():
+                            return ("class", c, func.attr)
+                ann = self.scan.params.get(base.id)
+                if ann is not None:
+                    return ("class", ann, func.attr)
+                return None
+            a = _self_attr(base)
+            if a is not None:
+                return ("attr", a, func.attr)
+            return None
+        if isinstance(func, ast.Name):
+            return ("func", func.id)
+        return None
+
+    # -- walk --
+
+    def walk(self, stmts: list[ast.stmt],
+             held: tuple[tuple[LockNode, int], ...] = ()) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _acquire(self, node: LockNode, line: int,
+                 held: tuple[tuple[LockNode, int], ...]
+                 ) -> tuple[tuple[LockNode, int], ...]:
+        self.scan.acquires.append((node, line))
+        for h, hline in held:
+            if h == node and node.reentrant:
+                continue
+            self.index.edges.append(Edge(
+                h, node, self.scan.rel, line,
+                f"{self.scan.qualname}() acquires {node.label} "
+                f"({self.scan.rel}:{line}) while holding {h.label} "
+                f"(acquired {self.scan.rel}:{hline})",
+                "nested"))
+        return held + ((node, line),)
+
+    def _stmt(self, node: ast.stmt, held) -> None:
+        if isinstance(node, ast.With):
+            h = held
+            for item in node.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    h = self._acquire(lock, item.context_expr.lineno, h)
+                else:
+                    self._expr(item.context_expr, h)
+            self.walk(node.body, h)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs scanned as their own FuncScan (they
+            # run when called, possibly after the lock was released)
+        if isinstance(node, ast.ClassDef):
+            return
+        for _f, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self._stmt(v, held)
+                    elif isinstance(v, ast.expr):
+                        self._expr(v, held)
+                    elif isinstance(v, ast.ExceptHandler):
+                        if v.type is not None:
+                            self._expr(v.type, held)
+                        self.walk(v.body, held)
+                    elif hasattr(v, "body") and isinstance(
+                            getattr(v, "body"), list):
+                        self.walk(v.body, held)  # match_case
+            elif isinstance(value, ast.expr):
+                self._expr(value, held)
+
+    def _expr(self, node: ast.expr, held) -> None:
+        if isinstance(node, ast.Lambda):
+            return  # runs later, not under this lock
+        if isinstance(node, ast.Call) and held:
+            self.scan.calls_under.append(CallSite(
+                node, held, node.lineno, self._target_of(node.func)))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.comprehension):
+                self._expr(child.iter, held)
+                for c in child.ifs:
+                    self._expr(c, held)
+
+
+def _scan_functions(owner_qual: str, body: list[ast.stmt],
+                    index: ModuleLockIndex, cls: Optional[ClassIndex],
+                    rel: str, enclosing_locks: dict[str, LockNode],
+                    out: dict[str, FuncScan]) -> None:
+    """Scan every (nested) def in ``body``; function-scope lock locals
+    are visible to nested defs (the loadgen closure pattern)."""
+    for stmt in body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = f"{owner_qual}.{stmt.name}" if owner_qual else stmt.name
+        scan = FuncScan(qualname=qual, name=stmt.name, rel=rel,
+                        line=stmt.lineno)
+        scan.du = _ANALYSIS.analyze(stmt)
+        for a in (stmt.args.posonlyargs + stmt.args.args
+                  + stmt.args.kwonlyargs):
+            ann = _ann_name(a.annotation)
+            if ann is not None:
+                scan.params[a.arg] = ann
+        # function-scope lock locals: lock = threading.Lock()
+        local_locks = dict(enclosing_locks)
+        dotted = index.dotted
+        for sub in ast.walk(stmt):
+            if (isinstance(sub, ast.Assign)
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)):
+                kind = _lock_factory_of(sub.value)
+                if kind is not None:
+                    local_locks[sub.targets[0].id] = LockNode(
+                        f"{dotted}.{qual}", sub.targets[0].id,
+                        reentrant=kind in _REENTRANT_FACTORIES)
+        scanner = _BodyScanner(scan, index, cls, local_locks)
+        scanner.walk(stmt.body)
+        out[stmt.name] = scan
+        _scan_functions(qual, stmt.body, index, cls, rel, local_locks, out)
+
+
+def _collect_class_locks(cls_node: ast.ClassDef, ci: ClassIndex) -> None:
+    """Phase 1 over a class: lock attributes (factory assignments, the
+    def-use-resolved local form, the ``object.__setattr__`` form),
+    Condition aliases, and attr → class constructor bindings."""
+    owner = f"{ci.module}.{ci.name}"
+    pending_aliases: list[tuple[str, str]] = []  # (cv_attr, lock_attr)
+    for m in cls_node.body:
+        if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        du = _ANALYSIS.analyze(m)
+        param_ann = {
+            a.arg: _ann_name(a.annotation)
+            for a in (m.args.posonlyargs + m.args.args + m.args.kwonlyargs)
+        }
+
+        def rhs_of(value: ast.expr) -> ast.expr:
+            # resolve one level through a local (def-use layer)
+            if isinstance(value, ast.Name):
+                r = _resolve_local(du, value.id)
+                if r is not None:
+                    return r
+            return value
+
+        for node in ast.walk(m):
+            targets: list[tuple[str, ast.expr]] = []
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    a = _self_attr(tgt)
+                    if a is not None:
+                        targets.append((a, node.value))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                a = _self_attr(node.target)
+                if a is not None:
+                    targets.append((a, node.value))
+            elif isinstance(node, ast.Call):
+                a = _setattr_target(node)
+                if a is not None:
+                    targets.append((a, node.args[2]))
+            for attr, raw in targets:
+                value = rhs_of(raw)
+                kind = _lock_factory_of(value)
+                if kind == _CONDITION_FACTORY:
+                    assert isinstance(value, ast.Call)
+                    arg = value.args[0] if value.args else None
+                    aliased = _self_attr(arg) if arg is not None else None
+                    if aliased is not None:
+                        pending_aliases.append((attr, aliased))
+                    else:
+                        # Condition() owns an RLock internally
+                        ci.locks[attr] = LockNode(owner, attr,
+                                                  reentrant=True)
+                elif kind is not None:
+                    ci.locks[attr] = LockNode(
+                        owner, attr, reentrant=kind in _REENTRANT_FACTORIES)
+                elif isinstance(value, ast.Call):
+                    c = callee_name(value.func)
+                    if c and c[:1].isupper() and c not in _LOCK_FACTORIES:
+                        ci.attr_classes.setdefault(attr, c)
+                elif isinstance(value, ast.Name):
+                    ann = param_ann.get(value.id)
+                    if ann:
+                        ci.attr_classes.setdefault(attr, ann)
+    for cv, lock in pending_aliases:
+        if lock in ci.locks:
+            ci.locks[cv] = ci.locks[lock]
+        else:
+            ci.locks[cv] = LockNode(owner, cv, reentrant=True)
+
+
+def index_module(mod: Module) -> ModuleLockIndex:
+    """Build (and cache) the lock index for one module."""
+    cached = getattr(mod, "_lock_index", None)
+    if cached is not None:
+        return cached
+    tree = mod.tree
+    assert tree is not None
+    index = ModuleLockIndex(rel=mod.rel, dotted=dotted_of(mod.rel))
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                index.imports[alias.asname or alias.name] = node.module
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            kind = _lock_factory_of(node.value)
+            if kind is not None:
+                name = node.targets[0].id
+                index.module_locks[name] = LockNode(
+                    index.dotted, name,
+                    reentrant=kind in _REENTRANT_FACTORIES
+                    or kind == _CONDITION_FACTORY)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            ci = ClassIndex(module=index.dotted, rel=mod.rel,
+                            name=node.name, line=node.lineno)
+            _collect_class_locks(node, ci)
+            _scan_functions(node.name, list(node.body), index, ci,
+                            mod.rel, {}, ci.methods)
+            index.classes[node.name] = ci
+    _scan_functions("", tree.body, index, None, mod.rel, {},
+                    index.functions)
+    mod._lock_index = index
+    return index
+
+
+# -- whole-program graph ----------------------------------------------
+
+
+@dataclass
+class LockGraph:
+    nodes: set[LockNode] = field(default_factory=set)
+    edges: list[Edge] = field(default_factory=list)
+    _seen: set[tuple] = field(default_factory=set)
+
+    def add(self, edge: Edge) -> None:
+        key = (edge.src, edge.dst, edge.path, edge.line, edge.kind)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.nodes.add(edge.src)
+        self.nodes.add(edge.dst)
+        self.edges.append(edge)
+
+    def successors(self) -> dict[LockNode, list[Edge]]:
+        out: dict[LockNode, list[Edge]] = {}
+        for e in self.edges:
+            out.setdefault(e.src, []).append(e)
+        return out
+
+
+def _resolve_class(name: str, home: ModuleLockIndex,
+                   by_name: dict[str, list[ClassIndex]],
+                   by_module: dict[str, ModuleLockIndex]
+                   ) -> Optional[ClassIndex]:
+    if name in home.classes:
+        return home.classes[name]
+    target = home.imports.get(name)
+    if target is not None:
+        tmod = by_module.get(target)
+        if tmod is not None and name in tmod.classes:
+            return tmod.classes[name]
+    cands = by_name.get(name, [])
+    if len(cands) == 1:
+        return cands[0]
+    return None
+
+
+def build_graph(modules: list[Module]) -> LockGraph:
+    """Index every module, then close the graph: nested-with edges come
+    straight from the scans; call edges resolve each under-lock call one
+    interprocedural level to the callee's lexical acquisitions."""
+    indexes = [index_module(m) for m in modules if m.tree is not None]
+    by_module = {ix.dotted: ix for ix in indexes}
+    by_name: dict[str, list[ClassIndex]] = {}
+    for ix in indexes:
+        for ci in ix.classes.values():
+            by_name.setdefault(ci.name, []).append(ci)
+
+    graph = LockGraph()
+    for ix in indexes:
+        for e in ix.edges:
+            graph.add(e)
+    for ix in indexes:
+        scopes: list[tuple[Optional[ClassIndex], FuncScan]] = []
+        for ci in ix.classes.values():
+            scopes.extend((ci, s) for s in ci.methods.values())
+        scopes.extend((None, s) for s in ix.functions.values())
+        for ci, scan in scopes:
+            for cs in scan.calls_under:
+                callee = _resolve_callee(cs, ci, ix, by_name, by_module)
+                if callee is None:
+                    continue
+                target_cls, target = callee
+                for node, tline in target.acquires:
+                    # *_locked convention: the callee expects its class
+                    # lock held — _scan callers never treat the name as
+                    # re-acquiring — but acquisitions of OTHER locks
+                    # inside it still happened lexically and are edges.
+                    for h, _hline in cs.held:
+                        if h == node and node.reentrant:
+                            continue
+                        where = (f"{target_cls.name}.{target.name}"
+                                 if target_cls is not None else target.name)
+                        graph.add(Edge(
+                            h, node, scan.rel, cs.line,
+                            f"{scan.qualname}() holds {h.label} and calls "
+                            f"{where}() ({scan.rel}:{cs.line}), which "
+                            f"acquires {node.label} ({target.rel}:{tline})",
+                            "call"))
+    return graph
+
+
+def _resolve_callee(cs: CallSite, ci: Optional[ClassIndex],
+                    ix: ModuleLockIndex,
+                    by_name: dict[str, list[ClassIndex]],
+                    by_module: dict[str, ModuleLockIndex]
+                    ) -> Optional[tuple[Optional[ClassIndex], FuncScan]]:
+    t = cs.target
+    if t is None:
+        return None
+    if t[0] == "self" and ci is not None:
+        scan = ci.methods.get(t[1])
+        return (ci, scan) if scan is not None else None
+    if t[0] == "attr" and ci is not None:
+        cname = ci.attr_classes.get(t[1])
+        if cname is None:
+            return None
+        target_ci = _resolve_class(cname, ix, by_name, by_module)
+        if target_ci is None:
+            return None
+        scan = target_ci.methods.get(t[2])
+        return (target_ci, scan) if scan is not None else None
+    if t[0] == "class":
+        target_ci = _resolve_class(t[1], ix, by_name, by_module)
+        if target_ci is None:
+            return None
+        scan = target_ci.methods.get(t[2])
+        return (target_ci, scan) if scan is not None else None
+    if t[0] == "func":
+        scan = ix.functions.get(t[1])
+        if scan is not None:
+            return (None, scan)
+        # bare ClassName(...) construction: __init__ may acquire
+        target_ci = _resolve_class(t[1], ix, by_name, by_module)
+        if target_ci is not None:
+            init = target_ci.methods.get("__init__")
+            if init is not None:
+                return (target_ci, init)
+    return None
+
+
+# -- cycles ------------------------------------------------------------
+
+
+@dataclass
+class Cycle:
+    nodes: tuple[LockNode, ...]
+    edges: tuple[Edge, ...]
+
+    def describe(self) -> str:
+        ring = " -> ".join(n.label for n in self.nodes)
+        ring += f" -> {self.nodes[0].label}"
+        lines = [ring]
+        for e in self.edges:
+            lines.append(f"  {e.via}")
+        return "\n".join(lines)
+
+
+def _tarjan_sccs(succ: dict[LockNode, list[Edge]],
+                 nodes: set[LockNode]) -> list[list[LockNode]]:
+    index: dict[LockNode, int] = {}
+    low: dict[LockNode, int] = {}
+    on_stack: set[LockNode] = set()
+    stack: list[LockNode] = []
+    sccs: list[list[LockNode]] = []
+    counter = [0]
+
+    def strongconnect(v: LockNode) -> None:
+        # iterative Tarjan (deep graphs must not hit the recursion cap)
+        work = [(v, iter(succ.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for edge in it:
+                w = edge.dst
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(succ.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in sorted(nodes, key=lambda n: n.label):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def find_cycles(graph: LockGraph) -> list[Cycle]:
+    """One representative cycle per SCC (every edge with its witness),
+    plus every non-reentrant self-edge as a length-1 cycle."""
+    succ = graph.successors()
+    cycles: list[Cycle] = []
+    for e in graph.edges:
+        if e.src == e.dst and not e.src.reentrant:
+            cycles.append(Cycle((e.src,), (e,)))
+    for scc in _tarjan_sccs(succ, graph.nodes):
+        if len(scc) < 2:
+            continue
+        members = set(scc)
+        start = min(scc, key=lambda n: n.label)
+        # BFS within the SCC from start back to start, tracking the
+        # first edge used into each node — shortest witness ring
+        parent: dict[LockNode, Edge] = {}
+        frontier = [start]
+        closed: Optional[Edge] = None
+        visited = {start}
+        while frontier and closed is None:
+            nxt: list[LockNode] = []
+            for u in frontier:
+                for edge in succ.get(u, ()):
+                    if edge.dst not in members:
+                        continue
+                    if edge.dst == start:
+                        closed = edge
+                        break
+                    if edge.dst not in visited:
+                        visited.add(edge.dst)
+                        parent[edge.dst] = edge
+                        nxt.append(edge.dst)
+                if closed is not None:
+                    break
+            frontier = nxt
+        if closed is None:
+            continue  # SCC held together only by self-loops
+        ring_edges = [closed]
+        cur = closed.src
+        while cur != start:
+            edge = parent[cur]
+            ring_edges.append(edge)
+            cur = edge.src
+        ring_edges.reverse()
+        ring_nodes = tuple(e.src for e in ring_edges)
+        cycles.append(Cycle(ring_nodes, tuple(ring_edges)))
+    return cycles

@@ -14,7 +14,9 @@ from tools.fusionlint.passes.conditionsvocab import ConditionsVocabularyPass
 from tools.fusionlint.passes.hostsync import HostSyncPass
 from tools.fusionlint.passes.hygiene import HygienePass
 from tools.fusionlint.passes.jitregistry import JitRegistryPass
+from tools.fusionlint.passes.lockblocking import LockBlockingPass
 from tools.fusionlint.passes.lockdiscipline import LockDisciplinePass
+from tools.fusionlint.passes.lockorder import LockOrderPass
 from tools.fusionlint.passes.metricsconv import MetricsConventionsPass
 from tools.fusionlint.passes.renderpurity import RenderPurityPass
 from tools.fusionlint.passes.resilience import ResiliencePass
@@ -26,6 +28,8 @@ ALL_PASSES = [
     HygienePass,
     ResiliencePass,
     LockDisciplinePass,
+    LockOrderPass,
+    LockBlockingPass,
     RenderPurityPass,
     MetricsConventionsPass,
     ConditionsVocabularyPass,

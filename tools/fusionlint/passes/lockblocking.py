@@ -1,0 +1,155 @@
+"""lock-blocking pass — nothing slow happens while a lock is held.
+
+A lock held across a blocking operation turns one stuck peer into a
+stuck *pod*: every handler thread piles up behind the critical section,
+the step loop stalls behind the handlers, and monitoring sees a
+healthy, idle process (the failure mode ``missing-timeout`` guards at
+the call level, promoted to the critical-section level).  In the
+serving-path modules (``config.LOCK_BLOCKING_MODULES``) this pass
+flags, at any call site where the :mod:`tools.fusionlint.lockgraph`
+scan proves a lock is held:
+
+* **network I/O** — ``urlopen`` / ``create_connection`` /
+  ``getresponse`` / socket ``recv``/``sendall``/``accept``/``connect``
+  (``config.LOCK_BLOCKING_NETWORK``): never under a lock, timeout or
+  not;
+* **device syncs** — ``.item()`` / ``.tolist()`` /
+  ``.block_until_ready()`` on device-provenance values,
+  ``jax.device_get``, ``np.asarray(device_value)`` — the host-sync
+  rule's fetch set, which under a lock also serializes every thread
+  that wants the lock behind a device round-trip;
+* **unbounded waits** — zero-arg ``queue.get()`` / ``.wait()`` /
+  ``.join()`` with no timeout, and ``sleep()`` — held-lock sleeps are
+  priority inversion by construction.
+
+``cv.wait()`` on the *same* condition that is the only lock held is
+the designed condition-variable pattern (wait releases it) and stays
+quiet.  Suppression is ``# noqa:lock-blocking — <why bounded>`` with
+the justification required by review convention.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.fusionlint import config
+from tools.fusionlint.core import REPO, Finding, LintPass, Module, callee_name
+from tools.fusionlint.dataflow import Prov, ProvenanceAnalysis
+from tools.fusionlint.passes.jitregistry import entry_name, load_registry
+from tools.fusionlint.lockgraph import (
+    CallSite,
+    ClassIndex,
+    FuncScan,
+    index_module,
+)
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_WAIT_METHODS = {"get", "wait", "join"}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if call.args:
+        return True  # q.get(True, 5) / ev.wait(5.0) / t.join(2)
+    return any(kw.arg in ("timeout", None) for kw in call.keywords)
+
+
+class LockBlockingPass(LintPass):
+    name = "lock-blocking"
+    rules = ("lock-blocking",)
+
+    def __init__(self, modules: list[str] | None = None,
+                 network: tuple[str, ...] | None = None):
+        self.module_globs = (config.LOCK_BLOCKING_MODULES
+                             if modules is None else modules)
+        self.network = (config.LOCK_BLOCKING_NETWORK
+                        if network is None else network)
+        # jit-registry entries are device callees (the hostsync seed):
+        # `x = step(...); … x.item()` under a lock is a device sync
+        path = pathlib.Path(config.JIT_REGISTRY_MODULE)
+        if not path.is_absolute():
+            path = REPO / path
+        try:
+            registry = load_registry(path)
+        except (OSError, SyntaxError, KeyError):
+            registry = {}
+        self.analysis = ProvenanceAnalysis(
+            device_callees={entry_name(key) for key in registry})
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if not mod.matches(self.module_globs):
+            return []
+        index = index_module(mod)
+        scopes: list[tuple[ClassIndex | None, FuncScan]] = []
+        for ci in index.classes.values():
+            scopes.extend((ci, s) for s in ci.methods.values())
+        scopes.extend((None, s) for s in index.functions.values())
+        findings: list[Finding] = []
+        seen: set[tuple[int, str]] = set()
+        for ci, scan in scopes:
+            for cs in scan.calls_under:
+                what = self._classify(cs, ci, scan)
+                if what is None:
+                    continue
+                key = (cs.line, what)
+                if key in seen:
+                    continue
+                seen.add(key)
+                held = ", ".join(h.label for h, _l in cs.held)
+                findings.append(Finding(
+                    "lock-blocking", mod.rel, cs.line,
+                    f"{what} inside {scan.qualname}() while holding "
+                    f"{held} — every thread contending for the lock "
+                    "blocks behind it; move the operation outside the "
+                    "critical section or bound it (suppress only with "
+                    "a justified # noqa:lock-blocking)"))
+        findings.sort(key=lambda f: (f.line, f.message))
+        return findings
+
+    def _classify(self, cs: CallSite, ci: ClassIndex | None,
+                  scan: FuncScan) -> str | None:
+        call = cs.call
+        func = call.func
+        name = callee_name(func)
+        if name in self.network:
+            return f"network I/O ({name}())"
+        if name == "sleep":
+            return "sleep()"
+        if isinstance(func, ast.Attribute):
+            root = func.value
+            if (func.attr == "device_get" and isinstance(root, ast.Name)
+                    and root.id == "jax"):
+                return "device sync (jax.device_get())"
+            if (func.attr == "asarray" and isinstance(root, ast.Name)
+                    and root.id in ("np", "numpy") and call.args
+                    and self._prov(call.args[0], scan) is Prov.DEVICE):
+                return "device sync (np.asarray() on a device value)"
+            if (func.attr in _SYNC_METHODS
+                    and self._prov(root, scan) is Prov.DEVICE):
+                return f"device sync (.{func.attr}() on a device value)"
+            if func.attr in _WAIT_METHODS and not _has_timeout(call):
+                if func.attr == "get" and call.keywords:
+                    return None  # q.get(block=False) and friends
+                if func.attr == "wait" and self._is_sole_held_cv(
+                        root, ci, cs):
+                    return None  # condition wait releases its own lock
+                return (f"unbounded .{func.attr}() (no timeout)")
+        return None
+
+    def _prov(self, expr: ast.expr, scan: FuncScan) -> Prov:
+        if scan.du is None:
+            return Prov.UNKNOWN
+        return self.analysis.prov_of(expr, scan.du, order=1 << 30)
+
+    def _is_sole_held_cv(self, receiver: ast.expr,
+                         ci: ClassIndex | None, cs: CallSite) -> bool:
+        """``with self._cv: … self._cv.wait()`` with nothing else held:
+        the sanctioned CV pattern."""
+        if ci is None or len(cs.held) != 1:
+            return False
+        if (isinstance(receiver, ast.Attribute)
+                and isinstance(receiver.value, ast.Name)
+                and receiver.value.id == "self"):
+            node = ci.locks.get(receiver.attr)
+            return node is not None and node == cs.held[0][0]
+        return False

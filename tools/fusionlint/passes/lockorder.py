@@ -1,0 +1,92 @@
+"""lock-order pass — whole-program deadlock-freedom as a lint gate.
+
+``lock-discipline`` (PR 3) checks that shared state is touched under
+*its* lock; nothing checked that two locks are always taken in the
+same order.  An ABBA inversion — thread 1 holds A and wants B, thread
+2 holds B and wants A — hangs the whole pod with zero errors: the
+serving twin of a revoked slice, except nothing ever restarts it.
+
+This pass runs the :mod:`tools.fusionlint.lockgraph` analysis over the
+whole package (``config.LOCK_ORDER_MODULES``) and reports every cycle
+in the merged acquisition graph, with one witness per edge so an ABBA
+report carries *both* paths.  Because the property is whole-program,
+the pass augments the linted file set with every in-scope module — in
+``--changed`` mode a one-file diff that closes a cycle against an
+unchanged file is still caught — but only reports cycles with at least
+one witness edge in the explicitly linted set, so pre-existing cycles
+elsewhere never block an unrelated diff (the same contract as the CI
+``--changed`` gate).
+
+A finding anchors at its lexically first witness edge in the linted
+set; suppression is ``# noqa:lock-order — <why this cannot deadlock>``
+on that line (justification required by review convention, as for
+``lock-discipline``).  The fix is almost never a suppression: give the
+two locks a global order, or collapse them into one.
+"""
+
+from __future__ import annotations
+
+from tools.fusionlint import config
+from tools.fusionlint.core import (
+    REPO,
+    Finding,
+    LintPass,
+    Module,
+    collect_files,
+)
+from tools.fusionlint.lockgraph import build_graph, find_cycles
+
+
+class LockOrderPass(LintPass):
+    name = "lock-order"
+    rules = ("lock-order",)
+
+    def __init__(self, scope: list[str] | None = None):
+        # scope=[] (fixture tests): graph over exactly the given files
+        self.scope = (config.LOCK_ORDER_MODULES
+                      if scope is None else scope)
+
+    def _scope_modules(self, modules: list[Module]) -> list[Module]:
+        """The graph's input: every in-scope module, whether or not it
+        was in the linted set (whole-program property), plus — when the
+        pass runs scope-less in a fixture — the given files."""
+        if not self.scope:
+            return modules
+        have = {m.rel for m in modules}
+        out = [m for m in modules if m.matches(self.scope)]
+        for f in collect_files(["fusioninfer_tpu"]):
+            rel = str(f.relative_to(REPO)).replace("\\", "/")
+            if rel in have:
+                continue
+            m = Module(f)
+            if m.tree is not None and m.matches(self.scope):
+                out.append(m)
+        return out
+
+    def finalize(self, modules: list[Module]) -> list[Finding]:
+        linted = {m.rel for m in modules}
+        graph = build_graph(self._scope_modules(modules))
+        findings: list[Finding] = []
+        for cycle in find_cycles(graph):
+            anchors = [e for e in cycle.edges if e.path in linted]
+            if not anchors:
+                continue  # pre-existing cycle outside the linted diff
+            anchor = min(anchors, key=lambda e: (e.path, e.line))
+            ring = " -> ".join(n.label for n in cycle.nodes)
+            ring += f" -> {cycle.nodes[0].label}"
+            witnesses = "; ".join(e.via for e in cycle.edges)
+            if len(cycle.nodes) == 1:
+                msg = (f"self-deadlock: {cycle.nodes[0].label} is "
+                       f"non-reentrant and re-acquired while already "
+                       f"held — {witnesses}.  Drop the inner acquisition "
+                       "(use the *_locked convention) or make the lock "
+                       "an RLock")
+            else:
+                msg = (f"lock-order cycle: {ring} — two threads taking "
+                       f"these paths concurrently deadlock.  Witnesses: "
+                       f"{witnesses}.  Give the locks one global order "
+                       "or collapse them into one")
+            findings.append(Finding(
+                "lock-order", anchor.path, anchor.line, msg))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
